@@ -1,0 +1,75 @@
+"""CLI launcher — run a federated simulation from a YAML config.
+
+Parity target: reference ``e2e_trainer.py`` (invoked under
+``torch.distributed.run`` with ``-config -dataPath -outputPath -task``,
+``e2e_trainer.py:198-253``).  The TPU build is single-controller: no
+process launcher, no backend flag — the mesh spans whatever devices JAX
+sees (multi-host via ``jax.distributed``, see
+``msrflute_tpu.parallel.mesh.maybe_init_distributed``).
+
+Usage:
+    python e2e_trainer.py -config cfg.yaml -dataPath ./data \
+        -outputPath ./out -task cv_lr_mnist
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+
+import yaml
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-config", required=True)
+    ap.add_argument("-dataPath", default=None)
+    ap.add_argument("-outputPath", default="./output")
+    ap.add_argument("-task", default=None)
+    ap.add_argument("-num_skip_decoding", default=-1, type=int)  # parity arg
+    ap.add_argument("-backend", default="xla")  # parity arg; always XLA here
+    args = ap.parse_args()
+
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.engine import select_server
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.parallel.mesh import maybe_init_distributed
+    from msrflute_tpu.tasks import build_task_datasets
+    from msrflute_tpu.utils import init_logging, print_rank
+
+    maybe_init_distributed()
+
+    # output/models/log dir setup + config copy (reference e2e_trainer.py:222-235)
+    os.makedirs(args.outputPath, exist_ok=True)
+    model_dir = os.path.join(args.outputPath, "models")
+    log_dir = os.path.join(args.outputPath, "log")
+    os.makedirs(model_dir, exist_ok=True)
+    init_logging(log_dir)
+    shutil.copyfile(args.config,
+                    os.path.join(args.outputPath, os.path.basename(args.config)))
+
+    with open(args.config) as fh:
+        raw = yaml.safe_load(fh)
+    cfg = FLUTEConfig.from_dict(raw)
+    cfg.task = args.task or cfg.task
+    cfg.data_path = args.dataPath or cfg.data_path
+    cfg.output_path = args.outputPath
+    cfg.validate(cfg.data_path)
+
+    task = make_task(cfg.model_config)
+    train_ds, val_ds, test_ds = build_task_datasets(cfg, task)
+    print_rank(f"task={cfg.task} users={len(train_ds)} "
+               f"val={len(val_ds) if val_ds else 0} "
+               f"test={len(test_ds) if test_ds else 0}")
+
+    mesh = make_mesh(model_axis_size=int(cfg.mesh_config.get("model_axis_size", 1)))
+    server_cls = select_server(cfg.server_config.get("type", "optimization"))
+    server = server_cls(task, cfg, train_ds, val_dataset=val_ds,
+                        test_dataset=test_ds, model_dir=model_dir, mesh=mesh)
+    server.run()
+
+
+if __name__ == "__main__":
+    main()

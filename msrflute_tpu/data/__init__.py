@@ -1,0 +1,5 @@
+from .user_blob import load_user_blob, UserBlob  # noqa: F401
+from .dataset import BaseDataset, ArraysDataset  # noqa: F401
+from .batching import (  # noqa: F401
+    RoundBatch, pack_round_batches, pack_eval_batches, steps_for,
+)

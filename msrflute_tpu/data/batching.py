@@ -1,0 +1,158 @@
+"""Static-shape round batching — the TPU replacement for torch DataLoaders.
+
+Parity target: reference per-task ``dataloaders/dataloader.py`` + the
+samplers in ``utils/data_utils.py`` (``BatchSampler`` contiguous batches,
+``DynamicBatchSampler`` padding-efficiency batching) + the
+``desired_max_samples`` early stop (``core/trainer.py:363-364``).
+
+TPU-first design: a round's sampled clients become ONE array program input of
+static shape ``[K, S, B, ...]`` (K clients x S local steps x B batch) with a
+``[K, S, B]`` sample mask.  Ragged client sizes are absorbed by masking, not
+by Python-side dynamic batching, so the whole round jits once per (K, S, B)
+and never retraces.  Sample weights count only *real* samples — the mask sums
+reproduce FLUTE's ``num_samples`` aggregation weights exactly
+(``core/strategies/fedavg.py:61-91``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .dataset import BaseDataset
+
+
+@dataclass
+class RoundBatch:
+    """One round's client data as static-shape arrays.
+
+    arrays:       each ``[K, S, B, *feat]``
+    sample_mask:  ``[K, S, B]`` — 1.0 for real samples
+    num_samples:  ``[K]`` — real (capped) per-client sample counts
+    client_mask:  ``[K]`` — 1.0 for real clients, 0.0 for mesh padding
+    client_ids:   ``[K]`` — dataset user indices (-1 for padding)
+    """
+
+    arrays: Dict[str, np.ndarray]
+    sample_mask: np.ndarray
+    num_samples: np.ndarray
+    client_mask: np.ndarray
+    client_ids: np.ndarray
+
+    @property
+    def shape(self):
+        return self.sample_mask.shape
+
+
+def steps_for(max_samples: int, batch_size: int,
+              desired_max_samples: Optional[int] = None) -> int:
+    """Static local-step count S for a round program.
+
+    FLUTE stops a client's epoch once ``desired_max_samples`` is reached
+    (``core/trainer.py:363-364``); the static equivalent caps every client at
+    ``S*B`` samples where ``S = ceil(min(max, desired)/B)``.
+    """
+    cap = max_samples if desired_max_samples is None else min(
+        max_samples, desired_max_samples)
+    return max(1, math.ceil(cap / batch_size))
+
+
+def _pad_feat(sample_count: int, shape: tuple, dtype) -> np.ndarray:
+    return np.zeros((sample_count,) + shape, dtype=dtype)
+
+
+def pack_round_batches(
+    dataset: BaseDataset,
+    client_indices: Sequence[int],
+    batch_size: int,
+    max_steps: int,
+    rng: Optional[np.random.Generator] = None,
+    shuffle: bool = True,
+    pad_clients_to: Optional[int] = None,
+    desired_max_samples: Optional[int] = None,
+) -> RoundBatch:
+    """Assemble ``[K, S, B, ...]`` arrays for the sampled clients.
+
+    Per client: optionally shuffle its samples (the reference's train
+    DataLoaders shuffle), truncate to ``min(S*B, desired_max_samples)``, and
+    zero-pad to the static grid.  K is padded to ``pad_clients_to`` (mesh
+    divisibility) with zero-weight clients — the masked equivalent of
+    FLUTE's idle-node dummy syncs (``core/federated.py:251-262``).
+    """
+    rng = rng or np.random.default_rng(0)
+    K = len(client_indices)
+    K_pad = max(pad_clients_to or K, K)
+    S, B = max_steps, batch_size
+    spec = dataset.element_spec
+
+    arrays = {k: np.zeros((K_pad, S, B) + shape,
+                          dtype=dataset.user_arrays(client_indices[0])[k].dtype)
+              for k, shape in spec.items()}
+    sample_mask = np.zeros((K_pad, S, B), dtype=np.float32)
+    num_samples = np.zeros((K_pad,), dtype=np.float32)
+    client_mask = np.zeros((K_pad,), dtype=np.float32)
+    client_ids = np.full((K_pad,), -1, dtype=np.int32)
+
+    cap = S * B if desired_max_samples is None else min(S * B, desired_max_samples)
+    for j, ci in enumerate(client_indices):
+        user = dataset.user_arrays(ci)
+        n = len(next(iter(user.values())))
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        take = order[:cap]
+        t = len(take)
+        for k, arr in user.items():
+            flat = arrays[k][j].reshape((S * B,) + arr.shape[1:])
+            flat[:t] = arr[take]
+        sample_mask[j].reshape(-1)[:t] = 1.0
+        num_samples[j] = t
+        client_mask[j] = 1.0
+        client_ids[j] = ci
+    return RoundBatch(arrays, sample_mask, num_samples, client_mask, client_ids)
+
+
+def pack_eval_batches(
+    dataset: BaseDataset,
+    batch_size: int,
+    pad_steps_to_multiple_of: int = 1,
+    user_indices: Optional[Sequence[int]] = None,
+) -> Dict[str, np.ndarray]:
+    """Flatten eval users into ``[T, B, ...]`` batches with a mask.
+
+    The reference chunks eval users ~evenly across workers
+    (``core/evaluation.py:185-216``) and weights metrics by batch size
+    (``core/evaluation.py:160-183``); here all samples go into one padded
+    grid sharded over devices, and per-sample masking makes the weighted
+    average exact.  Also returns ``user_idx`` ``[T, B]`` so personalization
+    / per-user metrics can segment by user.
+    """
+    idxs = list(user_indices) if user_indices is not None else list(range(len(dataset)))
+    spec = dataset.element_spec
+    total = sum(int(dataset.num_samples[i]) for i in idxs)
+    T = max(1, math.ceil(total / batch_size))
+    if T % pad_steps_to_multiple_of:
+        T += pad_steps_to_multiple_of - (T % pad_steps_to_multiple_of)
+    B = batch_size
+
+    first = dataset.user_arrays(idxs[0]) if idxs else {}
+    out = {k: np.zeros((T * B,) + shape, dtype=first[k].dtype)
+           for k, shape in spec.items()}
+    mask = np.zeros((T * B,), dtype=np.float32)
+    user_idx = np.full((T * B,), -1, dtype=np.int32)
+
+    pos = 0
+    for i in idxs:
+        user = dataset.user_arrays(i)
+        n = len(next(iter(user.values())))
+        for k, arr in user.items():
+            out[k][pos:pos + n] = arr
+        mask[pos:pos + n] = 1.0
+        user_idx[pos:pos + n] = i
+        pos += n
+
+    batched = {k: v.reshape((T, B) + v.shape[1:]) for k, v in out.items()}
+    batched["sample_mask"] = mask.reshape(T, B)
+    batched["user_idx"] = user_idx.reshape(T, B)
+    return batched

@@ -1,0 +1,91 @@
+"""Dataset contract for task plugins.
+
+Parity target: reference ``core/dataset.py:7-27`` (``BaseDataset`` with
+``user_list``, ``user_data``, ``num_samples`` [, ``user_data_label``] attrs)
+and each task's ``dataloaders/dataset.py``.
+
+The TPU-native contract is array-first: a task dataset must expose, per
+user, *numeric fixed-width arrays* (featurization — tokenization, padding to
+``max_seq_length``, image normalization — happens once at load time, not per
+batch).  The engine then packs users into static-shape round batches
+(:mod:`msrflute_tpu.data.batching`) with sample masks; there is no per-batch
+Python in the hot loop, unlike the reference's torch DataLoader iteration
+(``core/trainer.py:341-414``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class BaseDataset:
+    """Abstract federated dataset.
+
+    Subclasses populate :attr:`user_list` / :attr:`num_samples` and implement
+    :meth:`user_arrays` returning a dict of numpy arrays whose leading axis is
+    the user's sample count — canonically ``{'x': [n, ...], 'y': [n, ...]}``,
+    plus any extra per-sample arrays the model consumes (e.g.
+    ``attention_mask``).
+    """
+
+    user_list: List[str]
+    num_samples: List[int]
+
+    def __len__(self) -> int:
+        return len(self.user_list)
+
+    def user_arrays(self, user_idx: int) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @property
+    def element_spec(self) -> Dict[str, tuple]:
+        """Trailing (per-sample) shapes, derived from the first user."""
+        arrays = self.user_arrays(0)
+        return {k: tuple(v.shape[1:]) for k, v in arrays.items()}
+
+
+class ArraysDataset(BaseDataset):
+    """A dataset backed by per-user numpy arrays held in memory.
+
+    The workhorse for every built-in task: plugins featurize the raw user
+    blob into arrays once, then hand them here.
+    """
+
+    def __init__(self, user_list: Sequence[str],
+                 per_user: Sequence[Dict[str, np.ndarray]],
+                 num_samples: Optional[Sequence[int]] = None):
+        if len(user_list) != len(per_user):
+            raise ValueError("user_list and per_user length mismatch")
+        self.user_list = list(user_list)
+        self._per_user = list(per_user)
+        if num_samples is None:
+            num_samples = [len(next(iter(u.values()))) for u in per_user]
+        self.num_samples = [int(n) for n in num_samples]
+        for i, arrays in enumerate(self._per_user):
+            lens = {k: len(v) for k, v in arrays.items()}
+            if any(n != self.num_samples[i] for n in lens.values()):
+                raise ValueError(
+                    f"user {user_list[i]}: array lengths {lens} != "
+                    f"num_samples {self.num_samples[i]}")
+
+    def user_arrays(self, user_idx: int) -> Dict[str, np.ndarray]:
+        return self._per_user[user_idx]
+
+    @classmethod
+    def concat_users(cls, ds: "ArraysDataset") -> Dict[str, np.ndarray]:
+        """All users' samples concatenated (for server replay / central eval)."""
+        keys = ds.user_arrays(0).keys()
+        return {k: np.concatenate([ds.user_arrays(i)[k] for i in range(len(ds))])
+                for k in keys}
+
+
+def scrub_empty_clients(dataset: ArraysDataset) -> ArraysDataset:
+    """Drop users with zero samples (reference ``utils/utils.py:563-582``)."""
+    keep = [i for i, n in enumerate(dataset.num_samples) if n > 0]
+    return ArraysDataset(
+        [dataset.user_list[i] for i in keep],
+        [dataset.user_arrays(i) for i in keep],
+        [dataset.num_samples[i] for i in keep],
+    )

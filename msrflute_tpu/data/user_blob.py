@@ -1,0 +1,141 @@
+"""Readers for FLUTE's "user blob" federated dataset format.
+
+Parity target: the dataset contract in reference
+``doc/sphinx/scenarios.rst:5-33`` — a JSON or HDF5 blob with:
+
+- ``users`` (a.k.a. ``user_list``): list of client ids
+- ``num_samples``: per-user sample counts
+- ``user_data``: mapping user id -> samples (either ``{'x': [...]}`` dicts or
+  a raw list)
+- ``user_data_label`` (optional): mapping user id -> labels
+
+plus the json<->hdf5 converters in ``utils/preprocessing/``.  The reference
+reads these blobs in each task's ``dataloaders/dataset.py``; here a single
+reader feeds every task plugin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class UserBlob:
+    """In-memory federated dataset: per-user raw sample lists.
+
+    ``user_data[i]`` is whatever the blob stored for user ``user_list[i]``
+    (list of samples or ``{'x': ...}`` dict — normalized to the list), and
+    ``user_labels[i]`` the matching labels when present.
+    """
+
+    user_list: List[str]
+    num_samples: List[int]
+    user_data: List[Any]
+    user_labels: Optional[List[Any]] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.user_list)
+
+
+def _normalize_samples(entry: Any) -> Any:
+    """Blobs store either ``{'x': [...]}`` or a bare list
+    (``doc/sphinx/scenarios.rst:13-33``)."""
+    if isinstance(entry, dict) and "x" in entry:
+        return entry["x"]
+    return entry
+
+
+def _labels_of(entry: Any) -> Optional[Any]:
+    if isinstance(entry, dict) and "y" in entry:
+        return entry["y"]
+    return None
+
+
+def load_user_blob(path: str) -> UserBlob:
+    """Load a federated user blob from ``.json`` or ``.hdf5``."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".json", ".txt"):
+        return _load_json(path)
+    if ext in (".hdf5", ".h5"):
+        return _load_hdf5(path)
+    raise ValueError(f"unsupported user-blob extension: {path}")
+
+
+def _load_json(path: str) -> UserBlob:
+    with open(path, "r") as fh:
+        blob = json.load(fh)
+    users = blob.get("users", blob.get("user_list"))
+    if users is None:
+        raise ValueError(f"{path}: no 'users'/'user_list' key")
+    user_data_map = blob.get("user_data", {})
+    labels_map = blob.get("user_data_label")
+    data, labels = [], []
+    for user in users:
+        entry = user_data_map.get(user, [])
+        data.append(_normalize_samples(entry))
+        if labels_map is not None:
+            labels.append(labels_map[user] if isinstance(labels_map, dict)
+                          else labels_map[len(labels)])
+        else:
+            labels.append(_labels_of(entry))
+    have_labels = any(lab is not None for lab in labels)
+    num_samples = blob.get("num_samples") or [len(d) for d in data]
+    return UserBlob(
+        user_list=list(users),
+        num_samples=[int(n) for n in num_samples],
+        user_data=data,
+        user_labels=labels if have_labels else None,
+    )
+
+
+def _load_hdf5(path: str) -> UserBlob:
+    import h5py
+
+    with h5py.File(path, "r") as fh:
+        users_ds = fh.get("users", fh.get("user_list"))
+        users = [u.decode() if isinstance(u, bytes) else str(u) for u in users_ds[()]]
+        num_samples = [int(n) for n in fh["num_samples"][()]]
+        user_data_grp = fh["user_data"]
+        labels_grp = fh.get("user_data_label")
+        data: List[Any] = []
+        labels: List[Any] = []
+        for user in users:
+            entry = user_data_grp[user]
+            if isinstance(entry, h5py.Group):
+                data.append(np.asarray(entry["x"][()]))
+                if labels_grp is None and "y" in entry:
+                    labels.append(np.asarray(entry["y"][()]))
+            else:
+                data.append(np.asarray(entry[()]))
+            if labels_grp is not None:
+                labels.append(np.asarray(labels_grp[user][()]))
+    return UserBlob(
+        user_list=users,
+        num_samples=num_samples,
+        user_data=data,
+        user_labels=labels if labels else None,
+    )
+
+
+def save_user_blob_hdf5(path: str, blob: UserBlob) -> None:
+    """Write the hdf5 layout produced by reference
+    ``utils/preprocessing/create-hdf5.py``."""
+    import h5py
+
+    with h5py.File(path, "w") as fh:
+        fh.create_dataset("users", data=np.array(blob.user_list, dtype="S"))
+        fh.create_dataset("num_samples", data=np.asarray(blob.num_samples))
+        grp = fh.create_group("user_data")
+        for user, samples in zip(blob.user_list, blob.user_data):
+            sub = grp.create_group(user)
+            sub.create_dataset("x", data=np.asarray(samples))
+        if blob.user_labels is not None:
+            lab = fh.create_group("user_data_label")
+            for user, y in zip(blob.user_list, blob.user_labels):
+                lab.create_dataset(user, data=np.asarray(y))

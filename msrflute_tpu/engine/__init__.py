@@ -1,0 +1,4 @@
+from .client_update import build_client_update, ClientHParams  # noqa: F401
+from .round import RoundEngine, ServerState  # noqa: F401
+from .evaluation import build_eval_fn, evaluate  # noqa: F401
+from .server import OptimizationServer, select_server  # noqa: F401

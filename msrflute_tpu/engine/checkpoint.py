@@ -1,0 +1,125 @@
+"""Checkpoint / resume.
+
+Parity target: reference §5.4 — tar checkpoints of
+model/optimizer/lr-scheduler state (``core/trainer.py:753-775``),
+``latest_model`` every round + ``epoch<i>`` and best-model copies every
+``model_backup_freq`` (``core/server.py:530-558``), ``status_log.json``
+(``core/server.py:477-490``), resume (``core/server.py:183-204``), and
+fallback-to-best (``core/server.py:561-578``).
+
+Format: flax msgpack serialization of the full :class:`ServerState` pytree
+(+ a sidecar JSON with round/best-metric bookkeeping).  Saves use the
+3-retry wrapper (reference ``utils/utils.py:348-359``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from flax import serialization
+
+from ..utils.io import try_except_save, update_json_log
+from .round import ServerState
+
+LATEST = "latest_model.msgpack"
+STATUS_LOG = "status_log.json"
+
+
+def _state_to_bytes(state: ServerState) -> bytes:
+    payload = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "strategy_state": state.strategy_state,
+        "round": state.round,
+    }
+    return serialization.msgpack_serialize(
+        serialization.to_state_dict(jax.device_get(payload)))
+
+
+def _state_from_bytes(data: bytes, template: ServerState) -> ServerState:
+    target = {
+        "params": jax.device_get(template.params),
+        "opt_state": jax.device_get(template.opt_state),
+        "strategy_state": jax.device_get(template.strategy_state),
+        "round": template.round,
+    }
+    restored = serialization.msgpack_restore(data)
+    merged = serialization.from_state_dict(target, restored)
+    return ServerState(
+        params=merged["params"],
+        opt_state=merged["opt_state"],
+        strategy_state=merged["strategy_state"],
+        round=int(restored.get("round", 0)),
+    )
+
+
+class CheckpointManager:
+    """latest/every-N/best checkpoint policy + status log."""
+
+    def __init__(self, model_dir: str, backup_freq: int = 100):
+        self.model_dir = model_dir
+        self.backup_freq = max(int(backup_freq), 1)
+        os.makedirs(model_dir, exist_ok=True)
+
+    # -- save ----------------------------------------------------------
+    def save_latest(self, state: ServerState) -> None:
+        self._write(os.path.join(self.model_dir, LATEST), state)
+
+    def backup(self, state: ServerState, round_no: int,
+               best_names: Tuple[str, ...] = ()) -> None:
+        """Every ``backup_freq`` rounds: ``epoch<i>`` copy + snapshots of the
+        best-model files (reference ``core/server.py:530-558``)."""
+        if round_no % self.backup_freq:
+            return
+        src = os.path.join(self.model_dir, LATEST)
+        if os.path.exists(src):
+            shutil.copyfile(src, os.path.join(self.model_dir,
+                                              f"epoch{round_no}.msgpack"))
+        for name in best_names:
+            best = os.path.join(self.model_dir, f"best_val_{name}_model.msgpack")
+            if os.path.exists(best):
+                shutil.copyfile(best, os.path.join(
+                    self.model_dir, f"best_val_{name}_model_epoch{round_no}.msgpack"))
+
+    def save_best(self, state: ServerState, metric_name: str) -> None:
+        """Best-val checkpoint on improvement (reference
+        ``core/evaluation.py:103-109``)."""
+        self._write(os.path.join(
+            self.model_dir, f"best_val_{metric_name}_model.msgpack"), state)
+
+    def _write(self, path: str, state: ServerState) -> None:
+        blob = _state_to_bytes(state)
+        def _save():
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, path)
+        try_except_save(_save)
+
+    # -- load ----------------------------------------------------------
+    def load(self, template: ServerState,
+             name: str = LATEST) -> Optional[ServerState]:
+        path = os.path.join(self.model_dir, name)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as fh:
+            return _state_from_bytes(fh.read(), template)
+
+    def load_best(self, template: ServerState,
+                  metric_name: str) -> Optional[ServerState]:
+        return self.load(template, f"best_val_{metric_name}_model.msgpack")
+
+    # -- status log ----------------------------------------------------
+    def update_status(self, update: Dict[str, Any]) -> Dict[str, Any]:
+        return update_json_log(os.path.join(self.model_dir, STATUS_LOG), update)
+
+    def read_status(self) -> Dict[str, Any]:
+        path = os.path.join(self.model_dir, STATUS_LOG)
+        if os.path.exists(path):
+            with open(path) as fh:
+                return json.load(fh)
+        return {}

@@ -1,0 +1,182 @@
+"""Per-client local training as a pure jittable function.
+
+Parity target: reference ``Client.process_round`` + ``Trainer``
+(``core/client.py:226-511``, ``core/trainer.py:200-687``).  Semantics
+preserved exactly (SURVEY.md §7):
+
+- model reset per client: local params start from the server's globals
+  (``core/client.py:294-302``) — here simply the function argument;
+- fresh optimizer per client with the server-dictated LR
+  (``core/client.py:309-312``) — optax init inside the function;
+- per-batch loss -> grad -> clip -> stats -> step
+  (``core/trainer.py:341-414``) — a ``lax.scan`` over the static step grid;
+- ``desired_max_samples`` early stop (``core/trainer.py:363-364``) — encoded
+  in the batch packing (zero-mask beyond the cap), with all-padding steps
+  gated so they change nothing;
+- FedProx proximal term ``mu * (w - w_global)`` added to gradients
+  (``core/trainer.py:416-501``);
+- pseudo-gradient = w_server - w_trained (``core/client.py:380-383``);
+- gradient sufficient stats accumulated per batch
+  (``core/trainer.py:263-312``): ``sum``, ``sq_sum``, ``n``, and derived
+  ``mean = sum/n``, ``mag = sqrt(sq_sum/n)``, ``norm = sqrt(sq_sum)``.
+  NOTE the reference computes ``var = sq_sum/n - mag**2`` which is
+  identically zero (``core/trainer.py:301``); we keep that key for parity
+  but also expose the statistically meaningful ``var_corrected =
+  sq_sum/n - mean**2``.
+- per-layer freezing (``core/client.py:306-307``): frozen layers get zero
+  pseudo-gradient, equivalent to the reference's zeroed ``p.grad``.
+
+This function is ``vmap``-ed over the round's clients and ``shard_map``-ed
+over the mesh by :mod:`msrflute_tpu.engine.round` — the role FLUTE's Worker
+processes play (``core/federated.py:482-632``), with no RPC.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.base import BaseTask
+from ..optim import make_optimizer
+
+
+@dataclass(frozen=True)
+class ClientHParams:
+    """Static client-update hyperparameters (compiled into the program)."""
+
+    max_grad_norm: Optional[float] = None       # core/trainer clip
+    fedprox_mu: float = 0.0                     # FedProx proximal weight
+    num_epochs: int = 1                         # local epochs per round
+    stats_on_smooth_grad: bool = True           # dga.py:104-108
+    freeze_layers: Tuple[str, ...] = ()         # core/client.py:306-307
+
+
+def _global_norm(tree: Any) -> jnp.ndarray:
+    return optax.global_norm(tree)
+
+
+def _clip_by_global_norm(tree: Any, max_norm: float) -> Any:
+    norm = _global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: g * scale, tree)
+
+
+def _suff_stats_of(tree: Any) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    leaves = jax.tree.leaves(tree)
+    s = sum(jnp.sum(g) for g in leaves)
+    s2 = sum(jnp.sum(g * g) for g in leaves)
+    n = float(sum(g.size for g in leaves))
+    return s, s2, jnp.asarray(n)
+
+
+def _derive_stats(s, s2, n) -> Dict[str, jnp.ndarray]:
+    n = jnp.maximum(n, 1.0)
+    mean = s / n
+    mag = jnp.sqrt(s2 / n)
+    return {
+        "sum": s,
+        "sq_sum": s2,
+        "n": n,
+        "mean": mean,
+        "mag": mag,
+        "var": s2 / n - mag ** 2,            # reference formula (== 0)
+        "var_corrected": s2 / n - mean ** 2,  # meaningful variance
+        "norm": jnp.sqrt(s2),
+    }
+
+
+def build_client_update(task: BaseTask, client_opt_cfg,
+                        hparams: ClientHParams) -> Callable:
+    """Returns ``client_update(global_params, arrays, sample_mask, lr, rng)``
+    -> ``(pseudo_grad, train_loss, num_samples, stats)``.
+
+    ``arrays``: dict of ``[S, B, ...]`` feature arrays; ``sample_mask``:
+    ``[S, B]``.  Pure and side-effect free: safe under vmap/shard_map/jit.
+    """
+    tx = make_optimizer(client_opt_cfg)
+    freeze = hparams.freeze_layers
+
+    def client_update(global_params, arrays: Dict[str, jnp.ndarray],
+                      sample_mask: jnp.ndarray, lr: jnp.ndarray,
+                      rng: jax.Array):
+        opt_state = tx.init(global_params)
+        opt_state.hyperparams["learning_rate"] = lr
+
+        def one_step(carry, xs):
+            params, opt_state, rng, loss_sum, s, s2, n_acc = carry
+            batch_arrays, mask = xs
+            batch = dict(batch_arrays)
+            batch["sample_mask"] = mask
+            rng, sub = jax.random.split(rng)
+            (loss, _aux), grads = jax.value_and_grad(task.loss, has_aux=True)(
+                params, batch, sub, True)
+            if hparams.fedprox_mu > 0.0:
+                grads = jax.tree.map(
+                    lambda g, w, w0: g + hparams.fedprox_mu * (w - w0),
+                    grads, params, global_params)
+            if hparams.max_grad_norm is not None:
+                grads = _clip_by_global_norm(grads, hparams.max_grad_norm)
+            has_data = (jnp.sum(mask) > 0).astype(jnp.float32)
+            # sufficient stats per batch (core/trainer.py:271-292)
+            ds, ds2, dn = _suff_stats_of(grads)
+            s = s + has_data * ds
+            s2 = s2 + has_data * ds2
+            n_acc = n_acc + has_data * dn
+            loss_sum = loss_sum + has_data * loss
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # all-padding steps must be no-ops (momentum included)
+            params = jax.tree.map(
+                lambda new, old: jnp.where(has_data > 0, new, old),
+                new_params, params)
+            opt_state = jax.tree.map(
+                lambda new, old: jnp.where(has_data > 0, new, old),
+                new_opt, opt_state)
+            return (params, opt_state, rng, loss_sum, s, s2, n_acc), None
+
+        params = global_params
+        loss_sum = jnp.zeros(())
+        s = jnp.zeros(())
+        s2 = jnp.zeros(())
+        n_acc = jnp.zeros(())
+        carry = (params, opt_state, rng, loss_sum, s, s2, n_acc)
+        for _ in range(hparams.num_epochs):
+            carry, _ = jax.lax.scan(carry_step := one_step, carry,
+                                    (arrays, sample_mask))
+        params, opt_state, rng, loss_sum, s, s2, n_acc = carry
+
+        pseudo_grad = jax.tree.map(lambda w0, w: w0 - w, global_params, params)
+        if freeze:
+            pseudo_grad = _freeze_layers(pseudo_grad, freeze)
+
+        if hparams.stats_on_smooth_grad:
+            # recompute stats on the pseudo-gradient (dga.py:104-108)
+            s, s2, n = _suff_stats_of(pseudo_grad)
+            stats = _derive_stats(s, s2, n)
+        else:
+            stats = _derive_stats(s, s2, n_acc)
+
+        num_samples = jnp.sum(sample_mask)
+        return pseudo_grad, loss_sum, num_samples, stats
+
+    return client_update
+
+
+def _freeze_layers(tree: Any, freeze: Tuple[str, ...]) -> Any:
+    """Zero pseudo-gradients of frozen layers by path-name match
+    (reference zeroes ``p.grad`` for names in ``freeze_layer``,
+    ``core/client.py:306-307``, ``core/strategies/fedavg.py:83-88``)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    paths_leaves, treedef = flat
+    out = []
+    for path, leaf in paths_leaves:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if any(f in name for f in freeze):
+            out.append(jnp.zeros_like(leaf))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,17 @@
+"""Personalization server.
+
+Parity target: reference ``experiments/cv/server.py:9-18`` —
+``PersonalizationServer`` is a ctor-only subclass hook of
+``OptimizationServer`` (the actual personalization math — convex model
+interpolation and per-user alpha updates, ``core/client.py:387-443`` and
+``utils/utils.py:598-617`` — runs on the client side; see
+:mod:`msrflute_tpu.engine.personalization_state`).
+"""
+
+from __future__ import annotations
+
+from .server import OptimizationServer
+
+
+class PersonalizationServer(OptimizationServer):
+    """Round loop with per-user personalization state enabled."""

@@ -1,0 +1,220 @@
+"""The federated round as one jitted SPMD program.
+
+Parity target: the whole middle of the reference stack —
+``federated.Server.dispatch_clients/process_clients``
+(``core/federated.py:281-424``), the Worker recv loop
+(``core/federated.py:482-632``), and the server-side aggregation half of
+``OptimizationServer.train`` (``core/server.py:337-427``).
+
+TPU-native redesign (SURVEY.md §5.8): no message protocol, no work queue.
+One compiled ``round_step``:
+
+    shard_map over mesh 'clients' axis:
+        vmap(client_update) over the shard's clients        # local SGD
+        per-client strategy weight + payload transform      # DP/quant/freeze
+        weighted local sums -> psum over 'clients'          # "collection"
+    strategy.combine (+ staleness buffer, global DP)        # aggregation
+    server optax step on the aggregate pseudo-gradient      # ModelUpdater
+
+The per-round model "broadcast" (reference ``core/federated.py:330-335``,
+K-1 unicasts) is just the replicated ``params`` operand — XLA keeps it
+resident on every chip; the "harvest" poll loop (``core/federated.py:216-229``)
+is a single ``psum`` riding ICI.  Greedy work-stealing is replaced by static
+client sharding; imbalance is absorbed by masked padding, which costs FLOPs
+on padded samples instead of latency on stragglers — the right trade on MXUs.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..config import FLUTEConfig
+from ..data.batching import RoundBatch
+from ..models.base import BaseTask
+from ..optim import make_optimizer
+from ..parallel.mesh import CLIENTS_AXIS, MODEL_AXIS, make_mesh
+from ..strategies.base import BaseStrategy
+from .client_update import ClientHParams, build_client_update, _clip_by_global_norm
+
+
+@dataclass
+class ServerState:
+    """Replicated server-side state threaded through rounds
+    (the analogue of the reference's global model + ModelUpdater optimizer +
+    strategy buffers)."""
+
+    params: Any
+    opt_state: Any
+    strategy_state: Any
+    round: int = 0
+
+
+class RoundEngine:
+    """Compiles and runs the per-round SPMD program."""
+
+    def __init__(self, task: BaseTask, config: FLUTEConfig,
+                 strategy: BaseStrategy, mesh: Optional[Mesh] = None):
+        self.task = task
+        self.config = config
+        self.strategy = strategy
+        self.mesh = mesh if mesh is not None else make_mesh()
+
+        cc = config.client_config
+        sc = config.server_config
+        freeze = cc.get("freeze_layer") or []
+        if isinstance(freeze, str):
+            freeze = [freeze]
+        self.hparams = ClientHParams(
+            max_grad_norm=cc.get("max_grad_norm"),
+            fedprox_mu=float(cc.get("fedprox_mu", 0.0) or 0.0),
+            num_epochs=int(cc.get("num_epochs", 1) or 1),
+            freeze_layers=tuple(freeze),
+        )
+        self.client_update = build_client_update(
+            task, cc.optimizer_config, self.hparams)
+        self.server_tx = make_optimizer(sc.optimizer_config)
+        self.server_max_grad_norm = sc.get("max_grad_norm")
+        self.stale_prob = float(getattr(strategy, "stale_prob", 0.0) or 0.0)
+
+        self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
+        self._replicated = NamedSharding(self.mesh, P())
+        self._round_step = self._build_round_step()
+
+    # ------------------------------------------------------------------
+    def init_state(self, rng: jax.Array, params: Any = None) -> ServerState:
+        if params is None:
+            params = self.task.init_params(rng)
+        params = jax.device_put(params, self._replicated)
+        opt_state = jax.jit(self.server_tx.init,
+                            out_shardings=self._replicated)(params)
+        return ServerState(
+            params=params,
+            opt_state=opt_state,
+            strategy_state=self.strategy.init_state(params),
+            round=0,
+        )
+
+    # ------------------------------------------------------------------
+    def _build_round_step(self) -> Callable:
+        strategy = self.strategy
+        client_update = self.client_update
+        stale_prob = self.stale_prob
+        mesh = self.mesh
+        cspec = P(CLIENTS_AXIS)
+        rspec = P()
+
+        def shard_body(params, arrays, sample_mask, client_mask, client_ids,
+                       client_lr, rng):
+            def per_client(arr_c, mask_c, cm_c, cid_c):
+                # Deterministic independent stream per (round, client):
+                # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
+                rng_c = jax.random.fold_in(rng, cid_c)
+                pg, tl, ns, stats = client_update(
+                    params, arr_c, mask_c, client_lr, rng_c)
+                w = strategy.client_weight(
+                    num_samples=ns, train_loss=tl, stats=stats,
+                    rng=jax.random.fold_in(rng_c, 1))
+                pg, w = strategy.transform_payload(
+                    pg, w, jax.random.fold_in(rng_c, 2))
+                w = w * cm_c
+                if stale_prob > 0.0:
+                    coin = jax.random.bernoulli(
+                        jax.random.fold_in(rng_c, 3), stale_prob)
+                    stale = coin.astype(jnp.float32) * cm_c
+                else:
+                    stale = jnp.zeros(())
+                return pg, w, tl * cm_c, ns * cm_c, stats, stale
+
+            pgs, ws, tls, nss, stats, stale = jax.vmap(per_client)(
+                arrays, sample_mask, client_mask, client_ids)
+
+            w_now = ws * (1.0 - stale)
+            w_def = ws * stale
+            wsum = lambda w: jax.tree.map(
+                lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), pgs)
+            local = {
+                "grad_sum_now": wsum(w_now),
+                "weight_sum_now": jnp.sum(w_now),
+                "grad_sum_def": wsum(w_def),
+                "weight_sum_def": jnp.sum(w_def),
+                "train_loss_sum": jnp.sum(tls),
+                "num_samples_sum": jnp.sum(nss),
+                "client_count": jnp.sum(client_mask),
+                "stats_mean_sum": jnp.sum(stats["mean"] * client_mask),
+                "stats_mag_sum": jnp.sum(stats["mag"] * client_mask),
+                "stats_var_sum": jnp.sum(stats["var_corrected"] * client_mask),
+                "stats_norm_sum": jnp.sum(stats["norm"] * client_mask),
+                "weight_sum_raw": jnp.sum(ws),
+            }
+            # the "harvest": one collective instead of K P2P recvs
+            return jax.lax.psum(local, CLIENTS_AXIS)
+
+        sharded_collect = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec),
+            out_specs=rspec, check_vma=False)
+
+        def round_step(params, opt_state, strategy_state, arrays, sample_mask,
+                       client_mask, client_ids, client_lr, server_lr, rng):
+            collected = sharded_collect(
+                params, arrays, sample_mask, client_mask, client_ids,
+                client_lr, rng)
+            deferred = None
+            if stale_prob > 0.0:
+                deferred = {"grad_sum": collected["grad_sum_def"],
+                            "weight_sum": collected["weight_sum_def"]}
+            agg, new_strategy_state = strategy.combine(
+                collected["grad_sum_now"], collected["weight_sum_now"],
+                deferred, strategy_state, jax.random.fold_in(rng, 17),
+                num_clients=collected["client_count"])
+            # server optimizer over the aggregate pseudo-gradient
+            # (reference ModelUpdater.update_model, core/trainer.py:127-137)
+            if self.server_max_grad_norm is not None:
+                agg = _clip_by_global_norm(agg, float(self.server_max_grad_norm))
+            opt_state.hyperparams["learning_rate"] = server_lr
+            updates, new_opt_state = self.server_tx.update(agg, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            round_stats = {
+                "train_loss_sum": collected["train_loss_sum"],
+                "num_samples_sum": collected["num_samples_sum"],
+                "client_count": collected["client_count"],
+                "weight_sum": collected["weight_sum_now"],
+                "weight_sum_raw": collected["weight_sum_raw"],
+                "grad_mean": collected["stats_mean_sum"] / jnp.maximum(collected["client_count"], 1.0),
+                "grad_mag": collected["stats_mag_sum"] / jnp.maximum(collected["client_count"], 1.0),
+                "grad_var": collected["stats_var_sum"] / jnp.maximum(collected["client_count"], 1.0),
+                "grad_norm": collected["stats_norm_sum"] / jnp.maximum(collected["client_count"], 1.0),
+                "agg_grad_norm": optax.global_norm(agg),
+            }
+            return new_params, new_opt_state, new_strategy_state, round_stats
+
+        return jax.jit(round_step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def run_round(self, state: ServerState, batch: RoundBatch,
+                  client_lr: float, server_lr: float,
+                  rng: jax.Array) -> Tuple[ServerState, Dict[str, float]]:
+        """Stage one round's data onto the mesh and execute the program."""
+        arrays = {k: jax.device_put(v, self._client_sharding)
+                  for k, v in batch.arrays.items()}
+        sample_mask = jax.device_put(batch.sample_mask, self._client_sharding)
+        client_mask = jax.device_put(batch.client_mask, self._client_sharding)
+        client_ids = jax.device_put(batch.client_ids, self._client_sharding)
+
+        params, opt_state, strategy_state, stats = self._round_step(
+            state.params, state.opt_state, state.strategy_state,
+            arrays, sample_mask, client_mask, client_ids,
+            jnp.asarray(client_lr, jnp.float32),
+            jnp.asarray(server_lr, jnp.float32), rng)
+        new_state = ServerState(params, opt_state, strategy_state,
+                                state.round + 1)
+        return new_state, stats

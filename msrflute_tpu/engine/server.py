@@ -1,0 +1,240 @@
+"""Server round loop — the host-side controller.
+
+Parity target: reference ``OptimizationServer`` (``core/server.py:48-578``).
+Everything data-dependent stays here (sampling, eval cadence, LR plateau
+decay, checkpointing, logging, timing); everything numeric is inside the
+jitted :class:`~msrflute_tpu.engine.round.RoundEngine` program.  Feature map:
+
+- per-round client sampling, incl. ``"lo:hi"`` random count
+  (``core/server.py:284-302``)                          -> :meth:`_sample`
+- model "broadcast"/collection                          -> RoundEngine
+- per-client stats + strategy processing
+  (``core/server.py:337-427``)                          -> RoundEngine
+- periodic val/test + best tracking (``:448-462``)      -> :meth:`_maybe_eval`
+- client-LR decay on val plateau (``:464-469``)         -> ``lr_weight``
+- checkpoint/backup/fallback (``:471-475,530-578``)     -> CheckpointManager
+- status log (``:477-490``)                             -> ``status_log.json``
+- timing stats (``:492-521``)                           -> ``run_stats``
+- initial val/test before training (``:236``)           -> ``initial_val``
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..config import FLUTEConfig, parse_clients_per_round
+from ..data.batching import pack_eval_batches, pack_round_batches, steps_for
+from ..data.dataset import BaseDataset
+from ..models.base import BaseTask
+from ..optim import PlateauTracker, make_lr_schedule
+from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
+from ..strategies import select_strategy
+from ..utils.logging import log_metric, print_rank
+from ..utils.metrics import Metric, MetricsDict
+from .checkpoint import CheckpointManager
+from .evaluation import build_eval_fn, evaluate
+from .round import RoundEngine, ServerState
+
+
+class OptimizationServer:
+    """Single-controller federated optimization loop."""
+
+    def __init__(self, task: BaseTask, config: FLUTEConfig,
+                 train_dataset: BaseDataset,
+                 val_dataset: Optional[BaseDataset] = None,
+                 test_dataset: Optional[BaseDataset] = None,
+                 model_dir: str = "./models", mesh=None,
+                 seed: int = 0):
+        self.task = task
+        self.config = config
+        self.train_dataset = train_dataset
+        self.val_dataset = val_dataset
+        self.test_dataset = test_dataset
+        self.mesh = mesh if mesh is not None else make_mesh()
+
+        sc = config.server_config
+        dp = config.dp_config
+        strategy_cls = select_strategy(config.strategy)
+        self.strategy = strategy_cls(config, dp)
+        self.engine = RoundEngine(task, config, self.strategy, self.mesh)
+        self.ckpt = CheckpointManager(model_dir,
+                                      backup_freq=sc.get("model_backup_freq", 100))
+
+        # LR machinery: server-side schedule + client plateau decay
+        self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
+        self.lr_decay_factor = float(sc.get("lr_decay_factor", 1.0))
+        self.lr_weight = 1.0
+        self.server_lr_schedule = make_lr_schedule(
+            sc.annealing_config, float(sc.optimizer_config.get("lr", 1.0)))
+        self.plateau: Optional[PlateauTracker] = None
+        if sc.annealing_config is not None and \
+                sc.annealing_config.get("type") == "val_loss":
+            self.plateau = PlateauTracker(
+                sc.annealing_config, float(sc.optimizer_config.get("lr", 1.0)))
+
+        self.best_model_criterion = sc.get("best_model_criterion", "loss")
+        self.fall_back_to_best = bool(sc.get("fall_back_to_best_model", False))
+        self.best_val: Dict[str, Metric] = {}
+
+        # static round-program geometry
+        cc = config.client_config
+        self.batch_size = int(cc.data_config.train.get("batch_size", 32))
+        self.desired_max_samples = cc.get("desired_max_samples") or \
+            cc.data_config.train.get("desired_max_samples")
+        max_client_samples = int(max(train_dataset.num_samples))
+        self.max_steps = steps_for(max_client_samples, self.batch_size,
+                                   self.desired_max_samples)
+
+        self._eval_fn = build_eval_fn(task, self.mesh)
+        self._np_rng = np.random.default_rng(seed)
+        self._rng = jax.random.PRNGKey(seed)
+        self.run_stats: Dict[str, list] = {
+            "secsPerRound": [], "secsPerRoundHousekeeping": []}
+
+        self.state = self.engine.init_state(self._rng)
+        if sc.get("resume_from_checkpoint", False):
+            restored = self.ckpt.load(self.state)
+            if restored is not None:
+                self.state = restored
+                status = self.ckpt.read_status()
+                self.lr_weight = float(status.get("weight", 1.0))
+                print_rank(f"resumed from checkpoint at round {self.state.round}")
+
+    # ------------------------------------------------------------------
+    def _sample(self) -> list:
+        sc = self.config.server_config
+        n = parse_clients_per_round(sc.get("num_clients_per_iteration", 10),
+                                    self._np_rng)
+        n = min(n, len(self.train_dataset))
+        # random.sample equivalent (core/server.py:300-302)
+        return list(self._np_rng.choice(len(self.train_dataset), size=n,
+                                        replace=False))
+
+    # ------------------------------------------------------------------
+    def run(self) -> ServerState:
+        return self.train()
+
+    def train(self) -> ServerState:
+        sc = self.config.server_config
+        max_iteration = int(sc.get("max_iteration", 100))
+        val_freq = int(sc.get("val_freq", 20) or 20)
+        rec_freq = int(sc.get("rec_freq", 20) or 20)
+
+        if self.state.round == 0 and sc.get("initial_val", True):
+            self._maybe_eval("val", self.state.round, force=True)
+        if self.state.round == 0 and sc.get("initial_rec", False):
+            self._maybe_eval("test", self.state.round, force=True)
+
+        ndev = self.mesh.shape[CLIENTS_AXIS]
+        for round_no in range(self.state.round, max_iteration):
+            tic = time.time()
+            client_lr = self.initial_lr_client * self.lr_weight
+            server_lr = (self.plateau.lr if self.plateau is not None
+                         else self.server_lr_schedule(round_no))
+
+            sampled = self._sample()
+            batch = pack_round_batches(
+                self.train_dataset, sampled, self.batch_size, self.max_steps,
+                rng=self._np_rng, pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
+                desired_max_samples=self.desired_max_samples)
+
+            self._rng, round_rng = jax.random.split(self._rng)
+            self.state, stats = self.engine.run_round(
+                self.state, batch, client_lr, server_lr, round_rng)
+
+            toc = time.time()
+            self.run_stats["secsPerRound"].append(toc - tic)
+
+            # round logging (reference core/server.py:362-395 + AzureML)
+            stats = {k: float(v) for k, v in jax.device_get(stats).items()}
+            n_clients = max(stats["client_count"], 1.0)
+            log_metric("Training loss",
+                       stats["train_loss_sum"] / n_clients, step=round_no)
+            log_metric("LR for agg. opt.", server_lr, step=round_no)
+            log_metric("Client learning rate", client_lr, step=round_no)
+            log_metric("Agg. grad norm", stats["agg_grad_norm"], step=round_no)
+
+            housekeeping_tic = time.time()
+            improved = False
+            if (round_no + 1) % val_freq == 0:
+                improved = self._maybe_eval("val", round_no + 1)
+                # client-LR decay on val plateau (core/server.py:464-469)
+                if not improved and self.lr_decay_factor != 1.0:
+                    self.lr_weight *= float(self.lr_decay_factor)
+                    print_rank(f"decayed client lr weight to {self.lr_weight}")
+                if self.plateau is not None and "loss" in self._last_val:
+                    self.plateau.step(self._last_val["loss"].value)
+                if self.fall_back_to_best and not improved:
+                    self._fall_back()
+            if (round_no + 1) % rec_freq == 0 and self.test_dataset is not None:
+                self._maybe_eval("test", round_no + 1)
+
+            self.ckpt.save_latest(self.state)
+            self.ckpt.backup(self.state, round_no + 1,
+                             best_names=tuple(self.best_val))
+            self.ckpt.update_status({
+                "i": round_no + 1,
+                "weight": self.lr_weight,
+                **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
+            })
+            self.run_stats["secsPerRoundHousekeeping"].append(
+                time.time() - housekeeping_tic)
+        self._log_timing()
+        return self.state
+
+    # ------------------------------------------------------------------
+    _last_val: MetricsDict = {}
+
+    def _maybe_eval(self, split: str, round_no: int, force: bool = False) -> bool:
+        dataset = self.val_dataset if split == "val" else self.test_dataset
+        if dataset is None or len(dataset) == 0:
+            return False
+        ndev = self.mesh.shape[CLIENTS_AXIS]
+        batch_cfg = (self.config.server_config.data_config.val if split == "val"
+                     else self.config.server_config.data_config.test)
+        bs = int(batch_cfg.get("batch_size", self.batch_size))
+        batches = pack_eval_batches(dataset, bs, pad_steps_to_multiple_of=ndev)
+        metrics = evaluate(self.task, self._eval_fn, self.state.params,
+                           batches, self.mesh)
+        for name, metric in metrics.items():
+            log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
+
+        improved = False
+        if split == "val":
+            self._last_val = metrics
+            for name, metric in metrics.items():
+                prev = self.best_val.get(name)
+                if prev is None or metric.is_better_than(prev):
+                    self.best_val[name] = metric
+                    self.ckpt.save_best(self.state, name)
+                    if name == self.best_model_criterion:
+                        improved = True
+        return improved
+
+    def _fall_back(self) -> None:
+        """Reload the best checkpoint, preserving current LR weight
+        (reference ``core/server.py:561-578``)."""
+        restored = self.ckpt.load_best(self.state, self.best_model_criterion)
+        if restored is not None:
+            self.state = ServerState(restored.params, restored.opt_state,
+                                     restored.strategy_state, self.state.round)
+            print_rank("fell back to previous best model")
+
+    def _log_timing(self) -> None:
+        for key, values in self.run_stats.items():
+            if values:
+                log_metric(f"{key} (mean)", float(np.mean(values)))
+
+
+def select_server(server_type: str):
+    """Reference ``select_server`` (``core/server.py:581-597``):
+    ``personalization`` -> PersonalizationServer, else OptimizationServer."""
+    if (server_type or "").lower() == "personalization":
+        from .personalization import PersonalizationServer
+        return PersonalizationServer
+    return OptimizationServer

@@ -1,0 +1,2 @@
+from .base import BaseTask  # noqa: F401
+from .registry import make_task, register_task, TASK_REGISTRY  # noqa: F401

@@ -1,0 +1,76 @@
+"""Task/model contract.
+
+Parity target: reference ``core/model.py:7-51`` — ``BaseModel`` with
+``loss(input)``, ``inference(input)`` -> ``{'output', 'acc', 'batch_size'}``
+(plus custom metrics as ``{'value', 'higher_is_better'}``), and
+``set_train``/``set_eval`` mode toggles.
+
+TPU-native redesign: a task is a bundle of *pure functions* over explicit
+params (no mutable module state, no train/eval mode flags — train-ness is an
+argument so everything jits):
+
+- ``init_params(rng)``                        -> params pytree
+- ``loss(params, batch, rng, train)``         -> (scalar, aux)  masked mean
+- ``eval_stats(params, batch)``               -> dict of scalar SUMS
+- ``finalize_metrics(sums)``                  -> {name: Metric}
+
+``batch`` is a dict of arrays with leading batch axis plus ``sample_mask``;
+every reduction must be mask-weighted so padded samples are invisible.
+``eval_stats`` returns *sums* (not means) so the engine can ``psum`` them
+across devices and finalize once — this reproduces the reference's
+sample-weighted metric merge (``core/evaluation.py:160-183``) exactly while
+staying associative.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.metrics import Metric, MetricsDict
+
+Params = Any
+Batch = Dict[str, jnp.ndarray]
+
+
+class BaseTask:
+    """Abstract task: model + loss + metrics, all pure."""
+
+    name: str = "base"
+
+    def init_params(self, rng: jax.Array) -> Params:
+        raise NotImplementedError
+
+    def loss(self, params: Params, batch: Batch, rng: Optional[jax.Array] = None,
+             train: bool = True) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """Masked mean loss over the batch + aux stats (e.g. sample count)."""
+        raise NotImplementedError
+
+    def eval_stats(self, params: Params, batch: Batch) -> Dict[str, jnp.ndarray]:
+        """Scalar *sums* for evaluation; must include ``loss_sum`` and
+        ``sample_count``."""
+        raise NotImplementedError
+
+    def finalize_metrics(self, sums: Dict[str, jnp.ndarray]) -> MetricsDict:
+        """Turn psum'd eval sums into the reference metric dict
+        (``{'value','higher_is_better'}``, ``core/metrics.py:35-56``)."""
+        n = max(float(sums["sample_count"]), 1.0)
+        metrics = {"loss": Metric(float(sums["loss_sum"]) / n, higher_is_better=False)}
+        if "correct_sum" in sums:
+            metrics["acc"] = Metric(float(sums["correct_sum"]) / n, higher_is_better=True)
+        return metrics
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean over real samples only; padded entries contribute nothing."""
+    total = jnp.sum(values * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+def softmax_xent(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample cross entropy with integer labels."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

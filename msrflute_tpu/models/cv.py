@@ -1,0 +1,158 @@
+"""Computer-vision tasks: LR (MNIST), CNN (FEMNIST), CIFAR CNN.
+
+Parity targets:
+- ``LR`` logistic regression — reference ``experiments/cv_lr_mnist/model.py:23-47``
+- ``CNN`` 2conv+2fc — reference ``experiments/cv_cnn_femnist/model.py``
+- ``CNN`` CIFAR with custom f1 — reference ``experiments/classif_cnn/model.py:33-62``
+
+All flax.linen, NHWC layouts (TPU conv-friendly), bfloat16-ready matmuls via
+jax default precision; parameters stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.metrics import Metric
+from .base import BaseTask, Batch, masked_mean, softmax_xent
+
+
+class _LRModule(nn.Module):
+    num_classes: int = 10
+    input_dim: int = 784
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        return nn.Dense(self.num_classes)(x)
+
+
+class _CNNFEMNISTModule(nn.Module):
+    """2 conv + 2 fc (reference ``experiments/cv_cnn_femnist/model.py``):
+    conv5x5x32 -> pool -> conv5x5x64 -> pool -> fc2048 -> fc62."""
+
+    num_classes: int = 62
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(jnp.float32)
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(2048)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class _CIFARCNNModule(nn.Module):
+    """CIFAR-10 CNN (reference ``experiments/classif_cnn/model.py:33-62``):
+    conv3x32 -> conv3x64 -> pool -> conv3x64 -> fc64 -> fc10."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(jnp.float32)
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ClassificationTask(BaseTask):
+    """Generic masked classification task over a flax module."""
+
+    def __init__(self, module: nn.Module, example_shape: Tuple[int, ...],
+                 name: str = "classification", num_classes: int = 10,
+                 with_f1: bool = False):
+        self.module = module
+        self.example_shape = example_shape
+        self.name = name
+        self.num_classes = num_classes
+        self.with_f1 = with_f1
+
+    def init_params(self, rng: jax.Array):
+        dummy = jnp.zeros((1,) + self.example_shape, dtype=jnp.float32)
+        return self.module.init(rng, dummy)["params"]
+
+    def apply(self, params, x):
+        return self.module.apply({"params": params}, x)
+
+    def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
+             train: bool = True):
+        logits = self.apply(params, batch["x"])
+        labels = batch["y"].astype(jnp.int32)
+        per_sample = softmax_xent(logits, labels)
+        mask = batch["sample_mask"]
+        loss = masked_mean(per_sample, mask)
+        aux = {"sample_count": jnp.sum(mask)}
+        return loss, aux
+
+    def eval_stats(self, params, batch: Batch) -> Dict[str, jnp.ndarray]:
+        logits = self.apply(params, batch["x"])
+        labels = batch["y"].astype(jnp.int32)
+        mask = batch["sample_mask"]
+        per_sample = softmax_xent(logits, labels)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = (pred == labels).astype(jnp.float32)
+        stats = {
+            "loss_sum": jnp.sum(per_sample * mask),
+            "correct_sum": jnp.sum(correct * mask),
+            "sample_count": jnp.sum(mask),
+        }
+        if self.with_f1:
+            # per-class tp/fp/fn sums -> macro F1 at finalize (reference
+            # experiments/classif_cnn/model.py custom f1 metric)
+            onehot_true = jax.nn.one_hot(labels, self.num_classes) * mask[..., None]
+            onehot_pred = jax.nn.one_hot(pred, self.num_classes) * mask[..., None]
+            stats["tp"] = jnp.sum(onehot_true * onehot_pred, axis=0)
+            stats["fp"] = jnp.sum((1 - onehot_true) * onehot_pred, axis=0)
+            stats["fn"] = jnp.sum(onehot_true * (1 - onehot_pred), axis=0)
+        return stats
+
+    def finalize_metrics(self, sums):
+        metrics = super().finalize_metrics(sums)
+        if self.with_f1 and "tp" in sums:
+            tp, fp, fn = (jnp.asarray(sums[k]) for k in ("tp", "fp", "fn"))
+            f1 = 2 * tp / jnp.maximum(2 * tp + fp + fn, 1e-8)
+            metrics["f1_score"] = Metric(float(jnp.mean(f1)), higher_is_better=True)
+        return metrics
+
+
+def make_lr_task(model_config) -> ClassificationTask:
+    num_classes = int(model_config.get("num_classes", 10))
+    input_dim = int(model_config.get("input_dim", 784))
+    return ClassificationTask(
+        _LRModule(num_classes=num_classes, input_dim=input_dim),
+        example_shape=(input_dim,), name="cv_lr_mnist", num_classes=num_classes)
+
+
+def make_cnn_femnist_task(model_config) -> ClassificationTask:
+    num_classes = int(model_config.get("num_classes", 62))
+    side = int(model_config.get("image_size", 28))
+    return ClassificationTask(
+        _CNNFEMNISTModule(num_classes=num_classes),
+        example_shape=(side, side, 1), name="cv_cnn_femnist",
+        num_classes=num_classes)
+
+
+def make_cifar_cnn_task(model_config) -> ClassificationTask:
+    num_classes = int(model_config.get("num_classes", 10))
+    return ClassificationTask(
+        _CIFARCNNModule(num_classes=num_classes),
+        example_shape=(32, 32, 3), name="classif_cnn",
+        num_classes=num_classes, with_f1=True)

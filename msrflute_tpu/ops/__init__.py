@@ -1,0 +1,1 @@
+from .quantization import quantize_pytree, quantize_array  # noqa: F401

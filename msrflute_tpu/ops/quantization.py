@@ -1,0 +1,62 @@
+"""Gradient quantization — histogram binning + quantile sparsification.
+
+Parity target: reference ``extensions/quantization/quant.py:9-100``:
+per-layer (or global) min/max histogram binning of the gradient into
+``2**quant_bits`` levels, with components whose magnitude falls below the
+``quant_threshold`` quantile set to zero.  Semantics preserved:
+
+- bin labels = ``linspace(min, max, n_bins)``; each value maps to the
+  nearest label (the reference shifts by half a bin width before
+  ``bucketize`` to turn ceil into round — here we use rounding directly);
+- threshold = quantile of ``|grad|`` at ``quant_threshold``; strictly
+  greater survives (``quant.py:50-51``).
+
+TPU-native: pure jnp, runs inside the jitted round under vmap over clients.
+This is the designated Pallas-fusion candidate (SURVEY.md §7): a fused
+clip->noise->bin pass over the flat update; see
+:mod:`msrflute_tpu.ops.pallas_kernels`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_array(grad: jnp.ndarray, n_bins: int,
+                   quant_threshold: float,
+                   min_grad: Optional[jnp.ndarray] = None,
+                   max_grad: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Quantize one tensor to ``n_bins`` levels, zeroing sub-threshold
+    components (reference ``quant_bins`` + thresholding)."""
+    g = grad.astype(jnp.float32)
+    lo = jnp.min(g) if min_grad is None else min_grad
+    hi = jnp.max(g) if max_grad is None else max_grad
+    thresh = jnp.quantile(jnp.abs(g), quant_threshold)
+    width = (hi - lo) / jnp.maximum(n_bins - 1, 1)
+    # nearest-label rounding (== reference's half-bin-shifted bucketize)
+    idx = jnp.clip(jnp.round((g - lo) / jnp.maximum(width, 1e-30)), 0, n_bins - 1)
+    binned = lo + idx * width
+    return jnp.where(jnp.abs(g) > thresh, binned, 0.0).astype(grad.dtype)
+
+
+def quantize_pytree(tree: Any, quant_threshold: Optional[float],
+                    quant_bits: int = 8, global_stats: bool = False) -> Any:
+    """Quantize every leaf (reference ``quant_model``).  ``global_stats``
+    computes one min/max/threshold across all leaves (``quant.py:36-39``)."""
+    if quant_threshold is None:
+        return tree
+    n_bins = 2 ** int(quant_bits)
+    if not global_stats:
+        return jax.tree.map(
+            lambda g: quantize_array(g, n_bins, float(quant_threshold)), tree)
+    from jax.flatten_util import ravel_pytree
+    flat, unravel = ravel_pytree(tree)
+    lo, hi = jnp.min(flat), jnp.max(flat)
+    thresh = jnp.quantile(jnp.abs(flat), float(quant_threshold))
+    width = (hi - lo) / jnp.maximum(n_bins - 1, 1)
+    idx = jnp.clip(jnp.round((flat - lo) / jnp.maximum(width, 1e-30)), 0, n_bins - 1)
+    binned = lo + idx * width
+    return unravel(jnp.where(jnp.abs(flat) > thresh, binned, 0.0))

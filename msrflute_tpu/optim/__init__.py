@@ -1,0 +1,3 @@
+from .factory import (  # noqa: F401
+    make_optimizer, make_lr_schedule, PlateauTracker,
+)

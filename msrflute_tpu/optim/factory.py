@@ -1,0 +1,149 @@
+"""Optimizer & LR-scheduler factories.
+
+Parity targets:
+- ``make_optimizer`` (reference ``utils/utils.py:27-64``): types
+  sgd / adam / adamax / lars / LarsSGD / lamb / adamW
+  (``core/schema.py:90``), with the vendored LAMB/LARS variants in
+  ``utils/optimizers/``.  Here every type maps onto optax transforms — the
+  TPU-native replacements of the torch/apex implementations.
+- ``make_lr_scheduler`` (reference ``utils/utils.py:151-224``): ``step_lr``,
+  ``multi_step_lr``, ``rampup-keep-expdecay-keep`` (SpecAugment schedule),
+  and ``val_loss`` (ReduceLROnPlateau) — the last is data-dependent, so it
+  stays host-side as :class:`PlateauTracker` and feeds a scalar LR into the
+  jitted step (the reference likewise steps it outside the train loop,
+  ``core/trainer.py:139-155``).
+
+The server optimizer consumes *pseudo-gradients* (w0 - wT aggregates), same
+as the reference's ``ModelUpdater.update_model`` (``core/trainer.py:127-137``).
+LR is injected as a runtime scalar via ``optax.inject_hyperparams`` so
+annealing never retriggers compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import optax
+
+from ..config import AnnealingConfig, OptimizerConfig
+
+
+def make_optimizer(cfg: OptimizerConfig,
+                   learning_rate: Optional[float] = None) -> optax.GradientTransformation:
+    """Build an optax optimizer from a FLUTE-vocabulary optimizer config.
+
+    The returned transformation is wrapped in ``optax.inject_hyperparams`` so
+    ``opt_state.hyperparams['learning_rate']`` can be overwritten each round
+    (the reference mutates ``param_group['lr']`` the same way,
+    ``core/client.py:309-312``).
+    """
+    lr = float(cfg.lr if learning_rate is None else learning_rate)
+    kind = str(cfg.get("type", "sgd"))
+    kind_l = kind.lower()
+    wd = float(cfg.get("weight_decay", 0.0) or 0.0)
+
+    if kind_l == "sgd":
+        def base(learning_rate):
+            tx = optax.sgd(learning_rate, momentum=float(cfg.get("momentum", 0.0)) or None,
+                           nesterov=bool(cfg.get("nesterov", False)))
+            if wd:
+                tx = optax.chain(optax.add_decayed_weights(wd), tx)
+            return tx
+    elif kind_l == "adam":
+        betas = cfg.get("betas") or [0.9, 0.999]
+        def base(learning_rate):
+            return optax.adam(learning_rate, b1=float(betas[0]), b2=float(betas[1]),
+                              eps=float(cfg.get("eps", 1e-8)))
+    elif kind_l == "adamax":
+        def base(learning_rate):
+            return optax.adamax(learning_rate, eps=float(cfg.get("eps", 1e-8)))
+    elif kind_l in ("adamw",):
+        def base(learning_rate):
+            return optax.adamw(learning_rate, eps=float(cfg.get("eps", 1e-8)),
+                               weight_decay=wd)
+    elif kind_l == "lamb":
+        def base(learning_rate):
+            return optax.lamb(learning_rate, weight_decay=wd)
+    elif kind_l in ("lars", "larssgd"):
+        def base(learning_rate):
+            return optax.lars(learning_rate, weight_decay=wd,
+                              momentum=float(cfg.get("momentum", 0.9)))
+    else:
+        raise ValueError(f"unknown optimizer type {kind!r}")
+
+    return optax.inject_hyperparams(base)(learning_rate=lr)
+
+
+def make_lr_schedule(cfg: Optional[AnnealingConfig],
+                     base_lr: float) -> Callable[[int], float]:
+    """Host-side LR schedule: round/epoch index -> LR scalar.
+
+    Covers the reference's scheduler zoo (``utils/utils.py:151-224``) except
+    ``val_loss``, which needs validation data and lives in
+    :class:`PlateauTracker`.
+    """
+    if cfg is None or cfg.get("type", "step_lr") == "constant":
+        return lambda step: base_lr
+
+    kind = cfg.get("type", "step_lr")
+    if kind == "step_lr":
+        step_size = int(cfg.get("step_size", 1))
+        gamma = float(cfg.get("gamma", 1.0))
+        return lambda step: base_lr * (gamma ** (step // max(step_size, 1)))
+    if kind == "multi_step_lr":
+        milestones = sorted(cfg.get("milestones") or [])
+        gamma = float(cfg.get("gamma", 1.0))
+        def sched(step: int) -> float:
+            k = sum(1 for m in milestones if step >= m)
+            return base_lr * (gamma ** k)
+        return sched
+    if kind == "rampup-keep-expdecay-keep":
+        # SpecAugment schedule (reference utils/utils.py:189-224): linear
+        # ramp 0->peak over rampup_steps, hold hold_steps, exponential decay
+        # to floor over decay_steps, then hold floor.
+        peak = float(cfg.get("peak_lr", base_lr))
+        floor = float(cfg.get("floor_lr", base_lr * 0.01))
+        r = int(cfg.get("rampup_steps", 0))
+        h = int(cfg.get("hold_steps", 0))
+        d = max(int(cfg.get("decay_steps", 1)), 1)
+        import math
+        def sched(step: int) -> float:
+            if r and step < r:
+                return peak * (step + 1) / r
+            step2 = step - r
+            if step2 < h:
+                return peak
+            step3 = step2 - h
+            if step3 < d:
+                frac = step3 / d
+                return peak * math.exp(math.log(max(floor / peak, 1e-12)) * frac)
+            return floor
+        return sched
+    if kind == "val_loss":
+        # handled by PlateauTracker; return constant here
+        return lambda step: base_lr
+    raise ValueError(f"unknown annealing type {kind!r}")
+
+
+class PlateauTracker:
+    """ReduceLROnPlateau equivalent (reference ``val_loss`` mode,
+    ``utils/utils.py:151-186`` + ``core/trainer.py:139-155``): multiply LR by
+    ``factor`` after ``patience`` rounds without val-loss improvement."""
+
+    def __init__(self, cfg: AnnealingConfig, base_lr: float):
+        self.lr = float(base_lr)
+        self.factor = float(cfg.get("factor", 0.1))
+        self.patience = int(cfg.get("patience", 10))
+        self.best: Optional[float] = None
+        self.bad_rounds = 0
+
+    def step(self, val_loss: float) -> float:
+        if self.best is None or val_loss < self.best:
+            self.best = val_loss
+            self.bad_rounds = 0
+        else:
+            self.bad_rounds += 1
+            if self.bad_rounds > self.patience:
+                self.lr *= self.factor
+                self.bad_rounds = 0
+        return self.lr

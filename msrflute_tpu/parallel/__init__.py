@@ -1,0 +1,1 @@
+from .mesh import make_mesh, client_axis_sharding, replicated_sharding  # noqa: F401

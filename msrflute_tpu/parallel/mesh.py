@@ -1,0 +1,69 @@
+"""Device mesh construction & sharding helpers.
+
+This module replaces the reference's entire process/rank plumbing
+(``core/federated.py:45-55`` env-var ranks, ``e2e_trainer.py:95`` process
+groups).  In the TPU-native design there are no worker processes: a
+``jax.sharding.Mesh`` with a ``clients`` axis carries client parallelism
+(what FLUTE does with one whole-model replica per GPU worker rank,
+``doc/sphinx/overview.rst:6-27``), and an optional ``model`` axis carries
+tensor sharding for big models (net-new vs the reference, which has none —
+SURVEY.md §2.2).
+
+Multi-host: call :func:`maybe_init_distributed` first; the same mesh code
+then spans all hosts' devices and XLA routes collectives over ICI within a
+slice and DCN across slices — the role NCCL/Gloo plays in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENTS_AXIS = "clients"
+MODEL_AXIS = "model"
+
+
+def maybe_init_distributed() -> None:
+    """Initialize jax.distributed when launched multi-host (the analogue of
+    ``torch.distributed.run`` rendezvous, reference ``README.md:80-87``)."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+        jax.distributed.initialize()
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              model_axis_size: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``(clients, model)`` mesh over the available devices.
+
+    ``model_axis_size=1`` (the default) gives pure client parallelism — the
+    TPU equivalent of FLUTE's one-replica-per-worker pool.  Larger values
+    carve each client group into a tensor-sharded subgroup (for mlm_bert
+    style models).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    n = len(devs)
+    if n % model_axis_size:
+        raise ValueError(f"{n} devices not divisible by model_axis_size={model_axis_size}")
+    grid = np.asarray(devs).reshape(n // model_axis_size, model_axis_size)
+    return Mesh(grid, (CLIENTS_AXIS, MODEL_AXIS))
+
+
+def client_axis_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for arrays whose leading axis is the round's client axis."""
+    return NamedSharding(mesh, P(CLIENTS_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_mesh(k: int, mesh: Mesh) -> int:
+    """Round client count up to a multiple of the clients-axis size."""
+    n = mesh.shape[CLIENTS_AXIS]
+    return ((k + n - 1) // n) * n

@@ -1,0 +1,123 @@
+"""Config schema validation for msrflute_tpu.
+
+Parity target: reference ``core/schema.py`` (a cerberus schema dict loaded
+with ``eval`` at ``core/config.py:766-769``).  We validate the same
+constraints with a small hand-rolled checker: required sections, allowed
+enum values (optimizer types per ``core/schema.py:90``, annealing types per
+``utils/utils.py:151-186``, strategies per ``core/strategies/__init__.py:9-23``)
+and defaults.  Raises :class:`SchemaError` with every violation collected,
+like cerberus reports all errors at once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+ALLOWED_OPTIMIZERS = [
+    # reference core/schema.py:90
+    "sgd", "adam", "adamax", "lars", "LarsSGD", "lamb", "adamW",
+    # accepted aliases
+    "adamw", "larssgd",
+]
+
+ALLOWED_ANNEALING = [
+    # reference utils/utils.py:151-186
+    "step_lr", "multi_step_lr", "rampup-keep-expdecay-keep", "val_loss",
+    # alias
+    "constant",
+]
+
+ALLOWED_STRATEGIES = [
+    # reference core/strategies/__init__.py:9-23
+    "dga", "DGA", "fedavg", "FedAvg", "fedprox", "FedProx",
+    "fedlabels", "FedLabels",
+]
+
+ALLOWED_SERVER_TYPES = [
+    # reference core/server.py:581-597
+    "optimization", "model_optimization", "personalization",
+]
+
+
+class SchemaError(ValueError):
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("config schema violations:\n  " + "\n  ".join(errors))
+
+
+def _check_enum(errors: List[str], raw: Dict[str, Any], path: str, key: str,
+                allowed: List[str]) -> None:
+    val = raw.get(key)
+    if val is not None and val not in allowed:
+        errors.append(f"{path}.{key}: {val!r} not in {allowed}")
+
+
+def _check_optimizer(errors: List[str], raw: Any, path: str) -> None:
+    if not isinstance(raw, dict):
+        return
+    _check_enum(errors, raw, path, "type", ALLOWED_OPTIMIZERS)
+    lr = raw.get("lr")
+    if lr is not None and not isinstance(lr, (int, float)):
+        errors.append(f"{path}.lr: must be a number, got {type(lr).__name__}")
+
+
+def _check_annealing(errors: List[str], raw: Any, path: str) -> None:
+    if not isinstance(raw, dict):
+        return
+    _check_enum(errors, raw, path, "type", ALLOWED_ANNEALING)
+
+
+def validate(raw: Dict[str, Any]) -> None:
+    """Validate a raw (YAML-loaded) config dict in place.
+
+    Required sections follow reference ``core/schema.py``: ``model_config``
+    and ``server_config`` are required; everything else optional with
+    defaults supplied by the dataclass tree.
+    """
+    errors: List[str] = []
+
+    if "model_config" not in raw:
+        errors.append("model_config: required section missing")
+    elif not isinstance(raw["model_config"], dict):
+        errors.append("model_config: must be a mapping")
+    elif "model_type" not in raw["model_config"]:
+        errors.append("model_config.model_type: required key missing")
+
+    if "server_config" not in raw:
+        errors.append("server_config: required section missing")
+
+    strategy = raw.get("strategy")
+    if strategy is not None and strategy not in ALLOWED_STRATEGIES:
+        errors.append(f"strategy: {strategy!r} not in {ALLOWED_STRATEGIES}")
+
+    sc = raw.get("server_config")
+    if isinstance(sc, dict):
+        _check_enum(errors, sc, "server_config", "type", ALLOWED_SERVER_TYPES)
+        _check_optimizer(errors, sc.get("optimizer_config"), "server_config.optimizer_config")
+        _check_annealing(errors, sc.get("annealing_config"), "server_config.annealing_config")
+        ncpi = sc.get("num_clients_per_iteration")
+        if ncpi is not None and not isinstance(ncpi, int):
+            if not (isinstance(ncpi, str) and ":" in ncpi):
+                errors.append(
+                    "server_config.num_clients_per_iteration: must be int or 'lo:hi'")
+        for key in ("max_iteration", "val_freq", "rec_freq"):
+            val = sc.get(key)
+            if val is not None and (not isinstance(val, int) or val < 0):
+                errors.append(f"server_config.{key}: must be a non-negative int")
+
+    cc = raw.get("client_config")
+    if isinstance(cc, dict):
+        _check_optimizer(errors, cc.get("optimizer_config"), "client_config.optimizer_config")
+        if cc.get("annealing_config") is not None:
+            _check_annealing(errors, cc.get("annealing_config"), "client_config.annealing_config")
+
+    dp = raw.get("dp_config")
+    if isinstance(dp, dict):
+        for key in ("eps", "delta", "max_grad", "max_weight", "min_weight",
+                    "weight_scaler", "global_sigma"):
+            val = dp.get(key)
+            if val is not None and not isinstance(val, (int, float)):
+                errors.append(f"dp_config.{key}: must be a number")
+
+    if errors:
+        raise SchemaError(errors)

@@ -1,0 +1,24 @@
+"""Aggregation strategies.
+
+Parity target: reference ``core/strategies/`` — ``select_strategy``
+(``core/strategies/__init__.py:9-23``) mapping ``'dga'`` -> DGA,
+``'fedavg'``/``'fedprox'`` -> FedAvg, ``'fedlabels'`` -> FedLabels.
+"""
+
+from __future__ import annotations
+
+from .base import BaseStrategy  # noqa: F401
+from .fedavg import FedAvg  # noqa: F401
+from .dga import DGA  # noqa: F401
+
+
+def select_strategy(name: str) -> type:
+    key = (name or "fedavg").lower()
+    if key == "dga":
+        return DGA
+    if key in ("fedavg", "fedprox"):
+        return FedAvg
+    if key == "fedlabels":
+        from .fedlabels import FedLabels
+        return FedLabels
+    raise ValueError(f"unknown strategy {name!r}")

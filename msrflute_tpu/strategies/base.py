@@ -1,0 +1,82 @@
+"""Strategy contract.
+
+Parity target: reference ``core/strategies/base.py:8-57`` — the 3-method
+contract ``generate_client_payload`` / ``process_individual_payload`` /
+``combine_payloads`` executed on client and server processes.
+
+TPU-native redesign: a strategy contributes *pure traced functions* that the
+round engine composes into one jitted SPMD program:
+
+- :meth:`client_weight` — per-client aggregation weight from training
+  outcomes (runs inside ``vmap`` over clients; replaces the client-side half
+  of ``generate_client_payload``).
+- :meth:`transform_payload` — per-client payload post-processing: local DP,
+  layer freezing, quantization (the rest of ``generate_client_payload``).
+- :meth:`combine` — turn the weighted ``psum`` results into the aggregate
+  pseudo-gradient (replaces ``combine_payloads``); may carry strategy state
+  (e.g. DGA's staleness buffer) across rounds as an explicit pytree.
+
+Data-dependent, non-traceable behavior (adaptive thresholds, RL) stays in
+host-side hooks invoked at round boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_WEIGHT = 100.0  # reference core/strategies/utils.py:11-19
+
+
+def filter_weight(weight: jnp.ndarray) -> jnp.ndarray:
+    """NaN/Inf -> 0, cap at MAX_WEIGHT (reference
+    ``core/strategies/utils.py:11-19``)."""
+    weight = jnp.nan_to_num(weight, nan=0.0, posinf=0.0, neginf=0.0)
+    return jnp.clip(weight, 0.0, MAX_WEIGHT)
+
+
+class BaseStrategy:
+    """Base strategy: sample-count weights, identity transforms."""
+
+    #: whether combine() maintains cross-round state (a pytree)
+    stateful: bool = False
+    #: probability a client's payload is deferred one round (DGA staleness,
+    #: reference core/strategies/dga.py:260-284); the engine draws the
+    #: per-client coin and hands combine() separate now/deferred sums.
+    stale_prob: float = 0.0
+
+    def __init__(self, config, dp_config=None):
+        self.config = config
+        self.dp_config = dp_config
+
+    # ---- traced, per-client (inside vmap) ----------------------------
+    def client_weight(self, *, num_samples: jnp.ndarray,
+                      train_loss: jnp.ndarray,
+                      stats: Dict[str, jnp.ndarray],
+                      rng: jax.Array) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
+                          rng: jax.Array) -> Tuple[Any, jnp.ndarray]:
+        return pseudo_grad, weight
+
+    # ---- traced, post-psum (replicated) ------------------------------
+    def init_state(self, params_like: Any) -> Any:
+        return ()
+
+    def combine(self, weighted_grad_sum: Any, weight_sum: jnp.ndarray,
+                deferred: Optional[Dict[str, Any]], state: Any,
+                rng: jax.Array,
+                num_clients: Optional[jnp.ndarray] = None) -> Tuple[Any, Any]:
+        """Return (aggregate_pseudo_grad, new_state).
+
+        ``weighted_grad_sum``/``weight_sum`` are the psum'd contributions of
+        this round's non-deferred clients; ``deferred`` (when the engine runs
+        with ``stale_prob > 0``) holds ``{'grad_sum', 'weight_sum'}`` for the
+        clients deferred to next round.
+        """
+        denom = jnp.maximum(weight_sum, 1e-12)
+        agg = jax.tree.map(lambda g: g / denom, weighted_grad_sum)
+        return agg, state

@@ -1,0 +1,32 @@
+"""FedAvg / FedProx aggregation.
+
+Parity target: reference ``core/strategies/fedavg.py`` — client weight =
+``num_samples`` scaled through the DP ``weight_scaler`` (``fedavg.py:61-91``),
+optional ``freeze_layer`` gradient zeroing, server-side weighted average of
+pseudo-gradients divided by total weight (``fedavg.py:119-166``).  FedProx
+shares this aggregator; its proximal term lives in the client update
+(reference ``core/trainer.py:416-501``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import BaseStrategy, filter_weight
+
+
+class FedAvg(BaseStrategy):
+
+    def client_weight(self, *, num_samples, train_loss, stats, rng):
+        return filter_weight(num_samples)
+
+    def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
+                          rng: jax.Array) -> Tuple[Any, jnp.ndarray]:
+        if self.dp_config is not None and self.dp_config.get("enable_local_dp", False):
+            from ..privacy import apply_local_dp
+            pseudo_grad, weight = apply_local_dp(
+                pseudo_grad, weight, self.dp_config, add_weight_noise=False, rng=rng)
+        return pseudo_grad, weight

@@ -1,0 +1,69 @@
+"""Task dataset assembly — config-driven user-blob loading + featurization.
+
+Parity target: the reference's dataloader factory chain
+(``utils/dataloaders_utils.py:9-115``: dynamic import of each task's
+``DataLoader``/``Dataset`` + mode-based data-config selection).  Here the
+split files named in the config are read by the shared user-blob reader and
+featurized by the task (``BaseTask.make_dataset`` hook; numeric passthrough
+by default) into :class:`~msrflute_tpu.data.dataset.ArraysDataset`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .config import FLUTEConfig
+from .data import ArraysDataset, load_user_blob
+from .data.dataset import scrub_empty_clients
+from .data.user_blob import UserBlob
+from .models.base import BaseTask
+
+
+def default_featurize(blob: UserBlob, model_config) -> ArraysDataset:
+    """Numeric passthrough: samples -> float32 ``x``, labels -> int32 ``y``."""
+    per_user = []
+    for i in range(len(blob)):
+        x = np.asarray(blob.user_data[i], dtype=np.float32)
+        entry = {"x": x}
+        if blob.user_labels is not None:
+            entry["y"] = np.asarray(blob.user_labels[i]).astype(np.int32)
+        per_user.append(entry)
+    return ArraysDataset(blob.user_list, per_user, blob.num_samples)
+
+
+def make_dataset_for(task: BaseTask, blob: UserBlob, model_config,
+                     split: str) -> ArraysDataset:
+    hook = getattr(task, "make_dataset", None)
+    if hook is not None:
+        return hook(blob, model_config, split)
+    return default_featurize(blob, model_config)
+
+
+def build_task_datasets(cfg: FLUTEConfig, task: BaseTask) -> Tuple[
+        ArraysDataset, Optional[ArraysDataset], Optional[ArraysDataset]]:
+    """Load (train, val, test) datasets from the config's data paths.
+
+    Mirrors the reference's split selection: client train data from
+    ``client_config.data_config.train`` (``list_of_train_data`` or
+    ``train_data``), evals from ``server_config.data_config.{val,test}``
+    (``utils/dataloaders_utils.py:57-98``).
+    """
+    cc_train = cfg.client_config.data_config.train
+    train_path = cc_train.get("list_of_train_data") or cc_train.get("train_data")
+    if not train_path:
+        raise ValueError("client_config.data_config.train needs "
+                         "list_of_train_data or train_data")
+    train = scrub_empty_clients(make_dataset_for(
+        task, load_user_blob(train_path), cfg.model_config, "train"))
+
+    def _load(split_cfg, key, split):
+        path = split_cfg.get(key)
+        if not path:
+            return None
+        return make_dataset_for(task, load_user_blob(path), cfg.model_config, split)
+
+    val = _load(cfg.server_config.data_config.val, "val_data", "val")
+    test = _load(cfg.server_config.data_config.test, "test_data", "test")
+    return train, val, test

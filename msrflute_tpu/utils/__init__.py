@@ -1,0 +1,3 @@
+from .logging import init_logging, print_rank, log_metric  # noqa: F401
+from .metrics import Metric, MetricsDict, weighted_merge  # noqa: F401
+from .io import try_except_save, update_json_log, write_yaml  # noqa: F401

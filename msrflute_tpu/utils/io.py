@@ -1,0 +1,55 @@
+"""Persistence helpers.
+
+Parity target: reference ``utils/utils.py:335-359`` (``torch_save`` /
+``try_except_save`` with 3 retries), ``write_yaml``, and
+``update_json_log`` (``utils/utils.py:546-560``) used for
+``status_log.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict
+
+import yaml
+
+_LOGGER = logging.getLogger("msrflute_tpu")
+
+
+def try_except_save(save_fn: Callable[[], None], retries: int = 3,
+                    delay_s: float = 1.0) -> bool:
+    """Retry a save callable (reference ``utils/utils.py:348-359``)."""
+    for attempt in range(retries):
+        try:
+            save_fn()
+            return True
+        except Exception as exc:  # noqa: BLE001 - deliberate: persist best-effort
+            _LOGGER.warning("save attempt %d/%d failed: %s", attempt + 1, retries, exc)
+            time.sleep(delay_s)
+    return False
+
+
+def update_json_log(path: str, update: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge ``update`` into a JSON log file (reference
+    ``utils/utils.py:546-560``), returning the merged dict."""
+    data: Dict[str, Any] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, "r") as fh:
+                data = json.load(fh)
+        except (json.JSONDecodeError, OSError):
+            data = {}
+    data.update(update)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh, indent=2)
+    os.replace(tmp, path)
+    return data
+
+
+def write_yaml(path: str, payload: Dict[str, Any]) -> None:
+    with open(path, "w") as fh:
+        yaml.safe_dump(payload, fh)

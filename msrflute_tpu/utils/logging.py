@@ -1,0 +1,72 @@
+"""Logging & metric emission.
+
+Parity target: reference ``utils/utils.py:299-332`` (``init_logging``,
+timestamped ``print_rank``) and the AzureML ``run.log`` channel
+(``core/server.py:43-44``).  The TPU build replaces AzureML with a JSONL
+metric writer (one line per scalar) plus optional TensorBoard if available;
+both are observable offline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+_LOGGER = logging.getLogger("msrflute_tpu")
+_METRICS_FH = None
+
+
+def init_logging(log_dir: Optional[str] = None, loglevel: int = logging.INFO) -> None:
+    """File + stdout logging (reference ``utils/utils.py:299-307``), and a
+    ``metrics.jsonl`` writer in place of AzureML ``run.log``."""
+    global _METRICS_FH
+    handlers: list = [logging.StreamHandler()]
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        handlers.append(logging.FileHandler(os.path.join(log_dir, "log.out")))
+        _METRICS_FH = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+    logging.basicConfig(
+        level=loglevel,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        handlers=handlers,
+        force=True,
+    )
+
+
+def print_rank(msg: str, loglevel: int = logging.INFO) -> None:
+    """Timestamped log line (reference ``utils/utils.py:311-322``; the rank
+    prefix is moot in a single-controller design — we tag the process id of
+    the controller instead when running multi-host)."""
+    pid = os.environ.get("JAX_PROCESS_INDEX", "0")
+    _LOGGER.log(loglevel, "p%s: %s", pid, msg)
+
+
+def log_metric(name: str, value: Any, step: Optional[int] = None,
+               extra: Optional[Dict[str, Any]] = None) -> None:
+    """Scalar metric emission (replaces AzureML ``run.log`` at reference
+    ``core/server.py:261-264,523-525``)."""
+    record = {"ts": time.time(), "name": name, "value": _to_py(value)}
+    if step is not None:
+        record["step"] = step
+    if extra:
+        record.update(extra)
+    if _METRICS_FH is not None:
+        _METRICS_FH.write(json.dumps(record) + "\n")
+        _METRICS_FH.flush()
+    _LOGGER.info("metric %s=%s%s", name, record["value"],
+                 f" @ {step}" if step is not None else "")
+
+
+def _to_py(value: Any) -> Any:
+    try:
+        import numpy as np
+        if isinstance(value, (np.generic,)):
+            return value.item()
+        if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
+            return value.item()
+    except Exception:
+        pass
+    return value

@@ -1,0 +1,43 @@
+"""Metric data model.
+
+Parity target: reference metric contract — every metric is
+``{'value': float, 'higher_is_better': bool}`` produced by
+``model.inference`` (``core/model.py:23-43``, ``core/metrics.py:35-56``),
+merged across eval clients by sample-weighted averaging
+(``core/evaluation.py:160-183``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, Tuple
+
+
+@dataclass
+class Metric:
+    value: float
+    higher_is_better: bool = True
+
+    def is_better_than(self, other: "Metric") -> bool:
+        if self.higher_is_better:
+            return self.value > other.value
+        return self.value < other.value
+
+
+MetricsDict = Dict[str, Metric]
+
+
+def weighted_merge(parts: Iterable[Tuple[float, MetricsDict]]) -> MetricsDict:
+    """Sample-weighted average of per-client metric dicts (reference
+    ``core/evaluation.py:160-183``: metrics weighted by batch/sample counts)."""
+    sums: Dict[str, float] = {}
+    hib: Dict[str, bool] = {}
+    total = 0.0
+    for weight, metrics in parts:
+        total += weight
+        for name, metric in metrics.items():
+            sums[name] = sums.get(name, 0.0) + weight * float(metric.value)
+            hib[name] = metric.higher_is_better
+    if total <= 0:
+        return {}
+    return {name: Metric(sums[name] / total, hib[name]) for name in sums}

@@ -1,0 +1,56 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's testing philosophy (``testing/README.md:3``: tiny
+dummy data, exercise the machinery not the accuracy) — but with unit tests
+per layer, which the reference lacks (SURVEY.md §4).  Multi-chip sharding is
+exercised on ``xla_force_host_platform_device_count=8`` virtual devices.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# env vars alone are not enough: a sitecustomize may have imported jax at
+# interpreter startup with another platform already configured.
+jax.config.update("jax_platforms", "cpu")
+assert all(d.platform == "cpu" for d in jax.devices()), jax.devices()
+assert len(jax.devices()) == 8, jax.devices()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from msrflute_tpu.parallel import make_mesh
+    return make_mesh()
+
+
+def make_synthetic_classification(num_users=16, samples_lo=6, samples_hi=24,
+                                  dim=8, num_classes=4, seed=0):
+    """Tiny linearly-separable federated dataset (the unit-test analogue of
+    reference ``testing/create_data.py``)."""
+    from msrflute_tpu.data import ArraysDataset
+
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(dim, num_classes))
+    users, per_user, counts = [], [], []
+    for u in range(num_users):
+        n = int(rng.integers(samples_lo, samples_hi + 1))
+        x = rng.normal(size=(n, dim)).astype(np.float32)
+        y = np.argmax(x @ w_true + 0.1 * rng.normal(size=(n, num_classes)),
+                      axis=-1).astype(np.int32)
+        users.append(f"user{u:03d}")
+        per_user.append({"x": x, "y": y})
+        counts.append(n)
+    return ArraysDataset(users, per_user, counts)
+
+
+@pytest.fixture(scope="session")
+def synth_dataset():
+    return make_synthetic_classification()

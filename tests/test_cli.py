@@ -1,0 +1,80 @@
+"""CLI e2e smoke test — the direct analogue of reference
+``testing/test_e2e_trainer.py`` (subprocess run of the trainer on dummy
+data, assert exit 0), but also asserts on produced artifacts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import yaml
+
+
+def _write_blob(path, num_users, dim=6, classes=3, lo=4, hi=10, seed=0):
+    rng = np.random.default_rng(seed)
+    users = [f"u{i}" for i in range(num_users)]
+    data, labels, counts = {}, {}, []
+    w = rng.normal(size=(dim, classes))
+    for u in users:
+        n = int(rng.integers(lo, hi))
+        x = rng.normal(size=(n, dim))
+        y = np.argmax(x @ w, axis=1)
+        data[u] = {"x": x.tolist()}
+        labels[u] = y.tolist()
+        counts.append(n)
+    with open(path, "w") as fh:
+        json.dump({"users": users, "num_samples": counts,
+                   "user_data": data, "user_data_label": labels}, fh)
+
+
+def test_cli_end_to_end(tmp_path):
+    data_dir = tmp_path / "data"
+    out_dir = tmp_path / "out"
+    data_dir.mkdir()
+    _write_blob(data_dir / "train.json", 12)
+    _write_blob(data_dir / "val.json", 4, seed=1)
+    _write_blob(data_dir / "test.json", 4, seed=2)
+
+    cfg = {
+        "model_config": {"model_type": "LR", "num_classes": 3, "input_dim": 6},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 3,
+            "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.3,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2, "rec_freq": 2, "initial_val": True,
+            "best_model_criterion": "acc",
+            "data_config": {"val": {"batch_size": 8, "val_data": "val.json"},
+                            "test": {"batch_size": 8, "test_data": "test.json"}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.3},
+            "data_config": {"train": {"batch_size": 4,
+                                      "list_of_train_data": "train.json"}},
+        },
+    }
+    cfg_path = tmp_path / "cfg.yaml"
+    cfg_path.write_text(yaml.safe_dump(cfg))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # neutralize TPU sitecustomize
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "e2e_trainer.py"),
+         "-config", str(cfg_path), "-dataPath", str(data_dir),
+         "-outputPath", str(out_dir), "-task", "cv_lr_mnist"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    # artifacts: checkpoint + status log + metrics stream + copied config
+    assert (out_dir / "models" / "latest_model.msgpack").exists()
+    status = json.loads((out_dir / "models" / "status_log.json").read_text())
+    assert status["i"] == 3
+    metrics = [json.loads(l) for l in
+               (out_dir / "log" / "metrics.jsonl").read_text().splitlines()]
+    assert any(m["name"] == "Val acc" for m in metrics)
+    assert (out_dir / "cfg.yaml").exists()

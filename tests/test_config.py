@@ -1,0 +1,64 @@
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.schema import SchemaError
+
+
+MINI = {
+    "model_config": {"model_type": "LR", "num_classes": 4, "input_dim": 8},
+    "strategy": "fedavg",
+    "server_config": {
+        "max_iteration": 5,
+        "num_clients_per_iteration": 4,
+        "initial_lr_client": 0.1,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "annealing_config": {"type": "step_lr", "step_interval": "epoch",
+                             "step_size": 1, "gamma": 1.0},
+        "val_freq": 2,
+        "data_config": {"val": {"batch_size": 8}, "test": {"batch_size": 8}},
+    },
+    "client_config": {
+        "optimizer_config": {"type": "sgd", "lr": 0.1},
+        "data_config": {"train": {"batch_size": 4}},
+    },
+}
+
+
+def test_from_dict_and_lookup():
+    cfg = FLUTEConfig.from_dict(MINI)
+    assert cfg.server_config.max_iteration == 5
+    assert cfg.lookup("server_config.optimizer_config.lr") == 1.0
+    assert cfg.lookup("client_config.data_config.train.batch_size") == 4
+    assert cfg.lookup("does.not.exist", default=7) == 7
+    # unknown model params preserved in extra + mapping access
+    assert cfg.model_config["num_classes"] == 4
+    assert cfg.model_config.get("input_dim") == 8
+
+
+def test_schema_rejects_bad_optimizer():
+    bad = {**MINI, "server_config": {**MINI["server_config"],
+                                     "optimizer_config": {"type": "rmsprop"}}}
+    with pytest.raises(SchemaError, match="rmsprop"):
+        FLUTEConfig.from_dict(bad)
+
+
+def test_schema_requires_model_type():
+    with pytest.raises(SchemaError, match="model_type"):
+        FLUTEConfig.from_dict({"model_config": {}, "server_config": {}})
+
+
+def test_clients_per_round_range():
+    import numpy as np
+    from msrflute_tpu.config import parse_clients_per_round
+    rng = np.random.default_rng(0)
+    vals = {parse_clients_per_round("3:6", rng) for _ in range(50)}
+    assert vals <= {3, 4, 5, 6} and len(vals) > 1
+    assert parse_clients_per_round(10, rng) == 10
+
+
+def test_to_dict_roundtrip():
+    cfg = FLUTEConfig.from_dict(MINI)
+    d = cfg.to_dict()
+    cfg2 = FLUTEConfig.from_dict(d)
+    assert cfg2.server_config.max_iteration == cfg.server_config.max_iteration
+    assert cfg2.model_config["num_classes"] == 4

@@ -1,0 +1,92 @@
+import json
+
+import numpy as np
+import pytest
+
+from msrflute_tpu.data import (
+    ArraysDataset, load_user_blob, pack_eval_batches, pack_round_batches,
+    steps_for,
+)
+
+
+def test_load_user_blob_json(tmp_path):
+    blob = {
+        "users": ["a", "b"],
+        "num_samples": [2, 3],
+        "user_data": {"a": {"x": [[1, 2], [3, 4]]},
+                      "b": [[5, 6], [7, 8], [9, 10]]},
+    }
+    p = tmp_path / "data.json"
+    p.write_text(json.dumps(blob))
+    loaded = load_user_blob(str(p))
+    assert loaded.user_list == ["a", "b"]
+    assert loaded.num_samples == [2, 3]
+    assert len(loaded.user_data[1]) == 3
+
+
+def test_load_user_blob_hdf5(tmp_path):
+    from msrflute_tpu.data.user_blob import UserBlob, save_user_blob_hdf5
+    blob = UserBlob(
+        user_list=["u0", "u1"], num_samples=[2, 1],
+        user_data=[np.ones((2, 3), np.float32), np.zeros((1, 3), np.float32)],
+        user_labels=[np.array([0, 1]), np.array([2])])
+    p = str(tmp_path / "data.hdf5")
+    save_user_blob_hdf5(p, blob)
+    loaded = load_user_blob(p)
+    assert loaded.user_list == ["u0", "u1"]
+    assert loaded.num_samples == [2, 1]
+    np.testing.assert_array_equal(loaded.user_labels[1], [2])
+
+
+def test_steps_for():
+    assert steps_for(10, 4) == 3
+    assert steps_for(100, 4, desired_max_samples=10) == 3
+    assert steps_for(0, 4) == 1
+
+
+def test_pack_round_batches(synth_dataset):
+    B, S = 4, 3
+    batch = pack_round_batches(synth_dataset, [0, 1, 2], B, S,
+                               rng=np.random.default_rng(0),
+                               pad_clients_to=8)
+    assert batch.sample_mask.shape == (8, S, B)
+    assert batch.arrays["x"].shape == (8, S, B, 8)
+    # padding clients have zero mask and -1 ids
+    assert batch.client_mask.tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
+    assert batch.client_ids[3] == -1
+    assert batch.sample_mask[3].sum() == 0
+    # real sample counts capped at S*B
+    for j in range(3):
+        expected = min(synth_dataset.num_samples[j], S * B)
+        assert batch.num_samples[j] == expected
+        assert batch.sample_mask[j].sum() == expected
+
+
+def test_pack_round_batches_desired_max():
+    ds = ArraysDataset(
+        ["u"], [{"x": np.arange(40, dtype=np.float32).reshape(20, 2),
+                 "y": np.zeros(20, np.int32)}])
+    batch = pack_round_batches(ds, [0], batch_size=4, max_steps=5,
+                               desired_max_samples=7, shuffle=False)
+    assert batch.num_samples[0] == 7
+    assert batch.sample_mask[0].sum() == 7
+
+
+def test_pack_eval_batches(synth_dataset):
+    out = pack_eval_batches(synth_dataset, batch_size=8,
+                            pad_steps_to_multiple_of=8)
+    T = out["sample_mask"].shape[0]
+    assert T % 8 == 0
+    total = sum(synth_dataset.num_samples)
+    assert out["sample_mask"].sum() == total
+    # user segmentation is recoverable
+    assert (out["user_idx"] >= 0).sum() == total
+
+
+def test_scrub_empty_clients():
+    from msrflute_tpu.data.dataset import scrub_empty_clients
+    ds = ArraysDataset(
+        ["a", "b"], [{"x": np.zeros((0, 2), np.float32), "y": np.zeros(0, np.int32)},
+                     {"x": np.zeros((3, 2), np.float32), "y": np.zeros(3, np.int32)}])
+    out = scrub_empty_clients(ds)
+    assert out.user_list == ["b"]

@@ -1,0 +1,102 @@
+"""End-to-end round-loop smoke tests on the 8-device virtual mesh —
+the analogue of reference ``testing/test_e2e_trainer.py`` (which shells out
+to a 2-process torch.distributed run), plus correctness assertions the
+reference never had: learning actually reduces loss, checkpoints resume.
+"""
+
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+
+
+def _config(max_iteration=6, **server_over):
+    raw = {
+        "model_config": {"model_type": "LR", "num_classes": 4, "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": max_iteration,
+            "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.5,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2,
+            "rec_freq": 100,
+            "initial_val": True,
+            "best_model_criterion": "acc",
+            "data_config": {"val": {"batch_size": 8}, "test": {"batch_size": 8}},
+            **server_over,
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.5},
+            "data_config": {"train": {"batch_size": 4}},
+        },
+    }
+    return FLUTEConfig.from_dict(raw)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory, synth_dataset, mesh8):
+    cfg = _config()
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(
+        task, cfg, synth_dataset, val_dataset=synth_dataset,
+        model_dir=str(tmp_path_factory.mktemp("models")), mesh=mesh8, seed=1)
+    initial = server._maybe_eval  # run explicit initial eval through train()
+    state = server.train()
+    return server, state
+
+
+def test_training_improves_metrics(trained, synth_dataset):
+    server, state = trained
+    assert state.round == 6
+    # linear separable toy data: accuracy should beat the 1/4 chance level
+    assert server.best_val["acc"].value > 0.5
+    assert "loss" in server.best_val
+
+
+def test_checkpoint_resume(trained, synth_dataset, mesh8, tmp_path):
+    server, state = trained
+    # latest checkpoint exists and loads back with identical params
+    restored = server.ckpt.load(server.engine.init_state(
+        __import__("jax").random.PRNGKey(0)))
+    assert restored is not None
+    assert restored.round == 6
+    import jax
+    old = jax.device_get(state.params)
+    new = jax.device_get(restored.params)
+    for a, b in zip(jax.tree.leaves(old), jax.tree.leaves(new)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_resume_continues_rounds(synth_dataset, mesh8, tmp_path):
+    cfg = _config(max_iteration=2)
+    task = make_task(cfg.model_config)
+    d = str(tmp_path / "m")
+    s1 = OptimizationServer(task, cfg, synth_dataset, val_dataset=synth_dataset,
+                            model_dir=d, mesh=mesh8, seed=2)
+    s1.train()
+    cfg2 = _config(max_iteration=4, resume_from_checkpoint=True)
+    s2 = OptimizationServer(task, cfg2, synth_dataset, val_dataset=synth_dataset,
+                            model_dir=d, mesh=mesh8, seed=3)
+    assert s2.state.round == 2
+    final = s2.train()
+    assert final.round == 4
+
+
+def test_dga_strategy_runs(synth_dataset, mesh8, tmp_path):
+    raw_over = {"aggregate_median": "softmax", "softmax_beta": 0.5,
+                "weight_train_loss": "train_loss", "stale_prob": 0.3}
+    cfg = _config(max_iteration=3, **raw_over)
+    cfg.strategy = "dga"
+    from msrflute_tpu.strategies import select_strategy, DGA
+    assert select_strategy("dga") is DGA
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                val_dataset=synth_dataset,
+                                model_dir=str(tmp_path / "dga"), mesh=mesh8)
+    state = server.train()
+    assert state.round == 3
+    # staleness buffer is threaded state
+    assert "stale_grad_sum" in state.strategy_state
