@@ -36,7 +36,7 @@ def main() -> None:
     from msrflute_tpu.models import make_task
     from msrflute_tpu.parallel import make_mesh
     from msrflute_tpu.parallel.mesh import maybe_init_distributed
-    from msrflute_tpu.tasks import build_task_datasets
+    from msrflute_tpu.tasks import build_server_train_dataset, build_task_datasets
     from msrflute_tpu.utils import init_logging, print_rank
 
     maybe_init_distributed()
@@ -67,7 +67,9 @@ def main() -> None:
     mesh = make_mesh(model_axis_size=int(cfg.mesh_config.get("model_axis_size", 1)))
     server_cls = select_server(cfg.server_config.get("type", "optimization"))
     server = server_cls(task, cfg, train_ds, val_dataset=val_ds,
-                        test_dataset=test_ds, model_dir=model_dir, mesh=mesh)
+                        test_dataset=test_ds,
+                        server_train_dataset=build_server_train_dataset(cfg, task),
+                        model_dir=model_dir, mesh=mesh)
     server.run()
 
 

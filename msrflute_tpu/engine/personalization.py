@@ -1,17 +1,255 @@
-"""Personalization server.
+"""Personalization — per-user local models with convex interpolation.
 
-Parity target: reference ``experiments/cv/server.py:9-18`` —
-``PersonalizationServer`` is a ctor-only subclass hook of
-``OptimizationServer`` (the actual personalization math — convex model
-interpolation and per-user alpha updates, ``core/client.py:387-443`` and
-``utils/utils.py:598-617`` — runs on the client side; see
-:mod:`msrflute_tpu.engine.personalization_state`).
+Parity target: reference personalization flow
+(``experiments/cv/server.py``, ``core/client.py:387-443``,
+``utils/utils.py:598-617``):
+
+- every user owns a persistent *local* model and a scalar ``alpha``;
+- when sampled, the user trains BOTH the global model (the normal federated
+  path) and its local model on the same data;
+- ``alpha`` takes one SGD step on the interpolation objective:
+  ``grad_alpha = sum((w_g - w_p) . (alpha*pg_g + (1-alpha)*pg_p)) + 0.02*alpha``
+  with ``alpha`` clipped to [1e-4, 0.9999] (``utils/utils.py:607-617``,
+  the reference's argument names are swapped — semantics preserved);
+- evaluation interpolates logits: ``alpha*personal + (1-alpha)*global``
+  (``convex_inference``, ``utils/utils.py:600-605``), metric = accuracy.
+
+TPU-native: local models of the round's sampled users are stacked on the
+clients axis and trained by the SAME vmapped client-update program as the
+global pass — one extra shard_map program per round, no per-user Python.
+Per-user state lives host-side in :class:`PersonalizationStore` between
+rounds (the analogue of the reference's ``<user>_model.tar`` /
+``<user>_alpha`` files) and is checkpointed with msgpack.
+
+Divergence (configurable): the reference cold-starts a user's local model
+with random init (``make_model``, ``core/client.py:390``); default here is
+to clone the current global params (``personalization_init: global``), which
+dominates random init; set ``personalization_init: random`` for the
+reference behavior.
 """
 
 from __future__ import annotations
 
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..data.batching import pack_round_batches
+from ..parallel.mesh import CLIENTS_AXIS, pad_to_mesh
+from ..utils.logging import log_metric, print_rank
+from ..utils.metrics import Metric
 from .server import OptimizationServer
 
 
+class PersonalizationStore:
+    """Host-side per-user (local_params, alpha) state."""
+
+    def __init__(self, init_alpha: float):
+        self.init_alpha = float(init_alpha)
+        self.params: Dict[int, Any] = {}
+        self.alpha: Dict[int, float] = {}
+
+    def get(self, user_idx: int, default_params) -> Tuple[Any, float]:
+        return (self.params.get(user_idx, default_params),
+                self.alpha.get(user_idx, self.init_alpha))
+
+    def put(self, user_idx: int, params: Any, alpha: float) -> None:
+        self.params[user_idx] = params
+        self.alpha[user_idx] = float(alpha)
+
+    def save(self, path: str) -> None:
+        payload = {"alpha": {str(k): v for k, v in self.alpha.items()},
+                   "params": {str(k): jax.device_get(v)
+                              for k, v in self.params.items()}}
+        with open(path, "wb") as fh:
+            fh.write(serialization.msgpack_serialize(
+                serialization.to_state_dict(payload)))
+
+    def load(self, path: str, template) -> bool:
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as fh:
+            raw = serialization.msgpack_restore(fh.read())
+        self.alpha = {int(k): float(v) for k, v in raw.get("alpha", {}).items()}
+        tmpl = serialization.to_state_dict(jax.device_get(template))
+        self.params = {
+            int(k): serialization.from_state_dict(tmpl, v)
+            for k, v in raw.get("params", {}).items()}
+        return True
+
+
 class PersonalizationServer(OptimizationServer):
-    """Round loop with per-user personalization state enabled."""
+    """OptimizationServer + per-user personalization passes."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        cc = self.config.client_config
+        self.alpha0 = float(cc.get("convex_model_interp", 0.75))
+        self.store = PersonalizationStore(self.alpha0)
+        self._store_path = os.path.join(self.ckpt.model_dir,
+                                        "personalization.msgpack")
+        if self.config.server_config.get("resume_from_checkpoint", False):
+            if self.store.load(self._store_path, self.state.params):
+                print_rank(f"restored personalization state for "
+                           f"{len(self.store.alpha)} users")
+        self._personal_fn = None
+        self._random_init = (self.config.server_config.get(
+            "personalization_init", "global") == "random")
+        # the personal pass reads the CURRENT global params per round, so
+        # round fusion would train local models against stale globals
+        if int(self.config.server_config.get("rounds_per_step", 1) or 1) > 1:
+            print_rank("personalization forces rounds_per_step=1")
+            self.config.server_config.rounds_per_step = 1
+
+    def _round_housekeeping(self, round_no, val_freq, rec_freq):
+        super()._round_housekeeping(round_no, val_freq, rec_freq)
+        # persist per-user state at the same cadence as the global model
+        # (reference writes <user>_model.tar / <user>_alpha per client,
+        # core/client.py:408-443)
+        self.store.save(self._store_path)
+
+    # -- jitted per-user local pass ------------------------------------
+    def _build_personal_fn(self):
+        engine = self.engine
+        client_update = engine.client_update
+        cspec = P(CLIENTS_AXIS)
+        rspec = P()
+        from jax import shard_map
+
+        def shard_body(global_params, local_params, alphas, arrays,
+                       sample_mask, client_mask, client_ids, client_lr, rng):
+            def per_user(lp, alpha, arr, mask, cm, cid):
+                rng_c = jax.random.fold_in(rng, cid + 104729)
+                # global-model pass pseudo-grad (recomputed here so the
+                # alpha update sees both pseudo-gradients, as in the
+                # reference where both trainers run in the same round)
+                pg_g, _, _, _ = client_update(global_params, arr, mask,
+                                              client_lr, rng_c)
+                # local-model pass
+                pg_p, tl_p, ns, _ = client_update(lp, arr, mask, client_lr,
+                                                  jax.random.fold_in(rng_c, 5))
+                new_lp = jax.tree.map(lambda w, g: w - g, lp, pg_p)
+                # alpha SGD step (utils/utils.py:607-617); the reference
+                # calls alpha_update after BOTH trainings, so the dot uses
+                # post-training params: (w_g - pg_g) - (lp - pg_p)
+                dots = jax.tree.map(
+                    lambda wg, wp, gg, gp: jnp.sum(
+                        ((wg - gg) - (wp - gp)) *
+                        (alpha * gg + (1.0 - alpha) * gp)),
+                    global_params, lp, pg_g, pg_p)
+                grad_alpha = sum(jax.tree.leaves(dots)) + 0.02 * alpha
+                new_alpha = jnp.clip(alpha - client_lr * grad_alpha,
+                                     1e-4, 0.9999)
+                new_alpha = jnp.where(jnp.isfinite(new_alpha), new_alpha,
+                                      jnp.asarray(self.alpha0))
+                new_alpha = jnp.where(cm > 0, new_alpha, alpha)
+                new_lp = jax.tree.map(
+                    lambda new, old: jnp.where(cm > 0, new, old), new_lp, lp)
+                return new_lp, new_alpha, tl_p * cm
+
+            return jax.vmap(per_user)(local_params, alphas, arrays,
+                                      sample_mask, client_mask, client_ids)
+
+        fn = shard_map(
+            shard_body, mesh=engine.mesh,
+            in_specs=(rspec, cspec, cspec, cspec, cspec, cspec, cspec,
+                      rspec, rspec),
+            out_specs=cspec, check_vma=False)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    # -- hook into the round loop --------------------------------------
+    def train(self):
+        state = super().train()
+        self.store.save(self._store_path)
+        return state
+
+    def _sample(self):
+        sampled = super()._sample()
+        self._run_personal_pass(sampled)
+        return sampled
+
+    def _run_personal_pass(self, sampled) -> None:
+        """Train sampled users' local models + alphas for this round."""
+        if self._personal_fn is None:
+            self._personal_fn = self._build_personal_fn()
+        batch = pack_round_batches(
+            self.train_dataset, sampled, self.batch_size, self.max_steps,
+            rng=self._np_rng, pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
+            desired_max_samples=self.desired_max_samples)
+        k_pad = batch.client_mask.shape[0]
+        default = (self._random_params() if self._random_init
+                   else jax.device_get(self.state.params))
+        locals_, alphas = [], []
+        for j in range(k_pad):
+            cid = int(batch.client_ids[j])
+            lp, a = self.store.get(cid if cid >= 0 else -1, default)
+            locals_.append(lp)
+            alphas.append(a)
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *locals_)
+        sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
+        stage = lambda v: jax.device_put(v, sharding)
+        self._rng, rng = jax.random.split(self._rng)
+        new_lp, new_alpha, tl = self._personal_fn(
+            self.state.params, jax.tree.map(stage, stacked),
+            stage(np.asarray(alphas, np.float32)),
+            {k: stage(v) for k, v in batch.arrays.items()},
+            stage(batch.sample_mask), stage(batch.client_mask),
+            stage(batch.client_ids),
+            jnp.asarray(self.initial_lr_client * self.lr_weight, jnp.float32),
+            rng)
+        new_lp = jax.device_get(new_lp)
+        new_alpha = jax.device_get(new_alpha)
+        for j in range(k_pad):
+            cid = int(batch.client_ids[j])
+            if cid < 0:
+                continue
+            self.store.put(cid, jax.tree.map(lambda x: x[j], new_lp),
+                           float(new_alpha[j]))
+
+    def _random_params(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return jax.device_get(self.task.init_params(sub))
+
+    # -- personalized eval ---------------------------------------------
+    def personalized_accuracy(self, dataset) -> Optional[float]:
+        """Convex-interpolated accuracy over users with local state
+        (reference ``convex_inference``, ``utils/utils.py:600-605``).
+
+        Host-driven per-user loop (eval-time only), interpolating logits of
+        the global and local models.
+        """
+        if not self.store.alpha:
+            return None
+        task = self.task
+        if not hasattr(task, "apply"):
+            return None
+        correct = total = 0.0
+        gp = self.state.params
+        for uid, alpha in self.store.alpha.items():
+            if uid >= len(dataset):
+                continue
+            arrays = dataset.user_arrays(uid)
+            x = jnp.asarray(arrays["x"])
+            y = np.asarray(arrays["y"])
+            logits_g = jax.device_get(task.apply(gp, x))
+            logits_p = jax.device_get(task.apply(self.store.params[uid], x))
+            probs = alpha * _softmax(logits_p) + (1 - alpha) * _softmax(logits_g)
+            pred = probs.argmax(axis=-1)
+            correct += float((pred == y).sum())
+            total += len(y)
+        if total == 0:
+            return None
+        acc = correct / total
+        log_metric("Personalized val acc", acc, step=self.state.round)
+        return acc
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    x = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=-1, keepdims=True)

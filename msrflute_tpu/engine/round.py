@@ -66,6 +66,7 @@ class RoundEngine:
         self.task = task
         self.config = config
         self.strategy = strategy
+        strategy.task = task  # strategies may need model apply()/loss()
         self.mesh = mesh if mesh is not None else make_mesh()
 
         cc = config.client_config
@@ -87,6 +88,7 @@ class RoundEngine:
 
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
+        self._multi_cache = {}
         self._round_step = self._build_round_step()
 
     # ------------------------------------------------------------------
@@ -113,39 +115,48 @@ class RoundEngine:
         rspec = P()
 
         def shard_body(params, arrays, sample_mask, client_mask, client_ids,
-                       client_lr, rng):
+                       client_lr, round_idx, leakage_threshold, rng):
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
                 rng_c = jax.random.fold_in(rng, cid_c)
-                pg, tl, ns, stats = client_update(
-                    params, arr_c, mask_c, client_lr, rng_c)
-                w = strategy.client_weight(
-                    num_samples=ns, train_loss=tl, stats=stats,
-                    rng=jax.random.fold_in(rng_c, 1))
-                pg, w = strategy.transform_payload(
-                    pg, w, jax.random.fold_in(rng_c, 2))
-                w = w * cm_c
+                parts, tl, ns, stats = strategy.client_step(
+                    client_update, params, arr_c, mask_c, client_lr, rng_c,
+                    round_idx=round_idx, leakage_threshold=leakage_threshold)
+                parts = {name: (tree, w * cm_c)
+                         for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
                     coin = jax.random.bernoulli(
                         jax.random.fold_in(rng_c, 3), stale_prob)
                     stale = coin.astype(jnp.float32) * cm_c
                 else:
                     stale = jnp.zeros(())
-                return pg, w, tl * cm_c, ns * cm_c, stats, stale
+                return parts, tl * cm_c, ns * cm_c, stats, stale
 
-            pgs, ws, tls, nss, stats, stale = jax.vmap(per_client)(
+            parts, tls, nss, stats, stale = jax.vmap(per_client)(
                 arrays, sample_mask, client_mask, client_ids)
+            # per-client privacy-attack metrics stay per-client (the server
+            # needs the distribution for the adaptive leakage threshold,
+            # core/server.py:397-409)
+            privacy_per_client = {k: v for k, v in stats.items()
+                                  if k.startswith("privacy_")}
+            stats = {k: v for k, v in stats.items()
+                     if not k.startswith("privacy_")}
 
-            w_now = ws * (1.0 - stale)
-            w_def = ws * stale
-            wsum = lambda w: jax.tree.map(
-                lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), pgs)
-            local = {
-                "grad_sum_now": wsum(w_now),
-                "weight_sum_now": jnp.sum(w_now),
-                "grad_sum_def": wsum(w_def),
-                "weight_sum_def": jnp.sum(w_def),
+            local = {"parts": {}}
+            for name, (trees, ws) in parts.items():
+                w_now = ws * (1.0 - stale)
+                w_def = ws * stale
+                wsum = lambda w, t: jax.tree.map(
+                    lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), t)
+                local["parts"][name] = {
+                    "grad_sum": wsum(w_now, trees),
+                    "weight_sum": jnp.sum(w_now),
+                    "grad_sum_def": wsum(w_def, trees),
+                    "weight_sum_def": jnp.sum(w_def),
+                    "weight_sum_raw": jnp.sum(ws),
+                }
+            local.update({
                 "train_loss_sum": jnp.sum(tls),
                 "num_samples_sum": jnp.sum(nss),
                 "client_count": jnp.sum(client_mask),
@@ -153,29 +164,32 @@ class RoundEngine:
                 "stats_mag_sum": jnp.sum(stats["mag"] * client_mask),
                 "stats_var_sum": jnp.sum(stats["var_corrected"] * client_mask),
                 "stats_norm_sum": jnp.sum(stats["norm"] * client_mask),
-                "weight_sum_raw": jnp.sum(ws),
-            }
+            })
             # the "harvest": one collective instead of K P2P recvs
-            return jax.lax.psum(local, CLIENTS_AXIS)
+            return jax.lax.psum(local, CLIENTS_AXIS), privacy_per_client
 
         sharded_collect = shard_map(
             shard_body, mesh=mesh,
-            in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec),
-            out_specs=rspec, check_vma=False)
+            in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec,
+                      rspec, rspec),
+            out_specs=(rspec, cspec), check_vma=False)
 
         def round_step(params, opt_state, strategy_state, arrays, sample_mask,
-                       client_mask, client_ids, client_lr, server_lr, rng):
-            collected = sharded_collect(
+                       client_mask, client_ids, client_lr, server_lr,
+                       round_idx, leakage_threshold, rng):
+            collected, privacy_per_client = sharded_collect(
                 params, arrays, sample_mask, client_mask, client_ids,
-                client_lr, rng)
+                client_lr, round_idx, leakage_threshold, rng)
+            part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:
-                deferred = {"grad_sum": collected["grad_sum_def"],
-                            "weight_sum": collected["weight_sum_def"]}
-            agg, new_strategy_state = strategy.combine(
-                collected["grad_sum_now"], collected["weight_sum_now"],
-                deferred, strategy_state, jax.random.fold_in(rng, 17),
-                num_clients=collected["client_count"])
+                default = part_sums["default"]
+                deferred = {"grad_sum": default["grad_sum_def"],
+                            "weight_sum": default["weight_sum_def"]}
+            agg, new_strategy_state = strategy.combine_parts(
+                part_sums, deferred, strategy_state,
+                jax.random.fold_in(rng, 17),
+                num_clients=collected["client_count"], global_params=params)
             # server optimizer over the aggregate pseudo-gradient
             # (reference ModelUpdater.update_model, core/trainer.py:127-137)
             if self.server_max_grad_norm is not None:
@@ -183,26 +197,141 @@ class RoundEngine:
             opt_state.hyperparams["learning_rate"] = server_lr
             updates, new_opt_state = self.server_tx.update(agg, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            default_part = part_sums.get("default") or \
+                next(iter(part_sums.values()))
             round_stats = {
                 "train_loss_sum": collected["train_loss_sum"],
                 "num_samples_sum": collected["num_samples_sum"],
                 "client_count": collected["client_count"],
-                "weight_sum": collected["weight_sum_now"],
-                "weight_sum_raw": collected["weight_sum_raw"],
+                "weight_sum": default_part["weight_sum"],
+                "weight_sum_raw": default_part["weight_sum_raw"],
                 "grad_mean": collected["stats_mean_sum"] / jnp.maximum(collected["client_count"], 1.0),
                 "grad_mag": collected["stats_mag_sum"] / jnp.maximum(collected["client_count"], 1.0),
                 "grad_var": collected["stats_var_sum"] / jnp.maximum(collected["client_count"], 1.0),
                 "grad_norm": collected["stats_norm_sum"] / jnp.maximum(collected["client_count"], 1.0),
                 "agg_grad_norm": optax.global_norm(agg),
             }
+            for k, v in privacy_per_client.items():
+                round_stats[k] = v
             return new_params, new_opt_state, new_strategy_state, round_stats
 
+        self._round_step_core = round_step
         return jax.jit(round_step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def _multi_round_fn(self, num_rounds: int) -> Callable:
+        """Jitted ``lax.scan`` over ``num_rounds`` federated rounds.
+
+        TPU-first perf feature with no reference equivalent: FLUTE pays a
+        full server<->worker protocol exchange per round
+        (``core/federated.py:281-424``); even our single-round program pays
+        one host dispatch per round, which dominates when the controller is
+        far from the chips.  Scanning R rounds inside one program amortizes
+        dispatch/transfer to once per R rounds; client sampling stays
+        host-side (it is data-independent lookahead), eval boundaries cap R.
+        """
+        cached = self._multi_cache.get(num_rounds)
+        if cached is not None:
+            return cached
+        core = self._round_step_core
+
+        def multi(params, opt_state, strategy_state, arrays, sample_mask,
+                  client_mask, client_ids, client_lrs, server_lrs,
+                  round_idxs, leakage_threshold, rngs):
+            def body(carry, xs):
+                p, o, s = carry
+                arr, sm, cm, cid, clr, slr, ridx, rng = xs
+                p, o, s, stats = core(p, o, s, arr, sm, cm, cid, clr, slr,
+                                      ridx, leakage_threshold, rng)
+                return (p, o, s), stats
+
+            (p, o, s), stats = jax.lax.scan(
+                body, (params, opt_state, strategy_state),
+                (arrays, sample_mask, client_mask, client_ids,
+                 client_lrs, server_lrs, round_idxs, rngs))
+            return p, o, s, stats
+
+        fn = jax.jit(multi, donate_argnums=(0, 1, 2))
+        self._multi_cache[num_rounds] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # RL support: a round variant that also returns per-client payloads so
+    # the meta-aggregator can re-weight them (reference keeps
+    # client_parameters_stack for this, core/strategies/dga.py:317-330).
+    def _build_payload_step(self):
+        strategy = self.strategy
+        client_update = self.client_update
+        mesh = self.mesh
+        cspec = P(CLIENTS_AXIS)
+        rspec = P()
+
+        def shard_body(params, arrays, sample_mask, client_mask, client_ids,
+                       client_lr, rng):
+            def per_client(arr_c, mask_c, cm_c, cid_c):
+                rng_c = jax.random.fold_in(rng, cid_c)
+                parts, tl, ns, stats = strategy.client_step(
+                    client_update, params, arr_c, mask_c, client_lr, rng_c)
+                pg, w = parts["default"]
+                return pg, w * cm_c, stats
+            return jax.vmap(per_client)(arrays, sample_mask, client_mask,
+                                        client_ids)
+
+        fn = shard_map(shard_body, mesh=mesh,
+                       in_specs=(rspec, cspec, cspec, cspec, cspec, rspec,
+                                 rspec),
+                       out_specs=cspec, check_vma=False)
+        return jax.jit(fn)
+
+    def client_payloads(self, state: ServerState, batch: RoundBatch,
+                        client_lr: float, rng: jax.Array):
+        """Per-client (pseudo_grad [K,...], weight [K], stats [K]) for RL."""
+        if not hasattr(self, "_payload_step"):
+            self._payload_step = self._build_payload_step()
+        arrays = {k: jax.device_put(v, self._client_sharding)
+                  for k, v in batch.arrays.items()}
+        return self._payload_step(
+            state.params, arrays,
+            jax.device_put(batch.sample_mask, self._client_sharding),
+            jax.device_put(batch.client_mask, self._client_sharding),
+            jax.device_put(batch.client_ids, self._client_sharding),
+            jnp.asarray(client_lr, jnp.float32), rng)
+
+    def apply_custom_weights(self, state: ServerState, pgs, weights,
+                             server_lr: float) -> ServerState:
+        """Aggregate per-client payloads with externally chosen weights and
+        take a server step — the RL re-aggregation
+        (``sum pg_k * w_k / sum w_k``, reference ``dga.py:317-332``)."""
+        if not hasattr(self, "_custom_agg"):
+            server_tx = self.server_tx
+
+            def agg_fn(params, opt_state, pgs, weights, server_lr):
+                wsum = jnp.maximum(jnp.sum(weights), 1e-12)
+                agg = jax.tree.map(
+                    lambda g: jnp.tensordot(weights, g, axes=[[0], [0]]) / wsum,
+                    pgs)
+                if self.server_max_grad_norm is not None:
+                    agg = _clip_by_global_norm(
+                        agg, float(self.server_max_grad_norm))
+                opt_state.hyperparams["learning_rate"] = server_lr
+                updates, new_opt = server_tx.update(agg, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            self._custom_agg = jax.jit(agg_fn)
+        params, opt_state = self._custom_agg(
+            state.params, state.opt_state, pgs,
+            jax.device_put(jnp.asarray(weights, jnp.float32),
+                           self._client_sharding),
+            jnp.asarray(server_lr, jnp.float32))
+        return ServerState(params, opt_state, state.strategy_state,
+                           state.round + 1)
 
     # ------------------------------------------------------------------
     def run_round(self, state: ServerState, batch: RoundBatch,
                   client_lr: float, server_lr: float,
-                  rng: jax.Array) -> Tuple[ServerState, Dict[str, float]]:
+                  rng: jax.Array,
+                  leakage_threshold: Optional[float] = None
+                  ) -> Tuple[ServerState, Dict[str, float]]:
         """Stage one round's data onto the mesh and execute the program."""
         arrays = {k: jax.device_put(v, self._client_sharding)
                   for k, v in batch.arrays.items()}
@@ -214,7 +343,52 @@ class RoundEngine:
             state.params, state.opt_state, state.strategy_state,
             arrays, sample_mask, client_mask, client_ids,
             jnp.asarray(client_lr, jnp.float32),
-            jnp.asarray(server_lr, jnp.float32), rng)
+            jnp.asarray(server_lr, jnp.float32),
+            jnp.asarray(state.round, jnp.int32),
+            jnp.asarray(leakage_threshold if leakage_threshold is not None
+                        else jnp.inf, jnp.float32), rng)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
         return new_state, stats
+
+    # ------------------------------------------------------------------
+    def run_rounds(self, state: ServerState, batches: list,
+                   client_lrs: list, server_lrs: list,
+                   rng: jax.Array,
+                   leakage_threshold: Optional[float] = None
+                   ) -> Tuple[ServerState, Dict[str, np.ndarray]]:
+        """Run ``len(batches)`` rounds in ONE device program (scan).
+
+        Returns per-round stats stacked on a leading axis.
+        """
+        R = len(batches)
+        if R == 1:
+            new_state, stats = self.run_round(
+                state, batches[0], client_lrs[0], server_lrs[0], rng,
+                leakage_threshold=leakage_threshold)
+            return new_state, {k: np.asarray([v]) for k, v in
+                               jax.device_get(stats).items()}
+        stacked_sharding = NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
+        arrays = {k: jax.device_put(
+            np.stack([b.arrays[k] for b in batches]), stacked_sharding)
+            for k in batches[0].arrays}
+        sample_mask = jax.device_put(
+            np.stack([b.sample_mask for b in batches]), stacked_sharding)
+        client_mask = jax.device_put(
+            np.stack([b.client_mask for b in batches]), stacked_sharding)
+        client_ids = jax.device_put(
+            np.stack([b.client_ids for b in batches]), stacked_sharding)
+        rngs = jax.random.split(rng, R)
+
+        fn = self._multi_round_fn(R)
+        params, opt_state, strategy_state, stats = fn(
+            state.params, state.opt_state, state.strategy_state,
+            arrays, sample_mask, client_mask, client_ids,
+            jnp.asarray(client_lrs, jnp.float32),
+            jnp.asarray(server_lrs, jnp.float32),
+            jnp.arange(state.round, state.round + R, dtype=jnp.int32),
+            jnp.asarray(leakage_threshold if leakage_threshold is not None
+                        else jnp.inf, jnp.float32), rngs)
+        new_state = ServerState(params, opt_state, strategy_state,
+                                state.round + R)
+        return new_state, jax.device_get(stats)

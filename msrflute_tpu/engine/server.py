@@ -25,6 +25,7 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import FLUTEConfig, parse_clients_per_round
@@ -48,6 +49,7 @@ class OptimizationServer:
                  train_dataset: BaseDataset,
                  val_dataset: Optional[BaseDataset] = None,
                  test_dataset: Optional[BaseDataset] = None,
+                 server_train_dataset: Optional[BaseDataset] = None,
                  model_dir: str = "./models", mesh=None,
                  seed: int = 0):
         self.task = task
@@ -81,6 +83,29 @@ class OptimizationServer:
         self.fall_back_to_best = bool(sc.get("fall_back_to_best_model", False))
         self.best_val: Dict[str, Metric] = {}
 
+        # RL meta-aggregation (reference server_config.wantRL + extensions/RL)
+        self.rl = None
+        if sc.get("wantRL", False):
+            from ..rl import RLAggregator
+            from ..config import RLConfig
+            rl_cfg = sc.RL if sc.RL is not None else RLConfig.from_dict({})
+            ncpi = sc.get("num_clients_per_iteration", 10)
+            if not isinstance(ncpi, int):
+                raise ValueError("wantRL requires a fixed "
+                                 "num_clients_per_iteration")
+            self.rl = RLAggregator(rl_cfg, ncpi, model_dir, seed=seed)
+            self._rl_losses = None
+
+        # privacy-attack metric bookkeeping (reference core/server.py:319-325)
+        pm = config.privacy_metrics_config
+        self.max_allowed_leakage: Optional[float] = None
+        self.adaptive_leakage: Optional[float] = None
+        if pm is not None and pm.get("apply_metrics", False):
+            self.max_allowed_leakage = pm.get("max_allowed_leakage")
+            adaptive = pm.get("adaptive_leakage_threshold")
+            if adaptive:
+                self.adaptive_leakage = float(adaptive)
+
         # static round-program geometry
         cc = config.client_config
         self.batch_size = int(cc.data_config.train.get("batch_size", 32))
@@ -89,6 +114,18 @@ class OptimizationServer:
         max_client_samples = int(max(train_dataset.num_samples))
         self.max_steps = steps_for(max_client_samples, self.batch_size,
                                    self.desired_max_samples)
+
+        # server replay training (reference core/server.py:429-442): after
+        # aggregation, train on server-held data for a few iterations
+        self.server_replay = None
+        if sc.server_replay_config is not None and \
+                server_train_dataset is not None:
+            self.server_replay = {
+                "dataset": server_train_dataset,
+                "iterations": int(sc.server_replay_config.get(
+                    "server_iterations", 1)),
+                "opt_cfg": sc.server_replay_config.optimizer_config,
+            }
 
         self._eval_fn = build_eval_fn(task, self.mesh)
         self._np_rng = np.random.default_rng(seed)
@@ -130,62 +167,246 @@ class OptimizationServer:
         if self.state.round == 0 and sc.get("initial_rec", False):
             self._maybe_eval("test", self.state.round, force=True)
 
-        ndev = self.mesh.shape[CLIENTS_AXIS]
-        for round_no in range(self.state.round, max_iteration):
+        # TPU-native knob (no reference equivalent): how many rounds to fuse
+        # into one scanned device program.  1 == FLUTE-style per-round
+        # dispatch; larger values amortize host<->device latency.  Chunks
+        # never cross an eval boundary, so plateau/LR/fallback semantics are
+        # unchanged.
+        rounds_per_step = max(int(sc.get("rounds_per_step", 1) or 1), 1)
+
+        if self.rl is not None:
+            rounds_per_step = 1  # RL needs val feedback every round
+        if self.server_replay is not None and rounds_per_step > 1:
+            # reference runs replay after EVERY round (core/server.py:429);
+            # fusing rounds would cut the replay cadence
+            print_rank("server replay forces rounds_per_step=1")
+            rounds_per_step = 1
+
+        round_no = self.state.round
+        while round_no < max_iteration:
             tic = time.time()
+            until_val = (val_freq - (round_no % val_freq)
+                         if self.val_dataset is not None else max_iteration)
+            until_rec = (rec_freq - (round_no % rec_freq)
+                         if self.test_dataset is not None else max_iteration)
+            R = min(rounds_per_step, max_iteration - round_no,
+                    until_val, until_rec)
+
+            if self.rl is not None:
+                self._run_rl_round(round_no)
+                round_no += 1
+                self.run_stats["secsPerRound"].append(time.time() - tic)
+                self._round_housekeeping(round_no, val_freq, rec_freq)
+                continue
+
             client_lr = self.initial_lr_client * self.lr_weight
-            server_lr = (self.plateau.lr if self.plateau is not None
-                         else self.server_lr_schedule(round_no))
-
-            sampled = self._sample()
-            batch = pack_round_batches(
-                self.train_dataset, sampled, self.batch_size, self.max_steps,
-                rng=self._np_rng, pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
+            server_lrs = [(self.plateau.lr if self.plateau is not None
+                           else self.server_lr_schedule(r))
+                          for r in range(round_no, round_no + R)]
+            # sample the whole chunk first so every round pads to a common
+            # client count (ranged num_clients_per_iteration draws differ)
+            chunk_samples = [self._sample() for _ in range(R)]
+            pad_to = pad_to_mesh(max(len(s) for s in chunk_samples), self.mesh)
+            batches = [pack_round_batches(
+                self.train_dataset, sampled, self.batch_size,
+                self.max_steps, rng=self._np_rng, pad_clients_to=pad_to,
                 desired_max_samples=self.desired_max_samples)
+                for sampled in chunk_samples]
 
-            self._rng, round_rng = jax.random.split(self._rng)
-            self.state, stats = self.engine.run_round(
-                self.state, batch, client_lr, server_lr, round_rng)
+            self._rng, chunk_rng = jax.random.split(self._rng)
+            self.state, stats = self.engine.run_rounds(
+                self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
+                leakage_threshold=self.max_allowed_leakage)
 
             toc = time.time()
-            self.run_stats["secsPerRound"].append(toc - tic)
+            self.run_stats["secsPerRound"].append((toc - tic) / R)
 
-            # round logging (reference core/server.py:362-395 + AzureML)
-            stats = {k: float(v) for k, v in jax.device_get(stats).items()}
-            n_clients = max(stats["client_count"], 1.0)
-            log_metric("Training loss",
-                       stats["train_loss_sum"] / n_clients, step=round_no)
-            log_metric("LR for agg. opt.", server_lr, step=round_no)
-            log_metric("Client learning rate", client_lr, step=round_no)
-            log_metric("Agg. grad norm", stats["agg_grad_norm"], step=round_no)
-
-            housekeeping_tic = time.time()
-            improved = False
-            if (round_no + 1) % val_freq == 0:
-                improved = self._maybe_eval("val", round_no + 1)
-                # client-LR decay on val plateau (core/server.py:464-469)
-                if not improved and self.lr_decay_factor != 1.0:
-                    self.lr_weight *= float(self.lr_decay_factor)
-                    print_rank(f"decayed client lr weight to {self.lr_weight}")
-                if self.plateau is not None and "loss" in self._last_val:
-                    self.plateau.step(self._last_val["loss"].value)
-                if self.fall_back_to_best and not improved:
-                    self._fall_back()
-            if (round_no + 1) % rec_freq == 0 and self.test_dataset is not None:
-                self._maybe_eval("test", round_no + 1)
-
-            self.ckpt.save_latest(self.state)
-            self.ckpt.backup(self.state, round_no + 1,
-                             best_names=tuple(self.best_val))
-            self.ckpt.update_status({
-                "i": round_no + 1,
-                "weight": self.lr_weight,
-                **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
-            })
-            self.run_stats["secsPerRoundHousekeeping"].append(
-                time.time() - housekeeping_tic)
+            # per-round logging (reference core/server.py:362-395 + AzureML)
+            for j in range(R):
+                r = round_no + j
+                n_clients = max(float(stats["client_count"][j]), 1.0)
+                log_metric("Training loss",
+                           float(stats["train_loss_sum"][j]) / n_clients, step=r)
+                log_metric("LR for agg. opt.", server_lrs[j], step=r)
+                log_metric("Client learning rate", client_lr, step=r)
+                log_metric("Agg. grad norm",
+                           float(stats["agg_grad_norm"][j]), step=r)
+            self._process_privacy_stats(
+                stats, round_no,
+                client_mask=np.stack([b.client_mask for b in batches]))
+            round_no += R
+            if self.server_replay is not None:
+                self._run_server_replay()
+            self._round_housekeeping(round_no, val_freq, rec_freq)
         self._log_timing()
         return self.state
+
+    # ------------------------------------------------------------------
+    def _run_server_replay(self) -> None:
+        """Replay training on server-held data after aggregation
+        (reference ``core/server.py:429-442``)."""
+        if not hasattr(self, "_replay_fn"):
+            from ..data.dataset import ArraysDataset
+            from .client_update import ClientHParams, build_client_update
+            replay = self.server_replay
+            hp = ClientHParams(num_epochs=replay["iterations"])
+            self._replay_update = build_client_update(
+                self.task, replay["opt_cfg"], hp)
+            merged = ArraysDataset.concat_users(replay["dataset"])
+            n = len(next(iter(merged.values())))
+            bs = int(self.config.server_config.data_config.train.get(
+                "batch_size", self.batch_size))
+            one = ArraysDataset(["server"], [merged])
+            batch = pack_round_batches(one, [0], bs, steps_for(n, bs),
+                                       rng=self._np_rng)
+            self._replay_batch = (
+                {k: v[0] for k, v in batch.arrays.items()},
+                batch.sample_mask[0])
+            lr = float(replay["opt_cfg"].get("lr", 0.01))
+
+            def fn(params, arrays, mask, rng):
+                pg, tl, ns, _ = self._replay_update(
+                    params, arrays, mask, jnp.asarray(lr, jnp.float32), rng)
+                return jax.tree.map(lambda w, g: w - g, params, pg), tl
+            self._replay_fn = jax.jit(fn)
+        self._rng, rng = jax.random.split(self._rng)
+        arrays, mask = self._replay_batch
+        new_params, tl = self._replay_fn(self.state.params, arrays, mask, rng)
+        self.state = ServerState(new_params, self.state.opt_state,
+                                 self.state.strategy_state, self.state.round)
+        print_rank(f"server replay loss {float(tl):.4f}")
+
+    def _round_housekeeping(self, round_no: int, val_freq: int,
+                            rec_freq: int) -> None:
+        """Eval cadence, LR plateau decay, fallback, checkpoint, status log
+        (reference ``core/server.py:448-490``)."""
+        housekeeping_tic = time.time()
+        improved = False
+        if round_no % val_freq == 0:
+            improved = self._maybe_eval("val", round_no)
+            # client-LR decay on val plateau (core/server.py:464-469)
+            if not improved and self.lr_decay_factor != 1.0:
+                self.lr_weight *= float(self.lr_decay_factor)
+                print_rank(f"decayed client lr weight to {self.lr_weight}")
+            if self.plateau is not None and "loss" in self._last_val:
+                self.plateau.step(self._last_val["loss"].value)
+            if self.fall_back_to_best and not improved:
+                self._fall_back()
+        if round_no % rec_freq == 0 and self.test_dataset is not None:
+            self._maybe_eval("test", round_no)
+
+        self.ckpt.save_latest(self.state)
+        self.ckpt.backup(self.state, round_no, best_names=tuple(self.best_val))
+        self.ckpt.update_status({
+            "i": round_no,
+            "weight": self.lr_weight,
+            **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
+        })
+        self.run_stats["secsPerRoundHousekeeping"].append(
+            time.time() - housekeeping_tic)
+
+    # ------------------------------------------------------------------
+    def _val_acc(self) -> float:
+        """Validation accuracy (falls back to -loss) for RL rewards."""
+        batches = pack_eval_batches(
+            self.val_dataset,
+            int(self.config.server_config.data_config.val.get("batch_size",
+                                                              self.batch_size)),
+            pad_steps_to_multiple_of=self.mesh.shape[CLIENTS_AXIS])
+        metrics = evaluate(self.task, self._eval_fn, self.state.params,
+                           batches, self.mesh)
+        if "acc" in metrics:
+            return float(metrics["acc"].value)
+        return -float(metrics["loss"].value)
+
+    def _run_rl_round(self, round_no: int) -> None:
+        """One RL-assisted round (reference ``core/strategies/dga.py:286-406``):
+        collect per-client payloads once, aggregate with both the strategy
+        weights and the RL-estimated weights, keep whichever validates
+        better, reward the policy, train the DQN."""
+        client_lr = self.initial_lr_client * self.lr_weight
+        server_lr = (self.plateau.lr if self.plateau is not None
+                     else self.server_lr_schedule(round_no))
+        sampled = self._sample()
+        batch = pack_round_batches(
+            self.train_dataset, sampled, self.batch_size, self.max_steps,
+            rng=self._np_rng, pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
+            desired_max_samples=self.desired_max_samples)
+        self._rng, rng = jax.random.split(self._rng)
+
+        pgs, ws, stats = self.engine.client_payloads(self.state, batch,
+                                                     client_lr, rng)
+        ws_np = np.asarray(jax.device_get(ws))
+        k = len(sampled)
+        state_vec = np.concatenate([
+            ws_np[:k],
+            np.asarray(jax.device_get(stats["mag"]))[:k],
+            np.asarray(jax.device_get(stats["mean"]))[:k],
+            np.asarray(jax.device_get(stats["var_corrected"]))[:k]])
+
+        # candidate A: strategy weights; candidate B: RL weights
+        baseline_state = self.engine.apply_custom_weights(
+            self.state, pgs, ws, server_lr)
+        action = self.rl.forward(state_vec)
+        rl_w = self.rl.weights_from_action(action)
+        rl_w_full = np.zeros_like(ws_np)
+        rl_w_full[:k] = rl_w[:k] if len(rl_w) >= k else \
+            np.pad(rl_w, (0, k - len(rl_w)))
+        rl_state = self.engine.apply_custom_weights(
+            self.state, pgs, rl_w_full, server_lr)
+
+        self.state = baseline_state
+        baseline_acc = self._val_acc()
+        self.state = rl_state
+        rl_acc = self._val_acc()
+
+        reward, keep_rl = self.rl.compute_reward(
+            baseline_acc, rl_acc,
+            bool(self.config.lookup("server_config.RL.marginal_update_RL",
+                                    True)))
+        self.state = rl_state if keep_rl else baseline_state
+        log_metric("RL Rewards", reward, step=round_no)
+        log_metric("Val acc (baseline vs RL)",
+                   {"baseline": baseline_acc, "rl": rl_acc}, step=round_no)
+        self.rl.train(state_vec, action, reward)
+        self.rl.save()
+        log_metric("RL Running Loss", self.rl.running_loss, step=round_no)
+
+    # ------------------------------------------------------------------
+    def _process_privacy_stats(self, stats, round_no: int,
+                               client_mask=None) -> None:
+        """Log attack metrics + adapt the leakage threshold (reference
+        ``core/server.py:390-409``: the new threshold is the configured
+        quantile of this chunk's per-client leakage values).  ``client_mask``
+        [R, K] excludes mesh-padding lanes from the distribution."""
+        if "privacy_dropped" not in stats:
+            return
+        real = (np.asarray(client_mask).ravel() > 0 if client_mask is not None
+                else None)
+
+        def _select(key):
+            vals = np.asarray(stats[key]).ravel()
+            if real is not None and real.shape == vals.shape:
+                vals = vals[real]
+            return vals[np.isfinite(vals)]
+
+        log_metric("Dropped clients", float(_select("privacy_dropped").sum()),
+                   step=round_no)
+        for key, name in (("privacy_overlap", "Extracted indices percentage"),
+                          ("privacy_leakage", "Practical epsilon (Max leakage)"),
+                          ("privacy_above_rank", "Words percentage above rank")):
+            if key in stats:
+                finite = _select(key)
+                if finite.size:
+                    log_metric(name, float(finite.max()), step=round_no)
+        if self.adaptive_leakage is not None and "privacy_leakage" in stats:
+            values = np.sort(_select("privacy_leakage"))
+            if values.size:
+                idx = min(int(self.adaptive_leakage * values.size),
+                          values.size - 1)
+                self.max_allowed_leakage = float(values[idx])
+                print_rank(f"updated leakage threshold to "
+                           f"{self.max_allowed_leakage}")
 
     # ------------------------------------------------------------------
     _last_val: MetricsDict = {}

@@ -1,0 +1,86 @@
+"""ECG heartbeat classifier: CNN + LSTM + attention.
+
+Parity target: reference ``experiments/ecg_cnn/model.py`` (polomarco's
+Kaggle CNN-LSTM-attention architecture adapted to FLUTE): two ConvNormPool
+stacks (1D conv k=5, norm, swish, causal pads, conv1+conv3 skip, maxpool-2),
+an LSTM over the pooled feature map with the channel axis as time, an
+attention mix ``tanh(W [h;c]) @ outputs``, adaptive max-pool and a dense
+head.
+
+Divergences (deliberate, documented):
+- GroupNorm instead of BatchNorm (the reference exposes
+  ``norm_type='group'`` as an option; GN has no cross-client running stats,
+  which is both more correct for FL and vmap-safe).
+- The reference applies ``F.softmax`` *before* ``F.cross_entropy``
+  (``model.py:151-158``) — a double-softmax; we feed logits to the loss.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .cv import ClassificationTask
+
+
+def _swish(x):
+    return x * nn.sigmoid(x)
+
+
+class _ConvNormPool(nn.Module):
+    hidden: int
+    kernel: int = 5
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, L, C]
+        pad = self.kernel - 1
+        conv1 = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(x)
+        y = nn.GroupNorm(num_groups=8)(conv1)
+        y = _swish(y)
+        y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
+        y = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(y)
+        y = nn.GroupNorm(num_groups=8)(y)
+        y = _swish(y)
+        y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
+        conv3 = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(y)
+        y = nn.GroupNorm(num_groups=8)(conv1[:, :conv3.shape[1]] + conv3)
+        y = _swish(y)
+        y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
+        # maxpool k=2 stride 2
+        return nn.max_pool(y, (2,), strides=(2,))
+
+
+class _ECGNet(nn.Module):
+    hidden: int = 64
+    num_classes: int = 5
+    kernel: int = 5
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, L] or [B, L, 1]
+        if x.ndim == 2:
+            x = x[..., None]
+        x = x.astype(jnp.float32)
+        x = _ConvNormPool(self.hidden, self.kernel)(x)
+        x = _ConvNormPool(self.hidden, self.kernel)(x)
+        # reference treats channels as LSTM time axis (model.py:139-146):
+        # [B, L', H] -> transpose -> steps over H features of length L'
+        x = jnp.swapaxes(x, 1, 2)  # [B, H, L']
+        outs = nn.RNN(nn.OptimizedLSTMCell(self.hidden),
+                      return_carry=True)(x)
+        (c_fin, h_fin), outputs = outs
+        hc = jnp.concatenate([h_fin[:, None, :], c_fin[:, None, :]], axis=1)
+        attn = jnp.tanh(nn.Dense(self.hidden, use_bias=False)(hc))  # [B,2,H]
+        mixed = attn @ outputs  # [B,2,H] @ [B,T,H] with T==H -> [B,2,H]
+        # reference: transpose then AdaptiveMaxPool1d(1) == max over the two
+        # attention rows (model.py:146-150)
+        feat = jnp.max(mixed, axis=1)  # [B, H]
+        return nn.Dense(self.num_classes)(feat)
+
+
+def make_ecg_task(model_config) -> ClassificationTask:
+    num_classes = int(model_config.get("num_classes", 5))
+    seq_len = int(model_config.get("num_frames", 187))
+    module = _ECGNet(hidden=int(model_config.get("hidden_dim", 64)),
+                     num_classes=num_classes)
+    return ClassificationTask(module, example_shape=(seq_len,),
+                              name="ecg_cnn", num_classes=num_classes)

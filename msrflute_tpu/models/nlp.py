@@ -1,0 +1,183 @@
+"""NLP tasks: Shakespeare char LSTM and the Reddit GRU word LM.
+
+Parity targets:
+
+- ``RNN`` (reference ``experiments/nlp_rnn_fedshakespeare/model.py:12-40``):
+  embedding(90 -> 8, pad id 0) -> 2-layer LSTM(256) -> per-position dense to
+  vocab; cross-entropy with ``ignore_index=0``; accuracy over non-pad
+  positions.
+- ``GRU`` (reference ``experiments/nlg_gru/model.py:11-133``): custom GRU
+  cell (convex-combination update ``hy = n + i*(h - n)``), tied
+  embedding/unembedding through a ``squeeze`` projection, negative ids mark
+  padding, and OOV-rejecting accuracy: a prediction of the unk id (0) counts
+  as wrong even when the target is 0 (``model.py:118-121``).
+
+TPU-native: recurrences are ``nn.RNN``/``lax.scan`` (single compiled cell
+per layer), embeddings gathered on-device, losses masked — no ragged
+batches, no ``pack_padded_sequence``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.metrics import Metric
+from .base import BaseTask, Batch, softmax_xent
+
+
+class _ShakespeareLSTM(nn.Module):
+    vocab_size: int = 90
+    embed_dim: int = 8
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, L] int32
+        emb = nn.Embed(self.vocab_size, self.embed_dim)(x)
+        h = emb
+        for _ in range(2):
+            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        return nn.Dense(self.vocab_size)(h)  # [B, L, V]
+
+
+class _ConvexGRUCell(nn.Module):
+    """The reference's GRU2 cell (``nlg_gru/model.py:11-28``):
+    ``hy = new + input_gate * (hidden - new)``."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h = carry
+        gi = nn.Dense(3 * self.hidden, use_bias=True, name="w_ih")(x)
+        gh = nn.Dense(3 * self.hidden, use_bias=True, name="w_hh")(h)
+        i_r, i_i, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_i, h_n = jnp.split(gh, 3, axis=-1)
+        reset = jax.nn.sigmoid(i_r + h_r)
+        inp = jax.nn.sigmoid(i_i + h_i)
+        new = jnp.tanh(i_n + reset * h_n)
+        hy = new + inp * (h - new)
+        return hy, hy
+
+    @staticmethod
+    def init_carry(batch, hidden):
+        return jnp.zeros((batch, hidden))
+
+
+class _GRUWordLM(nn.Module):
+    """Tied-embedding GRU LM (``nlg_gru/model.py:39-83``)."""
+
+    vocab_size: int = 10000
+    embed_dim: int = 160
+    hidden_dim: int = 512
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, L] int32 (already clamped non-negative)
+        table = self.param(
+            "embedding",
+            lambda key, shape: jax.random.uniform(
+                key, shape, minval=-(3 / shape[1]) ** 0.5,
+                maxval=(3 / shape[1]) ** 0.5),
+            (self.vocab_size, self.embed_dim))
+        unembed_bias = self.param("unembedding_bias", nn.initializers.zeros,
+                                  (self.vocab_size,))
+        emb = jnp.take(table, x, axis=0)  # [B, L, E]
+
+        carry = _ConvexGRUCell.init_carry(x.shape[0], self.hidden_dim)
+        _, hiddens = nn.scan(
+            _ConvexGRUCell, variable_broadcast="params",
+            split_rngs={"params": False}, in_axes=1, out_axes=1,
+        )(hidden=self.hidden_dim)(carry, emb)
+        squeezed = nn.Dense(self.embed_dim, use_bias=False, name="squeeze")(hiddens)
+        logits = squeezed @ table.T + unembed_bias
+        return logits  # [B, L, V]
+
+
+class SequenceLMTask(BaseTask):
+    """Shared masked seq-to-seq LM task.
+
+    ``batch['x']``: ``[B, L]`` int ids, 0 = padding.  If ``batch['y']`` is
+    present it is the per-position target (fed_shakespeare ships explicit
+    targets); otherwise targets are ``x`` shifted left by one.
+    Per-sequence ``sample_mask`` gates whole padded sequences; position mask
+    is ``target != 0`` (the reference's ``ignore_index=0`` / ``>= 0``
+    masking).
+    """
+
+    def __init__(self, module: nn.Module, seq_len: int, name: str,
+                 oov_reject: bool = False):
+        self.module = module
+        self.seq_len = seq_len
+        self.name = name
+        self.oov_reject = oov_reject
+
+    def init_params(self, rng: jax.Array):
+        dummy = jnp.zeros((1, self.seq_len - 1), jnp.int32)
+        return self.module.init(rng, dummy)["params"]
+
+    def _logits_targets(self, params, batch: Batch):
+        x = batch["x"].astype(jnp.int32)
+        if "y" in batch and batch["y"].ndim == x.ndim:
+            inputs, targets = x, batch["y"].astype(jnp.int32)
+        else:
+            inputs, targets = x[:, :-1], x[:, 1:]
+        logits = self.module.apply({"params": params}, inputs)
+        tok_mask = (targets != 0).astype(jnp.float32)
+        tok_mask = tok_mask * batch["sample_mask"][:, None]
+        return logits, targets, tok_mask
+
+    def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
+             train: bool = True):
+        logits, targets, tok_mask = self._logits_targets(params, batch)
+        per_tok = softmax_xent(logits, targets)
+        total = jnp.sum(per_tok * tok_mask)
+        count = jnp.maximum(jnp.sum(tok_mask), 1.0)
+        aux = {"sample_count": jnp.sum(batch["sample_mask"])}
+        return total / count, aux
+
+    def token_logprobs(self, params, batch: Batch):
+        """Per-token log-prob of the target under the model + validity mask
+        (the ``compute_perplexity`` hook for the leakage attack, reference
+        ``extensions/privacy/metrics.py:25-30``)."""
+        logits, targets, tok_mask = self._logits_targets(params, batch)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return picked, tok_mask
+
+    def eval_stats(self, params, batch: Batch) -> Dict[str, jnp.ndarray]:
+        logits, targets, tok_mask = self._logits_targets(params, batch)
+        per_tok = softmax_xent(logits, targets)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = (pred == targets).astype(jnp.float32)
+        if self.oov_reject:
+            # predictions of the unk id count as wrong (nlg_gru model.py:118-121)
+            correct = correct * (pred != 0)
+        return {
+            "loss_sum": jnp.sum(per_tok * tok_mask),
+            "correct_sum": jnp.sum(correct * tok_mask),
+            "sample_count": jnp.sum(tok_mask),
+            "seq_count": jnp.sum(batch["sample_mask"]),
+        }
+
+
+def make_shakespeare_lstm_task(model_config) -> SequenceLMTask:
+    vocab = int(model_config.get("vocab_size", 90))
+    module = _ShakespeareLSTM(
+        vocab_size=vocab,
+        embed_dim=int(model_config.get("embed_dim", 8)),
+        hidden=int(model_config.get("hidden_dim", 256)))
+    return SequenceLMTask(module, seq_len=int(model_config.get("seq_len", 80)),
+                          name="nlp_rnn_fedshakespeare")
+
+
+def make_gru_lm_task(model_config) -> SequenceLMTask:
+    module = _GRUWordLM(
+        vocab_size=int(model_config.get("vocab_size", 10000)),
+        embed_dim=int(model_config.get("embed_dim", 160)),
+        hidden_dim=int(model_config.get("hidden_dim", 512)))
+    return SequenceLMTask(module,
+                          seq_len=int(model_config.get("max_num_words", 25)),
+                          name="nlg_gru", oov_reject=True)

@@ -1,0 +1,105 @@
+"""Privacy attack metrics — run on-device, per client, inside the round.
+
+Parity target: reference ``extensions/privacy/metrics.py``:
+
+- ``extract_indices_from_embeddings`` (``metrics.py:10-22``): the embedding
+  rows of tokens present in a batch get larger gradient norms; sort rows by
+  pseudo-gradient norm, take the top-``num_tokens``, and measure the overlap
+  with the batch's true (non-pad) tokens.
+- ``practical_epsilon_leakage`` (``metrics.py:33-76``): per-token
+  log-softmax scores of the round's data under the *pre-training* model vs
+  the model after an attacker optimizer step (Adamax, high LR) applied to
+  the client's pseudo-gradient; leakage = max over tokens of
+  ``clamp((pre+tol)/(post+tol), 0, max_ratio)`` — optionally weighted by
+  ``max(exp(pre), exp(post))`` — and the returned value is
+  ``max(log(max_leakage), 0)``.
+
+The reference runs these in client Python between training and payload
+shipping (``core/client.py:466-508``); here they are traced into the round
+program (vmapped per client), and client dropping is a weight mask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optim import make_optimizer
+
+
+def extract_indices_from_embeddings(pseudo_grad_embedding: jnp.ndarray,
+                                    token_batch: jnp.ndarray,
+                                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Embedding-gradient token-extraction attack.
+
+    Args:
+        pseudo_grad_embedding: ``[vocab, embed]`` pseudo-gradient of the
+            embedding table.
+        token_batch: integer token ids of the client's round data (any
+            shape); ids <= 0 are padding.
+
+    Returns:
+        (overlap_ratio, per_vocab_extracted_mask) — overlap of the top-k
+        extracted rows with the true tokens (k = total token count, as in
+        the reference), and a ``[vocab]`` 0/1 mask of extracted rows for
+        downstream word-rank stats.
+    """
+    flat = token_batch.reshape(-1)
+    valid = flat > 0
+    tot_tokens = flat.shape[0]  # reference uses total (incl. pad) as k
+    norms = jnp.linalg.norm(pseudo_grad_embedding, axis=-1)
+    vocab = norms.shape[0]
+    k = min(tot_tokens, vocab)
+    _, top_idx = jax.lax.top_k(norms, k)
+    extracted_mask = jnp.zeros((vocab,), jnp.float32).at[top_idx].set(1.0)
+    hit = extracted_mask[jnp.clip(flat, 0, vocab - 1)] * valid
+    overlap = jnp.sum(hit) / jnp.maximum(jnp.sum(valid), 1.0)
+    return overlap, extracted_mask
+
+
+def practical_epsilon_leakage(original_params: Any, pseudo_grad: Any,
+                              token_logprobs_fn, arrays: Dict[str, jnp.ndarray],
+                              sample_mask: jnp.ndarray,
+                              is_weighted: bool = True,
+                              max_ratio: float = 1e9,
+                              attacker_optimizer_config=None) -> jnp.ndarray:
+    """Perplexity-ratio leakage of one client's update (traced).
+
+    ``token_logprobs_fn(params, batch) -> (logp [.., L], mask [.., L])``
+    scores the client's own batches.  The attacker step applies the
+    configured optimizer (default Adamax lr 0.03, ``metrics.py:54-56``) to
+    ``original_params`` using the pseudo-gradient.
+    """
+    if attacker_optimizer_config is None:
+        from ..config import OptimizerConfig
+        attacker_optimizer_config = OptimizerConfig(type="adamax", lr=0.03)
+    tx = make_optimizer(attacker_optimizer_config)
+    opt_state = tx.init(original_params)
+    updates, _ = tx.update(pseudo_grad, opt_state, original_params)
+    import optax
+    attacked_params = optax.apply_updates(original_params, updates)
+
+    tol = 1.0 / max_ratio
+
+    def score(params):
+        total_lp = []
+        total_mask = []
+        S = sample_mask.shape[0]
+        for s in range(S):  # static unroll over the packed step grid
+            batch = {k: v[s] for k, v in arrays.items()}
+            batch["sample_mask"] = sample_mask[s]
+            lp, mask = token_logprobs_fn(params, batch)
+            total_lp.append(lp.reshape(-1))
+            total_mask.append(mask.reshape(-1))
+        return jnp.concatenate(total_lp), jnp.concatenate(total_mask)
+
+    pre, mask = score(original_params)
+    post, _ = score(attacked_params)
+    leakage = jnp.clip((pre + tol) / (post + tol), 0.0, max_ratio)
+    if is_weighted:
+        leakage = jnp.maximum(jnp.exp(pre), jnp.exp(post)) * leakage
+    leakage = jnp.where(mask > 0, leakage, -jnp.inf)
+    max_leakage = jnp.max(leakage)
+    return jnp.maximum(jnp.log(jnp.maximum(max_leakage, 1e-30)), 0.0)

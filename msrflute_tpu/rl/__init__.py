@@ -1,0 +1,1 @@
+from .rl import RLAggregator  # noqa: F401

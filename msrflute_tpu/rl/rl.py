@@ -1,0 +1,227 @@
+"""RL meta-aggregator — DQN-style re-weighting of client updates.
+
+Parity target: reference ``extensions/RL/RL.py`` + the DGA hooks
+(``core/strategies/dga.py:286-406``):
+
+- state = concat(client weights, grad magnitudes, grad means, grad vars)
+  (``dga.py:305``), length ``4 * clients_per_round``;
+- action = MLP (optionally LSTM over a window of recent states) output,
+  epsilon-greedy with annealed epsilon (``RL.py:183-201``);
+- aggregation weights = ``exp(action)`` with NaN/Inf -> 0
+  (``dga.py:306-315``);
+- reward by comparing val accuracy of the RL-aggregated model vs the
+  standard aggregation: +1 if better (keep RL model), 0.1 if within 1e-3
+  (keep if ``marginal_update_RL``), -1 otherwise (``dga.py:366-390``);
+- DQN update: replay memory, ``q = sum(model(state) * action)``, MSE to the
+  reward, epsilon annealing (``RL.py:204-262``), checkpoint + stats file
+  (``RL.py:314-340``).
+
+TPU-native: the network is flax, its train step is one jitted function;
+replay memory and epsilon schedule stay host-side (tiny, data-dependent).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization
+
+from ..config import RLConfig
+from ..optim import make_optimizer
+from ..utils.logging import print_rank
+
+
+class _QNet(nn.Module):
+    """MLP head (reference ``NeuralNetwork``, ``RL.py:79-144``); with
+    ``want_lstm`` a bidirectional LSTM encodes the state window first."""
+
+    sizes: Sequence[int]
+    want_lstm: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        if self.want_lstm:
+            # x: [T, F] window of recent states
+            fwd = nn.RNN(nn.OptimizedLSTMCell(self.sizes[0]))(x[None])[0]
+            bwd = nn.RNN(nn.OptimizedLSTMCell(self.sizes[0]), reverse=True)(
+                x[None])[0]
+            x = (fwd + bwd)[-1]
+        for h in self.sizes[:-1]:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.sizes[-1])(x)
+
+
+class RLAggregator:
+    """Host-driven RL weight estimator with jitted forward/train."""
+
+    def __init__(self, rl_config: RLConfig, num_clients_per_iteration: int,
+                 model_dir: str, seed: int = 0):
+        self.cfg = rl_config
+        self.out_size = int(num_clients_per_iteration)
+        self.want_lstm = bool(rl_config.get("wantLSTM", False))
+        self.epsilon = float(rl_config.get("initial_epsilon", 0.5))
+        self.final_epsilon = float(rl_config.get("final_epsilon", 1e-4))
+        self.epsilon_gamma = float(rl_config.get("epsilon_gamma", 0.9))
+        self.minibatch = int(rl_config.get("minibatch_size", 16))
+        self.max_memory = int(rl_config.get("max_replay_memory_size", 1000))
+        self.replay: List[Tuple[np.ndarray, np.ndarray, float]] = []
+        self.state_window: List[np.ndarray] = []
+        self.running_loss = 0.0
+        self.step = 0
+        self.rl_weights: Optional[np.ndarray] = None
+        self.rl_losses = None
+        self._pyrng = random.Random(seed)
+
+        in_dim = 4 * self.out_size
+        params_spec = rl_config.get("network_params") or [in_dim, 128, 128,
+                                                          self.out_size]
+        if isinstance(params_spec, str):
+            params_spec = [int(x) for x in params_spec.split(",")]
+        self.net = _QNet(sizes=tuple(int(x) for x in params_spec[1:]),
+                         want_lstm=self.want_lstm)
+        rng = jax.random.PRNGKey(seed)
+        dummy = (jnp.zeros((self.minibatch, in_dim)) if self.want_lstm
+                 else jnp.zeros((in_dim,)))
+        self.params = self.net.init(rng, dummy)["params"]
+        self.tx = make_optimizer(rl_config.optimizer_config)
+        self.opt_state = self.tx.init(self.params)
+
+        descriptor = rl_config.get("model_descriptor_RL", "Default")
+        base = rl_config.get("RL_path") or model_dir
+        os.makedirs(base, exist_ok=True)
+        self.model_name = os.path.join(
+            base, f"rl_{self.out_size}.{descriptor}.model")
+        self.stats_name = os.path.join(
+            base, f"rl_{self.out_size}.{descriptor}.stats")
+        self._forward = jax.jit(
+            lambda p, s: self.net.apply({"params": p}, s))
+        self._train_step = jax.jit(self._make_train_step())
+        self.load_saved_status()
+
+    # ------------------------------------------------------------------
+    def forward(self, state: np.ndarray) -> np.ndarray:
+        """Epsilon-greedy action (reference ``RL.py:183-201``)."""
+        state = np.asarray(state, np.float32).reshape(-1)
+        if self.want_lstm:
+            self.state_window.append(state)
+            self.state_window = self.state_window[-self.minibatch:]
+            window = np.zeros((self.minibatch, state.shape[0]), np.float32)
+            if self.state_window:
+                window[-len(self.state_window):] = np.stack(self.state_window)
+            state_in = window
+        else:
+            state_in = state
+        if self._pyrng.random() <= self.epsilon:
+            print_rank("RL: performed random action")
+            action = np.random.default_rng(
+                self._pyrng.randrange(2**31)).random(self.out_size)
+        else:
+            action = np.asarray(self._forward(self.params,
+                                              jnp.asarray(state_in)))
+            if action.ndim > 1:
+                action = action[-1]
+        return action.astype(np.float32)
+
+    def weights_from_action(self, action: np.ndarray) -> np.ndarray:
+        w = np.exp(action.astype(np.float64))
+        w[~np.isfinite(w)] = 0.0
+        return w.astype(np.float32)
+
+    # ------------------------------------------------------------------
+    def _make_train_step(self):
+        net = self.net
+        tx = self.tx
+
+        def train_step(params, opt_state, states, actions, rewards):
+            def loss_fn(p):
+                out = net.apply({"params": p}, states)
+                if out.ndim > 2:  # lstm branch returns per-window
+                    out = out[:, -1]
+                q = jnp.sum(out * actions, axis=-1)
+                return jnp.mean((q - rewards) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return train_step
+
+    def train(self, state: np.ndarray, action: np.ndarray,
+              reward: float) -> float:
+        """One replay-buffer DQN step (reference ``RL.py:204-262``)."""
+        self.replay.append((np.asarray(state, np.float32).reshape(-1),
+                            np.asarray(action, np.float32), float(reward)))
+        if len(self.replay) > self.max_memory:
+            self.replay.pop(0)
+        if self.epsilon * self.epsilon_gamma > self.final_epsilon:
+            self.epsilon *= self.epsilon_gamma
+        if self.want_lstm:
+            batch = self.replay[-self.minibatch:]
+        else:
+            batch = self._pyrng.sample(
+                self.replay, min(len(self.replay), self.minibatch))
+        states = np.stack([b[0] for b in batch])
+        if self.want_lstm:
+            pad = np.zeros((self.minibatch - len(batch), states.shape[1]),
+                           np.float32)
+            states = np.concatenate([pad, states])[None]  # [1, T, F] window
+        actions = np.stack([b[1] for b in batch])
+        rewards = np.asarray([b[2] for b in batch], np.float32)
+        if self.want_lstm:
+            actions = actions[-1:][None] if actions.ndim == 2 else actions
+            rewards = rewards[-1:]
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, jnp.asarray(states),
+            jnp.asarray(actions), jnp.asarray(rewards))
+        loss = float(loss)
+        self.running_loss = loss if self.running_loss == 0 else \
+            0.95 * self.running_loss + 0.05 * loss
+        self.step += 1
+        return loss
+
+    # ------------------------------------------------------------------
+    def compute_reward(self, baseline_acc: float, rl_acc: float,
+                       marginal_update: bool) -> Tuple[float, bool]:
+        """Reward + keep-RL-model decision (reference ``dga.py:366-390``)."""
+        if abs(baseline_acc - rl_acc) < 0.001:
+            return 0.1, bool(marginal_update)
+        if rl_acc > baseline_acc:
+            return 1.0, True
+        return -1.0, False
+
+    # ------------------------------------------------------------------
+    def save(self) -> None:
+        blob = serialization.msgpack_serialize(serialization.to_state_dict({
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }))
+        with open(self.model_name, "wb") as fh:
+            fh.write(blob)
+        with open(self.stats_name, "w") as fh:
+            json.dump({"step": self.step, "epsilon": self.epsilon,
+                       "running_loss": self.running_loss}, fh)
+
+    def load_saved_status(self) -> None:
+        if os.path.exists(self.model_name):
+            with open(self.model_name, "rb") as fh:
+                raw = serialization.msgpack_restore(fh.read())
+            target = serialization.to_state_dict({
+                "params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)})
+            merged = serialization.from_state_dict(target, raw)
+            self.params = merged["params"]
+            self.opt_state = merged["opt_state"]
+            print_rank(f"RL: restored model from {self.model_name}")
+        if os.path.exists(self.stats_name):
+            with open(self.stats_name) as fh:
+                stats = json.load(fh)
+            self.step = int(stats.get("step", 0))
+            self.epsilon = float(stats.get("epsilon", self.epsilon))
+            self.running_loss = float(stats.get("running_loss", 0.0))

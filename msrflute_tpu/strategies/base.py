@@ -22,12 +22,23 @@ host-side hooks invoked at round boundaries.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 MAX_WEIGHT = 100.0  # reference core/strategies/utils.py:11-19
+
+
+def _find_embedding_leaf(tree: Any):
+    """Locate the ``[vocab, embed]`` embedding-table leaf by path name."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path).lower()
+        if "embed" in name and getattr(leaf, "ndim", 0) == 2:
+            return leaf
+    return None
 
 
 def filter_weight(weight: jnp.ndarray) -> jnp.ndarray:
@@ -51,7 +62,75 @@ class BaseStrategy:
         self.config = config
         self.dp_config = dp_config
 
+    #: set by RoundEngine so strategies can reach model apply()/loss()
+    task: Any = None
+
     # ---- traced, per-client (inside vmap) ----------------------------
+    def client_step(self, client_update, global_params, arrays, sample_mask,
+                    client_lr, rng, round_idx=None, leakage_threshold=None):
+        """Run one client's local work and emit weighted payload parts.
+
+        Returns ``(parts, train_loss, num_samples, stats)`` where ``parts``
+        maps part name -> ``(pytree, weight scalar)``.  The engine computes a
+        weighted psum per part.  The default single-part flow reproduces the
+        reference's ``generate_client_payload`` pipeline, including the
+        privacy-attack metrics + client dropping of
+        ``core/client.py:466-508`` when ``privacy_metrics_config`` is on.
+        """
+        pg, tl, ns, stats = client_update(global_params, arrays, sample_mask,
+                                          client_lr, rng)
+        w = self.client_weight(num_samples=ns, train_loss=tl, stats=stats,
+                               rng=jax.random.fold_in(rng, 1))
+        w = self._apply_privacy_metrics(
+            pg, w, stats, global_params, arrays, sample_mask,
+            leakage_threshold)
+        pg, w = self.transform_payload(pg, w, jax.random.fold_in(rng, 2))
+        return {"default": (pg, w)}, tl, ns, stats
+
+    def _apply_privacy_metrics(self, pg, weight, stats, global_params,
+                               arrays, sample_mask, leakage_threshold):
+        """Attack metrics + ``wt=0`` client dropping
+        (reference ``core/client.py:466-508``).  Metrics land in ``stats``
+        under ``privacy_*`` keys, which the engine surfaces per client."""
+        pm = getattr(self.config, "privacy_metrics_config", None)
+        if pm is None or not pm.get("apply_metrics", False):
+            return weight
+        from .. import privacy
+        from ..privacy import attacks
+
+        dropped = jnp.zeros(())
+        if pm.get("apply_indices_extraction", False) and "x" in arrays:
+            embed_leaf = _find_embedding_leaf(pg)
+            if embed_leaf is not None:
+                overlap, extracted = attacks.extract_indices_from_embeddings(
+                    embed_leaf, arrays["x"].astype(jnp.int32))
+                stats["privacy_overlap"] = overlap
+                rank = int(pm.get("allowed_word_rank", 9000))
+                above = extracted[rank:] if rank < extracted.shape[0] else \
+                    jnp.zeros((1,))
+                stats["privacy_above_rank"] = jnp.sum(above) / jnp.maximum(
+                    jnp.sum(extracted), 1.0)
+                max_overlap = pm.get("max_allowed_overlap")
+                if max_overlap is not None:
+                    dropped = jnp.maximum(
+                        dropped, (overlap > float(max_overlap)).astype(jnp.float32))
+
+        if pm.get("apply_leakage_metric", False) and \
+                getattr(self.task, "token_logprobs", None) is not None:
+            leakage = attacks.practical_epsilon_leakage(
+                global_params, pg, self.task.token_logprobs, arrays,
+                sample_mask,
+                is_weighted=bool(pm.get("is_leakage_weighted", False)),
+                max_ratio=math.exp(float(pm.get("max_leakage", 30.0))),
+                attacker_optimizer_config=pm.attacker_optimizer_config)
+            stats["privacy_leakage"] = leakage
+            if leakage_threshold is not None:
+                dropped = jnp.maximum(
+                    dropped, (leakage > leakage_threshold).astype(jnp.float32))
+
+        stats["privacy_dropped"] = dropped
+        return weight * (1.0 - dropped)
+
     def client_weight(self, *, num_samples: jnp.ndarray,
                       train_loss: jnp.ndarray,
                       stats: Dict[str, jnp.ndarray],
@@ -80,3 +159,17 @@ class BaseStrategy:
         denom = jnp.maximum(weight_sum, 1e-12)
         agg = jax.tree.map(lambda g: g / denom, weighted_grad_sum)
         return agg, state
+
+    def combine_parts(self, part_sums: Dict[str, Dict[str, Any]],
+                      deferred: Optional[Dict[str, Any]], state: Any,
+                      rng: jax.Array, num_clients: jnp.ndarray,
+                      global_params: Any = None) -> Tuple[Any, Any]:
+        """Multi-part entry point; single-part strategies fall through to
+        :meth:`combine`."""
+        if set(part_sums) == {"default"}:
+            return self.combine(part_sums["default"]["grad_sum"],
+                                part_sums["default"]["weight_sum"],
+                                deferred, state, rng, num_clients)
+        raise NotImplementedError(
+            f"{type(self).__name__} must override combine_parts for parts "
+            f"{sorted(part_sums)}")

@@ -1,0 +1,177 @@
+"""FedLabels — semi-supervised federated learning.
+
+Parity target: reference ``core/strategies/fedlabels.py`` +
+``Trainer.run_train_epoch_sup`` (``core/trainer.py:503-619``) +
+``get_label_VAT`` (``utils/utils.py:620-678``):
+
+- each client trains a **supervised** model on its labeled data, and (after
+  ``burnout_round``) an **unsupervised** model starting from the round's
+  initial weights on pseudo-labeled unlabeled data;
+- pseudo-labels (VAT selection, ``comp='var'``): compare per-sample logit
+  variance of the *initial* ("local") model vs the *sup-trained* ("server")
+  model at temperature ``temp``; the higher-variance side labels the sample
+  iff its max prob exceeds ``thre``; the confidence weight is the variance
+  ratio of the losing side;
+- unsup loss = ``unsup_lamb * CE(net(aug or clean), est_labels)``
+  ``+ vat_consis *`` variance-weighted KL(net || sup-trained) over samples
+  where both sides agree ``+ l2_lambda * MSE(net, initial)``;
+- payload = full sup weights + full unsup weights
+  (``fedlabels.py:82-92``); the server averages sup **uniformly** and unsup
+  **sample-weighted**, then loads ``(sup + unsup)/2``
+  (``fedlabels.py:190-216``).
+
+TPU-native: dynamic label selection becomes masks (no ragged index lists);
+both local trainings are ``lax.scan``s inside the vmapped client step.  The
+server "load_state_dict" is expressed as pseudo-gradient
+``w0 - (sup_avg/2 + unsup_avg/2)``, which with the canonical SGD(lr=1.0)
+server optimizer reproduces the reference's direct load exactly — and
+unlike the reference also composes with server momentum/adam if configured.
+
+Client batch contract: labeled arrays ``x``/``y`` plus unlabeled ``ux``
+(clean) and optionally ``ux_rand`` (augmented view, used when ``uda: 1``),
+all packed on the same ``[S, B]`` grid (the featurizer pads/subsamples the
+unlabeled pool to the labeled grid).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.base import softmax_xent
+from .base import BaseStrategy, filter_weight
+
+
+class FedLabels(BaseStrategy):
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        ss = (config.client_config.get("semisupervision")
+              or config.server_config.get("semisupervision")
+              or config.extra.get("semisupervision") or {})
+        self.eta = float(ss.get("eta", 0.01))
+        self.burnout_round = int(ss.get("burnout_round", 0))
+        self.temp = float(ss.get("temp", 1.0))
+        self.thre = float(ss.get("thre", 0.6))
+        self.vat_consis = float(ss.get("vat_consis", 1.0))
+        self.l2_lambda = float(ss.get("l2_lambda", 0.0))
+        self.unsup_lamb = float(ss.get("unsup_lamb", 1.0))
+        self.uda = int(ss.get("uda", 0))
+        self.unsuptrain_ep = int(ss.get("unsuptrain_ep", 1))
+
+    # ------------------------------------------------------------------
+    def client_step(self, client_update, global_params, arrays, sample_mask,
+                    client_lr, rng, round_idx=None, leakage_threshold=None):
+        # 1) supervised pass: the standard local-SGD client update on x/y
+        labeled = {k: v for k, v in arrays.items()
+                   if k not in ("ux", "ux_rand", "uy")}
+        pg_sup, tl, ns, stats = client_update(
+            global_params, labeled, sample_mask, client_lr, rng)
+        sup_params = jax.tree.map(lambda w, g: w - g, global_params, pg_sup)
+
+        # 2) unsupervised pass (gated by burnout_round)
+        if "ux" in arrays:
+            unsup_params = self._unsup_train(
+                global_params, sup_params, arrays, sample_mask,
+                jax.random.fold_in(rng, 11))
+            if round_idx is not None:
+                active = (round_idx >= self.burnout_round)
+                unsup_params = jax.tree.map(
+                    lambda u, g: jnp.where(active, u, g),
+                    unsup_params, global_params)
+        else:
+            unsup_params = global_params
+
+        # weight = num samples (fedlabels.py:84: 1 if zero)
+        w = filter_weight(jnp.maximum(ns, 1.0))
+        parts = {
+            "sup": (sup_params, jnp.ones(())),   # uniform ratio 1/N
+            "unsup": (unsup_params, w),          # sample-weighted ratio
+        }
+        return parts, tl, ns, stats
+
+    # ------------------------------------------------------------------
+    def _unsup_train(self, initial_params, sup_params, arrays, sample_mask,
+                     rng):
+        """VAT pseudo-label training of ``net`` (starts at initial params)."""
+        task = self.task
+        temp, thre = self.temp, self.thre
+        ux = arrays["ux"]
+        ux_in = arrays.get("ux_rand", ux) if self.uda == 1 else ux
+        tx = optax.sgd(self.eta)
+
+        def step(carry, xs):
+            net, opt_state = carry
+            u_clean, u_in, mask = xs
+            local_logits = jax.nn.softmax(
+                task.apply(initial_params, u_clean) / temp, axis=-1)
+            server_logits = jax.nn.softmax(
+                task.apply(sup_params, u_clean) / temp, axis=-1)
+            lvar = jnp.var(local_logits, axis=-1)
+            svar = jnp.var(server_logits, axis=-1)
+            use_local = lvar >= svar
+            chosen = jnp.where(use_local[:, None], local_logits, server_logits)
+            conf_ok = jnp.max(chosen, axis=-1) > thre
+            est_mask = conf_ok.astype(jnp.float32) * mask
+            est_labels = jnp.argmax(chosen, axis=-1)
+            # confidence weight: losing side's variance / winning side's
+            est_var = jnp.where(use_local, svar / jnp.maximum(lvar, 1e-12),
+                                lvar / jnp.maximum(svar, 1e-12))
+            agree = (jnp.argmax(local_logits, axis=-1) ==
+                     jnp.argmax(server_logits, axis=-1)).astype(jnp.float32)
+            agree_mask = agree * est_mask
+
+            def loss_fn(net_params):
+                out = task.apply(net_params, u_in)
+                out_clean = task.apply(net_params, u_clean)
+                ce = softmax_xent(out, est_labels)
+                unsup_loss = jnp.sum(ce * est_mask) / jnp.maximum(
+                    jnp.sum(est_mask), 1.0)
+                # pointwise KL(server || net) at temperature, log-target form
+                log_p_net = jax.nn.log_softmax(out_clean / temp, axis=-1)
+                log_p_srv = jnp.log(jnp.maximum(server_logits, 1e-12))
+                kl_point = jnp.sum(
+                    jnp.exp(log_p_srv) * (log_p_srv - log_p_net), axis=-1)
+                consist = jnp.sum(kl_point * est_var * agree_mask) / \
+                    jnp.maximum(jnp.sum(agree_mask), 1.0)
+                sq = jax.tree.map(lambda a, b: jnp.mean((a - b) ** 2),
+                                  net_params, initial_params)
+                reg = sum(jax.tree.leaves(sq))
+                return (self.unsup_lamb * unsup_loss +
+                        self.vat_consis * consist + self.l2_lambda * reg)
+
+            grads = jax.grad(loss_fn)(net)
+            has_data = (jnp.sum(est_mask) > 0).astype(jnp.float32)
+            updates, new_opt = tx.update(grads, opt_state, net)
+            new_net = optax.apply_updates(net, updates)
+            net = jax.tree.map(lambda n, o: jnp.where(has_data > 0, n, o),
+                               new_net, net)
+            opt_state = jax.tree.map(
+                lambda n, o: jnp.where(has_data > 0, n, o), new_opt, opt_state)
+            return (net, opt_state), None
+
+        net = initial_params
+        carry = (net, tx.init(net))
+        for _ in range(max(self.unsuptrain_ep, 1)):
+            carry, _ = jax.lax.scan(step, carry, (ux, ux_in, sample_mask))
+        return carry[0]
+
+    # ------------------------------------------------------------------
+    def combine_parts(self, part_sums, deferred, state, rng, num_clients,
+                      global_params=None):
+        sup = part_sums["sup"]
+        unsup = part_sums["unsup"]
+        sup_avg = jax.tree.map(
+            lambda g: g / jnp.maximum(sup["weight_sum"], 1e-12),
+            sup["grad_sum"])
+        unsup_avg = jax.tree.map(
+            lambda g: g / jnp.maximum(unsup["weight_sum"], 1e-12),
+            unsup["grad_sum"])
+        target = jax.tree.map(lambda a, b: a / 2 + b / 2, sup_avg, unsup_avg)
+        # express "load (sup+unsup)/2" as a pseudo-gradient for the server
+        # optimizer (exact with sgd lr=1.0)
+        agg = jax.tree.map(lambda w0, t: w0 - t, global_params, target)
+        return agg, state

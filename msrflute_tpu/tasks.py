@@ -67,3 +67,13 @@ def build_task_datasets(cfg: FLUTEConfig, task: BaseTask) -> Tuple[
     val = _load(cfg.server_config.data_config.val, "val_data", "val")
     test = _load(cfg.server_config.data_config.test, "test_data", "test")
     return train, val, test
+
+
+def build_server_train_dataset(cfg: FLUTEConfig, task: BaseTask):
+    """Server-replay dataset from ``train_data_server``
+    (reference ``utils/dataloaders_utils.py:57-84`` server-side loader)."""
+    path = cfg.server_config.data_config.train.get("train_data_server")
+    if not path:
+        return None
+    return make_dataset_for(task, load_user_blob(path), cfg.model_config,
+                            "train")

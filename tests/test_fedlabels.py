@@ -1,0 +1,86 @@
+import jax
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+
+
+def _semisup_dataset(num_users=8, n=12, dim=8, classes=4, seed=0):
+    """Labeled x/y + unlabeled ux (+augmented view ux_rand) per user."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(dim, classes))
+    users, per_user = [], []
+    for u in range(num_users):
+        x = rng.normal(size=(n, dim)).astype(np.float32)
+        y = np.argmax(x @ w, axis=1).astype(np.int32)
+        ux = rng.normal(size=(n, dim)).astype(np.float32)
+        per_user.append({"x": x, "y": y, "ux": ux,
+                         "ux_rand": ux + 0.05 * rng.normal(size=(n, dim)).astype(np.float32)})
+        users.append(f"u{u}")
+    return ArraysDataset(users, per_user)
+
+
+def _cfg(burnout=1):
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4, "input_dim": 8},
+        "strategy": "fedlabels",
+        "server_config": {
+            "max_iteration": 3, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.2,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 100, "initial_val": False,
+            "data_config": {"val": {"batch_size": 8}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}},
+            "semisupervision": {
+                "eta": 0.05, "burnout_round": burnout, "temp": 0.5,
+                "thre": 0.3, "vat_consis": 0.5, "l2_lambda": 0.01,
+                "unsup_lamb": 1.0, "uda": 1, "unsuptrain_ep": 1,
+            },
+        },
+    })
+
+
+def test_fedlabels_end_to_end(mesh8, tmp_path):
+    ds = _semisup_dataset()
+    cfg = _cfg()
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    state = server.train()
+    assert state.round == 3
+    # model changed from init
+    init = jax.device_get(server.engine.init_state(jax.random.PRNGKey(0)).params)
+    final = jax.device_get(state.params)
+    diff = max(np.abs(a - b).max() for a, b in
+               zip(jax.tree.leaves(init), jax.tree.leaves(final)))
+    assert diff > 0
+
+
+def test_fedlabels_burnout_is_half_sup_average(mesh8):
+    """Before burnout, unsup side == w0, so new params = w0/2 + sup_avg/2."""
+    from msrflute_tpu.data import pack_round_batches
+    from msrflute_tpu.engine.round import RoundEngine
+    from msrflute_tpu.strategies import select_strategy
+    ds = _semisup_dataset()
+    cfg = _cfg(burnout=1000)  # never activates unsup training
+    task = make_task(cfg.model_config)
+    strat = select_strategy("fedlabels")(cfg, None)
+    engine = RoundEngine(task, cfg, strat, mesh8)
+    state = engine.init_state(jax.random.PRNGKey(0))
+    w0 = jax.device_get(state.params)
+    batch = pack_round_batches(ds, [0, 1, 2, 3], 4, 3,
+                               rng=np.random.default_rng(0), pad_clients_to=8)
+    new_state, _ = engine.run_round(state, batch, 0.2, 1.0,
+                                    jax.random.PRNGKey(1))
+    new = jax.device_get(new_state.params)
+    # new = w0 - (w0 - (sup_avg + w0)/2) => (new - w0/2)*2 = sup_avg, and
+    # crucially new != w0 (sup side trained) while staying halfway to w0
+    moved = max(np.abs(a - b).max() for a, b in
+                zip(jax.tree.leaves(new), jax.tree.leaves(w0)))
+    assert moved > 0

@@ -1,0 +1,116 @@
+"""Model zoo unit tests: init/loss/eval_stats contracts for every task.
+
+The reference has no unit tests at all (SURVEY.md §4); these pin the task
+contract (masked loss, sum-form eval stats) for each model family.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import ModelConfig
+from msrflute_tpu.models import make_task
+
+
+def _check_task(task, batch, expect_acc_key=True):
+    rng = jax.random.PRNGKey(0)
+    params = task.init_params(rng)
+    loss, aux = jax.jit(lambda p, b: task.loss(p, b, rng, True))(params, batch)
+    assert np.isfinite(float(loss))
+    sums = jax.jit(task.eval_stats)(params, batch)
+    assert float(sums["sample_count"]) > 0
+    metrics = task.finalize_metrics(jax.device_get(sums))
+    assert "loss" in metrics
+    if expect_acc_key:
+        assert "acc" in metrics and 0.0 <= metrics["acc"].value <= 1.0
+    # masking: zero-mask batch contributes nothing
+    zero_batch = dict(batch)
+    zero_batch["sample_mask"] = jnp.zeros_like(batch["sample_mask"])
+    sums0 = jax.jit(task.eval_stats)(params, zero_batch)
+    assert float(sums0["sample_count"]) == 0.0
+    assert float(sums0["loss_sum"]) == 0.0
+    return params
+
+
+def _img_batch(b, h, w, c, classes, key=0):
+    rng = np.random.default_rng(key)
+    return {
+        "x": jnp.asarray(rng.normal(size=(b, h, w, c)), jnp.float32),
+        "y": jnp.asarray(rng.integers(0, classes, b), jnp.int32),
+        "sample_mask": jnp.ones((b,), jnp.float32),
+    }
+
+
+def test_lr_task():
+    task = make_task(ModelConfig(model_type="LR", extra={"num_classes": 4,
+                                                         "input_dim": 12}))
+    batch = {
+        "x": jnp.ones((6, 12), jnp.float32),
+        "y": jnp.zeros((6,), jnp.int32),
+        "sample_mask": jnp.ones((6,), jnp.float32),
+    }
+    _check_task(task, batch)
+
+
+def test_cnn_femnist_task():
+    task = make_task(ModelConfig(model_type="CNN"))
+    _check_task(task, _img_batch(4, 28, 28, 1, 62))
+
+
+def test_cifar_cnn_f1_task():
+    task = make_task(ModelConfig(model_type="CIFAR_CNN"))
+    params = _check_task(task, _img_batch(4, 32, 32, 3, 10))
+    sums = jax.device_get(jax.jit(task.eval_stats)(
+        params, _img_batch(8, 32, 32, 3, 10)))
+    metrics = task.finalize_metrics(sums)
+    assert "f1_score" in metrics
+
+
+def test_resnet_gn_task():
+    task = make_task(ModelConfig(model_type="RESNET",
+                                 extra={"num_classes": 100}))
+    batch = _img_batch(2, 32, 32, 3, 100)
+    _check_task(task, batch)
+    # GroupNorm everywhere, no BatchNorm state: init returns params only
+    params = task.init_params(jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    assert 10_000_000 < n_params < 12_500_000  # ResNet-18 ~11.2M
+
+
+def test_shakespeare_lstm_task():
+    task = make_task(ModelConfig(model_type="RNN",
+                                 extra={"vocab_size": 90, "seq_len": 20}))
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 90, size=(4, 20))
+    x[:, 15:] = 0  # padding tail
+    batch = {"x": jnp.asarray(x, jnp.int32),
+             "sample_mask": jnp.ones((4,), jnp.float32)}
+    _check_task(task, batch)
+
+
+def test_gru_lm_task_oov_reject():
+    task = make_task(ModelConfig(model_type="GRU",
+                                 extra={"vocab_size": 50, "embed_dim": 16,
+                                        "hidden_dim": 32, "max_num_words": 12}))
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 50, size=(3, 12))
+    batch = {"x": jnp.asarray(x, jnp.int32),
+             "sample_mask": jnp.ones((3,), jnp.float32)}
+    params = _check_task(task, batch)
+    # tied embeddings: the unembedding uses the same table
+    assert "embedding" in params and "unembedding_bias" in params
+
+
+def test_ecg_task():
+    task = make_task(ModelConfig(model_type="ECG_CNN"))
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.normal(size=(3, 187)), jnp.float32),
+             "y": jnp.asarray(rng.integers(0, 5, 3), jnp.int32),
+             "sample_mask": jnp.ones((3,), jnp.float32)}
+    _check_task(task, batch)
+
+
+def test_unknown_model_type():
+    with pytest.raises(KeyError, match="NOPE"):
+        make_task(ModelConfig(model_type="NOPE"))
