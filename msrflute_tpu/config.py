@@ -529,9 +529,12 @@ class FLUTEConfig(Config):
                 for split in (section.train, section.val, section.test):
                     for attr in ("list_of_train_data", "test_data", "val_data",
                                  "train_data", "train_data_server", "vocab_dict"):
-                        val = getattr(attr_obj := split, attr)
+                        val = getattr(split, attr)
                         if val and not os.path.isabs(val):
-                            setattr(attr_obj, attr, os.path.join(data_path, val))
+                            setattr(split, attr, os.path.join(data_path, val))
+            vocab = self.model_config.get("vocab_dict")
+            if vocab and not os.path.isabs(vocab):
+                self.model_config["vocab_dict"] = os.path.join(data_path, vocab)
         return self
 
 

@@ -1,0 +1,104 @@
+"""Featurization helpers: raw user-blob samples -> fixed-width arrays.
+
+Parity targets:
+- image reshaping done per-task in reference ``dataloaders/dataset.py``
+  files (MNIST flat vectors, FEMNIST 28x28, CIFAR HWC/CHW);
+- Shakespeare char encoding (FedML-style 90-symbol table, reference
+  ``experiments/nlp_rnn_fedshakespeare``);
+- LEAF Reddit word encoding with case backoff: try the word, then its
+  lowercase, else unk=0 (reference ``experiments/nlg_gru/dataloaders/
+  dataset.py:37-47``) with the vocab loader of
+  ``experiments/nlg_gru/utils/utility.py:19-33``;
+- truncation to ``max_num_words``/``max_seq_length``
+  (``dataset.py:75-77``, ``core/config.py:180``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# FedML/LEAF Shakespeare symbol table: pad=0, then letters; OOV maps to the
+# last id.  86 printable symbols -> vocab 90 with room for specials.
+SHAKESPEARE_LETTERS = (
+    "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "[]abcdefghijklmnopqrstuvwxyz}"
+)
+_CHAR_TO_ID = {c: i + 1 for i, c in enumerate(SHAKESPEARE_LETTERS)}
+
+
+def encode_chars(text: str, seq_len: int, oov_id: int = 87) -> np.ndarray:
+    """Unpadded char ids (pad to a matrix with :func:`pad_token_matrix`)."""
+    return np.asarray([_CHAR_TO_ID.get(c, oov_id) for c in text[:seq_len]],
+                      np.int64)
+
+
+def load_vocab(path: str) -> Dict[str, int]:
+    """Word vocab from a json dict / json list / newline list (reference
+    ``experiments/nlg_gru/utils/utility.py:19-33``)."""
+    with open(path) as fh:
+        if path.endswith(".json"):
+            raw = json.load(fh)
+            if isinstance(raw, dict):
+                if "vocab" in raw and isinstance(raw["vocab"], dict):
+                    raw = raw["vocab"]
+                return {str(w): int(i) for w, i in raw.items()}
+            return {str(w): i for i, w in enumerate(raw)}
+        return {line.strip(): i for i, line in enumerate(fh) if line.strip()}
+
+
+def encode_words(text_or_tokens, vocab: Dict[str, int], seq_len: int,
+                 unk_id: int = 0) -> np.ndarray:
+    """Case-backoff word encoding (reference ``dataset.py:37-47``)."""
+    tokens = (text_or_tokens.split() if isinstance(text_or_tokens, str)
+              else list(text_or_tokens))
+    ids = []
+    for tok in tokens[:seq_len]:
+        tok = str(tok)
+        if tok in vocab:
+            ids.append(vocab[tok])
+        elif tok.lower() in vocab:
+            ids.append(vocab[tok.lower()])
+        else:
+            ids.append(unk_id)  # unk is a REAL token (id 0), not padding
+    return np.asarray(ids, np.int64)
+
+
+def to_image(x: np.ndarray, example_shape: Sequence[int]) -> np.ndarray:
+    """Reshape flat/CHW samples to the task's HWC example shape."""
+    x = np.asarray(x, np.float32)
+    target = tuple(example_shape)
+    n = x.shape[0]
+    if x.shape[1:] == target:
+        return x
+    if x.ndim == 2 and int(np.prod(target)) == x.shape[1]:
+        return x.reshape((n,) + target)
+    # CHW -> HWC
+    if x.ndim == 4 and x.shape[1] in (1, 3) and \
+            (x.shape[2], x.shape[3], x.shape[1]) == target:
+        return np.transpose(x, (0, 2, 3, 1))
+    # HW -> HW1
+    if x.ndim == 3 and x.shape[1:] + (1,) == target:
+        return x[..., None]
+    raise ValueError(f"cannot reshape samples {x.shape} to {target}")
+
+
+def pad_token_matrix(seqs: List[np.ndarray], seq_len: int):
+    """Returns (ids [n, L] int32, tok_mask [n, L] float32).
+
+    The explicit mask keeps the reference's distinction between padding
+    (negative ids, ``nlg_gru/model.py:88-91``) and a *real* unk token id 0
+    — an unk target stays in the loss/accuracy denominator (and is always
+    counted wrong by the OOV-rejecting accuracy), while padding drops out.
+    """
+    out = np.zeros((len(seqs), seq_len), np.int32)
+    mask = np.zeros((len(seqs), seq_len), np.float32)
+    for i, s in enumerate(seqs):
+        s = np.asarray(s, np.int64).reshape(-1)[:seq_len]
+        real = s >= 0  # negative ids mark padding in the reference pipeline
+        out[i, :len(s)] = np.where(real, s, 0)
+        mask[i, :len(s)] = real.astype(np.float32)
+    return out, mask

@@ -103,16 +103,23 @@ def _load_hdf5(path: str) -> UserBlob:
         num_samples = [int(n) for n in fh["num_samples"][()]]
         user_data_grp = fh["user_data"]
         labels_grp = fh.get("user_data_label")
+        def _decode(value):
+            arr = np.asarray(value)
+            if arr.dtype.kind in ("O", "S"):  # vlen strings come back bytes
+                return [v.decode() if isinstance(v, bytes) else str(v)
+                        for v in arr]
+            return arr
+
         data: List[Any] = []
         labels: List[Any] = []
         for user in users:
             entry = user_data_grp[user]
             if isinstance(entry, h5py.Group):
-                data.append(np.asarray(entry["x"][()]))
+                data.append(_decode(entry["x"][()]))
                 if labels_grp is None and "y" in entry:
                     labels.append(np.asarray(entry["y"][()]))
             else:
-                data.append(np.asarray(entry[()]))
+                data.append(_decode(entry[()]))
             if labels_grp is not None:
                 labels.append(np.asarray(labels_grp[user][()]))
     return UserBlob(
@@ -128,14 +135,22 @@ def save_user_blob_hdf5(path: str, blob: UserBlob) -> None:
     ``utils/preprocessing/create-hdf5.py``."""
     import h5py
 
+    def _as_dataset_value(samples):
+        arr = np.asarray(samples)
+        if arr.dtype.kind in ("U", "O"):  # text samples -> vlen utf-8
+            return np.asarray([str(s) for s in samples],
+                              dtype=h5py.string_dtype("utf-8"))
+        return arr
+
     with h5py.File(path, "w") as fh:
         fh.create_dataset("users", data=np.array(blob.user_list, dtype="S"))
         fh.create_dataset("num_samples", data=np.asarray(blob.num_samples))
         grp = fh.create_group("user_data")
         for user, samples in zip(blob.user_list, blob.user_data):
             sub = grp.create_group(user)
-            sub.create_dataset("x", data=np.asarray(samples))
+            sub.create_dataset("x", data=_as_dataset_value(samples))
         if blob.user_labels is not None:
             lab = fh.create_group("user_data_label")
             for user, y in zip(blob.user_list, blob.user_labels):
-                lab.create_dataset(user, data=np.asarray(y))
+                if y is not None:
+                    lab.create_dataset(user, data=_as_dataset_value(y))

@@ -29,10 +29,13 @@ from ..parallel.mesh import CLIENTS_AXIS
 from ..utils.metrics import MetricsDict
 
 
-def build_eval_fn(task: BaseTask, mesh: Mesh) -> Callable:
+def build_eval_fn(task: BaseTask, mesh: Mesh,
+                  partition_mode: str = "shard_map") -> Callable:
     """Returns jitted ``eval_fn(params, batches) -> stat sums`` where
     ``batches`` is the dict from ``pack_eval_batches`` (leading axis T padded
-    to a multiple of the clients-axis size)."""
+    to a multiple of the clients-axis size).  ``partition_mode='gspmd'``
+    skips the explicit shard_map/psum so model-sharded params work (XLA
+    partitions the scan body itself)."""
     cspec = P(CLIENTS_AXIS)
     rspec = P()
 
@@ -48,17 +51,31 @@ def build_eval_fn(task: BaseTask, mesh: Mesh) -> Callable:
         first = {k: v[0] for k, v in batches.items()}
         zero = jax.tree.map(jnp.zeros_like, task.eval_stats(params, first))
         sums, _ = jax.lax.scan(body, zero, batches)
-        return jax.lax.psum(sums, CLIENTS_AXIS)
+        if partition_mode == "shard_map":
+            sums = jax.lax.psum(sums, CLIENTS_AXIS)
+        return sums
 
-    fn = shard_map(shard_body, mesh=mesh,
-                   in_specs=(rspec, cspec), out_specs=rspec, check_vma=False)
+    if partition_mode == "shard_map":
+        fn = shard_map(shard_body, mesh=mesh,
+                       in_specs=(rspec, cspec), out_specs=rspec,
+                       check_vma=False)
+    else:
+        fn = shard_body
     return jax.jit(fn)
 
 
 def evaluate(task: BaseTask, eval_fn: Callable, params: Any,
-             batches: Dict[str, np.ndarray], mesh: Mesh) -> MetricsDict:
-    """Run the jitted eval program and finalize metrics host-side."""
-    sharding = NamedSharding(mesh, P(CLIENTS_AXIS))
+             batches: Dict[str, np.ndarray], mesh: Mesh,
+             partition_mode: str = "shard_map") -> MetricsDict:
+    """Run the jitted eval program and finalize metrics host-side.
+
+    In shard_map mode the batch-step axis T is sharded over ``clients``
+    (data-parallel eval); in gspmd mode batches stay replicated and the
+    model axis shards the compute instead (a scan cannot iterate a sharded
+    leading axis without resharding every step).
+    """
+    spec = P(CLIENTS_AXIS) if partition_mode == "shard_map" else P()
+    sharding = NamedSharding(mesh, spec)
     staged = {k: jax.device_put(v, sharding) for k, v in batches.items()}
     sums = jax.device_get(eval_fn(params, staged))
     return task.finalize_metrics(sums)

@@ -47,12 +47,20 @@ from .server import OptimizationServer
 
 
 class PersonalizationStore:
-    """Host-side per-user (local_params, alpha) state."""
+    """Host-side per-user (local_params, alpha) state.
 
-    def __init__(self, init_alpha: float):
+    Persistence mirrors the reference's per-user files
+    (``<user>_model.tar`` / ``<user>_alpha``, ``core/client.py:408-443``):
+    one msgpack per user, written only when that user was updated — so a
+    round's save cost is O(sampled users), not O(all seen users).
+    """
+
+    def __init__(self, init_alpha: float, store_dir: Optional[str] = None):
         self.init_alpha = float(init_alpha)
+        self.store_dir = store_dir
         self.params: Dict[int, Any] = {}
         self.alpha: Dict[int, float] = {}
+        self._dirty: set = set()
 
     def get(self, user_idx: int, default_params) -> Tuple[Any, float]:
         return (self.params.get(user_idx, default_params),
@@ -61,26 +69,40 @@ class PersonalizationStore:
     def put(self, user_idx: int, params: Any, alpha: float) -> None:
         self.params[user_idx] = params
         self.alpha[user_idx] = float(alpha)
+        self._dirty.add(user_idx)
 
-    def save(self, path: str) -> None:
-        payload = {"alpha": {str(k): v for k, v in self.alpha.items()},
-                   "params": {str(k): jax.device_get(v)
-                              for k, v in self.params.items()}}
-        with open(path, "wb") as fh:
-            fh.write(serialization.msgpack_serialize(
-                serialization.to_state_dict(payload)))
+    def _user_path(self, uid: int) -> str:
+        return os.path.join(self.store_dir, f"user{uid}_model.msgpack")
 
-    def load(self, path: str, template) -> bool:
-        if not os.path.exists(path):
+    def save(self) -> None:
+        """Flush users updated since the last save."""
+        if self.store_dir is None:
+            return
+        os.makedirs(self.store_dir, exist_ok=True)
+        for uid in self._dirty:
+            blob = serialization.msgpack_serialize(serialization.to_state_dict(
+                {"alpha": self.alpha[uid],
+                 "params": jax.device_get(self.params[uid])}))
+            with open(self._user_path(uid), "wb") as fh:
+                fh.write(blob)
+        self._dirty.clear()
+
+    def load(self, template) -> bool:
+        if self.store_dir is None or not os.path.isdir(self.store_dir):
             return False
-        with open(path, "rb") as fh:
-            raw = serialization.msgpack_restore(fh.read())
-        self.alpha = {int(k): float(v) for k, v in raw.get("alpha", {}).items()}
         tmpl = serialization.to_state_dict(jax.device_get(template))
-        self.params = {
-            int(k): serialization.from_state_dict(tmpl, v)
-            for k, v in raw.get("params", {}).items()}
-        return True
+        found = False
+        for name in os.listdir(self.store_dir):
+            if not (name.startswith("user") and name.endswith("_model.msgpack")):
+                continue
+            uid = int(name[len("user"):-len("_model.msgpack")])
+            with open(os.path.join(self.store_dir, name), "rb") as fh:
+                raw = serialization.msgpack_restore(fh.read())
+            self.alpha[uid] = float(raw["alpha"])
+            self.params[uid] = serialization.from_state_dict(
+                tmpl, raw["params"])
+            found = True
+        return found
 
 
 class PersonalizationServer(OptimizationServer):
@@ -90,11 +112,11 @@ class PersonalizationServer(OptimizationServer):
         super().__init__(*args, **kwargs)
         cc = self.config.client_config
         self.alpha0 = float(cc.get("convex_model_interp", 0.75))
-        self.store = PersonalizationStore(self.alpha0)
         self._store_path = os.path.join(self.ckpt.model_dir,
-                                        "personalization.msgpack")
+                                        "personalization")
+        self.store = PersonalizationStore(self.alpha0, self._store_path)
         if self.config.server_config.get("resume_from_checkpoint", False):
-            if self.store.load(self._store_path, self.state.params):
+            if self.store.load(self.state.params):
                 print_rank(f"restored personalization state for "
                            f"{len(self.store.alpha)} users")
         self._personal_fn = None
@@ -108,10 +130,9 @@ class PersonalizationServer(OptimizationServer):
 
     def _round_housekeeping(self, round_no, val_freq, rec_freq):
         super()._round_housekeeping(round_no, val_freq, rec_freq)
-        # persist per-user state at the same cadence as the global model
-        # (reference writes <user>_model.tar / <user>_alpha per client,
-        # core/client.py:408-443)
-        self.store.save(self._store_path)
+        # persist ONLY the users updated this round (reference writes
+        # <user>_model.tar per processed client, core/client.py:408-443)
+        self.store.save()
 
     # -- jitted per-user local pass ------------------------------------
     def _build_personal_fn(self):
@@ -165,7 +186,7 @@ class PersonalizationServer(OptimizationServer):
     # -- hook into the round loop --------------------------------------
     def train(self):
         state = super().train()
-        self.store.save(self._store_path)
+        self.store.save()
         return state
 
     def _sample(self):

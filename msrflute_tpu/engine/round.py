@@ -85,9 +85,21 @@ class RoundEngine:
         self.server_tx = make_optimizer(sc.optimizer_config)
         self.server_max_grad_norm = sc.get("max_grad_norm")
         self.stale_prob = float(getattr(strategy, "stale_prob", 0.0) or 0.0)
+        if self.stale_prob > 0.0 and not strategy.supports_staleness:
+            raise ValueError(
+                f"{type(strategy).__name__} does not support stale_prob > 0")
+        if sc.get("wantRL", False) and not strategy.supports_rl:
+            raise ValueError(
+                f"{type(strategy).__name__} does not support wantRL")
 
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
+        # partition mode: explicit shard_map collectives (default), or
+        # GSPMD sharding propagation (required for a model axis > 1)
+        mesh_cfg = config.mesh_config or {}
+        default_mode = ("gspmd" if self.mesh.shape.get(MODEL_AXIS, 1) > 1
+                        else "shard_map")
+        self.partition_mode = mesh_cfg.get("partition", default_mode)
         self._multi_cache = {}
         self._round_step = self._build_round_step()
 
@@ -95,9 +107,16 @@ class RoundEngine:
     def init_state(self, rng: jax.Array, params: Any = None) -> ServerState:
         if params is None:
             params = self.task.init_params(rng)
-        params = jax.device_put(params, self._replicated)
-        opt_state = jax.jit(self.server_tx.init,
-                            out_shardings=self._replicated)(params)
+        if self.partition_mode == "gspmd" and \
+                self.mesh.shape.get(MODEL_AXIS, 1) > 1:
+            from ..parallel.sharding import infer_model_sharding
+            shardings = infer_model_sharding(params, self.mesh)
+            params = jax.tree.map(jax.device_put, params, shardings)
+            opt_state = jax.jit(self.server_tx.init)(params)
+        else:
+            params = jax.device_put(params, self._replicated)
+            opt_state = jax.jit(self.server_tx.init,
+                                out_shardings=self._replicated)(params)
         return ServerState(
             params=params,
             opt_state=opt_state,
@@ -165,14 +184,23 @@ class RoundEngine:
                 "stats_var_sum": jnp.sum(stats["var_corrected"] * client_mask),
                 "stats_norm_sum": jnp.sum(stats["norm"] * client_mask),
             })
-            # the "harvest": one collective instead of K P2P recvs
-            return jax.lax.psum(local, CLIENTS_AXIS), privacy_per_client
+            if self.partition_mode == "shard_map":
+                # the "harvest": one collective instead of K P2P recvs
+                return jax.lax.psum(local, CLIENTS_AXIS), privacy_per_client
+            return local, privacy_per_client
 
-        sharded_collect = shard_map(
-            shard_body, mesh=mesh,
-            in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec,
-                      rspec, rspec),
-            out_specs=(rspec, cspec), check_vma=False)
+        if self.partition_mode == "shard_map":
+            sharded_collect = shard_map(
+                shard_body, mesh=mesh,
+                in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec,
+                          rspec, rspec),
+                out_specs=(rspec, cspec), check_vma=False)
+        else:
+            # GSPMD mode: plain jit — client data stays sharded on the
+            # 'clients' axis, params sharded per infer_model_sharding on the
+            # 'model' axis; XLA's SPMD partitioner inserts the collectives
+            # (enables tensor-parallel BERT, which the reference lacks).
+            sharded_collect = shard_body
 
         def round_step(params, opt_state, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, server_lr,

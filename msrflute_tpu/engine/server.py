@@ -127,7 +127,8 @@ class OptimizationServer:
                 "opt_cfg": sc.server_replay_config.optimizer_config,
             }
 
-        self._eval_fn = build_eval_fn(task, self.mesh)
+        self._eval_fn = build_eval_fn(task, self.mesh,
+                                      self.engine.partition_mode)
         self._np_rng = np.random.default_rng(seed)
         self._rng = jax.random.PRNGKey(seed)
         self.run_stats: Dict[str, list] = {
@@ -314,7 +315,7 @@ class OptimizationServer:
                                                               self.batch_size)),
             pad_steps_to_multiple_of=self.mesh.shape[CLIENTS_AXIS])
         metrics = evaluate(self.task, self._eval_fn, self.state.params,
-                           batches, self.mesh)
+                           batches, self.mesh, self.engine.partition_mode)
         if "acc" in metrics:
             return float(metrics["acc"].value)
         return -float(metrics["loss"].value)
@@ -421,7 +422,7 @@ class OptimizationServer:
         bs = int(batch_cfg.get("batch_size", self.batch_size))
         batches = pack_eval_batches(dataset, bs, pad_steps_to_multiple_of=ndev)
         metrics = evaluate(self.task, self._eval_fn, self.state.params,
-                           batches, self.mesh)
+                           batches, self.mesh, self.engine.partition_mode)
         for name, metric in metrics.items():
             log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
 

@@ -1,0 +1,167 @@
+"""BERT masked-LM task (mlm_bert).
+
+Parity target: reference ``experiments/mlm_bert/model.py`` — an HF
+``AutoModelForMaskedLM`` wrapper with label smoothing, MLM masking via the HF
+collator (``dataloaders/dataloader.py:23,60``: ``mlm_probability``), gradient
+accumulation and masked-token accuracy.
+
+TPU-native:
+
+- the model is HF **Flax** BERT (``FlaxBertForMaskedLM``), instantiated from
+  a local ``BertConfig`` (``model_name_or_path`` is honored when a local
+  checkpoint path is given; fresh init otherwise — this container is
+  zero-egress);
+- MLM masking is *dynamic, on-device*: the 80/10/10 mask/random/keep rule of
+  the HF collator is applied inside ``loss`` from the per-step RNG, so it
+  jits and re-masks every epoch like the torch collator re-collates;
+- label smoothing follows HF ``LabelSmoother`` semantics (epsilon spread
+  over the vocabulary, masked positions excluded);
+- gradient accumulation is subsumed by the engine's ``lax.scan`` over
+  steps (an explicit knob is unnecessary when the whole epoch is compiled);
+- with a ``model`` mesh axis > 1 the engine shards BERT params via
+  :func:`msrflute_tpu.parallel.sharding.infer_model_sharding` (net-new:
+  the reference has no tensor parallelism, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.metrics import Metric
+from .base import BaseTask, Batch
+
+
+class BertMLMTask(BaseTask):
+
+    name = "mlm_bert"
+
+    def __init__(self, model_config):
+        from transformers import BertConfig, FlaxBertForMaskedLM
+
+        bert_cfg = (model_config.get("BERT") or {}).get("model", {})
+        training_cfg = (model_config.get("BERT") or {}).get("training", {})
+        path = bert_cfg.get("model_name_or_path")
+        hidden = int(bert_cfg.get("hidden_size", 128))
+        self.seq_len = int(bert_cfg.get("max_seq_length",
+                                        model_config.get("max_seq_length", 128)))
+        self.mlm_probability = float(bert_cfg.get("mlm_probability", 0.15))
+        self.label_smoothing = float(
+            training_cfg.get("label_smoothing_factor", 0.0))
+        self.mask_token_id = int(bert_cfg.get("mask_token_id", 103))
+        if path:
+            self.model = FlaxBertForMaskedLM.from_pretrained(path)
+            self.config = self.model.config
+        else:
+            self.config = BertConfig(
+                vocab_size=int(bert_cfg.get("vocab_size", 30522)),
+                hidden_size=hidden,
+                num_hidden_layers=int(bert_cfg.get("num_hidden_layers", 2)),
+                num_attention_heads=int(bert_cfg.get("num_attention_heads", 2)),
+                intermediate_size=int(bert_cfg.get("intermediate_size",
+                                                   4 * hidden)),
+                max_position_embeddings=max(self.seq_len, 512),
+            )
+            self.model = FlaxBertForMaskedLM(self.config, _do_init=True)
+        self.vocab_size = int(self.config.vocab_size)
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng: jax.Array):
+        dummy = jnp.ones((1, self.seq_len), jnp.int32)
+        return self.model.module.init(
+            {"params": rng, "dropout": rng},
+            dummy, jnp.ones_like(dummy), jnp.zeros_like(dummy),
+            jnp.broadcast_to(jnp.arange(self.seq_len), (1, self.seq_len)),
+            None, deterministic=True, return_dict=False)["params"]
+
+    def _logits(self, params, input_ids, attention_mask, deterministic=True,
+                rng=None):
+        rngs = {"dropout": rng} if rng is not None else {}
+        out = self.model.module.apply(
+            {"params": params}, input_ids, attention_mask,
+            jnp.zeros_like(input_ids),
+            jnp.broadcast_to(jnp.arange(input_ids.shape[-1]),
+                             input_ids.shape),
+            None, deterministic=deterministic, return_dict=True, rngs=rngs)
+        return out.logits
+
+    def apply(self, params, input_ids):
+        return self._logits(params, input_ids.astype(jnp.int32),
+                            jnp.ones_like(input_ids, jnp.int32))
+
+    # ------------------------------------------------------------------
+    def _mlm_mask(self, rng, input_ids, attention_mask):
+        """HF DataCollatorForLanguageModeling rule: select
+        ``mlm_probability`` of real tokens; of those 80% -> [MASK], 10% ->
+        random token, 10% -> unchanged; labels = original ids at selected
+        positions, -100 elsewhere."""
+        r1, r2, r3 = jax.random.split(rng, 3)
+        select = (jax.random.uniform(r1, input_ids.shape) <
+                  self.mlm_probability) & (attention_mask > 0)
+        labels = jnp.where(select, input_ids, -100)
+        roll = jax.random.uniform(r2, input_ids.shape)
+        masked = jnp.where(select & (roll < 0.8), self.mask_token_id,
+                           input_ids)
+        random_ids = jax.random.randint(r3, input_ids.shape, 0,
+                                        self.vocab_size)
+        masked = jnp.where(select & (roll >= 0.8) & (roll < 0.9),
+                           random_ids, masked)
+        return masked, labels
+
+    def _masked_xent(self, logits, labels):
+        """Label-smoothed CE over positions with label != -100 (HF
+        LabelSmoother semantics)."""
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        if self.label_smoothing > 0:
+            smooth = -jnp.mean(logp, axis=-1)
+            nll = (1 - self.label_smoothing) * nll + self.label_smoothing * smooth
+        return nll, valid.astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
+             train: bool = True):
+        input_ids = batch["x"].astype(jnp.int32)
+        attention_mask = batch.get(
+            "attention_mask", (input_ids != 0).astype(jnp.int32))
+        attention_mask = attention_mask * batch["sample_mask"][:, None].astype(
+            attention_mask.dtype)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        mask_rng, drop_rng = jax.random.split(rng)
+        masked_ids, labels = self._mlm_mask(mask_rng, input_ids,
+                                            attention_mask)
+        logits = self._logits(params, masked_ids, attention_mask,
+                              deterministic=not train,
+                              rng=drop_rng if train else None)
+        nll, valid = self._masked_xent(logits, labels)
+        loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        return loss, {"sample_count": jnp.sum(batch["sample_mask"])}
+
+    def eval_stats(self, params, batch: Batch) -> Dict[str, jnp.ndarray]:
+        input_ids = batch["x"].astype(jnp.int32)
+        attention_mask = batch.get(
+            "attention_mask", (input_ids != 0).astype(jnp.int32))
+        attention_mask = attention_mask * batch["sample_mask"][:, None].astype(
+            attention_mask.dtype)
+        # deterministic eval masking so metrics are reproducible
+        masked_ids, labels = self._mlm_mask(jax.random.PRNGKey(1234),
+                                            input_ids, attention_mask)
+        logits = self._logits(params, masked_ids, attention_mask)
+        nll, valid = self._masked_xent(logits, labels)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = (pred == jnp.where(labels == -100, -1, labels)).astype(
+            jnp.float32)
+        return {
+            "loss_sum": jnp.sum(nll * valid),
+            "correct_sum": jnp.sum(correct * valid),
+            "sample_count": jnp.sum(valid),
+            "seq_count": jnp.sum(batch["sample_mask"]),
+        }
+
+
+def make_bert_mlm_task(model_config) -> BertMLMTask:
+    return BertMLMTask(model_config)

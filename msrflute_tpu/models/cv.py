@@ -132,6 +132,22 @@ class ClassificationTask(BaseTask):
             metrics["f1_score"] = Metric(float(jnp.mean(f1)), higher_is_better=True)
         return metrics
 
+    def make_dataset(self, blob, model_config, split, data_config=None):
+        """Featurize an image/vector user blob (reshapes flat or CHW samples
+        to this task's HWC example shape)."""
+        import numpy as np
+        from ..data.dataset import ArraysDataset
+        from ..data.featurize import to_image
+        per_user = []
+        for i in range(len(blob)):
+            x = to_image(np.asarray(blob.user_data[i], np.float32),
+                         self.example_shape)
+            y = (np.asarray(blob.user_labels[i]).astype(np.int32)
+                 if blob.user_labels is not None else
+                 np.zeros((len(x),), np.int32))
+            per_user.append({"x": x, "y": y})
+        return ArraysDataset(blob.user_list, per_user, blob.num_samples)
+
 
 def make_lr_task(model_config) -> ClassificationTask:
     num_classes = int(model_config.get("num_classes", 10))

@@ -1,0 +1,198 @@
+"""FedNewsRec — federated news recommendation (NRMS-style).
+
+Parity target: reference ``experiments/fednewsrec`` (FedNewsRec,
+EMNLP-Findings 2020, ported there from TF): a news encoder (word embeddings
+-> multi-head self-attention -> attentive pooling) and a user encoder
+(self-attention over clicked-news vectors -> attentive pooling), trained
+with ``npratio``-negative sampling (softmax over 1 positive + 4 negatives,
+``fednewsrec_model.py:5``), evaluated with AUC / MRR / nDCG@5 / nDCG@10
+(``model.py:19-51``).
+
+Batch contract (featurized by the MIND-style loader):
+- ``clicked``  [B, H, L]  token ids of the user's click history
+- ``cands``    [B, C, L]  candidate news token ids (C = 1 + npratio for
+  training; padded impression slate for eval)
+- ``y``        [B]        index of the positive candidate (train)
+- ``labels``   [B, C]     0/1 relevance (eval slates)
+- ``cand_mask``[B, C]     real-candidate mask (eval slates)
+
+All ranking metrics are computed *per impression* and summed, so they
+aggregate exactly across shards via the engine's psum.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.metrics import Metric
+from .base import BaseTask, Batch
+
+
+class _AttentivePooling(nn.Module):
+    """tanh-MLP attention pooling (reference ``AttentivePooling``)."""
+
+    hidden: int = 200
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):  # x: [..., T, D]
+        att = jnp.tanh(nn.Dense(self.hidden)(x))
+        att = nn.Dense(1)(att)[..., 0]
+        att = jax.nn.softmax(att, axis=-1)
+        return jnp.einsum("...td,...t->...d", x, att)
+
+
+class _NewsEncoder(nn.Module):
+    vocab_size: int
+    embed_dim: int = 300
+    heads: int = 20
+    head_dim: int = 20
+
+    @nn.compact
+    def __call__(self, tokens):  # [..., L]
+        emb = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        h = nn.SelfAttention(num_heads=self.heads,
+                             qkv_features=self.heads * self.head_dim,
+                             out_features=self.heads * self.head_dim,
+                             use_bias=False)(emb)
+        return _AttentivePooling()(h)
+
+
+class _UserEncoder(nn.Module):
+    heads: int = 20
+    head_dim: int = 20
+
+    @nn.compact
+    def __call__(self, news_vecs):  # [..., H, D]
+        h = nn.SelfAttention(num_heads=self.heads,
+                             qkv_features=self.heads * self.head_dim,
+                             out_features=self.heads * self.head_dim,
+                             use_bias=False)(news_vecs)
+        return _AttentivePooling()(h)
+
+
+class _NRMS(nn.Module):
+    vocab_size: int
+    embed_dim: int = 300
+    heads: int = 20
+    head_dim: int = 20
+
+    @nn.compact
+    def __call__(self, clicked, cands):
+        news_enc = _NewsEncoder(self.vocab_size, self.embed_dim, self.heads,
+                                self.head_dim)
+        clicked_vecs = news_enc(clicked)         # [B, H, D]
+        cand_vecs = news_enc(cands)              # [B, C, D]
+        user_vec = _UserEncoder(self.heads, self.head_dim)(clicked_vecs)
+        return jnp.einsum("bcd,bd->bc", cand_vecs, user_vec)  # scores
+
+
+class FedNewsRecTask(BaseTask):
+
+    name = "fednewsrec"
+
+    def __init__(self, model_config):
+        self.vocab_size = int(model_config.get("vocab_size", 40000))
+        self.seq_len = int(model_config.get("max_title_length", 30))
+        self.history = int(model_config.get("max_history", 50))
+        self.npratio = int(model_config.get("npratio", 4))
+        self.module = _NRMS(
+            vocab_size=self.vocab_size,
+            embed_dim=int(model_config.get("embed_dim", 300)),
+            heads=int(model_config.get("num_heads", 20)),
+            head_dim=int(model_config.get("head_dim", 20)))
+
+    def init_params(self, rng: jax.Array):
+        clicked = jnp.zeros((1, self.history, self.seq_len), jnp.int32)
+        cands = jnp.zeros((1, self.npratio + 1, self.seq_len), jnp.int32)
+        return self.module.init(rng, clicked, cands)["params"]
+
+    def _scores(self, params, batch):
+        return self.module.apply({"params": params},
+                                 batch["clicked"].astype(jnp.int32),
+                                 batch["cands"].astype(jnp.int32))
+
+    def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
+             train: bool = True):
+        scores = self._scores(params, batch)
+        y = batch["y"].astype(jnp.int32)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+        mask = batch["sample_mask"]
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, {"sample_count": jnp.sum(mask)}
+
+    # -- ranking metrics, one impression at a time ---------------------
+    def eval_stats(self, params, batch: Batch) -> Dict[str, jnp.ndarray]:
+        scores = self._scores(params, batch)
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jax.nn.one_hot(batch["y"].astype(jnp.int32),
+                                    scores.shape[-1])
+        labels = labels.astype(jnp.float32)
+        cand_mask = batch.get("cand_mask",
+                              jnp.ones_like(labels)).astype(jnp.float32)
+        mask = batch["sample_mask"]
+        neg_inf = jnp.finfo(scores.dtype).min
+        masked_scores = jnp.where(cand_mask > 0, scores, neg_inf)
+
+        def per_impression(s, l, cm):
+            # rank of each candidate (1 = best) among real candidates
+            order = jnp.argsort(-s)
+            ranks = jnp.empty_like(order).at[order].set(
+                jnp.arange(1, s.shape[0] + 1))
+            pos = l * cm
+            n_pos = jnp.sum(pos)
+            n_neg = jnp.sum((1 - l) * cm)
+            # AUC: P(pos ranked above neg) = (sum of neg ranks below each pos)
+            pairs = jnp.sum(pos[:, None] * ((1 - l) * cm)[None, :] *
+                            (s[:, None] > s[None, :]))
+            auc = pairs / jnp.maximum(n_pos * n_neg, 1.0)
+            # MRR over positives
+            mrr = jnp.sum(pos / ranks) / jnp.maximum(n_pos, 1.0)
+            # nDCG@k
+            def ndcg(k):
+                gains = pos / jnp.log2(ranks + 1.0) * (ranks <= k)
+                ideal_ranks = jnp.arange(1, s.shape[0] + 1)
+                ideal = jnp.sum((ideal_ranks <= jnp.minimum(n_pos, k)) /
+                                jnp.log2(ideal_ranks + 1.0))
+                return jnp.sum(gains) / jnp.maximum(ideal, 1e-12)
+            valid = (n_pos > 0) & (n_neg > 0)
+            return (jnp.where(valid, auc, 0.0),
+                    jnp.where(n_pos > 0, mrr, 0.0),
+                    jnp.where(n_pos > 0, ndcg(5), 0.0),
+                    jnp.where(n_pos > 0, ndcg(10), 0.0),
+                    valid.astype(jnp.float32))
+
+        auc, mrr, ndcg5, ndcg10, valid = jax.vmap(per_impression)(
+            masked_scores, labels, cand_mask)
+        valid = valid * mask
+        # loss over slates as well
+        logp = jax.nn.log_softmax(masked_scores, axis=-1)
+        nll = -jnp.sum(labels * cand_mask * logp, axis=-1) / \
+            jnp.maximum(jnp.sum(labels * cand_mask, axis=-1), 1.0)
+        return {
+            "loss_sum": jnp.sum(nll * mask),
+            "auc_sum": jnp.sum(auc * valid),
+            "mrr_sum": jnp.sum(mrr * valid),
+            "ndcg5_sum": jnp.sum(ndcg5 * valid),
+            "ndcg10_sum": jnp.sum(ndcg10 * valid),
+            "sample_count": jnp.sum(valid),
+        }
+
+    def finalize_metrics(self, sums):
+        n = max(float(sums["sample_count"]), 1.0)
+        return {
+            "loss": Metric(float(sums["loss_sum"]) / n, higher_is_better=False),
+            "auc": Metric(float(sums["auc_sum"]) / n),
+            "mrr": Metric(float(sums["mrr_sum"]) / n),
+            "ndcg@5": Metric(float(sums["ndcg5_sum"]) / n),
+            "ndcg@10": Metric(float(sums["ndcg10_sum"]) / n),
+        }
+
+
+def make_fednewsrec_task(model_config) -> FedNewsRecTask:
+    return FedNewsRecTask(model_config)

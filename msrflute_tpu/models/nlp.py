@@ -122,10 +122,20 @@ class SequenceLMTask(BaseTask):
         x = batch["x"].astype(jnp.int32)
         if "y" in batch and batch["y"].ndim == x.ndim:
             inputs, targets = x, batch["y"].astype(jnp.int32)
+            tok_mask = batch.get("tok_mask")
+            tok_mask = (tok_mask.astype(jnp.float32) if tok_mask is not None
+                        else (targets != 0).astype(jnp.float32))
         else:
             inputs, targets = x[:, :-1], x[:, 1:]
+            tok_mask = batch.get("tok_mask")
+            if tok_mask is not None:
+                # mask for the shifted targets: a target is real iff its
+                # position was real (keeps unk id 0 in the denominator, as
+                # the reference's >=0 padding rule does)
+                tok_mask = tok_mask.astype(jnp.float32)[:, 1:]
+            else:
+                tok_mask = (targets != 0).astype(jnp.float32)
         logits = self.module.apply({"params": params}, inputs)
-        tok_mask = (targets != 0).astype(jnp.float32)
         tok_mask = tok_mask * batch["sample_mask"][:, None]
         return logits, targets, tok_mask
 
@@ -163,14 +173,78 @@ class SequenceLMTask(BaseTask):
         }
 
 
+class _TokenDatasetMixin:
+    """make_dataset for token-sequence tasks: raw strings are tokenized
+    (chars for Shakespeare, vocab words for the GRU LM), int sequences pass
+    through 0-padded to ``seq_len``."""
+
+    tokenizer: str = "words"  # or "chars"
+
+    def make_dataset(self, blob, model_config, split, data_config=None):
+        import numpy as np
+        from ..data.dataset import ArraysDataset
+        from ..data import featurize
+
+        vocab = None
+        vocab_path = (model_config.get("vocab_dict") or
+                      (data_config.get("vocab_dict") if data_config else None))
+        if self.tokenizer == "words" and vocab_path:
+            vocab = featurize.load_vocab(vocab_path)
+        L = self.seq_len
+
+        def encode_rows(samples):
+            rows = []
+            for s in samples:
+                if isinstance(s, str):
+                    if self.tokenizer == "chars":
+                        rows.append(featurize.encode_chars(s, L))
+                    else:
+                        if vocab is None:
+                            raise ValueError(
+                                "word task needs vocab_dict for raw text")
+                        rows.append(featurize.encode_words(s, vocab, L))
+                elif isinstance(s, (list, tuple)) and s and \
+                        isinstance(s[0], str):
+                    if vocab is None:
+                        raise ValueError(
+                            "word task needs vocab_dict for raw tokens")
+                    rows.append(featurize.encode_words(s, vocab, L))
+                else:
+                    rows.append(np.asarray(s))
+            return featurize.pad_token_matrix(rows, L)
+
+        per_user = []
+        for i in range(len(blob)):
+            x, tok_mask = encode_rows(blob.user_data[i])
+            entry = {"x": x, "tok_mask": tok_mask}
+            if blob.user_labels is not None and \
+                    blob.user_labels[i] is not None:
+                # fed_shakespeare-style explicit target sequences
+                y, y_mask = encode_rows(blob.user_labels[i])
+                entry["y"] = y
+                entry["tok_mask"] = y_mask
+            per_user.append(entry)
+        return ArraysDataset(blob.user_list, per_user,
+                             [len(u["x"]) for u in per_user])
+
+
+class ShakespeareTask(_TokenDatasetMixin, SequenceLMTask):
+    tokenizer = "chars"
+
+
+class GRUWordTask(_TokenDatasetMixin, SequenceLMTask):
+    tokenizer = "words"
+
+
 def make_shakespeare_lstm_task(model_config) -> SequenceLMTask:
     vocab = int(model_config.get("vocab_size", 90))
     module = _ShakespeareLSTM(
         vocab_size=vocab,
         embed_dim=int(model_config.get("embed_dim", 8)),
         hidden=int(model_config.get("hidden_dim", 256)))
-    return SequenceLMTask(module, seq_len=int(model_config.get("seq_len", 80)),
-                          name="nlp_rnn_fedshakespeare")
+    return ShakespeareTask(module,
+                           seq_len=int(model_config.get("seq_len", 80)),
+                           name="nlp_rnn_fedshakespeare")
 
 
 def make_gru_lm_task(model_config) -> SequenceLMTask:
@@ -178,6 +252,6 @@ def make_gru_lm_task(model_config) -> SequenceLMTask:
         vocab_size=int(model_config.get("vocab_size", 10000)),
         embed_dim=int(model_config.get("embed_dim", 160)),
         hidden_dim=int(model_config.get("hidden_dim", 512)))
-    return SequenceLMTask(module,
-                          seq_len=int(model_config.get("max_num_words", 25)),
-                          name="nlg_gru", oov_reject=True)
+    return GRUWordTask(module,
+                       seq_len=int(model_config.get("max_num_words", 25)),
+                       name="nlg_gru", oov_reject=True)

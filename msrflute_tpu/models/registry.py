@@ -77,3 +77,9 @@ def _load_builtins() -> None:
         TASK_REGISTRY.setdefault("BERT", bert.make_bert_mlm_task)
     except ImportError:
         pass
+    try:
+        from . import fednewsrec
+        TASK_REGISTRY.setdefault("NRMS", fednewsrec.make_fednewsrec_task)
+        TASK_REGISTRY.setdefault("FEDNEWSREC", fednewsrec.make_fednewsrec_task)
+    except ImportError:
+        pass

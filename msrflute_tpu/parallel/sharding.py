@@ -1,0 +1,42 @@
+"""Parameter sharding inference for the ``model`` mesh axis.
+
+Net-new vs the reference (FLUTE has no tensor parallelism — SURVEY.md
+§2.2): when the mesh carves a ``model`` axis, large parameters are sharded
+across it and XLA's SPMD partitioner inserts the all-gathers/reduce-scatters
+over ICI.  The heuristic shards each ≥2-D parameter along its largest
+mesh-divisible dimension (embedding tables along vocab, dense kernels along
+the wider of in/out), leaving small leaves replicated — the standard
+Megatron-ish layout without hand-written per-layer rules, which is what the
+generic model zoo needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+
+def infer_model_sharding(params: Any, mesh: Mesh,
+                         min_elements: int = 16_384) -> Any:
+    """Pytree of NamedShardings: big leaves sharded on ``model``, rest
+    replicated."""
+    axis_size = mesh.shape[MODEL_AXIS]
+
+    def leaf_sharding(leaf):
+        if axis_size == 1 or leaf.ndim < 2 or leaf.size < min_elements:
+            return NamedSharding(mesh, P())
+        # shard the largest divisible dim
+        order = np.argsort(leaf.shape)[::-1]
+        for dim in order:
+            if leaf.shape[dim] % axis_size == 0:
+                spec = [None] * leaf.ndim
+                spec[int(dim)] = MODEL_AXIS
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf_sharding, params)

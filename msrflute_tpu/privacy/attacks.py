@@ -31,6 +31,7 @@ from ..optim import make_optimizer
 
 def extract_indices_from_embeddings(pseudo_grad_embedding: jnp.ndarray,
                                     token_batch: jnp.ndarray,
+                                    num_tokens: Optional[jnp.ndarray] = None,
                                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Embedding-gradient token-extraction attack.
 
@@ -39,21 +40,30 @@ def extract_indices_from_embeddings(pseudo_grad_embedding: jnp.ndarray,
             embedding table.
         token_batch: integer token ids of the client's round data (any
             shape); ids <= 0 are padding.
+        num_tokens: the client's *actual* token count (the reference's
+            ``len(batch)``, ``metrics.py:15``) — may be traced.  The static
+            grid is padded per round, so callers must pass the real count
+            (e.g. ``sum(sample_mask) * seq_len``); defaults to the grid
+            size for parity with naive callers.
 
     Returns:
         (overlap_ratio, per_vocab_extracted_mask) — overlap of the top-k
-        extracted rows with the true tokens (k = total token count, as in
-        the reference), and a ``[vocab]`` 0/1 mask of extracted rows for
-        downstream word-rank stats.
+        extracted rows with the true tokens (k = token count), and a
+        ``[vocab]`` 0/1 mask of extracted rows for word-rank stats.
     """
     flat = token_batch.reshape(-1)
     valid = flat > 0
-    tot_tokens = flat.shape[0]  # reference uses total (incl. pad) as k
+    if num_tokens is None:
+        num_tokens = jnp.asarray(flat.shape[0], jnp.float32)
     norms = jnp.linalg.norm(pseudo_grad_embedding, axis=-1)
     vocab = norms.shape[0]
-    k = min(tot_tokens, vocab)
-    _, top_idx = jax.lax.top_k(norms, k)
-    extracted_mask = jnp.zeros((vocab,), jnp.float32).at[top_idx].set(1.0)
+    # rank of every vocab row by descending grad norm; "extracted" = rank <
+    # k with k dynamic (top_k needs a static k, ranks do not)
+    order = jnp.argsort(-norms)
+    ranks = jnp.zeros((vocab,), jnp.float32).at[order].set(
+        jnp.arange(vocab, dtype=jnp.float32))
+    extracted_mask = (ranks < jnp.minimum(num_tokens, vocab)).astype(
+        jnp.float32)
     hit = extracted_mask[jnp.clip(flat, 0, vocab - 1)] * valid
     overlap = jnp.sum(hit) / jnp.maximum(jnp.sum(valid), 1.0)
     return overlap, extracted_mask

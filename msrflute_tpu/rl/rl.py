@@ -49,11 +49,16 @@ class _QNet(nn.Module):
     @nn.compact
     def __call__(self, x):
         if self.want_lstm:
-            # x: [T, F] window of recent states
-            fwd = nn.RNN(nn.OptimizedLSTMCell(self.sizes[0]))(x[None])[0]
-            bwd = nn.RNN(nn.OptimizedLSTMCell(self.sizes[0]), reverse=True)(
-                x[None])[0]
-            x = (fwd + bwd)[-1]
+            # x: [T, F] window of recent states, or [B, T, F]
+            squeeze = x.ndim == 2
+            if squeeze:
+                x = x[None]
+            fwd = nn.RNN(nn.OptimizedLSTMCell(self.sizes[0]))(x)
+            bwd = nn.RNN(nn.OptimizedLSTMCell(self.sizes[0]),
+                         reverse=True)(x)
+            x = (fwd + bwd)[:, -1]  # last timestep only, like forward()
+            if squeeze:
+                x = x[0]
         for h in self.sizes[:-1]:
             x = nn.relu(nn.Dense(h)(x))
         return nn.Dense(self.sizes[-1])(x)
@@ -143,8 +148,6 @@ class RLAggregator:
         def train_step(params, opt_state, states, actions, rewards):
             def loss_fn(p):
                 out = net.apply({"params": p}, states)
-                if out.ndim > 2:  # lstm branch returns per-window
-                    out = out[:, -1]
                 q = jnp.sum(out * actions, axis=-1)
                 return jnp.mean((q - rewards) ** 2)
             loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -168,14 +171,15 @@ class RLAggregator:
             batch = self._pyrng.sample(
                 self.replay, min(len(self.replay), self.minibatch))
         states = np.stack([b[0] for b in batch])
-        if self.want_lstm:
-            pad = np.zeros((self.minibatch - len(batch), states.shape[1]),
-                           np.float32)
-            states = np.concatenate([pad, states])[None]  # [1, T, F] window
         actions = np.stack([b[1] for b in batch])
         rewards = np.asarray([b[2] for b in batch], np.float32)
         if self.want_lstm:
-            actions = actions[-1:][None] if actions.ndim == 2 else actions
+            # one padded window sequence; Q is read at the last timestep,
+            # matching forward()
+            pad = np.zeros((self.minibatch - len(batch), states.shape[1]),
+                           np.float32)
+            states = np.concatenate([pad, states])[None]  # [1, T, F]
+            actions = actions[-1:]
             rewards = rewards[-1:]
         self.params, self.opt_state, loss = self._train_step(
             self.params, self.opt_state, jnp.asarray(states),

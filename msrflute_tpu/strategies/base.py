@@ -53,6 +53,9 @@ class BaseStrategy:
 
     #: whether combine() maintains cross-round state (a pytree)
     stateful: bool = False
+    #: single-'default'-payload features; FedLabels' dual payload opts out
+    supports_staleness: bool = True
+    supports_rl: bool = True
     #: probability a client's payload is deferred one round (DGA staleness,
     #: reference core/strategies/dga.py:260-284); the engine draws the
     #: per-client coin and hands combine() separate now/deferred sums.
@@ -102,8 +105,12 @@ class BaseStrategy:
         if pm.get("apply_indices_extraction", False) and "x" in arrays:
             embed_leaf = _find_embedding_leaf(pg)
             if embed_leaf is not None:
+                # real token count, not the padded grid (metrics.py:15)
+                seq_len = arrays["x"].shape[-1]
+                num_tokens = jnp.sum(sample_mask) * seq_len
                 overlap, extracted = attacks.extract_indices_from_embeddings(
-                    embed_leaf, arrays["x"].astype(jnp.int32))
+                    embed_leaf, arrays["x"].astype(jnp.int32),
+                    num_tokens=num_tokens)
                 stats["privacy_overlap"] = overlap
                 rank = int(pm.get("allowed_word_rank", 9000))
                 above = extracted[rank:] if rank < extracted.shape[0] else \

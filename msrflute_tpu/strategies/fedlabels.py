@@ -47,6 +47,11 @@ from .base import BaseStrategy, filter_weight
 
 class FedLabels(BaseStrategy):
 
+    # dual sup/unsup payload — no single 'default' part for the staleness
+    # buffer or RL re-weighting to act on
+    supports_staleness = False
+    supports_rl = False
+
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
         ss = (config.client_config.get("semisupervision")

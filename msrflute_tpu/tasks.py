@@ -34,10 +34,10 @@ def default_featurize(blob: UserBlob, model_config) -> ArraysDataset:
 
 
 def make_dataset_for(task: BaseTask, blob: UserBlob, model_config,
-                     split: str) -> ArraysDataset:
+                     split: str, data_config=None) -> ArraysDataset:
     hook = getattr(task, "make_dataset", None)
     if hook is not None:
-        return hook(blob, model_config, split)
+        return hook(blob, model_config, split, data_config=data_config)
     return default_featurize(blob, model_config)
 
 
@@ -56,13 +56,15 @@ def build_task_datasets(cfg: FLUTEConfig, task: BaseTask) -> Tuple[
         raise ValueError("client_config.data_config.train needs "
                          "list_of_train_data or train_data")
     train = scrub_empty_clients(make_dataset_for(
-        task, load_user_blob(train_path), cfg.model_config, "train"))
+        task, load_user_blob(train_path), cfg.model_config, "train",
+        data_config=cc_train))
 
     def _load(split_cfg, key, split):
         path = split_cfg.get(key)
         if not path:
             return None
-        return make_dataset_for(task, load_user_blob(path), cfg.model_config, split)
+        return make_dataset_for(task, load_user_blob(path), cfg.model_config,
+                                split, data_config=split_cfg)
 
     val = _load(cfg.server_config.data_config.val, "val_data", "val")
     test = _load(cfg.server_config.data_config.test, "test_data", "test")

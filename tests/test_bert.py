@@ -1,0 +1,89 @@
+"""mlm_bert task: tiny Flax BERT through the federated engine with a
+(clients, model) mesh — exercises the GSPMD tensor-sharding path that the
+reference doesn't have."""
+
+import jax
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig, ModelConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.models import make_task
+
+TINY_BERT = {
+    "model_type": "BERT",
+    "BERT": {
+        "model": {"vocab_size": 120, "hidden_size": 32,
+                  "num_hidden_layers": 2, "num_attention_heads": 2,
+                  "intermediate_size": 64, "max_seq_length": 16,
+                  "mlm_probability": 0.3, "mask_token_id": 4},
+        "training": {"label_smoothing_factor": 0.1, "batch_size": 4,
+                     "seed": 0},
+    },
+}
+
+
+def _token_dataset(num_users=8, n=8, L=16, vocab=120, seed=0):
+    rng = np.random.default_rng(seed)
+    users, per_user = [], []
+    for u in range(num_users):
+        x = rng.integers(5, vocab, size=(n, L)).astype(np.int32)
+        x[:, -3:] = 0  # padding tail
+        per_user.append({"x": x})
+        users.append(f"u{u}")
+    return ArraysDataset(users, per_user)
+
+
+@pytest.fixture(scope="module")
+def bert_task():
+    return make_task(ModelConfig.from_dict(TINY_BERT))
+
+
+def test_bert_loss_and_eval(bert_task):
+    import jax.numpy as jnp
+    params = bert_task.init_params(jax.random.PRNGKey(0))
+    batch = {"x": jnp.asarray(np.random.default_rng(0).integers(
+        5, 120, size=(4, 16)), jnp.int32),
+        "sample_mask": jnp.ones((4,), jnp.float32)}
+    loss, aux = jax.jit(
+        lambda p, b: bert_task.loss(p, b, jax.random.PRNGKey(1), True)
+    )(params, batch)
+    assert np.isfinite(float(loss))
+    sums = jax.jit(bert_task.eval_stats)(params, batch)
+    metrics = bert_task.finalize_metrics(jax.device_get(sums))
+    assert "acc" in metrics and "loss" in metrics
+
+
+def test_bert_federated_round_model_sharded(bert_task, tmp_path):
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.parallel import make_mesh
+    mesh = make_mesh(model_axis_size=2)  # 4 client groups x 2-way model
+    cfg = FLUTEConfig.from_dict({
+        "model_config": TINY_BERT,
+        "strategy": "fedavg",
+        "mesh_config": {"model_axis_size": 2},
+        "server_config": {
+            "max_iteration": 2, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.05,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2, "initial_val": False,
+            "data_config": {"val": {"batch_size": 8}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "adamw", "lr": 0.05},
+            "data_config": {"train": {"batch_size": 4}},
+        },
+    })
+    ds = _token_dataset()
+    task = bert_task
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), mesh=mesh, seed=0)
+    assert server.engine.partition_mode == "gspmd"
+    state = server.train()
+    assert state.round == 2
+    assert "acc" in server.best_val
+    # params actually sharded over the model axis
+    from msrflute_tpu.parallel.sharding import infer_model_sharding
+    leaves = jax.tree.leaves(state.params)
+    shardings = {str(l.sharding) for l in leaves}
+    assert any("model" in s for s in shardings), shardings

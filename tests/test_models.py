@@ -114,3 +114,31 @@ def test_ecg_task():
 def test_unknown_model_type():
     with pytest.raises(KeyError, match="NOPE"):
         make_task(ModelConfig(model_type="NOPE"))
+
+
+def test_fednewsrec_task():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    task = make_task(ModelConfig(model_type="NRMS", extra={
+        "vocab_size": 50, "embed_dim": 16, "num_heads": 2, "head_dim": 8,
+        "max_title_length": 6, "max_history": 4, "npratio": 2}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    batch = {
+        "clicked": jnp.asarray(rng.integers(1, 50, (3, 4, 6)), jnp.int32),
+        "cands": jnp.asarray(rng.integers(1, 50, (3, 3, 6)), jnp.int32),
+        "y": jnp.zeros((3,), jnp.int32),
+        "sample_mask": jnp.ones((3,), jnp.float32),
+    }
+    loss, _ = jax.jit(lambda p, b: task.loss(p, b, None, True))(params, batch)
+    assert np.isfinite(float(loss))
+    sums = jax.device_get(jax.jit(task.eval_stats)(params, batch))
+    metrics = task.finalize_metrics(sums)
+    for name in ("auc", "mrr", "ndcg@5", "ndcg@10"):
+        assert name in metrics and 0.0 <= metrics[name].value <= 1.0
+    # perfect ranking scores auc=1: positive score forced max
+    import jax.numpy as jnp2
+    labels = jnp2.asarray([[1, 0, 0]] * 3, jnp2.float32)
+    batch2 = dict(batch)
+    batch2["labels"] = labels
+    sums2 = jax.device_get(jax.jit(task.eval_stats)(params, batch2))
+    assert sums2["sample_count"] == 3

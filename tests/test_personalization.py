@@ -53,10 +53,12 @@ def test_personalization_trains_local_state(synth_dataset, mesh8, tmp_path):
     # interpolated eval runs
     acc = server.personalized_accuracy(synth_dataset)
     assert acc is not None and 0.0 <= acc <= 1.0
-    # store persisted + reload roundtrip
+    # store persisted per-user + reload roundtrip
     import os
-    assert os.path.exists(server._store_path)
+    assert os.path.isdir(server._store_path)
+    assert any(n.endswith("_model.msgpack")
+               for n in os.listdir(server._store_path))
     from msrflute_tpu.engine.personalization import PersonalizationStore
-    store2 = PersonalizationStore(0.75)
-    assert store2.load(server._store_path, state.params)
+    store2 = PersonalizationStore(0.75, server._store_path)
+    assert store2.load(state.params)
     assert store2.alpha == server.store.alpha

@@ -1,0 +1,53 @@
+"""json <-> hdf5 user-blob converters.
+
+Parity target: reference ``utils/preprocessing/{create-hdf5,create-json,
+from_json_to_hdf5}.py`` — converts the ``users/num_samples/user_data``
+federated blob between json and hdf5.
+
+Usage:
+    python tools/convert_data.py input.json output.hdf5
+    python tools/convert_data.py input.hdf5 output.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from msrflute_tpu.data.user_blob import (  # noqa: E402
+    load_user_blob, save_user_blob_hdf5,
+)
+
+
+def main() -> None:
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    src, dst = sys.argv[1], sys.argv[2]
+    blob = load_user_blob(src)
+    if dst.endswith((".hdf5", ".h5")):
+        save_user_blob_hdf5(dst, blob)
+    elif dst.endswith(".json"):
+        payload = {
+            "users": blob.user_list,
+            "num_samples": blob.num_samples,
+            "user_data": {u: {"x": np.asarray(x).tolist()}
+                          for u, x in zip(blob.user_list, blob.user_data)},
+        }
+        if blob.user_labels is not None:
+            payload["user_data_label"] = {
+                u: np.asarray(y).tolist()
+                for u, y in zip(blob.user_list, blob.user_labels)}
+        with open(dst, "w") as fh:
+            json.dump(payload, fh)
+    else:
+        raise SystemExit(f"unsupported output format: {dst}")
+    print(f"converted {src} -> {dst} ({len(blob)} users)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Synthetic dataset generator for smoke tests.
+
+Parity target: reference ``testing/create_data.py`` — builds tiny dummy
+datasets per task (truncated LEAF Reddit for nlg_gru/mlm_bert, CIFAR split
+into synthetic users, random ECG) so the e2e trainer can run without real
+downloads (``testing/README.md:3``: "evaluate the operation of the tasks,
+not the performance").
+
+Usage:
+    python tools/create_data.py --task cv_lr_mnist --out ./data [--users 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _write(path, blob):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(blob, fh)
+    print(f"wrote {path}")
+
+
+def _image_blob(rng, users, lo, hi, shape, classes):
+    names = [f"u{i:04d}" for i in range(users)]
+    data, labels, counts = {}, {}, []
+    for u in names:
+        n = int(rng.integers(lo, hi))
+        x = rng.normal(size=(n,) + shape).round(3)
+        y = rng.integers(0, classes, size=n)
+        data[u] = {"x": x.tolist()}
+        labels[u] = y.tolist()
+        counts.append(n)
+    return {"users": names, "num_samples": counts, "user_data": data,
+            "user_data_label": labels}
+
+
+def _text_blob(rng, users, lo, hi, sentence_pool):
+    names = [f"u{i:04d}" for i in range(users)]
+    data, counts = {}, []
+    for u in names:
+        n = int(rng.integers(lo, hi))
+        data[u] = {"x": [sentence_pool[int(rng.integers(len(sentence_pool)))]
+                         for _ in range(n)]}
+        counts.append(n)
+    return {"users": names, "num_samples": counts, "user_data": data}
+
+
+WORDS = ("the of and to in a is that it was for on are with as his they at be "
+         "this have from or one had by word but not what all were we when "
+         "your can said there use an each which she do how their if").split()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", required=True)
+    ap.add_argument("--out", default="./data")
+    ap.add_argument("--users", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    rng = np.random.default_rng(args.seed)
+    task, out, users = args.task, args.out, args.users
+
+    if task == "cv_lr_mnist":
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "mnist", f"{split}.json"),
+                   _image_blob(r, users, 8, 30, (784,), 10))
+    elif task in ("cv_cnn_femnist",):
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "femnist", f"{split}.json"),
+                   _image_blob(r, users, 8, 30, (28, 28), 62))
+    elif task == "cv_resnet_fedcifar100":
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "fedcifar100", f"{split}.json"),
+                   _image_blob(r, users, 4, 12, (32, 32, 3), 100))
+    elif task == "nlp_rnn_fedshakespeare":
+        lines = ["To be, or not to be: that is the question:",
+                 "Whether 'tis nobler in the mind to suffer",
+                 "The slings and arrows of outrageous fortune,",
+                 "Or to take arms against a sea of troubles."]
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "shakespeare", f"{split}.json"),
+                   _text_blob(r, users, 4, 16, lines))
+    elif task == "nlg_gru":
+        vocab = {w: i + 1 for i, w in enumerate(WORDS)}
+        vocab["<unk>"] = 0
+        os.makedirs(os.path.join(out, "mockup"), exist_ok=True)
+        with open(os.path.join(out, "mockup", "vocab_reddit.vocab"), "w") as fh:
+            json.dump(vocab, fh)
+        sentences = [" ".join(np.random.default_rng(i).choice(
+            WORDS, size=12)) for i in range(40)]
+        for split, name, seed in (("train", "train_data", 0),
+                                  ("val", "val_data", 1),
+                                  ("test", "test_data", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "mockup", f"{name}.json"),
+                   _text_blob(r, users, 4, 16, sentences))
+    elif task == "ecg_cnn":
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "ecg", f"{split}.json"),
+                   _image_blob(r, users, 8, 24, (187,), 5))
+    elif task in ("classif_cnn", "cv", "semisupervision"):
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            _write(os.path.join(out, "cifar", f"{split}.json"),
+                   _image_blob(r, users, 8, 24, (32, 32, 3), 10))
+    elif task == "mlm_bert":
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            names = [f"u{i:04d}" for i in range(users)]
+            data, counts = {}, []
+            for u in names:
+                n = int(r.integers(4, 12))
+                data[u] = {"x": r.integers(
+                    999, 29000, size=(n, 128)).tolist()}
+                counts.append(n)
+            _write(os.path.join(out, "reddit", f"{split}_tokens.json"),
+                   {"users": names, "num_samples": counts, "user_data": data})
+    else:
+        raise SystemExit(f"unknown task {task}")
+
+
+if __name__ == "__main__":
+    main()
