@@ -105,9 +105,15 @@ def _load_hdf5(path: str) -> UserBlob:
         labels_grp = fh.get("user_data_label")
         def _decode(value):
             arr = np.asarray(value)
-            if arr.dtype.kind in ("O", "S"):  # vlen strings come back bytes
+            if arr.dtype.kind == "S" or (
+                    arr.dtype.kind == "O" and arr.size and
+                    isinstance(arr.reshape(-1)[0], (bytes, str))):
+                # vlen strings come back as bytes
                 return [v.decode() if isinstance(v, bytes) else str(v)
                         for v in arr]
+            if arr.dtype.kind == "O":
+                # vlen numeric (ragged) datasets: keep per-sample arrays
+                return [np.asarray(v) for v in arr]
             return arr
 
         data: List[Any] = []
@@ -136,10 +142,22 @@ def save_user_blob_hdf5(path: str, blob: UserBlob) -> None:
     import h5py
 
     def _as_dataset_value(samples):
-        arr = np.asarray(samples)
-        if arr.dtype.kind in ("U", "O"):  # text samples -> vlen utf-8
+        try:
+            arr = np.asarray(samples)
+        except ValueError:  # ragged lengths -> object array
+            arr = np.empty(len(samples), dtype=object)
+            arr[:] = [np.asarray(s) for s in samples]
+        if arr.dtype.kind == "U" or (
+                arr.dtype.kind == "O" and len(samples) and
+                isinstance(samples[0], (str, bytes))):
+            # text samples -> vlen utf-8
             return np.asarray([str(s) for s in samples],
                               dtype=h5py.string_dtype("utf-8"))
+        if arr.dtype.kind == "O":
+            # ragged numeric samples -> vlen float64
+            return np.asarray([np.asarray(s, np.float64).reshape(-1)
+                               for s in samples],
+                              dtype=h5py.vlen_dtype(np.float64))
         return arr
 
     with h5py.File(path, "w") as fh:

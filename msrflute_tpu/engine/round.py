@@ -91,6 +91,9 @@ class RoundEngine:
         if sc.get("wantRL", False) and not strategy.supports_rl:
             raise ValueError(
                 f"{type(strategy).__name__} does not support wantRL")
+        self.dump_norm_stats = bool(config.get("dump_norm_stats",
+                                               sc.get("dump_norm_stats",
+                                                      False)))
 
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
@@ -186,8 +189,32 @@ class RoundEngine:
             })
             if self.partition_mode == "shard_map":
                 # the "harvest": one collective instead of K P2P recvs
-                return jax.lax.psum(local, CLIENTS_AXIS), privacy_per_client
-            return local, privacy_per_client
+                total = jax.lax.psum(local, CLIENTS_AXIS)
+            else:
+                total = local
+            if self.dump_norm_stats and "default" in parts:
+                # per-client PAYLOAD norm + cosine vs the aggregate
+                # direction (reference norm_stats.txt/cosines.txt dumps over
+                # client_parameters_stack — i.e. post-transform payloads —
+                # core/server.py:392-395, fedavg.py:149-152); the weighted
+                # grad SUM has the aggregate's direction, so cosines match
+                # the reference's vs-agg values exactly
+                pgs, _ = parts["default"]
+                gsum = total["parts"]["default"]["grad_sum"]
+                dots = jax.tree.map(
+                    lambda g, G: jnp.tensordot(
+                        g.reshape(g.shape[0], -1), G.reshape(-1), axes=1),
+                    pgs, gsum)
+                dot = sum(jax.tree.leaves(dots))
+                sqs = jax.tree.map(
+                    lambda g: jnp.sum(g.reshape(g.shape[0], -1) ** 2, axis=1),
+                    pgs)
+                pg_norm = jnp.sqrt(sum(jax.tree.leaves(sqs)))
+                gnorm = optax.global_norm(gsum)
+                privacy_per_client["norm"] = pg_norm
+                privacy_per_client["cosine"] = dot / jnp.maximum(
+                    pg_norm * gnorm, 1e-12)
+            return total, privacy_per_client
 
         if self.partition_mode == "shard_map":
             sharded_collect = shard_map(

@@ -21,6 +21,7 @@ jitted :class:`~msrflute_tpu.engine.round.RoundEngine` program.  Feature map:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -127,6 +128,13 @@ class OptimizationServer:
                 "opt_cfg": sc.server_replay_config.optimizer_config,
             }
 
+        # flag-gated profiling (reference server/client do_profiling flags,
+        # core/schema.py:84,233) — emits a TensorBoard-readable XLA trace
+        self._profile_dir = None
+        self._chunks_run = 0
+        if sc.get("do_profiling", False) or cc.get("do_profiling", False):
+            self._profile_dir = os.path.join(model_dir, "profile")
+
         self._eval_fn = build_eval_fn(task, self.mesh,
                                       self.engine.partition_mode)
         self._np_rng = np.random.default_rng(seed)
@@ -182,6 +190,10 @@ class OptimizationServer:
             # fusing rounds would cut the replay cadence
             print_rank("server replay forces rounds_per_step=1")
             rounds_per_step = 1
+        # which chunk to profile: the second (post-compile) when there will
+        # be more than one, else the only one
+        profile_chunk = (0 if max_iteration - self.state.round <=
+                         rounds_per_step else 1)
 
         round_no = self.state.round
         while round_no < max_iteration:
@@ -215,9 +227,19 @@ class OptimizationServer:
                 for sampled in chunk_samples]
 
             self._rng, chunk_rng = jax.random.split(self._rng)
+            # flag-gated profiling (reference cProfile hooks, SURVEY §5.1)
+            profile_this = (self._profile_dir is not None and
+                            self._chunks_run == profile_chunk)
+            if profile_this:
+                jax.profiler.start_trace(self._profile_dir)
             self.state, stats = self.engine.run_rounds(
                 self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
                 leakage_threshold=self.max_allowed_leakage)
+            if profile_this:
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+                print_rank(f"wrote profiler trace to {self._profile_dir}")
+            self._chunks_run += 1
 
             toc = time.time()
             self.run_stats["secsPerRound"].append((toc - tic) / R)
@@ -235,6 +257,8 @@ class OptimizationServer:
             self._process_privacy_stats(
                 stats, round_no,
                 client_mask=np.stack([b.client_mask for b in batches]))
+            if self.engine.dump_norm_stats and "norm" in stats:
+                self._dump_norm_stats(stats, batches)
             round_no += R
             if self.server_replay is not None:
                 self._run_server_replay()
@@ -277,6 +301,24 @@ class OptimizationServer:
                                  self.state.strategy_state, self.state.round)
         print_rank(f"server replay loss {float(tl):.4f}")
 
+    def _dump_norm_stats(self, stats, batches) -> None:
+        """Append per-round client grad norms + cosines-vs-aggregate
+        (reference ``norm_stats.txt``/``cosines.txt``,
+        ``core/server.py:392-395``, ``core/strategies/fedavg.py:149-152``)."""
+        import json as _json
+        norms = np.asarray(stats["norm"])      # [R, K]
+        cosines = np.asarray(stats["cosine"])  # [R, K]
+        masks = np.stack([b.client_mask for b in batches]) > 0
+        with open(os.path.join(self.ckpt.model_dir, "norm_stats.txt"),
+                  "a", encoding="utf-8") as fh:
+            for r in range(norms.shape[0]):
+                fh.write(_json.dumps(norms[r][masks[r]].tolist()) + "\n")
+        with open(os.path.join(self.ckpt.model_dir, "cosines.txt"),
+                  "a", encoding="utf-8") as fh:
+            for r in range(cosines.shape[0]):
+                fh.write(_json.dumps(cosines[r][masks[r]].tolist()) + "\n")
+
+    # ------------------------------------------------------------------
     def _round_housekeeping(self, round_no: int, val_freq: int,
                             rec_freq: int) -> None:
         """Eval cadence, LR plateau decay, fallback, checkpoint, status log

@@ -51,9 +51,11 @@ class BertMLMTask(BaseTask):
         self.label_smoothing = float(
             training_cfg.get("label_smoothing_factor", 0.0))
         self.mask_token_id = int(bert_cfg.get("mask_token_id", 103))
+        self._pretrained_params = None
         if path:
             self.model = FlaxBertForMaskedLM.from_pretrained(path)
             self.config = self.model.config
+            self._pretrained_params = self.model.params
         else:
             self.config = BertConfig(
                 vocab_size=int(bert_cfg.get("vocab_size", 30522)),
@@ -69,6 +71,10 @@ class BertMLMTask(BaseTask):
 
     # ------------------------------------------------------------------
     def init_params(self, rng: jax.Array):
+        if self._pretrained_params is not None:
+            # honor model_name_or_path (reference loads pretrained weights,
+            # experiments/mlm_bert/model.py:119-123)
+            return jax.tree.map(jnp.asarray, self._pretrained_params)
         dummy = jnp.ones((1, self.seq_len), jnp.int32)
         return self.model.module.init(
             {"params": rng, "dropout": rng},

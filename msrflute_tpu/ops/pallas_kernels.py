@@ -1,0 +1,136 @@
+"""Pallas TPU kernels for the DP/quantization hot ops.
+
+SURVEY.md §2.9: the reference has no native components — its NCCL/Gloo layer
+maps to XLA collectives here, and the "custom kernel" obligation lands on
+the fused elementwise passes over flattened updates.  Two kernels:
+
+- :func:`fused_gaussian_noise` — ``out = x * scale + sigma * N(0,1)`` with
+  the Gaussian generated **on-core** (pltpu PRNG + Box-Muller).  The jnp
+  path materializes a full noise array in HBM
+  (``jax.random.normal`` -> add), i.e. 3 HBM streams; the kernel reads x
+  and writes out only — the noise never touches HBM.  Used by the
+  server-side global-DP step (``privacy.apply_global_dp``).
+- :func:`quant_bin_sparsify` — histogram binning to ``n_bins`` levels +
+  magnitude sparsification in one pass (the elementwise core of
+  ``ops.quantization``; min/max/quantile stay in XLA where sort belongs).
+
+Both degrade gracefully: on non-TPU backends they run in Pallas interpret
+mode (tests) or fall back to jnp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_SUBLANES = 8
+_BLOCK_ROWS = 256  # rows of 128 lanes per grid step (128 KiB f32 blocks)
+
+
+def _pad_to_grid(flat: jnp.ndarray):
+    n = flat.shape[0]
+    per_block = _BLOCK_ROWS * _LANES
+    padded = int(np.ceil(max(n, 1) / per_block)) * per_block
+    x = jnp.zeros((padded,), flat.dtype).at[:n].set(flat)
+    return x.reshape(padded // _LANES, _LANES), n
+
+
+def _interpret_default():
+    """Off-TPU, run kernels under the TPU interpreter (which implements the
+    pltpu PRNG primitives, unlike generic interpret mode)."""
+    if jax.default_backend() == "tpu":
+        return False
+    return pltpu.InterpretParams()
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return _interpret_default()
+    if interpret is True:
+        return pltpu.InterpretParams()
+    return interpret
+
+
+# ----------------------------------------------------------------------
+def _noise_kernel(seed_ref, params_ref, x_ref, o_ref):
+    # distinct stream per grid block
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    scale = params_ref[0]
+    sigma = params_ref[1]
+    shape = x_ref.shape
+    # Box-Muller from two draws of uniform(0,1)
+    b1 = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    b2 = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    u1 = (b1 >> 8).astype(jnp.float32) * (1.0 / (1 << 24)) + 1e-12
+    u2 = (b2 >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
+    o_ref[:] = x_ref[:] * scale + sigma * normal
+
+
+def fused_gaussian_noise(flat: jnp.ndarray, scale: jnp.ndarray,
+                         sigma: jnp.ndarray, seed: jnp.ndarray,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``flat * scale + sigma * N(0,1)`` with on-core noise generation."""
+    interpret = _resolve_interpret(interpret)
+    x2d, n = _pad_to_grid(flat.astype(jnp.float32))
+    rows = x2d.shape[0]
+    grid = rows // _BLOCK_ROWS
+    out = pl.pallas_call(
+        _noise_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((_BLOCK_ROWS, _LANES),
+                                   lambda i, *_: (i, 0))],
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES),
+                                   lambda i, *_: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray([seed], jnp.int32),
+      jnp.asarray([scale, sigma], jnp.float32), x2d)
+    return out.reshape(-1)[:n].astype(flat.dtype)
+
+
+# ----------------------------------------------------------------------
+def _quant_kernel(params_ref, x_ref, o_ref, *, n_bins):
+    lo = params_ref[0]
+    hi = params_ref[1]
+    thresh = params_ref[2]
+    x = x_ref[:]
+    width = jnp.maximum((hi - lo) / max(n_bins - 1, 1), 1e-30)
+    idx = jnp.clip(jnp.round((x - lo) / width), 0, n_bins - 1)
+    binned = lo + idx * width
+    o_ref[:] = jnp.where(jnp.abs(x) > thresh, binned, 0.0)
+
+
+def quant_bin_sparsify(flat: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+                       thresh: jnp.ndarray, n_bins: int,
+                       interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused histogram binning + sub-threshold zeroing over a flat vector."""
+    interpret = _resolve_interpret(interpret)
+    x2d, n = _pad_to_grid(flat.astype(jnp.float32))
+    rows = x2d.shape[0]
+    grid = rows // _BLOCK_ROWS
+    out = pl.pallas_call(
+        functools.partial(_quant_kernel, n_bins=n_bins),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((_BLOCK_ROWS, _LANES),
+                                   lambda i, *_: (i, 0))],
+            out_specs=pl.BlockSpec((_BLOCK_ROWS, _LANES),
+                                   lambda i, *_: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray([lo, hi, thresh], jnp.float32), x2d)
+    return out.reshape(-1)[:n].astype(flat.dtype)

@@ -30,11 +30,18 @@ def quantize_array(grad: jnp.ndarray, n_bins: int,
                    min_grad: Optional[jnp.ndarray] = None,
                    max_grad: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Quantize one tensor to ``n_bins`` levels, zeroing sub-threshold
-    components (reference ``quant_bins`` + thresholding)."""
+    components (reference ``quant_bins`` + thresholding).
+
+    Stats (min/max/quantile) run in XLA; on TPU the elementwise
+    bin+sparsify pass runs as the fused Pallas kernel."""
     g = grad.astype(jnp.float32)
     lo = jnp.min(g) if min_grad is None else min_grad
     hi = jnp.max(g) if max_grad is None else max_grad
     thresh = jnp.quantile(jnp.abs(g), quant_threshold)
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import quant_bin_sparsify
+        out = quant_bin_sparsify(g.reshape(-1), lo, hi, thresh, n_bins)
+        return out.reshape(grad.shape).astype(grad.dtype)
     width = (hi - lo) / jnp.maximum(n_bins - 1, 1)
     # nearest-label rounding (== reference's half-bin-shifted bucketize)
     idx = jnp.clip(jnp.round((g - lo) / jnp.maximum(width, 1e-30)), 0, n_bins - 1)

@@ -85,12 +85,23 @@ def apply_local_dp(pseudo_grad: Any, weight: jnp.ndarray, dp_config,
 def apply_global_dp(agg_grad: Any, dp_config, rng: jax.Array,
                     num_clients: jnp.ndarray) -> Any:
     """Server-side Gaussian noise on the aggregate (reference ``:128-151``):
-    per-element std ``global_sigma * max_grad / num_clients``."""
+    per-element std ``global_sigma * max_grad / num_clients``.
+
+    On TPU this runs the fused Pallas kernel (noise generated on-core,
+    never materialized in HBM); elsewhere the jnp path.
+    """
     flat, unravel = ravel_pytree(agg_grad)
     sigma = float(dp_config.get("global_sigma", 0.0))
     max_grad = float(dp_config.get("max_grad", 1.0))
     noise_scale = sigma * max_grad / jnp.maximum(num_clients, 1.0)
-    noisy = flat + noise_scale * jax.random.normal(rng, flat.shape, flat.dtype)
+    if jax.default_backend() == "tpu":
+        from ..ops.pallas_kernels import fused_gaussian_noise
+        seed = jax.random.randint(rng, (), 0, 2**31 - 1)
+        noisy = fused_gaussian_noise(flat, jnp.asarray(1.0, flat.dtype),
+                                     noise_scale, seed)
+    else:
+        noisy = flat + noise_scale * jax.random.normal(rng, flat.shape,
+                                                       flat.dtype)
     return unravel(noisy)
 
 

@@ -84,3 +84,28 @@ def test_server_replay(synth_dataset, mesh8, tmp_path):
     assert server.server_replay is not None
     state = server.train()
     assert state.round == 2
+
+
+def test_dump_norm_stats_and_profiling(synth_dataset, mesh8, tmp_path):
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    import json, os
+    cfg = _cfg(rounds_per_step=2)
+    cfg.server_config.max_iteration = 2
+    cfg.server_config["dump_norm_stats"] = True
+    cfg.server_config.do_profiling = True
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    assert server.engine.dump_norm_stats
+    server.train()
+    norms = [json.loads(l) for l in
+             (tmp_path / "norm_stats.txt").read_text().splitlines()]
+    cosines = [json.loads(l) for l in
+               (tmp_path / "cosines.txt").read_text().splitlines()]
+    assert len(norms) == 2 and len(norms[0]) == 4  # 4 real clients/round
+    # cosines are valid cosine values and not all identical
+    flat = [c for row in cosines for c in row]
+    assert all(-1.001 <= c <= 1.001 for c in flat)
+    # do_profiling produced a trace even for a single-chunk run
+    assert (tmp_path / "profile").exists()

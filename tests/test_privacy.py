@@ -112,3 +112,28 @@ def test_dp_end_to_end_round(synth_dataset, mesh8, tmp_path):
     eps = update_privacy_accountant(cfg, num_clients=len(synth_dataset),
                                     curr_iter=1, num_clients_curr_iter=4)
     assert eps is not None and eps > 0
+
+
+def test_dp_kmeans_clusters_separated_data():
+    from msrflute_tpu.privacy.dp_kmeans import (
+        dp_kmeans, sphere_packing_initialization)
+    rng = np.random.default_rng(0)
+    # three well-separated blobs on the unit sphere scale
+    blobs = [rng.normal(loc=c, scale=0.03, size=(40, 2))
+             for c in ([0.6, 0.0], [-0.5, 0.4], [0.0, -0.7])]
+    x = np.concatenate(blobs)
+    centers, labels, n_iter = dp_kmeans(
+        x, n_clusters=3, eps=50.0, max_cluster_l2=1.0, max_iter=20, seed=1)
+    assert centers.shape == (3, 2)
+    assert n_iter <= 20
+    # high-eps DP: blob members mostly agree on a label
+    for i in range(3):
+        blk = labels[i * 40:(i + 1) * 40]
+        counts = np.bincount(blk, minlength=3)
+        assert counts.max() >= 30
+    # packing invariant: pairwise center distance >= 2a at returned radius
+    packed, a = sphere_packing_initialization(4, 3, 0.2, 1.0,
+                                              rng=np.random.default_rng(2))
+    d = np.linalg.norm(packed[:, None] - packed[None], axis=-1)
+    d[np.arange(4), np.arange(4)] = np.inf
+    assert d.min() >= 2 * a - 1e-9
