@@ -3,3 +3,4 @@ from .dataset import BaseDataset, ArraysDataset  # noqa: F401
 from .batching import (  # noqa: F401
     RoundBatch, pack_round_batches, pack_eval_batches, steps_for,
 )
+from .samplers import BatchSampler, DynamicBatchSampler  # noqa: F401

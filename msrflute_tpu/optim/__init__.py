@@ -1,3 +1,6 @@
 from .factory import (  # noqa: F401
     make_optimizer, make_lr_schedule, PlateauTracker,
 )
+from .schedulers import (  # noqa: F401
+    NBestTaskScheduler, ScheduledSamplingScheduler,
+)

@@ -1,0 +1,86 @@
+"""Auxiliary training schedulers.
+
+Parity targets:
+- :class:`ScheduledSamplingScheduler` (reference ``utils/utils.py:228-260``):
+  ramps a scheduled-sampling rate from ``initial_rate`` to ``final_rate``
+  between ``ramp_start`` and ``ramp_stop`` iterations.  Functional here:
+  instead of mutating a model attribute, :meth:`rate` returns the value for
+  an iteration and the engine passes it into the task (tasks read
+  ``batch['scheduled_sampling_rate']`` or a loss kwarg).
+- :class:`NBestTaskScheduler` (reference ``utils/utils.py:263-294``):
+  staged multi-task schedule (ASR n-best legacy) — cycles through stages of
+  ``num_tasks`` with boundaries ``iteration_per_task``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+
+class ScheduledSamplingScheduler:
+
+    def __init__(self, ramp_start: int, ramp_stop: int,
+                 initial_rate: float, final_rate: float):
+        self.ramp_start = int(ramp_start)
+        self.ramp_stop = int(ramp_stop)
+        self.initial_rate = float(initial_rate)
+        self.final_rate = float(final_rate)
+        self.iter = 0
+
+    def rate(self, iteration: int) -> float:
+        if iteration < self.ramp_start:
+            return self.initial_rate
+        if iteration <= self.ramp_stop:
+            frac = (iteration - self.ramp_start) / max(
+                self.ramp_stop - self.ramp_start, 1)
+            return self.initial_rate + (self.final_rate -
+                                        self.initial_rate) * frac
+        return self.final_rate
+
+    def step(self) -> float:
+        value = self.rate(self.iter)
+        self.iter += 1
+        return value
+
+    def state_dict(self) -> Dict:
+        return dict(self.__dict__)
+
+    def load_state_dict(self, state: Dict) -> None:
+        self.__dict__.update(state)
+
+
+class NBestTaskScheduler:
+
+    def __init__(self, num_tasks: Sequence[int],
+                 iteration_per_task: Sequence[int]):
+        if len(num_tasks) != len(iteration_per_task):
+            raise ValueError(
+                f"mismatched lengths {len(num_tasks)} != "
+                f"{len(iteration_per_task)}")
+        self.iter = 0
+        self.stagex = 0
+        self.num_tasks = list(num_tasks)
+        self.iteration_per_task = list(iteration_per_task)
+
+    def current_num_tasks(self) -> int:
+        return self.num_tasks[self.stagex]
+
+    def no_label_updates(self) -> int:
+        return (self.iter // self.iteration_per_task[-1]) + 1
+
+    def set_iteration_no(self, iter_no: int) -> None:
+        self.iter = iter_no
+
+    def step(self) -> None:
+        local_iter = self.iter % self.iteration_per_task[-1]
+        if local_iter == 0:
+            self.stagex = 0
+        elif local_iter >= self.iteration_per_task[self.stagex]:
+            self.stagex += 1
+        self.iter += 1
+
+    def state_dict(self) -> Dict:
+        return dict(self.__dict__)
+
+    def load_state_dict(self, state: Dict) -> None:
+        self.__dict__.update(state)

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+
+def test_batch_sampler_contiguous():
+    from msrflute_tpu.data.samplers import BatchSampler
+    s = BatchSampler(10, 4, randomize=False)
+    batches = list(s)
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    s2 = BatchSampler(10, 4, randomize=False, drop_last=True)
+    assert list(s2) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_dynamic_batch_sampler_budget():
+    from msrflute_tpu.data.samplers import DynamicBatchSampler
+    durations = [3.0, 1.0, 2.0, 1.0, 2.5, 0.5]
+    fps = 10.0
+    s = DynamicBatchSampler(durations, frames_threshold=40.0, fps=fps)
+    all_idx = sorted(i for b in s.batches for i in b)
+    assert all_idx == list(range(6))
+    for b in s.batches:
+        assert sum(durations[i] * fps for i in b) <= 40.0 + 1e-9
+    # sorted packing keeps similar durations together => high efficiency
+    assert s.padding_efficiency > 0.6
+    # max_batch_size respected
+    s2 = DynamicBatchSampler(durations, frames_threshold=1000.0,
+                             max_batch_size=2, fps=fps)
+    assert all(len(b) <= 2 for b in s2.batches)
+
+
+def test_scheduled_sampling_scheduler():
+    from msrflute_tpu.optim.schedulers import ScheduledSamplingScheduler
+    ss = ScheduledSamplingScheduler(ramp_start=2, ramp_stop=6,
+                                    initial_rate=0.0, final_rate=1.0)
+    rates = [ss.step() for _ in range(9)]
+    assert rates[0] == rates[1] == 0.0
+    assert rates[6] == 1.0 and rates[8] == 1.0
+    assert 0.0 < rates[3] < 1.0
+    # monotone through the ramp
+    assert rates == sorted(rates)
+    # state roundtrip
+    state = ss.state_dict()
+    ss2 = ScheduledSamplingScheduler(0, 1, 0, 0)
+    ss2.load_state_dict(state)
+    assert ss2.iter == 9
+
+
+def test_nbest_task_scheduler():
+    from msrflute_tpu.optim.schedulers import NBestTaskScheduler
+    ts = NBestTaskScheduler([1, 2], [3, 6])
+    stages = []
+    for _ in range(12):
+        stages.append(ts.current_num_tasks())
+        ts.step()
+    # the reference applies stage changes in step() AFTER the read, so
+    # transitions land one iteration late (utils/utils.py:284-294); the
+    # 6-iteration cycle then repeats
+    assert stages[:6] == [1, 1, 1, 1, 2, 2]
+    assert stages[6:12] == [2, 1, 1, 1, 2, 2]
+    assert ts.no_label_updates() == 3
+    with pytest.raises(ValueError):
+        NBestTaskScheduler([1], [1, 2])
