@@ -1,0 +1,113 @@
+"""Ring attention — sequence-parallel exact attention over a mesh axis.
+
+Net-new vs the reference (FLUTE has no long-context machinery, SURVEY.md
+§5.7); this is the TPU-native long-sequence path: shard the sequence over a
+``sequence`` mesh axis and rotate key/value blocks around the ring with
+``ppermute`` while accumulating a numerically-stable online softmax — exact
+attention with O(L/N) memory per chip and N-1 rotations total.  (The
+blockwise-computation idea follows the public ring attention literature;
+implementation is independent, in pure jax/shard_map.)
+
+Usage — on GLOBAL arrays (the function applies its own shard_map):
+
+    attn = ring_self_attention(q, k, v, mesh, axis="sequence")
+
+with q/k/v of global shape ``[B, L, H, D]`` sharded on L.  Code already
+running *inside* a shard_map body should call :func:`ring_attention_local`
+on its local chunks instead.  Causal masking uses global position ids, so
+it is correct regardless of which chunk a block lives on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQUENCE_AXIS = "sequence"
+
+
+def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
+                         q_offset, chunk: int):
+    """Online-softmax ring accumulation over local chunks.
+
+    For use INSIDE a shard_map body whose mesh has ``axis_name``: ``q`` /
+    ``k0`` / ``v0`` are this device's ``[B, L/N, H, D]`` chunks and
+    ``q_offset`` the global position of ``q``'s first row.  Performs N-1
+    ``ppermute`` rotations (the final block is accumulated without a
+    further rotation).
+    """
+    B, Lq, H, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    q_pos = q_offset + jnp.arange(Lq)
+
+    def accumulate(state, k_cur, v_cur, owner_shift):
+        m, l, acc = state
+        # the held k/v block originated at owner = idx - shift on the ring
+        owner = (idx - owner_shift) % n
+        k_pos = owner * chunk + jnp.arange(k_cur.shape[1])
+        scores = jnp.einsum("blhd,bmhd->bhlm", q, k_cur) * scale
+        if causal:
+            mask = (q_pos[:, None] >= k_pos[None, :])  # [Lq, Lk]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_blk = jnp.max(scores, axis=-1)  # [B,H,Lq]
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (all -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + \
+            jnp.einsum("bhlm,bmhd->blhd", p, v_cur)
+        return (m_new, l_new, acc_new)
+
+    def step(carry, owner_shift):
+        k_cur, v_cur, state = carry
+        state = accumulate(state, k_cur, v_cur, owner_shift)
+        # rotate k/v to the next device on the ring
+        rotation = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, rotation)
+        v_next = jax.lax.ppermute(v_cur, axis_name, rotation)
+        return (k_next, v_next, state), None
+
+    state0 = (jnp.full((B, H, Lq), -jnp.inf, q.dtype),
+              jnp.zeros((B, H, Lq), q.dtype),
+              jnp.zeros_like(q))
+    # n-1 rotating steps, then the final block without a dead rotation
+    (k_last, v_last, state), _ = jax.lax.scan(
+        step, (k0, v0, state0), jnp.arange(n - 1))
+    m, l, acc = accumulate(state, k_last, v_last, n - 1)
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return acc / denom
+
+
+def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                        causal: bool = False) -> jnp.ndarray:
+    """Exact attention with GLOBAL q/k/v ``[B, L, H, D]`` sharded on L over
+    ``axis``.  Returns the output with the same sharding.  Must be called
+    outside shard_map (it applies its own); inside a shard_map body use
+    :func:`ring_attention_local`."""
+    n = mesh.shape[axis]
+    L = q.shape[1]
+    if k.shape[1] != L or v.shape[1] != L:
+        raise ValueError(
+            f"q/k/v sequence lengths differ: {L}, {k.shape[1]}, {v.shape[1]}")
+    if L % n:
+        raise ValueError(f"sequence length {L} not divisible by {axis}={n}")
+    chunk = L // n
+    spec = P(None, axis, None, None)
+
+    def body(q_l, k_l, v_l):
+        idx = jax.lax.axis_index(axis)
+        q_offset = idx * chunk
+        return ring_attention_local(q_l, k_l, v_l, axis, causal, q_offset,
+                                    chunk)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)

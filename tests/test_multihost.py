@@ -1,0 +1,96 @@
+"""Multi-host federated round: two jax.distributed processes, one global
+mesh — the DCN-scaling analogue of FLUTE's multi-node
+``torch.distributed.run`` rendezvous (``README.md:80-87``).
+
+Each process owns 4 virtual CPU devices; ``jax.distributed.initialize``
+glues them into a global 8-device ``clients`` mesh; the round program's
+psum crosses the process boundary exactly the way it crosses DCN on a
+multi-host TPU slice.  Both controllers must end with identical params.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2, process_id=int(sys.argv[2]))
+assert jax.device_count() == 8, jax.device_count()
+assert jax.process_count() == 2
+
+sys.path.insert(0, {repo!r})
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset, pack_round_batches
+from msrflute_tpu.engine.round import RoundEngine
+from msrflute_tpu.models import make_task
+from msrflute_tpu.parallel import make_mesh
+from msrflute_tpu.strategies import select_strategy
+
+cfg = FLUTEConfig.from_dict({{
+    "model_config": {{"model_type": "LR", "num_classes": 3, "input_dim": 6}},
+    "strategy": "fedavg",
+    "server_config": {{"max_iteration": 1, "num_clients_per_iteration": 8,
+                      "optimizer_config": {{"type": "sgd", "lr": 1.0}}}},
+    "client_config": {{"optimizer_config": {{"type": "sgd", "lr": 0.2}},
+                      "data_config": {{"train": {{"batch_size": 4}}}}}},
+}})
+rng = np.random.default_rng(0)
+users = [f"u{{i}}" for i in range(8)]
+per_user = [{{"x": rng.normal(size=(8, 6)).astype(np.float32),
+             "y": rng.integers(0, 3, 8).astype(np.int32)}} for _ in users]
+ds = ArraysDataset(users, per_user)
+
+mesh = make_mesh()  # spans both processes: 8 global devices
+task = make_task(cfg.model_config)
+engine = RoundEngine(task, cfg, select_strategy("fedavg")(cfg, None), mesh)
+state = engine.init_state(jax.random.PRNGKey(0))
+batch = pack_round_batches(ds, list(range(8)), 4, 2,
+                           rng=np.random.default_rng(1), pad_clients_to=8)
+state, stats = engine.run_round(state, batch, 0.2, 1.0, jax.random.PRNGKey(2))
+leaves = jax.tree.leaves(jax.device_get(state.params))  # replicated
+checksum = float(sum(np.abs(l).sum() for l in leaves))
+print(f"CHECKSUM {{checksum:.10f}} round {{state.round}}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_round(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO))
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PALLAS_AXON_POOL_IPS": ""})
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, str(i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err[-3000:]
+        outs.append(out)
+    sums = [line.split()[1] for out in outs for line in out.splitlines()
+            if line.startswith("CHECKSUM")]
+    assert len(sums) == 2
+    assert sums[0] == sums[1], f"processes disagree: {sums}"
+    assert float(sums[0]) > 0
