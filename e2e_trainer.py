@@ -64,6 +64,25 @@ def main() -> None:
                f"val={len(val_ds) if val_ds else 0} "
                f"test={len(test_ds) if test_ds else 0}")
 
+    # experiment properties at startup (reference log_run_properties,
+    # e2e_trainer.py:40-74 — AzureML run properties become metrics.jsonl)
+    from msrflute_tpu.utils import log_metric
+    log_metric("run_properties", {
+        "task": cfg.task,
+        "model_type": cfg.model_config.get("model_type"),
+        "strategy": cfg.strategy,
+        "max_iteration": cfg.server_config.get("max_iteration"),
+        "num_clients_per_iteration":
+            cfg.server_config.get("num_clients_per_iteration"),
+        "initial_lr_client": cfg.server_config.get("initial_lr_client"),
+        "server_optimizer": cfg.server_config.optimizer_config.get("type"),
+        "client_optimizer": cfg.client_config.optimizer_config.get("type"),
+        "num_users": len(train_ds),
+        "dp_enabled": bool(cfg.dp_config and
+                           (cfg.dp_config.get("enable_local_dp") or
+                            cfg.dp_config.get("enable_global_dp"))),
+    })
+
     mesh = make_mesh(model_axis_size=int(cfg.mesh_config.get("model_axis_size", 1)))
     server_cls = select_server(cfg.server_config.get("type", "optimization"))
     server = server_cls(task, cfg, train_ds, val_dataset=val_ds,

@@ -130,6 +130,11 @@ class PersonalizationServer(OptimizationServer):
 
     def _round_housekeeping(self, round_no, val_freq, rec_freq):
         super()._round_housekeeping(round_no, val_freq, rec_freq)
+        # personalized eval: convex logit interpolation over users with
+        # local state (reference convex_inference during run_testvalidate,
+        # core/client.py:167-183)
+        if round_no % val_freq == 0 and self.val_dataset is not None:
+            self.personalized_accuracy(self.val_dataset)
         # persist ONLY the users updated this round (reference writes
         # <user>_model.tar per processed client, core/client.py:408-443)
         self.store.save()

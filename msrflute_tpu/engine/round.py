@@ -137,14 +137,16 @@ class RoundEngine:
         rspec = P()
 
         def shard_body(params, arrays, sample_mask, client_mask, client_ids,
-                       client_lr, round_idx, leakage_threshold, rng):
+                       client_lr, round_idx, leakage_threshold,
+                       quant_threshold, rng):
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
                 rng_c = jax.random.fold_in(rng, cid_c)
                 parts, tl, ns, stats = strategy.client_step(
                     client_update, params, arr_c, mask_c, client_lr, rng_c,
-                    round_idx=round_idx, leakage_threshold=leakage_threshold)
+                    round_idx=round_idx, leakage_threshold=leakage_threshold,
+                    quant_threshold=quant_threshold)
                 parts = {name: (tree, w * cm_c)
                          for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
@@ -220,7 +222,7 @@ class RoundEngine:
             sharded_collect = shard_map(
                 shard_body, mesh=mesh,
                 in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec,
-                          rspec, rspec),
+                          rspec, rspec, rspec),
                 out_specs=(rspec, cspec), check_vma=False)
         else:
             # GSPMD mode: plain jit — client data stays sharded on the
@@ -231,10 +233,11 @@ class RoundEngine:
 
         def round_step(params, opt_state, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, server_lr,
-                       round_idx, leakage_threshold, rng):
+                       round_idx, leakage_threshold, quant_threshold, rng):
             collected, privacy_per_client = sharded_collect(
                 params, arrays, sample_mask, client_mask, client_ids,
-                client_lr, round_idx, leakage_threshold, rng)
+                client_lr, round_idx, leakage_threshold, quant_threshold,
+                rng)
             part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:
@@ -292,18 +295,18 @@ class RoundEngine:
 
         def multi(params, opt_state, strategy_state, arrays, sample_mask,
                   client_mask, client_ids, client_lrs, server_lrs,
-                  round_idxs, leakage_threshold, rngs):
+                  round_idxs, leakage_threshold, quant_thresholds, rngs):
             def body(carry, xs):
                 p, o, s = carry
-                arr, sm, cm, cid, clr, slr, ridx, rng = xs
+                arr, sm, cm, cid, clr, slr, ridx, qt, rng = xs
                 p, o, s, stats = core(p, o, s, arr, sm, cm, cid, clr, slr,
-                                      ridx, leakage_threshold, rng)
+                                      ridx, leakage_threshold, qt, rng)
                 return (p, o, s), stats
 
             (p, o, s), stats = jax.lax.scan(
                 body, (params, opt_state, strategy_state),
                 (arrays, sample_mask, client_mask, client_ids,
-                 client_lrs, server_lrs, round_idxs, rngs))
+                 client_lrs, server_lrs, round_idxs, quant_thresholds, rngs))
             return p, o, s, stats
 
         fn = jax.jit(multi, donate_argnums=(0, 1, 2))
@@ -385,7 +388,8 @@ class RoundEngine:
     def run_round(self, state: ServerState, batch: RoundBatch,
                   client_lr: float, server_lr: float,
                   rng: jax.Array,
-                  leakage_threshold: Optional[float] = None
+                  leakage_threshold: Optional[float] = None,
+                  quant_threshold: Optional[float] = None
                   ) -> Tuple[ServerState, Dict[str, float]]:
         """Stage one round's data onto the mesh and execute the program."""
         arrays = {k: jax.device_put(v, self._client_sharding)
@@ -401,7 +405,9 @@ class RoundEngine:
             jnp.asarray(server_lr, jnp.float32),
             jnp.asarray(state.round, jnp.int32),
             jnp.asarray(leakage_threshold if leakage_threshold is not None
-                        else jnp.inf, jnp.float32), rng)
+                        else jnp.inf, jnp.float32),
+            jnp.asarray(quant_threshold if quant_threshold is not None
+                        else -1.0, jnp.float32), rng)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
         return new_state, stats
@@ -410,7 +416,8 @@ class RoundEngine:
     def run_rounds(self, state: ServerState, batches: list,
                    client_lrs: list, server_lrs: list,
                    rng: jax.Array,
-                   leakage_threshold: Optional[float] = None
+                   leakage_threshold: Optional[float] = None,
+                   quant_thresholds: Optional[list] = None
                    ) -> Tuple[ServerState, Dict[str, np.ndarray]]:
         """Run ``len(batches)`` rounds in ONE device program (scan).
 
@@ -420,7 +427,9 @@ class RoundEngine:
         if R == 1:
             new_state, stats = self.run_round(
                 state, batches[0], client_lrs[0], server_lrs[0], rng,
-                leakage_threshold=leakage_threshold)
+                leakage_threshold=leakage_threshold,
+                quant_threshold=(quant_thresholds[0] if quant_thresholds
+                                 else None))
             return new_state, {k: np.asarray([v]) for k, v in
                                jax.device_get(stats).items()}
         stacked_sharding = NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
@@ -443,7 +452,9 @@ class RoundEngine:
             jnp.asarray(server_lrs, jnp.float32),
             jnp.arange(state.round, state.round + R, dtype=jnp.int32),
             jnp.asarray(leakage_threshold if leakage_threshold is not None
-                        else jnp.inf, jnp.float32), rngs)
+                        else jnp.inf, jnp.float32),
+            jnp.asarray(quant_thresholds if quant_thresholds is not None
+                        else [-1.0] * R, jnp.float32), rngs)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
         return new_state, jax.device_get(stats)

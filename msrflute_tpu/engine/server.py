@@ -128,6 +128,10 @@ class OptimizationServer:
                 "opt_cfg": sc.server_replay_config.optimizer_config,
             }
 
+        # quantization threshold annealing (reference core/server.py:294-298)
+        self.quant_thresh = cc.get("quant_thresh") or             config.model_config.get("quant_threshold")
+        self.quant_anneal = float(cc.get("quant_anneal", 1.0) or 1.0)
+
         # flag-gated profiling (reference server/client do_profiling flags,
         # core/schema.py:84,233) — emits a TensorBoard-readable XLA trace
         self._profile_dir = None
@@ -232,9 +236,20 @@ class OptimizationServer:
                             self._chunks_run == profile_chunk)
             if profile_this:
                 jax.profiler.start_trace(self._profile_dir)
+            quant_thresholds = None
+            if self.quant_thresh is not None:
+                # per-round annealed thresholds (core/server.py:294-298),
+                # each logged at its own round like the reference
+                quant_thresholds = []
+                for j in range(R):
+                    self.quant_thresh *= self.quant_anneal
+                    quant_thresholds.append(self.quant_thresh)
+                    log_metric("Quantization Thresh.", self.quant_thresh,
+                               step=round_no + j)
             self.state, stats = self.engine.run_rounds(
                 self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
-                leakage_threshold=self.max_allowed_leakage)
+                leakage_threshold=self.max_allowed_leakage,
+                quant_thresholds=quant_thresholds)
             if profile_this:
                 jax.block_until_ready(self.state.params)
                 jax.profiler.stop_trace()

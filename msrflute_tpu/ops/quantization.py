@@ -58,11 +58,11 @@ def quantize_pytree(tree: Any, quant_threshold: Optional[float],
     n_bins = 2 ** int(quant_bits)
     if not global_stats:
         return jax.tree.map(
-            lambda g: quantize_array(g, n_bins, float(quant_threshold)), tree)
+            lambda g: quantize_array(g, n_bins, quant_threshold), tree)
     from jax.flatten_util import ravel_pytree
     flat, unravel = ravel_pytree(tree)
     lo, hi = jnp.min(flat), jnp.max(flat)
-    thresh = jnp.quantile(jnp.abs(flat), float(quant_threshold))
+    thresh = jnp.quantile(jnp.abs(flat), quant_threshold)
     width = (hi - lo) / jnp.maximum(n_bins - 1, 1)
     idx = jnp.clip(jnp.round((flat - lo) / jnp.maximum(width, 1e-30)), 0, n_bins - 1)
     binned = lo + idx * width

@@ -46,6 +46,87 @@ def add_gaussian_noise(flat: jnp.ndarray, eps: float, max_sensitivity: float,
     return flat + sigma * jax.random.normal(rng, flat.shape, flat.dtype), sigma
 
 
+# ---------------------------------------------------------------------
+# "unused extras" kept for parity (reference :51-102): alternative local
+# mechanisms — the d-sphere PrivateUnit2 sampler, discrete scalar DP and
+# Laplace noise.  Host-side numpy like the reference.
+
+def privacy_parameters(eps0: float, eps: float, d: int):
+    """Split epsilons into (sampling prob, gamma) for PrivateUnit2
+    (reference ``:37-48``)."""
+    exp_eps0 = np.exp(eps0)
+    exp_eps = np.exp(eps)
+    p0 = 1.0 if np.isinf(exp_eps0) else exp_eps0 / (1 + exp_eps0)
+    base = np.sqrt(np.pi / (2 * (d - 1)))
+    gamma = base if np.isinf(exp_eps) else \
+        ((exp_eps - 1) / (exp_eps + 1)) * base
+    return p0, gamma
+
+
+def private_unit2(grad: np.ndarray, gamma: float, prob: float,
+                  rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """d-sphere mechanism for a unit vector (reference ``:51-66``):
+    rejection-sample a unit direction correlated with ``grad`` w.p.
+    ``prob``, anti-correlated otherwise, unbiased via the 1/m factor."""
+    from scipy.special import betainc, betaln
+    rng = rng if rng is not None else np.random.default_rng()
+    grad = np.asarray(grad, np.float64)
+    assert abs(np.linalg.norm(grad) - 1.0) < 1e-4
+    assert prob >= 0.5 and 0.0 <= gamma <= 1.0
+    p = rng.random()
+    while True:
+        v = rng.normal(size=grad.shape)
+        v /= np.linalg.norm(v)
+        dot = float(v @ grad)
+        if (dot >= gamma and p < prob) or (dot < gamma and p >= prob):
+            break
+    d = grad.shape[0]
+    alpha = (d - 1) / 2
+    tau = (1 + gamma) / 2
+    ratio = 1.0 / betainc(alpha, alpha, tau)
+    log_m1 = alpha * np.log(1 - gamma ** 2) - (d - 2) * np.log(2) - \
+        np.log(d - 1)
+    log_m2 = (np.log(prob / (ratio - 1) - (1 - prob)) + np.log(ratio) -
+              betaln(alpha, alpha))
+    m = np.exp(log_m1 + log_m2)
+    return v / m
+
+
+def add_private_unit2_noise(eps: float, grad: np.ndarray,
+                            rng: Optional[np.random.Generator] = None):
+    """Reference ``:75-79``: split eps 1%/99% between sampling and gamma."""
+    p0, gamma = privacy_parameters(0.01 * eps, 0.99 * eps, grad.shape[0])
+    return private_unit2(grad, gamma, p0, rng)
+
+
+def scalar_dp(r: float, eps: float, k: int, r_max: float,
+              rng: Optional[np.random.Generator] = None) -> float:
+    """Discrete scalar DP mechanism (reference ``scalar_DP``, ``:82-98``):
+    stochastic rounding to k levels + randomized response, debiased."""
+    rng = rng if rng is not None else np.random.default_rng()
+    r = min(r, r_max)
+    val = k * r / r_max
+    f_val, c_val = math.floor(val), math.ceil(val)
+    j = f_val if rng.random() < (c_val - val) else c_val
+    exp_eps = np.exp(eps)
+    if rng.random() >= exp_eps / (exp_eps + k):
+        while True:
+            j_new = int(rng.integers(0, k + 1))
+            if j_new != j:
+                j = j_new
+                break
+    a = ((exp_eps + k) / (exp_eps - 1)) * (r_max / k)
+    b = (k * (k + 1)) / (2 * (exp_eps + k))
+    return float(a * (j - b))
+
+
+def laplace_noise(max_sens: float, eps: float, size: int,
+                  rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Reference ``laplace_noise`` (``:101-102``)."""
+    rng = rng if rng is not None else np.random.default_rng()
+    return rng.laplace(0.0, max_sens / eps, size)
+
+
 def apply_local_dp(pseudo_grad: Any, weight: jnp.ndarray, dp_config,
                    add_weight_noise: bool, rng: jax.Array
                    ) -> Tuple[Any, jnp.ndarray]:

@@ -70,7 +70,8 @@ class BaseStrategy:
 
     # ---- traced, per-client (inside vmap) ----------------------------
     def client_step(self, client_update, global_params, arrays, sample_mask,
-                    client_lr, rng, round_idx=None, leakage_threshold=None):
+                    client_lr, rng, round_idx=None, leakage_threshold=None,
+                    quant_threshold=None):
         """Run one client's local work and emit weighted payload parts.
 
         Returns ``(parts, train_loss, num_samples, stats)`` where ``parts``
@@ -87,7 +88,8 @@ class BaseStrategy:
         w = self._apply_privacy_metrics(
             pg, w, stats, global_params, arrays, sample_mask,
             leakage_threshold)
-        pg, w = self.transform_payload(pg, w, jax.random.fold_in(rng, 2))
+        pg, w = self.transform_payload(pg, w, jax.random.fold_in(rng, 2),
+                                       quant_threshold=quant_threshold)
         return {"default": (pg, w)}, tl, ns, stats
 
     def _apply_privacy_metrics(self, pg, weight, stats, global_params,
@@ -145,7 +147,8 @@ class BaseStrategy:
         raise NotImplementedError
 
     def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
-                          rng: jax.Array) -> Tuple[Any, jnp.ndarray]:
+                          rng: jax.Array,
+                          quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
         return pseudo_grad, weight
 
     # ---- traced, post-psum (replicated) ------------------------------

@@ -40,9 +40,13 @@ class DGA(BaseStrategy):
         self.stale_prob = float(sc.get("stale_prob", 0.0))
         cc = config.client_config
         mc = config.model_config
-        self.quant_threshold = (mc.get("quant_threshold")
-                                if mc is not None else None)
-        self.quant_bits = int(mc.get("quant_bits", 10)) if mc is not None else 10
+        self.quant_threshold = cc.get("quant_thresh")
+        if self.quant_threshold is None and mc is not None:
+            self.quant_threshold = mc.get("quant_threshold")
+        bits = cc.get("quant_bits")
+        if bits is None and mc is not None:
+            bits = mc.get("quant_bits")
+        self.quant_bits = int(bits) if bits is not None else 10
 
     def client_weight(self, *, num_samples, train_loss, stats, rng):
         if self.aggregate_median == "softmax":
@@ -60,7 +64,8 @@ class DGA(BaseStrategy):
         return filter_weight(weight)
 
     def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
-                          rng: jax.Array) -> Tuple[Any, jnp.ndarray]:
+                          rng: jax.Array,
+                          quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
         dp_rng, _ = jax.random.split(rng)
         if self.dp_config is not None and self.dp_config.get("enable_local_dp", False):
             from ..privacy import apply_local_dp
@@ -69,9 +74,15 @@ class DGA(BaseStrategy):
                 add_weight_noise=(self.aggregate_median == "softmax"), rng=dp_rng)
         if self.quant_threshold is not None:
             from ..ops.quantization import quantize_pytree
+            # the threshold may be annealed per round (reference
+            # core/server.py:294-298): a dynamic scalar overrides the
+            # static config value when >= 0
+            thr = (quant_threshold if quant_threshold is not None
+                   else float(self.quant_threshold))
+            thr = jnp.where(jnp.asarray(thr) >= 0, thr,
+                            float(self.quant_threshold))
             pseudo_grad = quantize_pytree(
-                pseudo_grad, quant_threshold=float(self.quant_threshold),
-                quant_bits=self.quant_bits)
+                pseudo_grad, quant_threshold=thr, quant_bits=self.quant_bits)
         return pseudo_grad, weight
 
     # ---- staleness buffer (replaces dga.py:260-284 host lists) --------

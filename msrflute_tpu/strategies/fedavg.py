@@ -24,7 +24,8 @@ class FedAvg(BaseStrategy):
         return filter_weight(num_samples)
 
     def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
-                          rng: jax.Array) -> Tuple[Any, jnp.ndarray]:
+                          rng: jax.Array,
+                          quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
         if self.dp_config is not None and self.dp_config.get("enable_local_dp", False):
             from ..privacy import apply_local_dp
             pseudo_grad, weight = apply_local_dp(

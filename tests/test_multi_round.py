@@ -109,3 +109,35 @@ def test_dump_norm_stats_and_profiling(synth_dataset, mesh8, tmp_path):
     assert all(-1.001 <= c <= 1.001 for c in flat)
     # do_profiling produced a trace even for a single-chunk run
     assert (tmp_path / "profile").exists()
+
+
+def test_quant_threshold_annealing(synth_dataset, mesh8, tmp_path):
+    """Quantization threshold anneals per round (reference
+    core/server.py:294-298) and flows into the jitted round as a dynamic
+    scalar."""
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4, "input_dim": 8},
+        "strategy": "dga",
+        "server_config": {
+            "max_iteration": 4, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.2, "rounds_per_step": 2,
+            "aggregate_median": "softmax", "softmax_beta": 1.0,
+            "weight_train_loss": "train_loss",
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 100, "initial_val": False, "data_config": {}},
+        "client_config": {
+            "quant_thresh": 0.8, "quant_anneal": 0.5, "quant_bits": 6,
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    assert server.quant_thresh == 0.8
+    state = server.train()
+    assert state.round == 4
+    # annealed 4 times: 0.8 * 0.5^4
+    assert abs(server.quant_thresh - 0.8 * 0.5 ** 4) < 1e-9

@@ -137,3 +137,24 @@ def test_dp_kmeans_clusters_separated_data():
     d = np.linalg.norm(packed[:, None] - packed[None], axis=-1)
     d[np.arange(4), np.arange(4)] = np.inf
     assert d.min() >= 2 * a - 1e-9
+
+
+def test_privacy_extras():
+    """The reference's 'unused extras' mechanisms (extensions/privacy
+    __init__.py:51-102) exist and behave sanely."""
+    from msrflute_tpu.privacy import (
+        add_private_unit2_noise, laplace_noise, privacy_parameters,
+        scalar_dp)
+    rng = np.random.default_rng(0)
+    g = rng.normal(size=32)
+    g /= np.linalg.norm(g)
+    out = add_private_unit2_noise(8.0, g, rng=rng)
+    assert out.shape == g.shape and np.isfinite(out).all()
+    # scalar mechanism is approximately unbiased for high eps
+    vals = [scalar_dp(0.7, 50.0, 16, 1.0, rng=np.random.default_rng(i))
+            for i in range(300)]
+    assert abs(np.mean(vals) - 0.7) < 0.05
+    lap = laplace_noise(1.0, 2.0, 1000, rng=rng)
+    assert abs(np.mean(np.abs(lap)) - 0.5) < 0.1  # E|Lap(b)| = b
+    p0, gamma = privacy_parameters(0.1, 4.0, 64)
+    assert 0.5 <= p0 <= 1.0 and 0.0 <= gamma <= 1.0
