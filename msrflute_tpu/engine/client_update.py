@@ -53,6 +53,10 @@ class ClientHParams:
     num_epochs: int = 1                         # local epochs per round
     stats_on_smooth_grad: bool = True           # dga.py:104-108
     freeze_layers: Tuple[str, ...] = ()         # core/client.py:306-307
+    #: regex allowlist — when set, ONLY matching layers move; the rest are
+    #: frozen at every inner step, like the reference's per-param lr=0
+    #: (set_component_wise_lr, core/trainer.py:725-751)
+    updatable_layers: Optional[Tuple[str, ...]] = None
 
 
 def _global_norm(tree: Any) -> jnp.ndarray:
@@ -100,11 +104,34 @@ def build_client_update(task: BaseTask, client_opt_cfg,
     tx = make_optimizer(client_opt_cfg)
     freeze = hparams.freeze_layers
 
+    def _updatable_mask(params):
+        """0/1 per-leaf mask from the updatable_layers regex allowlist
+        (names are '.'-joined like torch's named_parameters; patterns are
+        start-anchored via re.match, matching the reference)."""
+        import logging
+        import re
+
+        from ..utils.logging import print_rank
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        masks = []
+        for path, leaf in flat:
+            name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            keep = any(re.match(pat, name)
+                       for pat in hparams.updatable_layers)
+            print_rank(("updating " if keep else "freezing ") + name,
+                       loglevel=logging.DEBUG)
+            masks.append(jnp.ones_like(leaf) if keep
+                         else jnp.zeros_like(leaf))
+        return jax.tree_util.tree_unflatten(treedef, masks)
+
     def client_update(global_params, arrays: Dict[str, jnp.ndarray],
                       sample_mask: jnp.ndarray, lr: jnp.ndarray,
                       rng: jax.Array):
         opt_state = tx.init(global_params)
         opt_state.hyperparams["learning_rate"] = lr
+        update_mask = (_updatable_mask(global_params)
+                       if hparams.updatable_layers is not None else None)
 
         def one_step(carry, xs):
             params, opt_state, rng, loss_sum, s, s2, n_acc = carry
@@ -128,6 +155,12 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             n_acc = n_acc + has_data * dn
             loss_sum = loss_sum + has_data * loss
             updates, new_opt = tx.update(grads, opt_state, params)
+            if update_mask is not None:
+                # frozen layers never move at ANY inner step (the per-param
+                # lr=0 semantics of the reference; momentum state still
+                # accumulates, exactly like torch SGD with lr=0)
+                updates = jax.tree.map(lambda u, m: u * m, updates,
+                                       update_mask)
             new_params = optax.apply_updates(params, updates)
             # all-padding steps must be no-ops (momentum included)
             params = jax.tree.map(

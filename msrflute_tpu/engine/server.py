@@ -126,6 +126,10 @@ class OptimizationServer:
                 "iterations": int(sc.server_replay_config.get(
                     "server_iterations", 1)),
                 "opt_cfg": sc.server_replay_config.optimizer_config,
+                # regex allowlist of layers to update during replay
+                # (reference set_component_wise_lr, core/trainer.py:725-751)
+                "updatable_names": sc.server_replay_config.get(
+                    "updatable_names"),
             }
 
         # quantization threshold annealing (reference core/server.py:294-298)
@@ -289,7 +293,10 @@ class OptimizationServer:
             from ..data.dataset import ArraysDataset
             from .client_update import ClientHParams, build_client_update
             replay = self.server_replay
-            hp = ClientHParams(num_epochs=replay["iterations"])
+            updatable = replay.get("updatable_names")
+            hp = ClientHParams(
+                num_epochs=replay["iterations"],
+                updatable_layers=tuple(updatable) if updatable else None)
             self._replay_update = build_client_update(
                 self.task, replay["opt_cfg"], hp)
             merged = ArraysDataset.concat_users(replay["dataset"])

@@ -26,11 +26,36 @@ def register_task(name: str):
     return deco
 
 
+def _apply_plugin_config(model_config, folder: str) -> None:
+    """Model-specific config discovery (reference ``core/config.py:100-116``):
+    a ``config.py`` in the model folder may define ``<model_type>Config``
+    whose attributes/defaults are merged into the model config (explicit
+    YAML keys win)."""
+    cfg_path = os.path.join(folder, "config.py")
+    if not os.path.exists(cfg_path):
+        return
+    spec = importlib.util.spec_from_file_location("flute_tpu_plugin_cfg",
+                                                  cfg_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    cls = getattr(mod, model_config.get("model_type", "LR") + "Config", None)
+    if cls is None:
+        return
+    defaults = getattr(cls, "defaults", None)
+    if defaults is None:
+        defaults = {k: v for k, v in vars(cls).items()
+                    if not k.startswith("_") and not callable(v)}
+    for key, value in defaults.items():
+        if model_config.get(key) is None:
+            model_config[key] = value
+
+
 def make_task(model_config) -> BaseTask:
     """Instantiate the task named by ``model_config.model_type``."""
     model_type = model_config.get("model_type", "LR")
     folder = model_config.get("model_folder")
     if folder:
+        _apply_plugin_config(model_config, folder)
         plugin = os.path.join(folder, "task.py")
         if os.path.exists(plugin):
             spec = importlib.util.spec_from_file_location("flute_tpu_plugin", plugin)

@@ -1,0 +1,93 @@
+"""Plugin loading (task.py + config.py discovery), n-best writers, and
+server-replay layer freezing."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_model_folder_plugin_with_config_discovery(tmp_path):
+    """A model_folder with task.py + config.py (<model_type>Config defaults)
+    loads like the reference's dynamic experiments/ plugins
+    (experiments/__init__.py:8-43, core/config.py:100-116)."""
+    (tmp_path / "config.py").write_text(
+        "class MYLRConfig:\n"
+        "    defaults = {'num_classes': 7, 'input_dim': 5}\n")
+    (tmp_path / "task.py").write_text(
+        "from msrflute_tpu.models.cv import make_lr_task\n"
+        "def make_task(model_config):\n"
+        "    assert model_config.get('num_classes') == 7\n"
+        "    assert model_config.get('input_dim') == 3  # YAML wins\n"
+        "    return make_lr_task(model_config)\n")
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    cfg = ModelConfig(model_type="MYLR", model_folder=str(tmp_path),
+                      extra={"input_dim": 3})
+    task = make_task(cfg)
+    assert task.num_classes == 7  # discovered default applied
+
+
+def test_write_nbest_jsonl(tmp_path):
+    from msrflute_tpu.utils.nbest import softmax, write_nbest_jsonl
+    out = tmp_path / "nbest.jsonl"
+    uttid2jsonl = {"u1": {"wav": "/org/u1.wav", "dur": 1.0},
+                   "u2": {"wav": "/org/u2.wav", "dur": 2.0},
+                   "u3": {"wav": "/org/u3.wav", "dur": 3.0}}
+    hypos = {"u1": [["hello", "world"], ["hallo", "world"]],
+             "u2": [["good", "day"]],  # missing 2nd best -> backfilled
+             }  # u3 missing entirely -> skipped with a warning
+    scores = {"u1": np.array([0.1, -0.5]), "u2": np.array([0.2])}
+    assert write_nbest_jsonl(uttid2jsonl, hypos, scores, str(out), nbest=2,
+                             orgpath="/org", newpath="/new")
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(recs) == 4  # 2 utts x 2 best
+    assert recs[0]["id"] == "u1-0" and recs[0]["text"] == "hello world"
+    assert recs[0]["wav"].startswith("/new/")
+    w = softmax(np.array([0.1, -0.5]))
+    assert recs[0]["loss_weight"] == pytest.approx(w[0])
+    # backfilled 2nd best repeats the 1-best text
+    assert recs[3]["id"] == "u2-1" and recs[3]["text"] == "good day"
+
+
+def test_server_replay_updatable_names(synth_dataset, mesh8, tmp_path):
+    """Replay with updatable_names only moves matching layers (reference
+    set_component_wise_lr freezing, core/trainer.py:725-751)."""
+    import jax
+    from msrflute_tpu.config import (FLUTEConfig, OptimizerConfig,
+                                     ServerReplayConfig)
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4, "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 1, "num_clients_per_iteration": 2,
+            "initial_lr_client": 0.0,  # no federated movement
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 100, "initial_val": False, "data_config": {}},
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.0},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+    replay = ServerReplayConfig(
+        server_iterations=2,
+        optimizer_config=OptimizerConfig(type="sgd", lr=0.5))
+    # start-anchored match like the reference's re.match: the pattern must
+    # cover the layer prefix ('.'-joined names, e.g. Dense_0.kernel)
+    replay.extra["updatable_names"] = [r".*\.kernel"]  # freeze bias
+    cfg.server_config.server_replay_config = replay
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                server_train_dataset=synth_dataset,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    before = jax.device_get(server.state.params)
+    server.train()
+    after = jax.device_get(server.state.params)
+    kernel_moved = np.abs(after["Dense_0"]["kernel"] -
+                          before["Dense_0"]["kernel"]).max()
+    bias_moved = np.abs(after["Dense_0"]["bias"] -
+                        before["Dense_0"]["bias"]).max()
+    assert kernel_moved > 0
+    assert bias_moved == 0.0  # frozen by updatable_names
