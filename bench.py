@@ -61,11 +61,14 @@ def main() -> None:
     })
 
     rng = np.random.default_rng(0)
-    # only materialize a pool of users large enough to sample rounds from
+    # only materialize a pool of users large enough to sample rounds from;
+    # images stay uint8 on the host (like real FEMNIST pixels) and are cast
+    # to f32 on device — 4x less host->device traffic per round
     pool = 64
     users, per_user = [], []
     for u in range(pool):
-        x = rng.normal(size=(samples_per_user, 28, 28, 1)).astype(np.float32)
+        x = rng.integers(0, 256, size=(samples_per_user, 28, 28, 1),
+                         dtype=np.uint8)
         y = rng.integers(0, 62, size=(samples_per_user,)).astype(np.int32)
         users.append(f"u{u:04d}")
         per_user.append({"x": x, "y": y})

@@ -68,14 +68,23 @@ def encode_words(text_or_tokens, vocab: Dict[str, int], seq_len: int,
 
 
 def to_image(x: np.ndarray, example_shape: Sequence[int]) -> np.ndarray:
-    """Reshape flat/CHW samples to the task's HWC example shape."""
-    x = np.asarray(x, np.float32)
+    """Reshape flat/CHW/HW samples to the task's example shape.
+
+    Dtype-preserving: uint8 pixels stay uint8 (models normalize on device,
+    see ``models.base.to_float_image``); anything else becomes float32.
+    """
+    x = np.asarray(x)
+    if x.dtype != np.uint8:
+        if x.dtype.kind in "iu" and x.size and 0 <= x.min() and x.max() <= 255:
+            # integer pixel values (json round-trips uint8 as int64):
+            # keep them as bytes so device-side [0,1] normalization applies
+            x = x.astype(np.uint8)
+        else:
+            x = x.astype(np.float32)
     target = tuple(example_shape)
     n = x.shape[0]
     if x.shape[1:] == target:
         return x
-    if x.ndim == 2 and int(np.prod(target)) == x.shape[1]:
-        return x.reshape((n,) + target)
     # CHW -> HWC
     if x.ndim == 4 and x.shape[1] in (1, 3) and \
             (x.shape[2], x.shape[3], x.shape[1]) == target:
@@ -83,6 +92,9 @@ def to_image(x: np.ndarray, example_shape: Sequence[int]) -> np.ndarray:
     # HW -> HW1
     if x.ndim == 3 and x.shape[1:] + (1,) == target:
         return x[..., None]
+    # any layout whose element count matches (flat <-> image both ways)
+    if int(np.prod(x.shape[1:])) == int(np.prod(target)):
+        return x.reshape((n,) + target)
     raise ValueError(f"cannot reshape samples {x.shape} to {target}")
 
 

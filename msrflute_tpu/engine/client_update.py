@@ -105,15 +105,16 @@ def build_client_update(task: BaseTask, client_opt_cfg,
     freeze = hparams.freeze_layers
 
     def _updatable_mask(params):
-        """0/1 per-leaf mask from the updatable_layers regex allowlist
+        """Per-leaf PYTHON bools from the updatable_layers regex allowlist
         (names are '.'-joined like torch's named_parameters; patterns are
-        start-anchored via re.match, matching the reference)."""
+        start-anchored via re.match, matching the reference).  Static at
+        trace time, so frozen updates compile to nothing."""
         import logging
         import re
 
         from ..utils.logging import print_rank
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-        masks = []
+        keeps = []
         for path, leaf in flat:
             name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
                             for p in path)
@@ -121,9 +122,8 @@ def build_client_update(task: BaseTask, client_opt_cfg,
                        for pat in hparams.updatable_layers)
             print_rank(("updating " if keep else "freezing ") + name,
                        loglevel=logging.DEBUG)
-            masks.append(jnp.ones_like(leaf) if keep
-                         else jnp.zeros_like(leaf))
-        return jax.tree_util.tree_unflatten(treedef, masks)
+            keeps.append(bool(keep))
+        return jax.tree_util.tree_unflatten(treedef, keeps)
 
     def client_update(global_params, arrays: Dict[str, jnp.ndarray],
                       sample_mask: jnp.ndarray, lr: jnp.ndarray,
@@ -158,9 +158,11 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             if update_mask is not None:
                 # frozen layers never move at ANY inner step (the per-param
                 # lr=0 semantics of the reference; momentum state still
-                # accumulates, exactly like torch SGD with lr=0)
-                updates = jax.tree.map(lambda u, m: u * m, updates,
-                                       update_mask)
+                # accumulates, exactly like torch SGD with lr=0); the mask
+                # is static, so frozen leaves are zero constants in XLA
+                updates = jax.tree.map(
+                    lambda u, keep: u if keep else jnp.zeros_like(u),
+                    updates, update_mask)
             new_params = optax.apply_updates(params, updates)
             # all-padding steps must be no-ops (momentum included)
             params = jax.tree.map(

@@ -294,9 +294,12 @@ class OptimizationServer:
             from .client_update import ClientHParams, build_client_update
             replay = self.server_replay
             updatable = replay.get("updatable_names")
+            # empty list means "freeze everything", which is distinct from
+            # None ("no allowlist"): use an explicit None check
             hp = ClientHParams(
                 num_epochs=replay["iterations"],
-                updatable_layers=tuple(updatable) if updatable else None)
+                updatable_layers=(tuple(updatable) if updatable is not None
+                                  else None))
             self._replay_update = build_client_update(
                 self.task, replay["opt_cfg"], hp)
             merged = ArraysDataset.concat_users(replay["dataset"])

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.metrics import Metric
-from .base import BaseTask, Batch, masked_mean, softmax_xent
+from .base import BaseTask, Batch, masked_mean, softmax_xent, to_float_image
 
 
 class _LRModule(nn.Module):
@@ -27,7 +27,7 @@ class _LRModule(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = to_float_image(x).reshape((x.shape[0], -1))
         return nn.Dense(self.num_classes)(x)
 
 
@@ -41,7 +41,7 @@ class _CNNFEMNISTModule(nn.Module):
     def __call__(self, x):
         if x.ndim == 3:
             x = x[..., None]
-        x = x.astype(jnp.float32)
+        x = to_float_image(x)
         x = nn.Conv(32, (5, 5), padding="SAME")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -62,7 +62,7 @@ class _CIFARCNNModule(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x.astype(jnp.float32)
+        x = to_float_image(x)
         x = nn.relu(nn.Conv(32, (3, 3))(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.relu(nn.Conv(64, (3, 3))(x))
@@ -140,8 +140,7 @@ class ClassificationTask(BaseTask):
         from ..data.featurize import to_image
         per_user = []
         for i in range(len(blob)):
-            x = to_image(np.asarray(blob.user_data[i], np.float32),
-                         self.example_shape)
+            x = to_image(np.asarray(blob.user_data[i]), self.example_shape)
             y = (np.asarray(blob.user_labels[i]).astype(np.int32)
                  if blob.user_labels is not None else
                  np.zeros((len(x),), np.int32))

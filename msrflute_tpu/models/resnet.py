@@ -17,6 +17,7 @@ from typing import Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .base import to_float_image
 from .cv import ClassificationTask
 
 
@@ -54,7 +55,7 @@ class _ResNetGN(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x.astype(jnp.float32)
+        x = to_float_image(x)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False)(x)
         x = _gn(64, self.channels_per_group)(x)
         x = nn.relu(x)

@@ -19,12 +19,13 @@ from .logging import print_rank
 
 
 def softmax(x: np.ndarray, axis: Optional[int] = None) -> np.ndarray:
-    """Stable softmax (reference ``utils/utils.py:78-114``); like the
-    reference, the default axis is the first one (per-column distributions
-    for 2-D inputs), not a flatten-everything normalization."""
+    """Stable softmax (reference ``utils/utils.py:78-114``).  Like the
+    reference, the default axis is the first NON-singleton one (a (1, n)
+    row vector normalizes over n, not elementwise); 1-D inputs stay 1-D."""
     x = np.asarray(x, np.float64)
     if axis is None:
-        axis = 0
+        axis = next((i for i, n in enumerate(x.shape) if n > 1), 0) \
+            if x.ndim > 0 else 0
     shifted = x - np.max(x, axis=axis, keepdims=True)
     e = np.exp(shifted)
     return e / np.sum(e, axis=axis, keepdims=True)
@@ -47,14 +48,19 @@ def write_nbest_jsonl(uttid2jsonl: Dict[str, dict],
                        loglevel=logging.WARNING)
             continue
         hypos = uttid2hypos[uttid]
+        if len(hypos) == 0:
+            print_rank(f"Empty hypotheses for {uttid}; skipping",
+                       loglevel=logging.WARNING)
+            continue
         if nbest > 1:
-            if uttid in uttid2scores:
-                weights = np.asarray(uttid2scores[uttid], np.float64)
+            scores = np.asarray(uttid2scores.get(uttid, []), np.float64)
+            if scores.size:
+                weights = scores
                 while len(weights) < nbest:
                     print_rank(f"Missing {len(weights)}-th best result in "
                                f"{uttid}; appending 1-best score")
                     weights = np.append(weights, weights[0])
-                weights = softmax(weights[:nbest])
+                weights = softmax(weights[:nbest]).reshape(-1)
             else:
                 weights = np.ones(nbest) / nbest
             for n in range(nbest):
