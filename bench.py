@@ -38,6 +38,16 @@ def main() -> None:
     clients_per_round = 10
     batch_size = 20
     samples_per_user = 240  # FEMNIST averages ~226 samples/user
+    on_tpu = jax.default_backend() == "tpu"
+    # off-TPU (e.g. CI smoke on a virtual CPU mesh) the full protocol is
+    # compute-bound on host cores; shrink so the harness still completes
+    # and emits its JSON contract — the recorded number only means
+    # "vs baseline" on real TPU hardware
+    warmup_rounds = 25 if on_tpu else 2
+    timed_rounds = 50 if on_tpu else 4
+    fuse = 25 if on_tpu else 2
+    if not on_tpu:
+        samples_per_user = 40
 
     cfg = FLUTEConfig.from_dict({
         "model_config": {"model_type": "CNN", "num_classes": 62},
@@ -48,9 +58,9 @@ def main() -> None:
             "initial_lr_client": 0.1,
             "optimizer_config": {"type": "sgd", "lr": 1.0},
             "val_freq": 10_000, "initial_val": False,
-            # fuse 25 rounds into one scanned device program (TPU-native
+            # fuse rounds into one scanned device program (TPU-native
             # perf feature; see RoundEngine.run_rounds)
-            "rounds_per_step": 25,
+            "rounds_per_step": 25,  # overwritten below per backend
             "data_config": {"val": {"batch_size": 128},
                             "test": {"batch_size": 128}},
         },
@@ -86,12 +96,13 @@ def main() -> None:
             val_dataset=ArraysDataset(users[:eval_users], per_user[:eval_users]),
             model_dir=tmp, mesh=mesh, seed=0)
 
-        # ---- warmup (compile the 25-round program) ----
-        server.config.server_config.max_iteration = 25
+        server.config.server_config.rounds_per_step = fuse
+        # ---- warmup (compile the fused-round program) ----
+        server.config.server_config.max_iteration = warmup_rounds
         server.train()
         # ---- timed rounds ----
-        n_rounds = 50
-        server.config.server_config.max_iteration = 25 + n_rounds
+        n_rounds = timed_rounds
+        server.config.server_config.max_iteration = warmup_rounds + n_rounds
         tic = time.time()
         server.train()
         jax.block_until_ready(server.state.params)

@@ -92,6 +92,16 @@ class ClassificationTask(BaseTask):
     def apply(self, params, x):
         return self.module.apply({"params": params}, x)
 
+    def predict(self, params, batch: Batch):
+        """Concatenatable eval outputs (the reference's
+        ``run_validation_generic`` ``output_tot``, ``core/trainer.py:690-723``):
+        per-sample logits + predictions, with padded rows labeled -1."""
+        logits = self.apply(params, batch["x"])
+        pred = jnp.argmax(logits, axis=-1)
+        labels = jnp.where(batch["sample_mask"] > 0,
+                           batch["y"].astype(jnp.int32), -1)
+        return logits, pred, labels
+
     def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
              train: bool = True):
         logits = self.apply(params, batch["x"])

@@ -148,6 +148,18 @@ class SequenceLMTask(BaseTask):
         aux = {"sample_count": jnp.sum(batch["sample_mask"])}
         return total / count, aux
 
+    def topk_predictions(self, params, batch: Batch, k: int = 1):
+        """Top-K predictions per target position (the reference GRU's
+        ``wantLogits`` output payload, ``nlg_gru/model.py:113-130``):
+        returns ``(probabilities, predictions, labels)`` with shapes
+        ``[..., k]`` / ``[..., k]`` / ``[...]``; padded positions carry
+        label -1."""
+        logits, targets, tok_mask = self._logits_targets(params, batch)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p, top_ids = jax.lax.top_k(probs, k)
+        labels = jnp.where(tok_mask > 0, targets, -1)
+        return top_p, top_ids, labels
+
     def token_logprobs(self, params, batch: Batch):
         """Per-token log-prob of the target under the model + validity mask
         (the ``compute_perplexity`` hook for the leakage attack, reference

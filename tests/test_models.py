@@ -142,3 +142,27 @@ def test_fednewsrec_task():
     batch2["labels"] = labels
     sums2 = jax.device_get(jax.jit(task.eval_stats)(params, batch2))
     assert sums2["sample_count"] == 3
+
+
+def test_prediction_outputs():
+    """wantLogits/output_tot parity: top-K token predictions (GRU) and
+    per-sample logits (classification)."""
+    task = make_task(ModelConfig(model_type="GRU",
+                                 extra={"vocab_size": 30, "embed_dim": 8,
+                                        "hidden_dim": 16, "max_num_words": 6}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    x = np.random.default_rng(0).integers(1, 30, size=(2, 6))
+    batch = {"x": jnp.asarray(x, jnp.int32),
+             "sample_mask": jnp.asarray([1.0, 0.0])}
+    probs, ids, labels = task.topk_predictions(params, batch, k=3)
+    assert probs.shape == (2, 5, 3) and ids.shape == (2, 5, 3)
+    assert np.all(np.asarray(labels[1]) == -1)  # masked sequence
+    assert np.all(np.asarray(probs) <= 1.0)
+
+    ctask = make_task(ModelConfig(model_type="LR", extra={"num_classes": 4,
+                                                          "input_dim": 8}))
+    cparams = ctask.init_params(jax.random.PRNGKey(0))
+    cbatch = {"x": jnp.ones((3, 8)), "y": jnp.zeros((3,), jnp.int32),
+              "sample_mask": jnp.asarray([1.0, 1.0, 0.0])}
+    logits, pred, labels = ctask.predict(cparams, cbatch)
+    assert logits.shape == (3, 4) and int(labels[2]) == -1
