@@ -45,10 +45,24 @@ class UserBlob:
 
 def _normalize_samples(entry: Any) -> Any:
     """Blobs store either ``{'x': [...]}`` or a bare list
-    (``doc/sphinx/scenarios.rst:13-33``)."""
+    (``doc/sphinx/scenarios.rst:13-33``).  Rich dicts with extra streams
+    (e.g. semisupervision's unlabeled ``ux``, fednewsrec's
+    ``clicked``/``impressions``) are preserved whole for task featurizers."""
     if isinstance(entry, dict) and "x" in entry:
+        if set(entry.keys()) - {"x", "y"}:
+            return entry
         return entry["x"]
     return entry
+
+
+def _entry_len(entry: Any) -> int:
+    """Sample count of a normalized entry.  ``len(dict)`` would count
+    streams, not samples — rich dicts measure their ``x`` stream (or first
+    stream for x-less formats like fednewsrec, whose featurizer recounts)."""
+    if isinstance(entry, dict):
+        stream = entry.get("x", next(iter(entry.values()), []))
+        return len(stream)
+    return len(entry)
 
 
 def _labels_of(entry: Any) -> Optional[Any]:
@@ -85,7 +99,7 @@ def _load_json(path: str) -> UserBlob:
         else:
             labels.append(_labels_of(entry))
     have_labels = any(lab is not None for lab in labels)
-    num_samples = blob.get("num_samples") or [len(d) for d in data]
+    num_samples = blob.get("num_samples") or [_entry_len(d) for d in data]
     return UserBlob(
         user_list=list(users),
         num_samples=[int(n) for n in num_samples],
@@ -121,18 +135,37 @@ def _load_hdf5(path: str) -> UserBlob:
         for user in users:
             entry = user_data_grp[user]
             if isinstance(entry, h5py.Group):
-                data.append(_decode(entry["x"][()]))
-                if labels_grp is None and "y" in entry:
-                    labels.append(np.asarray(entry["y"][()]))
+                keys = set(entry.keys())
+                if keys - {"x", "y"}:
+                    # rich per-user dict (semisup ux, fednewsrec
+                    # clicked/impressions): every stream round-trips;
+                    # '<key>.json' datasets hold non-array streams
+                    rich: Dict[str, Any] = {}
+                    for key in entry.keys():
+                        if key.endswith(".json"):
+                            rich[key[:-len(".json")]] = json.loads(
+                                bytes(entry[key][()]).decode("utf-8"))
+                        else:
+                            rich[key] = _decode(entry[key][()])
+                    data.append(rich)
+                else:
+                    data.append(_decode(entry["x"][()]))
+                if labels_grp is None:
+                    # always append (None when absent) to keep user<->label
+                    # alignment with mixed layouts, like _load_json does
+                    labels.append(np.asarray(entry["y"][()])
+                                  if "y" in entry else None)
             else:
                 data.append(_decode(entry[()]))
+                if labels_grp is None:
+                    labels.append(None)
             if labels_grp is not None:
                 labels.append(np.asarray(labels_grp[user][()]))
     return UserBlob(
         user_list=users,
         num_samples=num_samples,
         user_data=data,
-        user_labels=labels if labels else None,
+        user_labels=(labels if any(l is not None for l in labels) else None),
     )
 
 
@@ -166,7 +199,19 @@ def save_user_blob_hdf5(path: str, blob: UserBlob) -> None:
         grp = fh.create_group("user_data")
         for user, samples in zip(blob.user_list, blob.user_data):
             sub = grp.create_group(user)
-            sub.create_dataset("x", data=_as_dataset_value(samples))
+            if isinstance(samples, dict):
+                # rich per-user dict: one dataset per stream; streams that
+                # have no array form (nested dicts, e.g. fednewsrec
+                # impressions) persist as '<key>.json'
+                for key, value in samples.items():
+                    try:
+                        sub.create_dataset(key, data=_as_dataset_value(value))
+                    except (TypeError, ValueError):
+                        sub.create_dataset(
+                            f"{key}.json",
+                            data=np.void(json.dumps(value).encode("utf-8")))
+            else:
+                sub.create_dataset("x", data=_as_dataset_value(samples))
         if blob.user_labels is not None:
             lab = fh.create_group("user_data_label")
             for user, y in zip(blob.user_list, blob.user_labels):

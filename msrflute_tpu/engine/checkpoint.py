@@ -57,6 +57,26 @@ def _state_from_bytes(data: bytes, template: ServerState) -> ServerState:
     )
 
 
+def load_pretrained_params(path: str, template_params,
+                           data_path: Optional[str] = None):
+    """Load model params from a checkpoint file for warm-starting training
+    (reference ``model_config.pretrained_model_path``, ``core/config.py:93``;
+    relative paths resolve against ``data_path``, ``core/config.py:744-745``).
+
+    Accepts either a full :class:`ServerState` dump (any file this module
+    wrote — ``latest``/``epoch<i>``/``best_val_*``) or a bare params-pytree
+    msgpack; only the params are taken.
+    """
+    if not os.path.isabs(path) and not os.path.exists(path) and data_path:
+        path = os.path.join(data_path, path)
+    with open(path, "rb") as fh:
+        restored = serialization.msgpack_restore(fh.read())
+    target = jax.device_get(template_params)
+    if isinstance(restored, dict) and "params" in restored:
+        restored = restored["params"]
+    return serialization.from_state_dict(target, restored)
+
+
 class CheckpointManager:
     """latest/every-N/best checkpoint policy + status log."""
 

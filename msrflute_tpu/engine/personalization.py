@@ -120,6 +120,7 @@ class PersonalizationServer(OptimizationServer):
                 print_rank(f"restored personalization state for "
                            f"{len(self.store.alpha)} users")
         self._personal_fn = None
+        self._personal_eval_fn = None
         self._random_init = (self.config.server_config.get(
             "personalization_init", "global") == "random")
         # the personal pass reads the CURRENT global params per round, so
@@ -199,6 +200,17 @@ class PersonalizationServer(OptimizationServer):
         self._run_personal_pass(sampled)
         return sampled
 
+    def _stage_on_clients_axis(self, host_params_list, alphas, batch):
+        """Stack per-user param pytrees + stage a packed round batch onto
+        the clients mesh axis (shared by the round pass and the eval)."""
+        sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
+        stage = lambda v: jax.device_put(v, sharding)
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *host_params_list)
+        return (jax.tree.map(stage, stacked),
+                stage(np.asarray(alphas, np.float32)),
+                {k: stage(v) for k, v in batch.arrays.items()},
+                stage(batch.sample_mask), stage(batch.client_mask), stage)
+
     def _run_personal_pass(self, sampled) -> None:
         """Train sampled users' local models + alphas for this round."""
         if self._personal_fn is None:
@@ -216,15 +228,11 @@ class PersonalizationServer(OptimizationServer):
             lp, a = self.store.get(cid if cid >= 0 else -1, default)
             locals_.append(lp)
             alphas.append(a)
-        stacked = jax.tree.map(lambda *xs: np.stack(xs), *locals_)
-        sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
-        stage = lambda v: jax.device_put(v, sharding)
+        lps_dev, alphas_dev, arrays_dev, smask, cmask, stage = \
+            self._stage_on_clients_axis(locals_, alphas, batch)
         self._rng, rng = jax.random.split(self._rng)
         new_lp, new_alpha, tl = self._personal_fn(
-            self.state.params, jax.tree.map(stage, stacked),
-            stage(np.asarray(alphas, np.float32)),
-            {k: stage(v) for k, v in batch.arrays.items()},
-            stage(batch.sample_mask), stage(batch.client_mask),
+            self.state.params, lps_dev, alphas_dev, arrays_dev, smask, cmask,
             stage(batch.client_ids),
             jnp.asarray(self.initial_lr_client * self.lr_weight, jnp.float32),
             rng)
@@ -242,40 +250,82 @@ class PersonalizationServer(OptimizationServer):
         return jax.device_get(self.task.init_params(sub))
 
     # -- personalized eval ---------------------------------------------
-    def personalized_accuracy(self, dataset) -> Optional[float]:
-        """Convex-interpolated accuracy over users with local state
-        (reference ``convex_inference``, ``utils/utils.py:600-605``).
+    def _build_personal_eval_fn(self):
+        """One jitted shard_map+vmap program scoring ALL users' convex-
+        interpolated logits (reference ``convex_inference``,
+        ``utils/utils.py:600-605``) — users ride the clients mesh axis with
+        their local params stacked, exactly like the round path."""
+        task = self.task
+        from jax import shard_map
+        cspec = P(CLIENTS_AXIS)
+        rspec = P()
 
-        Host-driven per-user loop (eval-time only), interpolating logits of
-        the global and local models.
-        """
+        def shard_body(gp, lps, alphas, arrays, sample_mask, client_mask):
+            def per_user(lp, alpha, arr, mask, cm):
+                x = arr["x"].reshape((-1,) + arr["x"].shape[2:])
+                y = arr["y"].reshape(-1).astype(jnp.int32)
+                m = mask.reshape(-1) * cm
+                probs = (alpha * jax.nn.softmax(task.apply(lp, x)) +
+                         (1.0 - alpha) * jax.nn.softmax(task.apply(gp, x)))
+                pred = jnp.argmax(probs, axis=-1)
+                return (jnp.sum((pred == y).astype(jnp.float32) * m),
+                        jnp.sum(m))
+
+            c, t = jax.vmap(per_user)(lps, alphas, arrays, sample_mask,
+                                      client_mask)
+            return (jax.lax.psum(jnp.sum(c), CLIENTS_AXIS),
+                    jax.lax.psum(jnp.sum(t), CLIENTS_AXIS))
+
+        fn = shard_map(shard_body, mesh=self.engine.mesh,
+                       in_specs=(rspec, cspec, cspec, cspec, cspec, cspec),
+                       out_specs=(rspec, rspec), check_vma=False)
+        return jax.jit(fn)
+
+    def personalized_accuracy(self, dataset) -> Optional[float]:
+        """Convex-interpolated accuracy over users with local state —
+        one compiled program services all users.
+
+        Chunk width is FIXED at the mesh's client-axis size: one local-model
+        replica per device lane bounds the staging memory (K param copies is
+        the real cost at ResNet scale), and the constant shape means exactly
+        one compilation no matter how the store grows.  ``S`` respects the
+        configured ``desired_max_samples`` cap when present."""
         if not self.store.alpha:
             return None
-        task = self.task
-        if not hasattr(task, "apply"):
+        if not hasattr(self.task, "apply"):
             return None
+        uids = sorted(u for u in self.store.alpha if 0 <= u < len(dataset))
+        if not uids:
+            return None
+        if self._personal_eval_fn is None:
+            self._personal_eval_fn = self._build_personal_eval_fn()
+        from ..data.batching import steps_for
+        bs = int(self.config.server_config.data_config.val.get(
+            "batch_size", self.batch_size))
+        S = steps_for(int(max(dataset.num_samples)), bs,
+                      self.desired_max_samples)
+        chunk_k = self.mesh.shape[CLIENTS_AXIS]
+        gp_host = jax.device_get(self.state.params)
         correct = total = 0.0
-        gp = self.state.params
-        for uid, alpha in self.store.alpha.items():
-            if uid >= len(dataset):
-                continue
-            arrays = dataset.user_arrays(uid)
-            x = jnp.asarray(arrays["x"])
-            y = np.asarray(arrays["y"])
-            logits_g = jax.device_get(task.apply(gp, x))
-            logits_p = jax.device_get(task.apply(self.store.params[uid], x))
-            probs = alpha * _softmax(logits_p) + (1 - alpha) * _softmax(logits_g)
-            pred = probs.argmax(axis=-1)
-            correct += float((pred == y).sum())
-            total += len(y)
+        for i in range(0, len(uids), chunk_k):
+            part = uids[i:i + chunk_k]
+            batch = pack_round_batches(
+                dataset, part, bs, S, shuffle=False, pad_clients_to=chunk_k,
+                desired_max_samples=self.desired_max_samples)
+            lps = [self.store.params.get(u, gp_host) for u in part]
+            alphas = [self.store.alpha[u] for u in part]
+            while len(lps) < chunk_k:  # mesh-padding lanes (client_mask 0)
+                lps.append(gp_host)
+                alphas.append(self.alpha0)
+            lps_dev, alphas_dev, arrays_dev, smask, cmask, _ = \
+                self._stage_on_clients_axis(lps, alphas, batch)
+            c, t = self._personal_eval_fn(
+                self.state.params, lps_dev, alphas_dev, arrays_dev,
+                smask, cmask)
+            correct += float(c)
+            total += float(t)
         if total == 0:
             return None
         acc = correct / total
         log_metric("Personalized val acc", acc, step=self.state.round)
         return acc
-
-
-def _softmax(x: np.ndarray) -> np.ndarray:
-    x = x - x.max(axis=-1, keepdims=True)
-    e = np.exp(x)
-    return e / e.sum(axis=-1, keepdims=True)

@@ -151,6 +151,21 @@ class OptimizationServer:
             "secsPerRound": [], "secsPerRoundHousekeeping": []}
 
         self.state = self.engine.init_state(self._rng)
+        pretrained = config.model_config.get("pretrained_model_path")
+        if pretrained:
+            from .checkpoint import load_pretrained_params
+            params = load_pretrained_params(pretrained, self.state.params,
+                                            data_path=config.data_path)
+            # warm-started params, fresh optimizer/strategy state, round 0
+            # (reference loads the model before training, e2e_trainer.py:104);
+            # keep each leaf on the sharding init_state chose for it
+            params = jax.tree.map(
+                lambda host, old: jax.device_put(
+                    jnp.asarray(host, old.dtype), old.sharding),
+                params, self.state.params)
+            self.state = ServerState(params, self.state.opt_state,
+                                     self.state.strategy_state, 0)
+            print_rank(f"warm-started from pretrained model {pretrained}")
         if sc.get("resume_from_checkpoint", False):
             restored = self.ckpt.load(self.state)
             if restored is not None:
@@ -306,12 +321,12 @@ class OptimizationServer:
             n = len(next(iter(merged.values())))
             bs = int(self.config.server_config.data_config.train.get(
                 "batch_size", self.batch_size))
-            one = ArraysDataset(["server"], [merged])
-            batch = pack_round_batches(one, [0], bs, steps_for(n, bs),
-                                       rng=self._np_rng)
-            self._replay_batch = (
-                {k: v[0] for k, v in batch.arrays.items()},
-                batch.sample_mask[0])
+            # geometry is static (same jitted program every round); the
+            # *contents* are re-packed per round below — the reference
+            # re-iterates a shuffling DataLoader each round
+            # (core/server.py:429-442), so sample order must not freeze
+            self._replay_pack = (ArraysDataset(["server"], [merged]),
+                                 bs, steps_for(n, bs))
             lr = float(replay["opt_cfg"].get("lr", 0.01))
 
             def fn(params, arrays, mask, rng):
@@ -320,7 +335,10 @@ class OptimizationServer:
                 return jax.tree.map(lambda w, g: w - g, params, pg), tl
             self._replay_fn = jax.jit(fn)
         self._rng, rng = jax.random.split(self._rng)
-        arrays, mask = self._replay_batch
+        one, bs, steps = self._replay_pack
+        batch = pack_round_batches(one, [0], bs, steps, rng=self._np_rng)
+        arrays = {k: v[0] for k, v in batch.arrays.items()}
+        mask = batch.sample_mask[0]
         new_params, tl = self._replay_fn(self.state.params, arrays, mask, rng)
         self.state = ServerState(new_params, self.state.opt_state,
                                  self.state.strategy_state, self.state.round)

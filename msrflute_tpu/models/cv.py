@@ -144,17 +144,43 @@ class ClassificationTask(BaseTask):
 
     def make_dataset(self, blob, model_config, split, data_config=None):
         """Featurize an image/vector user blob (reshapes flat or CHW samples
-        to this task's HWC example shape)."""
+        to this task's HWC example shape).
+
+        Semisupervision blobs ship per-user dicts with an unlabeled stream
+        ``ux`` (reference ``experiments/semisupervision/dataloaders/
+        dataset.py``); when ``data_config.augment`` is configured (train
+        split only) the augmented view ``ux_rand`` for the FedLabels
+        ``uda: 1`` path is produced here with RandAugment — the TPU-design
+        analogue of the reference's per-__getitem__ transform.
+        """
         import numpy as np
         from ..data.dataset import ArraysDataset
         from ..data.featurize import to_image
+        aug_cfg = dict((data_config or {}).get("augment") or {}) \
+            if split == "train" else {}
+        aug_rng = np.random.default_rng(int(aug_cfg.get("seed", 0)))
         per_user = []
         for i in range(len(blob)):
-            x = to_image(np.asarray(blob.user_data[i]), self.example_shape)
+            entry = blob.user_data[i]
+            raw_x = entry["x"] if isinstance(entry, dict) else entry
+            x = to_image(np.asarray(raw_x), self.example_shape)
             y = (np.asarray(blob.user_labels[i]).astype(np.int32)
                  if blob.user_labels is not None else
                  np.zeros((len(x),), np.int32))
-            per_user.append({"x": x, "y": y})
+            user = {"x": x, "y": y}
+            if isinstance(entry, dict) and "ux" in entry:
+                ux = to_image(np.asarray(entry["ux"]), self.example_shape)
+                user["ux"] = ux
+                if "ux_rand" in entry:
+                    user["ux_rand"] = to_image(np.asarray(entry["ux_rand"]),
+                                               self.example_shape)
+                elif aug_cfg:
+                    from ..data.augment import rand_augment
+                    user["ux_rand"] = rand_augment(
+                        ux, num_ops=int(aug_cfg.get("num_ops", 2)),
+                        magnitude=int(aug_cfg.get("magnitude", 9)),
+                        rng=aug_rng)
+            per_user.append(user)
         return ArraysDataset(blob.user_list, per_user, blob.num_samples)
 
 

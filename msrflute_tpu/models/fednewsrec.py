@@ -193,6 +193,117 @@ class FedNewsRecTask(BaseTask):
             "ndcg@10": Metric(float(sums["ndcg10_sum"]) / n),
         }
 
+    # -- MIND-style featurizer -----------------------------------------
+    def _pad_title(self, title) -> "np.ndarray":
+        import numpy as np
+        ids = np.zeros((self.seq_len,), np.int32)
+        toks = np.asarray(title, np.int64).reshape(-1)[:self.seq_len]
+        ids[:len(toks)] = np.clip(toks, 0, self.vocab_size - 1)
+        return ids
+
+    def _pad_history(self, clicked) -> "np.ndarray":
+        import numpy as np
+        hist = np.zeros((self.history, self.seq_len), np.int32)
+        # most-recent H clicks (reference keeps the trailing window,
+        # preprocess_mind.py click-history truncation)
+        for j, title in enumerate(list(clicked)[-self.history:]):
+            hist[j] = self._pad_title(title)
+        return hist
+
+    def make_dataset(self, blob, model_config, split, data_config=None):
+        """Featurize a MIND-style user blob into the batch contract above
+        (reference ``experiments/fednewsrec/dataloaders/``: per-user click
+        histories + impression slates; train samples are npratio-negative
+        slates with the positive at a random slot, eval samples are the
+        full impression padded to a static candidate count).
+
+        Blob format per user:
+        ``{"clicked": [[tok,...], ...],``
+        `` "impressions": [{"cands": [[tok,...], ...],``
+        ``                  "labels": [0/1, ...]}, ...]}``
+        """
+        import numpy as np
+        from ..data.dataset import ArraysDataset
+
+        dc = data_config or {}
+        max_cands = int(dc.get("max_candidates",
+                               model_config.get("max_candidates", 20)))
+        rng = np.random.default_rng(int(dc.get("seed", 0)))
+        users, per_user, counts = [], [], []
+        truncated = 0
+        for i in range(len(blob)):
+            entry = blob.user_data[i]
+            if not isinstance(entry, dict) or "impressions" not in entry:
+                raise ValueError(
+                    "fednewsrec expects MIND-style user dicts with "
+                    "'clicked' and 'impressions' (see docstring)")
+            hist = self._pad_history(entry.get("clicked", []))
+            clicked_rows, cand_rows, y_rows = [], [], []
+            label_rows, mask_rows = [], []
+            for imp in entry["impressions"]:
+                titles = [self._pad_title(t) for t in imp["cands"]]
+                labels = np.asarray(imp["labels"], np.int32).reshape(-1)
+                if split == "train":
+                    pos = np.flatnonzero(labels > 0)
+                    neg = np.flatnonzero(labels == 0)
+                    if pos.size == 0:
+                        continue
+                    # one slate per positive: positive + npratio sampled
+                    # negatives at a random slot (reference newsample())
+                    for p in pos:
+                        if neg.size:
+                            take = rng.choice(
+                                neg, self.npratio,
+                                replace=neg.size < self.npratio)
+                            slate = [titles[j] for j in take]
+                        else:  # all-positive slate: pad-id negatives
+                            slate = [np.zeros_like(titles[0])] * self.npratio
+                        slot = int(rng.integers(self.npratio + 1))
+                        slate.insert(slot, titles[p])
+                        clicked_rows.append(hist)
+                        cand_rows.append(np.stack(slate))
+                        y_rows.append(slot)
+                else:
+                    keep = np.arange(len(titles))
+                    if len(titles) > max_cands:
+                        # subsample negatives but NEVER drop positives —
+                        # real MIND slates run long (~37 avg) and losing a
+                        # positive silently voids the impression's metrics
+                        pos_i = np.flatnonzero(labels > 0)[:max_cands]
+                        neg_i = np.flatnonzero(labels == 0)
+                        neg_i = neg_i[:max_cands - len(pos_i)]
+                        keep = np.sort(np.concatenate([pos_i, neg_i]))
+                        truncated += 1
+                    cands = np.zeros((max_cands, self.seq_len), np.int32)
+                    lab = np.zeros((max_cands,), np.float32)
+                    msk = np.zeros((max_cands,), np.float32)
+                    c = len(keep)
+                    cands[:c] = np.stack([titles[j] for j in keep])
+                    lab[:c] = labels[keep]
+                    msk[:c] = 1.0
+                    clicked_rows.append(hist)
+                    cand_rows.append(cands)
+                    label_rows.append(lab)
+                    mask_rows.append(msk)
+            if not clicked_rows:
+                continue
+            user = {"clicked": np.stack(clicked_rows),
+                    "cands": np.stack(cand_rows)}
+            if split == "train":
+                user["y"] = np.asarray(y_rows, np.int32)
+            else:
+                user["labels"] = np.stack(label_rows)
+                user["cand_mask"] = np.stack(mask_rows)
+            users.append(blob.user_list[i])
+            per_user.append(user)
+            counts.append(len(clicked_rows))
+        if truncated:
+            from ..utils.logging import print_rank
+            print_rank(f"fednewsrec {split}: {truncated} impressions longer "
+                       f"than max_candidates={max_cands}; negatives "
+                       "subsampled (positives kept)")
+        return ArraysDataset(users, per_user, counts)
+
 
 def make_fednewsrec_task(model_config) -> FedNewsRecTask:
     return FedNewsRecTask(model_config)

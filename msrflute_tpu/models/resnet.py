@@ -21,9 +21,17 @@ from .base import to_float_image
 from .cv import ClassificationTask
 
 
-def _gn(channels: int, channels_per_group: int = 32) -> nn.GroupNorm:
+#: He fan-out init, the reference's ``normal_(0, sqrt(2/n))`` on convs
+#: (``model.py:139-140``)
+_he_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def _gn(channels: int, channels_per_group: int = 32,
+        zero_scale: bool = False) -> nn.GroupNorm:
     groups = max(channels // max(channels_per_group, 1), 1)
-    return nn.GroupNorm(num_groups=groups)
+    return nn.GroupNorm(num_groups=groups,
+                        scale_init=(nn.initializers.zeros if zero_scale
+                                    else nn.initializers.ones))
 
 
 class _BasicBlock(nn.Module):
@@ -35,15 +43,20 @@ class _BasicBlock(nn.Module):
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                    padding=1, use_bias=False)(x)
+                    padding=1, use_bias=False, kernel_init=_he_init)(x)
         y = _gn(self.planes, self.channels_per_group)(y)
         y = nn.relu(y)
-        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(y)
-        y = _gn(self.planes, self.channels_per_group)(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                    kernel_init=_he_init)(y)
+        # block-final norm scale starts at zero so every block begins as
+        # identity (the reference's zero_init_residual,
+        # ``model.py:148-152``) — without it the 8-block stack amplifies
+        # activations and early SGD diverges
+        y = _gn(self.planes, self.channels_per_group, zero_scale=True)(y)
         if residual.shape[-1] != self.planes or self.stride != 1:
             residual = nn.Conv(self.planes, (1, 1),
                                strides=(self.stride, self.stride),
-                               use_bias=False)(x)
+                               use_bias=False, kernel_init=_he_init)(x)
             residual = _gn(self.planes, self.channels_per_group)(residual)
         return nn.relu(y + residual)
 
@@ -56,7 +69,8 @@ class _ResNetGN(nn.Module):
     @nn.compact
     def __call__(self, x):
         x = to_float_image(x)
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False)(x)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    kernel_init=_he_init)(x)
         x = _gn(64, self.channels_per_group)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

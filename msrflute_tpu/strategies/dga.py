@@ -47,6 +47,9 @@ class DGA(BaseStrategy):
         if bits is None and mc is not None:
             bits = mc.get("quant_bits")
         self.quant_bits = int(bits) if bits is not None else 10
+        # O(n) histogram-CDF threshold instead of a sort per leaf per
+        # client (see ops.quantization.approx_quantile_abs)
+        self.quant_approx = bool(cc.get("quant_approx", False))
 
     def client_weight(self, *, num_samples, train_loss, stats, rng):
         if self.aggregate_median == "softmax":
@@ -82,7 +85,8 @@ class DGA(BaseStrategy):
             thr = jnp.where(jnp.asarray(thr) >= 0, thr,
                             float(self.quant_threshold))
             pseudo_grad = quantize_pytree(
-                pseudo_grad, quant_threshold=thr, quant_bits=self.quant_bits)
+                pseudo_grad, quant_threshold=thr, quant_bits=self.quant_bits,
+                approx=self.quant_approx)
         return pseudo_grad, weight
 
     # ---- staleness buffer (replaces dga.py:260-284 host lists) --------

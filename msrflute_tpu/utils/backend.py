@@ -1,0 +1,45 @@
+"""Backend insulation for a single-client TPU tunnel.
+
+The chip here is reached through an exclusive-claim relay that can fail fast
+OR hang on init, and a SIGKILLed claim wedges it for every later process —
+so CPU-only codepaths (tests, dryruns, bench fallback) must keep jax from
+ever initializing the TPU plugin.  This is the one shared implementation of
+that discipline (used by ``tests/conftest.py``-style setups, ``bench.py``
+and ``__graft_entry__.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def force_cpu_backend(n_devices: Optional[int] = None) -> None:
+    """Configure this process for a (virtual) CPU mesh before first backend
+    init: drop the TPU relay env, force ``jax_platforms=cpu`` (env var AND
+    config — a sitecustomize may have imported jax already), and optionally
+    request ``n_devices`` virtual host devices.
+
+    Must run before anything triggers jax backend initialization; after
+    that, XLA_FLAGS changes are ignored.
+    """
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        # replace any pre-existing count unless it already suffices —
+        # a smaller ambient value would bring up too few devices
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m and int(m.group(1)) >= n_devices:
+            pass
+        else:
+            if m:
+                flags = flags.replace(m.group(0), "")
+            os.environ["XLA_FLAGS"] = (
+                flags.strip() +
+                f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

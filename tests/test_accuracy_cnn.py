@@ -1,0 +1,101 @@
+"""Real-data convergence for the benchmark CNN and ResNet models.
+
+BASELINE.md's accuracy targets (CNN_FEMNIST ~83% @1500r, Fed-CIFAR-100
+~33% @4000r) need the real datasets, which a zero-egress container cannot
+fetch — ``docs/RUNBOOK.md`` documents how to run them when data is mounted.
+What CAN be validated here is that the exact benchmark *models* (2conv+2fc
+CNN, ResNet-18+GN) learn real data through the full federated stack: sklearn
+digits (1797 real 8x8 images) as 100 clients, same protocol shape as
+``test_accuracy_digits.py``.
+"""
+
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+
+
+@pytest.fixture(scope="module")
+def digits_images():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32).reshape(-1, 8, 8, 1)
+    y = d.target.astype(np.int32)
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    val = ArraysDataset(["val"], [{"x": x[1500:], "y": y[1500:]}])
+    users, per_user = [], []
+    for u in range(100):
+        sl = slice(u * 15, (u + 1) * 15)
+        users.append(f"u{u:03d}")
+        per_user.append({"x": x[sl], "y": y[sl]})
+    return ArraysDataset(users, per_user), val
+
+
+def _cfg(model_cfg, rounds, lr, rounds_per_step=10):
+    return FLUTEConfig.from_dict({
+        "model_config": model_cfg,
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": rounds,
+            "num_clients_per_iteration": 10,
+            "initial_lr_client": lr,
+            "rounds_per_step": rounds_per_step,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": rounds_per_step, "initial_val": False,
+            "best_model_criterion": "acc",
+            "data_config": {"val": {"batch_size": 512}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": lr},
+            "data_config": {"train": {"batch_size": 5}},
+        },
+    })
+
+
+def test_benchmark_cnn_learns_digits(digits_images, mesh8, tmp_path):
+    """The CNN_FEMNIST benchmark model (2conv+2fc) through the federated
+    stack on real images."""
+    train, val = digits_images
+    cfg = _cfg({"model_type": "CNN", "num_classes": 10, "image_size": 8},
+               rounds=30, lr=0.1)
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, train, val_dataset=val,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    server._maybe_eval("val", 0, force=True)
+    initial = server.best_val["acc"].value
+    server.train()
+    final = server.best_val["acc"].value
+    assert initial < 0.35, f"untrained CNN already at {initial:.3f}"
+    assert final > 0.8, f"federated CNN only reached {final:.3f} on digits"
+
+
+def test_benchmark_resnet_learns_digits(digits_images, mesh8, tmp_path):
+    """The RESNET_FEDCIFAR100 benchmark model (ResNet-18 + GroupNorm)
+    through the federated stack on real images (narrow groups to keep the
+    CPU smoke affordable; architecture unchanged)."""
+    train, val = digits_images
+
+    def rgb(ds):
+        return ArraysDataset(
+            ds.user_list,
+            [{**ds.user_arrays(i),
+              "x": np.repeat(ds.user_arrays(i)["x"], 3, axis=-1)}
+             for i in range(len(ds))])
+
+    train, val = rgb(train), rgb(val)
+    cfg = _cfg({"model_type": "RESNET", "depth": 18, "num_classes": 10,
+                "image_size": 8, "channels_per_group": 16},
+               rounds=30, lr=0.1)
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, train, val_dataset=val,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    server.train()
+    final = server.best_val["acc"].value
+    # calibrated: 0.68 at 30 rounds with the zero-init-residual fix (was
+    # stuck at chance before it); margin for seed variation
+    assert final > 0.55, f"federated ResNet only reached {final:.3f} on digits"

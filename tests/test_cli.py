@@ -78,3 +78,22 @@ def test_cli_end_to_end(tmp_path):
                (out_dir / "log" / "metrics.jsonl").read_text().splitlines()]
     assert any(m["name"] == "Val acc" for m in metrics)
     assert (out_dir / "cfg.yaml").exists()
+
+    # ---- warm-start: a second run from the first run's best checkpoint
+    # (reference model_config.pretrained_model_path, core/config.py:93) ----
+    best = out_dir / "models" / "best_val_acc_model.msgpack"
+    assert best.exists()
+    cfg["model_config"]["pretrained_model_path"] = str(best)
+    cfg["server_config"]["max_iteration"] = 1
+    cfg["server_config"]["initial_val"] = False
+    cfg2_path = tmp_path / "cfg2.yaml"
+    cfg2_path.write_text(yaml.safe_dump(cfg))
+    out2 = tmp_path / "out2"
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(repo, "e2e_trainer.py"),
+         "-config", str(cfg2_path), "-dataPath", str(data_dir),
+         "-outputPath", str(out2), "-task", "cv_lr_mnist"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc2.returncode == 0, proc2.stderr[-3000:]
+    assert "warm-started from pretrained model" in (proc2.stdout + proc2.stderr)
+    assert (out2 / "models" / "latest_model.msgpack").exists()

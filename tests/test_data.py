@@ -38,6 +38,33 @@ def test_load_user_blob_hdf5(tmp_path):
     np.testing.assert_array_equal(loaded.user_labels[1], [2])
 
 
+def test_hdf5_rich_dict_roundtrip(tmp_path):
+    """Rich per-user dicts (semisup ``ux``, fednewsrec
+    ``clicked``/``impressions``) must survive json<->hdf5 — every stream,
+    not just ``x``."""
+    from msrflute_tpu.data.user_blob import UserBlob, save_user_blob_hdf5
+    semi = UserBlob(["u0"], [3],
+                    [{"x": np.ones((3, 4, 4, 1), np.float32),
+                      "ux": np.zeros((3, 4, 4, 1), np.float32)}],
+                    user_labels=[np.array([0, 1, 2])])
+    p = str(tmp_path / "semi.hdf5")
+    save_user_blob_hdf5(p, semi)
+    loaded = load_user_blob(p)
+    assert isinstance(loaded.user_data[0], dict)
+    np.testing.assert_array_equal(loaded.user_data[0]["ux"],
+                                  semi.user_data[0]["ux"])
+    mind = UserBlob(["u0"], [1],
+                    [{"clicked": [[1, 2], [3]],
+                      "impressions": [{"cands": [[4], [5, 6]],
+                                       "labels": [1, 0]}]}])
+    p2 = str(tmp_path / "mind.hdf5")
+    save_user_blob_hdf5(p2, mind)
+    loaded = load_user_blob(p2)
+    d = loaded.user_data[0]
+    assert d["impressions"][0]["labels"] == [1, 0]
+    assert [list(map(int, c)) for c in d["clicked"]] == [[1, 2], [3]]
+
+
 def test_steps_for():
     assert steps_for(10, 4) == 3
     assert steps_for(100, 4, desired_max_samples=10) == 3

@@ -84,6 +84,74 @@ def test_nlg_gru_e2e_from_config(tmp_path):
     assert status["i"] == 2
 
 
+def test_cv_personalization_e2e_from_config(tmp_path):
+    """Dirichlet + rotation-wedge partitioned blob through the
+    PersonalizationServer (reference experiments/cv; the partitioner is
+    experiments/cv/data.py:118-149).  Small CNN stands in for ResNet-18 to
+    keep the CPU smoke fast — the data pipeline is what's under test."""
+    out = _run_cli("cv", {
+        "model_config.model_type": "CIFAR_CNN",
+        "server_config.max_iteration": 2,
+        "server_config.val_freq": 2,
+        "server_config.rec_freq": 100,
+        "server_config.initial_val": False,
+        "server_config.data_config.val.batch_size": 32,
+        "client_config.data_config.train.batch_size": 8,
+        "client_config.desired_max_samples": 8,
+    }, tmp_path)
+    status = json.loads((out / "models" / "status_log.json").read_text())
+    assert status["i"] == 2
+    # personalization artifacts: per-user local models persisted
+    assert any(n.endswith("_model.msgpack")
+               for n in os.listdir(out / "models" / "personalization"))
+
+
+def test_semisupervision_e2e_from_config(tmp_path):
+    """FedLabels uda:1 path end-to-end: the blob's unlabeled ``ux`` gets a
+    RandAugment view (``ux_rand``) at featurize time via the config's
+    ``data_config.train.augment`` (reference RandAugment.py)."""
+    out = _run_cli("semisupervision", {
+        "server_config.max_iteration": 2,
+        "server_config.val_freq": 2,
+        "server_config.rec_freq": 100,
+        "server_config.initial_val": False,
+        "server_config.data_config.val.batch_size": 32,
+        "client_config.data_config.train.batch_size": 8,
+        "client_config.desired_max_samples": 8,
+        "client_config.semisupervision.burnout_round": 0,
+    }, tmp_path)
+    status = json.loads((out / "models" / "status_log.json").read_text())
+    assert status["i"] == 2
+
+
+def test_fednewsrec_e2e_from_config(tmp_path):
+    """MIND-style featurizer end-to-end: clicked/impressions blob ->
+    npratio train slates + padded eval slates -> NRMS federated rounds with
+    AUC/MRR/nDCG eval (reference experiments/fednewsrec/dataloaders/)."""
+    out = _run_cli("fednewsrec", {
+        "model_config.vocab_size": 500,
+        "model_config.embed_dim": 24,
+        "model_config.num_heads": 2,
+        "model_config.head_dim": 8,
+        "model_config.max_title_length": 12,
+        "model_config.max_history": 6,
+        "model_config.npratio": 2,
+        "model_config.max_candidates": 10,
+        "server_config.max_iteration": 2,
+        "server_config.val_freq": 2,
+        "server_config.rec_freq": 100,
+        "server_config.initial_val": False,
+        "server_config.data_config.val.batch_size": 16,
+        "client_config.data_config.train.batch_size": 4,
+        "client_config.desired_max_samples": 8,
+    }, tmp_path)
+    status = json.loads((out / "models" / "status_log.json").read_text())
+    assert status["i"] == 2
+    metrics = [json.loads(l) for l in
+               (out / "log" / "metrics.jsonl").read_text().splitlines()]
+    assert any(m["name"] == "Val auc" for m in metrics)
+
+
 def test_shakespeare_e2e_from_config(tmp_path):
     out = _run_cli("nlp_rnn_fedshakespeare", {
         "server_config.max_iteration": 2,

@@ -86,6 +86,41 @@ def test_server_replay(synth_dataset, mesh8, tmp_path):
     assert state.round == 2
 
 
+def test_server_replay_reshuffles_each_round(synth_dataset, mesh8, tmp_path):
+    """The replay batch must be re-packed per round — the reference
+    re-iterates a shuffling DataLoader (core/server.py:429-442), so two
+    consecutive replay rounds must not train on a frozen sample order."""
+    import numpy as np
+    from msrflute_tpu.config import ServerReplayConfig, OptimizerConfig
+    from msrflute_tpu.engine import OptimizationServer
+    cfg = _cfg()
+    cfg.server_config.max_iteration = 2
+    cfg.server_config.server_replay_config = ServerReplayConfig(
+        server_iterations=1,
+        optimizer_config=OptimizerConfig(type="sgd", lr=0.05))
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                server_train_dataset=synth_dataset,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    import msrflute_tpu.engine.server as server_mod
+    real_pack = server_mod.pack_round_batches
+    replay_xs = []
+
+    def spy_pack(ds, *args, **kwargs):
+        batch = real_pack(ds, *args, **kwargs)
+        if getattr(server, "_replay_pack", (None,))[0] is ds:
+            replay_xs.append(batch.arrays["x"].copy())
+        return batch
+
+    server_mod.pack_round_batches = spy_pack
+    try:
+        server.train()  # 2 rounds -> 2 replay calls through the live path
+    finally:
+        server_mod.pack_round_batches = real_pack
+    assert len(replay_xs) == 2
+    assert not np.array_equal(replay_xs[0], replay_xs[1])
+
+
 def test_dump_norm_stats_and_profiling(synth_dataset, mesh8, tmp_path):
     from msrflute_tpu.engine import OptimizationServer
     from msrflute_tpu.models import make_task

@@ -1,0 +1,148 @@
+"""Dirichlet partitioner + RandAugment unit tests (reference
+``experiments/cv/data.py`` and ``experiments/semisupervision/dataloaders/
+RandAugment.py`` behavioral parity)."""
+
+import numpy as np
+import pytest
+
+
+def test_dirichlet_partition_is_a_partition():
+    from msrflute_tpu.data.partition import dirichlet_partition
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, size=3000)
+    parts = dirichlet_partition(y, 30, 0.5, rng)
+    assert len(parts) == 30
+    allidx = np.concatenate(parts)
+    assert len(allidx) == 3000
+    assert len(np.unique(allidx)) == 3000  # disjoint + complete
+
+
+def test_dirichlet_alpha_controls_skew():
+    """Small alpha -> label-skewed shards; huge alpha -> near-uniform.
+    Skew measured as mean per-client max-class share."""
+    from msrflute_tpu.data.partition import (dirichlet_partition,
+                                             partition_label_counts)
+
+    def mean_max_share(alpha, seed):
+        rng = np.random.default_rng(seed)
+        y = rng.integers(0, 10, size=4000)
+        parts = dirichlet_partition(y, 20, alpha, rng)
+        stats = partition_label_counts(y, parts)
+        shares = [max(s.values()) / sum(s.values()) for s in stats if s]
+        return float(np.mean(shares))
+
+    assert mean_max_share(0.1, 1) > mean_max_share(100.0, 1) + 0.15
+
+
+def test_dirichlet_balance_rule():
+    """No client hoards far beyond N/num_clients (the FedML balance rule)."""
+    from msrflute_tpu.data.partition import dirichlet_partition
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 10, size=2000)
+    parts = dirichlet_partition(y, 10, 0.1, rng)
+    sizes = np.array([len(p) for p in parts])
+    # with the balance rule, even alpha=0.1 keeps shards within ~2x quota
+    assert sizes.max() <= 2.2 * (2000 / 10)
+
+
+def test_client_rotation_ranges_tile_the_circle():
+    from msrflute_tpu.data.partition import client_rotation_range
+    n = 8
+    ranges = [client_rotation_range(j, n) for j in range(n)]
+    assert ranges[0][0] == -180
+    assert ranges[-1][1] == 180
+    for (lo1, hi1), (lo2, _) in zip(ranges, ranges[1:]):
+        assert hi1 == lo2
+        assert hi1 > lo1
+
+
+def test_rotate_images_shapes_and_identity():
+    from msrflute_tpu.data.partition import rotate_images
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, size=(3, 16, 16, 3)).astype(np.uint8)
+    r0 = rotate_images(x, 0.0)
+    assert r0.shape == x.shape and r0.dtype == x.dtype
+    np.testing.assert_array_equal(r0, x)
+    r90 = rotate_images(x, 90.0)
+    assert not np.array_equal(r90, x)
+
+
+def test_dirichlet_blob_format():
+    from msrflute_tpu.data.partition import dirichlet_blob
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 8, 8, 1))
+    y = rng.integers(0, 4, size=300)
+    blob = dirichlet_blob(x, y, 6, 0.5, rng, rotate=True)
+    assert set(blob) == {"users", "num_samples", "user_data",
+                         "user_data_label"}
+    assert sum(blob["num_samples"]) == 300
+    u0 = blob["users"][0]
+    assert len(blob["user_data"][u0]["x"]) == blob["num_samples"][0]
+    assert len(blob["user_data_label"][u0]) == blob["num_samples"][0]
+
+
+@pytest.mark.parametrize("dtype,shape", [
+    (np.uint8, (4, 16, 16, 3)),
+    (np.float32, (4, 16, 16)),
+    (np.float32, (4, 64)),  # flat vectors: jitter-only path
+])
+def test_rand_augment_shapes_dtypes(dtype, shape):
+    from msrflute_tpu.data.augment import rand_augment
+    rng = np.random.default_rng(0)
+    if np.issubdtype(dtype, np.integer):
+        x = rng.integers(0, 255, size=shape).astype(dtype)
+    else:
+        x = rng.normal(size=shape).astype(dtype)
+    out = rand_augment(x, num_ops=2, magnitude=9,
+                       rng=np.random.default_rng(1))
+    assert out.shape == x.shape and out.dtype == x.dtype
+    assert not np.array_equal(out, x)
+    if np.issubdtype(dtype, np.integer):
+        assert out.min() >= 0 and out.max() <= 255
+
+
+def test_rand_augment_every_op_runs():
+    """Each op individually preserves shape and [0,1] clamp."""
+    from msrflute_tpu.data.augment import AUGMENT_OPS
+    rng = np.random.default_rng(0)
+    img = rng.random((16, 16, 3)).astype(np.float32)
+    for name, fn in AUGMENT_OPS:
+        out = fn(img.copy(), 0.5, np.random.default_rng(3))
+        assert out.shape == img.shape, name
+        assert np.isfinite(out).all(), name
+
+
+def test_nrms_featurizer_contract():
+    """MIND-style blob -> documented batch arrays; train slates hold the
+    positive at index y; eval slates carry labels + cand_mask."""
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.data.user_blob import UserBlob
+    from msrflute_tpu.models import make_task
+
+    mc = {"vocab_size": 100, "embed_dim": 8, "num_heads": 2, "head_dim": 4,
+          "max_title_length": 6, "max_history": 4, "npratio": 2,
+          "max_candidates": 8}
+    task = make_task(ModelConfig(model_type="NRMS", extra=mc))
+    user = {
+        "clicked": [[1, 2, 3], [4, 5]],
+        "impressions": [
+            {"cands": [[7, 8], [9], [10, 11, 12]], "labels": [0, 1, 0]},
+            {"cands": [[13], [14, 15]], "labels": [1, 0]},
+        ],
+    }
+    blob = UserBlob(["u0"], [2], [user])
+    tr = task.make_dataset(blob, mc, "train")
+    arr = tr.user_arrays(0)
+    assert arr["clicked"].shape == (2, 4, 6)
+    assert arr["cands"].shape == (2, 3, 6)  # npratio+1
+    # the positive title really sits at slot y
+    pos_titles = [[9], [13]]
+    for i, pos in enumerate(pos_titles):
+        slate = arr["cands"][i]
+        slot = int(arr["y"][i])
+        assert slate[slot][0] == pos[0]
+    ev = task.make_dataset(blob, mc, "val")
+    arr = ev.user_arrays(0)
+    assert arr["cands"].shape == (2, 8, 6)
+    assert arr["labels"].shape == (2, 8)
+    assert arr["cand_mask"].sum() == 5  # 3 + 2 real candidates

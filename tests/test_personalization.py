@@ -50,9 +50,13 @@ def test_personalization_trains_local_state(synth_dataset, mesh8, tmp_path):
     diffs = [np.abs(a - b).max() for a, b in
              zip(jax.tree.leaves(lp), jax.tree.leaves(gp))]
     assert max(diffs) > 0
-    # interpolated eval runs
+    # interpolated eval runs, vmapped: ONE compiled program services all
+    # users (cache size stays 1 across repeat calls)
     acc = server.personalized_accuracy(synth_dataset)
     assert acc is not None and 0.0 <= acc <= 1.0
+    acc2 = server.personalized_accuracy(synth_dataset)
+    assert acc2 == acc
+    assert server._personal_eval_fn._cache_size() == 1
     # store persisted per-user + reload roundtrip
     import os
     assert os.path.isdir(server._store_path)

@@ -77,6 +77,28 @@ def test_quantization_levels_and_sparsity():
     assert same is tree
 
 
+def test_approx_quantile_tracks_exact():
+    """Histogram-CDF threshold stays within one bin width of the exact
+    sort-based quantile, and the approx quantize path keeps the sparsity
+    contract."""
+    import jax
+    from msrflute_tpu.ops import quantize_array
+    from msrflute_tpu.ops.quantization import approx_quantile_abs
+    rng = np.random.default_rng(1)
+    for q in (0.25, 0.5, 0.9):
+        for scale in (1.0, 1e-3):
+            x = jnp.asarray(rng.normal(size=(4096,)) * scale, jnp.float32)
+            exact = float(jnp.quantile(jnp.abs(x), q))
+            approx = float(jax.jit(approx_quantile_abs,
+                                   static_argnums=2)(x, q, 2048))
+            bin_w = float(jnp.max(jnp.abs(x))) / 2048
+            assert abs(approx - exact) <= 2 * bin_w + 1e-9, (q, scale)
+    g = jnp.asarray(rng.normal(size=(1000,)), jnp.float32)
+    qa = quantize_array(g, n_bins=16, quant_threshold=0.5, approx=True)
+    frac_zero = float((qa == 0).mean())
+    assert 0.4 < frac_zero < 0.6
+
+
 def test_dp_end_to_end_round(synth_dataset, mesh8, tmp_path):
     """Local DP + global DP flow through a full DGA round."""
     from msrflute_tpu.config import FLUTEConfig

@@ -65,6 +65,8 @@ def main():
     ap.add_argument("--out", default="./data")
     ap.add_argument("--users", type=int, default=25)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--alpha", type=float, default=0.5,
+                    help="Dirichlet concentration for the non-IID cv task")
     args = ap.parse_args()
     rng = np.random.default_rng(args.seed)
     task, out, users = args.task, args.out, args.users
@@ -112,11 +114,59 @@ def main():
             r = np.random.default_rng(seed)
             _write(os.path.join(out, "ecg", f"{split}.json"),
                    _image_blob(r, users, 8, 24, (187,), 5))
-    elif task in ("classif_cnn", "cv", "semisupervision"):
+    elif task == "classif_cnn":
         for split, seed in (("train", 0), ("val", 1), ("test", 2)):
             r = np.random.default_rng(seed)
             _write(os.path.join(out, "cifar", f"{split}.json"),
                    _image_blob(r, users, 8, 24, (32, 32, 3), 10))
+    elif task == "cv":
+        # personalization cv: Dirichlet label-skew + per-client rotation
+        # wedges (reference experiments/cv/data.py DataPartitioner)
+        from msrflute_tpu.data.partition import dirichlet_blob
+        for split, seed, n_flat, train in (("train", 0, 24 * users, True),
+                                           ("val", 1, 8 * users, False),
+                                           ("test", 2, 8 * users, False)):
+            r = np.random.default_rng(seed)
+            x = r.normal(size=(n_flat, 32, 32, 3)).round(3)
+            y = r.integers(0, 10, size=n_flat)
+            _write(os.path.join(out, "cifar", f"{split}.json"),
+                   dirichlet_blob(x, y, users, args.alpha, r,
+                                  rotate=True, is_train=train))
+    elif task == "semisupervision":
+        # labeled x/y + unlabeled ux per user; ux_rand is produced at
+        # featurize time by the config's data_config.train.augment
+        for split, seed in (("train_semisup", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            blob = _image_blob(r, users, 8, 24, (32, 32, 3), 10)
+            if split == "train_semisup":
+                for u, n in zip(blob["users"], blob["num_samples"]):
+                    blob["user_data"][u]["ux"] = r.normal(
+                        size=(n, 32, 32, 3)).round(3).tolist()
+            _write(os.path.join(out, "cifar", f"{split}.json"), blob)
+    elif task == "fednewsrec":
+        # MIND-style: per-user click histories + impression slates
+        title_len, vocab = 12, 500
+        def _titles(r, n):
+            return [r.integers(1, vocab, size=int(r.integers(4, title_len))
+                               ).tolist() for _ in range(n)]
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            names = [f"u{i:04d}" for i in range(users)]
+            data, counts = {}, []
+            for u in names:
+                n_imp = int(r.integers(2, 6))
+                imps = []
+                for _ in range(n_imp):
+                    c = int(r.integers(5, 9))
+                    labels = np.zeros(c, int)
+                    labels[r.integers(0, c)] = 1
+                    imps.append({"cands": _titles(r, c),
+                                 "labels": labels.tolist()})
+                data[u] = {"clicked": _titles(r, int(r.integers(3, 10))),
+                           "impressions": imps}
+                counts.append(n_imp)
+            _write(os.path.join(out, "mind", f"{split}.json"),
+                   {"users": names, "num_samples": counts, "user_data": data})
     elif task == "mlm_bert":
         for split, seed in (("train", 0), ("val", 1), ("test", 2)):
             r = np.random.default_rng(seed)
