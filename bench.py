@@ -326,6 +326,18 @@ def main() -> None:
                                         32 if on_tpu else 8, 80, 90, rng),
             eval_every=50),
     }
+    if on_tpu or os.environ.get("BENCH_BF16"):
+        # TPU-native extra: same CNN protocol with bf16 compute (MXU full
+        # rate); baselined against the same published fp32 number
+        protocols["cnn_femnist_bf16"] = dict(
+            cfg=_flute_config({"model_type": "CNN", "num_classes": 62,
+                               "dtype": "bfloat16"}, 20, 0.1, fuse),
+            data=img(64 if on_tpu else 16, 240 if on_tpu else 40,
+                     (28, 28, 1), 62),
+            eval_every=50)
+        BASELINES_SECS_PER_ROUND["cnn_femnist_bf16"] = \
+            BASELINES_SECS_PER_ROUND["cnn_femnist"]
+
     only = os.environ.get("BENCH_PROTOCOLS")  # e.g. "cnn_femnist,lr_mnist"
     if only:
         keep = set(only.split(","))

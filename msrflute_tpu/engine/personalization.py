@@ -127,7 +127,9 @@ class PersonalizationServer(OptimizationServer):
         # round fusion would train local models against stale globals
         if int(self.config.server_config.get("rounds_per_step", 1) or 1) > 1:
             print_rank("personalization forces rounds_per_step=1")
-            self.config.server_config.rounds_per_step = 1
+            # item assignment, NOT setattr: rounds_per_step is an extras
+            # key, and a plain attribute would be invisible to .get()
+            self.config.server_config["rounds_per_step"] = 1
 
     def _round_housekeeping(self, round_no, val_freq, rec_freq):
         super()._round_housekeeping(round_no, val_freq, rec_freq)

@@ -145,6 +145,7 @@ class OptimizationServer:
 
         self._eval_fn = build_eval_fn(task, self.mesh,
                                       self.engine.partition_mode)
+        self._eval_batches_cache: Dict[str, Any] = {}
         self._np_rng = np.random.default_rng(seed)
         self._rng = jax.random.PRNGKey(seed)
         self.run_stats: Dict[str, list] = {
@@ -218,15 +219,42 @@ class OptimizationServer:
         profile_chunk = (0 if max_iteration - self.state.round <=
                          rounds_per_step else 1)
 
+        def chunk_R(r0: int) -> int:
+            until_val = (val_freq - (r0 % val_freq)
+                         if self.val_dataset is not None else max_iteration)
+            until_rec = (rec_freq - (r0 % rec_freq)
+                         if self.test_dataset is not None else max_iteration)
+            return min(rounds_per_step, max_iteration - r0,
+                       until_val, until_rec)
+
+        def pack_chunk(R: int) -> list:
+            # sample the whole chunk first so every round pads to a common
+            # client count (ranged num_clients_per_iteration draws differ)
+            chunk_samples = [self._sample() for _ in range(R)]
+            pad_to = pad_to_mesh(max(len(s) for s in chunk_samples),
+                                 self.mesh)
+            return [pack_round_batches(
+                self.train_dataset, sampled, self.batch_size,
+                self.max_steps, rng=self._np_rng, pad_clients_to=pad_to,
+                desired_max_samples=self.desired_max_samples)
+                for sampled in chunk_samples]
+
+        # prefetch: with fused chunks, the NEXT chunk's host-side sampling
+        # and packing happen right after this chunk's async dispatch, so the
+        # numpy work overlaps device execution instead of serializing with
+        # it.  Disabled when anything host-side runs between chunks that
+        # could interact with sampling/packing order (RL, server replay —
+        # both force rounds_per_step=1 anyway — and subclasses that hook
+        # ``_sample`` against the live global model, e.g. personalization).
+        prefetch_ok = (rounds_per_step > 1 and self.rl is None and
+                       self.server_replay is None and
+                       type(self)._sample is OptimizationServer._sample)
+        prefetched = None  # (R, batches) for the upcoming round_no
+
         round_no = self.state.round
         while round_no < max_iteration:
             tic = time.time()
-            until_val = (val_freq - (round_no % val_freq)
-                         if self.val_dataset is not None else max_iteration)
-            until_rec = (rec_freq - (round_no % rec_freq)
-                         if self.test_dataset is not None else max_iteration)
-            R = min(rounds_per_step, max_iteration - round_no,
-                    until_val, until_rec)
+            R = chunk_R(round_no)
 
             if self.rl is not None:
                 self._run_rl_round(round_no)
@@ -239,15 +267,11 @@ class OptimizationServer:
             server_lrs = [(self.plateau.lr if self.plateau is not None
                            else self.server_lr_schedule(r))
                           for r in range(round_no, round_no + R)]
-            # sample the whole chunk first so every round pads to a common
-            # client count (ranged num_clients_per_iteration draws differ)
-            chunk_samples = [self._sample() for _ in range(R)]
-            pad_to = pad_to_mesh(max(len(s) for s in chunk_samples), self.mesh)
-            batches = [pack_round_batches(
-                self.train_dataset, sampled, self.batch_size,
-                self.max_steps, rng=self._np_rng, pad_clients_to=pad_to,
-                desired_max_samples=self.desired_max_samples)
-                for sampled in chunk_samples]
+            if prefetched is not None and prefetched[0] == R:
+                batches = prefetched[1]
+            else:
+                batches = pack_chunk(R)
+            prefetched = None
 
             self._rng, chunk_rng = jax.random.split(self._rng)
             # flag-gated profiling (reference cProfile hooks, SURVEY §5.1)
@@ -269,6 +293,11 @@ class OptimizationServer:
                 self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
                 leakage_threshold=self.max_allowed_leakage,
                 quant_thresholds=quant_thresholds)
+            # dispatch is async: pack the next chunk NOW, while the device
+            # executes this one (reading ``stats`` below is what blocks)
+            if prefetch_ok and round_no + R < max_iteration:
+                next_R = chunk_R(round_no + R)
+                prefetched = (next_R, pack_chunk(next_R))
             if profile_this:
                 jax.block_until_ready(self.state.params)
                 jax.profiler.stop_trace()
@@ -394,13 +423,9 @@ class OptimizationServer:
     # ------------------------------------------------------------------
     def _val_acc(self) -> float:
         """Validation accuracy (falls back to -loss) for RL rewards."""
-        batches = pack_eval_batches(
-            self.val_dataset,
-            int(self.config.server_config.data_config.val.get("batch_size",
-                                                              self.batch_size)),
-            pad_steps_to_multiple_of=self.mesh.shape[CLIENTS_AXIS])
         metrics = evaluate(self.task, self._eval_fn, self.state.params,
-                           batches, self.mesh, self.engine.partition_mode)
+                           self._packed_eval_batches("val"), self.mesh,
+                           self.engine.partition_mode)
         if "acc" in metrics:
             return float(metrics["acc"].value)
         return -float(metrics["loss"].value)
@@ -497,17 +522,31 @@ class OptimizationServer:
     # ------------------------------------------------------------------
     _last_val: MetricsDict = {}
 
+    def _packed_eval_batches(self, split: str):
+        """Packed ``[T, B, ...]`` eval grid for a split — cached: eval data
+        is static across rounds, so the host-side copy happens once per
+        split instead of on every eval call (the RL path evaluates twice
+        per round, making this the hottest host loop in a wantRL run)."""
+        batches = self._eval_batches_cache.get(split)
+        if batches is None:
+            dataset = self.val_dataset if split == "val" else self.test_dataset
+            batch_cfg = (self.config.server_config.data_config.val
+                         if split == "val"
+                         else self.config.server_config.data_config.test)
+            bs = int(batch_cfg.get("batch_size", self.batch_size))
+            batches = pack_eval_batches(
+                dataset, bs,
+                pad_steps_to_multiple_of=self.mesh.shape[CLIENTS_AXIS])
+            self._eval_batches_cache[split] = batches
+        return batches
+
     def _maybe_eval(self, split: str, round_no: int, force: bool = False) -> bool:
         dataset = self.val_dataset if split == "val" else self.test_dataset
         if dataset is None or len(dataset) == 0:
             return False
-        ndev = self.mesh.shape[CLIENTS_AXIS]
-        batch_cfg = (self.config.server_config.data_config.val if split == "val"
-                     else self.config.server_config.data_config.test)
-        bs = int(batch_cfg.get("batch_size", self.batch_size))
-        batches = pack_eval_batches(dataset, bs, pad_steps_to_multiple_of=ndev)
         metrics = evaluate(self.task, self._eval_fn, self.state.params,
-                           batches, self.mesh, self.engine.partition_mode)
+                           self._packed_eval_batches(split), self.mesh,
+                           self.engine.partition_mode)
         for name, metric in metrics.items():
             log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
 

@@ -63,12 +63,30 @@ class BaseTask:
         return metrics
 
 
-def to_float_image(x: jnp.ndarray) -> jnp.ndarray:
-    """Cast image batches to f32; uint8 pixels normalize to [0, 1] so hosts
+def to_float_image(x: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """Cast image batches to float; uint8 pixels normalize to [0, 1] so hosts
     can ship raw bytes (4x less transfer) and normalization fuses on-device."""
     if x.dtype == jnp.uint8:
-        return x.astype(jnp.float32) * (1.0 / 255.0)
-    return x.astype(jnp.float32)
+        return x.astype(dtype) * (1.0 / 255.0)
+    return x.astype(dtype)
+
+
+def parse_dtype(model_config):
+    """``model_config.dtype`` -> jnp dtype for activations/compute.
+
+    TPU-native knob with no reference equivalent: ``bfloat16`` runs the
+    matmuls/convs on the MXU at full rate while parameters (and the
+    loss/metric math, which tasks upcast) stay float32 — the standard
+    mixed-precision recipe.
+    """
+    name = str(model_config.get("dtype", "float32") or "float32").lower()
+    table = {"float32": jnp.float32, "f32": jnp.float32,
+             "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+             "float16": jnp.float16, "f16": jnp.float16}
+    if name not in table:
+        raise ValueError(f"model_config.dtype={name!r}; "
+                         f"expected one of {sorted(table)}")
+    return table[name]
 
 
 def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:

@@ -11,24 +11,26 @@ jax default precision; parameters stay float32.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from ..utils.metrics import Metric
-from .base import BaseTask, Batch, masked_mean, softmax_xent, to_float_image
+from .base import (BaseTask, Batch, masked_mean, parse_dtype, softmax_xent,
+                   to_float_image)
 
 
 class _LRModule(nn.Module):
     num_classes: int = 10
     input_dim: int = 784
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = to_float_image(x).reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes)(x)
+        x = to_float_image(x, self.dtype).reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
 class _CNNFEMNISTModule(nn.Module):
@@ -36,22 +38,23 @@ class _CNNFEMNISTModule(nn.Module):
     conv5x5x32 -> pool -> conv5x5x64 -> pool -> fc2048 -> fc62."""
 
     num_classes: int = 62
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         if x.ndim == 3:
             x = x[..., None]
-        x = to_float_image(x)
-        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = to_float_image(x, self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(2048)(x)
+        x = nn.Dense(2048, dtype=self.dtype)(x)
         x = nn.relu(x)
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
 class _CIFARCNNModule(nn.Module):
@@ -59,18 +62,19 @@ class _CIFARCNNModule(nn.Module):
     conv3x32 -> conv3x64 -> pool -> conv3x64 -> fc64 -> fc10."""
 
     num_classes: int = 10
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = to_float_image(x)
-        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = to_float_image(x, self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(64)(x))
-        return nn.Dense(self.num_classes)(x)
+        x = nn.relu(nn.Dense(64, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
 class ClassificationTask(BaseTask):
@@ -90,7 +94,9 @@ class ClassificationTask(BaseTask):
         return self.module.init(rng, dummy)["params"]
 
     def apply(self, params, x):
-        return self.module.apply({"params": params}, x)
+        # logits upcast: with a bfloat16 compute dtype the matmuls run on
+        # the MXU in bf16, but softmax/xent/metric math stays float32
+        return self.module.apply({"params": params}, x).astype(jnp.float32)
 
     def predict(self, params, batch: Batch):
         """Concatenatable eval outputs (the reference's
@@ -188,7 +194,8 @@ def make_lr_task(model_config) -> ClassificationTask:
     num_classes = int(model_config.get("num_classes", 10))
     input_dim = int(model_config.get("input_dim", 784))
     return ClassificationTask(
-        _LRModule(num_classes=num_classes, input_dim=input_dim),
+        _LRModule(num_classes=num_classes, input_dim=input_dim,
+                  dtype=parse_dtype(model_config)),
         example_shape=(input_dim,), name="cv_lr_mnist", num_classes=num_classes)
 
 
@@ -196,7 +203,8 @@ def make_cnn_femnist_task(model_config) -> ClassificationTask:
     num_classes = int(model_config.get("num_classes", 62))
     side = int(model_config.get("image_size", 28))
     return ClassificationTask(
-        _CNNFEMNISTModule(num_classes=num_classes),
+        _CNNFEMNISTModule(num_classes=num_classes,
+                          dtype=parse_dtype(model_config)),
         example_shape=(side, side, 1), name="cv_cnn_femnist",
         num_classes=num_classes)
 
@@ -204,6 +212,7 @@ def make_cnn_femnist_task(model_config) -> ClassificationTask:
 def make_cifar_cnn_task(model_config) -> ClassificationTask:
     num_classes = int(model_config.get("num_classes", 10))
     return ClassificationTask(
-        _CIFARCNNModule(num_classes=num_classes),
+        _CIFARCNNModule(num_classes=num_classes,
+                        dtype=parse_dtype(model_config)),
         example_shape=(32, 32, 3), name="classif_cnn",
         num_classes=num_classes, with_f1=True)

@@ -19,28 +19,29 @@ batches, no ``pack_padded_sequence``.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from ..utils.metrics import Metric
-from .base import BaseTask, Batch, softmax_xent
+from .base import BaseTask, Batch, parse_dtype, softmax_xent
 
 
 class _ShakespeareLSTM(nn.Module):
     vocab_size: int = 90
     embed_dim: int = 8
     hidden: int = 256
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):  # x: [B, L] int32
-        emb = nn.Embed(self.vocab_size, self.embed_dim)(x)
+        emb = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(x)
         h = emb
         for _ in range(2):
-            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
-        return nn.Dense(self.vocab_size)(h)  # [B, L, V]
+            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype))(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype)(h)  # [B, L, V]
 
 
 class _ConvexGRUCell(nn.Module):
@@ -135,7 +136,10 @@ class SequenceLMTask(BaseTask):
                 tok_mask = tok_mask.astype(jnp.float32)[:, 1:]
             else:
                 tok_mask = (targets != 0).astype(jnp.float32)
-        logits = self.module.apply({"params": params}, inputs)
+        # f32 logits regardless of the module's compute dtype (bf16 MXU
+        # matmuls, float32 softmax/xent — see models.base.parse_dtype)
+        logits = self.module.apply({"params": params},
+                                   inputs).astype(jnp.float32)
         tok_mask = tok_mask * batch["sample_mask"][:, None]
         return logits, targets, tok_mask
 
@@ -253,7 +257,8 @@ def make_shakespeare_lstm_task(model_config) -> SequenceLMTask:
     module = _ShakespeareLSTM(
         vocab_size=vocab,
         embed_dim=int(model_config.get("embed_dim", 8)),
-        hidden=int(model_config.get("hidden_dim", 256)))
+        hidden=int(model_config.get("hidden_dim", 256)),
+        dtype=parse_dtype(model_config))
     return ShakespeareTask(module,
                            seq_len=int(model_config.get("seq_len", 80)),
                            name="nlp_rnn_fedshakespeare")

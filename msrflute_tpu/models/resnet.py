@@ -12,12 +12,12 @@ the ImageNet-style 7x7/stride-2 + maxpool of the reference; CIFAR inputs
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .base import to_float_image
+from .base import parse_dtype, to_float_image
 from .cv import ClassificationTask
 
 
@@ -27,9 +27,11 @@ _he_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
 
 def _gn(channels: int, channels_per_group: int = 32,
-        zero_scale: bool = False) -> nn.GroupNorm:
+        zero_scale: bool = False, dtype=jnp.float32) -> nn.GroupNorm:
     groups = max(channels // max(channels_per_group, 1), 1)
-    return nn.GroupNorm(num_groups=groups,
+    # flax GroupNorm computes its statistics in float32 regardless of
+    # ``dtype``; passing the compute dtype only keeps activations bf16
+    return nn.GroupNorm(num_groups=groups, dtype=dtype,
                         scale_init=(nn.initializers.zeros if zero_scale
                                     else nn.initializers.ones))
 
@@ -38,26 +40,31 @@ class _BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     channels_per_group: int = 32
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
-                    padding=1, use_bias=False, kernel_init=_he_init)(x)
-        y = _gn(self.planes, self.channels_per_group)(y)
+                    padding=1, use_bias=False, kernel_init=_he_init,
+                    dtype=self.dtype)(x)
+        y = _gn(self.planes, self.channels_per_group, dtype=self.dtype)(y)
         y = nn.relu(y)
         y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
-                    kernel_init=_he_init)(y)
+                    kernel_init=_he_init, dtype=self.dtype)(y)
         # block-final norm scale starts at zero so every block begins as
         # identity (the reference's zero_init_residual,
         # ``model.py:148-152``) — without it the 8-block stack amplifies
         # activations and early SGD diverges
-        y = _gn(self.planes, self.channels_per_group, zero_scale=True)(y)
+        y = _gn(self.planes, self.channels_per_group, zero_scale=True,
+                dtype=self.dtype)(y)
         if residual.shape[-1] != self.planes or self.stride != 1:
             residual = nn.Conv(self.planes, (1, 1),
                                strides=(self.stride, self.stride),
-                               use_bias=False, kernel_init=_he_init)(x)
-            residual = _gn(self.planes, self.channels_per_group)(residual)
+                               use_bias=False, kernel_init=_he_init,
+                               dtype=self.dtype)(x)
+            residual = _gn(self.planes, self.channels_per_group,
+                           dtype=self.dtype)(residual)
         return nn.relu(y + residual)
 
 
@@ -65,13 +72,14 @@ class _ResNetGN(nn.Module):
     stage_sizes: Sequence[int] = (2, 2, 2, 2)  # ResNet-18
     num_classes: int = 100
     channels_per_group: int = 32
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = to_float_image(x)
+        x = to_float_image(x, self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
-                    kernel_init=_he_init)(x)
-        x = _gn(64, self.channels_per_group)(x)
+                    kernel_init=_he_init, dtype=self.dtype)(x)
+        x = _gn(64, self.channels_per_group, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         planes = 64
@@ -79,10 +87,10 @@ class _ResNetGN(nn.Module):
             for block in range(blocks):
                 stride = 2 if stage > 0 and block == 0 else 1
                 x = _BasicBlock(planes, stride,
-                                self.channels_per_group)(x)
+                                self.channels_per_group, self.dtype)(x)
             planes *= 2
         x = jnp.mean(x, axis=(1, 2))  # global average pool
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
 def make_resnet_task(model_config) -> ClassificationTask:
@@ -92,7 +100,8 @@ def make_resnet_task(model_config) -> ClassificationTask:
         int(model_config.get("depth", 18))]
     module = _ResNetGN(
         stage_sizes=depth, num_classes=num_classes,
-        channels_per_group=int(model_config.get("channels_per_group", 32)))
+        channels_per_group=int(model_config.get("channels_per_group", 32)),
+        dtype=parse_dtype(model_config))
     return ClassificationTask(module, example_shape=(side, side, 3),
                               name="cv_resnet_fedcifar100",
                               num_classes=num_classes)
