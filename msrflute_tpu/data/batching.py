@@ -97,19 +97,30 @@ def pack_round_batches(
     client_ids = np.full((K_pad,), -1, dtype=np.int32)
 
     cap = S * B if desired_max_samples is None else min(S * B, desired_max_samples)
+    users, takes = [], []
     for j, ci in enumerate(client_indices):
         user = dataset.user_arrays(ci)
         n = len(next(iter(user.values())))
         order = rng.permutation(n) if shuffle else np.arange(n)
         take = order[:cap]
+        users.append(user)
+        takes.append(take)
         t = len(take)
-        for k, arr in user.items():
-            flat = arrays[k][j].reshape((S * B,) + arr.shape[1:])
-            flat[:t] = arr[take]
         sample_mask[j].reshape(-1)[:t] = 1.0
         num_samples[j] = t
         client_mask[j] = 1.0
         client_ids[j] = ci
+
+    # row gather: the native packer memcpy's all clients in parallel (the
+    # runtime analogue of the reference's DataLoader worker collation);
+    # numpy fallback is identical, just single-threaded
+    from ..native import gather_rows
+    for k, shape in spec.items():
+        dst = arrays[k].reshape((K_pad, S * B) + shape)
+        srcs = [np.asarray(u[k]) for u in users]
+        if not gather_rows(dst, srcs, takes):
+            for j, (src, take) in enumerate(zip(srcs, takes)):
+                dst[j, :len(take)] = src[take]
     return RoundBatch(arrays, sample_mask, num_samples, client_mask, client_ids)
 
 

@@ -1,0 +1,126 @@
+"""Native runtime components (C++ via ctypes; no build-time deps).
+
+The compute path is jax/XLA/Pallas; what stays native here is the host
+runtime around it — currently the parallel round-batch packer
+(:mod:`packer.cpp`), the analogue of the reference's native DataLoader
+collation workers.  Everything degrades gracefully to the numpy
+implementation when the shared library is absent (zero-install default)
+or ``MSRFLUTE_NATIVE=0``.
+
+The library is built on demand with the toolchain's ``g++`` (inline in
+:func:`_build`: ``g++ -O3 -shared -fPIC -std=c++17 -pthread``) and the
+``_packer.so`` is cached next to this file, rebuilt when the source is
+newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_packer.so")
+_SRC_PATH = os.path.join(_HERE, "packer.cpp")
+
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    """Compile packer.cpp -> _packer.so with g++ (cached)."""
+    try:
+        if os.path.exists(_SO_PATH) and \
+                os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH):
+            return True
+    except OSError:
+        # cached .so without its source: still usable
+        return os.path.exists(_SO_PATH)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             _SRC_PATH, "-o", _SO_PATH + ".tmp"],
+            check=True, capture_output=True, timeout=120)
+        os.replace(_SO_PATH + ".tmp", _SO_PATH)
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    if os.environ.get("MSRFLUTE_NATIVE", "1") == "0" or not _build():
+        _lib_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.pack_gather_rows.restype = None
+        # addresses travel as void*; c_char_p would copy the buffer CONTENT
+        # when assigned, not the pointer
+        lib.pack_gather_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64]
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def gather_rows(dst: np.ndarray, srcs: List[np.ndarray],
+                takes: List[np.ndarray], n_threads: int = 0) -> bool:
+    """Copy ``srcs[j][takes[j]]`` into ``dst[j, :len(takes[j])]`` for all
+    clients in parallel.  ``dst`` is ``[K, slots, *feat]`` and must be
+    C-contiguous and pre-zeroed; each ``srcs[j]`` is ``[n_j, *feat]``.
+
+    Returns False (caller should fall back to numpy) when the native lib
+    is unavailable or the arrays don't meet the layout contract.
+    """
+    lib = _load()
+    if lib is None or dst.ndim < 2 or not dst.flags.c_contiguous:
+        return False
+    K = len(srcs)
+    if K == 0 or K > dst.shape[0] or len(takes) != K:
+        return False
+    row_bytes = int(np.prod(dst.shape[2:], dtype=np.int64)) * dst.itemsize
+    if row_bytes <= 0:
+        return False
+    src_ptrs = (ctypes.c_void_p * K)()
+    counts = np.empty((K,), np.int64)
+    offsets = np.empty((K,), np.int64)
+    flat_takes: List[np.ndarray] = []
+    pos = 0
+    for j, (src, take) in enumerate(zip(srcs, takes)):
+        src = np.ascontiguousarray(src)
+        srcs[j] = src  # keep the contiguous copy alive for the call
+        if src.dtype != dst.dtype or \
+                src.shape[1:] != dst.shape[2:] or len(take) > dst.shape[1]:
+            return False
+        take = np.asarray(take, np.int64)
+        if take.size and (take.min() < 0 or take.max() >= len(src)):
+            return False
+        src_ptrs[j] = src.ctypes.data
+        counts[j] = take.size
+        offsets[j] = pos
+        flat_takes.append(take)
+        pos += take.size
+    all_takes = (np.concatenate(flat_takes) if pos
+                 else np.empty((0,), np.int64))
+    lib.pack_gather_rows(
+        src_ptrs, ctypes.c_void_p(dst.ctypes.data),
+        all_takes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        K, dst.shape[1], row_bytes, n_threads)
+    return True

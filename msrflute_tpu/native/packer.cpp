@@ -1,0 +1,75 @@
+// Native round-batch packer.
+//
+// Parity target: the reference feeds its trainers through torch
+// DataLoaders whose collation runs in native worker code; here the
+// analogous host hot loop is pack_round_batches' per-client gather into
+// the static [K, S, B, ...] grid (msrflute_tpu/data/batching.py).  numpy's
+// fancy-indexing gather is C-speed but single-threaded; at K=hundreds of
+// clients x MBs each it serializes on one core.  This packer memcpy's all
+// clients' selected rows in parallel.
+//
+// Built on demand by __init__.py::_build (g++ -O3 -shared -fPIC -std=c++17
+// -pthread, no dependencies).  ABI: one flat C function so ctypes can call
+// it with plain pointers.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows into a padded destination grid, parallel over clients.
+//
+//   srcs[j]        base pointer of client j's source array
+//                  ([n_j, row_bytes] row-major)
+//   dst            base of the destination grid
+//                  ([K, slots, row_bytes] row-major, pre-zeroed)
+//   takes          concatenated row indices; client j's indices are
+//                  takes[offsets[j]] .. takes[offsets[j] + counts[j])
+//   counts[j]      number of rows to copy for client j (<= slots)
+//   offsets[j]     start of client j's indices within `takes`
+//   K              number of clients
+//   slots          destination capacity per client (S * B)
+//   row_bytes      bytes per sample row (product of feature dims * itemsize)
+//   n_threads      worker threads (<=0 -> hardware_concurrency)
+void pack_gather_rows(const char** srcs, char* dst, const int64_t* takes,
+                      const int64_t* counts, const int64_t* offsets,
+                      int64_t K, int64_t slots, int64_t row_bytes,
+                      int64_t n_threads) {
+  if (K <= 0 || row_bytes <= 0) return;
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int workers = n_threads > 0 ? static_cast<int>(n_threads)
+                              : (hw > 0 ? hw : 4);
+  if (workers > K) workers = static_cast<int>(K);
+
+  auto run = [&](int64_t j0, int64_t j1) {
+    for (int64_t j = j0; j < j1; ++j) {
+      const char* src = srcs[j];
+      char* out = dst + j * slots * row_bytes;
+      const int64_t* take = takes + offsets[j];
+      const int64_t t = counts[j];
+      for (int64_t r = 0; r < t; ++r) {
+        std::memcpy(out + r * row_bytes, src + take[r] * row_bytes,
+                    static_cast<size_t>(row_bytes));
+      }
+    }
+  };
+
+  if (workers <= 1) {
+    run(0, K);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  int64_t chunk = (K + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    int64_t j0 = w * chunk;
+    int64_t j1 = j0 + chunk < K ? j0 + chunk : K;
+    if (j0 >= j1) break;
+    pool.emplace_back(run, j0, j1);
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"
