@@ -108,3 +108,8 @@ def _load_builtins() -> None:
         TASK_REGISTRY.setdefault("FEDNEWSREC", fednewsrec.make_fednewsrec_task)
     except ImportError:
         pass
+    try:
+        from . import ringlm
+        TASK_REGISTRY.setdefault("RINGLM", ringlm.make_ringlm_task)
+    except ImportError:
+        pass

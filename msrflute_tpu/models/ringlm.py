@@ -1,0 +1,176 @@
+"""RingLM — long-context causal transformer LM with ring attention.
+
+Net-new vs the reference (FLUTE has no long-context machinery, SURVEY.md
+§5.7).  The model is a standard pre-LN causal transformer; its attention
+runs in one of two modes:
+
+- **local** (default): full softmax attention — used when the model rides
+  the federated round engine (short per-client sequences, clients-axis
+  parallelism);
+- **sequence-parallel**: :func:`msrflute_tpu.ops.ring_attention.
+  ring_self_attention` over a mesh's ``sequence`` axis, optionally combined
+  with a data-parallel batch axis — the long-context central-training path
+  where one sequence doesn't fit a chip.  O(L/N) activation memory per
+  device, N-1 ``ppermute`` rotations per layer.
+
+``build_sp_train_step`` turns a RingLM task into one jitted
+loss+grad+optimizer step over a ``(data, sequence)`` mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ring_attention import ring_self_attention
+from .base import parse_dtype
+from .nlp import SequenceLMTask
+
+
+class _MHA(nn.Module):
+    heads: int
+    head_dim: int
+    dtype: Any = jnp.float32
+    # sequence-parallel mode: mesh + axis names (None = local full softmax)
+    ring_mesh: Optional[Mesh] = None
+    seq_axis: str = "sequence"
+    batch_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):  # [B, L, E]
+        B, L, _ = x.shape
+        H, D = self.heads, self.head_dim
+        qkv = nn.Dense(3 * H * D, use_bias=False, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv.reshape(B, L, 3 * H, D), 3, axis=2)
+        if self.ring_mesh is not None:
+            attn = ring_self_attention(q, k, v, self.ring_mesh,
+                                       axis=self.seq_axis, causal=True,
+                                       batch_axis=self.batch_axis)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+            scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+            mask = jnp.tril(jnp.ones((L, L), bool))
+            scores = jnp.where(mask[None, None], scores,
+                               jnp.finfo(scores.dtype).min)
+            p = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bhlm,bmhd->blhd", p, v)
+        return nn.Dense(x.shape[-1], use_bias=False,
+                        dtype=self.dtype)(attn.reshape(B, L, H * D))
+
+
+class _Block(nn.Module):
+    heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    ring_mesh: Optional[Mesh] = None
+    seq_axis: str = "sequence"
+    batch_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + _MHA(self.heads, self.head_dim, self.dtype, self.ring_mesh,
+                     self.seq_axis, self.batch_axis)(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+
+
+class _RingLM(nn.Module):
+    vocab_size: int = 256
+    embed_dim: int = 64
+    heads: int = 4
+    head_dim: int = 16
+    mlp_dim: int = 256
+    num_layers: int = 2
+    dtype: Any = jnp.float32
+    ring_mesh: Optional[Mesh] = None
+    seq_axis: str = "sequence"
+    batch_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):  # [B, L] int32
+        h = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(x)
+        # additive learned positions (static max length = whatever L is in)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (x.shape[1], self.embed_dim))
+        h = h + pos.astype(self.dtype)[None]
+        for _ in range(self.num_layers):
+            h = _Block(self.heads, self.head_dim, self.mlp_dim, self.dtype,
+                       self.ring_mesh, self.seq_axis, self.batch_axis)(h)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype)(h)
+
+
+class RingLMTask(SequenceLMTask):
+    """Causal-LM task over the RingLM module (local attention mode — the
+    federated engine path).  ``sp_module(mesh)`` clones the module into
+    sequence-parallel mode for long-context training."""
+
+    def sp_module(self, mesh: Mesh, seq_axis: str = "sequence",
+                  batch_axis: Optional[str] = None) -> _RingLM:
+        return self.module.clone(ring_mesh=mesh, seq_axis=seq_axis,
+                                 batch_axis=batch_axis)
+
+
+def make_ringlm_task(model_config) -> RingLMTask:
+    module = _RingLM(
+        vocab_size=int(model_config.get("vocab_size", 256)),
+        embed_dim=int(model_config.get("embed_dim", 64)),
+        heads=int(model_config.get("num_heads", 4)),
+        head_dim=int(model_config.get("head_dim", 16)),
+        mlp_dim=int(model_config.get("mlp_dim", 256)),
+        num_layers=int(model_config.get("num_layers", 2)),
+        dtype=parse_dtype(model_config))
+    return RingLMTask(module,
+                      seq_len=int(model_config.get("seq_len", 128)),
+                      name="ringlm")
+
+
+def build_sp_train_step(task: RingLMTask, mesh: Mesh,
+                        learning_rate: float = 1e-3,
+                        seq_axis: str = "sequence",
+                        batch_axis: Optional[str] = None):
+    """One jitted sequence-parallel training step.
+
+    Returns ``(step, init)``: ``init(rng, batch_shape)`` builds replicated
+    params + optimizer state; ``step(params, opt_state, tokens)`` shards
+    ``tokens [B, L]`` over ``(batch_axis, seq_axis)``, runs loss+grad with
+    ring attention (XLA differentiates through the ppermute ring), and
+    applies an adam update.  Gradients are summed across the mesh by XLA's
+    sharding propagation — no hand-written collectives.
+    """
+    sp_mod = task.sp_module(mesh, seq_axis=seq_axis, batch_axis=batch_axis)
+    tx = optax.adam(learning_rate)
+    token_sharding = NamedSharding(mesh, P(batch_axis, seq_axis))
+
+    def init(rng, seq_len: int):
+        dummy = jnp.zeros((1, seq_len - 1), jnp.int32)
+        params = task.module.init(rng, dummy)["params"]
+        return params, tx.init(params)
+
+    def loss_fn(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = sp_mod.apply({"params": params},
+                              inputs).astype(jnp.float32)
+        mask = (targets != 0).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, token_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step, init

@@ -18,8 +18,8 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import sys
-from typing import List, Optional
+import tempfile
+from typing import List
 
 import numpy as np
 
@@ -40,14 +40,24 @@ def _build() -> bool:
     except OSError:
         # cached .so without its source: still usable
         return os.path.exists(_SO_PATH)
+    tmp = None
     try:
+        # unique tmp per process: concurrent builders must not share an
+        # output inode, or one g++ keeps writing into the installed file
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-             _SRC_PATH, "-o", _SO_PATH + ".tmp"],
+             _SRC_PATH, "-o", tmp],
             check=True, capture_output=True, timeout=120)
-        os.replace(_SO_PATH + ".tmp", _SO_PATH)
+        os.replace(tmp, _SO_PATH)
         return True
     except Exception:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 
@@ -100,10 +110,11 @@ def gather_rows(dst: np.ndarray, srcs: List[np.ndarray],
     counts = np.empty((K,), np.int64)
     offsets = np.empty((K,), np.int64)
     flat_takes: List[np.ndarray] = []
+    keep_alive: List[np.ndarray] = []  # pins contiguous copies for the call
     pos = 0
     for j, (src, take) in enumerate(zip(srcs, takes)):
         src = np.ascontiguousarray(src)
-        srcs[j] = src  # keep the contiguous copy alive for the call
+        keep_alive.append(src)
         if src.dtype != dst.dtype or \
                 src.shape[1:] != dst.shape[2:] or len(take) > dst.shape[1]:
             return False

@@ -87,11 +87,17 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
 
 def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         mesh: Mesh, axis: str = SEQUENCE_AXIS,
-                        causal: bool = False) -> jnp.ndarray:
+                        causal: bool = False,
+                        batch_axis: str = None) -> jnp.ndarray:
     """Exact attention with GLOBAL q/k/v ``[B, L, H, D]`` sharded on L over
     ``axis``.  Returns the output with the same sharding.  Must be called
     outside shard_map (it applies its own); inside a shard_map body use
-    :func:`ring_attention_local`."""
+    :func:`ring_attention_local`.
+
+    ``batch_axis`` additionally shards B over another mesh axis (combined
+    data + sequence parallelism): the ring rotations stay within each
+    batch shard's ring, no cross-batch communication.
+    """
     n = mesh.shape[axis]
     L = q.shape[1]
     if k.shape[1] != L or v.shape[1] != L:
@@ -99,8 +105,11 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             f"q/k/v sequence lengths differ: {L}, {k.shape[1]}, {v.shape[1]}")
     if L % n:
         raise ValueError(f"sequence length {L} not divisible by {axis}={n}")
+    if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
+        raise ValueError(f"batch {q.shape[0]} not divisible by "
+                         f"{batch_axis}={mesh.shape[batch_axis]}")
     chunk = L // n
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
 
     def body(q_l, k_l, v_l):
         idx = jax.lax.axis_index(axis)

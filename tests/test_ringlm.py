@@ -1,0 +1,89 @@
+"""RingLM — long-context LM whose attention can run sequence-parallel.
+
+Checks: local (full-softmax) and ring (sequence-parallel) modes agree
+numerically; the jitted dp x sp training step runs and learns; the task
+also rides the ordinary federated engine in local mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from msrflute_tpu.config import FLUTEConfig, ModelConfig
+from msrflute_tpu.models import make_task
+
+MC = {"vocab_size": 40, "embed_dim": 32, "num_heads": 2, "head_dim": 8,
+      "mlp_dim": 64, "num_layers": 2, "seq_len": 33}
+
+
+@pytest.fixture(scope="module")
+def task():
+    return make_task(ModelConfig(model_type="RINGLM", extra=MC))
+
+
+def test_sp_mode_matches_local(task):
+    """Ring attention inside the full model == full softmax attention."""
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "sequence"))
+    params = task.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).integers(1, 40, size=(4, 32)),
+                    jnp.int32)
+    local = task.module.apply({"params": params}, x)
+    sp = task.sp_module(mesh, batch_axis="data").apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(local), np.asarray(sp),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_train_step_learns(task):
+    from msrflute_tpu.models.ringlm import build_sp_train_step
+    devs = np.asarray(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "sequence"))
+    step, init = build_sp_train_step(task, mesh, learning_rate=3e-3,
+                                     batch_axis="data")
+    params, opt_state = init(jax.random.PRNGKey(0), MC["seq_len"])
+    rng = np.random.default_rng(0)
+    # learnable structure: token t+1 = (t + 1) % 13, offset per sequence
+    tokens = np.zeros((8, MC["seq_len"]), np.int32)
+    for b in range(8):
+        start = int(rng.integers(1, 13))
+        tokens[b] = (start + np.arange(MC["seq_len"])) % 13 + 1
+    tokens = jnp.asarray(tokens)
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.5 * losses[0], losses[::10]
+
+
+def test_ringlm_federated_round(synth_dataset, mesh8, tmp_path):
+    """Local-attention mode through the ordinary federated engine."""
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+    rng = np.random.default_rng(0)
+    users = [f"u{i}" for i in range(8)]
+    per_user = [{"x": rng.integers(1, 40, size=(6, 33)).astype(np.int32)}
+                for _ in users]
+    ds = ArraysDataset(users, per_user)
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "RINGLM", **MC},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 2, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.1,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2, "initial_val": False,
+            "data_config": {"val": {"batch_size": 8}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.1},
+            "data_config": {"train": {"batch_size": 3}},
+        },
+    })
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    state = server.train()
+    assert state.round == 2
+    assert "loss" in server.best_val
