@@ -29,7 +29,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ring_attention import ring_self_attention
-from .base import parse_dtype
+from .base import masked_mean, parse_dtype, softmax_xent
 from .nlp import SequenceLMTask
 
 
@@ -151,20 +151,24 @@ def build_sp_train_step(task: RingLMTask, mesh: Mesh,
     sp_mod = task.sp_module(mesh, seq_axis=seq_axis, batch_axis=batch_axis)
     tx = optax.adam(learning_rate)
     token_sharding = NamedSharding(mesh, P(batch_axis, seq_axis))
+    replicated = NamedSharding(mesh, P())
 
     def init(rng, seq_len: int):
-        dummy = jnp.zeros((1, seq_len - 1), jnp.int32)
-        params = task.module.init(rng, dummy)["params"]
-        return params, tx.init(params)
+        # init through the SEQUENCE-PARALLEL module: the local module's
+        # full-softmax forward would materialize O(L^2) scores on one
+        # device — the very thing this path exists to avoid at long L
+        b = mesh.shape[batch_axis] if batch_axis is not None else 1
+        dummy = jnp.zeros((b, seq_len - 1), jnp.int32)
+        params = sp_mod.init(rng, dummy)["params"]
+        params = jax.device_put(params, replicated)
+        return params, jax.jit(tx.init, out_shardings=replicated)(params)
 
     def loss_fn(params, tokens):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         logits = sp_mod.apply({"params": params},
                               inputs).astype(jnp.float32)
         mask = (targets != 0).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return masked_mean(softmax_xent(logits, targets), mask)
 
     @jax.jit
     def step(params, opt_state, tokens):

@@ -88,7 +88,7 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
 def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         mesh: Mesh, axis: str = SEQUENCE_AXIS,
                         causal: bool = False,
-                        batch_axis: str = None) -> jnp.ndarray:
+                        batch_axis: "str | None" = None) -> jnp.ndarray:
     """Exact attention with GLOBAL q/k/v ``[B, L, H, D]`` sharded on L over
     ``axis``.  Returns the output with the same sharding.  Must be called
     outside shard_map (it applies its own); inside a shard_map body use
@@ -105,9 +105,13 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             f"q/k/v sequence lengths differ: {L}, {k.shape[1]}, {v.shape[1]}")
     if L % n:
         raise ValueError(f"sequence length {L} not divisible by {axis}={n}")
-    if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
-        raise ValueError(f"batch {q.shape[0]} not divisible by "
-                         f"{batch_axis}={mesh.shape[batch_axis]}")
+    if batch_axis is not None:
+        if batch_axis not in mesh.shape:
+            raise ValueError(f"batch_axis {batch_axis!r} not in mesh axes "
+                             f"{tuple(mesh.shape)}")
+        if q.shape[0] % mesh.shape[batch_axis]:
+            raise ValueError(f"batch {q.shape[0]} not divisible by "
+                             f"{batch_axis}={mesh.shape[batch_axis]}")
     chunk = L // n
     spec = P(batch_axis, axis, None, None)
 

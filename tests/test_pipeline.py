@@ -1,0 +1,109 @@
+"""SPMD microbatch pipeline — matches sequential stage application exactly,
+differentiates, and composes into a jitted training step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+
+@pytest.fixture(scope="module")
+def stage_mesh():
+    return Mesh(np.asarray(jax.devices()), ("stage",))
+
+
+def _mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _stack_params(rng, n_stages, dim, hidden):
+    ws = {
+        "w1": rng.normal(size=(n_stages, dim, hidden)) * 0.3,
+        "b1": rng.normal(size=(n_stages, hidden)) * 0.1,
+        "w2": rng.normal(size=(n_stages, hidden, dim)) * 0.3,
+        "b2": rng.normal(size=(n_stages, dim)) * 0.1,
+    }
+    return {k: jnp.asarray(v, jnp.float32) for k, v in ws.items()}
+
+
+def _sequential(params, mbs):
+    out = []
+    n = params["w1"].shape[0]
+    for m in range(mbs.shape[0]):
+        x = mbs[m]
+        for i in range(n):
+            x = _mlp_stage(jax.tree.map(lambda a: a[i], params), x)
+        out.append(x)
+    return jnp.stack(out)
+
+
+def test_pipeline_matches_sequential(stage_mesh):
+    from msrflute_tpu.ops.pipeline import pipeline_apply
+    rng = np.random.default_rng(0)
+    n = stage_mesh.shape["stage"]
+    params = _stack_params(rng, n, dim=6, hidden=10)
+    mbs = jnp.asarray(rng.normal(size=(12, 4, 6)), jnp.float32)
+    out = pipeline_apply(_mlp_stage, params, mbs, stage_mesh)
+    ref = _sequential(params, mbs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_match(stage_mesh):
+    from msrflute_tpu.ops.pipeline import pipeline_apply
+    rng = np.random.default_rng(1)
+    n = stage_mesh.shape["stage"]
+    params = _stack_params(rng, n, dim=4, hidden=6)
+    mbs = jnp.asarray(rng.normal(size=(9, 2, 4)), jnp.float32)
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(_mlp_stage, p, mbs, stage_mesh) ** 2)
+
+    def loss_seq(p):
+        return jnp.sum(_sequential(p, mbs) ** 2)
+
+    g1 = jax.grad(loss_pipe)(params)
+    g2 = jax.grad(loss_seq)(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_jitted_train_step(stage_mesh):
+    """One jitted SGD step through the pipeline schedule runs and reduces
+    the loss on a fixed regression target."""
+    from msrflute_tpu.ops.pipeline import pipeline_apply
+    rng = np.random.default_rng(2)
+    n = stage_mesh.shape["stage"]
+    params = _stack_params(rng, n, dim=4, hidden=8)
+    mbs = jnp.asarray(rng.normal(size=(8, 4, 4)), jnp.float32)
+    # learnable target: a teacher with different weights (same family)
+    teacher = _stack_params(np.random.default_rng(7), n, dim=4, hidden=8)
+    target = _sequential(teacher, mbs)
+
+    @jax.jit
+    def step(p):
+        def loss(p):
+            return jnp.mean(
+                (pipeline_apply(_mlp_stage, p, mbs, stage_mesh) - target) ** 2)
+        l, g = jax.value_and_grad(loss)(p)
+        return jax.tree.map(lambda w, gg: w - 0.05 * gg, p, g), l
+
+    losses = []
+    for _ in range(40):
+        params, l = step(params)
+        losses.append(float(l))
+    # composes + optimizes: strictly decreasing trend, no NaNs (this is a
+    # schedule test, not a convergence benchmark)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.95 * losses[0], losses[::8]
+
+
+def test_pipeline_rejects_bad_stage_count(stage_mesh):
+    from msrflute_tpu.ops.pipeline import pipeline_apply
+    params = {"w1": jnp.zeros((3, 2, 2)), "b1": jnp.zeros((3, 2)),
+              "w2": jnp.zeros((3, 2, 2)), "b2": jnp.zeros((3, 2))}
+    with pytest.raises(ValueError, match="leading axis"):
+        pipeline_apply(_mlp_stage, params, jnp.zeros((4, 2, 2)), stage_mesh)

@@ -57,7 +57,7 @@ def test_sp_train_step_learns(task):
     assert losses[-1] < 0.5 * losses[0], losses[::10]
 
 
-def test_ringlm_federated_round(synth_dataset, mesh8, tmp_path):
+def test_ringlm_federated_round(mesh8, tmp_path):
     """Local-attention mode through the ordinary federated engine."""
     from msrflute_tpu.data import ArraysDataset
     from msrflute_tpu.engine import OptimizationServer
