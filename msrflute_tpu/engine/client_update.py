@@ -103,6 +103,12 @@ def build_client_update(task: BaseTask, client_opt_cfg,
     """
     tx = make_optimizer(client_opt_cfg)
     freeze = hparams.freeze_layers
+    # NOTE on rematerialization: each local step's grad is taken inside the
+    # step scan, so wrapping task.loss in jax.checkpoint here would buy no
+    # peak-HBM reduction (the step's own residuals still materialize).
+    # Remat belongs INSIDE the model, per block — see model_config.remat
+    # (models/ringlm.py, nn.remat around the transformer block).
+    loss_fn = task.loss
 
     def _updatable_mask(params):
         """Per-leaf PYTHON bools from the updatable_layers regex allowlist
@@ -139,7 +145,7 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             batch = dict(batch_arrays)
             batch["sample_mask"] = mask
             rng, sub = jax.random.split(rng)
-            (loss, _aux), grads = jax.value_and_grad(task.loss, has_aux=True)(
+            (loss, _aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch, sub, True)
             if hparams.fedprox_mu > 0.0:
                 grads = jax.tree.map(

@@ -100,3 +100,5 @@ def test_dga_strategy_runs(synth_dataset, mesh8, tmp_path):
     assert state.round == 3
     # staleness buffer is threaded state
     assert "stale_grad_sum" in state.strategy_state
+
+

@@ -152,6 +152,28 @@ def test_fednewsrec_e2e_from_config(tmp_path):
     assert any(m["name"] == "Val auc" for m in metrics)
 
 
+def test_ringlm_e2e_from_config(tmp_path):
+    """Long-context RingLM family from raw-text blobs through the CLI
+    (char featurizer; net-new family, docs/architecture.md)."""
+    out = _run_cli("ringlm", {
+        "model_config.embed_dim": 16,
+        "model_config.num_heads": 2,
+        "model_config.head_dim": 8,
+        "model_config.mlp_dim": 32,
+        "model_config.num_layers": 1,
+        "model_config.seq_len": 64,
+        "server_config.max_iteration": 2,
+        "server_config.val_freq": 2,
+        "server_config.rec_freq": 100,
+        "server_config.initial_val": False,
+        "server_config.rounds_per_step": 2,
+        "server_config.data_config.val.batch_size": 8,
+        "client_config.data_config.train.batch_size": 2,
+    }, tmp_path)
+    status = json.loads((out / "models" / "status_log.json").read_text())
+    assert status["i"] == 2
+
+
 def test_shakespeare_e2e_from_config(tmp_path):
     out = _run_cli("nlp_rnn_fedshakespeare", {
         "server_config.max_iteration": 2,

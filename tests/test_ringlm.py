@@ -57,6 +57,26 @@ def test_sp_train_step_learns(task):
     assert losses[-1] < 0.5 * losses[0], losses[::10]
 
 
+def test_remat_matches_plain(task):
+    """model_config.remat (per-block nn.remat) is a pure memory/FLOPs
+    trade — gradients identical to the plain model."""
+    remat_task = make_task(ModelConfig(model_type="RINGLM",
+                                       extra={**MC, "remat": True}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(3).integers(1, 40, size=(4, 33)),
+                    jnp.int32)
+    batch = {"x": x, "sample_mask": jnp.ones((4,), jnp.float32)}
+
+    def loss(t):
+        return lambda p: t.loss(p, batch, jax.random.PRNGKey(0), True)[0]
+
+    g_plain = jax.grad(loss(task))(params)
+    g_remat = jax.grad(loss(remat_task))(params)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_ringlm_federated_round(mesh8, tmp_path):
     """Local-attention mode through the ordinary federated engine."""
     from msrflute_tpu.data import ArraysDataset

@@ -109,6 +109,14 @@ def main():
             r = np.random.default_rng(seed)
             _write(os.path.join(out, "mockup", f"{name}.json"),
                    _text_blob(r, users, 4, 16, sentences))
+    elif task == "ringlm":
+        # long-context documents: repeated phrase soup per user, as raw
+        # text — the char featurizer window-truncates to seq_len
+        for split, seed in (("train", 0), ("val", 1), ("test", 2)):
+            r = np.random.default_rng(seed)
+            docs = [" ".join(r.choice(WORDS, size=200)) for _ in range(16)]
+            _write(os.path.join(out, "longtext", f"{split}.json"),
+                   _text_blob(r, users, 2, 6, docs))
     elif task == "ecg_cnn":
         for split, seed in (("train", 0), ("val", 1), ("test", 2)):
             r = np.random.default_rng(seed)
