@@ -29,32 +29,37 @@ LATEST = "latest_model.msgpack"
 STATUS_LOG = "status_log.json"
 
 
-def _state_to_bytes(state: ServerState) -> bytes:
-    payload = {
+def _payload(state: ServerState) -> dict:
+    """The one checkpointed dict, shared by every backend — add new
+    ServerState fields HERE (and in :func:`_merge`) only."""
+    return {
         "params": state.params,
         "opt_state": state.opt_state,
         "strategy_state": state.strategy_state,
         "round": state.round,
     }
-    return serialization.msgpack_serialize(
-        serialization.to_state_dict(jax.device_get(payload)))
 
 
-def _state_from_bytes(data: bytes, template: ServerState) -> ServerState:
-    target = {
-        "params": jax.device_get(template.params),
-        "opt_state": jax.device_get(template.opt_state),
-        "strategy_state": jax.device_get(template.strategy_state),
-        "round": template.round,
-    }
-    restored = serialization.msgpack_restore(data)
-    merged = serialization.from_state_dict(target, restored)
+def _merge(template: ServerState, restored: dict) -> ServerState:
+    """Restore typed pytrees (optax namedtuples etc.) from a plain
+    state-dict by merging onto the RAW template payload."""
+    merged = serialization.from_state_dict(
+        _payload(template), restored)
     return ServerState(
         params=merged["params"],
         opt_state=merged["opt_state"],
         strategy_state=merged["strategy_state"],
         round=int(restored.get("round", 0)),
     )
+
+
+def _state_to_bytes(state: ServerState) -> bytes:
+    return serialization.msgpack_serialize(
+        serialization.to_state_dict(jax.device_get(_payload(state))))
+
+
+def _state_from_bytes(data: bytes, template: ServerState) -> ServerState:
+    return _merge(template, serialization.msgpack_restore(data))
 
 
 def load_pretrained_params(path: str, template_params,
@@ -78,15 +83,100 @@ def load_pretrained_params(path: str, template_params,
 
 
 class CheckpointManager:
-    """latest/every-N/best checkpoint policy + status log."""
+    """latest/every-N/best checkpoint policy + status log.
 
-    def __init__(self, model_dir: str, backup_freq: int = 100):
+    Backends: ``msgpack`` (default; one flat file, synchronous) or
+    ``orbax`` (``server_config.checkpoint_backend: orbax``) — async saves
+    via ``orbax.checkpoint.AsyncCheckpointer``, so serialization/IO of the
+    previous round's state overlaps the next rounds' device compute (the
+    TPU-framework norm for big models; the reference's torch.save has no
+    async path).
+    """
+
+    def __init__(self, model_dir: str, backup_freq: int = 100,
+                 backend: str = "msgpack"):
         self.model_dir = model_dir
         self.backup_freq = max(int(backup_freq), 1)
+        if backend not in ("msgpack", "orbax"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        self.backend = backend
+        self._orbax = None
+        self._pending_slot = None
+        if backend == "orbax":
+            import orbax.checkpoint as ocp
+            self._ocp = ocp
+            self._orbax = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         os.makedirs(model_dir, exist_ok=True)
+
+    # -- orbax helpers -------------------------------------------------
+    _LATEST_SLOTS = ("latest_model.orbax.a", "latest_model.orbax.b")
+    _LATEST_PTR = "latest_model.orbax.ptr"
+
+    def _orbax_path(self, name: str) -> str:
+        # orbax checkpoints are directories; keep the msgpack names with a
+        # .orbax suffix so both backends can coexist in one model_dir
+        return os.path.join(os.path.abspath(self.model_dir),
+                            name.replace(".msgpack", ".orbax"))
+
+    def _orbax_save(self, path: str, state: ServerState) -> None:
+        # device arrays go straight to orbax: the d2h snapshot happens
+        # inside the async save, not inline on the training loop
+        payload = serialization.to_state_dict(_payload(state))
+        self._orbax.wait_until_finished()  # one in-flight save at a time
+        self._orbax.save(path, args=self._ocp.args.StandardSave(payload),
+                         force=True)
+
+    def _orbax_load(self, path: str,
+                    template: ServerState) -> Optional[ServerState]:
+        if not os.path.isdir(path):
+            return None
+        self._orbax.wait_until_finished()
+        target = serialization.to_state_dict(jax.device_get(
+            _payload(template)))
+        restored = self._orbax.restore(
+            path, args=self._ocp.args.StandardRestore(target))
+        return _merge(template, restored)
+
+    def _commit_pending_latest(self) -> None:
+        """Point the latest-pointer at the slot whose async save has now
+        finished (two-slot scheme: the previous committed slot stays valid
+        through the entire save window, so a crash mid-save never loses
+        the resume anchor — the async analogue of tmp+os.replace)."""
+        if self._pending_slot is None:
+            return
+        self._orbax.wait_until_finished()
+        ptr = os.path.join(self.model_dir, self._LATEST_PTR)
+        tmp = ptr + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(self._pending_slot)
+        os.replace(tmp, ptr)
+        self._pending_slot = None
+
+    def _latest_slot(self) -> Optional[str]:
+        ptr = os.path.join(self.model_dir, self._LATEST_PTR)
+        if not os.path.exists(ptr):
+            return None
+        with open(ptr) as fh:
+            return fh.read().strip()
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable (call before reading
+        checkpoint files externally or at process exit)."""
+        if self._orbax is not None:
+            self._orbax.wait_until_finished()
+            self._commit_pending_latest()
 
     # -- save ----------------------------------------------------------
     def save_latest(self, state: ServerState) -> None:
+        if self.backend == "orbax":
+            self._commit_pending_latest()
+            committed = self._latest_slot()
+            slot = (self._LATEST_SLOTS[1]
+                    if committed == self._LATEST_SLOTS[0]
+                    else self._LATEST_SLOTS[0])
+            self._orbax_save(self._orbax_path(slot), state)
+            self._pending_slot = slot
+            return
         self._write(os.path.join(self.model_dir, LATEST), state)
 
     def backup(self, state: ServerState, round_no: int,
@@ -94,6 +184,21 @@ class CheckpointManager:
         """Every ``backup_freq`` rounds: ``epoch<i>`` copy + snapshots of the
         best-model files (reference ``core/server.py:530-558``)."""
         if round_no % self.backup_freq:
+            return
+        if self.backend == "orbax":
+            self.wait()  # copies must see complete checkpoints
+            slot = self._latest_slot()
+            src = self._orbax_path(slot) if slot else ""
+            if src and os.path.isdir(src):
+                dst = self._orbax_path(f"epoch{round_no}.orbax")
+                if not os.path.isdir(dst):
+                    shutil.copytree(src, dst)
+            for name in best_names:
+                best = self._orbax_path(f"best_val_{name}_model.orbax")
+                dst = self._orbax_path(
+                    f"best_val_{name}_model_epoch{round_no}.orbax")
+                if os.path.isdir(best) and not os.path.isdir(dst):
+                    shutil.copytree(best, dst)
             return
         src = os.path.join(self.model_dir, LATEST)
         if os.path.exists(src):
@@ -108,6 +213,11 @@ class CheckpointManager:
     def save_best(self, state: ServerState, metric_name: str) -> None:
         """Best-val checkpoint on improvement (reference
         ``core/evaluation.py:103-109``)."""
+        if self.backend == "orbax":
+            self._orbax_save(
+                self._orbax_path(f"best_val_{metric_name}_model.orbax"),
+                state)
+            return
         self._write(os.path.join(
             self.model_dir, f"best_val_{metric_name}_model.msgpack"), state)
 
@@ -123,6 +233,14 @@ class CheckpointManager:
     # -- load ----------------------------------------------------------
     def load(self, template: ServerState,
              name: str = LATEST) -> Optional[ServerState]:
+        if self.backend == "orbax":
+            if name == LATEST:
+                self._commit_pending_latest()
+                slot = self._latest_slot()
+                if slot is None:
+                    return None
+                return self._orbax_load(self._orbax_path(slot), template)
+            return self._orbax_load(self._orbax_path(name), template)
         path = os.path.join(self.model_dir, name)
         if not os.path.exists(path):
             return None

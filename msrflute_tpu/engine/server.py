@@ -65,8 +65,9 @@ class OptimizationServer:
         strategy_cls = select_strategy(config.strategy)
         self.strategy = strategy_cls(config, dp)
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
-        self.ckpt = CheckpointManager(model_dir,
-                                      backup_freq=sc.get("model_backup_freq", 100))
+        self.ckpt = CheckpointManager(
+            model_dir, backup_freq=sc.get("model_backup_freq", 100),
+            backend=str(sc.get("checkpoint_backend", "msgpack")))
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -326,6 +327,7 @@ class OptimizationServer:
             if self.server_replay is not None:
                 self._run_server_replay()
             self._round_housekeeping(round_no, val_freq, rec_freq)
+        self.ckpt.wait()  # async checkpoint saves must be durable on return
         self._log_timing()
         return self.state
 

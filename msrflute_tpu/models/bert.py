@@ -51,9 +51,14 @@ class BertMLMTask(BaseTask):
         self.label_smoothing = float(
             training_cfg.get("label_smoothing_factor", 0.0))
         self.mask_token_id = int(bert_cfg.get("mask_token_id", 103))
+        from .base import parse_dtype
+        # compute dtype (bf16 MXU path; HF Flax threads it through every
+        # layer — params stay f32, logits are upcast in the loss)
+        dtype = parse_dtype(bert_cfg if "dtype" in bert_cfg else model_config)
         self._pretrained_params = None
         if path:
-            self.model = FlaxBertForMaskedLM.from_pretrained(path)
+            self.model = FlaxBertForMaskedLM.from_pretrained(path,
+                                                             dtype=dtype)
             self.config = self.model.config
             self._pretrained_params = self.model.params
         else:
@@ -66,7 +71,8 @@ class BertMLMTask(BaseTask):
                                                    4 * hidden)),
                 max_position_embeddings=max(self.seq_len, 512),
             )
-            self.model = FlaxBertForMaskedLM(self.config, _do_init=True)
+            self.model = FlaxBertForMaskedLM(self.config, _do_init=True,
+                                             dtype=dtype)
         self.vocab_size = int(self.config.vocab_size)
 
     # ------------------------------------------------------------------
@@ -91,7 +97,8 @@ class BertMLMTask(BaseTask):
             jnp.broadcast_to(jnp.arange(input_ids.shape[-1]),
                              input_ids.shape),
             None, deterministic=deterministic, return_dict=True, rngs=rngs)
-        return out.logits
+        # f32 logits regardless of compute dtype (bf16 matmuls, f32 xent)
+        return out.logits.astype(jnp.float32)
 
     def apply(self, params, input_ids):
         return self._logits(params, input_ids.astype(jnp.int32),

@@ -111,7 +111,9 @@ class _RingLM(nn.Module):
         block_cls = nn.remat(_Block) if self.remat else _Block
         for i in range(self.num_layers):
             # explicit names keep the param tree identical with remat on
-            # or off (nn.remat's auto-names would prefix "Checkpoint_")
+            # or off (nn.remat's auto-names would prefix "Checkpoint_");
+            # "block_{i}" is the STABLE checkpoint key contract for this
+            # family — renaming breaks every saved RingLM checkpoint
             h = block_cls(self.heads, self.head_dim, self.mlp_dim,
                           self.dtype, self.ring_mesh, self.seq_axis,
                           self.batch_axis, name=f"block_{i}")(h)

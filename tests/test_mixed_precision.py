@@ -41,6 +41,26 @@ def test_bf16_task_params_stay_f32(model_cfg):
     assert all(g.dtype == jnp.float32 for g in jax.tree.leaves(grads))
 
 
+def test_bf16_bert_params_stay_f32():
+    """HF Flax BERT threads the compute dtype; params stay f32 and the
+    (upcast) loss is finite."""
+    mc = {"BERT": {"model": {
+        "vocab_size": 128, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 2, "intermediate_size": 64,
+        "max_seq_length": 16, "mlm_probability": 0.25, "mask_token_id": 4,
+        "dtype": "bfloat16"},
+        "training": {"batch_size": 2, "seed": 0}}}
+    task = make_task(ModelConfig(model_type="BERT", extra=mc))
+    params = task.init_params(jax.random.PRNGKey(0))
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(params))
+    batch = {"x": jnp.asarray(np.random.default_rng(0).integers(
+        5, 128, size=(4, 16)), jnp.int32),
+        "sample_mask": jnp.ones((4,), jnp.float32)}
+    loss, _ = jax.jit(lambda p, b: task.loss(p, b, jax.random.PRNGKey(0),
+                                             True))(params, batch)
+    assert loss.dtype == jnp.float32 and bool(jnp.isfinite(loss))
+
+
 def test_bf16_federated_round_learns(synth_dataset, mesh8, tmp_path):
     """LR in bf16 through the full engine still converges on separable
     data — mixed precision composes with the round program."""
