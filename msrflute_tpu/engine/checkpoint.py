@@ -15,6 +15,7 @@ Format: flax msgpack serialization of the full :class:`ServerState` pytree
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 from typing import Any, Dict, Optional, Tuple
@@ -23,6 +24,7 @@ import jax
 from flax import serialization
 
 from ..utils.io import try_except_save, update_json_log
+from ..utils.logging import print_rank
 from .round import ServerState
 
 LATEST = "latest_model.msgpack"
@@ -68,14 +70,21 @@ def load_pretrained_params(path: str, template_params,
     (reference ``model_config.pretrained_model_path``, ``core/config.py:93``;
     relative paths resolve against ``data_path``, ``core/config.py:744-745``).
 
-    Accepts either a full :class:`ServerState` dump (any file this module
-    wrote — ``latest``/``epoch<i>``/``best_val_*``) or a bare params-pytree
+    Accepts a full :class:`ServerState` dump from EITHER backend (msgpack
+    file or orbax checkpoint directory — anything this module wrote:
+    ``latest``/``epoch<i>``/``best_val_*``) or a bare params-pytree
     msgpack; only the params are taken.
     """
     if not os.path.isabs(path) and not os.path.exists(path) and data_path:
         path = os.path.join(data_path, path)
-    with open(path, "rb") as fh:
-        restored = serialization.msgpack_restore(fh.read())
+    if os.path.isdir(path):
+        # orbax checkpoint directory
+        import orbax.checkpoint as ocp
+        with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as cp:
+            restored = cp.restore(os.path.abspath(path))
+    else:
+        with open(path, "rb") as fh:
+            restored = serialization.msgpack_restore(fh.read())
     target = jax.device_get(template_params)
     if isinstance(restored, dict) and "params" in restored:
         restored = restored["params"]
@@ -91,6 +100,12 @@ class CheckpointManager:
     previous round's state overlaps the next rounds' device compute (the
     TPU-framework norm for big models; the reference's torch.save has no
     async path).
+
+    Async durability contract: a round's checkpoint becomes the committed
+    resume anchor at the NEXT save/load/wait (two-slot + pointer for
+    ``latest``, tmp-dir + rename for ``best``), so a hard crash can lose
+    at most the one most recent round — the inherent async window.  Save
+    failures warn and training continues, mirroring ``try_except_save``.
     """
 
     def __init__(self, model_dir: str, backup_freq: int = 100,
@@ -102,6 +117,7 @@ class CheckpointManager:
         self.backend = backend
         self._orbax = None
         self._pending_slot = None
+        self._pending_renames = []  # [(tmp_dir, final_dir)] after async save
         if backend == "orbax":
             import orbax.checkpoint as ocp
             self._ocp = ocp
@@ -119,12 +135,41 @@ class CheckpointManager:
                             name.replace(".msgpack", ".orbax"))
 
     def _orbax_save(self, path: str, state: ServerState) -> None:
-        # device arrays go straight to orbax: the d2h snapshot happens
-        # inside the async save, not inline on the training loop
+        """Issue one async save (best-effort: failures warn, training goes
+        on — the orbax analogue of the msgpack path's try_except_save)."""
         payload = serialization.to_state_dict(_payload(state))
-        self._orbax.wait_until_finished()  # one in-flight save at a time
-        self._orbax.save(path, args=self._ocp.args.StandardSave(payload),
-                         force=True)
+        self._drain()  # one in-flight save at a time + commit renames
+        try:
+            self._orbax.save(path, args=self._ocp.args.StandardSave(payload),
+                             force=True)
+        except Exception as exc:  # disk-full/NFS blip: warn, keep training
+            print_rank(f"orbax save to {path} failed: {exc!r}",
+                       loglevel=logging.WARNING)
+
+    def _drain(self) -> None:
+        """Finish the in-flight save (tolerating failure) and perform any
+        deferred directory renames."""
+        try:
+            self._orbax.wait_until_finished()
+        except Exception as exc:
+            print_rank(f"async checkpoint save failed: {exc!r}",
+                       loglevel=logging.WARNING)
+            self._pending_slot = None
+            self._pending_renames.clear()
+            return
+        for tmp, final in self._pending_renames:
+            if not os.path.isdir(tmp):
+                continue
+            old = final + ".old"
+            try:
+                if os.path.isdir(final):
+                    os.rename(final, old)
+                os.rename(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+            except OSError as exc:
+                print_rank(f"checkpoint rename {tmp} -> {final} failed: "
+                           f"{exc!r}", loglevel=logging.WARNING)
+        self._pending_renames.clear()
 
     def _orbax_load(self, path: str,
                     template: ServerState) -> Optional[ServerState]:
@@ -143,14 +188,18 @@ class CheckpointManager:
         through the entire save window, so a crash mid-save never loses
         the resume anchor — the async analogue of tmp+os.replace)."""
         if self._pending_slot is None:
+            self._drain()
             return
-        self._orbax.wait_until_finished()
+        slot = self._pending_slot
+        self._pending_slot = None
+        self._drain()
+        if not os.path.isdir(self._orbax_path(slot)):
+            return  # the save failed; keep pointing at the old slot
         ptr = os.path.join(self.model_dir, self._LATEST_PTR)
         tmp = ptr + ".tmp"
         with open(tmp, "w") as fh:
-            fh.write(self._pending_slot)
+            fh.write(slot)
         os.replace(tmp, ptr)
-        self._pending_slot = None
 
     def _latest_slot(self) -> Optional[str]:
         ptr = os.path.join(self.model_dir, self._LATEST_PTR)
@@ -163,7 +212,6 @@ class CheckpointManager:
         """Block until pending async saves are durable (call before reading
         checkpoint files externally or at process exit)."""
         if self._orbax is not None:
-            self._orbax.wait_until_finished()
             self._commit_pending_latest()
 
     # -- save ----------------------------------------------------------
@@ -214,9 +262,14 @@ class CheckpointManager:
         """Best-val checkpoint on improvement (reference
         ``core/evaluation.py:103-109``)."""
         if self.backend == "orbax":
-            self._orbax_save(
-                self._orbax_path(f"best_val_{metric_name}_model.orbax"),
-                state)
+            # async save to a .new dir; the rename into place happens at
+            # the next drain, with the previous best parked at .old until
+            # the swap completes — no moment without a readable best
+            final = self._orbax_path(f"best_val_{metric_name}_model.orbax")
+            tmp = final + ".new"
+            shutil.rmtree(tmp, ignore_errors=True)
+            self._orbax_save(tmp, state)
+            self._pending_renames.append((tmp, final))
             return
         self._write(os.path.join(
             self.model_dir, f"best_val_{metric_name}_model.msgpack"), state)
@@ -234,13 +287,18 @@ class CheckpointManager:
     def load(self, template: ServerState,
              name: str = LATEST) -> Optional[ServerState]:
         if self.backend == "orbax":
+            self._commit_pending_latest()
             if name == LATEST:
-                self._commit_pending_latest()
                 slot = self._latest_slot()
                 if slot is None:
                     return None
                 return self._orbax_load(self._orbax_path(slot), template)
-            return self._orbax_load(self._orbax_path(name), template)
+            path = self._orbax_path(name)
+            restored = self._orbax_load(path, template)
+            if restored is None:
+                # crash mid-swap: the previous version is parked at .old
+                restored = self._orbax_load(path + ".old", template)
+            return restored
         path = os.path.join(self.model_dir, name)
         if not os.path.exists(path):
             return None

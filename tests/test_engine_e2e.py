@@ -136,3 +136,12 @@ def test_orbax_async_checkpoint_backend(synth_dataset, mesh8, tmp_path):
                     jax.tree.leaves(jax.device_get(server2.state.params))):
         np.testing.assert_array_equal(a, b)
     assert server2.train().round == 5
+
+    # warm-start from an orbax checkpoint directory (pretrained_model_path
+    # accepts either backend's output)
+    from msrflute_tpu.engine.checkpoint import load_pretrained_params
+    best_dir = next(str(tmp_path / n) for n in os.listdir(tmp_path)
+                    if n.startswith("best_val_") and n.endswith(".orbax"))
+    warm = load_pretrained_params(best_dir, server2.state.params)
+    assert jax.tree.structure(warm) == jax.tree.structure(
+        jax.device_get(server2.state.params))

@@ -1,0 +1,107 @@
+"""Expert-parallel MoE — all-to-all dispatch matches a sequential
+reference with identical routing/capacity semantics, differentiates, and
+trains."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+
+@pytest.fixture(scope="module")
+def expert_mesh():
+    return Mesh(np.asarray(jax.devices()), ("expert",))
+
+
+def _expert_fn(p, x):
+    return jnp.tanh(x @ p["w"]) @ p["v"]
+
+
+def _make(rng, E, D, H):
+    router_w = jnp.asarray(rng.normal(size=(D, E)), jnp.float32)
+    params = {"w": jnp.asarray(rng.normal(size=(E, D, H)) * 0.4, jnp.float32),
+              "v": jnp.asarray(rng.normal(size=(E, H, D)) * 0.4, jnp.float32)}
+    return router_w, params
+
+
+def _reference(router_w, params, x, E, capacity):
+    """Same semantics, sequentially: tokens are routed per device-shard
+    with per-(shard, expert) capacity."""
+    T, D = x.shape
+    local_t = T // E
+    out = np.zeros_like(np.asarray(x))
+    for d in range(E):
+        xs = np.asarray(x[d * local_t:(d + 1) * local_t])
+        logits = xs @ np.asarray(router_w)
+        eid = logits.argmax(-1)
+        gate = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+        counts = {}
+        for i in range(local_t):
+            j = int(eid[i])
+            pos = counts.get(j, 0)
+            counts[j] = pos + 1
+            if pos >= capacity:
+                continue  # dropped
+            p_j = {k: np.asarray(v[j]) for k, v in params.items()}
+            y = np.asarray(_expert_fn(
+                {k: jnp.asarray(v) for k, v in p_j.items()},
+                jnp.asarray(xs[i][None])))[0]
+            out[d * local_t + i] = y * float(gate[i, j])
+    return out
+
+
+def test_moe_matches_reference(expert_mesh):
+    from msrflute_tpu.ops.moe import moe_apply
+    rng = np.random.default_rng(0)
+    E = expert_mesh.shape["expert"]
+    D, H, local_t = 6, 10, 8
+    router_w, params = _make(rng, E, D, H)
+    x = jnp.asarray(rng.normal(size=(E * local_t, D)), jnp.float32)
+    cf = 2.0
+    capacity = max(1, int(cf * local_t / E))
+    out = moe_apply(router_w, params, _expert_fn, x, expert_mesh,
+                    capacity_factor=cf)
+    ref = _reference(router_w, params, x, E, capacity)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_differentiates_and_trains(expert_mesh):
+    from msrflute_tpu.ops.moe import moe_apply
+    rng = np.random.default_rng(1)
+    E = expert_mesh.shape["expert"]
+    D, H, local_t = 4, 8, 8
+    router_w, params = _make(rng, E, D, H)
+    x = jnp.asarray(rng.normal(size=(E * local_t, D)), jnp.float32)
+    teacher_rw, teacher_p = _make(np.random.default_rng(9), E, D, H)
+    target = moe_apply(teacher_rw, teacher_p, _expert_fn, x, expert_mesh)
+
+    @jax.jit
+    def step(rw, p):
+        def loss(rw, p):
+            y = x + moe_apply(rw, p, _expert_fn, x, expert_mesh)
+            return jnp.mean((y - (x + target)) ** 2)
+        l, (g_rw, g_p) = jax.value_and_grad(loss, argnums=(0, 1))(rw, p)
+        return (rw - 0.1 * g_rw,
+                jax.tree.map(lambda w, g: w - 0.1 * g, p, g_p), l)
+
+    losses = []
+    for _ in range(30):
+        router_w, params, l = step(router_w, params)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.8 * losses[0], losses[::6]
+
+
+def test_moe_rejects_bad_shapes(expert_mesh):
+    from msrflute_tpu.ops.moe import moe_apply
+    E = expert_mesh.shape["expert"]
+    router_w = jnp.zeros((4, E))
+    params = {"w": jnp.zeros((E + 1, 4, 4)), "v": jnp.zeros((E + 1, 4, 4))}
+    with pytest.raises(ValueError, match="leading axis"):
+        moe_apply(router_w, params, _expert_fn, jnp.zeros((E * 2, 4)),
+                  expert_mesh)
+    with pytest.raises(ValueError, match="not divisible"):
+        moe_apply(router_w, {"w": jnp.zeros((E, 4, 4)),
+                             "v": jnp.zeros((E, 4, 4))},
+                  _expert_fn, jnp.zeros((E * 2 + 1, 4)), expert_mesh)
