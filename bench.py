@@ -262,17 +262,23 @@ def scale_probe(backend: str) -> dict:
     where ``[K, S, B, ...]`` staging hits the memory ceiling and how
     s/round grows.  Run via ``BENCH_SCALE_PROBE=1``."""
     curve = {}
-    ks = (64, 128, 256, 512, 1024) if backend == "tpu" else (16, 32)
+    # CPU branch exists only to smoke the code path (tiny K, LR model);
+    # the real curve is a TPU measurement
+    on_tpu = backend == "tpu"
+    ks = (64, 128, 256, 512, 1024) if on_tpu else (8,)
     for k in ks:
-        cfg = _flute_config({"model_type": "CNN", "num_classes": 62},
-                            20, 0.1, fuse=4)
+        model = ({"model_type": "CNN", "num_classes": 62} if on_tpu else
+                 {"model_type": "LR", "num_classes": 62, "input_dim": 784})
+        cfg = _flute_config(model, 20, 0.1, fuse=4 if on_tpu else 2)
         cfg.server_config.num_clients_per_iteration = k
-        spu = 240 if backend == "tpu" else 40
+        spu = 240 if on_tpu else 20
         try:
-            data = _image_dataset(max(k, 16), spu, (28, 28, 1), 62,
+            data = _image_dataset(max(k, 8), spu,
+                                  (28, 28, 1) if on_tpu else (784,), 62,
                                   np.random.default_rng(0))
             res = bench_protocol("cnn_femnist", cfg, data, eval_users=4,
-                                 warmup_rounds=4, timed_chunks=2,
+                                 warmup_rounds=4 if on_tpu else 2,
+                                 timed_chunks=2,
                                  eval_every=50)
             curve[str(k)] = {"secs_per_round": res["secs_per_round"]}
         except Exception as exc:

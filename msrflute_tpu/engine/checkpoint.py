@@ -162,6 +162,10 @@ class CheckpointManager:
                 continue
             old = final + ".old"
             try:
+                # a crash between the renames below can leave a stale .old
+                # behind; clear it or os.rename onto it raises ENOTEMPTY
+                # forever after
+                shutil.rmtree(old, ignore_errors=True)
                 if os.path.isdir(final):
                     os.rename(final, old)
                 os.rename(tmp, final)

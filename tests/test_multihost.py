@@ -64,6 +64,64 @@ print(f"CHECKSUM {{checksum:.10f}} round {{state.round}}", flush=True)
 """
 
 
+WORKER_GSPMD = r"""
+import os, sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2, process_id=int(sys.argv[2]))
+assert jax.device_count() == 8
+
+sys.path.insert(0, {repo!r})
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset, pack_round_batches
+from msrflute_tpu.engine.round import RoundEngine
+from msrflute_tpu.models import make_task
+from msrflute_tpu.parallel import make_mesh
+from msrflute_tpu.strategies import select_strategy
+
+# (clients=4, model=2) GLOBAL mesh across the two processes: tensor shards
+# of the BERT params live on devices of BOTH hosts — the collectives this
+# round runs are exactly the ICI/DCN mix of a real multi-host slice
+cfg = FLUTEConfig.from_dict({{
+    "model_config": {{"model_type": "BERT", "BERT": {{
+        "model": {{"vocab_size": 96, "hidden_size": 32,
+                  "num_hidden_layers": 2, "num_attention_heads": 2,
+                  "intermediate_size": 64, "max_seq_length": 12,
+                  "mlm_probability": 0.25, "mask_token_id": 4}},
+        "training": {{"batch_size": 2, "seed": 0}}}}}},
+    "strategy": "fedavg",
+    "mesh_config": {{"model_axis_size": 2}},
+    "server_config": {{"max_iteration": 1, "num_clients_per_iteration": 4,
+                      "optimizer_config": {{"type": "sgd", "lr": 1.0}}}},
+    "client_config": {{"optimizer_config": {{"type": "adamw", "lr": 0.05}},
+                      "data_config": {{"train": {{"batch_size": 2}}}}}},
+}})
+rng = np.random.default_rng(0)
+users = [f"u{{i}}" for i in range(4)]
+per_user = [{{"x": rng.integers(5, 96, size=(4, 12)).astype(np.int32)}}
+            for _ in users]
+ds = ArraysDataset(users, per_user)
+
+mesh = make_mesh(model_axis_size=2)
+task = make_task(cfg.model_config)
+engine = RoundEngine(task, cfg, select_strategy("fedavg")(cfg, None), mesh)
+assert engine.partition_mode == "gspmd"
+state = engine.init_state(jax.random.PRNGKey(0))
+batch = pack_round_batches(ds, list(range(4)), 2, 2,
+                           rng=np.random.default_rng(1), pad_clients_to=4)
+state, stats = engine.run_round(state, batch, 0.05, 1.0,
+                                jax.random.PRNGKey(2))
+leaves = jax.tree.leaves(jax.device_get(state.params))
+checksum = float(sum(np.abs(np.asarray(l, np.float64)).sum()
+                     for l in leaves))
+print(f"CHECKSUM {{checksum:.6f}} round {{state.round}}", flush=True)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -72,10 +130,10 @@ def _free_port():
     return port
 
 
-def test_two_process_round(tmp_path):
+def _run_two_process(tmp_path, worker_src: str) -> None:
     coord = f"127.0.0.1:{_free_port()}"
     script = tmp_path / "worker.py"
-    script.write_text(WORKER.format(repo=REPO))
+    script.write_text(worker_src.format(repo=REPO))
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
@@ -94,3 +152,15 @@ def test_two_process_round(tmp_path):
     assert len(sums) == 2
     assert sums[0] == sums[1], f"processes disagree: {sums}"
     assert float(sums[0]) > 0
+
+
+def test_two_process_round(tmp_path):
+    _run_two_process(tmp_path, WORKER)
+
+
+def test_two_process_gspmd_round(tmp_path):
+    """Tensor-sharded (clients, model) round across two processes: BERT
+    params shard over devices of BOTH hosts, so the round's collectives
+    mix the clients-axis psum with model-axis all-reduces across the
+    process boundary — the full multi-host GSPMD path."""
+    _run_two_process(tmp_path, WORKER_GSPMD)
