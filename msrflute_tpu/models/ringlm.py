@@ -28,6 +28,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.moe import MoEFFN
 from ..ops.ring_attention import ring_self_attention
 from .base import masked_mean, parse_dtype, softmax_xent
 from .nlp import SequenceLMTask, _TokenDatasetMixin
@@ -72,6 +73,12 @@ class _Block(nn.Module):
     ring_mesh: Optional[Mesh] = None
     seq_axis: str = "sequence"
     batch_axis: Optional[str] = None
+    #: >0 replaces the dense MLP with a switch MoE FFN (ops/moe.py);
+    #: federated/local mode evaluates experts densely, expert-parallel
+    #: dispatch engages when moe_ep_axis names a mesh axis (sp_module)
+    moe_experts: int = 0
+    moe_ep_axis: Optional[str] = None
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x):
@@ -79,6 +86,14 @@ class _Block(nn.Module):
         x = x + _MHA(self.heads, self.head_dim, self.dtype, self.ring_mesh,
                      self.seq_axis, self.batch_axis)(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.moe_experts > 0:
+            ep_mesh = (self.ring_mesh if self.moe_ep_axis is not None
+                       else None)
+            return x + MoEFFN(self.moe_experts, self.mlp_dim,
+                              dtype=self.dtype, ep_mesh=ep_mesh,
+                              expert_axis=self.moe_ep_axis or "expert",
+                              capacity_factor=self.moe_capacity_factor,
+                              name="moe_ffn")(h)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
         h = nn.gelu(h)
         return x + nn.Dense(x.shape[-1], dtype=self.dtype)(h)
@@ -100,6 +115,9 @@ class _RingLM(nn.Module):
     #: O(num_layers) fewer live activations, ~1/3 extra FLOPs.  The right
     #: altitude for remat: wrapping the whole loss would save nothing.
     remat: bool = False
+    moe_experts: int = 0
+    moe_ep_axis: Optional[str] = None
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x):  # [B, L] int32
@@ -116,7 +134,9 @@ class _RingLM(nn.Module):
             # family — renaming breaks every saved RingLM checkpoint
             h = block_cls(self.heads, self.head_dim, self.mlp_dim,
                           self.dtype, self.ring_mesh, self.seq_axis,
-                          self.batch_axis, name=f"block_{i}")(h)
+                          self.batch_axis, self.moe_experts,
+                          self.moe_ep_axis, self.moe_capacity_factor,
+                          name=f"block_{i}")(h)
         h = nn.LayerNorm(dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=self.dtype)(h)
 
@@ -130,9 +150,14 @@ class RingLMTask(_TokenDatasetMixin, SequenceLMTask):
     tokenizer = "chars"
 
     def sp_module(self, mesh: Mesh, seq_axis: str = "sequence",
-                  batch_axis: Optional[str] = None) -> _RingLM:
+                  batch_axis: Optional[str] = None,
+                  expert_axis: Optional[str] = None) -> _RingLM:
+        """Clone into sequence-parallel mode; ``expert_axis`` additionally
+        engages expert-parallel MoE dispatch on that mesh axis (requires
+        ``moe_experts == mesh.shape[expert_axis]``)."""
         return self.module.clone(ring_mesh=mesh, seq_axis=seq_axis,
-                                 batch_axis=batch_axis)
+                                 batch_axis=batch_axis,
+                                 moe_ep_axis=expert_axis)
 
 
 def make_ringlm_task(model_config) -> RingLMTask:
@@ -144,7 +169,8 @@ def make_ringlm_task(model_config) -> RingLMTask:
         mlp_dim=int(model_config.get("mlp_dim", 256)),
         num_layers=int(model_config.get("num_layers", 2)),
         dtype=parse_dtype(model_config),
-        remat=bool(model_config.get("remat", False)))
+        remat=bool(model_config.get("remat", False)),
+        moe_experts=int(model_config.get("moe_experts", 0) or 0))
     return RingLMTask(module,
                       seq_len=int(model_config.get("seq_len", 128)),
                       name="ringlm")

@@ -16,8 +16,9 @@ Everything is SPMD and differentiable; XLA rides the all-to-alls on ICI.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
@@ -98,3 +99,65 @@ def moe_apply(router_w: jnp.ndarray, expert_params: Any,
                   P(axis)),
         out_specs=P(axis), check_vma=False)
     return fn(router_w, expert_params, x)
+
+
+class MoEFFN(nn.Module):
+    """Switch top-1 MoE feed-forward as a drop-in flax module.
+
+    Two execution modes over the SAME parameters:
+
+    - **local** (``ep_mesh=None``): every device evaluates all experts and
+      selects per token — exact routing, no capacity drops.  The federated
+      path uses this (experts are tiny, clients ride the clients axis).
+    - **expert-parallel** (``ep_mesh`` set): :func:`moe_apply` all-to-all
+      dispatch with one expert per device of ``expert_axis``; requires
+      ``num_experts == mesh.shape[expert_axis]``.  With capacity ample
+      enough that nothing drops, both modes are numerically identical
+      (tested).
+
+    Input/output: ``[..., D]`` tokens (leading axes flattened internally).
+    """
+
+    num_experts: int
+    hidden: int
+    dtype: Any = jnp.float32
+    ep_mesh: Optional[Mesh] = None
+    expert_axis: str = EXPERT_AXIS
+    capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, x):
+        D = x.shape[-1]
+        E = self.num_experts
+        router = self.param("router", nn.initializers.lecun_normal(),
+                            (D, E)).astype(self.dtype)
+        w_in = self.param("w_in", nn.initializers.lecun_normal(),
+                          (E, D, self.hidden)).astype(self.dtype)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(),
+                           (E, self.hidden, D)).astype(self.dtype)
+        lead = x.shape[:-1]
+        t = x.reshape(-1, D).astype(self.dtype)
+
+        if self.ep_mesh is not None:
+            if self.ep_mesh.shape[self.expert_axis] != E:
+                raise ValueError(
+                    f"num_experts={E} != {self.expert_axis}="
+                    f"{self.ep_mesh.shape[self.expert_axis]}")
+
+            def expert_fn(p, tok):
+                return nn.gelu(tok @ p["w_in"]) @ p["w_out"]
+
+            y = moe_apply(router, {"w_in": w_in, "w_out": w_out}, expert_fn,
+                          t, self.ep_mesh, axis=self.expert_axis,
+                          capacity_factor=self.capacity_factor)
+            return y.reshape(*lead, D)
+
+        # local mode: evaluate all experts, select per token
+        logits = (t @ router).astype(jnp.float32)          # [T, E]
+        eid = jnp.argmax(logits, axis=-1)
+        gate = jax.nn.softmax(logits, axis=-1)[
+            jnp.arange(t.shape[0]), eid].astype(t.dtype)
+        h = nn.gelu(jnp.einsum("td,edh->teh", t, w_in))
+        y_all = jnp.einsum("teh,ehd->ted", h, w_out)       # [T, E, D]
+        y = jnp.take_along_axis(y_all, eid[:, None, None], axis=1)[:, 0]
+        return (y * gate[:, None]).reshape(*lead, D)
