@@ -91,6 +91,14 @@ class RoundEngine:
         if sc.get("wantRL", False) and not strategy.supports_rl:
             raise ValueError(
                 f"{type(strategy).__name__} does not support wantRL")
+        if getattr(strategy, "owns_server_update", False):
+            opt_type = str(sc.optimizer_config.get("type", "sgd")).lower()
+            if opt_type != "sgd":
+                raise ValueError(
+                    f"{type(strategy).__name__} applies its own coupled "
+                    f"server update; server optimizer_config type="
+                    f"{opt_type!r} would be silently ignored — use sgd "
+                    "(the lr still scales the update)")
         self.dump_norm_stats = bool(config.get("dump_norm_stats",
                                                sc.get("dump_norm_stats",
                                                       False)))
@@ -234,8 +242,11 @@ class RoundEngine:
         def round_step(params, opt_state, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, server_lr,
                        round_idx, leakage_threshold, quant_threshold, rng):
+            # strategies may move the broadcast point off the canonical
+            # params (e.g. FedAC's momentum-like md point); default identity
+            bcast = strategy.broadcast_params(params, strategy_state)
             collected, privacy_per_client = sharded_collect(
-                params, arrays, sample_mask, client_mask, client_ids,
+                bcast, arrays, sample_mask, client_mask, client_ids,
                 client_lr, round_idx, leakage_threshold, quant_threshold,
                 rng)
             part_sums = collected["parts"]
@@ -247,14 +258,22 @@ class RoundEngine:
             agg, new_strategy_state = strategy.combine_parts(
                 part_sums, deferred, strategy_state,
                 jax.random.fold_in(rng, 17),
-                num_clients=collected["client_count"], global_params=params)
-            # server optimizer over the aggregate pseudo-gradient
-            # (reference ModelUpdater.update_model, core/trainer.py:127-137)
+                num_clients=collected["client_count"], global_params=bcast)
             if self.server_max_grad_norm is not None:
                 agg = _clip_by_global_norm(agg, float(self.server_max_grad_norm))
-            opt_state.hyperparams["learning_rate"] = server_lr
-            updates, new_opt_state = self.server_tx.update(agg, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            if strategy.owns_server_update:
+                # multi-sequence schemes (FedAC) apply their own coupled
+                # update; the optax state passes through untouched
+                new_params, new_strategy_state = strategy.apply_server_update(
+                    params, agg, new_strategy_state, server_lr)
+                new_opt_state = opt_state
+            else:
+                # server optimizer over the aggregate pseudo-gradient
+                # (reference ModelUpdater.update_model, core/trainer.py:127-137)
+                opt_state.hyperparams["learning_rate"] = server_lr
+                updates, new_opt_state = self.server_tx.update(
+                    agg, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
             default_part = part_sums.get("default") or \
                 next(iter(part_sums.values()))
             round_stats = {

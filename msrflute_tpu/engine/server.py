@@ -122,6 +122,11 @@ class OptimizationServer:
         self.server_replay = None
         if sc.server_replay_config is not None and \
                 server_train_dataset is not None:
+            if getattr(self.strategy, "owns_server_update", False):
+                raise ValueError(
+                    f"{type(self.strategy).__name__} maintains coupled "
+                    "parameter sequences; server replay would mutate params "
+                    "behind its back — disable server_replay_config")
             self.server_replay = {
                 "dataset": server_train_dataset,
                 "iterations": int(sc.server_replay_config.get(
@@ -165,8 +170,11 @@ class OptimizationServer:
                 lambda host, old: jax.device_put(
                     jnp.asarray(host, old.dtype), old.sharding),
                 params, self.state.params)
+            # strategy state re-derives from the WARM params (e.g. FedAC's
+            # w_ag sequence must start at the pretrained point, not the
+            # discarded random init)
             self.state = ServerState(params, self.state.opt_state,
-                                     self.state.strategy_state, 0)
+                                     self.strategy.init_state(params), 0)
             print_rank(f"warm-started from pretrained model {pretrained}")
         if sc.get("resume_from_checkpoint", False):
             restored = self.ckpt.load(self.state)

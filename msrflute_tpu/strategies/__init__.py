@@ -18,6 +18,9 @@ def select_strategy(name: str) -> type:
         return DGA
     if key in ("fedavg", "fedprox"):
         return FedAvg
+    if key == "fedac":
+        from .fedac import FedAC
+        return FedAC
     if key == "fedlabels":
         from .fedlabels import FedLabels
         return FedLabels

@@ -60,6 +60,9 @@ class BaseStrategy:
     #: reference core/strategies/dga.py:260-284); the engine draws the
     #: per-client coin and hands combine() separate now/deferred sums.
     stale_prob: float = 0.0
+    #: when True the engine skips the server optimizer and calls
+    #: :meth:`apply_server_update` instead (multi-sequence schemes: FedAC)
+    owns_server_update: bool = False
 
     def __init__(self, config, dp_config=None):
         self.config = config
@@ -150,6 +153,17 @@ class BaseStrategy:
                           rng: jax.Array,
                           quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
         return pseudo_grad, weight
+
+    # ---- traced, pre-dispatch (replicated) ---------------------------
+    def broadcast_params(self, params: Any, state: Any) -> Any:
+        """The params clients start this round from (default: the server's
+        canonical params; FedAC broadcasts its momentum-like md point)."""
+        return params
+
+    def apply_server_update(self, params: Any, agg: Any, state: Any,
+                            server_lr) -> Tuple[Any, Any]:
+        """Custom server update for ``owns_server_update`` strategies."""
+        raise NotImplementedError
 
     # ---- traced, post-psum (replicated) ------------------------------
     def init_state(self, params_like: Any) -> Any:
