@@ -582,9 +582,14 @@ class OptimizationServer:
             print_rank("fell back to previous best model")
 
     def _log_timing(self) -> None:
+        """Timing summary (reference ``run_stats``, ``core/server.py:492-521``)
+        — percentiles as well as means: tail rounds are what a wall-clock
+        budget actually pays for."""
         for key, values in self.run_stats.items():
             if values:
                 log_metric(f"{key} (mean)", float(np.mean(values)))
+                log_metric(f"{key} (p50)", float(np.percentile(values, 50)))
+                log_metric(f"{key} (p95)", float(np.percentile(values, 95)))
 
 
 def select_server(server_type: str):
