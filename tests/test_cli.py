@@ -97,3 +97,23 @@ def test_cli_end_to_end(tmp_path):
     assert proc2.returncode == 0, proc2.stderr[-3000:]
     assert "warm-started from pretrained model" in (proc2.stdout + proc2.stderr)
     assert (out2 / "models" / "latest_model.msgpack").exists()
+
+
+def test_summarize_run_tool(tmp_path):
+    """tools/summarize_run.py renders a per-metric table from a run's
+    metrics.jsonl (the offline stand-in for the reference's AzureML
+    dashboard)."""
+    log_dir = tmp_path / "log"
+    log_dir.mkdir()
+    lines = [{"name": "Val acc", "value": 0.5, "step": 2},
+             {"name": "Val acc", "value": 0.8, "step": 4},
+             {"name": "Training loss", "value": 1.2, "step": 4}]
+    (log_dir / "metrics.jsonl").write_text(
+        "\n".join(json.dumps(l) for l in lines))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools/summarize_run.py"),
+         str(tmp_path)], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "Val acc" in proc.stdout and "0.8" in proc.stdout
+    assert "Training loss" in proc.stdout

@@ -5,9 +5,18 @@ shapes/values, not just the fixtures."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 SETTINGS = dict(max_examples=25, deadline=None)
+
+
+def _sort_rows(a: np.ndarray) -> np.ndarray:
+    """Lexicographic ROW sort (np.sort(axis=0) would sort columns
+    independently and miss cross-feature scrambles)."""
+    return a[np.lexsort(a.T[::-1])]
 
 
 @st.composite
@@ -38,16 +47,27 @@ def test_pack_round_batches_masked_padding_algebra(shapes, seed):
     for j, n in enumerate(counts):
         flat = rb.arrays["x"][j].reshape(S * batch, dim)
         mask = rb.sample_mask[j].reshape(-1)
-        t = min(n, S * batch)
-        assert mask.sum() == t == rb.num_samples[j]
+        assert mask.sum() == n == rb.num_samples[j]
         real = flat[mask > 0]
-        if t == n:
-            # all samples taken: the real rows are a permutation of source
-            np.testing.assert_allclose(
-                np.sort(real, axis=0), np.sort(per_user[j]["x"], axis=0),
-                rtol=1e-6)
+        # the real ROWS are a permutation of the source rows
+        np.testing.assert_allclose(_sort_rows(real),
+                                   _sort_rows(per_user[j]["x"]), rtol=1e-6)
         assert not flat[mask == 0].any()  # padding rows all-zero
         assert rb.client_mask[j] == 1.0
+
+    # truncation path: a cap below some client sizes must bound the mask
+    # and keep every surviving row a genuine source row
+    cap = max(1, min(counts))
+    rb2 = pack_round_batches(ds, list(range(n_users)), batch, S,
+                             rng=np.random.default_rng(seed + 2),
+                             desired_max_samples=cap)
+    for j, n in enumerate(counts):
+        t = min(n, cap)
+        mask = rb2.sample_mask[j].reshape(-1)
+        assert mask.sum() == t == rb2.num_samples[j]
+        real = rb2.arrays["x"][j].reshape(S * batch, dim)[mask > 0]
+        src_rows = {tuple(np.round(r, 5)) for r in per_user[j]["x"]}
+        assert all(tuple(np.round(r, 5)) in src_rows for r in real)
 
 
 @given(st.integers(1, 2 ** 31 - 1), st.floats(0.05, 0.95),

@@ -1,0 +1,69 @@
+"""Run summary from a training run's metrics stream.
+
+The reference surfaces run metrics through AzureML dashboards
+(``run.log`` calls throughout ``core/server.py``); this build streams the
+same scalars to ``<out>/log/metrics.jsonl``.  This tool is the offline
+dashboard: per-metric last/best/count plus the timing summary.
+
+Usage:
+    python tools/summarize_run.py <outputPath>   # or the log dir itself
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import OrderedDict
+
+
+def load_metrics(path: str):
+    """Locate and parse metrics.jsonl under a run dir (or take it directly)."""
+    candidates = [path,
+                  os.path.join(path, "metrics.jsonl"),
+                  os.path.join(path, "log", "metrics.jsonl")]
+    for cand in candidates:
+        if os.path.isfile(cand):
+            with open(cand) as fh:
+                return [json.loads(line) for line in fh if line.strip()]
+    raise FileNotFoundError(f"no metrics.jsonl under {path!r}")
+
+
+def summarize(records):
+    """Per-metric summary rows: (last, best, n, last step)."""
+    out: "OrderedDict[str, dict]" = OrderedDict()
+    for rec in records:
+        name = rec.get("name")
+        value = rec.get("value")
+        if name is None or not isinstance(value, (int, float)):
+            continue
+        row = out.setdefault(name, {"n": 0, "last": None, "step": None,
+                                    "min": float("inf"),
+                                    "max": float("-inf")})
+        row["n"] += 1
+        row["last"] = value
+        row["step"] = rec.get("step")
+        row["min"] = min(row["min"], value)
+        row["max"] = max(row["max"], value)
+    return out
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        raise SystemExit(__doc__)
+    records = load_metrics(sys.argv[1])
+    rows = summarize(records)
+    if not rows:
+        print("no scalar metrics found")
+        return
+    w = max(len(n) for n in rows) + 2
+    print(f"{'metric':<{w}} {'last':>12} {'min':>12} {'max':>12} "
+          f"{'n':>5} {'step':>6}")
+    for name, r in rows.items():
+        step = "-" if r["step"] is None else str(r["step"])
+        print(f"{name:<{w}} {r['last']:>12.6g} {r['min']:>12.6g} "
+              f"{r['max']:>12.6g} {r['n']:>5} {step:>6}")
+
+
+if __name__ == "__main__":
+    main()
