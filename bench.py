@@ -61,40 +61,48 @@ print("TPU_PROBE_OK", flush=True)
 """
 
 
-def select_backend(probe_timeout: float = 180.0) -> str:
-    """Return ``"tpu"`` if the chip answers a real matmul within the timeout,
-    else configure this process for CPU and return ``"cpu"``.
+def select_backend(probe_timeout: float = 180.0):
+    """Return ``(backend, reason)``: ``"tpu"`` if the chip answers a real
+    matmul within the timeout, else configure this process for CPU.  The
+    reason string records WHY a fallback happened, so a recorded CPU run is
+    attributable (wedged tunnel vs override vs fast failure).
 
     Must be called before anything initializes a jax backend in this process.
     """
     want = os.environ.get("BENCH_BACKEND")  # manual override for debugging
-    backend = None
+    backend, reason = None, None
     if want in ("tpu", "cpu"):
-        backend = want
+        backend, reason = want, f"BENCH_BACKEND={want} override"
     else:
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-c", _PROBE_CODE],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
             try:
-                out, _ = proc.communicate(timeout=probe_timeout)
+                out, err = proc.communicate(timeout=probe_timeout)
                 if proc.returncode == 0 and "TPU_PROBE_OK" in (out or ""):
-                    backend = "tpu"
+                    backend, reason = "tpu", "probe matmul OK"
+                else:
+                    tail = (err or "").strip().splitlines()[-1:]
+                    reason = (f"probe exited rc={proc.returncode}: "
+                              f"{tail[0] if tail else 'no stderr'}")[:300]
             except subprocess.TimeoutExpired:
                 # graceful SIGTERM only: SIGKILL on a TPU-claiming process
                 # wedges the single-client tunnel for everyone after us
+                reason = (f"probe hung >{probe_timeout:.0f}s "
+                          "(TPU tunnel init wedged)")
                 proc.terminate()
                 try:
                     proc.wait(timeout=15)
                 except subprocess.TimeoutExpired:
                     pass  # abandon it; we are going to CPU anyway
-        except Exception:
-            pass
+        except Exception as exc:
+            reason = f"probe failed to launch: {exc!r}"
     if backend != "tpu":
         backend = "cpu"
         from msrflute_tpu.utils.backend import force_cpu_backend
         force_cpu_backend()
-    return backend
+    return backend, reason
 
 
 # ----------------------------------------------------------------------
@@ -291,8 +299,14 @@ def scale_probe(backend: str) -> dict:
 
 
 def main() -> None:
-    backend = select_backend()
+    backend, backend_reason = select_backend()
     on_tpu = backend == "tpu"
+    if on_tpu:
+        # persistent XLA compilation cache: first-compile on TPU is tens of
+        # seconds per program; repeat bench runs then start hot
+        from msrflute_tpu.utils.backend import enable_compilation_cache
+        enable_compilation_cache(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
     rng = np.random.default_rng(0)
 
     # protocol table (BASELINE.md `README.md:22-27`): model cfg, batch, lr,
@@ -349,7 +363,7 @@ def main() -> None:
         keep = set(only.split(","))
         protocols = {k: v for k, v in protocols.items() if k in keep}
 
-    extras = {"backend": backend}
+    extras = {"backend": backend, "backend_reason": backend_reason}
     for name, spec in protocols.items():
         try:
             extras[name] = bench_protocol(

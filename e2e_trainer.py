@@ -58,6 +58,14 @@ def main() -> None:
     cfg.output_path = args.outputPath
     cfg.validate(cfg.data_path)
 
+    # persistent XLA compilation cache (server_config.compilation_cache_dir):
+    # repeat runs of the same protocol skip the tens-of-seconds first
+    # compile — worth it on TPU, harmless elsewhere
+    cache_dir = cfg.server_config.get("compilation_cache_dir")
+    if cache_dir:
+        from msrflute_tpu.utils.backend import enable_compilation_cache
+        enable_compilation_cache(cache_dir)
+
     task = make_task(cfg.model_config)
     train_ds, val_ds, test_ds = build_task_datasets(cfg, task)
     print_rank(f"task={cfg.task} users={len(train_ds)} "

@@ -43,3 +43,17 @@ def force_cpu_backend(n_devices: Optional[int] = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Turn on jax's persistent XLA compilation cache (best-effort: an
+    unwritable path must not abort a training run — it only forfeits the
+    warm-start).  Returns whether it was enabled."""
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        return True
+    except Exception:
+        return False

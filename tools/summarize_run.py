@@ -24,18 +24,30 @@ def load_metrics(path: str):
                   os.path.join(path, "log", "metrics.jsonl")]
     for cand in candidates:
         if os.path.isfile(cand):
+            records = []
             with open(cand) as fh:
-                return [json.loads(line) for line in fh if line.strip()]
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # a run killed mid-write leaves a truncated tail
+                        # line; the summary matters most for exactly that
+                        # crashed run, so skip instead of dying
+                        continue
+            return records
     raise FileNotFoundError(f"no metrics.jsonl under {path!r}")
 
 
 def summarize(records):
-    """Per-metric summary rows: (last, best, n, last step)."""
+    """Per-metric summary rows: last/min/max/count + last step."""
     out: "OrderedDict[str, dict]" = OrderedDict()
     for rec in records:
         name = rec.get("name")
         value = rec.get("value")
-        if name is None or not isinstance(value, (int, float)):
+        if name is None or isinstance(value, bool) or \
+                not isinstance(value, (int, float)):
             continue
         row = out.setdefault(name, {"n": 0, "last": None, "step": None,
                                     "min": float("inf"),
