@@ -144,9 +144,9 @@ class RoundEngine:
         cspec = P(CLIENTS_AXIS)
         rspec = P()
 
-        def shard_body(params, arrays, sample_mask, client_mask, client_ids,
-                       client_lr, round_idx, leakage_threshold,
-                       quant_threshold, rng):
+        def shard_body(params, strategy_state, arrays, sample_mask,
+                       client_mask, client_ids, client_lr, round_idx,
+                       leakage_threshold, quant_threshold, rng):
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
@@ -154,7 +154,8 @@ class RoundEngine:
                 parts, tl, ns, stats = strategy.client_step(
                     client_update, params, arr_c, mask_c, client_lr, rng_c,
                     round_idx=round_idx, leakage_threshold=leakage_threshold,
-                    quant_threshold=quant_threshold)
+                    quant_threshold=quant_threshold,
+                    strategy_state=strategy_state)
                 parts = {name: (tree, w * cm_c)
                          for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
@@ -229,8 +230,8 @@ class RoundEngine:
         if self.partition_mode == "shard_map":
             sharded_collect = shard_map(
                 shard_body, mesh=mesh,
-                in_specs=(rspec, cspec, cspec, cspec, cspec, rspec, rspec,
-                          rspec, rspec, rspec),
+                in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
+                          rspec, rspec, rspec, rspec),
                 out_specs=(rspec, cspec), check_vma=False)
         else:
             # GSPMD mode: plain jit — client data stays sharded on the
@@ -246,9 +247,9 @@ class RoundEngine:
             # params (e.g. FedAC's momentum-like md point); default identity
             bcast = strategy.broadcast_params(params, strategy_state)
             collected, privacy_per_client = sharded_collect(
-                bcast, arrays, sample_mask, client_mask, client_ids,
-                client_lr, round_idx, leakage_threshold, quant_threshold,
-                rng)
+                bcast, strategy_state, arrays, sample_mask, client_mask,
+                client_ids, client_lr, round_idx, leakage_threshold,
+                quant_threshold, rng)
             part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:

@@ -128,16 +128,24 @@ def laplace_noise(max_sens: float, eps: float, size: int,
 
 
 def apply_local_dp(pseudo_grad: Any, weight: jnp.ndarray, dp_config,
-                   add_weight_noise: bool, rng: jax.Array
-                   ) -> Tuple[Any, jnp.ndarray]:
+                   add_weight_noise: bool, rng: jax.Array,
+                   clip_override=None) -> Tuple[Any, jnp.ndarray]:
     """Client-side DP on the flattened pseudo-gradient (traced; vmap-safe).
 
     Reproduces reference ``apply_local_dp`` (``:154-201``) including the
-    weight scale/clamp/noise/unscale dance.
+    weight scale/clamp/noise/unscale dance.  ``clip_override`` (a traced
+    scalar) substitutes the static ``max_grad`` — the adaptive-clipping
+    hook (strategies/fedavg.py).  NOTE: with eps >= 0 the noise sigma uses
+    the STATIC max_grad sensitivity bound, which stays valid as long as
+    the adaptive clip <= max_grad (enforced by the caller).
     """
     flat, unravel = ravel_pytree(pseudo_grad)
     eps = float(dp_config.get("eps", -1.0))
-    max_grad = float(dp_config.get("max_grad", 1.0))
+    static_max_grad = float(dp_config.get("max_grad", 1.0))
+    max_grad = static_max_grad
+    if clip_override is not None:
+        max_grad = jnp.minimum(jnp.asarray(clip_override, jnp.float32),
+                               static_max_grad)
 
     if eps < 0:
         # clip-only mode
@@ -154,7 +162,9 @@ def apply_local_dp(pseudo_grad: Any, weight: jnp.ndarray, dp_config,
     scaled_weight = jnp.minimum(weight * weight_scaler, max_weight)
     # normalize the update to exactly max_grad norm (reference :182)
     normed = max_grad * flat / jnp.maximum(jnp.linalg.norm(flat), 1e-12)
-    max_sensitivity = math.sqrt(max_grad ** 2 +
+    # sensitivity stays the STATIC bound: sigma must not depend on the
+    # (traced) adaptive clip, and static >= adaptive keeps it an upper bound
+    max_sensitivity = math.sqrt(static_max_grad ** 2 +
                                 (max_weight ** 2 if add_weight_noise else 0.0))
     joint = jnp.concatenate([normed, scaled_weight[None]])
     noisy, _sigma = add_gaussian_noise(joint, eps, max_sensitivity, delta, rng)

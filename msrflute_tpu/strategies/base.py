@@ -74,7 +74,7 @@ class BaseStrategy:
     # ---- traced, per-client (inside vmap) ----------------------------
     def client_step(self, client_update, global_params, arrays, sample_mask,
                     client_lr, rng, round_idx=None, leakage_threshold=None,
-                    quant_threshold=None):
+                    quant_threshold=None, strategy_state=None):
         """Run one client's local work and emit weighted payload parts.
 
         Returns ``(parts, train_loss, num_samples, stats)`` where ``parts``
@@ -92,7 +92,9 @@ class BaseStrategy:
             pg, w, stats, global_params, arrays, sample_mask,
             leakage_threshold)
         pg, w = self.transform_payload(pg, w, jax.random.fold_in(rng, 2),
-                                       quant_threshold=quant_threshold)
+                                       quant_threshold=quant_threshold,
+                                       strategy_state=strategy_state,
+                                       stats=stats)
         return {"default": (pg, w)}, tl, ns, stats
 
     def _apply_privacy_metrics(self, pg, weight, stats, global_params,
@@ -150,8 +152,12 @@ class BaseStrategy:
         raise NotImplementedError
 
     def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
-                          rng: jax.Array,
-                          quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
+                          rng: jax.Array, quant_threshold=None,
+                          strategy_state=None,
+                          stats=None) -> Tuple[Any, jnp.ndarray]:
+        """``stats`` (the client's mutable stats dict) lets implementations
+        record per-client diagnostics for the same-trace caller (e.g. the
+        pre-clip update norm for adaptive clipping)."""
         return pseudo_grad, weight
 
     # ---- traced, pre-dispatch (replicated) ---------------------------

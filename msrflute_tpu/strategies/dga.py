@@ -67,8 +67,9 @@ class DGA(BaseStrategy):
         return filter_weight(weight)
 
     def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
-                          rng: jax.Array,
-                          quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
+                          rng: jax.Array, quant_threshold=None,
+                          strategy_state=None,
+                          stats=None) -> Tuple[Any, jnp.ndarray]:
         dp_rng, _ = jax.random.split(rng)
         if self.dp_config is not None and self.dp_config.get("enable_local_dp", False):
             from ..privacy import apply_local_dp

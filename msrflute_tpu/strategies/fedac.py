@@ -43,6 +43,11 @@ class FedAC(FedAvg):
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
+        if self.adaptive_clip is not None:
+            raise ValueError(
+                "FedAC and dp_config.adaptive_clipping both need the "
+                "strategy-state slot (w_ag vs dp_clip) — not supported "
+                "together; use strategy: fedavg for adaptive clipping")
         sc = config.server_config
         self.eta = float(sc.get("fedac_eta", 1.0))
         self.gamma = float(sc.get("fedac_gamma", max(self.eta, 1.0)))

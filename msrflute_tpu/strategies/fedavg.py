@@ -19,15 +19,108 @@ from .base import BaseStrategy, filter_weight
 
 
 class FedAvg(BaseStrategy):
+    """FedAvg/FedProx aggregation; optional Andrew-et-al.-style adaptive
+    DP clipping (arXiv:1905.03871 — net-new vs the reference, whose clip
+    norm is a fixed ``dp_config.max_grad``):
+
+    ``dp_config.adaptive_clipping: {target_quantile: 0.5, clip_lr: 0.2,
+    initial_clip: <= max_grad}`` tracks the target quantile of client
+    update norms with the geometric update ``C <- C * exp(-lr*(b - q))``
+    where ``b`` is the fraction of this round's clients whose update norm
+    was <= C.  Everything runs in-jit: the clip rides strategy state, the
+    below-clip indicator is aggregated as an extra psum'd payload part,
+    and the noise sigma keeps the static max_grad sensitivity bound
+    (always >= the adaptive clip).
+    """
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        self.adaptive_clip = None
+        if dp_config is not None and dp_config.get("enable_local_dp", False):
+            ac = dp_config.get("adaptive_clipping")
+            if ac:
+                max_grad = float(dp_config.get("max_grad", 1.0))
+                self.adaptive_clip = {
+                    "target": float(ac.get("target_quantile", 0.5)),
+                    "lr": float(ac.get("clip_lr", 0.2)),
+                    "init": min(float(ac.get("initial_clip", max_grad)),
+                                max_grad),
+                    # noise on the below-clip count (paper default m/20
+                    # applied at combine time when left unset)
+                    "count_sigma": ac.get("count_sigma"),
+                }
+                self.stateful = True
+
+    def init_state(self, params_like: Any) -> Any:
+        if self.adaptive_clip is None:
+            return super().init_state(params_like)
+        return {"dp_clip": jnp.asarray(self.adaptive_clip["init"],
+                                       jnp.float32)}
 
     def client_weight(self, *, num_samples, train_loss, stats, rng):
         return filter_weight(num_samples)
 
+    def client_step(self, client_update, global_params, arrays, sample_mask,
+                    client_lr, rng, round_idx=None, leakage_threshold=None,
+                    quant_threshold=None, strategy_state=None):
+        parts, tl, ns, stats = super().client_step(
+            client_update, global_params, arrays, sample_mask, client_lr,
+            rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
+            quant_threshold=quant_threshold, strategy_state=strategy_state)
+        if self.adaptive_clip is not None and strategy_state is not None:
+            # below-clip indicator vs the PRE-clip update norm, which
+            # transform_payload recorded in this client's stats dict; it
+            # aggregates as its own psum'd part.  The indicator weight
+            # mirrors the payload's "was this client dropped" status so
+            # the quantile tracks the same population being aggregated.
+            clip = strategy_state["dp_clip"]
+            norm = stats.pop("update_norm")
+            below = (norm <= clip).astype(jnp.float32)
+            ind_w = (parts["default"][1] > 0).astype(jnp.float32)
+            parts["clip_frac"] = ({"below": below}, ind_w)
+        return parts, tl, ns, stats
+
     def transform_payload(self, pseudo_grad: Any, weight: jnp.ndarray,
-                          rng: jax.Array,
-                          quant_threshold=None) -> Tuple[Any, jnp.ndarray]:
+                          rng: jax.Array, quant_threshold=None,
+                          strategy_state=None,
+                          stats=None) -> Tuple[Any, jnp.ndarray]:
         if self.dp_config is not None and self.dp_config.get("enable_local_dp", False):
             from ..privacy import apply_local_dp
+            clip = None
+            if self.adaptive_clip is not None and strategy_state is not None:
+                clip = strategy_state["dp_clip"]
+                if stats is not None:
+                    import optax
+                    stats["update_norm"] = optax.global_norm(pseudo_grad)
             pseudo_grad, weight = apply_local_dp(
-                pseudo_grad, weight, self.dp_config, add_weight_noise=False, rng=rng)
+                pseudo_grad, weight, self.dp_config, add_weight_noise=False,
+                rng=rng, clip_override=clip)
         return pseudo_grad, weight
+
+    def combine_parts(self, part_sums, deferred, state, rng, num_clients,
+                      global_params=None):
+        if self.adaptive_clip is None or "clip_frac" not in part_sums:
+            return super().combine_parts(part_sums, deferred, state, rng,
+                                         num_clients,
+                                         global_params=global_params)
+        agg, _ = self.combine(part_sums["default"]["grad_sum"],
+                              part_sums["default"]["weight_sum"],
+                              deferred, (), rng, num_clients)
+        frac_part = part_sums["clip_frac"]
+        below_count = frac_part["grad_sum"]["below"]
+        m = jnp.maximum(frac_part["weight_sum"], 1.0)
+        ac = self.adaptive_clip
+        # privatize the indicator count (Andrew et al. §3: the released
+        # clip depends on data, so the count gets Gaussian noise sigma_b;
+        # default m/20 per the paper).  Skipped only when the count noise
+        # is explicitly disabled (count_sigma: 0) — e.g. clip-only mode
+        # where no DP guarantee is claimed anyway.
+        sigma_b = ac["count_sigma"]
+        sigma_b = m / 20.0 if sigma_b is None else float(sigma_b)
+        noisy_count = below_count + sigma_b * jax.random.normal(
+            jax.random.fold_in(rng, 23))
+        b = jnp.clip(noisy_count / m, 0.0, 1.0)
+        new_clip = state["dp_clip"] * jnp.exp(-ac["lr"] * (b - ac["target"]))
+        new_clip = jnp.minimum(
+            new_clip, float(self.dp_config.get("max_grad", 1.0)))
+        return agg, {"dp_clip": new_clip}

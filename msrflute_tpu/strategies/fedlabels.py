@@ -70,7 +70,7 @@ class FedLabels(BaseStrategy):
     # ------------------------------------------------------------------
     def client_step(self, client_update, global_params, arrays, sample_mask,
                     client_lr, rng, round_idx=None, leakage_threshold=None,
-                    quant_threshold=None):
+                    quant_threshold=None, strategy_state=None):
         # 1) supervised pass: the standard local-SGD client update on x/y
         labeled = {k: v for k, v in arrays.items()
                    if k not in ("ux", "ux_rand", "uy")}

@@ -88,3 +88,12 @@ def test_fedac_accelerates_on_digits(digits, mesh8, tmp_path, fedavg_run):
     acc_ac = fedac.best_val["acc"].value
     assert acc_ac >= acc_avg - 0.02, (acc_avg, acc_ac)
     assert acc_ac > 0.6, acc_ac
+
+
+def test_fedac_rejects_adaptive_clipping():
+    from msrflute_tpu.strategies.fedac import FedAC
+    cfg = _cfg("fedac", 1)
+    dp = {"enable_local_dp": True, "max_grad": 1.0,
+          "adaptive_clipping": {"target_quantile": 0.5}}
+    with pytest.raises(ValueError, match="adaptive"):
+        FedAC(cfg, dp)
