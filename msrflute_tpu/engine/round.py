@@ -344,20 +344,21 @@ class RoundEngine:
         cspec = P(CLIENTS_AXIS)
         rspec = P()
 
-        def shard_body(params, arrays, sample_mask, client_mask, client_ids,
-                       client_lr, rng):
+        def shard_body(params, strategy_state, arrays, sample_mask,
+                       client_mask, client_ids, client_lr, rng):
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 rng_c = jax.random.fold_in(rng, cid_c)
                 parts, tl, ns, stats = strategy.client_step(
-                    client_update, params, arr_c, mask_c, client_lr, rng_c)
+                    client_update, params, arr_c, mask_c, client_lr, rng_c,
+                    strategy_state=strategy_state)
                 pg, w = parts["default"]
                 return pg, w * cm_c, stats
             return jax.vmap(per_client)(arrays, sample_mask, client_mask,
                                         client_ids)
 
         fn = shard_map(shard_body, mesh=mesh,
-                       in_specs=(rspec, cspec, cspec, cspec, cspec, rspec,
-                                 rspec),
+                       in_specs=(rspec, rspec, cspec, cspec, cspec, cspec,
+                                 rspec, rspec),
                        out_specs=cspec, check_vma=False)
         return jax.jit(fn)
 
@@ -369,7 +370,7 @@ class RoundEngine:
         arrays = {k: jax.device_put(v, self._client_sharding)
                   for k, v in batch.arrays.items()}
         return self._payload_step(
-            state.params, arrays,
+            state.params, state.strategy_state, arrays,
             jax.device_put(batch.sample_mask, self._client_sharding),
             jax.device_put(batch.client_mask, self._client_sharding),
             jax.device_put(batch.client_ids, self._client_sharding),

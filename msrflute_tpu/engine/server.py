@@ -532,6 +532,10 @@ class OptimizationServer:
     # ------------------------------------------------------------------
     _last_val: MetricsDict = {}
 
+    def _split_cfg(self, split: str):
+        dc = self.config.server_config.data_config
+        return dc.val if split == "val" else dc.test
+
     def _packed_eval_batches(self, split: str):
         """Packed ``[T, B, ...]`` eval grid for a split — cached: eval data
         is static across rounds, so the host-side copy happens once per
@@ -540,10 +544,8 @@ class OptimizationServer:
         batches = self._eval_batches_cache.get(split)
         if batches is None:
             dataset = self.val_dataset if split == "val" else self.test_dataset
-            batch_cfg = (self.config.server_config.data_config.val
-                         if split == "val"
-                         else self.config.server_config.data_config.test)
-            bs = int(batch_cfg.get("batch_size", self.batch_size))
+            bs = int(self._split_cfg(split).get("batch_size",
+                                                self.batch_size))
             batches = pack_eval_batches(
                 dataset, bs,
                 pad_steps_to_multiple_of=self.mesh.shape[CLIENTS_AXIS])
@@ -559,6 +561,8 @@ class OptimizationServer:
                            self.engine.partition_mode)
         for name, metric in metrics.items():
             log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
+        if self._split_cfg(split).get("wantLogits", False):
+            self._dump_predictions(split, round_no)
 
         improved = False
         if split == "val":
@@ -571,6 +575,66 @@ class OptimizationServer:
                     if name == self.best_model_criterion:
                         improved = True
         return improved
+
+    def _dump_predictions(self, split: str, round_no: int,
+                          topk: int = 3) -> None:
+        """Per-sample prediction dump when the split's data_config sets
+        ``wantLogits`` (reference ``core/client.py:156`` +
+        ``nlg_gru/model.py:113-130``: eval returns output payloads).
+        One JSON line per real sample -> ``predictions_<split>_r<N>.jsonl``.
+
+        Deliberate cost: this is a SECOND forward over the eval grid, kept
+        separate from the metric eval (whose contract is psum'd scalar
+        sums, not per-sample payloads) — it only runs on wantLogits evals.
+        """
+        import json as _json
+
+        task = self.task
+        seq_fn = getattr(task, "topk_predictions", None)
+        cls_fn = getattr(task, "predict", None)
+        if seq_fn is None and cls_fn is None:
+            print_rank(f"wantLogits set for {split} but task "
+                       f"{type(task).__name__} exposes neither "
+                       "topk_predictions nor predict — no dump written",
+                       loglevel=logging.WARNING)
+            return
+        batches = self._packed_eval_batches(split)
+        if not hasattr(self, "_pred_fns"):
+            self._pred_fns = {}
+        fn = self._pred_fns.get(split)
+        if fn is None:
+            if seq_fn is not None:
+                fn = jax.jit(lambda p, b: seq_fn(p, b, topk))
+            else:
+                fn = jax.jit(cls_fn)
+            self._pred_fns[split] = fn
+
+        path = os.path.join(self.ckpt.model_dir,
+                            f"predictions_{split}_r{round_no}.jsonl")
+        T = batches["sample_mask"].shape[0]
+        with open(path, "w", encoding="utf-8") as fh:
+            for t in range(T):
+                batch = {k: v[t] for k, v in batches.items()
+                         if k != "user_idx"}
+                out = jax.device_get(fn(self.state.params, batch))
+                mask = np.asarray(batches["sample_mask"][t]) > 0
+                uids = np.asarray(batches["user_idx"][t])
+                for i in np.flatnonzero(mask):
+                    if seq_fn is not None:
+                        top_p, top_ids, labels = out
+                        row = {"user": int(uids[i]),
+                               "topk_ids": top_ids[i].tolist(),
+                               "topk_probs": np.round(
+                                   top_p[i], 6).tolist(),
+                               "labels": labels[i].tolist()}
+                    else:
+                        logits, pred, labels = out
+                        row = {"user": int(uids[i]),
+                               "pred": int(pred[i]),
+                               "label": int(labels[i]),
+                               "logits": np.round(logits[i], 6).tolist()}
+                    fh.write(_json.dumps(row) + "\n")
+        print_rank(f"wrote {split} predictions to {path}")
 
     def _fall_back(self) -> None:
         """Reload the best checkpoint, preserving current LR weight

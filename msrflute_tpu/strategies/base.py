@@ -63,10 +63,18 @@ class BaseStrategy:
     #: when True the engine skips the server optimizer and calls
     #: :meth:`apply_server_update` instead (multi-sequence schemes: FedAC)
     owns_server_update: bool = False
+    #: strategies that implement dp_config.adaptive_clipping set this; the
+    #: base init fails loudly instead of silently ignoring the config
+    supports_adaptive_clipping: bool = False
 
     def __init__(self, config, dp_config=None):
         self.config = config
         self.dp_config = dp_config
+        if dp_config is not None and dp_config.get("adaptive_clipping") and \
+                not self.supports_adaptive_clipping:
+            raise ValueError(
+                f"{type(self).__name__} does not implement "
+                "dp_config.adaptive_clipping — use strategy: fedavg")
 
     #: set by RoundEngine so strategies can reach model apply()/loss()
     task: Any = None

@@ -31,7 +31,17 @@ class FedAvg(BaseStrategy):
     below-clip indicator is aggregated as an extra psum'd payload part,
     and the noise sigma keeps the static max_grad sensitivity bound
     (always >= the adaptive clip).
+
+    Threat model (documented caveat): this follows the paper's CENTRAL-DP
+    setting — the below-clip count is noised at the aggregator (sigma_b),
+    not per client, and the count query is an additional mechanism that
+    the RDP accountant does not yet compose into the reported epsilon.
+    Under a strict local-DP threat model the raw indicator leaves the
+    client; a warning is logged when eps >= 0 so the budget accounting
+    gap is visible.
     """
+
+    supports_adaptive_clipping = True
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
@@ -50,6 +60,12 @@ class FedAvg(BaseStrategy):
                     "count_sigma": ac.get("count_sigma"),
                 }
                 self.stateful = True
+                if float(dp_config.get("eps", -1.0)) >= 0:
+                    from ..utils.logging import print_rank
+                    print_rank(
+                        "adaptive_clipping: the below-clip count query is "
+                        "noised centrally (sigma_b) and is NOT composed "
+                        "into the RDP accountant — budget accordingly")
 
     def init_state(self, params_like: Any) -> Any:
         if self.adaptive_clip is None:

@@ -91,3 +91,92 @@ def test_server_replay_updatable_names(synth_dataset, mesh8, tmp_path):
                         before["Dense_0"]["bias"]).max()
     assert kernel_moved > 0
     assert bias_moved == 0.0  # frozen by updatable_names
+
+
+def test_want_logits_prediction_dump(synth_dataset, mesh8, tmp_path):
+    """data_config.val.wantLogits dumps per-sample predictions at eval
+    (reference core/client.py:156 output payloads)."""
+    import json
+    import os
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 2, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.3,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2, "initial_val": False,
+            "data_config": {"val": {"batch_size": 8, "wantLogits": True}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.3},
+            "data_config": {"train": {"batch_size": 4}},
+        },
+    })
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                val_dataset=synth_dataset,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    server.train()
+    dumps = [n for n in os.listdir(tmp_path)
+             if n.startswith("predictions_val_")]
+    assert dumps, os.listdir(tmp_path)
+    rows = [json.loads(l) for l in
+            (tmp_path / dumps[0]).read_text().splitlines()]
+    total = sum(synth_dataset.num_samples)
+    assert len(rows) == total
+    assert {"user", "pred", "label", "logits"} <= set(rows[0])
+    assert all(0 <= r["pred"] < 4 for r in rows)
+
+
+def test_want_logits_sequence_topk_dump(mesh8, tmp_path):
+    """Sequence tasks dump top-K token predictions (the GRU wantLogits
+    payload shape, nlg_gru/model.py:113-130)."""
+    import json
+    import os
+    import numpy as np
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    rng = np.random.default_rng(0)
+    users = [f"u{i}" for i in range(4)]
+    per_user = [{"x": rng.integers(1, 30, size=(3, 12)).astype(np.int32)}
+                for _ in users]
+    ds = ArraysDataset(users, per_user)
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "GRU", "vocab_size": 30,
+                         "embed_dim": 8, "hidden_dim": 16,
+                         "max_num_words": 12},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 2, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.1,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2, "initial_val": False,
+            "data_config": {"val": {"batch_size": 4, "wantLogits": True}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.1},
+            "data_config": {"train": {"batch_size": 2}},
+        },
+    })
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), mesh=mesh8, seed=0)
+    server.train()
+    dumps = [n for n in os.listdir(tmp_path)
+             if n.startswith("predictions_val_")]
+    assert dumps
+    rows = [json.loads(l) for l in
+            (tmp_path / dumps[0]).read_text().splitlines()]
+    assert len(rows) == 12  # 4 users x 3 sequences
+    r = rows[0]
+    assert {"user", "topk_ids", "topk_probs", "labels"} <= set(r)
+    assert len(r["topk_ids"][0]) == 3  # top-3 per position
