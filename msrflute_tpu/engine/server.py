@@ -614,10 +614,12 @@ class OptimizationServer:
         T = batches["sample_mask"].shape[0]
         with open(path, "w", encoding="utf-8") as fh:
             for t in range(T):
+                mask = np.asarray(batches["sample_mask"][t]) > 0
+                if not mask.any():
+                    continue  # mesh-padding step: skip the forward entirely
                 batch = {k: v[t] for k, v in batches.items()
                          if k != "user_idx"}
                 out = jax.device_get(fn(self.state.params, batch))
-                mask = np.asarray(batches["sample_mask"][t]) > 0
                 uids = np.asarray(batches["user_idx"][t])
                 for i in np.flatnonzero(mask):
                     if seq_fn is not None:

@@ -46,6 +46,11 @@ class FedAvg(BaseStrategy):
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
         self.adaptive_clip = None
+        if dp_config is not None and dp_config.get("adaptive_clipping") and \
+                not dp_config.get("enable_local_dp", False):
+            raise ValueError(
+                "dp_config.adaptive_clipping requires enable_local_dp: true "
+                "(the clip applies inside the local-DP transform)")
         if dp_config is not None and dp_config.get("enable_local_dp", False):
             ac = dp_config.get("adaptive_clipping")
             if ac:
