@@ -329,6 +329,14 @@ class OptimizationServer:
             self._process_privacy_stats(
                 stats, round_no,
                 client_mask=np.stack([b.client_mask for b in batches]))
+            if isinstance(self.state.strategy_state, dict) and \
+                    "dp_clip" in self.state.strategy_state:
+                # adaptive DP clipping observability (arXiv:1905.03871);
+                # the post-chunk value is the clip the NEXT round applies,
+                # so it logs at that round's step
+                log_metric("DP clip norm",
+                           float(self.state.strategy_state["dp_clip"]),
+                           step=round_no + R)
             if self.engine.dump_norm_stats and "norm" in stats:
                 self._dump_norm_stats(stats, batches)
             round_no += R

@@ -42,12 +42,15 @@ class FedAC(FedAvg):
     supports_rl = False
 
     def __init__(self, config, dp_config=None):
-        super().__init__(config, dp_config)
-        if self.adaptive_clip is not None:
+        # reject BEFORE the inherited FedAvg checks: their advice
+        # ("requires enable_local_dp") would mislead a FedAC user into a
+        # second error instead of the real answer (not supported together)
+        if dp_config is not None and dp_config.get("adaptive_clipping"):
             raise ValueError(
                 "FedAC and dp_config.adaptive_clipping both need the "
                 "strategy-state slot (w_ag vs dp_clip) — not supported "
                 "together; use strategy: fedavg for adaptive clipping")
+        super().__init__(config, dp_config)
         sc = config.server_config
         self.eta = float(sc.get("fedac_eta", 1.0))
         self.gamma = float(sc.get("fedac_gamma", max(self.eta, 1.0)))

@@ -7,17 +7,18 @@ exercised on ``xla_force_host_platform_device_count=8`` virtual devices.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the one shared implementation of the never-touch-the-TPU-tunnel
+# discipline (also used by bench.py and __graft_entry__.py)
+from msrflute_tpu.utils.backend import force_cpu_backend  # noqa: E402
+
+force_cpu_backend(8)
 
 import jax  # noqa: E402
 
-# env vars alone are not enough: a sitecustomize may have imported jax at
-# interpreter startup with another platform already configured.
-jax.config.update("jax_platforms", "cpu")
 assert all(d.platform == "cpu" for d in jax.devices()), jax.devices()
 assert len(jax.devices()) == 8, jax.devices()
 
