@@ -32,7 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
-from .accountant import compute_rdp, get_privacy_spent  # noqa: F401
+# NOTE: the near-exact PRV accountant lives in .prv and is NOT re-exported
+# here — it is offline-only (tools/compute_dp_epsilon.py) and importing it
+# would put scipy.stats on every training-process startup path.
+from .accountant import DEFAULT_ORDERS, compute_rdp, get_privacy_spent  # noqa: F401
 
 
 def compute_ldp_noise_std(eps: float, max_sensitivity: float, delta: float) -> float:
@@ -228,9 +231,8 @@ def update_privacy_accountant(config, num_clients: int, curr_iter: int,
         mu = -1.0
 
     q = B / n
-    orders = list(range(2, 64)) + [128, 256, 512]
-    rdp = compute_rdp(q, global_sigma, T_iters, orders)
-    rdp_epsilon, opt_order = get_privacy_spent(orders, rdp, delta)
+    rdp = compute_rdp(q, global_sigma, T_iters, DEFAULT_ORDERS)
+    rdp_epsilon, opt_order = get_privacy_spent(DEFAULT_ORDERS, rdp, delta)
 
     props = {
         "dp_global_K": K, "dp_global_B": B, "dp_global_n": n,

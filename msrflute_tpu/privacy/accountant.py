@@ -28,6 +28,12 @@ import numpy as np
 from scipy.special import gammaln, logsumexp
 
 
+# shared order grid for RDP accounting (integer-order mechanism family;
+# fractional entries below 2 are rounded up by compute_rdp anyway, so the
+# grid is integers with a coarse high-order tail)
+DEFAULT_ORDERS: Tuple[int, ...] = tuple(range(2, 64)) + (128, 256, 512)
+
+
 def _log_comb(n: int, k: int) -> float:
     return gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
 

@@ -1,0 +1,115 @@
+"""PRV accountant validation.
+
+The PRV result is near-exact, so it can be cross-checked two ways:
+against the closed-form Gaussian-mechanism curve (q=1; Balle & Wang 2018,
+"Improving the Gaussian mechanism for differential privacy") and against
+the Renyi accountant (:mod:`msrflute_tpu.privacy.accountant`), which is a
+strict upper bound for the same mechanism.  Role parity: the reference's
+``dp-accountant`` submodule (``.gitmodules:1-3``, ``README.md:162-171``).
+"""
+
+import math
+
+import numpy as np
+import pytest
+from scipy.stats import norm
+
+from msrflute_tpu.privacy.accountant import (DEFAULT_ORDERS, compute_rdp,
+                                             get_privacy_spent)
+from msrflute_tpu.privacy.prv import PRVAccountant, compute_dp_epsilon
+
+
+def analytic_gaussian_eps(sigma: float, steps: int, delta: float) -> float:
+    """Exact eps for the T-fold Gaussian mechanism: composition of T
+    Gaussians = one Gaussian with sensitivity sqrt(T)/sigma, and
+    delta(eps) = Phi(mu/2 - eps/mu) - e^eps Phi(-mu/2 - eps/mu) with
+    mu = sqrt(T)/sigma (Balle & Wang 2018, Thm. 8)."""
+    mu = math.sqrt(steps) / sigma
+
+    def delta_of(eps):
+        return (norm.cdf(mu / 2 - eps / mu)
+                - math.exp(eps) * norm.cdf(-mu / 2 - eps / mu))
+
+    lo, hi = 0.0, 1.0
+    while delta_of(hi) > delta:
+        hi *= 2
+    for _ in range(200):
+        mid = (lo + hi) / 2
+        if delta_of(mid) > delta:
+            lo = mid
+        else:
+            hi = mid
+    return hi
+
+
+@pytest.mark.parametrize("sigma,steps,delta", [
+    (2.0, 1, 1e-5),
+    (1.0, 10, 1e-6),
+    (4.0, 100, 1e-6),
+])
+def test_matches_analytic_gaussian(sigma, steps, delta):
+    """q=1 reduces to the pure Gaussian mechanism, whose eps(delta) is
+    known in closed form; the PRV bracket must contain it and the
+    estimate must sit within the documented error."""
+    acc = PRVAccountant(noise_multiplier=sigma, sampling_probability=1.0,
+                        max_steps=steps, eps_error=0.05)
+    lo, est, up = acc.compute_epsilon(delta, steps)
+    exact = analytic_gaussian_eps(sigma, steps, delta)
+    assert lo <= exact <= up, (lo, exact, up)
+    assert abs(est - exact) < 0.15
+
+
+@pytest.mark.parametrize("q,sigma,steps", [
+    (0.01, 1.0, 1000),
+    (0.1, 2.0, 300),
+    (0.003, 0.8, 2000),
+])
+def test_tighter_than_rdp(q, sigma, steps):
+    """PRV is near-exact; the Renyi bound is a genuine upper bound for the
+    same subsampled-Gaussian composition, so PRV's upper reading must not
+    exceed it (and the estimate should be strictly tighter)."""
+    delta = 1e-6
+    acc = PRVAccountant(sigma, q, max_steps=steps, eps_error=0.1)
+    lo, est, up = acc.compute_epsilon(delta, steps)
+    rdp_eps, _ = get_privacy_spent(
+        DEFAULT_ORDERS, compute_rdp(q, sigma, steps, DEFAULT_ORDERS), delta)
+    assert up <= rdp_eps + 0.25, (up, rdp_eps)
+    assert est < rdp_eps
+    assert 0 < lo <= est <= up
+
+
+def test_monotone_in_steps_and_noise():
+    acc = PRVAccountant(1.0, 0.05, max_steps=500, eps_error=0.1)
+    e100 = acc.compute_epsilon(1e-6, 100)[1]
+    e500 = acc.compute_epsilon(1e-6, 500)[1]
+    assert e500 > e100 > 0
+    quiet = PRVAccountant(2.0, 0.05, max_steps=500, eps_error=0.1)
+    assert quiet.compute_epsilon(1e-6, 500)[1] < e500
+
+
+def test_delta_inverse_roundtrip():
+    """compute_delta at the pessimistic eps must come back <= delta."""
+    acc = PRVAccountant(1.2, 0.02, max_steps=200, eps_error=0.1)
+    _, _, up = acc.compute_epsilon(1e-6, 200)
+    assert acc.compute_delta(up, 200) <= 1e-6 * 1.01
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        PRVAccountant(0.0, 0.1, max_steps=10)
+    with pytest.raises(ValueError):
+        PRVAccountant(1.0, 0.0, max_steps=10)
+    with pytest.raises(ValueError):
+        PRVAccountant(1.0, 1.5, max_steps=10)
+    acc = PRVAccountant(1.0, 0.1, max_steps=10)
+    with pytest.raises(ValueError):
+        acc.compute_epsilon(1e-6, 11)
+    with pytest.raises(ValueError):
+        acc.compute_epsilon(0.0, 10)
+
+
+def test_cli_helper_contract():
+    out = compute_dp_epsilon(0.02, 1.0, 100, 1e-6, eps_error=0.1)
+    assert set(out) >= {"eps_lower", "eps_estimate", "eps_upper", "delta",
+                        "iterations"}
+    assert out["eps_lower"] <= out["eps_estimate"] <= out["eps_upper"]

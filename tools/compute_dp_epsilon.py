@@ -1,0 +1,54 @@
+"""Offline DP accounting CLI — PRV (near-exact) and RDP (upper bound).
+
+Role parity: the reference's ``dp-accountant`` submodule ships
+``compute-dp-epsilon -p SAMPLING_PROBABILITY -s NOISE_MULTIPLIER
+-i ITERATIONS -d DELTA`` (reference ``README.md:162-171``); accounting is
+done offline from the parameters the server logs (``README.md:160``,
+mirrored by our ``update_privacy_accountant`` metrics records).
+
+Usage::
+
+    python tools/compute_dp_epsilon.py -p 0.01 -s 1.0 -i 1000 -d 1e-6
+
+Prints one JSON line with the PRV bracket (eps_lower/estimate/upper) and
+the RDP upper bound for cross-checking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-p", "--sampling-probability", type=float, required=True)
+    ap.add_argument("-s", "--noise-multiplier", type=float, required=True)
+    ap.add_argument("-i", "--iterations", type=int, required=True)
+    ap.add_argument("-d", "--delta", type=float, required=True)
+    ap.add_argument("--eps-error", type=float, default=0.1,
+                    help="PRV discretization budget (default 0.1)")
+    args = ap.parse_args(argv)
+
+    from msrflute_tpu.privacy.accountant import (DEFAULT_ORDERS, compute_rdp,
+                                                 get_privacy_spent)
+    from msrflute_tpu.privacy.prv import compute_dp_epsilon
+
+    out = compute_dp_epsilon(args.sampling_probability,
+                             args.noise_multiplier, args.iterations,
+                             args.delta, eps_error=args.eps_error)
+    rdp = compute_rdp(args.sampling_probability, args.noise_multiplier,
+                      args.iterations, DEFAULT_ORDERS)
+    rdp_eps, opt_order = get_privacy_spent(DEFAULT_ORDERS, rdp, args.delta)
+    out["rdp_eps_upper"] = rdp_eps
+    out["rdp_opt_order"] = opt_order
+    print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in out.items()}))
+
+
+if __name__ == "__main__":
+    main()
