@@ -29,6 +29,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.moe import MoEFFN
+from ..ops.pallas_attention import flash_attention
 from ..ops.ring_attention import ring_self_attention
 from .base import masked_mean, parse_dtype, softmax_xent
 from .nlp import SequenceLMTask, _TokenDatasetMixin
@@ -38,10 +39,16 @@ class _MHA(nn.Module):
     heads: int
     head_dim: int
     dtype: Any = jnp.float32
-    # sequence-parallel mode: mesh + axis names (None = local full softmax)
+    # sequence-parallel mode: mesh + axis names (None = local attention)
     ring_mesh: Optional[Mesh] = None
     seq_axis: str = "sequence"
     batch_axis: Optional[str] = None
+    #: local mode: tile attention in VMEM via the Pallas flash kernel
+    #: (ops/pallas_attention.py) instead of materializing the O(L^2)
+    #: score matrix — the single-chip long-context lever.  Ring mode
+    #: ignores it (the ring's per-rotation blocks are already O(L/N)
+    #: sized; sp_module warns if both are requested).
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):  # [B, L, E]
@@ -53,6 +60,8 @@ class _MHA(nn.Module):
             attn = ring_self_attention(q, k, v, self.ring_mesh,
                                        axis=self.seq_axis, causal=True,
                                        batch_axis=self.batch_axis)
+        elif self.use_flash:
+            attn = flash_attention(q, k, v, causal=True)
         else:
             scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
             scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
@@ -79,12 +88,14 @@ class _Block(nn.Module):
     moe_experts: int = 0
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + _MHA(self.heads, self.head_dim, self.dtype, self.ring_mesh,
-                     self.seq_axis, self.batch_axis)(h)
+                     self.seq_axis, self.batch_axis,
+                     use_flash=self.use_flash)(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
             ep_mesh = (self.ring_mesh if self.moe_ep_axis is not None
@@ -118,6 +129,7 @@ class _RingLM(nn.Module):
     moe_experts: int = 0
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):  # [B, L] int32
@@ -136,7 +148,7 @@ class _RingLM(nn.Module):
                           self.dtype, self.ring_mesh, self.seq_axis,
                           self.batch_axis, self.moe_experts,
                           self.moe_ep_axis, self.moe_capacity_factor,
-                          name=f"block_{i}")(h)
+                          self.use_flash, name=f"block_{i}")(h)
         h = nn.LayerNorm(dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=self.dtype)(h)
 
@@ -155,6 +167,12 @@ class RingLMTask(_TokenDatasetMixin, SequenceLMTask):
         """Clone into sequence-parallel mode; ``expert_axis`` additionally
         engages expert-parallel MoE dispatch on that mesh axis (requires
         ``moe_experts == mesh.shape[expert_axis]``)."""
+        if self.module.use_flash:
+            import warnings
+            warnings.warn(
+                "flash_attention is a LOCAL-mode knob; ring mode tiles "
+                "attention via its own O(L/N) rotation blocks and ignores "
+                "it", stacklevel=2)
         return self.module.clone(ring_mesh=mesh, seq_axis=seq_axis,
                                  batch_axis=batch_axis,
                                  moe_ep_axis=expert_axis)
@@ -170,7 +188,8 @@ def make_ringlm_task(model_config) -> RingLMTask:
         num_layers=int(model_config.get("num_layers", 2)),
         dtype=parse_dtype(model_config),
         remat=bool(model_config.get("remat", False)),
-        moe_experts=int(model_config.get("moe_experts", 0) or 0))
+        moe_experts=int(model_config.get("moe_experts", 0) or 0),
+        use_flash=bool(model_config.get("flash_attention", False)))
     return RingLMTask(module,
                       seq_len=int(model_config.get("seq_len", 128)),
                       name="ringlm")

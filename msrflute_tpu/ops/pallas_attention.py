@@ -1,0 +1,328 @@
+"""Pallas flash attention — the long-context hot op, tiled for the MXU.
+
+Net-new vs the reference (FLUTE has no attention models beyond HF BERT and
+no long-context machinery, SURVEY.md §5.7).  This is the TPU-native
+answer for the RingLM family: exact attention computed blockwise in VMEM
+with an online softmax, O(L) memory instead of the O(L^2) score
+materialization of the jnp path (``models/ringlm.py`` local mode).  Both
+passes are Pallas kernels (FlashAttention-2 style tiling):
+
+- forward: grid ``(B, H, Lq/block_q)``; each program streams key/value
+  blocks through VMEM, carrying ``(m, l, acc)`` in registers and writing
+  the output block plus the log-sum-exp row statistics for the backward.
+- backward: ``dq`` on the same grid; ``dk``/``dv`` on a
+  ``(B, H, Lk/block_k)`` grid — each recomputes the probabilities from
+  the saved ``lse`` (no O(L^2) residuals).
+
+Causal masking and length padding are position-based and fully static:
+sequence/feature dims are padded to block/lane multiples, the real
+lengths are baked into the kernels at trace time, and masked probability
+entries are zeroed explicitly (no ``-inf`` arithmetic on the MXU path).
+
+Degrades gracefully off-TPU: kernels run in Pallas interpret mode (the
+same code path the tests exercise), so the op is usable — if not fast —
+everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from .pallas_kernels import _resolve_interpret
+
+_LANES = 128
+_NEG = -1e30  # "minus infinity" that survives exp/max without NaNs
+
+
+def _pad_axis(x, axis, to):
+    pad = to - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _ceil_to(n, m):
+    return int(np.ceil(n / m)) * m
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                block_q, block_k, l_q, l_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [bq, D]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    num_k = pl.cdiv(l_k, block_k)
+    if causal:
+        # blocks entirely above the diagonal contribute nothing
+        num_k = jnp.minimum(num_k,
+                            pl.cdiv((qi + 1) * block_q, block_k))
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+            jnp.float32)                                # [bk, D]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < l_k
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        # mask p explicitly: for fully-masked rows s == m_new == _NEG and
+        # exp(0) would resurrect the masked entries
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+    lse_ref[0, 0, :] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                                 _NEG)
+
+
+# ----------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               causal, scale, block_q, block_k, l_q, l_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, :, 0, :].astype(jnp.float32)
+    do = do_ref[0, :, 0, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    num_k = pl.cdiv(l_k, block_k)
+    if causal:
+        num_k = jnp.minimum(num_k,
+                            pl.cdiv((qi + 1) * block_q, block_k))
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+            jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < l_k
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_k, body, dq0)
+    dq_ref[0, :, 0, :] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, causal, scale, block_q, block_k,
+                l_q, l_k):
+    ki = pl.program_id(2)
+    k_blk = k_ref[0, :, 0, :].astype(jnp.float32)       # [bk, D]
+    v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    num_q = pl.cdiv(l_q, block_q)
+    # causal: q blocks strictly below this key block's diagonal see nothing
+    i0 = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = k_pos < l_k
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        # padded q rows carry lse = _NEG -> exp(s - _NEG) would overflow;
+        # mask on the valid-q side too
+        mask = jnp.logical_and(mask, q_pos < l_q)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, D]
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, k_blk.shape[1]), jnp.float32)
+    dv0 = jnp.zeros((block_k, v_blk.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i0, num_q, body, (dk0, dv0))
+    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------------------
+# pallas_call plumbing
+# ----------------------------------------------------------------------
+def _specs(block_q, block_k, lq_p, lk_p, d_p):
+    q_spec = pl.BlockSpec((1, block_q, 1, d_p),
+                          lambda b, h, i: (b, i, h, 0))
+    kv_spec = pl.BlockSpec((1, lk_p, 1, d_p),
+                           lambda b, h, i: (b, 0, h, 0))
+    lse_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+    return q_spec, kv_spec, lse_spec
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    lq_p, lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
+    d_p = _ceil_to(D, _LANES)
+    qp = _pad_axis(_pad_axis(q, 1, lq_p), 3, d_p)
+    kp = _pad_axis(_pad_axis(k, 1, lk_p), 3, d_p)
+    vp = _pad_axis(_pad_axis(v, 1, lk_p), 3, d_p)
+    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lq_p, lk_p, d_p)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               l_q=Lq, l_k=Lk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, lq_p // block_q),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                   jax.ShapeDtypeStruct((B, H, lq_p), jnp.float32)],
+        interpret=_resolve_interpret(interpret),
+    )(qp, kp, vp)
+    return out[:, :Lq, :, :D], lse
+
+
+def _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret):
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    lq_p, lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
+    d_p = _ceil_to(D, _LANES)
+    qp = _pad_axis(_pad_axis(q, 1, lq_p), 3, d_p)
+    kp = _pad_axis(_pad_axis(k, 1, lk_p), 3, d_p)
+    vp = _pad_axis(_pad_axis(v, 1, lk_p), 3, d_p)
+    gp = _pad_axis(_pad_axis(g, 1, lq_p), 3, d_p)
+    # delta_i = sum_d dO_i . O_i  (rowwise), the softmax-grad correction
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=3)                              # [B, Lq, H]
+    delta = _pad_axis(delta.transpose(0, 2, 1), 2, lq_p)  # [B, H, lq_p]
+    interp = _resolve_interpret(interpret)
+    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lq_p, lk_p, d_p)
+
+    dq_kernel = functools.partial(_dq_kernel, causal=causal, scale=scale,
+                                  block_q=block_q, block_k=block_k,
+                                  l_q=Lq, l_k=Lk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, lq_p // block_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        interpret=interp,
+    )(qp, kp, vp, gp, lse, delta)
+
+    # dk/dv: grid over key blocks; q/do/lse/delta stream in full
+    kq_spec = pl.BlockSpec((1, lq_p, 1, d_p), lambda b, h, i: (b, 0, h, 0))
+    kk_spec = pl.BlockSpec((1, block_k, 1, d_p),
+                           lambda b, h, i: (b, i, h, 0))
+    full_lse_spec = pl.BlockSpec((1, 1, lq_p), lambda b, h, i: (b, h, 0))
+    dkv_kernel = functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   l_q=Lq, l_k=Lk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, lk_p // block_k),
+        in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, full_lse_spec,
+                  full_lse_spec],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, v.dtype)],
+        interpret=interp,
+    )(qp, kp, vp, gp, lse, delta)
+    return dq[:, :Lq, :, :D], dk[:, :Lk, :, :D], dv[:, :Lk, :, :D]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    D = q.shape[3]
+    scale = float(1.0 / np.sqrt(D))
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    D = q.shape[3]
+    scale = float(1.0 / np.sqrt(D))
+    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    D = q.shape[3]
+    scale = float(1.0 / np.sqrt(D))
+    return _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False, *, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Exact attention over ``[B, L, H, D]`` tensors, tiled in VMEM.
+
+    Softmax scale is ``1/sqrt(D)`` (matching ``models/ringlm.py``).
+    ``D`` is padded to the 128-lane width and ``L`` to the block size; the
+    key/value stream for one head must fit VMEM, which bounds local
+    sequence length at roughly 16k (f32) per chip — beyond that, shard the
+    sequence axis and let :mod:`msrflute_tpu.ops.ring_attention` rotate
+    these same blocks around the ring.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, L, H, D], got {q.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    return _flash(q, k, v, bool(causal), int(block_q), int(block_k),
+                  interpret)

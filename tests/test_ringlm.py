@@ -107,3 +107,28 @@ def test_ringlm_federated_round(mesh8, tmp_path):
     state = server.train()
     assert state.round == 2
     assert "loss" in server.best_val
+
+
+def test_flash_attention_matches_local(task):
+    """Local mode with the Pallas flash kernel == dense-softmax local mode
+    through the whole model, forward AND parameter gradients."""
+    flash_task = make_task(ModelConfig(
+        model_type="RINGLM", extra=dict(MC, flash_attention=True)))
+    params = task.init_params(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(4).integers(1, 40, size=(2, 32)),
+                    jnp.int32)
+
+    def loss(apply_task, p):
+        out = apply_task.module.apply({"params": p}, x)
+        return jnp.mean(out.astype(jnp.float32) ** 2)
+
+    l_dense, g_dense = jax.value_and_grad(
+        lambda p: loss(task, p))(params)
+    l_flash, g_flash = jax.value_and_grad(
+        lambda p: loss(flash_task, p))(params)
+    np.testing.assert_allclose(float(l_dense), float(l_flash),
+                               rtol=2e-5, atol=2e-5)
+    flat_d, _ = jax.flatten_util.ravel_pytree(g_dense)
+    flat_f, _ = jax.flatten_util.ravel_pytree(g_flash)
+    np.testing.assert_allclose(np.asarray(flat_d), np.asarray(flat_f),
+                               rtol=5e-4, atol=5e-5)
