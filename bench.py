@@ -264,6 +264,57 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
     return out
 
 
+def bench_longctx(on_tpu: bool) -> dict:
+    """Net-new long-context protocol (no reference baseline — FLUTE has no
+    long-context machinery, SURVEY.md §5.7): tokens/s of a jitted RingLM
+    causal-LM train step, dense-softmax attention vs the Pallas flash
+    kernel (``ops/pallas_attention.py``).  Off-TPU this only smokes the
+    code path (interpret-mode kernels are not a measurement)."""
+    import jax
+    import jax.numpy as jnp
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+
+    L = 2048 if on_tpu else 64
+    B = 4 if on_tpu else 2
+    mc = {"vocab_size": 256, "embed_dim": 256, "num_heads": 4,
+          "head_dim": 64, "mlp_dim": 1024, "num_layers": 4, "seq_len": L}
+    if on_tpu:
+        mc["dtype"] = "bfloat16"
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        1, 256, size=(B, L)), jnp.int32)
+    out = {"seq_len": L, "batch": B}
+
+    def step_time(flash: bool) -> float:
+        task = make_task(ModelConfig(model_type="RINGLM", extra=dict(
+            mc, flash_attention=flash)))
+        params = task.init_params(jax.random.PRNGKey(0))
+        batch = {"x": tokens,
+                 "sample_mask": jnp.ones((B,), jnp.float32)}
+
+        @jax.jit
+        def step(p):
+            def loss(pp):
+                return task.loss(pp, batch, jax.random.PRNGKey(0), True)[0]
+            return jax.grad(loss)(p)
+
+        jax.block_until_ready(step(params))  # compile
+        reps = 5 if on_tpu else 1
+        tic = time.time()
+        for _ in range(reps):
+            g = step(params)
+        jax.block_until_ready(g)
+        return (time.time() - tic) / reps
+
+    dense = step_time(False)
+    flash = step_time(True)
+    out["dense_secs_per_step"] = round(dense, 4)
+    out["flash_secs_per_step"] = round(flash, 4)
+    out["flash_speedup"] = round(dense / flash, 2)
+    out["flash_tokens_per_sec"] = round(B * L / flash, 1)
+    return out
+
+
 def scale_probe(backend: str) -> dict:
     """K-clients-per-round scaling curve for the CNN protocol (the
     reference's "tens of thousands sampled" axis, ``README.md:9``): find
@@ -359,8 +410,8 @@ def main() -> None:
             BASELINES_SECS_PER_ROUND["cnn_femnist"]
 
     only = os.environ.get("BENCH_PROTOCOLS")  # e.g. "cnn_femnist,lr_mnist"
-    if only:
-        keep = set(only.split(","))
+    keep = set(only.split(",")) if only else None
+    if keep is not None:
         protocols = {k: v for k, v in protocols.items() if k in keep}
 
     extras = {"backend": backend, "backend_reason": backend_reason}
@@ -373,6 +424,15 @@ def main() -> None:
                 want_mfu=(name == HEADLINE and on_tpu))
         except Exception as exc:  # one bad protocol must not kill the line
             extras[name] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    # longctx respects the same BENCH_PROTOCOLS narrowing as the others
+    if (on_tpu or os.environ.get("BENCH_LONGCTX")) and \
+            (keep is None or "longctx_ringlm" in keep):
+        try:
+            extras["longctx_ringlm"] = bench_longctx(on_tpu)
+        except Exception as exc:
+            extras["longctx_ringlm"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
 
     if os.environ.get("BENCH_SCALE_PROBE"):
         extras["scale_probe"] = scale_probe(backend)

@@ -128,7 +128,8 @@ def test_flash_attention_matches_local(task):
         lambda p: loss(flash_task, p))(params)
     np.testing.assert_allclose(float(l_dense), float(l_flash),
                                rtol=2e-5, atol=2e-5)
-    flat_d, _ = jax.flatten_util.ravel_pytree(g_dense)
-    flat_f, _ = jax.flatten_util.ravel_pytree(g_flash)
+    from jax.flatten_util import ravel_pytree
+    flat_d, _ = ravel_pytree(g_dense)
+    flat_f, _ = ravel_pytree(g_flash)
     np.testing.assert_allclose(np.asarray(flat_d), np.asarray(flat_f),
                                rtol=5e-4, atol=5e-5)
