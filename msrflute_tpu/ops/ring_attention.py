@@ -65,9 +65,20 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
             jnp.einsum("bhlm,bmhd->blhd", p, v_cur)
         return (m_new, l_new, acc_new)
 
+    # remat the blockwise accumulate: under reverse-mode AD a scan stores
+    # every step's residuals — here the [B,H,Lq,chunk] probability matrix
+    # per rotation, i.e. O(L^2/N) per device, exactly the memory wall this
+    # op exists to avoid.  Recomputing scores from the (q, k, v) chunks in
+    # the backward keeps live memory at O(L/N) state per rotation for ~1/3
+    # extra FLOPs (the blockwise-recompute backward of the ring/flash
+    # attention literature).
+    # prevent_cse=False: inside lax.scan the CSE-prevention barriers are
+    # unnecessary (per the jax.checkpoint docs) and would inhibit fusion
+    accumulate_ckpt = jax.checkpoint(accumulate, prevent_cse=False)
+
     def step(carry, owner_shift):
         k_cur, v_cur, state = carry
-        state = accumulate(state, k_cur, v_cur, owner_shift)
+        state = accumulate_ckpt(state, k_cur, v_cur, owner_shift)
         # rotate k/v to the next device on the ring
         rotation = [(i, (i + 1) % n) for i in range(n)]
         k_next = jax.lax.ppermute(k_cur, axis_name, rotation)
@@ -80,7 +91,7 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
     # n-1 rotating steps, then the final block without a dead rotation
     (k_last, v_last, state), _ = jax.lax.scan(
         step, (k0, v0, state0), jnp.arange(n - 1))
-    m, l, acc = accumulate(state, k_last, v_last, n - 1)
+    m, l, acc = accumulate_ckpt(state, k_last, v_last, n - 1)
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return acc / denom
 
