@@ -43,11 +43,11 @@ class _MHA(nn.Module):
     ring_mesh: Optional[Mesh] = None
     seq_axis: str = "sequence"
     batch_axis: Optional[str] = None
-    #: local mode: tile attention in VMEM via the Pallas flash kernel
-    #: (ops/pallas_attention.py) instead of materializing the O(L^2)
-    #: score matrix — the single-chip long-context lever.  Ring mode
-    #: ignores it (the ring's per-rotation blocks are already O(L/N)
-    #: sized; sp_module warns if both are requested).
+    #: tile attention in VMEM via the Pallas flash kernels
+    #: (ops/pallas_attention.py) instead of materializing score matrices.
+    #: Local mode: the single-chip long-context lever.  Ring mode: each
+    #: rotation's chunk pair runs through the same kernels with position
+    #: offsets (ring_flash_attention_local) — the two levers compose.
     use_flash: bool = False
 
     @nn.compact
@@ -59,7 +59,8 @@ class _MHA(nn.Module):
         if self.ring_mesh is not None:
             attn = ring_self_attention(q, k, v, self.ring_mesh,
                                        axis=self.seq_axis, causal=True,
-                                       batch_axis=self.batch_axis)
+                                       batch_axis=self.batch_axis,
+                                       use_flash=self.use_flash)
         elif self.use_flash:
             attn = flash_attention(q, k, v, causal=True)
         else:
@@ -167,12 +168,6 @@ class RingLMTask(_TokenDatasetMixin, SequenceLMTask):
         """Clone into sequence-parallel mode; ``expert_axis`` additionally
         engages expert-parallel MoE dispatch on that mesh axis (requires
         ``moe_experts == mesh.shape[expert_axis]``)."""
-        if self.module.use_flash:
-            import warnings
-            warnings.warn(
-                "flash_attention is a LOCAL-mode knob; ring mode tiles "
-                "attention via its own O(L/N) rotation blocks and ignores "
-                "it", stacklevel=2)
         return self.module.clone(ring_mesh=mesh, seq_axis=seq_axis,
                                  batch_axis=batch_axis,
                                  moe_ep_axis=expert_axis)

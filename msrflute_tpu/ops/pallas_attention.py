@@ -14,14 +14,18 @@ passes are Pallas kernels (FlashAttention-2 style tiling):
   ``(B, H, Lk/block_k)`` grid — each recomputes the probabilities from
   the saved ``lse`` (no O(L^2) residuals).
 
-Causal masking and length padding are position-based and fully static:
-sequence/feature dims are padded to block/lane multiples, the real
-lengths are baked into the kernels at trace time, and masked probability
-entries are zeroed explicitly (no ``-inf`` arithmetic on the MXU path).
+Causal masking is GLOBAL-position based: dynamic ``q_offset``/``k_offset``
+scalars (SMEM scalar-prefetch) shift the row/column ids, which is what
+lets :func:`msrflute_tpu.ops.ring_attention.ring_self_attention` run these
+same kernels on rotating chunk pairs whose positions differ per step.
+:func:`flash_attention_lse` additionally returns the per-row logsumexp —
+with a VJP that honors the lse cotangent — so rotation outputs can be
+merged exactly outside the kernel.
 
-Degrades gracefully off-TPU: kernels run in Pallas interpret mode (the
-same code path the tests exercise), so the op is usable — if not fast —
-everywhere.
+Length/feature padding is static; masked probability entries are zeroed
+explicitly (no ``-inf`` arithmetic on the MXU path).  Degrades gracefully
+off-TPU: kernels run in Pallas interpret mode (the same code path the
+tests exercise), so the op is usable — if not fast — everywhere.
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_kernels import _resolve_interpret
 
@@ -57,17 +62,19 @@ def _ceil_to(n, m):
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
-                block_q, block_k, l_q, l_k):
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
+                scale, block_q, block_k, l_q, l_k):
     qi = pl.program_id(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
     q = q_ref[0, :, 0, :].astype(jnp.float32)          # [bq, D]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     num_k = pl.cdiv(l_k, block_k)
     if causal:
-        # blocks entirely above the diagonal contribute nothing
-        num_k = jnp.minimum(num_k,
-                            pl.cdiv((qi + 1) * block_q, block_k))
+        # k blocks entirely above the (global) diagonal contribute nothing
+        num_k = jnp.clip(
+            (q_off + (qi + 1) * block_q - k_off + block_k - 1) // block_k,
+            0, num_k)
 
     def body(j, carry):
         m, l, acc = carry
@@ -78,11 +85,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
+        k_loc = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < l_k
+        mask = k_loc < l_k
         if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_loc)
         s = jnp.where(mask, s, _NEG)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
@@ -108,19 +115,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
 # ----------------------------------------------------------------------
 # backward
 # ----------------------------------------------------------------------
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, scale, block_q, block_k, l_q, l_k):
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               glse_ref, dq_ref, *, causal, scale, block_q, block_k,
+               l_q, l_k):
     qi = pl.program_id(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
     q = q_ref[0, :, 0, :].astype(jnp.float32)
     do = do_ref[0, :, 0, :].astype(jnp.float32)
     lse = lse_ref[0, 0, :]
     delta = delta_ref[0, 0, :]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+    glse = glse_ref[0, 0, :]
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     num_k = pl.cdiv(l_k, block_k)
     if causal:
-        num_k = jnp.minimum(num_k,
-                            pl.cdiv((qi + 1) * block_q, block_k))
+        num_k = jnp.clip(
+            (q_off + (qi + 1) * block_q - k_off + block_k - 1) // block_k,
+            0, num_k)
 
     def body(j, dq):
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
@@ -130,16 +141,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        k_pos = j * block_k + jax.lax.broadcasted_iota(
+        k_loc = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < l_k
+        mask = k_loc < l_k
         if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_loc)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        # d lse / d s = p, so the lse cotangent adds straight into ds
+        ds = p * (dp - delta[:, None] + glse[:, None]) * scale
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
@@ -147,17 +159,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, :, 0, :] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal, scale, block_q, block_k,
-                l_q, l_k):
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                glse_ref, dk_ref, dv_ref, *, causal, scale, block_q,
+                block_k, l_q, l_k):
     ki = pl.program_id(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
     k_blk = k_ref[0, :, 0, :].astype(jnp.float32)       # [bk, D]
     v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+    k_pos = k_off + ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     num_q = pl.cdiv(l_q, block_q)
-    # causal: q blocks strictly below this key block's diagonal see nothing
-    i0 = (ki * block_k) // block_q if causal else 0
+    if causal:
+        # q blocks strictly above this key block's (global) diagonal start
+        # see nothing: first candidate block index, clipped into range
+        i0 = jnp.clip((k_off + ki * block_k - q_off) // block_q, 0, num_q)
+    else:
+        i0 = 0
 
     def body(i, carry):
         dk, dv = carry
@@ -165,17 +182,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        glse = glse_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        q_pos = i * block_q + jax.lax.broadcasted_iota(
+        q_loc = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        mask = k_pos < l_k
+        k_loc = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_loc < l_k
         if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
+            mask = jnp.logical_and(mask, q_off + q_loc >= k_pos)
         # padded q rows carry lse = _NEG -> exp(s - _NEG) would overflow;
         # mask on the valid-q side too
-        mask = jnp.logical_and(mask, q_pos < l_q)
+        mask = jnp.logical_and(mask, q_loc < l_q)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -183,7 +203,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None] + glse[:, None]) * scale
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, D]
@@ -199,16 +219,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ----------------------------------------------------------------------
 # pallas_call plumbing
 # ----------------------------------------------------------------------
-def _specs(block_q, block_k, lq_p, lk_p, d_p):
+def _specs(block_q, block_k, lk_p, d_p):
     q_spec = pl.BlockSpec((1, block_q, 1, d_p),
-                          lambda b, h, i: (b, i, h, 0))
+                          lambda b, h, i, *_: (b, i, h, 0))
     kv_spec = pl.BlockSpec((1, lk_p, 1, d_p),
-                           lambda b, h, i: (b, 0, h, 0))
-    lse_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i))
+                           lambda b, h, i, *_: (b, 0, h, 0))
+    lse_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, *_: (b, h, i))
     return q_spec, kv_spec, lse_spec
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _offs(q_offset, k_offset):
+    return jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32)])
+
+
+def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k,
+         interpret):
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     lq_p, lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
@@ -216,23 +242,27 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     qp = _pad_axis(_pad_axis(q, 1, lq_p), 3, d_p)
     kp = _pad_axis(_pad_axis(k, 1, lk_p), 3, d_p)
     vp = _pad_axis(_pad_axis(v, 1, lk_p), 3, d_p)
-    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lq_p, lk_p, d_p)
+    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lk_p, d_p)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
                                l_q=Lq, l_k=Lk)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B, H, lq_p // block_q),
-        in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=[q_spec, lse_spec],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, lq_p // block_q),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[q_spec, lse_spec],
+        ),
         out_shape=[jax.ShapeDtypeStruct(qp.shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, lq_p), jnp.float32)],
         interpret=_resolve_interpret(interpret),
-    )(qp, kp, vp)
-    return out[:, :Lq, :, :D], lse
+    )(_offs(q_offset, k_offset), qp, kp, vp)
+    return out[:, :Lq, :, :D], lse[:, :, :Lq]
 
 
-def _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret):
+def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
+         block_q, block_k, interpret):
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     lq_p, lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
@@ -241,70 +271,104 @@ def _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret):
     kp = _pad_axis(_pad_axis(k, 1, lk_p), 3, d_p)
     vp = _pad_axis(_pad_axis(v, 1, lk_p), 3, d_p)
     gp = _pad_axis(_pad_axis(g, 1, lq_p), 3, d_p)
+    lse_p = _pad_axis(lse, 2, lq_p)
+    glse_p = _pad_axis(g_lse.astype(jnp.float32), 2, lq_p)
     # delta_i = sum_d dO_i . O_i  (rowwise), the softmax-grad correction
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=3)                              # [B, Lq, H]
     delta = _pad_axis(delta.transpose(0, 2, 1), 2, lq_p)  # [B, H, lq_p]
     interp = _resolve_interpret(interpret)
-    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lq_p, lk_p, d_p)
+    offs = _offs(q_offset, k_offset)
+    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lk_p, d_p)
 
     dq_kernel = functools.partial(_dq_kernel, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
                                   l_q=Lq, l_k=Lk)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(B, H, lq_p // block_q),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec],
-        out_specs=q_spec,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, lq_p // block_q),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec,
+                      lse_spec],
+            out_specs=q_spec,
+        ),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         interpret=interp,
-    )(qp, kp, vp, gp, lse, delta)
+    )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
 
     # dk/dv: grid over key blocks; q/do/lse/delta stream in full
-    kq_spec = pl.BlockSpec((1, lq_p, 1, d_p), lambda b, h, i: (b, 0, h, 0))
+    kq_spec = pl.BlockSpec((1, lq_p, 1, d_p),
+                           lambda b, h, i, *_: (b, 0, h, 0))
     kk_spec = pl.BlockSpec((1, block_k, 1, d_p),
-                           lambda b, h, i: (b, i, h, 0))
-    full_lse_spec = pl.BlockSpec((1, 1, lq_p), lambda b, h, i: (b, h, 0))
+                           lambda b, h, i, *_: (b, i, h, 0))
+    full_lse_spec = pl.BlockSpec((1, 1, lq_p),
+                                 lambda b, h, i, *_: (b, h, 0))
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal, scale=scale,
                                    block_q=block_q, block_k=block_k,
                                    l_q=Lq, l_k=Lk)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(B, H, lk_p // block_k),
-        in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, full_lse_spec,
-                  full_lse_spec],
-        out_specs=[kk_spec, kk_spec],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, lk_p // block_k),
+            in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, full_lse_spec,
+                      full_lse_spec, full_lse_spec],
+            out_specs=[kk_spec, kk_spec],
+        ),
         out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)],
         interpret=interp,
-    )(qp, kp, vp, gp, lse, delta)
+    )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
     return dq[:, :Lq, :, :D], dk[:, :Lk, :, :D], dv[:, :Lk, :, :D]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_lse(q, k, v, q_offset, k_offset, causal, block_q, block_k,
+               interpret):
     D = q.shape[3]
     scale = float(1.0 / np.sqrt(D))
-    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out
+    return _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q,
+                block_k, interpret)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, q_offset, k_offset, causal, block_q, block_k,
+                   interpret):
+    out, lse = _flash_lse(q, k, v, q_offset, k_offset, causal, block_q,
+                          block_k, interpret)
+    return (out, lse), (q, k, v, out, lse, q_offset, k_offset)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, cotangents):
+    q, k, v, out, lse, q_offset, k_offset = res
+    g, g_lse = cotangents
     D = q.shape[3]
     scale = float(1.0 / np.sqrt(D))
-    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    dq, dk, dv = _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse,
+                      causal, scale, block_q, block_k, interpret)
+    zero = np.zeros((), jax.dtypes.float0)
+    return dq, dk, dv, zero, zero
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    D = q.shape[3]
-    scale = float(1.0 / np.sqrt(D))
-    return _bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                interpret)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        causal: bool = False, *, q_offset=0, k_offset=0,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp ``[B, H, Lq]`` (f32), with a VJP that honors its cotangent.
+    ``q_offset``/``k_offset`` shift the global positions used by the
+    causal mask — dynamic scalars, so ring rotations can jit one program.
+    Rows whose keys are ALL masked come back as zeros with lse ≈ -1e30
+    (exact identity for the rotation-merge in ring attention)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, L, H, D], got {q.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    return _flash_lse(q, k, v, q_offset, k_offset, bool(causal),
+                      int(block_q), int(block_k), interpret)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -317,12 +381,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``D`` is padded to the 128-lane width and ``L`` to the block size; the
     key/value stream for one head must fit VMEM, which bounds local
     sequence length at roughly 16k (f32) per chip — beyond that, shard the
-    sequence axis and let :mod:`msrflute_tpu.ops.ring_attention` rotate
-    these same blocks around the ring.
+    sequence axis over a mesh and run these kernels per ring rotation
+    (``ring_self_attention(..., use_flash=True)``).
     """
-    if q.ndim != 4:
-        raise ValueError(f"expected [B, L, H, D], got {q.shape}")
-    if k.shape != v.shape:
-        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
-    return _flash(q, k, v, bool(causal), int(block_q), int(block_k),
-                  interpret)
+    return flash_attention_lse(q, k, v, causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)[0]

@@ -28,6 +28,62 @@ from jax.sharding import Mesh, PartitionSpec as P
 SEQUENCE_AXIS = "sequence"
 
 
+def _ring_scan(k0, v0, acc0, axis_name: str, n, accumulate):
+    """Shared ring choreography: accumulate the held chunk, rotate k/v to
+    the next device, N-1 times; accumulate the final chunk without a dead
+    rotation.  ``accumulate(acc, k_cur, v_cur, owner_shift) -> acc`` is
+    the per-rotation kernel (``owner = (idx - owner_shift) % n`` is where
+    the held chunk originated)."""
+    def step(carry, owner_shift):
+        k_cur, v_cur, acc = carry
+        acc = accumulate(acc, k_cur, v_cur, owner_shift)
+        rotation = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, rotation)
+        v_next = jax.lax.ppermute(v_cur, axis_name, rotation)
+        return (k_next, v_next, acc), None
+
+    (k_last, v_last, acc), _ = jax.lax.scan(
+        step, (k0, v0, acc0), jnp.arange(n - 1))
+    return accumulate(acc, k_last, v_last, n - 1)
+
+
+def ring_flash_attention_local(q, k0, v0, axis_name: str, causal: bool,
+                               q_offset, chunk: int, block_q: int = 128,
+                               block_k: int = 128):
+    """Blockwise-ring attention: each rotation's chunk pair runs through
+    the Pallas flash kernels (:func:`msrflute_tpu.ops.pallas_attention.
+    flash_attention_lse` with dynamic position offsets), and the
+    per-rotation normalized outputs are merged EXACTLY via their
+    logsumexps — never materializing a score matrix anywhere, forward or
+    backward.  This is the composition of the two long-context levers:
+    the ring bounds per-device residency at O(L/N) chunks, the kernel
+    bounds per-rotation working set at O(block) tiles.
+    """
+    from .pallas_attention import _NEG, flash_attention_lse
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def merge(acc, k_cur, v_cur, owner_shift):
+        out_acc, lse_acc = acc
+        owner = (idx - owner_shift) % n
+        out_r, lse_r = flash_attention_lse(
+            q, k_cur, v_cur, causal, q_offset=q_offset,
+            k_offset=owner * chunk, block_q=block_q, block_k=block_k)
+        # exact merge of independently-normalized rotation outputs:
+        # out = sum_r exp(lse_r - lse_tot) * out_r
+        lse_new = jnp.logaddexp(lse_acc, lse_r)
+        w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+        w_r = jnp.exp(lse_r - lse_new).transpose(0, 2, 1)[..., None]
+        return out_acc * w_acc + out_r.astype(jnp.float32) * w_r, lse_new
+
+    B, Lq, H, D = q.shape
+    acc0 = (jnp.zeros((B, Lq, H, D), jnp.float32),
+            jnp.full((B, H, Lq), _NEG, jnp.float32))
+    out, _ = _ring_scan(k0, v0, acc0, axis_name, n, merge)
+    return out.astype(q.dtype)
+
+
 def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
                          q_offset, chunk: int):
     """Online-softmax ring accumulation over local chunks.
@@ -36,7 +92,8 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
     ``k0`` / ``v0`` are this device's ``[B, L/N, H, D]`` chunks and
     ``q_offset`` the global position of ``q``'s first row.  Performs N-1
     ``ppermute`` rotations (the final block is accumulated without a
-    further rotation).
+    further rotation).  For the fully-tiled variant (no per-rotation
+    score matrix at all) see :func:`ring_flash_attention_local`.
     """
     B, Lq, H, D = q.shape
     n = jax.lax.psum(1, axis_name)
@@ -76,22 +133,10 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
     # unnecessary (per the jax.checkpoint docs) and would inhibit fusion
     accumulate_ckpt = jax.checkpoint(accumulate, prevent_cse=False)
 
-    def step(carry, owner_shift):
-        k_cur, v_cur, state = carry
-        state = accumulate_ckpt(state, k_cur, v_cur, owner_shift)
-        # rotate k/v to the next device on the ring
-        rotation = [(i, (i + 1) % n) for i in range(n)]
-        k_next = jax.lax.ppermute(k_cur, axis_name, rotation)
-        v_next = jax.lax.ppermute(v_cur, axis_name, rotation)
-        return (k_next, v_next, state), None
-
     state0 = (jnp.full((B, H, Lq), -jnp.inf, q.dtype),
               jnp.zeros((B, H, Lq), q.dtype),
               jnp.zeros_like(q))
-    # n-1 rotating steps, then the final block without a dead rotation
-    (k_last, v_last, state), _ = jax.lax.scan(
-        step, (k0, v0, state0), jnp.arange(n - 1))
-    m, l, acc = accumulate_ckpt(state, k_last, v_last, n - 1)
+    m, l, acc = _ring_scan(k0, v0, state0, axis_name, n, accumulate_ckpt)
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return acc / denom
 
@@ -99,7 +144,9 @@ def ring_attention_local(q, k0, v0, axis_name: str, causal: bool,
 def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         mesh: Mesh, axis: str = SEQUENCE_AXIS,
                         causal: bool = False,
-                        batch_axis: "str | None" = None) -> jnp.ndarray:
+                        batch_axis: "str | None" = None,
+                        use_flash: bool = False, flash_block_q: int = 128,
+                        flash_block_k: int = 128) -> jnp.ndarray:
     """Exact attention with GLOBAL q/k/v ``[B, L, H, D]`` sharded on L over
     ``axis``.  Returns the output with the same sharding.  Must be called
     outside shard_map (it applies its own); inside a shard_map body use
@@ -108,6 +155,10 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``batch_axis`` additionally shards B over another mesh axis (combined
     data + sequence parallelism): the ring rotations stay within each
     batch shard's ring, no cross-batch communication.
+
+    ``use_flash`` runs each rotation through the Pallas flash kernels
+    (:func:`ring_flash_attention_local`) instead of the jnp online-softmax
+    accumulate — same numerics (tested), no per-rotation score matrix.
     """
     n = mesh.shape[axis]
     L = q.shape[1]
@@ -129,6 +180,11 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def body(q_l, k_l, v_l):
         idx = jax.lax.axis_index(axis)
         q_offset = idx * chunk
+        if use_flash:
+            return ring_flash_attention_local(q_l, k_l, v_l, axis, causal,
+                                              q_offset, chunk,
+                                              block_q=flash_block_q,
+                                              block_k=flash_block_k)
         return ring_attention_local(q_l, k_l, v_l, axis, causal, q_offset,
                                     chunk)
 
