@@ -81,11 +81,75 @@ class ArraysDataset(BaseDataset):
                 for k in keys}
 
 
-def scrub_empty_clients(dataset: ArraysDataset) -> ArraysDataset:
+def scrub_empty_clients(dataset: BaseDataset) -> BaseDataset:
     """Drop users with zero samples (reference ``utils/utils.py:563-582``)."""
     keep = [i for i, n in enumerate(dataset.num_samples) if n > 0]
+    if len(keep) == len(dataset.num_samples):
+        return dataset
+    if isinstance(dataset, LazyUserDataset):
+        return dataset.subset(keep)  # no sample IO
     return ArraysDataset(
         [dataset.user_list[i] for i in keep],
         [dataset.user_arrays(i) for i in keep],
         [dataset.num_samples[i] for i in keep],
     )
+
+
+class LazyUserDataset(BaseDataset):
+    """Featurize-on-access dataset over a :class:`~msrflute_tpu.data.
+    user_blob.LazyHDF5Users` handle — the "millions of clients" path
+    (reference ``README.md:9``): a round touches only its sampled users,
+    so sample IO and featurization happen on demand with a bounded LRU
+    cache instead of materializing the whole blob up front.
+
+    ``featurize(data_entry, label_or_None) -> {name: np.ndarray}`` runs
+    per user on first access (default: the same numeric passthrough as
+    :func:`msrflute_tpu.tasks.default_featurize`, per-user).
+    """
+
+    def __init__(self, users, featurize=None, cache_users: int = 256,
+                 keep: Optional[Sequence[int]] = None):
+        import threading
+        from collections import OrderedDict
+        self._users = users
+        self._featurize = featurize or _numeric_featurize_user
+        self._idx = (list(range(len(users.user_list))) if keep is None
+                     else list(keep))
+        self.user_list = [users.user_list[i] for i in self._idx]
+        self.num_samples = [users.num_samples[i] for i in self._idx]
+        self._cache: "OrderedDict[int, Dict[str, np.ndarray]]" = OrderedDict()
+        self._cache_users = max(int(cache_users), 1)
+        # the layer below serializes hdf5 reads for off-controller-thread
+        # callers (personalization/eval helpers); the cache needs the same
+        # discipline or a concurrent insert's eviction can race a reader's
+        # membership-check -> move_to_end sequence
+        self._cache_lock = threading.Lock()
+
+    def user_arrays(self, user_idx: int) -> Dict[str, np.ndarray]:
+        with self._cache_lock:
+            if user_idx in self._cache:
+                self._cache.move_to_end(user_idx)
+                return self._cache[user_idx]
+        data, label = self._users.read(self.user_list[user_idx])
+        arrays = self._featurize(data, label)
+        with self._cache_lock:
+            self._cache[user_idx] = arrays
+            if len(self._cache) > self._cache_users:
+                self._cache.popitem(last=False)
+        return arrays
+
+    def subset(self, keep: Sequence[int]) -> "LazyUserDataset":
+        """A view over a subset of users — no sample IO."""
+        return LazyUserDataset(self._users, self._featurize,
+                               self._cache_users,
+                               keep=[self._idx[i] for i in keep])
+
+
+def _numeric_featurize_user(data, label) -> Dict[str, np.ndarray]:
+    """Per-user numeric passthrough — EXACTLY ``tasks.default_featurize``
+    per user (x float32, y int32), so flipping ``lazy`` never changes what
+    the model sees.  Dtype-preserving tricks (raw uint8 pixels) belong to
+    task featurize_user hooks like the CV family's ``to_image``."""
+    return ({"x": np.asarray(data, dtype=np.float32)} if label is None else
+            {"x": np.asarray(data, dtype=np.float32),
+             "y": np.asarray(label).astype(np.int32)})

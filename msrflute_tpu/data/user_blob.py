@@ -108,65 +108,115 @@ def _load_json(path: str) -> UserBlob:
     )
 
 
+def _hdf5_decode(value):
+    arr = np.asarray(value)
+    if arr.dtype.kind == "S" or (
+            arr.dtype.kind == "O" and arr.size and
+            isinstance(arr.reshape(-1)[0], (bytes, str))):
+        # vlen strings come back as bytes
+        return [v.decode() if isinstance(v, bytes) else str(v)
+                for v in arr]
+    if arr.dtype.kind == "O":
+        # vlen numeric (ragged) datasets: keep per-sample arrays
+        return [np.asarray(v) for v in arr]
+    return arr
+
+
+def _read_hdf5_user(fh, user: str):
+    """One user's ``(data_entry, label_or_None)`` from an open blob file.
+
+    Shared by the eager loader and :class:`LazyHDF5Users` so the two paths
+    cannot drift on layout handling."""
+    import h5py
+
+    entry = fh["user_data"][user]
+    labels_grp = fh.get("user_data_label")
+    label = (np.asarray(labels_grp[user][()])
+             if labels_grp is not None else None)
+    if isinstance(entry, h5py.Group):
+        keys = set(entry.keys())
+        if keys - {"x", "y"}:
+            # rich per-user dict (semisup ux, fednewsrec
+            # clicked/impressions): every stream round-trips;
+            # '<key>.json' datasets hold non-array streams
+            rich: Dict[str, Any] = {}
+            for key in entry.keys():
+                if key.endswith(".json"):
+                    rich[key[:-len(".json")]] = json.loads(
+                        bytes(entry[key][()]).decode("utf-8"))
+                else:
+                    rich[key] = _hdf5_decode(entry[key][()])
+            if label is None and "y" in entry:
+                label = np.asarray(entry["y"][()])
+            return rich, label
+        data = _hdf5_decode(entry["x"][()])
+        if label is None and "y" in entry:
+            label = np.asarray(entry["y"][()])
+        return data, label
+    return _hdf5_decode(entry[()]), label
+
+
 def _load_hdf5(path: str) -> UserBlob:
     import h5py
 
     with h5py.File(path, "r") as fh:
         users_ds = fh.get("users", fh.get("user_list"))
-        users = [u.decode() if isinstance(u, bytes) else str(u) for u in users_ds[()]]
+        users = [u.decode() if isinstance(u, bytes) else str(u)
+                 for u in users_ds[()]]
         num_samples = [int(n) for n in fh["num_samples"][()]]
-        user_data_grp = fh["user_data"]
-        labels_grp = fh.get("user_data_label")
-        def _decode(value):
-            arr = np.asarray(value)
-            if arr.dtype.kind == "S" or (
-                    arr.dtype.kind == "O" and arr.size and
-                    isinstance(arr.reshape(-1)[0], (bytes, str))):
-                # vlen strings come back as bytes
-                return [v.decode() if isinstance(v, bytes) else str(v)
-                        for v in arr]
-            if arr.dtype.kind == "O":
-                # vlen numeric (ragged) datasets: keep per-sample arrays
-                return [np.asarray(v) for v in arr]
-            return arr
-
         data: List[Any] = []
         labels: List[Any] = []
         for user in users:
-            entry = user_data_grp[user]
-            if isinstance(entry, h5py.Group):
-                keys = set(entry.keys())
-                if keys - {"x", "y"}:
-                    # rich per-user dict (semisup ux, fednewsrec
-                    # clicked/impressions): every stream round-trips;
-                    # '<key>.json' datasets hold non-array streams
-                    rich: Dict[str, Any] = {}
-                    for key in entry.keys():
-                        if key.endswith(".json"):
-                            rich[key[:-len(".json")]] = json.loads(
-                                bytes(entry[key][()]).decode("utf-8"))
-                        else:
-                            rich[key] = _decode(entry[key][()])
-                    data.append(rich)
-                else:
-                    data.append(_decode(entry["x"][()]))
-                if labels_grp is None:
-                    # always append (None when absent) to keep user<->label
-                    # alignment with mixed layouts, like _load_json does
-                    labels.append(np.asarray(entry["y"][()])
-                                  if "y" in entry else None)
-            else:
-                data.append(_decode(entry[()]))
-                if labels_grp is None:
-                    labels.append(None)
-            if labels_grp is not None:
-                labels.append(np.asarray(labels_grp[user][()]))
+            entry, label = _read_hdf5_user(fh, user)
+            data.append(entry)
+            # always append (None when absent) to keep user<->label
+            # alignment with mixed layouts, like _load_json does
+            labels.append(label)
     return UserBlob(
         user_list=users,
         num_samples=num_samples,
         user_data=data,
         user_labels=(labels if any(l is not None for l in labels) else None),
     )
+
+
+class LazyHDF5Users:
+    """Per-user on-demand reader over an hdf5 blob (the scale path).
+
+    The eager loaders above materialize EVERY user's samples — fine for the
+    benchmark blobs, impossible at the reference's stated scale ("millions
+    of clients", reference ``README.md:9``) where a round only ever touches
+    the sampled clients.  This handle reads ``users``/``num_samples`` (two
+    small datasets) eagerly and defers all sample IO to :meth:`read`.
+
+    The h5py file is opened lazily per process and reads are serialized
+    with a lock (h5py is not thread-safe; the engine's prefetch overlap
+    packs on the controller thread, but personalization/eval helpers may
+    not).
+    """
+
+    def __init__(self, path: str):
+        import h5py  # noqa: F401  (fail fast if unavailable)
+        self.path = path
+        self._fh = None
+        import threading
+        self._lock = threading.Lock()
+        with self._open() as fh:
+            users_ds = fh.get("users", fh.get("user_list"))
+            self.user_list = [u.decode() if isinstance(u, bytes) else str(u)
+                              for u in users_ds[()]]
+            self.num_samples = [int(n) for n in fh["num_samples"][()]]
+
+    def _open(self):
+        import h5py
+        return h5py.File(self.path, "r")
+
+    def read(self, user: str):
+        """``(data_entry, label_or_None)`` for one user, read on demand."""
+        with self._lock:
+            if self._fh is None:
+                self._fh = self._open()
+            return _read_hdf5_user(self._fh, user)
 
 
 def save_user_blob_hdf5(path: str, blob: UserBlob) -> None:

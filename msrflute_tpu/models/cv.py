@@ -167,27 +167,39 @@ class ClassificationTask(BaseTask):
         aug_rng = np.random.default_rng(int(aug_cfg.get("seed", 0)))
         per_user = []
         for i in range(len(blob)):
-            entry = blob.user_data[i]
-            raw_x = entry["x"] if isinstance(entry, dict) else entry
-            x = to_image(np.asarray(raw_x), self.example_shape)
-            y = (np.asarray(blob.user_labels[i]).astype(np.int32)
-                 if blob.user_labels is not None else
-                 np.zeros((len(x),), np.int32))
-            user = {"x": x, "y": y}
-            if isinstance(entry, dict) and "ux" in entry:
-                ux = to_image(np.asarray(entry["ux"]), self.example_shape)
-                user["ux"] = ux
-                if "ux_rand" in entry:
-                    user["ux_rand"] = to_image(np.asarray(entry["ux_rand"]),
-                                               self.example_shape)
-                elif aug_cfg:
-                    from ..data.augment import rand_augment
-                    user["ux_rand"] = rand_augment(
-                        ux, num_ops=int(aug_cfg.get("num_ops", 2)),
-                        magnitude=int(aug_cfg.get("magnitude", 9)),
-                        rng=aug_rng)
-            per_user.append(user)
+            label = (blob.user_labels[i] if blob.user_labels is not None
+                     else None)
+            per_user.append(self.featurize_user(
+                blob.user_data[i], label, aug_cfg=aug_cfg, aug_rng=aug_rng))
         return ArraysDataset(blob.user_list, per_user, blob.num_samples)
+
+    def featurize_user(self, data, label, aug_cfg=None, aug_rng=None):
+        """Featurize ONE user's raw blob entry — the per-user unit of
+        :meth:`make_dataset`, exposed separately so lazy datasets
+        (``data/dataset.py::LazyUserDataset``) can featurize on access.
+        Augmentation needs a shared rng stream, so lazy callers leave
+        ``aug_cfg`` unset."""
+        import numpy as np
+        from ..data.featurize import to_image
+        aug_cfg = aug_cfg or {}
+        raw_x = data["x"] if isinstance(data, dict) else data
+        x = to_image(np.asarray(raw_x), self.example_shape)
+        y = (np.asarray(label).astype(np.int32) if label is not None
+             else np.zeros((len(x),), np.int32))
+        user = {"x": x, "y": y}
+        if isinstance(data, dict) and "ux" in data:
+            ux = to_image(np.asarray(data["ux"]), self.example_shape)
+            user["ux"] = ux
+            if "ux_rand" in data:
+                user["ux_rand"] = to_image(np.asarray(data["ux_rand"]),
+                                           self.example_shape)
+            elif aug_cfg:
+                from ..data.augment import rand_augment
+                user["ux_rand"] = rand_augment(
+                    ux, num_ops=int(aug_cfg.get("num_ops", 2)),
+                    magnitude=int(aug_cfg.get("magnitude", 9)),
+                    rng=aug_rng)
+        return user
 
 
 def make_lr_task(model_config) -> ClassificationTask:

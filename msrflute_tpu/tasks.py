@@ -55,9 +55,32 @@ def build_task_datasets(cfg: FLUTEConfig, task: BaseTask) -> Tuple[
     if not train_path:
         raise ValueError("client_config.data_config.train needs "
                          "list_of_train_data or train_data")
-    train = scrub_empty_clients(make_dataset_for(
-        task, load_user_blob(train_path), cfg.model_config, "train",
-        data_config=cc_train))
+    if cc_train.get("lazy"):
+        # scale path: per-user on-demand hdf5 reads; a round only touches
+        # its sampled clients (reference "millions of clients",
+        # README.md:9), so never materialize the whole blob
+        import os as _os
+        if _os.path.splitext(train_path)[1].lower() not in (".hdf5", ".h5"):
+            raise ValueError("data_config.train.lazy requires an hdf5 blob "
+                             f"(got {train_path})")
+        featurize = getattr(task, "featurize_user", None)
+        if featurize is None and getattr(task, "make_dataset", None) \
+                is not None:
+            raise ValueError(
+                f"task {task.name!r} has a whole-blob featurizer and no "
+                "per-user featurize_user hook; lazy loading needs one")
+        if featurize is not None and cc_train.get("augment"):
+            raise ValueError("augment needs a shared rng stream; use the "
+                             "eager loader (lazy: false) with augment")
+        from .data.dataset import LazyUserDataset
+        from .data.user_blob import LazyHDF5Users
+        train = scrub_empty_clients(LazyUserDataset(
+            LazyHDF5Users(train_path), featurize=featurize,
+            cache_users=int(cc_train.get("lazy_cache_users", 256))))
+    else:
+        train = scrub_empty_clients(make_dataset_for(
+            task, load_user_blob(train_path), cfg.model_config, "train",
+            data_config=cc_train))
 
     def _load(split_cfg, key, split):
         path = split_cfg.get(key)
