@@ -132,6 +132,15 @@ class LazyUserDataset(BaseDataset):
                 return self._cache[user_idx]
         data, label = self._users.read(self.user_list[user_idx])
         arrays = self._featurize(data, label)
+        # the eager ArraysDataset validates array lengths against
+        # num_samples at construction; lazy must fail as loudly, or a
+        # blob whose metadata disagrees with its rows trains silently on
+        # wrong effective counts
+        n = len(next(iter(arrays.values())))
+        if n != self.num_samples[user_idx]:
+            raise ValueError(
+                f"user {self.user_list[user_idx]}: blob num_samples says "
+                f"{self.num_samples[user_idx]} but arrays have {n} rows")
         with self._cache_lock:
             self._cache[user_idx] = arrays
             if len(self._cache) > self._cache_users:

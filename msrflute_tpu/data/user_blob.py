@@ -156,14 +156,20 @@ def _read_hdf5_user(fh, user: str):
     return _hdf5_decode(entry[()]), label
 
 
+def _read_hdf5_header(fh):
+    """``(users, num_samples)`` from an open blob file — shared by the
+    eager and lazy loaders so the header decode cannot drift either."""
+    users_ds = fh.get("users", fh.get("user_list"))
+    users = [u.decode() if isinstance(u, bytes) else str(u)
+             for u in users_ds[()]]
+    return users, [int(n) for n in fh["num_samples"][()]]
+
+
 def _load_hdf5(path: str) -> UserBlob:
     import h5py
 
     with h5py.File(path, "r") as fh:
-        users_ds = fh.get("users", fh.get("user_list"))
-        users = [u.decode() if isinstance(u, bytes) else str(u)
-                 for u in users_ds[()]]
-        num_samples = [int(n) for n in fh["num_samples"][()]]
+        users, num_samples = _read_hdf5_header(fh)
         data: List[Any] = []
         labels: List[Any] = []
         for user in users:
@@ -202,10 +208,7 @@ class LazyHDF5Users:
         import threading
         self._lock = threading.Lock()
         with self._open() as fh:
-            users_ds = fh.get("users", fh.get("user_list"))
-            self.user_list = [u.decode() if isinstance(u, bytes) else str(u)
-                              for u in users_ds[()]]
-            self.num_samples = [int(n) for n in fh["num_samples"][()]]
+            self.user_list, self.num_samples = _read_hdf5_header(fh)
 
     def _open(self):
         import h5py
