@@ -42,6 +42,9 @@ BASELINES_SECS_PER_ROUND = {
     "resnet_fedcifar100": (1 * 3600 + 42 * 60 + 1) / 4000.0,
     "rnn_fedshakespeare": (21 * 60 + 50) / 1200.0,
 }
+# the bf16 extra races against the same published fp32 number
+BASELINES_SECS_PER_ROUND["cnn_femnist_bf16"] = \
+    BASELINES_SECS_PER_ROUND["cnn_femnist"]
 HEADLINE = "cnn_femnist"
 # TPU v5e peak: 197 TFLOP/s bf16 (394 int8).  We report model FLOPs utilisation
 # against the bf16 peak even for f32 programs — a deliberately conservative
@@ -160,8 +163,20 @@ def _flute_config(model_cfg, batch_size, client_lr, fuse, eval_bs=128):
 # ----------------------------------------------------------------------
 # measurement
 # ----------------------------------------------------------------------
-def _grad_step_flops(task, params, batch) -> float | None:
-    """Compiled-cost FLOPs of one client fwd+bwd step (for the MFU estimate)."""
+def _one_client_batch(dataset, batch_size, max_steps):
+    """One client's packed ``[S, B, ...]`` batch + sample mask (shared by
+    the MFU estimate here and ``tools/profile_round.py``)."""
+    from msrflute_tpu.data import pack_round_batches
+    rb = pack_round_batches(dataset, [0], batch_size, max_steps,
+                            rng=np.random.default_rng(0))
+    one = {k: v[0, 0] for k, v in rb.arrays.items()}
+    one["sample_mask"] = rb.sample_mask[0, 0]
+    return one
+
+
+def grad_step_cost(task, params, batch):
+    """XLA cost analysis (flops/bytes) of one client fwd+bwd step, or None
+    (shared by the MFU estimate and ``tools/profile_round.py``)."""
     import jax
 
     def step(p, b):
@@ -173,7 +188,7 @@ def _grad_step_flops(task, params, batch) -> float | None:
         cost = jax.jit(step).lower(params, batch).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost["flops"])
+        return dict(cost)
     except Exception:
         return None
 
@@ -235,17 +250,14 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
 
         mfu = None
         if want_mfu:
-            from msrflute_tpu.data import pack_round_batches
-            rb = pack_round_batches(dataset, [0], int(
+            one_batch = _one_client_batch(dataset, int(
                 cfg.client_config.data_config.train["batch_size"]),
-                server.max_steps, rng=np.random.default_rng(0))
-            one_batch = {k: v[0, 0] for k, v in rb.arrays.items()}
-            one_batch["sample_mask"] = rb.sample_mask[0, 0]
-            flops = _grad_step_flops(task, server.state.params, one_batch)
-            if flops is not None:
+                server.max_steps)
+            cost = grad_step_cost(task, server.state.params, one_batch)
+            if cost is not None:
                 steps = server.max_steps
                 clients = int(cfg.server_config.num_clients_per_iteration)
-                flops_per_round = flops * steps * clients
+                flops_per_round = float(cost["flops"]) * steps * clients
                 mfu = flops_per_round / float(np.median(per_chunk)) \
                     / V5E_BF16_PEAK_FLOPS
 
@@ -262,6 +274,55 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
     if mfu is not None:
         out["mfu_vs_bf16_peak"] = round(mfu, 5)
     return out
+
+
+def build_protocols(on_tpu: bool, rng, with_bf16: bool = False) -> dict:
+    """The protocol table (BASELINE.md `README.md:22-27`): model cfg,
+    batch, lr, samples/user (real-dataset average), data maker, eval
+    cadence.  Off-TPU (CI smoke on host CPU) the full protocols are
+    compute-bound on host cores; shrink so harnesses still complete — the
+    recorded number only means "vs baseline" on real TPU.  Shared with
+    ``tools/profile_round.py``."""
+    fuse = 25 if on_tpu else 2
+
+    def img(pool, spu, shape, classes):
+        return lambda: _image_dataset(pool, spu, shape, classes, rng)
+
+    protocols = {
+        "lr_mnist": dict(
+            cfg=_flute_config({"model_type": "LR", "num_classes": 10,
+                               "input_dim": 784}, 10, 0.03, fuse),
+            data=img(64 if on_tpu else 16, 60 if on_tpu else 20, (784,), 10),
+            eval_every=20),
+        "cnn_femnist": dict(
+            cfg=_flute_config({"model_type": "CNN", "num_classes": 62},
+                              20, 0.1, fuse),
+            data=img(64 if on_tpu else 16, 240 if on_tpu else 40,
+                     (28, 28, 1), 62),
+            eval_every=50),
+        "resnet_fedcifar100": dict(
+            cfg=_flute_config({"model_type": "RESNET", "num_classes": 100,
+                               "image_size": 32}, 20, 0.1, fuse),
+            data=img(32 if on_tpu else 12, 100 if on_tpu else 20,
+                     (32, 32, 3), 100),
+            eval_every=50),
+        "rnn_fedshakespeare": dict(
+            cfg=_flute_config({"model_type": "LSTM", "vocab_size": 90,
+                               "seq_len": 80}, 4, 0.8, fuse, eval_bs=32),
+            data=lambda: _token_dataset(32 if on_tpu else 12,
+                                        32 if on_tpu else 8, 80, 90, rng),
+            eval_every=50),
+    }
+    if with_bf16:
+        # TPU-native extra: same CNN protocol with bf16 compute (MXU full
+        # rate); baselined against the same published fp32 number
+        protocols["cnn_femnist_bf16"] = dict(
+            cfg=_flute_config({"model_type": "CNN", "num_classes": 62,
+                               "dtype": "bfloat16"}, 20, 0.1, fuse),
+            data=img(64 if on_tpu else 16, 240 if on_tpu else 40,
+                     (28, 28, 1), 62),
+            eval_every=50)
+    return protocols
 
 
 def bench_longctx(on_tpu: bool) -> dict:
@@ -359,55 +420,11 @@ def main() -> None:
         enable_compilation_cache(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
     rng = np.random.default_rng(0)
-
-    # protocol table (BASELINE.md `README.md:22-27`): model cfg, batch, lr,
-    # samples/user (real-dataset average), data maker, eval cadence
-    # off-TPU (CI smoke on host CPU) the full protocols are compute-bound on
-    # host cores; shrink so the harness still completes and emits its JSON
-    # contract — the recorded number only means "vs baseline" on real TPU
     warmup = 25 if on_tpu else 2
     chunks = 4 if on_tpu else 2
-    fuse = 25 if on_tpu else 2
-
-    def img(pool, spu, shape, classes):
-        return lambda: _image_dataset(pool, spu, shape, classes, rng)
-
-    protocols = {
-        "lr_mnist": dict(
-            cfg=_flute_config({"model_type": "LR", "num_classes": 10,
-                               "input_dim": 784}, 10, 0.03, fuse),
-            data=img(64 if on_tpu else 16, 60 if on_tpu else 20, (784,), 10),
-            eval_every=20),
-        "cnn_femnist": dict(
-            cfg=_flute_config({"model_type": "CNN", "num_classes": 62},
-                              20, 0.1, fuse),
-            data=img(64 if on_tpu else 16, 240 if on_tpu else 40,
-                     (28, 28, 1), 62),
-            eval_every=50),
-        "resnet_fedcifar100": dict(
-            cfg=_flute_config({"model_type": "RESNET", "num_classes": 100,
-                               "image_size": 32}, 20, 0.1, fuse),
-            data=img(32 if on_tpu else 12, 100 if on_tpu else 20,
-                     (32, 32, 3), 100),
-            eval_every=50),
-        "rnn_fedshakespeare": dict(
-            cfg=_flute_config({"model_type": "LSTM", "vocab_size": 90,
-                               "seq_len": 80}, 4, 0.8, fuse, eval_bs=32),
-            data=lambda: _token_dataset(32 if on_tpu else 12,
-                                        32 if on_tpu else 8, 80, 90, rng),
-            eval_every=50),
-    }
-    if on_tpu or os.environ.get("BENCH_BF16"):
-        # TPU-native extra: same CNN protocol with bf16 compute (MXU full
-        # rate); baselined against the same published fp32 number
-        protocols["cnn_femnist_bf16"] = dict(
-            cfg=_flute_config({"model_type": "CNN", "num_classes": 62,
-                               "dtype": "bfloat16"}, 20, 0.1, fuse),
-            data=img(64 if on_tpu else 16, 240 if on_tpu else 40,
-                     (28, 28, 1), 62),
-            eval_every=50)
-        BASELINES_SECS_PER_ROUND["cnn_femnist_bf16"] = \
-            BASELINES_SECS_PER_ROUND["cnn_femnist"]
+    protocols = build_protocols(on_tpu, rng,
+                                with_bf16=on_tpu or
+                                bool(os.environ.get("BENCH_BF16")))
 
     only = os.environ.get("BENCH_PROTOCOLS")  # e.g. "cnn_femnist,lr_mnist"
     keep = set(only.split(",")) if only else None

@@ -136,11 +136,12 @@ class LazyUserDataset(BaseDataset):
         # num_samples at construction; lazy must fail as loudly, or a
         # blob whose metadata disagrees with its rows trains silently on
         # wrong effective counts
-        n = len(next(iter(arrays.values())))
-        if n != self.num_samples[user_idx]:
+        want = self.num_samples[user_idx]
+        lens = {k: len(v) for k, v in arrays.items()}
+        if any(n != want for n in lens.values()):
             raise ValueError(
                 f"user {self.user_list[user_idx]}: blob num_samples says "
-                f"{self.num_samples[user_idx]} but arrays have {n} rows")
+                f"{want} but arrays have {lens} rows")
         with self._cache_lock:
             self._cache[user_idx] = arrays
             if len(self._cache) > self._cache_users:

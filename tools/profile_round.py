@@ -1,0 +1,138 @@
+"""One-command round profiling — where does a federated round's time go?
+
+The reference's answer is flag-gated cProfile dumps (``core/server.py:
+327-331``, SURVEY §5.1); the TPU answer is this CLI: run one benchmark
+protocol for a few fused chunks, split wall-clock into host packing vs
+device execution, attach the compiled program's own cost analysis
+(FLOPs/bytes from XLA), optionally capture a ``jax.profiler`` trace, and
+print one JSON object.
+
+Usage::
+
+    python tools/profile_round.py --protocol cnn_femnist --chunks 3
+    python tools/profile_round.py --protocol lr_mnist --trace /tmp/trace
+    BENCH_BACKEND=cpu python tools/profile_round.py ...   # force backend
+
+Run it the moment the chip answers: ``pack_share`` (host packing as a
+fraction of the round) says whether to optimize kernels or the host path
+first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--protocol", default="cnn_femnist",
+                    help="one of bench.py's protocols")
+    ap.add_argument("--chunks", type=int, default=3,
+                    help="timed fused-round chunks after warmup")
+    ap.add_argument("--trace", default=None,
+                    help="directory for a jax.profiler trace of one chunk")
+    args = ap.parse_args(argv)
+
+    import bench  # repo-root harness: backend probe + protocol table
+
+    backend, reason = bench.select_backend()
+    on_tpu = backend == "tpu"
+    rng = np.random.default_rng(0)
+    protocols = bench.build_protocols(on_tpu, rng, with_bf16=True)
+    if args.protocol not in protocols:
+        raise SystemExit(f"unknown protocol {args.protocol!r}; have "
+                         f"{sorted(protocols)}")
+    spec = protocols[args.protocol]
+    cfg, dataset = spec["cfg"], spec["data"]()
+
+    import jax
+    from msrflute_tpu.data import pack_round_batches
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    task = make_task(cfg.model_config)
+    fuse = int(cfg.server_config.get("rounds_per_step", 1))
+    out = {"protocol": args.protocol, "backend": backend,
+           "backend_reason": reason, "rounds_per_step": fuse}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = OptimizationServer(task, cfg, dataset, model_dir=tmp,
+                                    mesh=mesh, seed=0)
+        # ---- compile (first chunk) ----
+        tic = time.time()
+        server.config.server_config.max_iteration = fuse
+        server.train()
+        jax.block_until_ready(server.state.params)
+        out["compile_plus_first_chunk_secs"] = round(time.time() - tic, 3)
+
+        # ---- host packing cost, measured alone — with the SAME client
+        # padding the server uses (pad_to_mesh), or the share is
+        # understated exactly on the hardware this tool targets ----
+        from msrflute_tpu.parallel.mesh import pad_to_mesh
+        sampled = list(range(int(
+            cfg.server_config.num_clients_per_iteration)))
+        bs = int(cfg.client_config.data_config.train["batch_size"])
+        pad_to = pad_to_mesh(len(sampled), mesh)
+        tic = time.time()
+        for _ in range(5):
+            pack_round_batches(dataset, sampled, bs, server.max_steps,
+                               rng=np.random.default_rng(0),
+                               pad_clients_to=pad_to)
+        pack_secs = (time.time() - tic) / 5
+        out["pack_secs_per_round"] = round(pack_secs, 5)
+
+        # ---- optional trace chunk: profiler instrumentation inflates
+        # wall time, so it is NOT counted into the steady-state stats ----
+        if args.trace:
+            jax.profiler.start_trace(args.trace)
+            server.config.server_config.max_iteration += fuse
+            server.train()
+            jax.block_until_ready(server.state.params)
+            jax.profiler.stop_trace()
+            out["trace_dir"] = args.trace
+
+        # ---- timed chunks (the steady state) ----
+        per_round = []
+        for _ in range(max(args.chunks, 1)):
+            server.config.server_config.max_iteration += fuse
+            tic = time.time()
+            server.train()
+            jax.block_until_ready(server.state.params)
+            per_round.append((time.time() - tic) / fuse)
+        out["secs_per_round_p50"] = round(float(np.percentile(per_round, 50)), 5)
+        out["secs_per_round_p90"] = round(float(np.percentile(per_round, 90)), 5)
+        out["pack_share"] = round(pack_secs / max(np.median(per_round), 1e-9), 3)
+
+        # ---- XLA's own cost analysis of one client grad step ----
+        one = bench._one_client_batch(dataset, bs, server.max_steps)
+        cost = bench.grad_step_cost(task, server.state.params, one)
+        if cost is not None:
+            flops = float(cost.get("flops", 0.0))
+            out["client_step_flops"] = flops
+            out["client_step_bytes"] = float(
+                cost.get("bytes accessed", 0.0))
+            out["round_model_flops"] = flops * server.max_steps * len(sampled)
+            if on_tpu:
+                out["mfu_vs_bf16_peak"] = round(
+                    out["round_model_flops"] / max(np.median(per_round),
+                                                   1e-9)
+                    / bench.V5E_BF16_PEAK_FLOPS, 5)
+        else:
+            out["cost_analysis_error"] = "cost analysis unavailable"
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
