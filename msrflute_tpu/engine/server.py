@@ -116,6 +116,13 @@ class OptimizationServer:
         max_client_samples = int(max(train_dataset.num_samples))
         self.max_steps = steps_for(max_client_samples, self.batch_size,
                                    self.desired_max_samples)
+        # per-chunk step bucketing: size each fused chunk's [K, S, B] grid
+        # to ITS sampled clients instead of the dataset-wide worst case —
+        # padded steps are exact no-ops, so the math is unchanged (tested
+        # bit-equal), but small-client rounds stop paying max-client FLOPs
+        # and memory.  S rounds up to a power of two so jit retraces at
+        # most log2(max_steps) distinct programs.
+        self.step_bucketing = bool(cc.get("step_bucketing", True))
 
         # server replay training (reference core/server.py:429-442): after
         # aggregation, train on server-held data for a few iterations
@@ -242,9 +249,10 @@ class OptimizationServer:
             chunk_samples = [self._sample() for _ in range(R)]
             pad_to = pad_to_mesh(max(len(s) for s in chunk_samples),
                                  self.mesh)
+            steps = self._chunk_steps(chunk_samples)
             return [pack_round_batches(
-                self.train_dataset, sampled, self.batch_size,
-                self.max_steps, rng=self._np_rng, pad_clients_to=pad_to,
+                self.train_dataset, sampled, self.batch_size, steps,
+                rng=self._np_rng, pad_clients_to=pad_to,
                 desired_max_samples=self.desired_max_samples)
                 for sampled in chunk_samples]
 
@@ -348,6 +356,18 @@ class OptimizationServer:
         return self.state
 
     # ------------------------------------------------------------------
+    def _chunk_steps(self, chunk_samples: list) -> int:
+        """Step grid for one fused chunk: the dataset-wide ``max_steps``
+        worst case, or (``step_bucketing``, default) the chunk's own max
+        rounded up to a power of two — bounded retraces, identical math."""
+        if not self.step_bucketing:
+            return self.max_steps
+        need = max(steps_for(self.train_dataset.num_samples[i],
+                             self.batch_size, self.desired_max_samples)
+                   for sampled in chunk_samples for i in sampled)
+        pow2 = 1 << max(need - 1, 0).bit_length()
+        return min(self.max_steps, pow2)
+
     def _run_server_replay(self) -> None:
         """Replay training on server-held data after aggregation
         (reference ``core/server.py:429-442``)."""

@@ -176,3 +176,44 @@ def test_quant_threshold_annealing(synth_dataset, mesh8, tmp_path):
     assert state.round == 4
     # annealed 4 times: 0.8 * 0.5^4
     assert abs(server.quant_thresh - 0.8 * 0.5 ** 4) < 1e-9
+
+
+def test_step_bucketing_bit_equal(mesh8, tmp_path):
+    """Per-chunk step bucketing (pad [K,S,B] to the chunk's own client
+    sizes, not the dataset-wide max) changes program shapes only: padded
+    steps are exact no-ops, so trained params must be BIT-equal with the
+    knob on or off — while the bucketed chunk really packs a smaller S."""
+    from jax.flatten_util import ravel_pytree
+
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+
+    rng = np.random.default_rng(0)
+    # heterogeneous pool: most users tiny, one huge -> global max_steps is
+    # dominated by the outlier the typical round never samples
+    sizes = [6, 7, 5, 8, 6, 7, 5, 64]
+    users, per = [], []
+    for u, n in enumerate(sizes):
+        users.append(f"u{u}")
+        per.append({"x": rng.normal(size=(n, 8)).astype(np.float32),
+                    "y": rng.integers(0, 4, n).astype(np.int32)})
+    ds = ArraysDataset(users, per)
+
+    def run(bucketing):
+        raw = _cfg(rounds_per_step=2)
+        raw.client_config["step_bucketing"] = bucketing
+        raw.server_config["num_clients_per_iteration"] = 4
+        task = make_task(raw.model_config)
+        server = OptimizationServer(
+            task, raw, ds, model_dir=str(tmp_path / f"m{bucketing}"),
+            mesh=mesh8, seed=7)
+        state = server.train()
+        return server, ravel_pytree(state.params)[0]
+
+    server_on, flat_on = run(True)
+    server_off, flat_off = run(False)
+    np.testing.assert_array_equal(np.asarray(flat_on), np.asarray(flat_off))
+    # the outlier-free chunk really runs a smaller program
+    assert server_on.max_steps == 16
+    assert server_on._chunk_steps([[0, 1, 2, 3]]) == 2
+    assert server_off._chunk_steps([[0, 1, 2, 3]]) == 16
