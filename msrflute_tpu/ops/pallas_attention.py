@@ -23,9 +23,12 @@ with a VJP that honors the lse cotangent — so rotation outputs can be
 merged exactly outside the kernel.
 
 Length/feature padding is static; masked probability entries are zeroed
-explicitly (no ``-inf`` arithmetic on the MXU path).  Degrades gracefully
-off-TPU: kernels run in Pallas interpret mode (the same code path the
-tests exercise), so the op is usable — if not fast — everywhere.
+explicitly (no ``-inf`` arithmetic on the MXU path).  Off-TPU the default
+is an exact dense jnp reference with identical masking/lse semantics —
+NOT interpret-mode kernels: the interpret machinery's cross-core barriers
+deadlock when the op runs inside ``shard_map`` over multiple virtual CPU
+devices (the federated round does exactly that).  Pass ``interpret=True``
+to force the kernel code path (what the unit tests do, outside shard_map).
 """
 
 from __future__ import annotations
@@ -43,6 +46,11 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_kernels import _resolve_interpret
 
 _LANES = 128
+# row statistics (lse/delta/glse) ride broadcast over a SMALL trailing dim:
+# a block whose last dim EQUALS the array dim is always legal, and 8 lanes
+# instead of 128 keeps the dkv pass's three full-length stat streams 16x
+# smaller in VMEM at long sequence lengths
+_STAT_LANES = 8
 _NEG = -1e30  # "minus infinity" that survives exp/max without NaNs
 
 
@@ -66,7 +74,7 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
                 scale, block_q, block_k, l_q, l_k):
     qi = pl.program_id(2)
     q_off, k_off = offs_ref[0], offs_ref[1]
-    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [bq, D]
+    q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, D]
     q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     num_k = pl.cdiv(l_k, block_k)
@@ -78,9 +86,9 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)                                # [bk, D]
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
@@ -107,9 +115,13 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
-    lse_ref[0, 0, :] = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
-                                 _NEG)
+    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
+    # TPU mosaic requires the last two BLOCK dims be (8k, 128m)-aligned, so
+    # the per-row lse is stored lane-broadcast as [bq, _STAT_LANES] (the
+    # trick as jax's own tpu flash kernel's l/m outputs)
+    lse_ref[0, 0, :, :] = jax.lax.broadcast_in_dim(
+        lse, (block_q, _STAT_LANES), (0,))
 
 
 # ----------------------------------------------------------------------
@@ -120,11 +132,13 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                l_q, l_k):
     qi = pl.program_id(2)
     q_off, k_off = offs_ref[0], offs_ref[1]
-    q = q_ref[0, :, 0, :].astype(jnp.float32)
-    do = do_ref[0, :, 0, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :]
-    delta = delta_ref[0, 0, :]
-    glse = glse_ref[0, 0, :]
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    # lse/delta/glse arrive lane-broadcast [bq, _STAT_LANES]; any lane-reduce
+    # that preserves the (identical) value recovers the row vector
+    lse = jnp.max(lse_ref[0, 0, :, :], axis=1)
+    delta = jnp.max(delta_ref[0, 0, :, :], axis=1)
+    glse = jnp.max(glse_ref[0, 0, :, :], axis=1)
     q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     num_k = pl.cdiv(l_k, block_k)
@@ -134,9 +148,9 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             0, num_k)
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
@@ -156,7 +170,7 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     dq = jax.lax.fori_loop(0, num_k, body, dq0)
-    dq_ref[0, :, 0, :] = dq.astype(dq_ref.dtype)
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -164,8 +178,8 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 block_k, l_q, l_k):
     ki = pl.program_id(2)
     q_off, k_off = offs_ref[0], offs_ref[1]
-    k_blk = k_ref[0, :, 0, :].astype(jnp.float32)       # [bk, D]
-    v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)       # [bk, D]
+    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
     k_pos = k_off + ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     num_q = pl.cdiv(l_q, block_q)
@@ -178,11 +192,14 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        glse = glse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = jnp.max(
+            lse_ref[0, 0, pl.ds(i * block_q, block_q), :], axis=1)
+        delta = jnp.max(
+            delta_ref[0, 0, pl.ds(i * block_q, block_q), :], axis=1)
+        glse = jnp.max(
+            glse_ref[0, 0, pl.ds(i * block_q, block_q), :], axis=1)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -212,20 +229,36 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((block_k, k_blk.shape[1]), jnp.float32)
     dv0 = jnp.zeros((block_k, v_blk.shape[1]), jnp.float32)
     dk, dv = jax.lax.fori_loop(i0, num_q, body, (dk0, dv0))
-    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
 # ----------------------------------------------------------------------
 # pallas_call plumbing
 # ----------------------------------------------------------------------
 def _specs(block_q, block_k, lk_p, d_p):
-    q_spec = pl.BlockSpec((1, block_q, 1, d_p),
-                          lambda b, h, i, *_: (b, i, h, 0))
-    kv_spec = pl.BlockSpec((1, lk_p, 1, d_p),
-                           lambda b, h, i, *_: (b, 0, h, 0))
-    lse_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, *_: (b, h, i))
+    # kernel-side layout is [B, H, S, D]: the blocked dims (S, D) sit in
+    # the last two positions, as TPU mosaic tiling requires
+    q_spec = pl.BlockSpec((1, 1, block_q, d_p),
+                          lambda b, h, i, *_: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, lk_p, d_p),
+                           lambda b, h, i, *_: (b, h, 0, 0))
+    # per-row lse rides lane-broadcast as [B, H, lq_p, _STAT_LANES]
+    lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                            lambda b, h, i, *_: (b, h, i, 0))
     return q_spec, kv_spec, lse_spec
+
+
+def _bhsd(x):
+    """[B, L, H, D] -> [B, H, L, D] (kernel layout)."""
+    return x.transpose(0, 2, 1, 3)
+
+
+def _lanes(x, to):
+    """[B, H, L] -> lane-broadcast [B, H, to, _STAT_LANES] (f32)."""
+    return jnp.broadcast_to(
+        _pad_axis(x.astype(jnp.float32), 2, to)[..., None],
+        x.shape[:2] + (to, _STAT_LANES))
 
 
 def _offs(q_offset, k_offset):
@@ -239,9 +272,9 @@ def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k,
     Lk = k.shape[1]
     lq_p, lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
     d_p = _ceil_to(D, _LANES)
-    qp = _pad_axis(_pad_axis(q, 1, lq_p), 3, d_p)
-    kp = _pad_axis(_pad_axis(k, 1, lk_p), 3, d_p)
-    vp = _pad_axis(_pad_axis(v, 1, lk_p), 3, d_p)
+    qp = _bhsd(_pad_axis(_pad_axis(q, 1, lq_p), 3, d_p))
+    kp = _bhsd(_pad_axis(_pad_axis(k, 1, lk_p), 3, d_p))
+    vp = _bhsd(_pad_axis(_pad_axis(v, 1, lk_p), 3, d_p))
     q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lk_p, d_p)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
@@ -255,10 +288,10 @@ def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k,
             out_specs=[q_spec, lse_spec],
         ),
         out_shape=[jax.ShapeDtypeStruct(qp.shape, q.dtype),
-                   jax.ShapeDtypeStruct((B, H, lq_p), jnp.float32)],
+                   jax.ShapeDtypeStruct((B, H, lq_p, _STAT_LANES), jnp.float32)],
         interpret=_resolve_interpret(interpret),
     )(_offs(q_offset, k_offset), qp, kp, vp)
-    return out[:, :Lq, :, :D], lse[:, :, :Lq]
+    return _bhsd(out)[:, :Lq, :, :D], lse[:, :, :Lq, 0]
 
 
 def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
@@ -267,16 +300,16 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
     Lk = k.shape[1]
     lq_p, lk_p = _ceil_to(Lq, block_q), _ceil_to(Lk, block_k)
     d_p = _ceil_to(D, _LANES)
-    qp = _pad_axis(_pad_axis(q, 1, lq_p), 3, d_p)
-    kp = _pad_axis(_pad_axis(k, 1, lk_p), 3, d_p)
-    vp = _pad_axis(_pad_axis(v, 1, lk_p), 3, d_p)
-    gp = _pad_axis(_pad_axis(g, 1, lq_p), 3, d_p)
-    lse_p = _pad_axis(lse, 2, lq_p)
-    glse_p = _pad_axis(g_lse.astype(jnp.float32), 2, lq_p)
+    qp = _bhsd(_pad_axis(_pad_axis(q, 1, lq_p), 3, d_p))
+    kp = _bhsd(_pad_axis(_pad_axis(k, 1, lk_p), 3, d_p))
+    vp = _bhsd(_pad_axis(_pad_axis(v, 1, lk_p), 3, d_p))
+    gp = _bhsd(_pad_axis(_pad_axis(g, 1, lq_p), 3, d_p))
+    lse_p = _lanes(lse, lq_p)
+    glse_p = _lanes(g_lse, lq_p)
     # delta_i = sum_d dO_i . O_i  (rowwise), the softmax-grad correction
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=3)                              # [B, Lq, H]
-    delta = _pad_axis(delta.transpose(0, 2, 1), 2, lq_p)  # [B, H, lq_p]
+    delta = _lanes(delta.transpose(0, 2, 1), lq_p)
     interp = _resolve_interpret(interpret)
     offs = _offs(q_offset, k_offset)
     q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lk_p, d_p)
@@ -298,12 +331,12 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
     )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
 
     # dk/dv: grid over key blocks; q/do/lse/delta stream in full
-    kq_spec = pl.BlockSpec((1, lq_p, 1, d_p),
-                           lambda b, h, i, *_: (b, 0, h, 0))
-    kk_spec = pl.BlockSpec((1, block_k, 1, d_p),
-                           lambda b, h, i, *_: (b, i, h, 0))
-    full_lse_spec = pl.BlockSpec((1, 1, lq_p),
-                                 lambda b, h, i, *_: (b, h, 0))
+    kq_spec = pl.BlockSpec((1, 1, lq_p, d_p),
+                           lambda b, h, i, *_: (b, h, 0, 0))
+    kk_spec = pl.BlockSpec((1, 1, block_k, d_p),
+                           lambda b, h, i, *_: (b, h, i, 0))
+    full_lse_spec = pl.BlockSpec((1, 1, lq_p, _STAT_LANES),
+                                 lambda b, h, i, *_: (b, h, 0, 0))
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal, scale=scale,
                                    block_q=block_q, block_k=block_k,
                                    l_q=Lq, l_k=Lk)
@@ -320,7 +353,34 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)],
         interpret=interp,
     )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
-    return dq[:, :Lq, :, :D], dk[:, :Lk, :, :D], dv[:, :Lk, :, :D]
+    return (_bhsd(dq)[:, :Lq, :, :D], _bhsd(dk)[:, :Lk, :, :D],
+            _bhsd(dv)[:, :Lk, :, :D])
+
+
+def _dense_lse(q, k, v, q_offset, k_offset, causal):
+    """Exact dense reference with the kernels' masking/lse semantics
+    (global-position causal mask; fully-masked rows -> zeros, lse=_NEG).
+    The lse cotangent flows naturally through autodiff — no custom VJP."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = jnp.asarray(q_offset, jnp.int32) + jnp.arange(Lq)
+        k_pos = jnp.asarray(k_offset, jnp.int32) + jnp.arange(Lk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+        e_mask = mask[None, None]
+    else:
+        e_mask = jnp.ones((1, 1, Lq, Lk), bool)
+    m = jnp.max(s, axis=3)
+    e = jnp.where(e_mask, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(e, axis=3)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
+    p = e / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
@@ -367,6 +427,10 @@ def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError(f"expected [B, L, H, D], got {q.shape}")
     if k.shape != v.shape:
         raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    if interpret is None and jax.default_backend() != "tpu":
+        # off-TPU default: exact dense math (see module docstring for why
+        # interpret-mode kernels are not safe under shard_map)
+        return _dense_lse(q, k, v, q_offset, k_offset, bool(causal))
     return _flash_lse(q, k, v, q_offset, k_offset, bool(causal),
                       int(block_q), int(block_k), interpret)
 
@@ -383,6 +447,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     sequence length at roughly 16k (f32) per chip — beyond that, shard the
     sequence axis over a mesh and run these kernels per ring rotation
     (``ring_self_attention(..., use_flash=True)``).
+
+    On a non-TPU backend with ``interpret=None`` this op computes the SAME
+    math via a dense reference — O(Lq*Lk) score memory, not the tiled
+    O(L) profile above (see module docstring for why).  The Pallas-tiled
+    path runs only on TPU (compiled) or with ``interpret=True``.
     """
     return flash_attention_lse(q, k, v, causal, block_q=block_q,
                                block_k=block_k, interpret=interpret)[0]

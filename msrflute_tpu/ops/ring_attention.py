@@ -156,9 +156,11 @@ def ring_self_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     data + sequence parallelism): the ring rotations stay within each
     batch shard's ring, no cross-batch communication.
 
-    ``use_flash`` runs each rotation through the Pallas flash kernels
-    (:func:`ring_flash_attention_local`) instead of the jnp online-softmax
-    accumulate — same numerics (tested), no per-rotation score matrix.
+    ``use_flash`` runs each rotation through
+    :func:`ring_flash_attention_local` — the Pallas flash kernels on TPU
+    (no per-rotation score matrix), the dense-lse reference elsewhere;
+    same numerics either way (kernel/dense parity incl. the lse cotangent
+    is pinned by ``test_pallas_attention.py``).
     """
     n = mesh.shape[axis]
     L = q.shape[1]

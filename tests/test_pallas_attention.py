@@ -97,3 +97,41 @@ def test_shape_validation():
         flash_attention(jnp.zeros((8, 4)), x, x)
     with pytest.raises(ValueError):
         flash_attention(x, x, jnp.zeros((2, 8, 2, 5)))
+
+
+def test_flash_lse_cotangent_kernel():
+    """Kernel-path lse + a NONZERO lse cotangent vs the dense reference.
+
+    The off-TPU default of :func:`flash_attention_lse` is the dense
+    reference, so this is the one test that still drives the kernel
+    backward's glse plumbing (``_dq_kernel``/``_dkv_kernel``) with
+    ``interpret=True`` — with global-position offsets and Lq != Lk, the
+    exact configuration ring attention runs on TPU."""
+    from msrflute_tpu.ops.pallas_attention import (_dense_lse,
+                                                   flash_attention_lse)
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(1, 24, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 40, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 40, 2, 16)), jnp.float32)
+    # q global positions start past the k chunk: every row sees some keys
+    q_off, k_off = 40, 8
+
+    def obj_kernel(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, causal=True,
+                                       q_offset=q_off, k_offset=k_off,
+                                       block_q=16, block_k=16,
+                                       interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def obj_dense(q, k, v):
+        out, lse = _dense_lse(q, k, v, q_off, k_off, True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    np.testing.assert_allclose(float(obj_kernel(q, k, v)),
+                               float(obj_dense(q, k, v)), rtol=1e-5)
+    gk = jax.grad(obj_kernel, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(obj_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5,
+                                   err_msg=f"d{name} (lse cotangent)")

@@ -88,9 +88,10 @@ def test_ring_grads_match_full_attention(seq_mesh, causal):
 
 @pytest.fixture(scope="module")
 def ring2_mesh():
-    # interpret-mode kernels run serially per device per rotation; a
-    # 2-device ring keeps the kernel count (and test time) bounded while
-    # still exercising rotation offsets, the merge, and ppermute
+    # on this CPU backend use_flash resolves to the dense-lse fallback
+    # (identical math; the kernel/dense parity incl. the lse cotangent is
+    # pinned by test_pallas_attention.py::test_flash_lse_cotangent_kernel);
+    # a 2-device ring still exercises rotation offsets, the merge, ppermute
     return Mesh(np.asarray(jax.devices()[:2]), ("sequence",))
 
 
