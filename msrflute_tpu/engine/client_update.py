@@ -133,7 +133,12 @@ def build_client_update(task: BaseTask, client_opt_cfg,
 
     def client_update(global_params, arrays: Dict[str, jnp.ndarray],
                       sample_mask: jnp.ndarray, lr: jnp.ndarray,
-                      rng: jax.Array):
+                      rng: jax.Array, grad_offset=None):
+        """``grad_offset`` (optional params-shaped pytree) is added to every
+        inner step's gradient — the drift-correction hook used by SCAFFOLD's
+        ``c - c_i`` control variate (``strategies/scaffold.py``); it
+        participates in clipping like any other gradient term.  ``None``
+        compiles to the plain path."""
         opt_state = tx.init(global_params)
         opt_state.hyperparams["learning_rate"] = lr
         update_mask = (_updatable_mask(global_params)
@@ -147,6 +152,8 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             rng, sub = jax.random.split(rng)
             (loss, _aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch, sub, True)
+            if grad_offset is not None:
+                grads = jax.tree.map(lambda g, o: g + o, grads, grad_offset)
             if hparams.fedprox_mu > 0.0:
                 grads = jax.tree.map(
                     lambda g, w, w0: g + hparams.fedprox_mu * (w - w0),

@@ -337,7 +337,7 @@ class RoundEngine:
     # RL support: a round variant that also returns per-client payloads so
     # the meta-aggregator can re-weight them (reference keeps
     # client_parameters_stack for this, core/strategies/dga.py:317-330).
-    def _build_payload_step(self):
+    def _build_payload_step(self, with_offsets: bool = False):
         strategy = self.strategy
         client_update = self.client_update
         mesh = self.mesh
@@ -345,36 +345,70 @@ class RoundEngine:
         rspec = P()
 
         def shard_body(params, strategy_state, arrays, sample_mask,
-                       client_mask, client_ids, client_lr, rng):
-            def per_client(arr_c, mask_c, cm_c, cid_c):
+                       client_mask, client_ids, client_lr, rng,
+                       leakage_threshold, offsets_flat=None):
+            def per_client(arr_c, mask_c, cm_c, cid_c, off_c):
                 rng_c = jax.random.fold_in(rng, cid_c)
+                off_tree = None
+                if off_c is not None:
+                    from jax.flatten_util import ravel_pytree
+                    _, unravel = ravel_pytree(params)
+                    off_tree = unravel(off_c)
                 parts, tl, ns, stats = strategy.client_step(
                     client_update, params, arr_c, mask_c, client_lr, rng_c,
-                    strategy_state=strategy_state)
+                    leakage_threshold=leakage_threshold,
+                    strategy_state=strategy_state, grad_offset=off_tree)
                 pg, w = parts["default"]
-                return pg, w * cm_c, stats
-            return jax.vmap(per_client)(arrays, sample_mask, client_mask,
-                                        client_ids)
+                return pg, w * cm_c, tl * cm_c, stats
+            return jax.vmap(per_client, in_axes=(0, 0, 0, 0,
+                                                 0 if with_offsets else None))(
+                arrays, sample_mask, client_mask, client_ids, offsets_flat)
 
         fn = shard_map(shard_body, mesh=mesh,
                        in_specs=(rspec, rspec, cspec, cspec, cspec, cspec,
-                                 rspec, rspec),
+                                 rspec, rspec, rspec) +
+                                ((cspec,) if with_offsets else ()),
                        out_specs=cspec, check_vma=False)
         return jax.jit(fn)
 
     def client_payloads(self, state: ServerState, batch: RoundBatch,
-                        client_lr: float, rng: jax.Array):
-        """Per-client (pseudo_grad [K,...], weight [K], stats [K]) for RL."""
-        if not hasattr(self, "_payload_step"):
-            self._payload_step = self._build_payload_step()
-        arrays = {k: jax.device_put(v, self._client_sharding)
-                  for k, v in batch.arrays.items()}
-        return self._payload_step(
-            state.params, state.strategy_state, arrays,
+                        client_lr: float, rng: jax.Array,
+                        grad_offsets: Optional[np.ndarray] = None,
+                        leakage_threshold: Optional[float] = None):
+        """Per-client ``(pseudo_grad [K,...], weight [K], train_loss [K],
+        stats [K])`` — the payload program behind RL re-weighting
+        (reference keeps ``client_parameters_stack``, ``dga.py:317-330``)
+        and SCAFFOLD control-variate rounds.
+
+        ``grad_offsets`` (optional ``[K, n_params]`` flat f32 array) is the
+        per-client drift correction added to every local step's gradient
+        (SCAFFOLD's ``c - c_i``); rows for padding clients must be zero.
+        ``leakage_threshold`` enables the same privacy-leakage client
+        dropping the fused round applies (``wt=0`` above threshold).
+        """
+        key = "_payload_step_off" if grad_offsets is not None \
+            else "_payload_step"
+        if not hasattr(self, key):
+            setattr(self, key, self._build_payload_step(
+                with_offsets=grad_offsets is not None))
+        args = [
+            state.params, state.strategy_state,
+            {k: jax.device_put(v, self._client_sharding)
+             for k, v in batch.arrays.items()},
             jax.device_put(batch.sample_mask, self._client_sharding),
             jax.device_put(batch.client_mask, self._client_sharding),
             jax.device_put(batch.client_ids, self._client_sharding),
-            jnp.asarray(client_lr, jnp.float32), rng)
+            jnp.asarray(client_lr, jnp.float32), rng,
+            jnp.asarray(leakage_threshold if leakage_threshold is not None
+                        else jnp.inf, jnp.float32),
+        ]
+        if grad_offsets is not None:
+            # numpy -> sharded put directly: staging through jnp.asarray
+            # would commit the whole [K, n_params] matrix to one device
+            args.append(jax.device_put(
+                np.asarray(grad_offsets, np.float32),
+                self._client_sharding))
+        return getattr(self, key)(*args)
 
     def apply_custom_weights(self, state: ServerState, pgs, weights,
                              server_lr: float) -> ServerState:

@@ -183,13 +183,38 @@ class OptimizationServer:
             self.state = ServerState(params, self.state.opt_state,
                                      self.strategy.init_state(params), 0)
             print_rank(f"warm-started from pretrained model {pretrained}")
+        resumed = False
         if sc.get("resume_from_checkpoint", False):
             restored = self.ckpt.load(self.state)
             if restored is not None:
                 self.state = restored
+                resumed = True
                 status = self.ckpt.read_status()
                 self.lr_weight = float(status.get("weight", 1.0))
                 print_rank(f"resumed from checkpoint at round {self.state.round}")
+
+        # SCAFFOLD control variates (strategies/scaffold.py): host-side
+        # store under the model dir.  Controls are reloaded ONLY when the
+        # model checkpoint itself resumed — params and controls belong to
+        # the same trajectory; a fresh run wipes any previous run's files.
+        self.scaffold_store = None
+        if getattr(self.strategy, "host_rounds", False):
+            from ..strategies.scaffold import ControlStore
+            n_params = sum(int(np.prod(l.shape))
+                           for l in jax.tree.leaves(self.state.params))
+            self.scaffold_store = ControlStore(
+                n_params, store_dir=os.path.join(model_dir, "scaffold"),
+                resume=resumed)
+            if resumed and self.scaffold_store.round() != self.state.round:
+                # control writes are synchronous but the model checkpoint
+                # may be async: a crash can leave controls ahead of the
+                # restored params.  Mismatched trajectories must not mix —
+                # restart control estimation from zero.
+                print_rank(
+                    f"SCAFFOLD controls were at round "
+                    f"{self.scaffold_store.round()} but the checkpoint "
+                    f"resumed at {self.state.round}; resetting controls")
+                self.scaffold_store.reset()
 
     # ------------------------------------------------------------------
     def _sample(self) -> list:
@@ -225,6 +250,10 @@ class OptimizationServer:
 
         if self.rl is not None:
             rounds_per_step = 1  # RL needs val feedback every round
+        if self.scaffold_store is not None:
+            # control gather/update is per-round host work (like the
+            # reference's per-round protocol exchange); no chunk fusion
+            rounds_per_step = 1
         if self.server_replay is not None and rounds_per_step > 1:
             # reference runs replay after EVERY round (core/server.py:429);
             # fusing rounds would cut the replay cadence
@@ -273,8 +302,17 @@ class OptimizationServer:
             tic = time.time()
             R = chunk_R(round_no)
 
-            if self.rl is not None:
-                self._run_rl_round(round_no)
+            # host-orchestrated per-round paths (RL re-weighting, SCAFFOLD
+            # controls) share the normal round bookkeeping tail
+            host_round = (self._run_rl_round if self.rl is not None else
+                          self._run_scaffold_round
+                          if self.scaffold_store is not None else None)
+            if host_round is not None:
+                host_round(round_no)
+                if self.server_replay is not None:
+                    # the reference runs replay after EVERY round
+                    # (core/server.py:429)
+                    self._run_server_replay()
                 round_no += 1
                 self.run_stats["secsPerRound"].append(time.time() - tic)
                 self._round_housekeeping(round_no, val_freq, rec_freq)
@@ -468,25 +506,86 @@ class OptimizationServer:
             return float(metrics["acc"].value)
         return -float(metrics["loss"].value)
 
-    def _run_rl_round(self, round_no: int) -> None:
-        """One RL-assisted round (reference ``core/strategies/dga.py:286-406``):
-        collect per-client payloads once, aggregate with both the strategy
-        weights and the RL-estimated weights, keep whichever validates
-        better, reward the policy, train the DQN."""
+    def _host_round_setup(self, round_no: int):
+        """Shared prologue of the host-orchestrated round paths (RL,
+        SCAFFOLD): LRs, client sampling, packed batch (with the same
+        per-round step bucketing the fused path uses), round rng."""
         client_lr = self.initial_lr_client * self.lr_weight
         server_lr = (self.plateau.lr if self.plateau is not None
                      else self.server_lr_schedule(round_no))
         sampled = self._sample()
         batch = pack_round_batches(
-            self.train_dataset, sampled, self.batch_size, self.max_steps,
-            rng=self._np_rng, pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
+            self.train_dataset, sampled, self.batch_size,
+            self._chunk_steps([sampled]), rng=self._np_rng,
+            pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
             desired_max_samples=self.desired_max_samples)
         self._rng, rng = jax.random.split(self._rng)
+        return client_lr, server_lr, batch, rng
 
-        pgs, ws, stats = self.engine.client_payloads(self.state, batch,
-                                                     client_lr, rng)
+    def _run_scaffold_round(self, round_no: int) -> None:
+        """One SCAFFOLD round (``strategies/scaffold.py``): gather per-client
+        control offsets ``c - c_i``, run the drift-corrected payload program,
+        aggregate with sample-count weights, then update the controls
+        host-side from the per-client pseudo-gradients (option II)."""
+        client_lr, server_lr, batch, rng = self._host_round_setup(round_no)
+
+        offsets = self.scaffold_store.offsets(batch.client_ids)
+        pgs, ws, tls, stats = self.engine.client_payloads(
+            self.state, batch, client_lr, rng, grad_offsets=offsets,
+            leakage_threshold=self.max_allowed_leakage)
+        self.state = self.engine.apply_custom_weights(self.state, pgs, ws,
+                                                      server_lr)
+
+        # ---- host-side control update (exact per-client math) ----
+        pgs_np = jax.device_get(pgs)
         ws_np = np.asarray(jax.device_get(ws))
-        k = len(sampled)
+        k = len(batch.client_ids)
+        # [K, n_params] in ravel_pytree order: tree.leaves order, each leaf
+        # C-order — one concatenate, no per-client device round-trips
+        pgs_flat = np.concatenate(
+            [np.asarray(leaf).reshape(k, -1)
+             for leaf in jax.tree.leaves(pgs_np)], axis=1)
+        epochs = int(self.config.client_config.get("num_epochs", 1) or 1)
+        # real local steps per client: steps with >= 1 real sample, per epoch
+        steps = (batch.sample_mask.sum(axis=2) > 0).sum(axis=1) * epochs
+        # invalidate the marker while the control files mutate: a crash
+        # mid-update must read as a mismatch on resume, not as round N
+        self.scaffold_store.set_round(-1)
+        self.strategy.update_controls(
+            self.scaffold_store, batch.client_ids, steps, pgs_flat,
+            client_lr, total_clients=len(self.train_dataset),
+            weights=ws_np)
+
+        # attack metrics + adaptive leakage threshold run here too
+        # (the fused path does this on its own stats)
+        self._process_privacy_stats(jax.device_get(stats), round_no,
+                                    client_mask=batch.client_mask)
+        # marker pairing the fully-written controls with the
+        # round-(round_no+1) model checkpoint; resume resets the controls
+        # if the marker disagrees (or is the -1 mid-update sentinel)
+        self.scaffold_store.set_round(round_no + 1)
+        tls_np = np.asarray(jax.device_get(tls))
+        n_real = max(float((batch.client_ids >= 0).sum()), 1.0)
+        log_metric("Training loss",
+                   float(tls_np.sum() / n_real), step=round_no)
+        log_metric("Aggregated weights", float(ws_np.sum()), step=round_no)
+        log_metric("Control norm (server c)",
+                   float(np.linalg.norm(self.scaffold_store.c)),
+                   step=round_no)  # latest-checkpoint save: housekeeping
+
+    # ------------------------------------------------------------------
+    def _run_rl_round(self, round_no: int) -> None:
+        """One RL-assisted round (reference ``core/strategies/dga.py:286-406``):
+        collect per-client payloads once, aggregate with both the strategy
+        weights and the RL-estimated weights, keep whichever validates
+        better, reward the policy, train the DQN."""
+        client_lr, server_lr, batch, rng = self._host_round_setup(round_no)
+
+        pgs, ws, _tls, stats = self.engine.client_payloads(
+            self.state, batch, client_lr, rng,
+            leakage_threshold=self.max_allowed_leakage)
+        ws_np = np.asarray(jax.device_get(ws))
+        k = int((batch.client_ids >= 0).sum())
         state_vec = np.concatenate([
             ws_np[:k],
             np.asarray(jax.device_get(stats["mag"]))[:k],
@@ -517,6 +616,11 @@ class OptimizationServer:
         log_metric("RL Rewards", reward, step=round_no)
         log_metric("Val acc (baseline vs RL)",
                    {"baseline": baseline_acc, "rl": rl_acc}, step=round_no)
+        # attack metrics + adaptive leakage threshold, same as the fused
+        # and scaffold paths — without this the adaptive threshold could
+        # never update and the leakage-based dropping would stay inert
+        self._process_privacy_stats(jax.device_get(stats), round_no,
+                                    client_mask=batch.client_mask)
         self.rl.train(state_vec, action, reward)
         self.rl.save()
         log_metric("RL Running Loss", self.rl.running_loss, step=round_no)
@@ -674,6 +778,13 @@ class OptimizationServer:
             self.state = ServerState(restored.params, restored.opt_state,
                                      restored.strategy_state, self.state.round)
             print_rank("fell back to previous best model")
+            if self.scaffold_store is not None:
+                # controls accumulated since that checkpoint belong to the
+                # abandoned trajectory; restart control estimation from
+                # zero (the paper's init) rather than bias the restored
+                # params with stale drift corrections
+                self.scaffold_store.reset()
+                print_rank("reset SCAFFOLD controls after fallback")
 
     def _log_timing(self) -> None:
         """Timing summary (reference ``run_stats``, ``core/server.py:492-521``)

@@ -30,7 +30,7 @@ ALLOWED_ANNEALING = [
 ALLOWED_STRATEGIES = [
     # reference core/strategies/__init__.py:9-23
     "dga", "DGA", "fedavg", "FedAvg", "fedprox", "FedProx",
-    "fedlabels", "FedLabels", "fedac", "FedAC",
+    "fedlabels", "FedLabels", "fedac", "FedAC", "scaffold", "Scaffold",
 ]
 
 ALLOWED_SERVER_TYPES = [

@@ -21,6 +21,9 @@ def select_strategy(name: str) -> type:
     if key == "fedac":
         from .fedac import FedAC
         return FedAC
+    if key == "scaffold":
+        from .scaffold import Scaffold
+        return Scaffold
     if key == "fedlabels":
         from .fedlabels import FedLabels
         return FedLabels

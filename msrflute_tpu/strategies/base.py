@@ -82,7 +82,8 @@ class BaseStrategy:
     # ---- traced, per-client (inside vmap) ----------------------------
     def client_step(self, client_update, global_params, arrays, sample_mask,
                     client_lr, rng, round_idx=None, leakage_threshold=None,
-                    quant_threshold=None, strategy_state=None):
+                    quant_threshold=None, strategy_state=None,
+                    grad_offset=None):
         """Run one client's local work and emit weighted payload parts.
 
         Returns ``(parts, train_loss, num_samples, stats)`` where ``parts``
@@ -91,9 +92,12 @@ class BaseStrategy:
         reference's ``generate_client_payload`` pipeline, including the
         privacy-attack metrics + client dropping of
         ``core/client.py:466-508`` when ``privacy_metrics_config`` is on.
+        ``grad_offset`` (per-client drift correction, SCAFFOLD) forwards to
+        the client update's inner steps.
         """
         pg, tl, ns, stats = client_update(global_params, arrays, sample_mask,
-                                          client_lr, rng)
+                                          client_lr, rng,
+                                          grad_offset=grad_offset)
         w = self.client_weight(num_samples=ns, train_loss=tl, stats=stats,
                                rng=jax.random.fold_in(rng, 1))
         w = self._apply_privacy_metrics(

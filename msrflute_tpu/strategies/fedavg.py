@@ -83,11 +83,13 @@ class FedAvg(BaseStrategy):
 
     def client_step(self, client_update, global_params, arrays, sample_mask,
                     client_lr, rng, round_idx=None, leakage_threshold=None,
-                    quant_threshold=None, strategy_state=None):
+                    quant_threshold=None, strategy_state=None,
+                    grad_offset=None):
         parts, tl, ns, stats = super().client_step(
             client_update, global_params, arrays, sample_mask, client_lr,
             rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
-            quant_threshold=quant_threshold, strategy_state=strategy_state)
+            quant_threshold=quant_threshold, strategy_state=strategy_state,
+            grad_offset=grad_offset)
         if self.adaptive_clip is not None and strategy_state is not None:
             # below-clip indicator vs the PRE-clip update norm, which
             # transform_payload recorded in this client's stats dict; it

@@ -70,7 +70,11 @@ class FedLabels(BaseStrategy):
     # ------------------------------------------------------------------
     def client_step(self, client_update, global_params, arrays, sample_mask,
                     client_lr, rng, round_idx=None, leakage_threshold=None,
-                    quant_threshold=None, strategy_state=None):
+                    quant_threshold=None, strategy_state=None,
+                    grad_offset=None):
+        if grad_offset is not None:
+            raise ValueError("FedLabels does not support grad_offset "
+                             "(SCAFFOLD drift correction)")
         # 1) supervised pass: the standard local-SGD client update on x/y
         labeled = {k: v for k, v in arrays.items()
                    if k not in ("ux", "ux_rand", "uy")}

@@ -1,0 +1,249 @@
+"""SCAFFOLD — stochastic controlled averaging (arXiv:1910.06378).
+
+Net-new vs the reference (FLUTE ships FedAvg/FedProx/DGA/FedLabels only):
+SCAFFOLD corrects client drift under heterogeneous (non-IID) client data
+with control variates — a server control ``c`` and one per-client control
+``c_i`` — so multiple local epochs stop pulling the global model toward
+each client's local optimum.
+
+Per sampled client (option II of the paper):
+
+    local step:   y <- y - lr * (grad f_i(y) + c - c_i)
+    new control:  c_i+ = c_i - c + (x - y_T) / (K_i * lr)
+    server:       x <- x - server_lr * weighted_avg(x - y_T)
+                  c <- c + sum_i (c_i+ - c_i) / N_total
+
+TPU mapping: the correction ``c - c_i`` is a per-client *gradient offset*
+threaded into every inner SGD step of the jitted client update
+(``engine/client_update.py`` ``grad_offset``); the per-client pseudo-
+gradients come back via the engine's payload program (the same machinery
+the RL re-weighting uses), and all control bookkeeping is exact host-side
+numpy — ``K_i`` (real local steps) is known from the round batch's sample
+mask, so no extra device outputs are needed.
+
+Scale note: controls cost one flat model vector per *participating*
+client.  With a ``store_dir`` (the server always sets one) the durable
+copy lives on disk (one ``.npy`` per client, crash-safe writes) and the
+in-RAM cache is FIFO-bounded at ``ControlStore.CACHE_LIMIT`` vectors, so
+host memory stays flat for very large pools; the disk copies also make
+controls resume-safe.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .fedavg import FedAvg
+
+
+class ControlStore:
+    """Host-side control variates: server ``c`` + per-client ``c_i``.
+
+    Flat f32 vectors in ravel-pytree order.  With ``store_dir`` set, every
+    update is persisted (tmp+rename, crash-safe) and missing entries are
+    read back from disk — so a resumed run continues with the controls it
+    left off with.  Unseen clients start at ``c_i = 0`` (the paper's
+    initialization).
+    """
+
+    def __init__(self, n_params: int, store_dir: Optional[str] = None,
+                 resume: bool = False):
+        self.n_params = int(n_params)
+        self.store_dir = store_dir
+        self._ci: Dict[int, np.ndarray] = {}
+        self.c = np.zeros((self.n_params,), np.float32)
+        if store_dir is not None:
+            os.makedirs(store_dir, exist_ok=True)
+            if resume:
+                cpath = self._path("server")
+                if os.path.exists(cpath):
+                    self.c = np.load(cpath).astype(np.float32)
+            else:
+                # a fresh run must not pick up a previous run's controls:
+                # they belong to an abandoned parameter trajectory, and
+                # round 1 would no longer match FedAvg at zero controls
+                for name in os.listdir(store_dir):
+                    if name.startswith("control_"):
+                        os.remove(os.path.join(store_dir, name))
+
+    def _path(self, key) -> str:
+        return os.path.join(self.store_dir, f"control_{key}.npy")
+
+    def _save(self, key, vec: np.ndarray) -> None:
+        if self.store_dir is None:
+            return
+        path = self._path(key)
+        tmp = path + ".tmp.npy"  # .npy suffix stops np.save appending one
+        np.save(tmp, vec)
+        os.replace(tmp, path)
+
+    #: with a disk store, keep at most this many client controls in RAM
+    #: (insertion-ordered dict, FIFO eviction) — the disk copy is the
+    #: durable one, so eviction is free; without a store_dir everything
+    #: must stay resident (there is nowhere to spill to)
+    CACHE_LIMIT = 1024
+
+    def _cache(self, cid: int, vec: np.ndarray) -> None:
+        self._ci[cid] = vec
+        if self.store_dir is not None:
+            while len(self._ci) > self.CACHE_LIMIT:
+                self._ci.pop(next(iter(self._ci)))
+
+    def ci(self, client_id: int) -> np.ndarray:
+        cid = int(client_id)
+        if cid in self._ci:
+            return self._ci[cid]
+        if self.store_dir is not None:
+            path = self._path(cid)
+            if os.path.exists(path):
+                vec = np.load(path).astype(np.float32)
+                self._cache(cid, vec)
+                return vec
+        return np.zeros((self.n_params,), np.float32)
+
+    def set_ci(self, client_id: int, vec: np.ndarray) -> None:
+        cid = int(client_id)
+        self._cache(cid, vec.astype(np.float32))
+        self._save(cid, self._ci[cid])
+
+    def reset(self) -> None:
+        """Zero all controls and delete persisted files (used when the
+        server falls back to a best checkpoint: the accumulated controls
+        belong to the abandoned trajectory)."""
+        self._ci.clear()
+        self.c = np.zeros((self.n_params,), np.float32)
+        if self.store_dir is not None:
+            for name in os.listdir(self.store_dir):
+                if name.startswith("control_"):
+                    os.remove(os.path.join(self.store_dir, name))
+
+    # ---- round marker: pairs the controls with a model checkpoint ------
+    # Control writes are synchronous; the model checkpoint may be async.
+    # The marker records which round the controls belong to, so resume can
+    # detect controls that ran ahead of the restored params (crash between
+    # a control update and its checkpoint landing) and reset instead of
+    # applying another trajectory's drift corrections.
+    def set_round(self, round_no: int) -> None:
+        self._save("round", np.asarray([round_no], np.int64))
+
+    def round(self) -> Optional[int]:
+        if self.store_dir is None:
+            return None
+        path = self._path("round")
+        if not os.path.exists(path):
+            return None
+        return int(np.load(path)[0])
+
+    def set_c(self, vec: np.ndarray) -> None:
+        self.c = vec.astype(np.float32)
+        self._save("server", self.c)
+
+    def offsets(self, client_ids) -> np.ndarray:
+        """``[K, n_params]`` rows of ``c - c_i``; zero rows for padding
+        clients (id < 0) so their (masked) updates stay exact no-ops."""
+        out = np.zeros((len(client_ids), self.n_params), np.float32)
+        for row, cid in enumerate(client_ids):
+            if int(cid) >= 0:
+                out[row] = self.c - self.ci(int(cid))
+        return out
+
+
+class Scaffold(FedAvg):
+    """Aggregation weights are FedAvg's sample counts; the control-variate
+    flow is orchestrated by the server's scaffold round
+    (``engine/server.py::_run_scaffold_round``), flagged by ``host_rounds``.
+    Payload transforms that would corrupt the control update (local DP,
+    adaptive clipping, quantization) and non-SGD client optimizers are
+    rejected at construction — see ``__init__``."""
+
+    #: the server routes every round through its host-side scaffold path
+    #: (per-client state in/out); round fusion is disabled like RL/replay
+    host_rounds = True
+    # control updates assume the single-payload flow
+    supports_staleness = False
+    supports_rl = False
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        # The option-II control update reads the PAYLOAD pseudo-gradient as
+        # "sum of corrected SGD steps x lr": anything that breaks that
+        # identity would bake garbage into the controls and re-inject it
+        # into every future client's inner steps.  Reject loudly.
+        if dp_config is not None and (
+                dp_config.get("enable_local_dp", False) or
+                dp_config.get("adaptive_clipping")):
+            raise ValueError(
+                "strategy: scaffold does not compose with "
+                "dp_config.enable_local_dp / adaptive_clipping — the "
+                "control update would absorb the DP noise; use fedavg/dga "
+                "for DP runs")
+        cc = getattr(config, "client_config", None)
+        if cc is not None:
+            oc = cc.optimizer_config
+            opt_type = str(oc.get("type", "sgd")).lower()
+            # y_T = x - lr * sum(corrected grads) only holds for PLAIN SGD
+            # (the paper's local update): momentum/nesterov/weight-decay
+            # variants, other optimizers, and the FedProx proximal term all
+            # make (x - y_T)/(K*lr) a different quantity entirely
+            plain = (opt_type == "sgd" and
+                     not float(oc.get("momentum", 0.0) or 0.0) and
+                     not bool(oc.get("nesterov", False)) and
+                     not float(oc.get("weight_decay", 0.0) or 0.0))
+            if not plain:
+                raise ValueError(
+                    "strategy: scaffold requires a PLAIN sgd client "
+                    "optimizer (no momentum/nesterov/weight_decay), got "
+                    f"{dict(oc)!r}")
+            if float(cc.get("fedprox_mu", 0.0) or 0.0) > 0.0:
+                raise ValueError(
+                    "strategy: scaffold does not compose with fedprox_mu "
+                    "— the proximal term would be absorbed into the "
+                    "controls")
+            if cc.get("max_grad_norm") is not None:
+                raise ValueError(
+                    "strategy: scaffold does not compose with "
+                    "client_config.max_grad_norm — per-step clipping "
+                    "breaks pg = lr * sum(corrected grads), so the "
+                    "controls would absorb the clipping residual")
+            if cc.get("freeze_layer") or cc.get("updatable_layers"):
+                raise ValueError(
+                    "strategy: scaffold does not compose with layer "
+                    "freezing — zeroed payload entries would desync the "
+                    "controls from the steps actually taken")
+            if cc.get("quant_thresh") is not None or \
+                    config.model_config.get("quant_threshold") is not None:
+                raise ValueError(
+                    "strategy: scaffold does not compose with gradient "
+                    "quantization — the control update would absorb the "
+                    "quantization error; drop quant_thresh or use "
+                    "fedavg/dga")
+
+    def update_controls(self, store: ControlStore, client_ids,
+                        steps_per_client, pgs_flat: np.ndarray,
+                        client_lr: float, total_clients: int,
+                        weights=None) -> None:
+        """Option-II control update after a round (host-side, exact).
+
+        ``pgs_flat``: ``[K, n_params]`` per-client pseudo-gradients
+        ``x - y_T``; ``steps_per_client``: real (non-padding) local steps
+        ``K_i`` each client took.  ``weights`` (the aggregation weights,
+        when given) gate the update: clients excluded from aggregation —
+        privacy-dropped (``wt=0``, ``core/client.py:479-504`` semantics)
+        or empty — must not leak their update into the controls either.
+        """
+        delta_sum = np.zeros_like(store.c)
+        for row, cid in enumerate(client_ids):
+            cid = int(cid)
+            if cid < 0:
+                continue
+            if weights is not None and float(weights[row]) <= 0.0:
+                continue
+            k_i = max(float(steps_per_client[row]), 1.0)
+            ci_old = store.ci(cid)
+            ci_new = ci_old - store.c + pgs_flat[row] / (k_i * client_lr)
+            delta_sum += ci_new - ci_old
+            store.set_ci(cid, ci_new)
+        store.set_c(store.c + delta_sum / max(float(total_clients), 1.0))

@@ -1,0 +1,196 @@
+"""SCAFFOLD control-variate strategy (strategies/scaffold.py).
+
+Net-new vs the reference (SURVEY §2.5 lists FedAvg/FedProx/DGA/FedLabels).
+Pins: (1) exact FedAvg equivalence on the first round (zero controls →
+zero offsets → identical pseudo-gradients and server step), (2) the
+option-II control invariant c == mean_i(c_i) after a full-participation
+round, (3) convergence advantage under label-skew heterogeneity with
+multiple local epochs — the regime SCAFFOLD exists for, and (4) control
+persistence across server restarts.
+"""
+
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+
+
+def _cfg(strategy, rounds, *, clients_per_round=4, epochs=2, lr=0.3):
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": strategy,
+        "server_config": {
+            "max_iteration": rounds,
+            "num_clients_per_iteration": clients_per_round,
+            "initial_lr_client": lr,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": int(rounds), "initial_val": False,
+            "best_model_criterion": "acc",
+            "data_config": {"val": {"batch_size": 16}}},
+        "client_config": {
+            "num_epochs": epochs,
+            "optimizer_config": {"type": "sgd", "lr": lr},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _iid_dataset(num_users=8, n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(8, 4))
+    users, per_user = [], []
+    for u in range(num_users):
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.argmax(x @ w_true, axis=-1).astype(np.int32)
+        users.append(f"u{u}")
+        per_user.append({"x": x, "y": y})
+    return ArraysDataset(users, per_user)
+
+
+def _skewed_dataset(num_users=12, n=24, seed=0):
+    """Label-skew heterogeneity: each client holds samples of only TWO of
+    the four classes — the client-drift regime of arXiv:1910.06378 §5."""
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(8, 4))
+    users, per_user = [], []
+    for u in range(num_users):
+        keep = {u % 4, (u + 1) % 4}
+        xs, ys = [], []
+        while len(ys) < n:
+            x = rng.normal(size=(8,)).astype(np.float32)
+            y = int(np.argmax(x @ w_true))
+            if y in keep:
+                xs.append(x)
+                ys.append(y)
+        users.append(f"u{u}")
+        per_user.append({"x": np.stack(xs),
+                         "y": np.asarray(ys, np.int32)})
+    return ArraysDataset(users, per_user)
+
+
+def _train(strategy, dataset, rounds, tmp, seed=0, **cfg_kw):
+    cfg = _cfg(strategy, rounds, **cfg_kw)
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, dataset, val_dataset=dataset,
+                                model_dir=tmp, seed=seed)
+    state = server.train()
+    return server, state
+
+
+def test_first_round_matches_fedavg():
+    ds = _iid_dataset()
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        _, s_state = _train("scaffold", ds, 1, t1, seed=3)
+        _, f_state = _train("fedavg", ds, 1, t2, seed=3)
+    for a, b in zip(jax.tree.leaves(s_state.params),
+                    jax.tree.leaves(f_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_control_invariant_full_participation():
+    ds = _iid_dataset(num_users=6)
+    with tempfile.TemporaryDirectory() as tmp:
+        server, _ = _train("scaffold", ds, 1, tmp, clients_per_round=6)
+        store = server.scaffold_store
+        assert len(store._ci) == 6
+        mean_ci = np.mean([store.ci(i) for i in range(6)], axis=0)
+        np.testing.assert_allclose(store.c, mean_ci, rtol=1e-5, atol=1e-7)
+        assert np.linalg.norm(store.c) > 0
+
+
+def test_scaffold_beats_fedavg_under_heterogeneity():
+    ds = _skewed_dataset()
+    rounds, kw = 12, dict(clients_per_round=4, epochs=4, lr=0.4)
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        s_server, _ = _train("scaffold", ds, rounds, t1, **kw)
+        f_server, _ = _train("fedavg", ds, rounds, t2, **kw)
+        acc_s = s_server.best_val["acc"].value
+        acc_f = f_server.best_val["acc"].value
+    # drift-corrected training must be competitive AND converge well;
+    # equality would indicate the offsets are not being applied
+    assert acc_s >= acc_f - 0.02, (acc_s, acc_f)
+    assert acc_s > 0.8, acc_s
+
+
+def test_offsets_change_training_after_round_one():
+    """From round 2 on, nonzero controls must steer the trajectory: scaffold
+    and fedavg params must DIVERGE (a wiring regression that drops the
+    grad offsets would keep them identical and silently degrade SCAFFOLD
+    to FedAvg — round-1 equivalence alone cannot catch that)."""
+    ds = _skewed_dataset(num_users=8)
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        _, s_state = _train("scaffold", ds, 3, t1, seed=5, epochs=3)
+        _, f_state = _train("fedavg", ds, 3, t2, seed=5, epochs=3)
+    diff = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+               for a, b in zip(jax.tree.leaves(s_state.params),
+                               jax.tree.leaves(f_state.params)))
+    assert diff > 1e-4, f"params identical ({diff=}): offsets not applied"
+
+
+def test_controls_persist_across_restart():
+    """Controls reload ONLY together with a checkpoint resume: params and
+    controls belong to the same trajectory.  A fresh run in a reused model
+    dir must start from zero controls (and wipe the stale files), or round
+    1 would apply a dead run's drift corrections to new random params."""
+    ds = _iid_dataset(num_users=6)
+    with tempfile.TemporaryDirectory() as tmp:
+        server, _ = _train("scaffold", ds, 2, tmp, clients_per_round=6)
+        c_before = server.scaffold_store.c.copy()
+        ci_before = server.scaffold_store.ci(0).copy()
+        assert np.linalg.norm(c_before) > 0
+
+        # resume: controls come back with the checkpointed params
+        cfg = _cfg("scaffold", 2, clients_per_round=6)
+        cfg.server_config["resume_from_checkpoint"] = True
+        task = make_task(cfg.model_config)
+        resumed = OptimizationServer(task, cfg, ds, model_dir=tmp, seed=1)
+        assert resumed.state.round == 2
+        np.testing.assert_allclose(resumed.scaffold_store.c, c_before)
+        np.testing.assert_allclose(resumed.scaffold_store.ci(0), ci_before)
+
+        # fresh run, same dir: zero controls, stale files gone
+        cfg2 = _cfg("scaffold", 2, clients_per_round=6)
+        task2 = make_task(cfg2.model_config)
+        fresh = OptimizationServer(task2, cfg2, ds, model_dir=tmp, seed=1)
+        assert np.linalg.norm(fresh.scaffold_store.c) == 0
+        assert np.linalg.norm(fresh.scaffold_store.ci(0)) == 0
+
+
+def test_scaffold_rejects_local_dp():
+    cfg = _cfg("scaffold", 1)
+    cfg_raw = {"eps": 1.0, "max_grad": 1.0, "enable_local_dp": True}
+    from msrflute_tpu.config import DPConfig
+    cfg.dp_config = DPConfig.from_dict(cfg_raw)
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        with pytest.raises(ValueError):
+            OptimizationServer(task, cfg, _iid_dataset(), model_dir=tmp)
+
+
+def test_scaffold_schema_accepted():
+    from msrflute_tpu.schema import SchemaError, validate
+    base = {"model_config": {"model_type": "LR"}, "strategy": "scaffold",
+            "server_config": {"optimizer_config": {"type": "sgd"}},
+            "client_config": {"optimizer_config": {"type": "sgd"}}}
+    validate(base)  # accepted
+    with pytest.raises(SchemaError):
+        validate(dict(base, strategy="scaffolding"))
+
+
+def test_scaffold_rejects_rl():
+    cfg = _cfg("scaffold", 1)
+    cfg.server_config["wantRL"] = True
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        with pytest.raises(ValueError):
+            OptimizationServer(task, cfg, _iid_dataset(), model_dir=tmp)

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Serialized TPU measurement queue.  The chip sits behind a single-client
+# tunnel that WEDGES if a claiming process is killed — so: one job at a
+# time, no kill timeouts, wait for recovery by polling with a real matmul.
+cd /root/repo
+log() { echo "[tpu_queue $(date +%H:%M:%S)] $*"; }
+
+log "waiting for chip..."
+tries=0
+until python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+jax.block_until_ready(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+EOF
+do
+  tries=$((tries+1)); log "probe $tries failed; sleeping 120s"; sleep 120
+done
+log "chip up"
+
+log "1/5 flash on-chip validation"
+python tools/validate_flash_tpu.py > tpu_flash_validation.log 2>&1
+log "rc=$?"
+
+log "2/5 pallas kernel tests on chip"
+python -m pytest tests/test_pallas_kernels.py tests/test_pallas_attention.py \
+  -q -p no:cacheprovider --noconftest > tpu_pallas_tests.log 2>&1
+log "rc=$?"
+
+log "3/5 longctx bench"
+BENCH_PROTOCOLS=longctx_ringlm python bench.py > bench_longctx.json 2> bench_longctx.err
+log "rc=$?"
+
+log "4/5 profile cnn_femnist"
+python tools/profile_round.py --protocol cnn_femnist --chunks 3 \
+  > profile_cnn.json 2> profile_cnn.err
+log "rc=$?"
+
+log "5/5 scale probe"
+BENCH_SCALE_PROBE=1 BENCH_PROTOCOLS=cnn_femnist python bench.py \
+  > bench_scale.json 2> bench_scale.err
+log "rc=$?"
+log "queue done"
