@@ -155,7 +155,12 @@ def _flute_config(model_cfg, batch_size, client_lr, fuse, eval_bs=128):
         },
         "client_config": {
             "optimizer_config": {"type": "sgd", "lr": client_lr},
-            "data_config": {"train": {"batch_size": batch_size}},
+            # device-resident pool: upload samples to HBM once, ship only
+            # [K,S,B] int32 indices per chunk (bit-identical training,
+            # tests/test_device_pool.py) — on a remote-attached chip the
+            # per-chunk feature-bytes transfer otherwise rides the tunnel
+            "data_config": {"train": {"batch_size": batch_size,
+                                      "device_resident": True}},
         },
     })
 

@@ -1,6 +1,7 @@
 from .user_blob import load_user_blob, UserBlob  # noqa: F401
 from .dataset import BaseDataset, ArraysDataset  # noqa: F401
 from .batching import (  # noqa: F401
-    RoundBatch, pack_round_batches, pack_eval_batches, steps_for,
+    IndexRoundBatch, RoundBatch, build_sample_pool, pack_eval_batches,
+    pack_round_batches, pack_round_indices, steps_for,
 )
 from .samplers import BatchSampler, DynamicBatchSampler  # noqa: F401

@@ -124,6 +124,106 @@ def pack_round_batches(
     return RoundBatch(arrays, sample_mask, num_samples, client_mask, client_ids)
 
 
+@dataclass
+class IndexRoundBatch:
+    """One round's client data as POOL INDICES instead of gathered rows
+    (the device-resident dataset mode).
+
+    ``indices``: ``[K, S, B]`` int32 rows into the flat sample pool built
+    by :func:`build_sample_pool` (0 for padding slots — masked anyway).
+    The mask/count fields match :class:`RoundBatch`; there is deliberately
+    NO ``arrays`` field — feature rows exist only on-device, and the one
+    consumer is ``RoundEngine._stage_arrays`` (pool mode).
+    """
+
+    indices: np.ndarray
+    sample_mask: np.ndarray
+    num_samples: np.ndarray
+    client_mask: np.ndarray
+    client_ids: np.ndarray
+
+    @property
+    def shape(self):
+        return self.sample_mask.shape
+
+
+def build_sample_pool(dataset: BaseDataset):
+    """Concatenate every user's samples into flat per-key arrays.
+
+    Returns ``(pool, offsets)``: ``pool[k]`` is ``[total_samples, *feat]``
+    in user order (dtype preserved — uint8 pixels stay uint8 so the
+    one-time upload is as small as the dataset), ``offsets`` is ``[N+1]``
+    int64 with user ``i``'s rows at ``offsets[i]:offsets[i+1]``.
+
+    This is the TPU-native dataloader endgame: upload the pool to HBM
+    ONCE, then each round ships only ``[K, S, B]`` int32 indices and the
+    round program gathers on-device — no per-round host packing of
+    feature bytes, no per-round host->device feature transfer (which
+    rides a network tunnel on remote-attached chips).  Requires the
+    dataset to fit in host memory to build and in HBM to use; the
+    federated benchmarks (SURVEY §2.8) all fit with room to spare.
+    """
+    spec = dataset.element_spec
+    n_users = len(dataset)
+    counts = [int(dataset.num_samples[i]) for i in range(n_users)]
+    offsets = np.zeros((n_users + 1,), np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    total = int(offsets[-1])
+    first = dataset.user_arrays(0)
+    pool = {k: np.empty((total,) + shape, dtype=np.asarray(first[k]).dtype)
+            for k, shape in spec.items()}
+    for i in range(n_users):
+        user = dataset.user_arrays(i)
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        for k in pool:
+            pool[k][lo:hi] = np.asarray(user[k])
+    return pool, offsets
+
+
+def pack_round_indices(
+    dataset: BaseDataset,
+    offsets: np.ndarray,
+    client_indices: Sequence[int],
+    batch_size: int,
+    max_steps: int,
+    rng: Optional[np.random.Generator] = None,
+    shuffle: bool = True,
+    pad_clients_to: Optional[int] = None,
+    desired_max_samples: Optional[int] = None,
+) -> IndexRoundBatch:
+    """:func:`pack_round_batches` with the row gather deferred to the
+    device: identical sampling/shuffle/cap/mask semantics (same rng
+    consumption, so a pool-mode round is bit-comparable to a host-packed
+    one), but the output is ``[K, S, B]`` int32 indices into the
+    :func:`build_sample_pool` flat pool instead of gathered feature rows.
+    """
+    rng = rng or np.random.default_rng(0)
+    K = len(client_indices)
+    K_pad = max(pad_clients_to or K, K)
+    S, B = max_steps, batch_size
+
+    indices = np.zeros((K_pad, S, B), dtype=np.int32)
+    sample_mask = np.zeros((K_pad, S, B), dtype=np.float32)
+    num_samples = np.zeros((K_pad,), dtype=np.float32)
+    client_mask = np.zeros((K_pad,), dtype=np.float32)
+    client_ids = np.full((K_pad,), -1, dtype=np.int32)
+
+    cap = S * B if desired_max_samples is None else min(S * B,
+                                                        desired_max_samples)
+    for j, ci in enumerate(client_indices):
+        n = int(dataset.num_samples[ci])
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        take = order[:cap]
+        t = len(take)
+        indices[j].reshape(-1)[:t] = offsets[ci] + take
+        sample_mask[j].reshape(-1)[:t] = 1.0
+        num_samples[j] = t
+        client_mask[j] = 1.0
+        client_ids[j] = ci
+    return IndexRoundBatch(indices, sample_mask, num_samples, client_mask,
+                           client_ids)
+
+
 def pack_eval_batches(
     dataset: BaseDataset,
     batch_size: int,

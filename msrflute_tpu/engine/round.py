@@ -105,6 +105,9 @@ class RoundEngine:
 
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
+        #: device-resident sample pool (build_sample_pool); when set, round
+        #: inputs are [K,S,B] indices and the gather runs in-program
+        self._pool = None
         # partition mode: explicit shard_map collectives (default), or
         # GSPMD sharding propagation (required for a model axis > 1)
         mesh_cfg = config.mesh_config or {}
@@ -136,6 +139,21 @@ class RoundEngine:
         )
 
     # ------------------------------------------------------------------
+    def attach_pool(self, pool_arrays: Dict[str, np.ndarray]) -> None:
+        """Upload the flat sample pool (``build_sample_pool``) to every
+        device ONCE and switch the round program to device-resident mode:
+        per-round inputs shrink from gathered feature rows to ``[K,S,B]``
+        int32 indices, and the row gather becomes part of the compiled
+        program.  The dataloading analogue of keeping params resident —
+        the reference re-ships client data from host per round
+        (``core/client.py:101-124``); on a remote-attached chip that
+        transfer dominates small-model rounds."""
+        self._pool = {k: jax.device_put(np.asarray(v), self._replicated)
+                      for k, v in pool_arrays.items()}
+        self._multi_cache = {}
+        self._round_step = self._build_round_step()
+
+    # ------------------------------------------------------------------
     def _build_round_step(self) -> Callable:
         strategy = self.strategy
         client_update = self.client_update
@@ -143,10 +161,17 @@ class RoundEngine:
         mesh = self.mesh
         cspec = P(CLIENTS_AXIS)
         rspec = P()
+        pool_mode = self._pool is not None
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
-                       leakage_threshold, quant_threshold, rng):
+                       leakage_threshold, quant_threshold, rng, pool=None):
+            if pool is not None:
+                # device-resident mode: 'arrays' carries pool indices;
+                # gather the feature rows in-program (one XLA gather per
+                # key, HBM-local — no host bytes moved)
+                idx = arrays["__idx__"]
+                arrays = {k: pool[k][idx] for k in pool}
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
@@ -231,7 +256,8 @@ class RoundEngine:
             sharded_collect = shard_map(
                 shard_body, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
-                          rspec, rspec, rspec, rspec),
+                          rspec, rspec, rspec, rspec) +
+                         ((rspec,) if pool_mode else ()),
                 out_specs=(rspec, cspec), check_vma=False)
         else:
             # GSPMD mode: plain jit — client data stays sharded on the
@@ -242,14 +268,15 @@ class RoundEngine:
 
         def round_step(params, opt_state, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, server_lr,
-                       round_idx, leakage_threshold, quant_threshold, rng):
+                       round_idx, leakage_threshold, quant_threshold, rng,
+                       *pool_args):
             # strategies may move the broadcast point off the canonical
             # params (e.g. FedAC's momentum-like md point); default identity
             bcast = strategy.broadcast_params(params, strategy_state)
             collected, privacy_per_client = sharded_collect(
                 bcast, strategy_state, arrays, sample_mask, client_mask,
                 client_ids, client_lr, round_idx, leakage_threshold,
-                quant_threshold, rng)
+                quant_threshold, rng, *pool_args)
             part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:
@@ -315,12 +342,14 @@ class RoundEngine:
 
         def multi(params, opt_state, strategy_state, arrays, sample_mask,
                   client_mask, client_ids, client_lrs, server_lrs,
-                  round_idxs, leakage_threshold, quant_thresholds, rngs):
+                  round_idxs, leakage_threshold, quant_thresholds, rngs,
+                  *pool_args):
             def body(carry, xs):
                 p, o, s = carry
                 arr, sm, cm, cid, clr, slr, ridx, qt, rng = xs
                 p, o, s, stats = core(p, o, s, arr, sm, cm, cid, clr, slr,
-                                      ridx, leakage_threshold, qt, rng)
+                                      ridx, leakage_threshold, qt, rng,
+                                      *pool_args)
                 return (p, o, s), stats
 
             (p, o, s), stats = jax.lax.scan(
@@ -447,8 +476,7 @@ class RoundEngine:
                   quant_threshold: Optional[float] = None
                   ) -> Tuple[ServerState, Dict[str, float]]:
         """Stage one round's data onto the mesh and execute the program."""
-        arrays = {k: jax.device_put(v, self._client_sharding)
-                  for k, v in batch.arrays.items()}
+        arrays, pool_args = self._stage_arrays([batch], self._client_sharding)
         sample_mask = jax.device_put(batch.sample_mask, self._client_sharding)
         client_mask = jax.device_put(batch.client_mask, self._client_sharding)
         client_ids = jax.device_put(batch.client_ids, self._client_sharding)
@@ -462,10 +490,37 @@ class RoundEngine:
             jnp.asarray(leakage_threshold if leakage_threshold is not None
                         else jnp.inf, jnp.float32),
             jnp.asarray(quant_threshold if quant_threshold is not None
-                        else -1.0, jnp.float32), rng)
+                        else -1.0, jnp.float32), rng, *pool_args)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
         return new_state, stats
+
+    # ------------------------------------------------------------------
+    def _stage_arrays(self, batches: list, sharding):
+        """Device-stage the data inputs of one round (``[batch]``) or a
+        fused chunk (stacked on a leading round axis).
+
+        Host-packed ``RoundBatch``es stage their gathered feature arrays;
+        ``IndexRoundBatch``es stage only the int32 index grid and ride the
+        resident pool (``attach_pool``) as a trailing program operand.
+        """
+        from ..data.batching import IndexRoundBatch
+        is_idx = isinstance(batches[0], IndexRoundBatch)
+        if is_idx != (self._pool is not None):
+            raise ValueError(
+                "round engine pool mode mismatch: "
+                f"batch={'indices' if is_idx else 'arrays'} but pool "
+                f"{'attached' if self._pool is not None else 'absent'}")
+
+        def stack(pick):
+            vals = [pick(b) for b in batches]
+            return vals[0] if len(vals) == 1 else np.stack(vals)
+
+        if is_idx:
+            idx = stack(lambda b: b.indices)
+            return {"__idx__": jax.device_put(idx, sharding)}, (self._pool,)
+        return {k: jax.device_put(stack(lambda b: b.arrays[k]), sharding)
+                for k in batches[0].arrays}, ()
 
     # ------------------------------------------------------------------
     def run_rounds(self, state: ServerState, batches: list,
@@ -488,9 +543,7 @@ class RoundEngine:
             return new_state, {k: np.asarray([v]) for k, v in
                                jax.device_get(stats).items()}
         stacked_sharding = NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
-        arrays = {k: jax.device_put(
-            np.stack([b.arrays[k] for b in batches]), stacked_sharding)
-            for k in batches[0].arrays}
+        arrays, pool_args = self._stage_arrays(batches, stacked_sharding)
         sample_mask = jax.device_put(
             np.stack([b.sample_mask for b in batches]), stacked_sharding)
         client_mask = jax.device_put(
@@ -509,7 +562,7 @@ class RoundEngine:
             jnp.asarray(leakage_threshold if leakage_threshold is not None
                         else jnp.inf, jnp.float32),
             jnp.asarray(quant_thresholds if quant_thresholds is not None
-                        else [-1.0] * R, jnp.float32), rngs)
+                        else [-1.0] * R, jnp.float32), rngs, *pool_args)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
         return new_state, jax.device_get(stats)

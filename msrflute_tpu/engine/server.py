@@ -124,6 +124,26 @@ class OptimizationServer:
         # most log2(max_steps) distinct programs.
         self.step_bucketing = bool(cc.get("step_bucketing", True))
 
+        # device-resident dataset (data_config.train.device_resident): the
+        # whole sample pool lives in HBM; rounds ship [K,S,B] int32 indices
+        # and the row gather runs inside the compiled round program.
+        # Requires the dataset to fit in memory (build_sample_pool).
+        self._pool_offsets = None
+        if bool(cc.data_config.train.get("device_resident", False)):
+            if self.rl is not None or getattr(self.strategy, "host_rounds",
+                                              False):
+                # RL / SCAFFOLD rounds go through the host payload path,
+                # which never consults the pool — uploading the dataset to
+                # HBM would cost memory for zero benefit, silently
+                raise ValueError(
+                    "data_config.train.device_resident does not apply to "
+                    "host-orchestrated rounds (wantRL / strategy: "
+                    "scaffold) — drop the flag for this configuration")
+            from ..data.batching import build_sample_pool
+            pool_np, self._pool_offsets = build_sample_pool(train_dataset)
+            self.engine.attach_pool(pool_np)
+            del pool_np
+
         # server replay training (reference core/server.py:429-442): after
         # aggregation, train on server-held data for a few iterations
         self.server_replay = None
@@ -279,6 +299,14 @@ class OptimizationServer:
             pad_to = pad_to_mesh(max(len(s) for s in chunk_samples),
                                  self.mesh)
             steps = self._chunk_steps(chunk_samples)
+            if self._pool_offsets is not None:
+                from ..data.batching import pack_round_indices
+                return [pack_round_indices(
+                    self.train_dataset, self._pool_offsets, sampled,
+                    self.batch_size, steps, rng=self._np_rng,
+                    pad_clients_to=pad_to,
+                    desired_max_samples=self.desired_max_samples)
+                    for sampled in chunk_samples]
             return [pack_round_batches(
                 self.train_dataset, sampled, self.batch_size, steps,
                 rng=self._np_rng, pad_clients_to=pad_to,

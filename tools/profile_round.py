@@ -84,13 +84,24 @@ def main(argv=None) -> None:
             cfg.server_config.num_clients_per_iteration)))
         bs = int(cfg.client_config.data_config.train["batch_size"])
         pad_to = pad_to_mesh(len(sampled), mesh)
+        pool_mode = server._pool_offsets is not None
         tic = time.time()
         for _ in range(5):
-            pack_round_batches(dataset, sampled, bs, server.max_steps,
-                               rng=np.random.default_rng(0),
-                               pad_clients_to=pad_to)
+            if pool_mode:
+                # device-resident pool: the server packs int32 indices,
+                # not feature rows — measure what it actually pays
+                from msrflute_tpu.data import pack_round_indices
+                pack_round_indices(dataset, server._pool_offsets, sampled,
+                                   bs, server.max_steps,
+                                   rng=np.random.default_rng(0),
+                                   pad_clients_to=pad_to)
+            else:
+                pack_round_batches(dataset, sampled, bs, server.max_steps,
+                                   rng=np.random.default_rng(0),
+                                   pad_clients_to=pad_to)
         pack_secs = (time.time() - tic) / 5
         out["pack_secs_per_round"] = round(pack_secs, 5)
+        out["device_resident_pool"] = pool_mode
 
         # ---- optional trace chunk: profiler instrumentation inflates
         # wall time, so it is NOT counted into the steady-state stats ----
