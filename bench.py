@@ -242,10 +242,10 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
             jax.block_until_ready(server.state.params)
             per_chunk.append((time.time() - tic) / fuse)
 
-        # ---- eval cost (pure jitted eval; no checkpoint I/O) ----
-        ndev = mesh.shape[CLIENTS_AXIS]
-        bs = int(cfg.server_config.data_config.val.get("batch_size", 128))
-        batches = pack_eval_batches(val_ds, bs, pad_steps_to_multiple_of=ndev)
+        # ---- eval cost (pure jitted eval; no checkpoint I/O).  Batches
+        # are pre-staged on device like the server's per-split cache, so
+        # the steady-state number excludes the one-time transfer ----
+        batches = server._packed_eval_batches("val")
         evaluate(task, server._eval_fn, server.state.params, batches, mesh,
                  server.engine.partition_mode)  # compile
         tic = time.time()
