@@ -64,10 +64,42 @@ print("TPU_PROBE_OK", flush=True)
 """
 
 
+def _probe_once(probe_timeout: float):
+    """One subprocess chip probe.  Returns ``(ok, reason)``; the child is
+    never SIGKILLed (a killed TPU claim wedges the single-client tunnel)."""
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CODE],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            out, err = proc.communicate(timeout=probe_timeout)
+            if proc.returncode == 0 and "TPU_PROBE_OK" in (out or ""):
+                return True, "probe matmul OK"
+            tail = (err or "").strip().splitlines()[-1:]
+            return False, (f"probe exited rc={proc.returncode}: "
+                           f"{tail[0] if tail else 'no stderr'}")[:300]
+        except subprocess.TimeoutExpired:
+            # graceful SIGTERM only: SIGKILL on a TPU-claiming process
+            # wedges the single-client tunnel for everyone after us
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass  # abandon it; this attempt is over either way
+            return False, (f"probe hung >{probe_timeout:.0f}s "
+                           "(TPU tunnel init wedged)")
+    except Exception as exc:
+        return False, f"probe failed to launch: {exc!r}"
+
+
 def select_backend(probe_timeout: float = 180.0):
     """Return ``(backend, reason)``: ``"tpu"`` if the chip answers a real
-    matmul within the timeout, else configure this process for CPU.  The
-    reason string records WHY a fallback happened, so a recorded CPU run is
+    matmul, else configure this process for CPU.  The tunnel wedges for long
+    stretches and then recovers, so a single failed probe must not surrender
+    the round's perf number to a CPU fallback: we keep re-probing inside a
+    wait budget (``BENCH_TPU_WAIT_SECS``, default 35 min — tpu_queue.sh
+    discipline: sleep-retry, never kill a claiming process).  The reason
+    string records WHY a fallback happened, so a recorded CPU run is
     attributable (wedged tunnel vs override vs fast failure).
 
     Must be called before anything initializes a jax backend in this process.
@@ -77,30 +109,26 @@ def select_backend(probe_timeout: float = 180.0):
     if want in ("tpu", "cpu"):
         backend, reason = want, f"BENCH_BACKEND={want} override"
     else:
-        try:
-            proc = subprocess.Popen(
-                [sys.executable, "-c", _PROBE_CODE],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-            try:
-                out, err = proc.communicate(timeout=probe_timeout)
-                if proc.returncode == 0 and "TPU_PROBE_OK" in (out or ""):
-                    backend, reason = "tpu", "probe matmul OK"
-                else:
-                    tail = (err or "").strip().splitlines()[-1:]
-                    reason = (f"probe exited rc={proc.returncode}: "
-                              f"{tail[0] if tail else 'no stderr'}")[:300]
-            except subprocess.TimeoutExpired:
-                # graceful SIGTERM only: SIGKILL on a TPU-claiming process
-                # wedges the single-client tunnel for everyone after us
-                reason = (f"probe hung >{probe_timeout:.0f}s "
-                          "(TPU tunnel init wedged)")
-                proc.terminate()
-                try:
-                    proc.wait(timeout=15)
-                except subprocess.TimeoutExpired:
-                    pass  # abandon it; we are going to CPU anyway
-        except Exception as exc:
-            reason = f"probe failed to launch: {exc!r}"
+        budget = float(os.environ.get("BENCH_TPU_WAIT_SECS", 35 * 60))
+        deadline = time.time() + budget
+        attempt = 0
+        while True:
+            attempt += 1
+            ok, reason = _probe_once(probe_timeout)
+            if ok:
+                backend = "tpu"
+                if attempt > 1:
+                    reason += f" (after {attempt} probes)"
+                break
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                reason = (f"chip unavailable after {attempt} probes over "
+                          f"{budget:.0f}s budget; last: {reason}")
+                break
+            print(f"[bench] probe {attempt} failed ({reason}); "
+                  f"{remaining:.0f}s of wait budget left, retrying in 60s",
+                  file=sys.stderr, flush=True)
+            time.sleep(min(60.0, remaining))
     if backend != "tpu":
         backend = "cpu"
         from msrflute_tpu.utils.backend import force_cpu_backend
@@ -460,13 +488,23 @@ def main() -> None:
         extras["scale_probe"] = scale_probe(backend)
 
     head = extras.get(HEADLINE, {})
-    print(json.dumps({
+    line = {
         "metric": f"{HEADLINE}_secs_per_round",
         "value": head.get("secs_per_round"),
         "unit": "s/round",
         "vs_baseline": head.get("vs_baseline"),
         "extras": extras,
-    }))
+    }
+    if on_tpu:
+        # raw on-chip evidence is a committed artifact, not prose: every
+        # successful TPU run leaves a timestamped JSON in the repo root
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"BENCH_TPU_{stamp}.json")
+        with open(path, "w") as fh:
+            json.dump(dict(line, captured_at=stamp), fh, indent=1)
+        print(f"[bench] raw on-chip artifact: {path}", file=sys.stderr)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -58,6 +58,15 @@ def main() -> None:
     cfg.output_path = args.outputPath
     cfg.validate(cfg.data_path)
 
+    # applied-defaults report (reference core/config.py:771-779 prints the
+    # diff between the user YAML and the config with defaults filled in)
+    from msrflute_tpu.schema import applied_defaults
+    defaults = {k: v for k, v in applied_defaults(raw, cfg).items()
+                if k not in ("task", "data_path", "output_path")}  # CLI-assigned
+    if defaults:
+        print_rank("config defaults applied: "
+                   + ", ".join(f"{k}={v!r}" for k, v in sorted(defaults.items())))
+
     # persistent XLA compilation cache (server_config.compilation_cache_dir):
     # repeat runs of the same protocol skip the tens-of-seconds first
     # compile — worth it on TPU, harmless elsewhere

@@ -1,17 +1,34 @@
 """Config schema validation for msrflute_tpu.
 
-Parity target: reference ``core/schema.py`` (a cerberus schema dict loaded
-with ``eval`` at ``core/config.py:766-769``).  We validate the same
-constraints with a small hand-rolled checker: required sections, allowed
-enum values (optimizer types per ``core/schema.py:90``, annealing types per
-``utils/utils.py:151-186``, strategies per ``core/strategies/__init__.py:9-23``)
-and defaults.  Raises :class:`SchemaError` with every violation collected,
-like cerberus reports all errors at once.
+Parity target: reference ``core/schema.py`` (a 299-line cerberus schema dict
+loaded with ``eval`` at ``core/config.py:766-769``).  We validate the same
+classes of constraint with a small hand-rolled checker:
+
+- required sections and keys;
+- enum values (optimizer types per ``core/schema.py:90``, annealing types
+  per ``utils/utils.py:151-186``, strategies per
+  ``core/strategies/__init__.py:9-23``);
+- **unknown-key detection**: cerberus rejects keys outside the schema; we do
+  the same for every structured section, with a did-you-mean suggestion, so
+  a typo'd ``initial_lr_clients:`` fails loudly instead of silently falling
+  back to the default.  Free-form surfaces (``model_config`` plugin params,
+  ``semisupervision``, ``augment``, ``mesh_config``) stay open by design.
+- an **applied-defaults report** (:func:`applied_defaults`) mirroring the
+  reference's printout of the diff between the user config and the config
+  with defaults applied (``core/config.py:771-779``).
+
+Raises :class:`SchemaError` with every violation collected, like cerberus
+reports all errors at once.  ``strict=False`` (or env
+``MSRFLUTE_ALLOW_UNKNOWN=1``) downgrades unknown-key errors to warnings for
+forward-compat with configs written for newer versions.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import difflib
+import os
+import warnings
+from typing import Any, Dict, Iterable, List, Optional
 
 ALLOWED_OPTIMIZERS = [
     # reference core/schema.py:90
@@ -38,6 +55,104 @@ ALLOWED_SERVER_TYPES = [
     "optimization", "model_optimization", "personalization",
 ]
 
+# ----------------------------------------------------------------------
+# known keys per structured section.  Sources: the dataclass fields in
+# config.py plus every documented TPU-native extension key the engine
+# consumes (grep ``.get("<key>")`` over msrflute_tpu/).
+# ----------------------------------------------------------------------
+OPTIMIZER_KEYS = {
+    "type", "lr", "momentum", "nesterov", "weight_decay", "amsgrad", "eps",
+    "betas", "dampening",
+}
+
+ANNEALING_KEYS = {
+    "type", "step_interval", "step_size", "gamma", "milestones", "patience",
+    "factor", "peak_lr", "floor_lr", "rampup_steps", "hold_steps",
+    "decay_steps",
+}
+
+DATASET_KEYS = {
+    # reference per-split blocks
+    "batch_size", "loader_type", "list_of_train_data", "test_data",
+    "val_data", "train_data", "train_data_server", "vocab_dict",
+    "pin_memory", "num_workers", "prefetch_factor", "desired_max_samples",
+    "max_batch_size", "max_num_words", "max_seq_length",
+    "min_words_per_utt", "num_frames", "max_samples_per_user",
+    "max_grad_norm", "utterance_mvn", "unsorted_batch",
+    # TPU-native extensions
+    "device_resident", "lazy", "lazy_cache_users", "augment", "wantLogits",
+    "step_bucketing",
+}
+
+DATACONFIG_KEYS = {"train", "val", "test", "num_clients"}
+
+DP_KEYS = {
+    "enable_local_dp", "enable_global_dp", "eps", "delta", "max_grad",
+    "max_weight", "min_weight", "weight_scaler", "global_sigma",
+    # reference extras (extensions/privacy/__init__.py)
+    "enable_prod", "max_bound", "min_bound",
+    # TPU-native: quantile-tracking adaptive clipping (arXiv:1905.03871)
+    "adaptive_clipping",
+}
+
+ADAPTIVE_CLIP_KEYS = {
+    "target_quantile", "clip_lr", "initial_clip", "count_sigma",
+}
+
+PRIVACY_METRICS_KEYS = {
+    "apply_metrics", "apply_indices_extraction", "allowed_word_rank",
+    "apply_leakage_metric", "max_leakage", "max_allowed_leakage",
+    "adaptive_leakage_threshold", "is_leakage_weighted",
+    "attacker_optimizer_config", "max_allowed_overlap",
+}
+
+SERVER_REPLAY_KEYS = {"server_iterations", "optimizer_config", "data_config"}
+
+RL_KEYS = {
+    "marginal_update_RL", "RL_path", "RL_path_global", "model_descriptor_RL",
+    "network_params", "initial_epsilon", "final_epsilon", "epsilon_gamma",
+    "max_replay_memory_size", "minibatch_size", "gamma", "optimizer_config",
+    "annealing_config", "wantLSTM", "runningAvg_param", "resume_from_checkpoint",
+}
+
+SERVER_KEYS = {
+    "type", "max_iteration", "num_clients_per_iteration", "initial_lr_client",
+    "lr_decay_factor", "val_freq", "rec_freq", "initial_val", "initial_rec",
+    "best_model_criterion", "fall_back_to_best_model", "model_backup_freq",
+    "resume_from_checkpoint", "send_dicts", "max_grad_norm", "do_profiling",
+    "wantRL", "aggregate_median", "softmax_beta", "initial_lr",
+    "weight_train_loss", "stale_prob", "num_skip_decoding", "data_config",
+    "optimizer_config", "annealing_config", "server_replay_config", "RL",
+    "nbest_task_scheduler", "best_model_metric",
+    # TPU-native extensions
+    "rounds_per_step", "checkpoint_backend", "compilation_cache_dir",
+    "dump_norm_stats",
+    "semisupervision", "updatable_names",
+    "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
+}
+
+CLIENT_KEYS = {
+    "type", "meta_learning", "copying_train_data", "do_profiling",
+    "ignore_subtask", "num_skip_decoding", "desired_max_samples",
+    "max_grad_norm", "freeze_layer", "data_config", "optimizer_config",
+    "annealing_config", "fedprox_mu", "convex_model_interp",
+    "meta_optimizer_config", "ss_config",
+    # TPU-native extensions
+    "num_epochs", "step_bucketing", "quant_thresh", "quant_threshold",
+    "quant_bits", "quant_approx", "quant_anneal", "updatable_layers",
+    "semisupervision",
+}
+
+TOP_KEYS = {
+    "model_config", "dp_config", "privacy_metrics_config", "strategy",
+    "server_config", "client_config", "mesh_config", "task", "data_path",
+    "output_path", "experiment",
+}
+
+# sections whose contents are free-form by design (plugin surfaces)
+_FREEFORM = "model_config", "semisupervision", "augment", "mesh_config", \
+    "nbest_task_scheduler", "ss_config", "experiment"
+
 
 class SchemaError(ValueError):
     def __init__(self, errors: List[str]):
@@ -52,29 +167,65 @@ def _check_enum(errors: List[str], raw: Dict[str, Any], path: str, key: str,
         errors.append(f"{path}.{key}: {val!r} not in {allowed}")
 
 
-def _check_optimizer(errors: List[str], raw: Any, path: str) -> None:
+def _check_unknown(errors: List[str], raw: Any, path: str,
+                   known: Iterable[str]) -> None:
+    """Flag keys outside ``known`` with a did-you-mean suggestion (the
+    cerberus ``unknown field`` behavior, reference ``core/schema.py``)."""
+    if not isinstance(raw, dict):
+        return
+    known = set(known)
+    for key in raw:
+        if key in known or key in _FREEFORM:
+            continue
+        hint = difflib.get_close_matches(str(key), known, n=1, cutoff=0.6)
+        suggest = f" (did you mean {hint[0]!r}?)" if hint else ""
+        errors.append(f"{path}.{key}: unknown key{suggest}")
+
+
+def _check_optimizer(errors: List[str], raw: Any, path: str,
+                     unknown: Optional[List[str]] = None) -> None:
     if not isinstance(raw, dict):
         return
     _check_enum(errors, raw, path, "type", ALLOWED_OPTIMIZERS)
+    _check_unknown(unknown if unknown is not None else errors, raw, path,
+                   OPTIMIZER_KEYS)
     lr = raw.get("lr")
     if lr is not None and not isinstance(lr, (int, float)):
         errors.append(f"{path}.lr: must be a number, got {type(lr).__name__}")
 
 
-def _check_annealing(errors: List[str], raw: Any, path: str) -> None:
+def _check_annealing(errors: List[str], raw: Any, path: str,
+                     unknown: Optional[List[str]] = None) -> None:
     if not isinstance(raw, dict):
         return
     _check_enum(errors, raw, path, "type", ALLOWED_ANNEALING)
+    _check_unknown(unknown if unknown is not None else errors, raw, path,
+                   ANNEALING_KEYS)
 
 
-def validate(raw: Dict[str, Any]) -> None:
+def _check_data_config(errors: List[str], raw: Any, path: str) -> None:
+    if not isinstance(raw, dict):
+        return
+    _check_unknown(errors, raw, path, DATACONFIG_KEYS)
+    for split in ("train", "val", "test"):
+        blk = raw.get(split)
+        if isinstance(blk, dict):
+            _check_unknown(errors, blk, f"{path}.{split}", DATASET_KEYS)
+
+
+def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
     """Validate a raw (YAML-loaded) config dict in place.
 
     Required sections follow reference ``core/schema.py``: ``model_config``
     and ``server_config`` are required; everything else optional with
-    defaults supplied by the dataclass tree.
+    defaults supplied by the dataclass tree.  Unknown keys in structured
+    sections are errors (``strict=True``, the default) or warnings
+    (``strict=False`` / env ``MSRFLUTE_ALLOW_UNKNOWN=1``).
     """
+    if strict is None:
+        strict = not os.environ.get("MSRFLUTE_ALLOW_UNKNOWN")
     errors: List[str] = []
+    unknown: List[str] = []
 
     if "model_config" not in raw:
         errors.append("model_config: required section missing")
@@ -90,11 +241,25 @@ def validate(raw: Dict[str, Any]) -> None:
     if strategy is not None and strategy not in ALLOWED_STRATEGIES:
         errors.append(f"strategy: {strategy!r} not in {ALLOWED_STRATEGIES}")
 
+    _check_unknown(unknown, raw, "config", TOP_KEYS)
+
     sc = raw.get("server_config")
     if isinstance(sc, dict):
         _check_enum(errors, sc, "server_config", "type", ALLOWED_SERVER_TYPES)
-        _check_optimizer(errors, sc.get("optimizer_config"), "server_config.optimizer_config")
-        _check_annealing(errors, sc.get("annealing_config"), "server_config.annealing_config")
+        _check_unknown(unknown, sc, "server_config", SERVER_KEYS)
+        _check_optimizer(errors, sc.get("optimizer_config"), "server_config.optimizer_config", unknown)
+        _check_annealing(errors, sc.get("annealing_config"), "server_config.annealing_config", unknown)
+        _check_data_config(unknown, sc.get("data_config"), "server_config.data_config")
+        replay = sc.get("server_replay_config")
+        if isinstance(replay, dict):
+            _check_unknown(unknown, replay, "server_config.server_replay_config",
+                           SERVER_REPLAY_KEYS)
+            _check_optimizer(errors, replay.get("optimizer_config"),
+                             "server_config.server_replay_config.optimizer_config",
+                             unknown)
+        rl = sc.get("RL")
+        if isinstance(rl, dict):
+            _check_unknown(unknown, rl, "server_config.RL", RL_KEYS)
         ncpi = sc.get("num_clients_per_iteration")
         if ncpi is not None and not isinstance(ncpi, int):
             if not (isinstance(ncpi, str) and ":" in ncpi):
@@ -107,17 +272,66 @@ def validate(raw: Dict[str, Any]) -> None:
 
     cc = raw.get("client_config")
     if isinstance(cc, dict):
-        _check_optimizer(errors, cc.get("optimizer_config"), "client_config.optimizer_config")
+        _check_unknown(unknown, cc, "client_config", CLIENT_KEYS)
+        _check_optimizer(errors, cc.get("optimizer_config"), "client_config.optimizer_config", unknown)
         if cc.get("annealing_config") is not None:
-            _check_annealing(errors, cc.get("annealing_config"), "client_config.annealing_config")
+            _check_annealing(errors, cc.get("annealing_config"), "client_config.annealing_config", unknown)
+        _check_data_config(unknown, cc.get("data_config"), "client_config.data_config")
 
     dp = raw.get("dp_config")
     if isinstance(dp, dict):
+        _check_unknown(unknown, dp, "dp_config", DP_KEYS)
+        ac = dp.get("adaptive_clipping")
+        if isinstance(ac, dict):
+            _check_unknown(unknown, ac, "dp_config.adaptive_clipping",
+                           ADAPTIVE_CLIP_KEYS)
         for key in ("eps", "delta", "max_grad", "max_weight", "min_weight",
                     "weight_scaler", "global_sigma"):
             val = dp.get(key)
             if val is not None and not isinstance(val, (int, float)):
                 errors.append(f"dp_config.{key}: must be a number")
 
+    pm = raw.get("privacy_metrics_config")
+    if isinstance(pm, dict):
+        _check_unknown(unknown, pm, "privacy_metrics_config",
+                       PRIVACY_METRICS_KEYS)
+        _check_optimizer(errors, pm.get("attacker_optimizer_config"),
+                         "privacy_metrics_config.attacker_optimizer_config",
+                         unknown)
+
+    if unknown:
+        if strict:
+            errors.extend(unknown)
+        else:
+            warnings.warn("config has unknown keys (MSRFLUTE_ALLOW_UNKNOWN "
+                          "set; would be errors otherwise):\n  "
+                          + "\n  ".join(unknown), stacklevel=2)
     if errors:
         raise SchemaError(errors)
+
+
+# ----------------------------------------------------------------------
+# applied-defaults report (reference core/config.py:771-779 prints the
+# diff between the user YAML and the config with defaults applied)
+# ----------------------------------------------------------------------
+def applied_defaults(raw: Dict[str, Any], cfg: Any,
+                     _path: str = "") -> Dict[str, Any]:
+    """Return ``{dotted.path: default}`` for every structured field the user
+    did NOT set, i.e. the defaults the framework filled in.  ``cfg`` is the
+    built dataclass tree; ``raw`` the original YAML dict."""
+    import dataclasses
+
+    out: Dict[str, Any] = {}
+    if not dataclasses.is_dataclass(cfg):
+        return out
+    raw = raw if isinstance(raw, dict) else {}
+    for f in dataclasses.fields(cfg):
+        if f.name == "extra":
+            continue
+        val = getattr(cfg, f.name)
+        path = f"{_path}.{f.name}" if _path else f.name
+        if dataclasses.is_dataclass(val):
+            out.update(applied_defaults(raw.get(f.name), val, path))
+        elif f.name not in raw and val is not None:
+            out[path] = val
+    return out

@@ -62,3 +62,46 @@ def test_to_dict_roundtrip():
     cfg2 = FLUTEConfig.from_dict(d)
     assert cfg2.server_config.max_iteration == cfg.server_config.max_iteration
     assert cfg2.model_config["num_classes"] == 4
+
+
+def test_schema_rejects_unknown_key_with_suggestion():
+    # VERDICT round 2: a typo'd ``initial_lr_clients`` must fail loudly
+    # instead of silently falling back to the 0.01 default
+    bad = {**MINI, "server_config": {**MINI["server_config"],
+                                     "initial_lr_clients": 0.5}}
+    with pytest.raises(SchemaError, match=r"initial_lr_clients.*did you mean"):
+        FLUTEConfig.from_dict(bad)
+
+
+def test_schema_unknown_key_nested_dataset_block():
+    bad = {**MINI, "client_config": {
+        "optimizer_config": {"type": "sgd", "lr": 0.1},
+        "data_config": {"train": {"batch_sizes": 4}},
+    }}
+    with pytest.raises(SchemaError, match="batch_sizes"):
+        FLUTEConfig.from_dict(bad)
+
+
+def test_schema_allow_unknown_downgrades_to_warning(monkeypatch):
+    monkeypatch.setenv("MSRFLUTE_ALLOW_UNKNOWN", "1")
+    bad = {**MINI, "server_config": {**MINI["server_config"],
+                                     "initial_lr_clients": 0.5}}
+    with pytest.warns(UserWarning, match="initial_lr_clients"):
+        FLUTEConfig.from_dict(bad)
+
+
+def test_schema_freeform_sections_stay_open():
+    ok = {**MINI, "model_config": {"model_type": "LR", "num_classes": 4,
+                                   "input_dim": 8, "whatever_plugin_param": 1},
+          "mesh_config": {"axis_names": ["clients"], "custom": True}}
+    FLUTEConfig.from_dict(ok)  # must not raise
+
+
+def test_applied_defaults_report():
+    from msrflute_tpu.schema import applied_defaults
+    cfg = FLUTEConfig.from_dict(MINI)
+    rep = applied_defaults(MINI, cfg)
+    # user never set rec_freq / lr_decay_factor -> reported with defaults
+    assert "server_config.rec_freq" in rep
+    # user DID set max_iteration -> not reported
+    assert "server_config.max_iteration" not in rep
