@@ -169,9 +169,18 @@ class RoundEngine:
             if pool is not None:
                 # device-resident mode: 'arrays' carries pool indices;
                 # gather the feature rows in-program (one XLA gather per
-                # key, HBM-local — no host bytes moved)
+                # key, HBM-local — no host bytes moved).  Padding slots
+                # index row 0, so zero the gathered rows with the sample
+                # mask: padding then holds zeros exactly like host packing
+                # (pool-vs-host bit-identity by construction, not by every
+                # task loss masking perfectly — tests/test_device_pool.py)
                 idx = arrays["__idx__"]
-                arrays = {k: pool[k][idx] for k in pool}
+                m = sample_mask
+                arrays = {
+                    k: pool[k][idx]
+                    * m.reshape(m.shape + (1,) * (pool[k].ndim - 1)
+                                ).astype(pool[k].dtype)
+                    for k in pool}
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).

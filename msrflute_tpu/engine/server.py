@@ -516,6 +516,19 @@ class OptimizationServer:
 
         self.ckpt.save_latest(self.state)
         self.ckpt.backup(self.state, round_no, best_names=tuple(self.best_val))
+        if self.scaffold_store is not None:
+            # commit the control-round marker only once the paired model
+            # checkpoint is DURABLE (async orbax saves land out of band):
+            # clean restarts then keep accumulated controls; a crash inside
+            # the round window leaves the -1 sentinel and resets safely.
+            # The wait() (no-op on msgpack) deliberately serializes orbax's
+            # async save for SCAFFOLD runs: committing the marker lazily
+            # against the previous durable slot would let the control files
+            # run one round ahead of the marker — the silent controls/params
+            # mismatch this marker exists to prevent — and scaffold rounds
+            # are host-transfer-bound anyway
+            self.ckpt.wait()
+            self.scaffold_store.set_round(int(self.state.round))
         self.ckpt.update_status({
             "i": round_no,
             "weight": self.lr_weight,
@@ -588,10 +601,10 @@ class OptimizationServer:
         # (the fused path does this on its own stats)
         self._process_privacy_stats(jax.device_get(stats), round_no,
                                     client_mask=batch.client_mask)
-        # marker pairing the fully-written controls with the
-        # round-(round_no+1) model checkpoint; resume resets the controls
-        # if the marker disagrees (or is the -1 mid-update sentinel)
-        self.scaffold_store.set_round(round_no + 1)
+        # the -1 sentinel stays in place until _round_housekeeping commits
+        # the marker AFTER the paired model checkpoint is durable — resume
+        # keeps the controls whenever a matching checkpoint exists and
+        # resets only on a crash inside the round window
         tls_np = np.asarray(jax.device_get(tls))
         n_real = max(float((batch.client_ids >= 0).sum()), 1.0)
         log_metric("Training loss",

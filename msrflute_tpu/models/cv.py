@@ -23,37 +23,47 @@ from .base import (BaseTask, Batch, masked_mean, parse_dtype, softmax_xent,
 
 
 class _LRModule(nn.Module):
+    """Logistic regression (reference ``experiments/cv_lr_mnist/model.py:12-21``,
+    the FedML ``LogisticRegression``).  ``sigmoid_output=True`` reproduces the
+    reference's quirk of passing sigmoid activations (not raw logits) into
+    cross-entropy — needed for trajectory-exact cross-framework parity."""
+
     num_classes: int = 10
     input_dim: int = 784
     dtype: Any = jnp.float32
+    sigmoid_output: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         x = to_float_image(x, self.dtype).reshape((x.shape[0], -1))
-        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        out = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        if self.sigmoid_output:
+            out = jax.nn.sigmoid(out)
+        return out
 
 
 class _CNNFEMNISTModule(nn.Module):
-    """2 conv + 2 fc (reference ``experiments/cv_cnn_femnist/model.py``):
-    conv5x5x32 -> pool -> conv5x5x64 -> pool -> fc2048 -> fc62."""
+    """The FEMNIST benchmark CNN (reference
+    ``experiments/cv_cnn_femnist/model.py:12-82``, FedML ``CNN_DropOut``
+    recommended by "Adaptive Federated Optimization", arXiv:2003.00295):
+    conv3x3x32 VALID -> relu -> conv3x3x64 VALID -> relu -> maxpool2 ->
+    dropout(.25) -> flatten(9216) -> fc128 -> relu -> dropout(.5) -> fc62."""
 
     num_classes: int = 62
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         if x.ndim == 3:
             x = x[..., None]
         x = to_float_image(x, self.dtype)
-        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
-        x = nn.relu(x)
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(2048, dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
@@ -65,7 +75,7 @@ class _CIFARCNNModule(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         x = to_float_image(x, self.dtype)
         x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -93,10 +103,16 @@ class ClassificationTask(BaseTask):
         dummy = jnp.zeros((1,) + self.example_shape, dtype=jnp.float32)
         return self.module.init(rng, dummy)["params"]
 
-    def apply(self, params, x):
+    def apply(self, params, x, rng: Optional[jax.Array] = None,
+              train: bool = False):
         # logits upcast: with a bfloat16 compute dtype the matmuls run on
-        # the MXU in bf16, but softmax/xent/metric math stays float32
-        return self.module.apply({"params": params}, x).astype(jnp.float32)
+        # the MXU in bf16, but softmax/xent/metric math stays float32.
+        # Dropout needs an rng stream: train mode without one degrades to
+        # deterministic application instead of crashing at trace time.
+        train = bool(train) and rng is not None
+        rngs = {"dropout": rng} if train else None
+        return self.module.apply({"params": params}, x, train,
+                                 rngs=rngs).astype(jnp.float32)
 
     def predict(self, params, batch: Batch):
         """Concatenatable eval outputs (the reference's
@@ -110,7 +126,7 @@ class ClassificationTask(BaseTask):
 
     def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
              train: bool = True):
-        logits = self.apply(params, batch["x"])
+        logits = self.apply(params, batch["x"], rng=rng, train=train)
         labels = batch["y"].astype(jnp.int32)
         per_sample = softmax_xent(logits, labels)
         mask = batch["sample_mask"]
@@ -207,7 +223,9 @@ def make_lr_task(model_config) -> ClassificationTask:
     input_dim = int(model_config.get("input_dim", 784))
     return ClassificationTask(
         _LRModule(num_classes=num_classes, input_dim=input_dim,
-                  dtype=parse_dtype(model_config)),
+                  dtype=parse_dtype(model_config),
+                  sigmoid_output=bool(model_config.get("sigmoid_output",
+                                                       False))),
         example_shape=(input_dim,), name="cv_lr_mnist", num_classes=num_classes)
 
 

@@ -32,7 +32,7 @@ class _ConvNormPool(nn.Module):
     kernel: int = 5
 
     @nn.compact
-    def __call__(self, x):  # x: [B, L, C]
+    def __call__(self, x, train: bool = False):  # x: [B, L, C]
         pad = self.kernel - 1
         conv1 = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(x)
         y = nn.GroupNorm(num_groups=8)(conv1)
@@ -56,7 +56,7 @@ class _ECGNet(nn.Module):
     kernel: int = 5
 
     @nn.compact
-    def __call__(self, x):  # x: [B, L] or [B, L, 1]
+    def __call__(self, x, train: bool = False):  # x: [B, L] or [B, L, 1]
         if x.ndim == 2:
             x = x[..., None]
         x = x.astype(jnp.float32)

@@ -43,7 +43,7 @@ class _BasicBlock(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         residual = x
         y = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride),
                     padding=1, use_bias=False, kernel_init=_he_init,
@@ -75,7 +75,7 @@ class _ResNetGN(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         x = to_float_image(x, self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
                     kernel_init=_he_init, dtype=self.dtype)(x)

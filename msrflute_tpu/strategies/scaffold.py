@@ -81,12 +81,14 @@ class ControlStore:
         os.replace(tmp, path)
 
     #: with a disk store, keep at most this many client controls in RAM
-    #: (insertion-ordered dict, FIFO eviction) — the disk copy is the
-    #: durable one, so eviction is free; without a store_dir everything
-    #: must stay resident (there is nowhere to spill to)
+    #: (insertion-ordered dict, LRU eviction: reads and writes re-insert
+    #: the key at the tail) — the disk copy is the durable one, so
+    #: eviction is free; without a store_dir everything must stay resident
+    #: (there is nowhere to spill to)
     CACHE_LIMIT = 1024
 
     def _cache(self, cid: int, vec: np.ndarray) -> None:
+        self._ci.pop(cid, None)  # refresh position: hot clients stay cached
         self._ci[cid] = vec
         if self.store_dir is not None:
             while len(self._ci) > self.CACHE_LIMIT:
@@ -95,7 +97,9 @@ class ControlStore:
     def ci(self, client_id: int) -> np.ndarray:
         cid = int(client_id)
         if cid in self._ci:
-            return self._ci[cid]
+            vec = self._ci.pop(cid)  # LRU refresh on read
+            self._ci[cid] = vec
+            return vec
         if self.store_dir is not None:
             path = self._path(cid)
             if os.path.exists(path):
