@@ -1,0 +1,33 @@
+"""Cross-framework parity: the ACTUAL reference (torch, /root/reference)
+vs msrflute_tpu on identical blobs + identical init (VERDICT r2 item 3).
+
+The full 20-round artifact is PARITY.json (tools/parity/run_parity.py);
+this test runs the deterministic LR protocol for 3 rounds so the claim
+stays continuously verified.  Skips when the reference mount is absent.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference mount not available")
+def test_lr_trajectory_exact(tmp_path):
+    out = tmp_path / "parity.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parity",
+                                      "run_parity.py"),
+         "--tasks", "lr", "--rounds", "3",
+         "--scratch", str(tmp_path / "scratch"), "--out", str(out)],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    res = json.loads(out.read_text())["lr"]
+    assert res["ok"], res["verdict"]
+    assert res["rounds_compared"] >= 3
+    assert res["max_abs_diff_val_loss"] < 1e-4
+    assert res["max_abs_diff_val_acc"] == 0.0

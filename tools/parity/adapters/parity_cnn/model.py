@@ -1,0 +1,4 @@
+"""Parity-harness adapter task: re-exports the REFERENCE CNN model class
+unchanged (``experiments/cv_cnn_femnist/model.py:82``) so the cross-framework
+comparison trains the reference's own torch code, not a copy."""
+from experiments.cv_cnn_femnist.model import CNN  # noqa: F401

@@ -1,0 +1,4 @@
+"""Parity-harness adapter task: re-exports the REFERENCE LR model class
+unchanged (``experiments/cv_lr_mnist/model.py:23``) so the cross-framework
+comparison trains the reference's own torch code, not a copy."""
+from experiments.cv_lr_mnist.model import LR  # noqa: F401

@@ -1,0 +1,26 @@
+"""Launcher for the reference e2e_trainer inside the parity scratch tree.
+
+The reference's ``get_exp_dataloader`` (``utils/dataloaders_utils.py:9-23``)
+swallows every import error behind a bare ``except`` and returns an unbound
+loader — any adapter problem then surfaces 3 frames later as an unrelated
+crash.  This launcher patches it to load the same path but let the real
+traceback propagate, then runs e2e_trainer unchanged.
+"""
+import os
+import sys
+from importlib.machinery import SourceFileLoader
+
+import utils.dataloaders_utils as du
+
+
+def _get_exp_dataloader(task):
+    path = os.path.join("experiments", task, "dataloaders", "dataloader.py")
+    return SourceFileLoader("DataLoader", path).load_module().DataLoader
+
+
+du.get_exp_dataloader = _get_exp_dataloader
+
+sys.argv = ["e2e_trainer.py"] + sys.argv[1:]
+import runpy  # noqa: E402
+
+runpy.run_path("e2e_trainer.py", run_name="__main__")

@@ -1,0 +1,3 @@
+# Offline stand-in for the azureml-sdk so the reference trainer can run in
+# this container (zero egress, no AzureML workspace).  Only the surface the
+# reference touches: azureml.core.Run.get_context() -> run.log(name, value).

@@ -1,0 +1,32 @@
+"""azureml.core shim: records run.log() calls to $REF_METRICS_OUT (jsonl)."""
+import json
+import os
+
+
+class _OfflineRun:
+    def __init__(self):
+        self._path = os.environ.get("REF_METRICS_OUT")
+        # AzureML-looking run id: e2e_trainer.py:221-222 derives the
+        # experiment dir name from its dash-separated tail
+        self.id = "OfflineRun-parity-harness-local-0000-0000"
+        self.input_datasets = {}
+
+    def log(self, name, value, **kw):
+        if self._path:
+            with open(self._path, "a") as fh:
+                fh.write(json.dumps({"name": str(name), "value": value}) + "\n")
+
+    def log_row(self, name, **kw):
+        self.log(name, kw)
+
+    def add_properties(self, props):
+        self.log("run_properties", props)
+
+    def __getattr__(self, item):  # tag/display_name/etc. -> no-op
+        return lambda *a, **k: None
+
+
+class Run:
+    @staticmethod
+    def get_context():
+        return _OfflineRun()

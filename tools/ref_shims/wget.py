@@ -1,0 +1,5 @@
+"""wget shim: zero-egress container; any download attempt must fail loudly."""
+
+
+def download(*a, **k):  # pragma: no cover - guard only
+    raise RuntimeError("wget shim: no network egress in this container")

@@ -1,0 +1,4 @@
+#!/bin/bash
+# Full on-chip bench: four protocols + bf16 + longctx + MFU.  Writes the
+# timestamped BENCH_TPU_*.json raw artifact itself (bench.py main).
+BENCH_TPU_WAIT_SECS=60 python bench.py > bench_tpu_full.json 2> bench_tpu_full.err

@@ -1,0 +1,2 @@
+#!/bin/bash
+python tools/validate_flash_tpu.py > tpu_flash_validation.log 2>&1

@@ -1,0 +1,3 @@
+#!/bin/bash
+python -m pytest tests/test_pallas_kernels.py tests/test_pallas_attention.py \
+  -q -p no:cacheprovider --noconftest > tpu_pallas_tests.log 2>&1

@@ -1,0 +1,3 @@
+#!/bin/bash
+python tools/profile_round.py --protocol cnn_femnist --chunks 3 \
+  > profile_cnn.json 2> profile_cnn.err

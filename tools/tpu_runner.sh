@@ -1,0 +1,28 @@
+#!/bin/bash
+# Serialized TPU job runner (round 3).  The chip sits behind a single-client
+# tunnel that WEDGES if a claiming process is killed — so: one job at a
+# time, no kill timeouts, poll with a real matmul until the chip answers.
+# Jobs are tools/tpu_jobs.d/NN-*.sh, run in sort order, each exactly once
+# (marker: <job>.done holding the exit code).  Append jobs while running.
+cd /root/repo
+log(){ echo "[tpu_runner $(date +%H:%M:%S)] $*" >> tpu_runner.log; }
+probe(){ python - <<'PYEOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+jax.block_until_ready(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+PYEOF
+}
+log "runner started (pid $$)"
+while true; do
+  job=""
+  for j in $(ls tools/tpu_jobs.d/*.sh 2>/dev/null | sort); do
+    [ -f "$j.done" ] || { job="$j"; break; }
+  done
+  if [ -z "$job" ]; then sleep 120; continue; fi
+  until probe; do log "chip down (probe failed); sleeping 180s"; sleep 180; done
+  log "chip up; running $job"
+  bash "$job" >> tpu_runner.log 2>&1
+  rc=$?
+  echo "$rc" > "$job.done"
+  log "job $job rc=$rc"
+done
