@@ -409,6 +409,68 @@ def bench_longctx(on_tpu: bool) -> dict:
     return out
 
 
+def bench_varlen_bucketing(on_tpu: bool) -> dict:
+    """Length-bucketing win on a variable-length token round (VERDICT r2
+    item 5): same LSTM client-update grid with the real-data length
+    distribution (GRU-Reddit-like: short sentences inside a max-L grid),
+    timed at full L vs the cropped power-of-two bucket
+    (``data.batching.seq_length_bucket``).  Math identical — the delta is
+    pure padding FLOPs/bandwidth."""
+    import jax
+
+    from msrflute_tpu.config import ModelConfig, OptimizerConfig
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.data.batching import (pack_round_batches,
+                                            seq_length_bucket)
+    from msrflute_tpu.engine.client_update import (ClientHParams,
+                                                   build_client_update)
+    from msrflute_tpu.models import make_task
+
+    L, real_max = (80, 22) if on_tpu else (32, 9)
+    K, S, B = (10, 8, 8) if on_tpu else (4, 2, 4)
+    rng = np.random.default_rng(0)
+    per_user = []
+    for _ in range(K):
+        x = np.zeros((S * B, L), np.int32)
+        for r in range(S * B):
+            n = rng.integers(4, real_max + 1)
+            x[r, :n] = rng.integers(1, 90, size=n)
+        per_user.append({"x": x})
+    ds = ArraysDataset([f"u{i}" for i in range(K)], per_user)
+    task = make_task(ModelConfig(model_type="LSTM",
+                                 extra={"vocab_size": 90, "seq_len": L}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    upd = jax.jit(jax.vmap(
+        build_client_update(task, OptimizerConfig.from_dict(
+            {"type": "sgd", "lr": 0.5}), ClientHParams()),
+        in_axes=(None, 0, 0, None, None)))
+
+    out = {}
+    for tag, crop in (("full_len", False), ("bucketed", True)):
+        batch = pack_round_batches(ds, list(range(K)), B, S,
+                                   rng=np.random.default_rng(0))
+        stats = seq_length_bucket([batch], task.seq_pad_keys) if crop \
+            else None
+        args = (params, {"x": batch.arrays["x"]}, batch.sample_mask,
+                np.float32(0.5), jax.random.PRNGKey(1))
+        jax.block_until_ready(upd(*args))  # compile
+        reps = 10 if on_tpu else 2
+        tic = time.time()
+        for _ in range(reps):
+            res = upd(*args)
+        jax.block_until_ready(res)
+        out[tag] = {"secs_per_round": round((time.time() - tic) / reps, 5),
+                    "grid_L": int(batch.arrays["x"].shape[-1])}
+        if stats:
+            out[tag]["pad_eff"] = round(
+                stats["tokens_real"] / max(stats["tokens_grid_after"], 1), 3)
+            out["pad_eff_full"] = round(
+                stats["tokens_real"] / max(stats["tokens_grid_before"], 1), 3)
+    out["speedup"] = round(out["full_len"]["secs_per_round"]
+                           / out["bucketed"]["secs_per_round"], 2)
+    return out
+
+
 def scale_probe(backend: str) -> dict:
     """K-clients-per-round scaling curve for the CNN protocol (the
     reference's "tens of thousands sampled" axis, ``README.md:9``): find
@@ -482,6 +544,14 @@ def main() -> None:
             extras["longctx_ringlm"] = bench_longctx(on_tpu)
         except Exception as exc:
             extras["longctx_ringlm"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+
+    if (on_tpu or os.environ.get("BENCH_VARLEN")) and \
+            (keep is None or "varlen_bucketing" in keep):
+        try:
+            extras["varlen_bucketing"] = bench_varlen_bucketing(on_tpu)
+        except Exception as exc:
+            extras["varlen_bucketing"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
 
     if os.environ.get("BENCH_SCALE_PROBE"):

@@ -267,3 +267,58 @@ def pack_eval_batches(
     batched["sample_mask"] = mask.reshape(T, B)
     batched["user_idx"] = user_idx.reshape(T, B)
     return batched
+
+
+def seq_length_bucket(batches: Sequence[RoundBatch],
+                      seq_keys: Sequence[str],
+                      min_len: int = 8) -> Optional[dict]:
+    """Crop token-sequence grids to the power-of-two bucket of the chunk's
+    real max length (the static-shape answer to the reference's
+    ``DynamicBatchSampler`` padding-efficiency packing,
+    ``utils/data_utils.py:42-119``).
+
+    ``seq_keys`` name 0-padded ``[K, S, B, L]`` int arrays (the task's
+    ``seq_pad_keys``).  All batches of a fused chunk are cropped to one
+    common bucket so the chunk still compiles as a single program; cropping
+    only removes all-zero tail columns, and the in-model position mask is
+    derived from the ids themselves, so the math is identical — XLA just
+    stops running matmuls over padding.  Buckets are powers of two (floored
+    at ``min_len``), so the number of distinct compiled programs stays
+    logarithmic in max L.
+
+    Returns a stats dict (tokens_real / tokens_grid_before/after, bucket,
+    ``cropped``) when the grids hold sequence keys, else None.
+    """
+    keys = [k for k in seq_keys if batches and k in batches[0].arrays]
+    if not keys:
+        return None
+    L = max(b.arrays[k].shape[-1] for b in batches for k in keys)
+    # max real length across the chunk: position of the last nonzero column
+    need = 1
+    tokens_real = 0
+    for b in batches:
+        for k in keys:
+            arr = b.arrays[k]
+            nz = arr.reshape(-1, arr.shape[-1]) != 0
+            tokens_real += int(nz.sum())
+            cols = nz.any(axis=0)
+            if cols.any():
+                need = max(need, int(np.max(np.nonzero(cols)[0])) + 1)
+    bucket = max(min_len, 1 << max(need - 1, 0).bit_length())
+    stats = {
+        "bucket": int(min(bucket, L)),
+        "full_len": int(L),
+        "tokens_real": int(tokens_real),
+        "tokens_grid_before": int(sum(
+            b.arrays[k].reshape(-1, b.arrays[k].shape[-1]).shape[0] * L
+            for b in batches for k in keys)),
+    }
+    stats["cropped"] = bucket < L
+    if bucket < L:
+        for b in batches:
+            for k in keys:
+                b.arrays[k] = np.ascontiguousarray(b.arrays[k][..., :bucket])
+    stats["tokens_grid_after"] = int(sum(
+        b.arrays[k].reshape(-1, b.arrays[k].shape[-1]).shape[0]
+        * b.arrays[k].shape[-1] for b in batches for k in keys))
+    return stats

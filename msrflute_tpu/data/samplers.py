@@ -8,9 +8,15 @@ Parity target: reference ``utils/data_utils.py``:
 - :class:`DynamicBatchSampler` (``data_utils.py:42-119``): duration-sorted,
   frames-budgeted batch packing with a padding-efficiency meter.
 
-In the TPU pipeline these order samples *before* the static-grid packing in
-:mod:`msrflute_tpu.data.batching` (sorted neighbors -> tighter grids); they
-are also usable standalone for host-side iteration.
+Status in the TPU pipeline: these are the host-side *iteration* parity API
+(plugin dataloaders that want the reference's sampler semantics).  The round
+engine itself does not consume them — its static ``[K, S, B, L]`` grids get
+the same padding-efficiency win from per-chunk bucketing instead: step
+bucketing (``engine/server.py::_chunk_steps``) sizes S to the chunk, and
+length bucketing (``data.batching.seq_length_bucket``) crops token grids to
+the chunk's real-length power-of-two bucket — the static-shape translation
+of :class:`DynamicBatchSampler`'s frames budget (measured in ``bench.py``
+``varlen_bucketing``).
 """
 
 from __future__ import annotations

@@ -123,6 +123,15 @@ class OptimizationServer:
         # and memory.  S rounds up to a power of two so jit retraces at
         # most log2(max_steps) distinct programs.
         self.step_bucketing = bool(cc.get("step_bucketing", True))
+        # per-chunk LENGTH bucketing (token tasks): crop the [K,S,B,L]
+        # grids' all-pad tail columns to a power-of-two bucket — the
+        # static-shape answer to the reference DynamicBatchSampler's
+        # padding-efficiency packing (utils/data_utils.py:42-119).  Math
+        # identical (position masks come from the ids); host-packed path
+        # only (the device pool stores full-length rows).
+        self.length_bucketing = bool(
+            cc.data_config.train.get("length_bucketing", True))
+        self._length_bucket_stats = None
 
         # device-resident dataset (data_config.train.device_resident): the
         # whole sample pool lives in HBM; rounds ship [K,S,B] int32 indices
@@ -307,11 +316,13 @@ class OptimizationServer:
                     pad_clients_to=pad_to,
                     desired_max_samples=self.desired_max_samples)
                     for sampled in chunk_samples]
-            return [pack_round_batches(
+            batches = [pack_round_batches(
                 self.train_dataset, sampled, self.batch_size, steps,
                 rng=self._np_rng, pad_clients_to=pad_to,
                 desired_max_samples=self.desired_max_samples)
                 for sampled in chunk_samples]
+            self._maybe_length_bucket(batches)
+            return batches
 
         # prefetch: with fused chunks, the NEXT chunk's host-side sampling
         # and packing happen right after this chunk's async dispatch, so the
@@ -420,6 +431,24 @@ class OptimizationServer:
         self.ckpt.wait()  # async checkpoint saves must be durable on return
         self._log_timing()
         return self.state
+
+    # ------------------------------------------------------------------
+    def _maybe_length_bucket(self, batches: list) -> None:
+        """Crop the chunk's token grids to their real-length bucket (see
+        ``data.batching.seq_length_bucket``); logs the padding-efficiency
+        ratio like the reference's DynamicBatchSampler meter."""
+        keys = getattr(self.task, "seq_pad_keys", ())
+        if not self.length_bucketing or not keys:
+            return
+        from ..data.batching import seq_length_bucket
+        stats = seq_length_bucket(batches, keys)
+        if stats is not None and stats["cropped"]:
+            self._length_bucket_stats = stats
+            print_rank(
+                f"length bucket L={stats['bucket']}/{stats['full_len']} "
+                f"pad-eff {stats['tokens_real'] / max(stats['tokens_grid_after'], 1):.3f}"
+                f" (was {stats['tokens_real'] / max(stats['tokens_grid_before'], 1):.3f})",
+                loglevel=logging.DEBUG)
 
     # ------------------------------------------------------------------
     def _chunk_steps(self, chunk_samples: list) -> int:
@@ -560,6 +589,7 @@ class OptimizationServer:
             self._chunk_steps([sampled]), rng=self._np_rng,
             pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
             desired_max_samples=self.desired_max_samples)
+        self._maybe_length_bucket([batch])
         self._rng, rng = jax.random.split(self._rng)
         return client_lr, server_lr, batch, rng
 

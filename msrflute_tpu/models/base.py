@@ -39,6 +39,10 @@ class BaseTask:
     """Abstract task: model + loss + metrics, all pure."""
 
     name: str = "base"
+    #: feature keys holding 0-padded ``[..., L]`` token sequences whose tail
+    #: padding may be cropped per round (``data.batching.seq_length_bucket``);
+    #: the model must derive its position mask from the ids, never from L
+    seq_pad_keys: Tuple[str, ...] = ()
 
     def init_params(self, rng: jax.Array) -> Params:
         raise NotImplementedError

@@ -108,6 +108,13 @@ class SequenceLMTask(BaseTask):
     masking).
     """
 
+    #: x/y/tok_mask are 0-padded ``[n, L]`` rows: the round packer may crop
+    #: their common all-pad tail (length bucketing).  tok_mask MUST be in
+    #: the set — its nonzeros mark real positions even where x holds the
+    #: unk id 0, so it both gets cropped in lockstep with x and keeps the
+    #: bucket from under-counting unk tokens.
+    seq_pad_keys = ("x", "y", "tok_mask")
+
     def __init__(self, module: nn.Module, seq_len: int, name: str,
                  oov_reject: bool = False):
         self.module = module

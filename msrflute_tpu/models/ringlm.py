@@ -127,6 +127,12 @@ class _RingLM(nn.Module):
     #: O(num_layers) fewer live activations, ~1/3 extra FLOPs.  The right
     #: altitude for remat: wrapping the whole loss would save nothing.
     remat: bool = False
+    #: allocation length for the learned positional table.  When set, the
+    #: table is allocated at this size and sliced to the input's L, so the
+    #: same params serve length-bucketed (cropped) grids — the
+    #: ``BaseTask.seq_pad_keys`` contract.  None keeps the legacy
+    #: input-sized allocation (then every apply must use one fixed L).
+    max_len: Optional[int] = None
     moe_experts: int = 0
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
@@ -135,10 +141,12 @@ class _RingLM(nn.Module):
     @nn.compact
     def __call__(self, x):  # [B, L] int32
         h = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(x)
-        # additive learned positions (static max length = whatever L is in)
+        # additive learned positions, allocated at max_len and sliced to
+        # the input length (length-bucketed grids apply with L < max_len;
+        # the param shape — and so every checkpoint — is unchanged)
         pos = self.param("pos", nn.initializers.normal(0.02),
-                         (x.shape[1], self.embed_dim))
-        h = h + pos.astype(self.dtype)[None]
+                         (self.max_len or x.shape[1], self.embed_dim))
+        h = h + pos[:x.shape[1]].astype(self.dtype)[None]
         block_cls = nn.remat(_Block) if self.remat else _Block
         for i in range(self.num_layers):
             # explicit names keep the param tree identical with remat on
@@ -183,6 +191,7 @@ def make_ringlm_task(model_config) -> RingLMTask:
         num_layers=int(model_config.get("num_layers", 2)),
         dtype=parse_dtype(model_config),
         remat=bool(model_config.get("remat", False)),
+        max_len=int(model_config.get("seq_len", 128)) - 1,
         moe_experts=int(model_config.get("moe_experts", 0) or 0),
         use_flash=bool(model_config.get("flash_attention", False)))
     return RingLMTask(module,

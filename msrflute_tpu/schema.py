@@ -81,7 +81,7 @@ DATASET_KEYS = {
     "max_grad_norm", "utterance_mvn", "unsorted_batch",
     # TPU-native extensions
     "device_resident", "lazy", "lazy_cache_users", "augment", "wantLogits",
-    "step_bucketing",
+    "step_bucketing", "length_bucketing",
 }
 
 DATACONFIG_KEYS = {"train", "val", "test", "num_clients"}
