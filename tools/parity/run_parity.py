@@ -348,7 +348,7 @@ def run_msrflute(cfg_path, data_dir, out_dir, task):
 TASKS = {
     # task: (shape, classes, users, samples/user, batch, client_lr, rounds)
     "lr": ((784,), 10, 16, 32, 64, 0.1),
-    "cnn": ((28, 28), 62, 8, 24, 32, 0.05),
+    "cnn": ((28, 28), 62, 8, 48, 64, 0.15),
 }
 
 
