@@ -58,6 +58,25 @@ def main() -> None:
     cfg.output_path = args.outputPath
     cfg.validate(cfg.data_path)
 
+    # plugin-folder resolution (reference loads experiments/<task>/ by the
+    # -task name, utils/dataloaders_utils.py:9-23): an explicit
+    # model_folder resolves against cwd, the config file's directory, then
+    # the repo root; without one, experiments/<task>/task.py is used when
+    # it exists, so `-task mytask` alone finds the plugin
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    folder = cfg.model_config.get("model_folder")
+    if folder:
+        for base in ("", os.path.dirname(os.path.abspath(args.config)),
+                     repo_root):
+            cand = os.path.join(base, folder) if base else folder
+            if os.path.isdir(cand):
+                cfg.model_config["model_folder"] = os.path.abspath(cand)
+                break
+    elif cfg.task:
+        cand = os.path.join(repo_root, "experiments", cfg.task)
+        if os.path.exists(os.path.join(cand, "task.py")):
+            cfg.model_config["model_folder"] = cand
+
     # applied-defaults report (reference core/config.py:771-779 prints the
     # diff between the user YAML and the config with defaults filled in)
     from msrflute_tpu.schema import applied_defaults

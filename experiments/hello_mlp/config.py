@@ -1,0 +1,13 @@
+"""Model-specific config defaults for hello_mlp (docs/scenarios.md step 3).
+
+The registry merges ``<model_type>Config.defaults`` into the model config
+for keys the YAML did not set (reference ``core/config.py:100-116``).
+"""
+
+
+class HELLOMLPConfig:
+    defaults = {
+        "input_dim": 16,
+        "num_classes": 3,
+        "hidden": 64,
+    }

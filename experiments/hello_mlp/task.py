@@ -1,0 +1,66 @@
+"""hello_mlp — the scenario-authoring example task (docs/scenarios.md).
+
+A plugin task folder needs exactly one hook: ``make_task(model_config)`` in
+``task.py`` (the TPU-native analogue of the reference's dynamically loaded
+``experiments/<task>/model.py`` + ``dataloaders/``, reference
+``doc/sphinx/scenarios.rst`` + ``experiments/__init__.py:8-43``).  Everything
+else — datasets, metrics — hangs off the returned BaseTask.
+"""
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from msrflute_tpu.models.cv import ClassificationTask
+from msrflute_tpu.utils.metrics import Metric
+
+
+class _MLP(nn.Module):
+    hidden: int = 64
+    num_classes: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class HelloMLPTask(ClassificationTask):
+    """ClassificationTask + one custom metric.
+
+    Custom metrics are sum-form device stats (``eval_stats``) finalized to
+    ``Metric(value, higher_is_better)`` host-side (``finalize_metrics``) —
+    the TPU translation of the reference's ``inference()`` returning
+    ``{"custom": {"value": v, "higher_is_better": True}}``
+    (``doc/sphinx/scenarios.rst`` "Implement new metrics").
+    """
+
+    def eval_stats(self, params, batch):
+        stats = super().eval_stats(params, batch)
+        logits = self.apply(params, batch["x"])
+        labels = batch["y"].astype(jnp.int32)
+        top2 = jnp.argsort(logits, axis=-1)[:, -2:]
+        hit = jnp.any(top2 == labels[:, None], axis=-1).astype(jnp.float32)
+        stats["top2_sum"] = jnp.sum(hit * batch["sample_mask"])
+        return stats
+
+    def finalize_metrics(self, sums):
+        metrics = super().finalize_metrics(sums)
+        if "top2_sum" in sums:
+            metrics["top2_acc"] = Metric(
+                float(sums["top2_sum"]) / max(float(sums["sample_count"]), 1.0),
+                higher_is_better=True)
+        return metrics
+
+
+def make_task(model_config) -> HelloMLPTask:
+    input_dim = int(model_config.get("input_dim", 16))
+    num_classes = int(model_config.get("num_classes", 3))
+    return HelloMLPTask(
+        _MLP(hidden=int(model_config.get("hidden", 64)),
+             num_classes=num_classes),
+        example_shape=(input_dim,),
+        name="hello_mlp",
+        num_classes=num_classes)

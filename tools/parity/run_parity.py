@@ -346,7 +346,7 @@ def run_msrflute(cfg_path, data_dir, out_dir, task):
 # orchestration
 # ----------------------------------------------------------------------
 TASKS = {
-    # task: (shape, classes, users, samples/user, batch, client_lr, rounds)
+    # task: (shape, classes, users, samples/user, batch, client_lr)
     "lr": ((784,), 10, 16, 32, 64, 0.1),
     "cnn": ((28, 28), 62, 8, 48, 64, 0.15),
 }
