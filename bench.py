@@ -296,13 +296,14 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
 
     secs_train = float(np.median(per_chunk))
     secs_per_round = secs_train + secs_eval / eval_every
-    baseline = BASELINES_SECS_PER_ROUND[name]
+    baseline = BASELINES_SECS_PER_ROUND.get(name)  # None: no published number
     out = {
         "secs_per_round": round(secs_per_round, 4),
         "secs_train_p50": round(float(np.percentile(per_chunk, 50)), 4),
         "secs_train_p90": round(float(np.percentile(per_chunk, 90)), 4),
         "secs_eval": round(secs_eval, 4),
-        "vs_baseline": round(baseline / secs_per_round, 2),
+        "vs_baseline": (round(baseline / secs_per_round, 2)
+                        if baseline is not None else None),
     }
     if mfu is not None:
         out["mfu_vs_bf16_peak"] = round(mfu, 5)
@@ -346,6 +347,29 @@ def build_protocols(on_tpu: bool, rng, with_bf16: bool = False) -> dict:
                                         32 if on_tpu else 8, 80, 90, rng),
             eval_every=50),
     }
+    # mlm_bert federated rounds (reference experiments/mlm_bert; the
+    # README publishes no wall-clock for it, so this entry records
+    # absolute s/round + MFU-relevant sizes rather than a vs_baseline).
+    # TPU: an 8-layer/512-hidden BERT, bf16, full 30522 vocab; CPU: tiny.
+    bert_model = ({"vocab_size": 30522, "hidden_size": 512,
+                   "num_hidden_layers": 8, "num_attention_heads": 8,
+                   "intermediate_size": 2048, "max_seq_length": 128,
+                   "mlm_probability": 0.15, "mask_token_id": 103,
+                   "dtype": "bfloat16"}
+                  if on_tpu else
+                  {"vocab_size": 120, "hidden_size": 32,
+                   "num_hidden_layers": 2, "num_attention_heads": 2,
+                   "intermediate_size": 64, "max_seq_length": 16,
+                   "mlm_probability": 0.15, "mask_token_id": 4})
+    bL, bV = bert_model["max_seq_length"], bert_model["vocab_size"]
+    protocols["mlm_bert"] = dict(
+        cfg=_flute_config({"model_type": "BERT",
+                           "BERT": {"model": bert_model,
+                                    "training": {"seed": 0}}},
+                          16 if on_tpu else 4, 5e-5, fuse, eval_bs=32),
+        data=lambda: _token_dataset(16 if on_tpu else 8,
+                                    32 if on_tpu else 8, bL, bV, rng),
+        eval_every=50)
     if with_bf16:
         # TPU-native extra: same CNN protocol with bf16 compute (MXU full
         # rate); baselined against the same published fp32 number
