@@ -24,9 +24,21 @@ mask, so no extra device outputs are needed.
 Scale note: controls cost one flat model vector per *participating*
 client.  With a ``store_dir`` (the server always sets one) the durable
 copy lives on disk (one ``.npy`` per client, crash-safe writes) and the
-in-RAM cache is FIFO-bounded at ``ControlStore.CACHE_LIMIT`` vectors, so
+in-RAM cache is LRU-bounded at ``ControlStore.CACHE_LIMIT`` vectors, so
 host memory stays flat for very large pools; the disk copies also make
 controls resume-safe.
+
+Transfer note (large models): each round ships a dense ``[K, n_params]``
+offset matrix to the device and pulls the per-client payload stack back —
+on a remote-attached chip these are the round's dominant transfers.  That
+is inherent to durable PER-CLIENT controls (``c_i`` update needs ``pg_i``
+on the host); at benchmark scale it is cheap.  For very large models the
+TPU-native endgame would keep the whole ``[N, n_params]`` control table
+in HBM (gather offsets in-program, scatter updates, fetch only the
+``[n_params]`` server aggregate) at the cost of device-memory residency
+and checkpoint-size — the same tradeoff as the device-resident dataset
+pool, and only worth it once a deployment actually hits the transfer
+wall.
 """
 
 from __future__ import annotations
