@@ -191,7 +191,8 @@ class OptimizationServer:
         self._np_rng = np.random.default_rng(seed)
         self._rng = jax.random.PRNGKey(seed)
         self.run_stats: Dict[str, list] = {
-            "secsPerRound": [], "secsPerRoundHousekeeping": []}
+            "secsPerRound": [], "secsPerRoundHousekeeping": [],
+            "hostToDeviceBytesPerRound": []}
 
         self.state = self.engine.init_state(self._rng)
         pretrained = config.model_config.get("pretrained_model_path")
@@ -366,6 +367,7 @@ class OptimizationServer:
             else:
                 batches = pack_chunk(R)
             prefetched = None
+            self._record_staged_bytes(batches, R)
 
             self._rng, chunk_rng = jax.random.split(self._rng)
             # flag-gated profiling (reference cProfile hooks, SURVEY §5.1)
@@ -431,6 +433,23 @@ class OptimizationServer:
         self.ckpt.wait()  # async checkpoint saves must be durable on return
         self._log_timing()
         return self.state
+
+    # ------------------------------------------------------------------
+    def _record_staged_bytes(self, batches: list, rounds: int) -> None:
+        """Host->device payload per round (the design's whole communication
+        story: pool mode ships int32 indices, host packing ships feature
+        bytes) — the TPU-native counterpart of the reference's per-client
+        ``communicationCosts`` timing (``core/server.py:317,353``);
+        reported by ``_log_timing``.  Called from the fused path AND the
+        host-orchestrated (RL/SCAFFOLD) rounds, which also ship a packed
+        batch."""
+        chunk_bytes = sum(
+            sum(a.nbytes for a in
+                (getattr(b, "arrays", None) or
+                 {"__idx__": b.indices}).values())
+            + b.sample_mask.nbytes for b in batches)
+        self.run_stats["hostToDeviceBytesPerRound"].append(
+            chunk_bytes / max(rounds, 1))
 
     # ------------------------------------------------------------------
     def _maybe_length_bucket(self, batches: list) -> None:
@@ -590,6 +609,7 @@ class OptimizationServer:
             pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
             desired_max_samples=self.desired_max_samples)
         self._maybe_length_bucket([batch])
+        self._record_staged_bytes([batch], 1)
         self._rng, rng = jax.random.split(self._rng)
         return client_lr, server_lr, batch, rng
 

@@ -51,9 +51,15 @@ ADAPTERS = os.path.join(REPO, "tools", "parity", "adapters")
 # ----------------------------------------------------------------------
 # synthetic blobs
 # ----------------------------------------------------------------------
-def gen_blob(rng, users, samples, shape, classes, sep=2.0):
-    """Class-structured gaussian data: learnable but not trivial."""
-    means = rng.normal(size=(classes,) + shape).astype(np.float32)
+def gen_blob(rng, users, samples, shape, classes, sep=2.0, means=None):
+    """Class-structured gaussian data: learnable but not trivial.
+
+    Pass the same ``means`` for train and val: a fresh draw per split
+    would make validation distributionally unrelated to training and pin
+    val accuracy at chance regardless of learning.
+    """
+    if means is None:
+        means = rng.normal(size=(classes,) + shape).astype(np.float32)
     out = {"users": [], "num_samples": [], "user_data": {},
            "user_data_label": {}}
     for u in range(users):
@@ -346,14 +352,19 @@ def run_msrflute(cfg_path, data_dir, out_dir, task):
 # orchestration
 # ----------------------------------------------------------------------
 TASKS = {
-    # task: (shape, classes, users, samples/user, batch, client_lr)
-    "lr": ((784,), 10, 16, 32, 64, 0.1),
-    "cnn": ((28, 28), 62, 8, 48, 64, 0.15),
+    # task: (shape, model classes, users, samples/user, batch, client_lr,
+    #        data classes)
+    # CNN: the reference model hardcodes 62 outputs (CNN_DropOut(False)),
+    # but the synthetic blob only uses the first 10 labels with wide
+    # separation — learnable at a dropout-gentle lr, so both trajectories
+    # visibly descend instead of hovering at chance or diverging.
+    "lr": ((784,), 10, 16, 32, 64, 0.1, 10),
+    "cnn": ((28, 28), 62, 8, 48, 64, 0.05, 10),
 }
 
 
 def run_task(task, rounds, scratch):
-    shape, classes, users, samples, batch, lr = TASKS[task]
+    shape, classes, users, samples, batch, lr, data_classes = TASKS[task]
     rng = np.random.default_rng(7)
     work = os.path.join(scratch, task)
     shutil.rmtree(work, ignore_errors=True)
@@ -362,8 +373,10 @@ def run_task(task, rounds, scratch):
     os.makedirs(data_ref)
     os.makedirs(data_tpu)
 
-    train = gen_blob(rng, users, samples, shape, classes)
-    val = gen_blob(rng, 4, 64, shape, classes)
+    means = rng.normal(size=(data_classes,) + shape).astype(np.float32)
+    train = gen_blob(rng, users, samples, shape, data_classes, sep=3.0,
+                     means=means)
+    val = gen_blob(rng, 4, 64, shape, data_classes, sep=3.0, means=means)
     # the reference __getitem__ transposes images; pre-swap its copy so both
     # frameworks train on identical tensors
     for blob, name in ((train, "train.json"), (val, "val.json")):
