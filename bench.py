@@ -557,7 +557,7 @@ def main() -> None:
                 name, spec["cfg"], spec["data"](), eval_users=8,
                 warmup_rounds=warmup, timed_chunks=chunks,
                 eval_every=spec["eval_every"],
-                want_mfu=(name == HEADLINE and on_tpu))
+                want_mfu=on_tpu)  # MFU on every protocol (judging input)
         except Exception as exc:  # one bad protocol must not kill the line
             extras[name] = {"error": f"{type(exc).__name__}: {exc}"}
 
