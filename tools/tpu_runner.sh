@@ -13,7 +13,17 @@ jax.block_until_ready(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
 PYEOF
 }
 log "runner started (pid $$)"
+# Single-client tunnel: near the round's end the DRIVER runs bench.py on
+# the chip; the runner must not be mid-job holding the claim then.  Stop
+# starting new jobs after this UTC hour (driver window); touch
+# tools/tpu_jobs.d/.no_deadline to disable.
+DEADLINE_H=${TPU_RUNNER_DEADLINE_H:-7}
 while true; do
+  if [ ! -f tools/tpu_jobs.d/.no_deadline ] && \
+     [ "$(date -u +%H)" -ge "$DEADLINE_H" ] && [ "$(date -u +%H)" -lt 12 ]; then
+    log "driver bench window (>= 0${DEADLINE_H}:00 UTC); not starting new jobs"
+    sleep 300; continue
+  fi
   job=""
   for j in $(ls tools/tpu_jobs.d/*.sh 2>/dev/null | sort); do
     [ -f "$j.done" ] || { job="$j"; break; }
