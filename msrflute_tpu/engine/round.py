@@ -441,11 +441,13 @@ class RoundEngine:
                         else jnp.inf, jnp.float32),
         ]
         if grad_offsets is not None:
-            # numpy -> sharded put directly: staging through jnp.asarray
-            # would commit the whole [K, n_params] matrix to one device
-            args.append(jax.device_put(
-                np.asarray(grad_offsets, np.float32),
-                self._client_sharding))
+            # device arrays (DeviceControlTable.offsets) pass through —
+            # np.asarray would round-trip the matrix via the host; numpy
+            # goes through a sharded put directly (staging via jnp.asarray
+            # would commit the whole [K, n_params] matrix to one device)
+            if not isinstance(grad_offsets, jax.Array):
+                grad_offsets = np.asarray(grad_offsets, np.float32)
+            args.append(jax.device_put(grad_offsets, self._client_sharding))
         return getattr(self, key)(*args)
 
     def apply_custom_weights(self, state: ServerState, pgs, weights,

@@ -245,6 +245,23 @@ class OptimizationServer:
                     f"{self.scaffold_store.round()} but the checkpoint "
                     f"resumed at {self.state.round}; resetting controls")
                 self.scaffold_store.reset()
+        # device-resident control table (scaffold_device_controls): keep
+        # the whole [N, n_params] table in HBM; gather offsets and scatter
+        # the option-II update in-program so no model-sized per-round
+        # transfer crosses the host boundary (strategies/scaffold.py
+        # DeviceControlTable).  Built AFTER the resume/reset decision so
+        # the table warms up from exactly the controls the run keeps.
+        self.scaffold_device = None
+        if self.scaffold_store is not None and \
+                sc.get("scaffold_device_controls", False):
+            from ..strategies.scaffold import DeviceControlTable
+            self.scaffold_device = DeviceControlTable(
+                self.scaffold_store, len(train_dataset), self.mesh)
+            gb = 4.0 * self.scaffold_device.n_rows * \
+                self.scaffold_store.n_params / 2**30
+            print_rank(f"SCAFFOLD device control table: "
+                       f"{self.scaffold_device.n_rows} x "
+                       f"{self.scaffold_store.n_params} ({gb:.2f} GiB HBM)")
 
     # ------------------------------------------------------------------
     def _sample(self) -> list:
@@ -263,6 +280,9 @@ class OptimizationServer:
     def train(self) -> ServerState:
         sc = self.config.server_config
         max_iteration = int(sc.get("max_iteration", 100))
+        # single source of truth for "is this the final round" decisions
+        # made later in _round_housekeeping (scaffold flush cadence)
+        self._max_iteration = max_iteration
         val_freq = int(sc.get("val_freq", 20) or 20)
         rec_freq = int(sc.get("rec_freq", 20) or 20)
 
@@ -576,7 +596,28 @@ class OptimizationServer:
             # mismatch this marker exists to prevent — and scaffold rounds
             # are host-transfer-bound anyway
             self.ckpt.wait()
-            self.scaffold_store.set_round(int(self.state.round))
+            if self.scaffold_device is not None:
+                # write the dirty HBM rows through to the durable store
+                # before the marker claims they exist.  Flush cadence
+                # (scaffold_flush_freq, default 1) bounds the per-round
+                # [D, n_params] fetch: at freq > 1 the rounds in between
+                # fetch only logging scalars and the marker stays at the
+                # -1 sentinel — so a stop inside the window makes resume
+                # reset ALL controls (marker mismatch semantics), not just
+                # the unflushed tail.  That is the transfer-bound
+                # deployment's tradeoff (controls are estimates and
+                # re-warm), not the default.
+                flush_freq = int(self.config.server_config.get(
+                    "scaffold_flush_freq", 1) or 1)
+                # the iteration count train() stashed at entry — a second
+                # sc.get() here could desync and either flush every round
+                # or never fire the final-round flush
+                final = round_no >= self._max_iteration
+                if flush_freq <= 1 or round_no % flush_freq == 0 or final:
+                    self.scaffold_device.flush()
+                    self.scaffold_store.set_round(int(self.state.round))
+            else:
+                self.scaffold_store.set_round(int(self.state.round))
         self.ckpt.update_status({
             "i": round_no,
             "weight": self.lr_weight,
@@ -620,32 +661,43 @@ class OptimizationServer:
         host-side from the per-client pseudo-gradients (option II)."""
         client_lr, server_lr, batch, rng = self._host_round_setup(round_no)
 
-        offsets = self.scaffold_store.offsets(batch.client_ids)
+        offsets = (self.scaffold_device.offsets(batch.client_ids)
+                   if self.scaffold_device is not None else
+                   self.scaffold_store.offsets(batch.client_ids))
         pgs, ws, tls, stats = self.engine.client_payloads(
             self.state, batch, client_lr, rng, grad_offsets=offsets,
             leakage_threshold=self.max_allowed_leakage)
         self.state = self.engine.apply_custom_weights(self.state, pgs, ws,
                                                       server_lr)
 
-        # ---- host-side control update (exact per-client math) ----
-        pgs_np = jax.device_get(pgs)
         ws_np = np.asarray(jax.device_get(ws))
-        k = len(batch.client_ids)
-        # [K, n_params] in ravel_pytree order: tree.leaves order, each leaf
-        # C-order — one concatenate, no per-client device round-trips
-        pgs_flat = np.concatenate(
-            [np.asarray(leaf).reshape(k, -1)
-             for leaf in jax.tree.leaves(pgs_np)], axis=1)
         epochs = int(self.config.client_config.get("num_epochs", 1) or 1)
         # real local steps per client: steps with >= 1 real sample, per epoch
         steps = (batch.sample_mask.sum(axis=2) > 0).sum(axis=1) * epochs
         # invalidate the marker while the control files mutate: a crash
         # mid-update must read as a mismatch on resume, not as round N
         self.scaffold_store.set_round(-1)
-        self.strategy.update_controls(
-            self.scaffold_store, batch.client_ids, steps, pgs_flat,
-            client_lr, total_clients=len(self.train_dataset),
-            weights=ws_np)
+        if self.scaffold_device is not None:
+            # ---- in-program control update: the [K, n_params] payload
+            # stack never visits the host; flush() writes the durable
+            # copies when the marker commits ----
+            c_norm = self.scaffold_device.update(
+                batch.client_ids, steps, pgs, ws, ws_np, client_lr,
+                total_clients=len(self.train_dataset))
+        else:
+            # ---- host-side control update (exact per-client math) ----
+            pgs_np = jax.device_get(pgs)
+            k = len(batch.client_ids)
+            # [K, n_params] in ravel_pytree order: tree.leaves order, each
+            # leaf C-order — one concatenate, no per-client round-trips
+            pgs_flat = np.concatenate(
+                [np.asarray(leaf).reshape(k, -1)
+                 for leaf in jax.tree.leaves(pgs_np)], axis=1)
+            self.strategy.update_controls(
+                self.scaffold_store, batch.client_ids, steps, pgs_flat,
+                client_lr, total_clients=len(self.train_dataset),
+                weights=ws_np)
+            c_norm = float(np.linalg.norm(self.scaffold_store.c))
 
         # attack metrics + adaptive leakage threshold run here too
         # (the fused path does this on its own stats)
@@ -660,8 +712,7 @@ class OptimizationServer:
         log_metric("Training loss",
                    float(tls_np.sum() / n_real), step=round_no)
         log_metric("Aggregated weights", float(ws_np.sum()), step=round_no)
-        log_metric("Control norm (server c)",
-                   float(np.linalg.norm(self.scaffold_store.c)),
+        log_metric("Control norm (server c)", c_norm,
                    step=round_no)  # latest-checkpoint save: housekeeping
 
     # ------------------------------------------------------------------
@@ -886,7 +937,10 @@ class OptimizationServer:
                 # abandoned trajectory; restart control estimation from
                 # zero (the paper's init) rather than bias the restored
                 # params with stale drift corrections
-                self.scaffold_store.reset()
+                if self.scaffold_device is not None:
+                    self.scaffold_device.reset()  # also resets the store
+                else:
+                    self.scaffold_store.reset()
                 print_rank("reset SCAFFOLD controls after fallback")
 
     def _log_timing(self) -> None:

@@ -126,7 +126,7 @@ SERVER_KEYS = {
     "nbest_task_scheduler", "best_model_metric",
     # TPU-native extensions
     "rounds_per_step", "checkpoint_backend", "compilation_cache_dir",
-    "dump_norm_stats",
+    "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
 }

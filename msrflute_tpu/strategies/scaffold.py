@@ -28,17 +28,17 @@ in-RAM cache is LRU-bounded at ``ControlStore.CACHE_LIMIT`` vectors, so
 host memory stays flat for very large pools; the disk copies also make
 controls resume-safe.
 
-Transfer note (large models): each round ships a dense ``[K, n_params]``
-offset matrix to the device and pulls the per-client payload stack back —
-on a remote-attached chip these are the round's dominant transfers.  That
-is inherent to durable PER-CLIENT controls (``c_i`` update needs ``pg_i``
-on the host); at benchmark scale it is cheap.  For very large models the
-TPU-native endgame would keep the whole ``[N, n_params]`` control table
-in HBM (gather offsets in-program, scatter updates, fetch only the
-``[n_params]`` server aggregate) at the cost of device-memory residency
-and checkpoint-size — the same tradeoff as the device-resident dataset
-pool, and only worth it once a deployment actually hits the transfer
-wall.
+Transfer note (large models): each round the HOST path ships a dense
+``[K, n_params]`` offset matrix to the device and pulls the per-client
+payload stack back — on a remote-attached chip these are the round's
+dominant transfers.  That is inherent to durable PER-CLIENT controls
+(``c_i`` update needs ``pg_i`` on the host); at benchmark scale it is
+cheap.  ``server_config.scaffold_device_controls: true`` switches to the
+TPU-native ``DeviceControlTable``: the whole ``[N, n_params]`` control
+table lives in HBM (sharded over the clients mesh axis), offsets are
+gathered and the option-II update is scattered *in-program*, and the only
+per-round fetches are the logging scalars — the same transfer-vs-memory
+tradeoff as the device-resident dataset pool.
 """
 
 from __future__ import annotations
@@ -165,6 +165,166 @@ class ControlStore:
             if int(cid) >= 0:
                 out[row] = self.c - self.ci(int(cid))
         return out
+
+    def persisted_client_ids(self):
+        """Client ids with a durable control file (for table warm-up)."""
+        if self.store_dir is None:
+            return sorted(self._ci)
+        ids = []
+        for name in os.listdir(self.store_dir):
+            if name.startswith("control_") and name.endswith(".npy"):
+                key = name[len("control_"):-len(".npy")]
+                if key.lstrip("-").isdigit():
+                    ids.append(int(key))
+        return sorted(ids)
+
+
+class DeviceControlTable:
+    """HBM-resident SCAFFOLD controls (``scaffold_device_controls``).
+
+    The full ``[N_clients, n_params]`` control table is a device array
+    sharded over the clients mesh axis.  Per round:
+
+    - ``offsets(ids)`` gathers the K sampled rows and returns the
+      ``(c - c_i)`` offset matrix as a client-sharded device array — it
+      feeds ``RoundEngine.client_payloads`` without touching the host;
+    - ``update(...)`` runs the option-II control update as one jitted
+      program: flatten the per-client pseudo-gradient stack in ravel-pytree
+      order, ``c_i+ = c_i - c + pg_i/(K_i·lr)`` for participating clients
+      (id >= 0 and aggregation weight > 0 — privacy-dropped clients must
+      not leak into the controls), scatter the new rows back (the table
+      buffer is donated, so the update is in-place in HBM), and fold the
+      deltas into the server control ``c``.  Only the ``‖c‖`` logging
+      scalar is fetched.
+
+    Durability: the wrapped :class:`ControlStore` stays the format of
+    record.  Mutated rows accumulate in a dirty set and ``flush()`` writes
+    them through (one ``[D, n_params]`` fetch) — the server calls it when
+    the control-round marker commits, i.e. at checkpoint cadence, so crash
+    recovery semantics are identical to the host path.
+
+    Memory: the table costs ``4·N·n_params`` bytes of HBM — the same
+    residency tradeoff as the device-resident dataset pool; worth it when
+    per-round ``2×[K, n_params]`` transfers dominate (remote-attached
+    chips, large models), not when N is huge.
+    """
+
+    def __init__(self, store: ControlStore, n_clients: int, mesh):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import CLIENTS_AXIS
+
+        self.store = store
+        self.n_clients = int(n_clients)
+        axis = int(mesh.shape[CLIENTS_AXIS])
+        # pad rows to the clients-axis size so the table shards evenly;
+        # padding rows are never gathered (ids < N) and scatters to them
+        # are dropped (invalid rows target index n_rows, out of bounds)
+        self.n_rows = ((self.n_clients + axis - 1) // axis) * axis
+        self._row_sharding = NamedSharding(mesh, P(CLIENTS_AXIS, None))
+        self._rep = NamedSharding(mesh, P())
+        n_rows, n_params = self.n_rows, store.n_params
+        # allocate the (GiB-scale) zero table directly in HBM, sharded —
+        # never materialize a dense host copy; only the (typically few)
+        # persisted rows transfer
+        self._zeros = jax.jit(
+            lambda: jnp.zeros((n_rows, n_params), jnp.float32),
+            out_shardings=self._row_sharding)
+        self.table = self._zeros()
+        # warm-up scatters persisted rows in bounded chunks (a long run's
+        # resume can have controls for nearly every client — stacking them
+        # all would rebuild the dense table on the host and commit it to
+        # one device, the exact staging the sharded design avoids); the
+        # donated scatter updates the table in place
+        self._scatter = jax.jit(
+            lambda t, i, v: t.at[i].set(v), donate_argnums=(0,),
+            out_shardings=self._row_sharding)
+        warm = [cid for cid in store.persisted_client_ids()
+                if 0 <= cid < self.n_clients]
+        for lo in range(0, len(warm), 512):
+            chunk = warm[lo:lo + 512]
+            rows = np.stack([store.ci(cid) for cid in chunk])
+            self.table = self._scatter(
+                self.table, jnp.asarray(chunk, jnp.int32),
+                jax.device_put(rows, self._rep))
+        self.c = jax.device_put(store.c.copy(), self._rep)
+        self._dirty = set()
+
+        def gather_fn(table, c, ids):
+            rows = table[jnp.clip(ids, 0, n_rows - 1)]
+            valid = (ids >= 0).astype(jnp.float32)[:, None]
+            return (c[None, :] - rows) * valid
+
+        self._gather = jax.jit(
+            gather_fn, out_shardings=self._row_sharding)
+
+        def update_fn(table, c, ids, pgs, ws, steps, client_lr, inv_total):
+            k = ids.shape[0]
+            pg_flat = jnp.concatenate(
+                [leaf.reshape(k, -1).astype(jnp.float32)
+                 for leaf in jax.tree.leaves(pgs)], axis=1)
+            valid = (ids >= 0) & (ws > 0.0)
+            k_i = jnp.maximum(steps.astype(jnp.float32), 1.0)
+            ci_old = table[jnp.clip(ids, 0, n_rows - 1)]
+            ci_new = ci_old - c[None, :] + \
+                pg_flat / (k_i * client_lr)[:, None]
+            delta = jnp.where(valid[:, None], ci_new - ci_old, 0.0)
+            new_c = c + delta.sum(axis=0) * inv_total
+            new_table = table.at[jnp.where(valid, ids, n_rows)].set(
+                ci_new, mode="drop")
+            return new_table, new_c, jnp.linalg.norm(new_c)
+
+        self._update = jax.jit(
+            update_fn, donate_argnums=(0,),
+            out_shardings=(self._row_sharding, self._rep, self._rep))
+
+    def offsets(self, client_ids):
+        """Client-sharded ``[K, n_params]`` device array of ``c - c_i``."""
+        import jax.numpy as jnp
+        return self._gather(self.table, self.c,
+                            jnp.asarray(np.asarray(client_ids), jnp.int32))
+
+    def update(self, client_ids, steps, pgs, ws, ws_np, client_lr: float,
+               total_clients: int) -> float:
+        """In-program option-II update; returns ``‖c‖`` for logging.
+
+        ``ws`` is the device weight vector from the payload program and
+        ``ws_np`` its host copy (the server fetches it for logging anyway)
+        — used only to mark participating rows dirty for ``flush()``.
+        """
+        import jax.numpy as jnp
+        ids_np = np.asarray(client_ids)
+        self.table, self.c, c_norm = self._update(
+            self.table, self.c, jnp.asarray(ids_np, jnp.int32), pgs, ws,
+            jnp.asarray(np.asarray(steps), jnp.float32),
+            jnp.asarray(client_lr, jnp.float32),
+            jnp.asarray(1.0 / max(float(total_clients), 1.0), jnp.float32))
+        for row, cid in enumerate(ids_np):
+            if int(cid) >= 0 and float(ws_np[row]) > 0.0:
+                self._dirty.add(int(cid))
+        return float(c_norm)
+
+    def flush(self) -> None:
+        """Write dirty rows + server ``c`` through to the ControlStore."""
+        import jax
+        if self._dirty:
+            ids = np.asarray(sorted(self._dirty), np.int32)
+            rows = np.asarray(jax.device_get(self.table[ids]))
+            for cid, row in zip(ids, rows):
+                self.store.set_ci(int(cid), row)
+            self._dirty.clear()
+        self.store.set_c(np.asarray(jax.device_get(self.c)))
+
+    def reset(self) -> None:
+        """Zero table + ``c`` and the durable store (fallback semantics)."""
+        import jax
+        self.table = self._zeros()  # sharded device zeros; no host staging
+        self.c = jax.device_put(
+            np.zeros((self.store.n_params,), np.float32), self._rep)
+        self._dirty.clear()
+        self.store.reset()
 
 
 class Scaffold(FedAvg):

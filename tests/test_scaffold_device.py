@@ -1,0 +1,170 @@
+"""Device-resident SCAFFOLD controls (``DeviceControlTable``,
+``server_config.scaffold_device_controls`` — strategies/scaffold.py).
+
+The TPU-native control path keeps the ``[N, n_params]`` table in HBM and
+runs the option-II update in-program.  Pins: (1) numerical equivalence
+with the host-side control path — same trained params and same durable
+control files after several rounds (identical math, different executor);
+(2) flush-at-marker durability + checkpoint resume warms the table from
+the store; (3) ``scaffold_flush_freq > 1`` defers the durable writes but
+still flushes on the final round.
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+
+
+def _cfg(rounds, *, device_controls, clients_per_round=4, epochs=2,
+         lr=0.3, flush_freq=None):
+    sc = {
+        "max_iteration": rounds,
+        "num_clients_per_iteration": clients_per_round,
+        "initial_lr_client": lr,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "val_freq": int(rounds), "initial_val": False,
+        "best_model_criterion": "acc",
+        "data_config": {"val": {"batch_size": 16}},
+        "scaffold_device_controls": device_controls,
+    }
+    if flush_freq is not None:
+        sc["scaffold_flush_freq"] = flush_freq
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "scaffold",
+        "server_config": sc,
+        "client_config": {
+            "num_epochs": epochs,
+            "optimizer_config": {"type": "sgd", "lr": lr},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _skewed_dataset(num_users=8, n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(8, 4))
+    users, per_user = [], []
+    for u in range(num_users):
+        keep = {u % 4, (u + 1) % 4}
+        xs, ys = [], []
+        while len(ys) < n:
+            x = rng.normal(size=(8,)).astype(np.float32)
+            y = int(np.argmax(x @ w_true))
+            if y in keep:
+                xs.append(x)
+                ys.append(y)
+        users.append(f"u{u}")
+        per_user.append({"x": np.stack(xs), "y": np.asarray(ys, np.int32)})
+    return ArraysDataset(users, per_user)
+
+
+def _train(dataset, rounds, tmp, *, device_controls, seed=0, **kw):
+    cfg = _cfg(rounds, device_controls=device_controls, **kw)
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, dataset, val_dataset=dataset,
+                                model_dir=tmp, seed=seed)
+    state = server.train()
+    return server, state
+
+
+def test_device_controls_match_host_path():
+    """Same seeds, same rounds: the in-program control update must produce
+    the same trajectory and the same durable controls as the host path
+    (it is the same option-II math; only the executor differs)."""
+    ds = _skewed_dataset()
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        h_server, h_state = _train(ds, 4, t1, device_controls=False,
+                                   seed=7, epochs=3)
+        d_server, d_state = _train(ds, 4, t2, device_controls=True,
+                                   seed=7, epochs=3)
+        for a, b in zip(jax.tree.leaves(h_state.params),
+                        jax.tree.leaves(d_state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-6)
+        # durable stores agree: server c and every persisted client file
+        np.testing.assert_allclose(d_server.scaffold_store.c,
+                                   h_server.scaffold_store.c,
+                                   rtol=2e-5, atol=1e-7)
+        h_ids = h_server.scaffold_store.persisted_client_ids()
+        d_ids = d_server.scaffold_store.persisted_client_ids()
+        assert h_ids == d_ids and len(h_ids) > 0
+        for cid in h_ids:
+            np.testing.assert_allclose(
+                d_server.scaffold_store.ci(cid),
+                h_server.scaffold_store.ci(cid), rtol=2e-5, atol=1e-7)
+        # device mode must not have pulled per-round payload stacks just
+        # for the controls: the table object exists and the norm logged
+        assert d_server.scaffold_device is not None
+        assert np.linalg.norm(d_server.scaffold_store.c) > 0
+
+
+def test_device_controls_resume_warms_table():
+    """Resume rebuilds the HBM table from the durable store: continuing a
+    run after restart must see the controls it left off with."""
+    ds = _skewed_dataset(num_users=6)
+    with tempfile.TemporaryDirectory() as tmp:
+        server, _ = _train(ds, 2, tmp, device_controls=True,
+                           clients_per_round=6)
+        c_before = server.scaffold_store.c.copy()
+        ci_before = server.scaffold_store.ci(0).copy()
+        assert np.linalg.norm(c_before) > 0
+
+        cfg = _cfg(2, device_controls=True, clients_per_round=6)
+        cfg.server_config["resume_from_checkpoint"] = True
+        task = make_task(cfg.model_config)
+        resumed = OptimizationServer(task, cfg, ds, model_dir=tmp, seed=1)
+        assert resumed.state.round == 2
+        dev = resumed.scaffold_device
+        assert dev is not None
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(dev.c)), c_before)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(dev.table[0])), ci_before)
+
+
+def test_flush_freq_defers_durable_writes_until_final_round():
+    """With scaffold_flush_freq > rounds, intermediate rounds must not pull
+    control rows off the device; the final round's housekeeping still
+    flushes, so a completed run is durable (files + marker + matching c)."""
+    ds = _skewed_dataset(num_users=6)
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = _cfg(3, device_controls=True, clients_per_round=6,
+                   flush_freq=100)
+        task = make_task(cfg.model_config)
+        server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                    model_dir=tmp, seed=0)
+        calls = []
+        orig_flush = server.scaffold_device.flush
+        server.scaffold_device.flush = \
+            lambda: calls.append(1) or orig_flush()
+        server.train()
+        # only the FINAL round's housekeeping flushed
+        assert len(calls) == 1, calls
+        assert server.scaffold_store.round() == 3
+        store_dir = os.path.join(tmp, "scaffold")
+        files = [f for f in os.listdir(store_dir)
+                 if f.startswith("control_") and
+                 f[len("control_"):-len(".npy")].lstrip("-").isdigit()]
+        assert len(files) == 6, files
+        np.testing.assert_allclose(
+            server.scaffold_store.c,
+            np.asarray(jax.device_get(server.scaffold_device.c)))
+
+
+def test_schema_accepts_device_control_keys():
+    from msrflute_tpu.schema import validate
+    validate({
+        "model_config": {"model_type": "LR"}, "strategy": "scaffold",
+        "server_config": {"optimizer_config": {"type": "sgd"},
+                          "scaffold_device_controls": True,
+                          "scaffold_flush_freq": 20},
+        "client_config": {"optimizer_config": {"type": "sgd"}}})

@@ -18,9 +18,14 @@ log "runner started (pid $$)"
 # starting new jobs after this UTC hour (driver window); touch
 # tools/tpu_jobs.d/.no_deadline to disable.
 DEADLINE_H=${TPU_RUNNER_DEADLINE_H:-7}
+WINDOW_END_H=${TPU_RUNNER_WINDOW_END_H:-12}
+if [ "$DEADLINE_H" -ge "$WINDOW_END_H" ]; then
+  log "DEADLINE_H=$DEADLINE_H >= WINDOW_END_H=$WINDOW_END_H: guard disabled"
+fi
 while true; do
   if [ ! -f tools/tpu_jobs.d/.no_deadline ] && \
-     [ "$(date -u +%H)" -ge "$DEADLINE_H" ] && [ "$(date -u +%H)" -lt 12 ]; then
+     [ "$(date -u +%H)" -ge "$DEADLINE_H" ] && \
+     [ "$(date -u +%H)" -lt "$WINDOW_END_H" ]; then
     log "driver bench window (>= 0${DEADLINE_H}:00 UTC); not starting new jobs"
     sleep 300; continue
   fi
