@@ -60,19 +60,31 @@ def _resolve_interpret(interpret):
 
 
 # ----------------------------------------------------------------------
+def bits_to_normal(b1: jnp.ndarray, b2: jnp.ndarray) -> jnp.ndarray:
+    """Box-Muller: two uint32 random-bit draws -> standard normal.
+
+    This is the DP-critical math of the noise kernel (a wrong sigma here
+    silently under-noises every global-DP update), factored out so its
+    statistics are testable with ANY uint32 source: the tests feed
+    ``jax.random.bits`` on CPU (``tests/test_pallas_kernels.py``), the
+    kernel feeds the on-core pltpu PRNG — the transform is identical.
+    Top 24 bits -> uniform with 2^-24 resolution (f32-exact); the +1e-12
+    floor guards ``log(0)`` and caps |z| at ~7.43.
+    """
+    u1 = (b1 >> 8).astype(jnp.float32) * (1.0 / (1 << 24)) + 1e-12
+    u2 = (b2 >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    return jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
+
+
 def _noise_kernel(seed_ref, params_ref, x_ref, o_ref):
     # distinct stream per grid block
     pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
     scale = params_ref[0]
     sigma = params_ref[1]
     shape = x_ref.shape
-    # Box-Muller from two draws of uniform(0,1)
     b1 = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     b2 = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    u1 = (b1 >> 8).astype(jnp.float32) * (1.0 / (1 << 24)) + 1e-12
-    u2 = (b2 >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
-    normal = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
-    o_ref[:] = x_ref[:] * scale + sigma * normal
+    o_ref[:] = x_ref[:] * scale + sigma * bits_to_normal(b1, b2)
 
 
 def fused_gaussian_noise(flat: jnp.ndarray, scale: jnp.ndarray,
