@@ -15,6 +15,66 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_lstm_weight_transplant_forward_exact(tmp_path):
+    """The torch-LSTM -> flax-OptimizedLSTMCell transplant (gate slicing,
+    kernel transposes, bias summing) must produce the same forward loss on
+    the same batch — the foundation of the recurrent parity comparison.
+    Runs without the reference mount: the torch side is the same standard
+    nn.Embedding/nn.LSTM/nn.Linear architecture the reference hardcodes
+    (experiments/nlp_rnn_fedshakespeare/model.py:12-40)."""
+    import numpy as np
+    import torch
+    from torch import nn
+
+    sys.path.insert(0, os.path.join(REPO, "tools", "parity"))
+    from run_parity import (gen_lstm_blob, lstm_init, save_flax_lstm,
+                            save_torch_lstm)
+
+    init = lstm_init(np.random.default_rng(3))
+    pt, mp = str(tmp_path / "i.pt"), str(tmp_path / "i.msgpack")
+    save_torch_lstm(init, pt)
+    save_flax_lstm(init, mp)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embeddings = nn.Embedding(90, 8, padding_idx=0)
+            self.lstm = nn.LSTM(8, 256, num_layers=2, batch_first=True)
+            self.fc = nn.Linear(256, 90)
+
+        def forward(self, x):
+            out, _ = self.lstm(self.embeddings(x))
+            return torch.transpose(self.fc(out), 1, 2)
+
+    net = Net()
+    sd = torch.load(pt)
+    net.load_state_dict({k[len("net."):]: v for k, v in sd.items()})
+
+    blob = gen_lstm_blob(np.random.default_rng(5), 1, 4, 24)
+    x = np.asarray(blob["user_data"]["0000"]["x"])
+    y = np.asarray(blob["user_data_label"]["0000"])
+    with torch.no_grad():
+        loss_t = float(nn.CrossEntropyLoss(ignore_index=0)(
+            net(torch.tensor(x)), torch.tensor(y).long()))
+
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    task = make_task(ModelConfig(model_type="LSTM",
+                                 extra={"vocab_size": 90, "seq_len": 24}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    with open(mp, "rb") as fh:
+        params = serialization.from_state_dict(
+            params, serialization.msgpack_restore(fh.read()))
+    batch = {"x": jnp.asarray(x, jnp.int32), "y": jnp.asarray(y, jnp.int32),
+             "sample_mask": jnp.ones((4,), jnp.float32)}
+    loss_j = float(task.loss(params, batch, jax.random.PRNGKey(0), False)[0])
+    assert abs(loss_t - loss_j) < 1e-5, (loss_t, loss_j)
+
+
 @pytest.mark.skipif(not os.path.isdir("/root/reference"),
                     reason="reference mount not available")
 def test_lr_trajectory_exact(tmp_path):

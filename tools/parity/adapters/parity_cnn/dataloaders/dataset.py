@@ -2,30 +2,8 @@
 already-loaded blob dict (its path mode downloads MNIST, impossible with
 zero egress) — this subclass adds json-path loading, everything else is the
 reference class (``experiments/cv_cnn_femnist/dataloaders/dataset.py``)."""
-import json
-
-import numpy as np
-
 from experiments.cv_cnn_femnist.dataloaders.dataset import Dataset as _RefDataset
-
-
-def maybe_load(data):
-    """str path -> blob dict shaped like the reference loaders expect."""
-    if not isinstance(data, str):
-        return data
-    with open(data) as fh:
-        blob = json.load(fh)
-    users = list(blob["users"])
-    return {
-        "users": users,
-        "num_samples": list(blob["num_samples"]),
-        "user_data": {
-            u: np.asarray(blob["user_data"][u]["x"], dtype=np.float32)
-            for u in users},
-        "user_data_label": {
-            u: np.asarray(blob["user_data_label"][u], dtype=np.int64)
-            for u in users},
-    }
+from parity_blob import maybe_load
 
 
 class Dataset(_RefDataset):

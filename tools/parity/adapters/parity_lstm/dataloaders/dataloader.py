@@ -1,0 +1,14 @@
+"""Parity adapter dataloader: the reference fed_shakespeare DataLoader
+with json-path loading injected (see dataset.py.maybe_load)."""
+from experiments.nlp_rnn_fedshakespeare.dataloaders import dataloader as _ref
+from experiments.parity_lstm.dataloaders import dataset as _ds
+
+# the reference DataLoader constructs its Dataset from this module global;
+# point it at the path-aware subclass instead
+_ref.Dataset = _ds.Dataset
+
+
+class DataLoader(_ref.DataLoader):
+    def __init__(self, mode, num_workers=0, **kwargs):
+        kwargs["data"] = _ds.maybe_load(kwargs.get("data"))
+        super().__init__(mode, num_workers=num_workers, **kwargs)

@@ -1,0 +1,21 @@
+"""Parity adapter dataset: the reference fed_shakespeare Dataset expects an
+already-loaded blob dict (its path mode pulls the LEAF release, impossible
+with zero egress) — this subclass adds json-path loading, everything else
+is the reference class
+(``experiments/nlp_rnn_fedshakespeare/dataloaders/dataset.py``)."""
+import functools
+
+import numpy as np
+
+from experiments.nlp_rnn_fedshakespeare.dataloaders.dataset import \
+    Dataset as _RefDataset
+from parity_blob import maybe_load as _maybe_load
+
+# int [n, L] input sequences + int [n, L] per-position target sequences
+maybe_load = functools.partial(_maybe_load, x_dtype=np.int64)
+
+
+class Dataset(_RefDataset):
+    def __init__(self, data, test_only=False, user_idx=0, **kwargs):
+        super().__init__(maybe_load(data), test_only=test_only,
+                         user_idx=user_idx, **kwargs)

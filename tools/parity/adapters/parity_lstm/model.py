@@ -1,0 +1,5 @@
+"""Parity-harness adapter task: re-exports the REFERENCE Shakespeare RNN
+model class unchanged (``experiments/nlp_rnn_fedshakespeare/model.py:40``)
+so the cross-framework comparison trains the reference's own torch code,
+not a copy."""
+from experiments.nlp_rnn_fedshakespeare.model import RNN  # noqa: F401

@@ -13,6 +13,15 @@ class _OfflineRun:
 
     def log(self, name, value, **kw):
         if self._path:
+            # reference models may log torch/numpy scalars (e.g. the
+            # fed_shakespeare RNN's masked-accuracy tensor,
+            # experiments/nlp_rnn_fedshakespeare/model.py:66) — coerce any
+            # 0-d numeric to a plain float like the real AzureML SDK does
+            if hasattr(value, "item") and not isinstance(value, dict):
+                try:
+                    value = value.item()
+                except Exception:
+                    value = str(value)
             with open(self._path, "a") as fh:
                 fh.write(json.dumps({"name": str(name), "value": value}) + "\n")
 

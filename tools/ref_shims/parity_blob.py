@@ -1,0 +1,32 @@
+"""Shared blob loader for the parity adapter datasets.
+
+The parity harness writes one json schema (``run_parity.write_blob``:
+``users`` / ``num_samples`` / ``user_data[u]["x"]`` /
+``user_data_label[u]``); each adapter dataset converts it to the dict the
+reference loaders expect.  One loader here (this directory is already on
+the reference run's PYTHONPATH, see ``run_parity.run_reference``) keeps
+the schema contract in a single place — only the feature dtype differs
+per task.
+"""
+import json
+
+import numpy as np
+
+
+def maybe_load(data, x_dtype=np.float32):
+    """str path -> blob dict shaped like the reference loaders expect."""
+    if not isinstance(data, str):
+        return data
+    with open(data) as fh:
+        blob = json.load(fh)
+    users = list(blob["users"])
+    return {
+        "users": users,
+        "num_samples": list(blob["num_samples"]),
+        "user_data": {
+            u: np.asarray(blob["user_data"][u]["x"], dtype=x_dtype)
+            for u in users},
+        "user_data_label": {
+            u: np.asarray(blob["user_data_label"][u], dtype=np.int64)
+            for u in users},
+    }
