@@ -23,7 +23,7 @@ def test_lstm_weight_transplant_forward_exact(tmp_path):
     nn.Embedding/nn.LSTM/nn.Linear architecture the reference hardcodes
     (experiments/nlp_rnn_fedshakespeare/model.py:12-40)."""
     import numpy as np
-    import torch
+    torch = pytest.importorskip("torch")
     from torch import nn
 
     sys.path.insert(0, os.path.join(REPO, "tools", "parity"))
