@@ -1,0 +1,100 @@
+"""Static FLOP decomposition (utils/flops.py) against hand-computed
+counts: exact dot/conv formulas, scan trip-count multiplication, and a
+sanity pin that the benchmark CNN's client grad step is MXU-dominated
+(the profiler's chip-independent compute-bound evidence)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from msrflute_tpu.utils.flops import flops_by_op
+
+
+def test_dense_matmul_exact():
+    a = jnp.zeros((32, 64))
+    b = jnp.zeros((64, 128))
+    res = flops_by_op(lambda x, y: x @ y, a, b)
+    assert res["dot"] == 2 * 32 * 64 * 128
+    assert res["conv"] == 0.0
+    assert not res["approximate"]
+
+
+def test_conv_exact():
+    x = jnp.zeros((4, 28, 28, 1))
+    k = jnp.zeros((3, 3, 1, 32))
+
+    def conv(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    res = flops_by_op(conv, x, k)
+    # out: [4, 26, 26, 32]; per output element: 3*3*1 MACs
+    assert res["conv"] == 2 * (4 * 26 * 26 * 32) * (3 * 3 * 1)
+
+
+def test_scan_multiplies_body_flops():
+    w = jnp.zeros((16, 16))
+
+    def step(carry, _):
+        return carry @ w, None
+
+    def rolled(h):
+        out, _ = jax.lax.scan(step, h, None, length=10)
+        return out
+
+    res = flops_by_op(rolled, jnp.zeros((8, 16)))
+    assert res["dot"] == 10 * 2 * 8 * 16 * 16
+
+
+def test_cond_counts_only_max_branch_consistently():
+    w = jnp.zeros((16, 16))
+
+    def fn(pred, h):
+        return jax.lax.cond(pred, lambda x: (x @ w) @ w, lambda x: x @ w, h)
+
+    res = flops_by_op(fn, jnp.asarray(True), jnp.zeros((8, 16)))
+    one_mm = 2 * 8 * 16 * 16
+    # only the expensive (2-matmul) branch counts, in buckets AND total
+    assert res["dot"] == 2 * one_mm, res
+    assert res["approximate"]
+    assert abs(res["dot"] + res["conv"] + res["elementwise"] + res["other"]
+               - res["total"]) < 1e-6
+    assert res["mxu_share"] <= 1.0
+
+
+def test_grad_adds_backward_flops():
+    a = jnp.zeros((32, 64))
+    b = jnp.zeros((64, 128))
+
+    def loss(x):
+        return jnp.sum(x @ b)
+
+    fwd = flops_by_op(loss, a)["dot"]
+    both = flops_by_op(jax.grad(loss), a)["dot"]
+    # backward of one matmul adds one more matmul (dL/dx = g @ b.T);
+    # b is closed over, so its cotangent may add the third
+    assert both >= 2 * fwd
+
+
+def test_benchmark_cnn_step_is_mxu_dominated():
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+
+    task = make_task(ModelConfig(model_type="CNN",
+                                 extra={"num_classes": 62}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    batch = {"x": jnp.zeros((20, 28, 28, 1)),
+             "y": jnp.zeros((20,), jnp.int32),
+             "sample_mask": jnp.ones((20,), jnp.float32)}
+
+    def grad_step(p):
+        return jax.grad(
+            lambda pp: task.loss(pp, batch, jax.random.PRNGKey(0), True)[0]
+        )(p)
+
+    res = flops_by_op(grad_step, params)
+    # the benchmark round must be MXU work, not bookkeeping — this is the
+    # chip-independent half of the compute-bound argument
+    assert res["mxu_share"] > 0.5, res
+    assert res["conv"] > res["dot"], res  # convs carry the model

@@ -125,8 +125,24 @@ def main(argv=None) -> None:
         out["secs_per_round_p90"] = round(float(np.percentile(per_round, 90)), 5)
         out["pack_share"] = round(pack_secs / max(np.median(per_round), 1e-9), 3)
 
-        # ---- XLA's own cost analysis of one client grad step ----
+        # ---- static per-op-type FLOP decomposition (chip-independent):
+        # where the client grad step's FLOPs go — conv/dot (MXU) vs
+        # elementwise/bookkeeping (VPU) — so the compute-bound argument
+        # doesn't need the chip (utils/flops.py) ----
         one = bench._one_client_batch(dataset, bs, server.max_steps)
+        try:
+            from msrflute_tpu.utils.flops import flops_by_op
+
+            def _grad_step(p):
+                return jax.grad(lambda pp: task.loss(
+                    pp, one, jax.random.PRNGKey(0), True)[0])(p)
+
+            out["flops_by_op"] = flops_by_op(_grad_step,
+                                             server.state.params)
+        except Exception as exc:  # decomposition must not kill the tool
+            out["flops_by_op_error"] = f"{type(exc).__name__}: {exc}"
+
+        # ---- XLA's own cost analysis of one client grad step ----
         cost = bench.grad_step_cost(task, server.state.params, one)
         if cost is not None:
             flops = float(cost.get("flops", 0.0))
