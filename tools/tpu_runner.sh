@@ -34,7 +34,17 @@ while true; do
     [ -f "$j.done" ] || { job="$j"; break; }
   done
   if [ -z "$job" ]; then sleep 120; continue; fi
-  until probe; do log "chip down (probe failed); sleeping 180s"; sleep 180; done
+  until probe; do
+    log "chip down (probe failed); sleeping 180s"; sleep 180
+    # the probe-wait can span INTO the driver window: re-evaluate the
+    # guard between probes or a mid-window chip recovery would start a
+    # job and hold the single-client claim against the driver's bench
+    if [ ! -f tools/tpu_jobs.d/.no_deadline ] && \
+       [ "$(date -u +%H)" -ge "$DEADLINE_H" ] && \
+       [ "$(date -u +%H)" -lt "$WINDOW_END_H" ]; then
+      continue 2
+    fi
+  done
   log "chip up; running $job"
   bash "$job" >> tpu_runner.log 2>&1
   rc=$?
