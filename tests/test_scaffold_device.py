@@ -160,6 +160,24 @@ def test_flush_freq_defers_durable_writes_until_final_round():
             np.asarray(jax.device_get(server.scaffold_device.c)))
 
 
+def test_fallback_resets_device_table():
+    """Server fallback to a best checkpoint must zero the HBM table AND
+    the durable store (the controls belong to the abandoned trajectory) —
+    the device path routes reset through DeviceControlTable.reset()."""
+    ds = _skewed_dataset(num_users=6)
+    with tempfile.TemporaryDirectory() as tmp:
+        server, _ = _train(ds, 2, tmp, device_controls=True,
+                           clients_per_round=6)
+        dev = server.scaffold_device
+        assert float(np.linalg.norm(np.asarray(jax.device_get(dev.c)))) > 0
+        server._fall_back()  # best checkpoint exists from training
+        assert float(np.linalg.norm(np.asarray(jax.device_get(dev.c)))) == 0
+        assert float(np.abs(np.asarray(
+            jax.device_get(dev.table))).max()) == 0
+        assert np.linalg.norm(server.scaffold_store.c) == 0
+        assert server.scaffold_store.persisted_client_ids() == []
+
+
 def test_schema_accepts_device_control_keys():
     from msrflute_tpu.schema import validate
     validate({
