@@ -153,6 +153,105 @@ TOP_KEYS = {
 _FREEFORM = "model_config", "semisupervision", "augment", "mesh_config", \
     "nbest_task_scheduler", "ss_config", "experiment"
 
+# ----------------------------------------------------------------------
+# per-field type/range rules (the cerberus per-field ``type``/``min``/
+# ``max`` declarations, reference core/schema.py): spec is
+# ("bool" | "int" | "num", lo, hi) with inclusive bounds, None = open.
+# Only fields with an unambiguous scalar contract are listed — fields
+# with union types (num_clients_per_iteration int|"lo:hi") keep their
+# bespoke checks in validate().
+# ----------------------------------------------------------------------
+SERVER_FIELD_SPECS = {
+    "initial_lr_client": ("num", 0, None),
+    "lr_decay_factor": ("num", 0, None),
+    "softmax_beta": ("num", 0, None),
+    "stale_prob": ("num", 0.0, 1.0),
+    "initial_lr": ("num", 0, None),
+    "max_grad_norm": ("num", 0, None),
+    "initial_val": ("bool", None, None),
+    "initial_rec": ("bool", None, None),
+    "wantRL": ("bool", None, None),
+    "fall_back_to_best_model": ("bool", None, None),
+    "send_dicts": ("bool", None, None),
+    "do_profiling": ("bool", None, None),
+    "resume_from_checkpoint": ("bool", None, None),
+    "scaffold_device_controls": ("bool", None, None),
+    "dump_norm_stats": ("bool", None, None),
+    "rounds_per_step": ("int", 1, None),
+    "model_backup_freq": ("int", 1, None),
+    "scaffold_flush_freq": ("int", 1, None),
+}
+
+CLIENT_FIELD_SPECS = {
+    "fedprox_mu": ("num", 0, None),
+    "max_grad_norm": ("num", 0, None),
+    "quant_anneal": ("num", 0, 1.0),
+    # quantile of |g| (jnp.quantile q arg, ops/quantization.py): [0, 1]
+    "quant_thresh": ("num", 0, 1.0),
+    "convex_model_interp": ("num", 0.0, 1.0),
+    "num_epochs": ("int", 1, None),
+    "desired_max_samples": ("int", 0, None),
+    "quant_bits": ("int", 1, 32),
+    "quant_approx": ("bool", None, None),
+    "copying_train_data": ("bool", None, None),
+    "do_profiling": ("bool", None, None),
+    "ignore_subtask": ("bool", None, None),
+    "step_bucketing": ("bool", None, None),
+}
+
+DATASET_FIELD_SPECS = {
+    "batch_size": ("int", 1, None),
+    "desired_max_samples": ("int", 0, None),
+    "num_workers": ("int", 0, None),
+    "prefetch_factor": ("int", 1, None),
+    "max_seq_length": ("int", 1, None),
+    "max_num_words": ("int", 1, None),
+    "max_samples_per_user": ("int", 1, None),
+    "lazy_cache_users": ("int", 1, None),
+    "device_resident": ("bool", None, None),
+    "lazy": ("bool", None, None),
+    "wantLogits": ("bool", None, None),
+    "pin_memory": ("bool", None, None),
+    "unsorted_batch": ("bool", None, None),
+    "step_bucketing": ("bool", None, None),
+    "length_bucketing": ("bool", None, None),
+}
+
+OPTIMIZER_FIELD_SPECS = {
+    "lr": ("num", 0, None),
+    "momentum": ("num", 0, 1.0),
+    "weight_decay": ("num", 0, None),
+    "dampening": ("num", 0, 1.0),
+    "eps": ("num", 0, None),
+    "nesterov": ("bool", None, None),
+    "amsgrad": ("bool", None, None),
+}
+
+ANNEALING_FIELD_SPECS = {
+    "gamma": ("num", 0, None),
+    "step_size": ("int", 1, None),
+    "patience": ("int", 0, None),
+    "factor": ("num", 0, None),
+    "peak_lr": ("num", 0, None),
+    "floor_lr": ("num", 0, None),
+    "rampup_steps": ("int", 0, None),
+    "hold_steps": ("int", 0, None),
+    "decay_steps": ("int", 1, None),
+}
+
+DP_FIELD_SPECS = {
+    "eps": ("num", 0, None),
+    "delta": ("num", 0.0, 1.0),
+    "max_grad": ("num", 0, None),
+    "max_weight": ("num", 0, None),
+    "min_weight": ("num", 0, None),
+    "weight_scaler": ("num", 0, None),
+    "global_sigma": ("num", 0, None),
+    "enable_local_dp": ("bool", None, None),
+    "enable_global_dp": ("bool", None, None),
+    "enable_prod": ("bool", None, None),
+}
+
 
 class SchemaError(ValueError):
     def __init__(self, errors: List[str]):
@@ -182,6 +281,40 @@ def _check_unknown(errors: List[str], raw: Any, path: str,
         errors.append(f"{path}.{key}: unknown key{suggest}")
 
 
+def _check_fields(errors: List[str], raw: Any, path: str,
+                  specs: Dict[str, tuple]) -> None:
+    """Per-field type + inclusive-range checks (the cerberus ``type`` /
+    ``min`` / ``max`` rules).  ``None`` values skip — optionality is the
+    dataclass default's job, not the schema's."""
+    if not isinstance(raw, dict):
+        return
+    for key, (kind, lo, hi) in specs.items():
+        val = raw.get(key)
+        if val is None:
+            continue
+        if kind == "bool":
+            if not isinstance(val, bool):
+                errors.append(f"{path}.{key}: must be a boolean, got "
+                              f"{type(val).__name__}")
+            continue
+        # bool is an int subclass: a stray `true` must not pass as 1
+        if isinstance(val, bool) or not isinstance(
+                val, int if kind == "int" else (int, float)):
+            want = "an integer" if kind == "int" else "a number"
+            errors.append(f"{path}.{key}: must be {want}, got "
+                          f"{type(val).__name__}")
+            continue
+        if (lo is not None or hi is not None) and val != val:
+            # NaN compares False against any bound — reject it explicitly
+            # or `stale_prob: .nan` would sail through a [0, 1] range
+            errors.append(f"{path}.{key}: must be a finite number, got NaN")
+            continue
+        if lo is not None and val < lo:
+            errors.append(f"{path}.{key}: must be >= {lo}, got {val}")
+        if hi is not None and val > hi:
+            errors.append(f"{path}.{key}: must be <= {hi}, got {val}")
+
+
 def _check_optimizer(errors: List[str], raw: Any, path: str,
                      unknown: Optional[List[str]] = None) -> None:
     if not isinstance(raw, dict):
@@ -189,9 +322,7 @@ def _check_optimizer(errors: List[str], raw: Any, path: str,
     _check_enum(errors, raw, path, "type", ALLOWED_OPTIMIZERS)
     _check_unknown(unknown if unknown is not None else errors, raw, path,
                    OPTIMIZER_KEYS)
-    lr = raw.get("lr")
-    if lr is not None and not isinstance(lr, (int, float)):
-        errors.append(f"{path}.lr: must be a number, got {type(lr).__name__}")
+    _check_fields(errors, raw, path, OPTIMIZER_FIELD_SPECS)
 
 
 def _check_annealing(errors: List[str], raw: Any, path: str,
@@ -201,6 +332,7 @@ def _check_annealing(errors: List[str], raw: Any, path: str,
     _check_enum(errors, raw, path, "type", ALLOWED_ANNEALING)
     _check_unknown(unknown if unknown is not None else errors, raw, path,
                    ANNEALING_KEYS)
+    _check_fields(errors, raw, path, ANNEALING_FIELD_SPECS)
 
 
 def _check_data_config(errors: List[str], raw: Any, path: str) -> None:
@@ -211,6 +343,18 @@ def _check_data_config(errors: List[str], raw: Any, path: str) -> None:
         blk = raw.get(split)
         if isinstance(blk, dict):
             _check_unknown(errors, blk, f"{path}.{split}", DATASET_KEYS)
+
+
+def _check_data_fields(errors: List[str], raw: Any, path: str) -> None:
+    """Type/range rules for the per-split dataset blocks (always hard
+    errors, unlike the unknown-key pass which can be downgraded)."""
+    if not isinstance(raw, dict):
+        return
+    for split in ("train", "val", "test"):
+        blk = raw.get(split)
+        if isinstance(blk, dict):
+            _check_fields(errors, blk, f"{path}.{split}",
+                          DATASET_FIELD_SPECS)
 
 
 def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
@@ -250,6 +394,9 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
         _check_optimizer(errors, sc.get("optimizer_config"), "server_config.optimizer_config", unknown)
         _check_annealing(errors, sc.get("annealing_config"), "server_config.annealing_config", unknown)
         _check_data_config(unknown, sc.get("data_config"), "server_config.data_config")
+        _check_fields(errors, sc, "server_config", SERVER_FIELD_SPECS)
+        _check_data_fields(errors, sc.get("data_config"),
+                           "server_config.data_config")
         replay = sc.get("server_replay_config")
         if isinstance(replay, dict):
             _check_unknown(unknown, replay, "server_config.server_replay_config",
@@ -277,6 +424,9 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
         if cc.get("annealing_config") is not None:
             _check_annealing(errors, cc.get("annealing_config"), "client_config.annealing_config", unknown)
         _check_data_config(unknown, cc.get("data_config"), "client_config.data_config")
+        _check_fields(errors, cc, "client_config", CLIENT_FIELD_SPECS)
+        _check_data_fields(errors, cc.get("data_config"),
+                           "client_config.data_config")
 
     dp = raw.get("dp_config")
     if isinstance(dp, dict):
@@ -285,11 +435,7 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
         if isinstance(ac, dict):
             _check_unknown(unknown, ac, "dp_config.adaptive_clipping",
                            ADAPTIVE_CLIP_KEYS)
-        for key in ("eps", "delta", "max_grad", "max_weight", "min_weight",
-                    "weight_scaler", "global_sigma"):
-            val = dp.get(key)
-            if val is not None and not isinstance(val, (int, float)):
-                errors.append(f"dp_config.{key}: must be a number")
+        _check_fields(errors, dp, "dp_config", DP_FIELD_SPECS)
 
     pm = raw.get("privacy_metrics_config")
     if isinstance(pm, dict):

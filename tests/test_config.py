@@ -105,3 +105,55 @@ def test_applied_defaults_report():
     assert "server_config.rec_freq" in rep
     # user DID set max_iteration -> not reported
     assert "server_config.max_iteration" not in rep
+
+
+def test_schema_field_type_and_range_rules():
+    """Per-field cerberus-style type/min/max rules (schema.py
+    *_FIELD_SPECS): every violation is collected into one SchemaError."""
+    bad = {**MINI, "server_config": {
+        **MINI["server_config"],
+        "stale_prob": 1.5,              # > 1
+        "rounds_per_step": 0,           # < 1
+        "initial_val": "yes",           # not a boolean
+    }, "client_config": {
+        **MINI["client_config"],
+        "num_epochs": 0,                # < 1
+        "data_config": {"train": {"batch_size": 0}},  # < 1
+    }, "dp_config": {"eps": -1.0, "delta": 2.0}}
+    with pytest.raises(SchemaError) as ei:
+        FLUTEConfig.from_dict(bad)
+    msg = str(ei.value)
+    for frag in ("stale_prob", "rounds_per_step", "initial_val",
+                 "num_epochs", "batch_size", "dp_config.eps",
+                 "dp_config.delta"):
+        assert frag in msg, (frag, msg)
+
+
+def test_schema_bool_does_not_pass_as_int():
+    bad = {**MINI, "server_config": {**MINI["server_config"],
+                                     "rounds_per_step": True}}
+    with pytest.raises(SchemaError, match="rounds_per_step"):
+        FLUTEConfig.from_dict(bad)
+
+
+def test_schema_optimizer_field_rules():
+    bad = {**MINI, "client_config": {
+        **MINI["client_config"],
+        "optimizer_config": {"type": "sgd", "lr": -0.1, "momentum": 2.0}}}
+    with pytest.raises(SchemaError) as ei:
+        FLUTEConfig.from_dict(bad)
+    assert "lr" in str(ei.value) and "momentum" in str(ei.value)
+
+
+def test_schema_rejects_nan_in_bounded_fields():
+    bad = {**MINI, "server_config": {**MINI["server_config"],
+                                     "stale_prob": float("nan")}}
+    with pytest.raises(SchemaError, match="NaN"):
+        FLUTEConfig.from_dict(bad)
+
+
+def test_schema_quant_thresh_is_a_quantile():
+    bad = {**MINI, "client_config": {**MINI["client_config"],
+                                     "quant_thresh": 1.5}}
+    with pytest.raises(SchemaError, match="quant_thresh"):
+        FLUTEConfig.from_dict(bad)
