@@ -44,7 +44,7 @@ _ELEMENTWISE = frozenset({
 _REDUCTIONS = frozenset({
     "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
     "reduce_and", "reduce_or", "argmax", "argmin",
-    "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
+    "cumsum", "cumprod", "cummax", "cummin",
 })
 
 
@@ -159,7 +159,7 @@ def flops_by_op(fn, *args, **kwargs) -> Dict[str, Any]:
 
     buckets = {"dot": 0.0, "conv": 0.0, "elementwise": 0.0, "other": 0.0}
     total = visit(closed.jaxpr, 1.0, buckets)
-    out: Dict[str, Any] = {k: v for k, v in buckets.items()}
+    out: Dict[str, Any] = dict(buckets)
     out["total"] = total
     out["approximate"] = flags["approximate"]
     mxu = buckets["dot"] + buckets["conv"]

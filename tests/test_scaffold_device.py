@@ -49,6 +49,9 @@ def _cfg(rounds, *, device_controls, clients_per_round=4, epochs=2,
 
 
 def _skewed_dataset(num_users=8, n=16, seed=0):
+    # mirrors tests/test_scaffold.py::_skewed_dataset (kept local: tests/
+    # is not a package, so cross-test-module imports are fragile across
+    # pytest import modes)
     rng = np.random.default_rng(seed)
     w_true = rng.normal(size=(8, 4))
     users, per_user = [], []
