@@ -240,7 +240,9 @@ ANNEALING_FIELD_SPECS = {
 }
 
 DP_FIELD_SPECS = {
-    "eps": ("num", 0, None),
+    # eps < 0 is the documented clip-only sentinel
+    # (privacy/__init__.py::apply_local_dp) — numeric but unbounded
+    "eps": ("num", None, None),
     "delta": ("num", 0.0, 1.0),
     "max_grad": ("num", 0, None),
     "max_weight": ("num", 0, None),

@@ -119,14 +119,14 @@ def test_schema_field_type_and_range_rules():
         **MINI["client_config"],
         "num_epochs": 0,                # < 1
         "data_config": {"train": {"batch_size": 0}},  # < 1
-    }, "dp_config": {"eps": -1.0, "delta": 2.0}}
+    }, "dp_config": {"eps": -1.0, "delta": 2.0}}  # eps<0 = clip-only, OK
     with pytest.raises(SchemaError) as ei:
         FLUTEConfig.from_dict(bad)
     msg = str(ei.value)
     for frag in ("stale_prob", "rounds_per_step", "initial_val",
-                 "num_epochs", "batch_size", "dp_config.eps",
-                 "dp_config.delta"):
+                 "num_epochs", "batch_size", "dp_config.delta"):
         assert frag in msg, (frag, msg)
+    assert "dp_config.eps" not in msg  # the clip-only sentinel must pass
 
 
 def test_schema_bool_does_not_pass_as_int():
