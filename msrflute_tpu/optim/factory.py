@@ -68,6 +68,20 @@ def make_optimizer(cfg: OptimizerConfig,
         def base(learning_rate):
             return optax.lars(learning_rate, weight_decay=wd,
                               momentum=float(cfg.get("momentum", 0.9)))
+    elif kind_l == "yogi":
+        # net-new vs the reference's 7 types: as the SERVER optimizer over
+        # pseudo-gradients this is FedYogi (Reddi et al.,
+        # arXiv:2003.00295 — adam already gives FedAdam); yogi's additive
+        # second-moment update tames adam's aggressiveness under the
+        # sparse/noisy aggregate gradients federated rounds produce
+        betas = cfg.get("betas") or [0.9, 0.999]
+        def base(learning_rate):
+            tx = optax.yogi(learning_rate, b1=float(betas[0]),
+                            b2=float(betas[1]),
+                            eps=float(cfg.get("eps", 1e-3)))
+            if wd:  # optax.yogi has no weight_decay arg; chain like sgd
+                tx = optax.chain(optax.add_decayed_weights(wd), tx)
+            return tx
     else:
         raise ValueError(f"unknown optimizer type {kind!r}")
 

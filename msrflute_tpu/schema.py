@@ -35,6 +35,8 @@ ALLOWED_OPTIMIZERS = [
     "sgd", "adam", "adamax", "lars", "LarsSGD", "lamb", "adamW",
     # accepted aliases
     "adamw", "larssgd",
+    # net-new: FedYogi server optimizer (arXiv:2003.00295)
+    "yogi",
 ]
 
 ALLOWED_ANNEALING = [

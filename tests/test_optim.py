@@ -10,7 +10,8 @@ import pytest
 from msrflute_tpu.config import AnnealingConfig, OptimizerConfig
 from msrflute_tpu.optim import PlateauTracker, make_lr_schedule, make_optimizer
 
-ALL_TYPES = ["sgd", "adam", "adamax", "adamW", "lamb", "lars", "LarsSGD"]
+ALL_TYPES = ["sgd", "adam", "adamax", "adamW", "lamb", "lars", "LarsSGD",
+             "yogi"]  # yogi: FedYogi server opt (arXiv:2003.00295), net-new
 
 
 @pytest.mark.parametrize("kind", ALL_TYPES)
@@ -73,3 +74,40 @@ def test_plateau_tracker():
     assert tr.step(1.1) == 1.0   # 1 bad round <= patience
     assert tr.step(1.2) == pytest.approx(0.1)  # patience exceeded -> decay
     assert tr.step(0.5) == pytest.approx(0.1)  # new best, no further decay
+
+
+def test_fedyogi_server_optimizer_learns():
+    """yogi as the SERVER optimizer over pseudo-gradients == FedYogi
+    (arXiv:2003.00295): a full engine run must converge on separable
+    data, proving the adaptive server update composes with the round
+    program (state threads through fused chunks like any optax state)."""
+    import tempfile
+
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from conftest import make_synthetic_classification
+
+    ds = make_synthetic_classification(num_users=8, samples_lo=16,
+                                       samples_hi=16)
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 12, "num_clients_per_iteration": 8,
+            "initial_lr_client": 0.3, "rounds_per_step": 4,
+            "optimizer_config": {"type": "yogi", "lr": 0.05},
+            "val_freq": 12, "initial_val": False,
+            "best_model_criterion": "acc",
+            "data_config": {"val": {"batch_size": 32}}},
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.3},
+            "data_config": {"train": {"batch_size": 8}}},
+    })
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                    model_dir=tmp, seed=0)
+        server.train()
+        assert server.best_val["acc"].value > 0.6, server.best_val
