@@ -145,7 +145,7 @@ def build_client_update(task: BaseTask, client_opt_cfg,
                        if hparams.updatable_layers is not None else None)
 
         def one_step(carry, xs):
-            params, opt_state, rng, loss_sum, s, s2, n_acc = carry
+            params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc = carry
             batch_arrays, mask = xs
             batch = dict(batch_arrays)
             batch["sample_mask"] = mask
@@ -167,6 +167,11 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             s2 = s2 + has_data * ds2
             n_acc = n_acc + has_data * dn
             loss_sum = loss_sum + has_data * loss
+            # SAMPLE-weighted loss sum: loss is the batch's masked MEAN,
+            # so loss * sum(mask) restores the per-sample sum — dividing
+            # by (num_epochs * n_k) later gives a mean that is invariant
+            # to how the samples were split into batches (q-FFL weights)
+            wloss_acc = wloss_acc + loss * jnp.sum(mask)
             updates, new_opt = tx.update(grads, opt_state, params)
             if update_mask is not None:
                 # frozen layers never move at ANY inner step (the per-param
@@ -184,18 +189,20 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             opt_state = jax.tree.map(
                 lambda new, old: jnp.where(has_data > 0, new, old),
                 new_opt, opt_state)
-            return (params, opt_state, rng, loss_sum, s, s2, n_acc), None
+            return (params, opt_state, rng, loss_sum, s, s2, n_acc,
+                    wloss_acc), None
 
         params = global_params
         loss_sum = jnp.zeros(())
         s = jnp.zeros(())
         s2 = jnp.zeros(())
         n_acc = jnp.zeros(())
-        carry = (params, opt_state, rng, loss_sum, s, s2, n_acc)
+        wloss_acc = jnp.zeros(())
+        carry = (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc)
         for _ in range(hparams.num_epochs):
             carry, _ = jax.lax.scan(carry_step := one_step, carry,
                                     (arrays, sample_mask))
-        params, opt_state, rng, loss_sum, s, s2, n_acc = carry
+        params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc = carry
 
         pseudo_grad = jax.tree.map(lambda w0, w: w0 - w, global_params, params)
         if freeze:
@@ -209,6 +216,10 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             stats = _derive_stats(s, s2, n_acc)
 
         num_samples = jnp.sum(sample_mask)
+        # per-SAMPLE mean training loss, invariant to batch partitioning
+        # (consumed by q-FFL's fairness weights, strategies/qffl.py)
+        stats["mean_sample_loss"] = wloss_acc / jnp.maximum(
+            num_samples * hparams.num_epochs, 1.0)
         return pseudo_grad, loss_sum, num_samples, stats
 
     return client_update

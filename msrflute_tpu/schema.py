@@ -50,6 +50,8 @@ ALLOWED_STRATEGIES = [
     # reference core/strategies/__init__.py:9-23
     "dga", "DGA", "fedavg", "FedAvg", "fedprox", "FedProx",
     "fedlabels", "FedLabels", "fedac", "FedAC", "scaffold", "Scaffold",
+    # net-new: q-FFL fairness weighting (arXiv:1905.10497)
+    "qffl", "QFFL",
 ]
 
 ALLOWED_SERVER_TYPES = [
@@ -131,6 +133,7 @@ SERVER_KEYS = {
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
+    "qffl_q",
 }
 
 CLIENT_KEYS = {
@@ -182,6 +185,7 @@ SERVER_FIELD_SPECS = {
     "rounds_per_step": ("int", 1, None),
     "model_backup_freq": ("int", 1, None),
     "scaffold_flush_freq": ("int", 1, None),
+    "qffl_q": ("num", 0, None),
 }
 
 CLIENT_FIELD_SPECS = {

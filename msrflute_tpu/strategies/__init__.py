@@ -27,4 +27,7 @@ def select_strategy(name: str) -> type:
     if key == "fedlabels":
         from .fedlabels import FedLabels
         return FedLabels
+    if key == "qffl":
+        from .qffl import QFFL
+        return QFFL
     raise ValueError(f"unknown strategy {name!r}")

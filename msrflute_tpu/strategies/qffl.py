@@ -1,0 +1,66 @@
+"""q-FFL fair aggregation (arXiv:1905.10497 — net-new vs the reference).
+
+The reference ships FedAvg/FedProx/DGA/FedLabels
+(``core/strategies/__init__.py:9-23``); q-FFL adds the fairness axis: in
+the q-FFL objective ``sum_k (n_k/n) F_k(w)^{q+1} / (q+1)``, clients with
+HIGHER loss get proportionally more aggregation weight, flattening the
+accuracy distribution across heterogeneous clients instead of optimizing
+only the average.  This implements the weighting form used for the
+paper's q-FedSGD family: client weight
+
+    w_k = n_k * (mean_loss_k + eps)^q        (server_config.qffl_q)
+
+``mean_loss_k`` is ``stats['mean_sample_loss']``: the per-SAMPLE mean
+training loss (``engine/client_update.py`` accumulates
+``batch_mean_loss * batch_sample_count``), which is invariant to how
+the client's samples were split into batches — a per-step or per-``n_k``
+mean would scale with ``ceil(n_k/B)/n_k`` and silently favor clients
+whose sample count straddles a batch boundary.  It measures loss
+*during* local training rather than exactly at the broadcast weights
+``F_k(w^t)`` — the standard cheap estimator; an exact ``F_k(w^t)``
+would cost an extra forward epoch per round.
+
+``q = 0`` reduces EXACTLY to FedAvg (the sample-count factor goes
+through the same ``filter_weight`` cap FedAvg applies, so the two are
+identical weight-for-weight at any ``n_k`` — pinned by test); larger
+``q`` interpolates toward minimax fairness (AFL).  The weight is
+computed in-jit inside the same vmapped client step every strategy uses
+(``base.client_step``), so the fairness reweighting adds zero host
+round-trips and composes with DP/quantization payload transforms
+unchanged.
+
+The ``loss^q`` factor is intentionally heavy-tailed (that is the
+mechanism), so it multiplies OUTSIDE the reference MAX_WEIGHT=100 cap —
+squashing exactly the high-loss clients would silently degrade the
+strategy back toward uniform.  NaN/Inf still zero out; only relative
+weights matter (the combine normalizes by the weight sum).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import filter_weight
+from .fedavg import FedAvg
+
+#: guard rail far above any real capped_n * loss^q, not a shaping cap
+_QFFL_MAX_WEIGHT = 1e9
+
+
+class QFFL(FedAvg):
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        self.q = float(config.server_config.get("qffl_q", 1.0))
+        if self.q < 0:
+            raise ValueError(f"server_config.qffl_q must be >= 0, "
+                             f"got {self.q}")
+
+    def client_weight(self, *, num_samples, train_loss, stats, rng):
+        mean_loss = stats["mean_sample_loss"]
+        # eps floors a zero loss: a fully-fit client keeps an (epsilon)
+        # vote instead of dividing the round by zero total weight when
+        # every client has converged
+        weight = filter_weight(num_samples) * \
+            jnp.power(mean_loss + 1e-10, self.q)
+        weight = jnp.nan_to_num(weight, nan=0.0, posinf=0.0, neginf=0.0)
+        return jnp.clip(weight, 0.0, _QFFL_MAX_WEIGHT)

@@ -1,0 +1,171 @@
+"""q-FFL fairness aggregation (strategies/qffl.py, arXiv:1905.10497 —
+net-new vs the reference's strategy set).
+
+Pins: (1) q=0 reduces EXACTLY to FedAvg (the paper's boundary case — a
+wiring regression that ignores q would break this), (2) the weight
+mechanism: higher-loss clients get superlinearly more aggregation weight
+at q>0, (3) q>0 steers the trajectory away from FedAvg's on
+heterogeneous data while still learning end-to-end.
+"""
+
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+from msrflute_tpu.strategies import select_strategy
+
+
+def _cfg(strategy, rounds, q=None, lr=0.3):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": 8,
+        "initial_lr_client": lr,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "val_freq": int(rounds), "initial_val": False,
+        "best_model_criterion": "acc",
+        "data_config": {"val": {"batch_size": 32}},
+    }
+    if q is not None:
+        sc["qffl_q"] = q
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": strategy,
+        "server_config": sc,
+        "client_config": {
+            "num_epochs": 2,
+            "optimizer_config": {"type": "sgd", "lr": lr},
+            "data_config": {"train": {"batch_size": 8}}},
+    })
+
+
+def _skewed_dataset(num_users=8, n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(8, 4))
+    users, per_user = [], []
+    for u in range(num_users):
+        keep = {u % 4, (u + 1) % 4}
+        xs, ys = [], []
+        while len(ys) < n:
+            x = rng.normal(size=(8,)).astype(np.float32)
+            y = int(np.argmax(x @ w_true))
+            if y in keep:
+                xs.append(x)
+                ys.append(y)
+        users.append(f"u{u}")
+        per_user.append({"x": np.stack(xs), "y": np.asarray(ys, np.int32)})
+    return ArraysDataset(users, per_user)
+
+
+def _train(strategy, ds, rounds, tmp, *, q=None, seed=0):
+    cfg = _cfg(strategy, rounds, q=q)
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=tmp, seed=seed)
+    return server.train()
+
+
+def test_q_zero_is_exactly_fedavg():
+    ds = _skewed_dataset()
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        q_state = _train("qffl", ds, 3, t1, q=0.0, seed=4)
+        f_state = _train("fedavg", ds, 3, t2, seed=4)
+    for a, b in zip(jax.tree.leaves(q_state.params),
+                    jax.tree.leaves(f_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_weight_mechanism_favors_high_loss_clients():
+    cfg = _cfg("qffl", 1, q=2.0)
+    strat = select_strategy("qffl")(cfg)
+    ns = jnp.asarray([64.0, 64.0, 64.0])
+    msl = jnp.asarray([1.0, 2.0, 4.0])  # per-sample mean losses
+    w = np.asarray(strat.client_weight(
+        num_samples=ns, train_loss=msl * 64.0,
+        stats={"mean_sample_loss": msl}, rng=jax.random.PRNGKey(0)))
+    # q=2: weights scale with loss^2 -> ratios 1 : 4 : 16, NOT flattened
+    # by the reference MAX_WEIGHT cap even at realistic sample counts
+    np.testing.assert_allclose(w / w[0], [1.0, 4.0, 16.0], rtol=1e-5)
+    assert w[2] > 100  # the loss factor multiplies outside the n_k cap
+
+
+def test_mean_sample_loss_is_batching_invariant():
+    """The engine's mean_sample_loss stat must not depend on how samples
+    split into batches: the same 9 samples packed as one 9-wide batch or
+    as 8+1 must produce the same per-sample mean (a per-step or per-n_k
+    mean would scale with ceil(n_k/B)/n_k and corrupt q-FFL weights)."""
+    from msrflute_tpu.config import OptimizerConfig
+    from msrflute_tpu.engine.client_update import (ClientHParams,
+                                                   build_client_update)
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.config import ModelConfig
+
+    task = make_task(ModelConfig(model_type="LR",
+                                 extra={"num_classes": 4, "input_dim": 8}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    upd = build_client_update(task, OptimizerConfig(type="sgd", lr=0.0),
+                              ClientHParams())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(9, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(9,)).astype(np.int32)
+
+    def run(xs, masks):
+        arrays = {"x": jnp.asarray(xs), "y": jnp.asarray(ys_pad)}
+        _, _, _, stats = upd(params, arrays, jnp.asarray(masks),
+                             jnp.float32(0.0), jax.random.PRNGKey(1))
+        return float(stats["mean_sample_loss"])
+
+    # one 9-wide step
+    ys_pad = y[None, :]
+    one = run(x[None, :, :], np.ones((1, 9), np.float32))
+    # two steps: 8 + 1 (padded to width 8 -> widths must match per grid;
+    # use width 8 with the second row 1 real + 7 padding)
+    xs2 = np.zeros((2, 8, 8), np.float32)
+    xs2[0] = x[:8]
+    xs2[1, 0] = x[8]
+    ys2 = np.zeros((2, 8), np.int32)
+    ys2[0] = y[:8]
+    ys2[1, 0] = y[8]
+    m2 = np.zeros((2, 8), np.float32)
+    m2[0] = 1.0
+    m2[1, 0] = 1.0
+    ys_pad = ys2
+    two = run(xs2, m2)
+    np.testing.assert_allclose(one, two, rtol=1e-6)
+
+
+def test_qffl_rejects_negative_q():
+    # the schema's field spec fires first, at config parse
+    from msrflute_tpu.schema import SchemaError
+    with pytest.raises(SchemaError, match="qffl_q"):
+        _cfg("qffl", 1, q=-1.0)
+    # the strategy's own guard backs it up for programmatic construction
+    cfg = _cfg("qffl", 1)
+    cfg.server_config["qffl_q"] = -1.0
+    with pytest.raises(ValueError, match="qffl_q"):
+        select_strategy("qffl")(cfg)
+
+
+def test_q_positive_diverges_from_fedavg_and_learns():
+    ds = _skewed_dataset()
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        cfg = _cfg("qffl", 10, q=2.0)
+        task = make_task(cfg.model_config)
+        server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                    model_dir=t1, seed=4)
+        q_state = server.train()
+        assert server.best_val["acc"].value > 0.7, server.best_val
+        f_state = _train("fedavg", ds, 10, t2, seed=4)
+    diff = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+               for a, b in zip(jax.tree.leaves(q_state.params),
+                               jax.tree.leaves(f_state.params)))
+    assert diff > 1e-4, f"params identical ({diff=}): q not applied"
