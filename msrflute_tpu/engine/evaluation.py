@@ -64,6 +64,65 @@ def build_eval_fn(task: BaseTask, mesh: Mesh,
     return jax.jit(fn)
 
 
+def build_per_user_eval_fn(task: BaseTask, mesh: Mesh, n_users: int,
+                           partition_mode: str = "shard_map") -> Callable:
+    """Jitted ``(params, batches) -> (correct [n_users], count [n_users])``
+    classification accuracy segmented by the eval grid's ``user_idx``.
+
+    Fairness observability (the q-FFL / AFL complement — aggregate
+    accuracy hides the client dispersion those strategies optimize): one
+    scan over the same packed eval grid the metric eval uses, with
+    per-sample correctness scattered into per-user sums
+    (``.at[].add(mode="drop")``; padding rows map out of bounds).
+    Requires a classification-style task (``task.apply`` + ``y`` labels).
+    """
+    cspec = P(CLIENTS_AXIS)
+    rspec = P()
+
+    def shard_body(params, batches):
+        def body(carry, batch):
+            c, t = carry
+            pred = jnp.argmax(task.apply(params, batch["x"]), axis=-1)
+            correct = (pred == batch["y"].astype(jnp.int32)).astype(
+                jnp.float32) * batch["sample_mask"]
+            uid = batch["user_idx"]
+            # -1 padding must NOT wrap to the last user: send it out of
+            # bounds so mode="drop" discards it
+            uid = jnp.where(uid >= 0, uid, n_users)
+            c = c.at[uid].add(correct, mode="drop")
+            t = t.at[uid].add(batch["sample_mask"], mode="drop")
+            return (c, t), None
+
+        zero = (jnp.zeros((n_users,), jnp.float32),
+                jnp.zeros((n_users,), jnp.float32))
+        (c, t), _ = jax.lax.scan(body, zero, batches)
+        if partition_mode == "shard_map":
+            c = jax.lax.psum(c, CLIENTS_AXIS)
+            t = jax.lax.psum(t, CLIENTS_AXIS)
+        return c, t
+
+    if partition_mode == "shard_map":
+        fn = shard_map(shard_body, mesh=mesh,
+                       in_specs=(rspec, cspec), out_specs=rspec,
+                       check_vma=False)
+    else:
+        fn = shard_body
+    return jax.jit(fn)
+
+
+def per_user_accuracy(per_user_fn: Callable, params: Any,
+                      batches: Dict[str, np.ndarray], mesh: Mesh,
+                      partition_mode: str = "shard_map") -> np.ndarray:
+    """Per-user accuracy vector (NaN where a user had no eval samples)."""
+    spec = P(CLIENTS_AXIS) if partition_mode == "shard_map" else P()
+    sharding = NamedSharding(mesh, spec)
+    staged = {k: jax.device_put(v, sharding) for k, v in batches.items()}
+    c, t = jax.device_get(per_user_fn(params, staged))
+    c, t = np.asarray(c, np.float64), np.asarray(t, np.float64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(t > 0, c / np.maximum(t, 1.0), np.nan)
+
+
 def evaluate(task: BaseTask, eval_fn: Callable, params: Any,
              batches: Dict[str, np.ndarray], mesh: Mesh,
              partition_mode: str = "shard_map") -> MetricsDict:

@@ -188,6 +188,7 @@ class OptimizationServer:
         self._eval_fn = build_eval_fn(task, self.mesh,
                                       self.engine.partition_mode)
         self._eval_batches_cache: Dict[str, Any] = {}
+        self._per_user_fns: Dict[str, Any] = {}
         self._np_rng = np.random.default_rng(seed)
         self._rng = jax.random.PRNGKey(seed)
         self.run_stats: Dict[str, list] = {
@@ -845,6 +846,8 @@ class OptimizationServer:
             log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
         if self._split_cfg(split).get("wantLogits", False):
             self._dump_predictions(split, round_no)
+        if self._split_cfg(split).get("per_user_stats", False):
+            self._log_per_user_stats(split, round_no, dataset)
 
         improved = False
         if split == "val":
@@ -857,6 +860,45 @@ class OptimizationServer:
                     if name == self.best_model_criterion:
                         improved = True
         return improved
+
+    def _log_per_user_stats(self, split: str, round_no: int,
+                            dataset) -> None:
+        """Per-user accuracy dispersion when the split's data_config sets
+        ``per_user_stats`` — the fairness observability the aggregate
+        metric hides (and what q-FFL/AFL-style strategies optimize):
+        worst / p10 / p50 / p90 / std of per-user accuracy, plus the
+        evaluated-user count.  Classification-style tasks only: needs
+        ``task.apply`` producing per-sample class logits AND ``y`` labels
+        in the eval grid (BERT MLM has ``apply`` but no ``y``; sequence
+        tasks have neither) — anything else warns and skips."""
+        batches = self._packed_eval_batches(split)
+        if not hasattr(self.task, "apply") or "y" not in batches:
+            print_rank(f"per_user_stats set for {split} but task "
+                       f"{type(self.task).__name__} is not "
+                       "classification-style (needs apply() + y labels); "
+                       "skipping", loglevel=logging.WARNING)
+            return
+        from .evaluation import build_per_user_eval_fn, per_user_accuracy
+        if split not in self._per_user_fns:
+            self._per_user_fns[split] = build_per_user_eval_fn(
+                self.task, self.mesh, len(dataset),
+                self.engine.partition_mode)
+        accs = per_user_accuracy(self._per_user_fns[split],
+                                 self.state.params, batches,
+                                 self.mesh, self.engine.partition_mode)
+        accs = accs[~np.isnan(accs)]
+        if accs.size == 0:
+            return
+        cap = split.capitalize()
+        log_metric(f"{cap} acc (worst user)", float(accs.min()),
+                   step=round_no)
+        for pct in (10, 50, 90):
+            log_metric(f"{cap} acc (user p{pct})",
+                       float(np.percentile(accs, pct)), step=round_no)
+        log_metric(f"{cap} acc (user std)", float(accs.std()),
+                   step=round_no)
+        log_metric(f"{cap} acc (users evaluated)", int(accs.size),
+                   step=round_no)
 
     def _dump_predictions(self, split: str, round_no: int,
                           topk: int = 3) -> None:

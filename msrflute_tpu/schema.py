@@ -85,7 +85,7 @@ DATASET_KEYS = {
     "max_grad_norm", "utterance_mvn", "unsorted_batch",
     # TPU-native extensions
     "device_resident", "lazy", "lazy_cache_users", "augment", "wantLogits",
-    "step_bucketing", "length_bucketing",
+    "step_bucketing", "length_bucketing", "per_user_stats",
 }
 
 DATACONFIG_KEYS = {"train", "val", "test", "num_clients"}
@@ -221,6 +221,7 @@ DATASET_FIELD_SPECS = {
     "unsorted_batch": ("bool", None, None),
     "step_bucketing": ("bool", None, None),
     "length_bucketing": ("bool", None, None),
+    "per_user_stats": ("bool", None, None),
 }
 
 OPTIMIZER_FIELD_SPECS = {

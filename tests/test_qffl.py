@@ -169,3 +169,67 @@ def test_q_positive_diverges_from_fedavg_and_learns():
                for a, b in zip(jax.tree.leaves(q_state.params),
                                jax.tree.leaves(f_state.params)))
     assert diff > 1e-4, f"params identical ({diff=}): q not applied"
+
+
+def test_per_user_accuracy_matches_manual_eval():
+    """build_per_user_eval_fn (fairness observability companion): the
+    segmented per-user accuracy vector must equal a per-user manual eval
+    of the same params, with padding rows dropped (not wrapped onto the
+    last user)."""
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.data.batching import pack_eval_batches
+    from msrflute_tpu.engine.evaluation import (build_per_user_eval_fn,
+                                                per_user_accuracy)
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+    task = make_task(ModelConfig(model_type="LR",
+                                 extra={"num_classes": 4, "input_dim": 8}))
+    params = task.init_params(jax.random.PRNGKey(2))
+    rng = np.random.default_rng(1)
+    users, per_user = [], []
+    for u in range(3):
+        n = [5, 9, 3][u]
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=(n,)).astype(np.int32)
+        users.append(f"u{u}")
+        per_user.append({"x": x, "y": y})
+    ds = ArraysDataset(users, per_user)
+
+    mesh = make_mesh()
+    batches = pack_eval_batches(
+        ds, batch_size=4,
+        pad_steps_to_multiple_of=int(mesh.shape[CLIENTS_AXIS]))
+    fn = build_per_user_eval_fn(task, mesh, n_users=3)
+    accs = per_user_accuracy(fn, params, batches, mesh)
+
+    for u in range(3):
+        logits = task.apply(params, jnp.asarray(per_user[u]["x"]))
+        manual = float(np.mean(np.argmax(np.asarray(logits), axis=-1)
+                               == per_user[u]["y"]))
+        np.testing.assert_allclose(accs[u], manual, rtol=1e-6)
+
+
+def test_per_user_stats_cli_metrics(tmp_path):
+    """per_user_stats: true on the val split logs worst/percentile/std
+    per-user accuracy metrics from the real server eval path."""
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.utils.logging import init_logging
+
+    log_dir = tmp_path / "log"
+    init_logging(str(log_dir))
+    ds = _skewed_dataset()
+    cfg = _cfg("qffl", 2, q=1.0)
+    cfg.server_config.data_config.val["per_user_stats"] = True
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), seed=0)
+    server.train()
+    import json
+    names = set()
+    with open(log_dir / "metrics.jsonl") as fh:
+        for line in fh:
+            names.add(json.loads(line)["name"])
+    assert "Val acc (worst user)" in names, sorted(names)
+    assert "Val acc (user p50)" in names
