@@ -253,8 +253,13 @@ class OptimizationServer:
         # DeviceControlTable).  Built AFTER the resume/reset decision so
         # the table warms up from exactly the controls the run keeps.
         self.scaffold_device = None
-        if self.scaffold_store is not None and \
-                sc.get("scaffold_device_controls", False):
+        if sc.get("scaffold_device_controls", False):
+            if self.scaffold_store is None:
+                raise ValueError(
+                    "server_config.scaffold_device_controls requires "
+                    "strategy: scaffold — with "
+                    f"{type(self.strategy).__name__} there are no "
+                    "controls to keep on device; drop the flag")
             from ..strategies.scaffold import DeviceControlTable
             self.scaffold_device = DeviceControlTable(
                 self.scaffold_store, len(train_dataset), self.mesh)

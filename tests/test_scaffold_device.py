@@ -15,6 +15,7 @@ import tempfile
 
 import jax
 import numpy as np
+import pytest
 
 from msrflute_tpu.config import FLUTEConfig
 from msrflute_tpu.data import ArraysDataset
@@ -179,6 +180,32 @@ def test_fallback_resets_device_table():
             jax.device_get(dev.table))).max()) == 0
         assert np.linalg.norm(server.scaffold_store.c) == 0
         assert server.scaffold_store.persisted_client_ids() == []
+
+
+def test_device_controls_require_scaffold_strategy():
+    """scaffold_device_controls with a non-scaffold strategy must fail
+    loudly — silently ignoring the flag would let a user believe the
+    HBM control table is active when no controls exist at all."""
+    ds = _skewed_dataset(num_users=4)
+    cfg = _cfg(2, device_controls=True)
+    cfg.strategy = "fedavg"
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        with pytest.raises(ValueError, match="scaffold_device_controls"):
+            OptimizationServer(task, cfg, ds, model_dir=tmp, seed=0)
+
+
+def test_device_pool_rejected_for_host_rounds():
+    """data_config.train.device_resident with a host-orchestrated
+    strategy (scaffold) must error: those rounds use the host payload
+    path, so the HBM pool would cost memory for zero benefit."""
+    ds = _skewed_dataset(num_users=4)
+    cfg = _cfg(2, device_controls=False)
+    cfg.client_config.data_config.train["device_resident"] = True
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        with pytest.raises(ValueError, match="device_resident"):
+            OptimizationServer(task, cfg, ds, model_dir=tmp, seed=0)
 
 
 def test_schema_accepts_device_control_keys():
