@@ -92,9 +92,16 @@ class _GRUWordLM(nn.Module):
             _ConvexGRUCell, variable_broadcast="params",
             split_rngs={"params": False}, in_axes=1, out_axes=1,
         )(hidden=self.hidden_dim)(carry, emb)
+        # the reference stacks [h0, h1, ..., hL] (``GRU2.forward``,
+        # ``nlg_gru/model.py:31-36``): the zero INITIAL state's prediction
+        # — the marginal next-word distribution — is part of the output
+        # and of the loss (``model.py:92-100`` pairs output[:, t] with
+        # input[:, t], including t=0 from h0)
+        hiddens = jnp.concatenate(
+            [jnp.zeros_like(hiddens[:, :1]), hiddens], axis=1)
         squeezed = nn.Dense(self.embed_dim, use_bias=False, name="squeeze")(hiddens)
         logits = squeezed @ table.T + unembed_bias
-        return logits  # [B, L, V]
+        return logits  # [B, L+1, V]
 
 
 class SequenceLMTask(BaseTask):
@@ -115,6 +122,13 @@ class SequenceLMTask(BaseTask):
     #: bucket from under-counting unk tokens.
     seq_pad_keys = ("x", "y", "tok_mask")
 
+    #: reference-GRU loss alignment (``nlg_gru/model.py:92-100``): the
+    #: module emits one MORE position than its input (the initial zero
+    #: state's prediction), the forward consumes ``x[:, :-1]``, and the
+    #: targets are the FULL ``x`` — position 0 is predicted from h0.
+    #: False = standard shift alignment (Shakespeare implicit / RingLM).
+    ref_initial_prediction: bool = False
+
     def __init__(self, module: nn.Module, seq_len: int, name: str,
                  oov_reject: bool = False):
         self.module = module
@@ -129,7 +143,20 @@ class SequenceLMTask(BaseTask):
     def _logits_targets(self, params, batch: Batch):
         x = batch["x"].astype(jnp.int32)
         if "y" in batch and batch["y"].ndim == x.ndim:
-            inputs, targets = x, batch["y"].astype(jnp.int32)
+            # explicit per-position targets: with ref_initial_prediction
+            # the module emits len(inputs)+1 positions, so feed L-1
+            # inputs to keep logits aligned with the [B, L] targets
+            # (y[t] is predicted from the state after x[0..t-1], with
+            # y[0] from the initial state)
+            inputs = x[:, :-1] if self.ref_initial_prediction else x
+            targets = batch["y"].astype(jnp.int32)
+            tok_mask = batch.get("tok_mask")
+            tok_mask = (tok_mask.astype(jnp.float32) if tok_mask is not None
+                        else (targets != 0).astype(jnp.float32))
+        elif self.ref_initial_prediction:
+            # reference-GRU alignment: module([B, L-1]) -> [B, L, V]
+            # (initial-state prediction included); targets = full x
+            inputs, targets = x[:, :-1], x
             tok_mask = batch.get("tok_mask")
             tok_mask = (tok_mask.astype(jnp.float32) if tok_mask is not None
                         else (targets != 0).astype(jnp.float32))
@@ -257,6 +284,8 @@ class ShakespeareTask(_TokenDatasetMixin, SequenceLMTask):
 
 class GRUWordTask(_TokenDatasetMixin, SequenceLMTask):
     tokenizer = "words"
+    # the reference GRU trains position 0 from the zero initial state
+    ref_initial_prediction = True
 
 
 def make_shakespeare_lstm_task(model_config) -> SequenceLMTask:

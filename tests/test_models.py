@@ -155,7 +155,9 @@ def test_prediction_outputs():
     batch = {"x": jnp.asarray(x, jnp.int32),
              "sample_mask": jnp.asarray([1.0, 0.0])}
     probs, ids, labels = task.topk_predictions(params, batch, k=3)
-    assert probs.shape == (2, 5, 3) and ids.shape == (2, 5, 3)
+    # reference-GRU alignment: all L positions are predicted (position 0
+    # from the zero initial state, nlg_gru/model.py:92-100)
+    assert probs.shape == (2, 6, 3) and ids.shape == (2, 6, 3)
     assert np.all(np.asarray(labels[1]) == -1)  # masked sequence
     assert np.all(np.asarray(probs) <= 1.0)
 
@@ -166,3 +168,23 @@ def test_prediction_outputs():
               "sample_mask": jnp.asarray([1.0, 1.0, 0.0])}
     logits, pred, labels = ctask.predict(cparams, cbatch)
     assert logits.shape == (3, 4) and int(labels[2]) == -1
+
+
+def test_gru_explicit_targets_align_with_initial_prediction():
+    """ref_initial_prediction + explicit per-position targets: the module
+    emits len(inputs)+1 positions, so the explicit-y path must feed
+    x[:, :-1] to keep logits [B, L, V] aligned with y [B, L]."""
+    task = make_task(ModelConfig(model_type="GRU",
+                                 extra={"vocab_size": 30, "embed_dim": 8,
+                                        "hidden_dim": 16,
+                                        "max_num_words": 6}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 30, size=(2, 6))
+    y = rng.integers(1, 30, size=(2, 6))
+    batch = {"x": jnp.asarray(x, jnp.int32), "y": jnp.asarray(y, jnp.int32),
+             "sample_mask": jnp.ones((2,), jnp.float32)}
+    loss, _ = task.loss(params, batch, jax.random.PRNGKey(0), True)
+    assert np.isfinite(float(loss))
+    stats = task.eval_stats(params, batch)
+    assert float(stats["sample_count"]) == 12  # all L positions real

@@ -1,0 +1,5 @@
+"""Parity adapter dataloader: the reference nlg_gru DataLoader unchanged
+— its Dataset already json-loads a str data path, and the string
+utterances tokenize through the shared vocab file (case-backoff is a
+no-op for in-vocab words)."""
+from experiments.nlg_gru.dataloaders.dataloader import DataLoader  # noqa: F401

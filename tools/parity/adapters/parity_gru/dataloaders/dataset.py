@@ -1,0 +1,5 @@
+"""Parity adapter dataset: the reference nlg_gru Dataset unchanged — it
+already json-loads a str data path (``load_data``), and string
+utterances go through the same vocab/case-backoff tokenization both
+frameworks share."""
+from experiments.nlg_gru.dataloaders.dataset import Dataset  # noqa: F401

@@ -1,0 +1,4 @@
+"""Parity-harness adapter task: re-exports the REFERENCE nlg_gru GRU
+model class unchanged (``experiments/nlg_gru/model.py:57``) so the
+cross-framework comparison trains the reference's own torch code."""
+from experiments.nlg_gru.model import GRU  # noqa: F401
