@@ -815,16 +815,22 @@ def run_task(task, rounds, scratch):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--tasks", default="lr,cnn,lstm")
+    ap.add_argument("--tasks", default="lr,cnn,lstm,gru")
     ap.add_argument("--rounds", type=int, default=None,
                     help="override every task's round count "
                          "(default: per-task, see ROUNDS_BY_TASK)")
     ap.add_argument("--scratch", default="/tmp/parity_scratch")
     ap.add_argument("--out", default=os.path.join(REPO, "PARITY.json"))
+    ap.add_argument("--merge", action="store_true",
+                    help="update only --tasks entries in an existing "
+                         "--out instead of overwriting the whole file")
     args = ap.parse_args()
 
     os.makedirs(args.scratch, exist_ok=True)
     results = {}
+    if args.merge and os.path.exists(args.out):
+        with open(args.out) as fh:
+            results = json.load(fh)
     for task in args.tasks.split(","):
         results[task] = run_task(task.strip(), args.rounds, args.scratch)
         r = results[task]
