@@ -75,6 +75,96 @@ def test_lstm_weight_transplant_forward_exact(tmp_path):
     assert abs(loss_t - loss_j) < 1e-5, (loss_t, loss_j)
 
 
+def test_gru_weight_transplant_forward_exact(tmp_path):
+    """The torch-GRU2 -> flax _ConvexGRUCell transplant (stacked r/i/n
+    gates, kernel transposes, tied embedding + squeeze) must produce the
+    same forward loss on the same batch — including the reference's
+    initial-zero-state prediction of token 0
+    (SequenceLMTask.ref_initial_prediction).  The torch side replicates
+    the reference architecture (experiments/nlg_gru/model.py:11-83)
+    with standard modules, so no reference mount is needed."""
+    import numpy as np
+    torch = pytest.importorskip("torch")
+    from torch import nn
+
+    sys.path.insert(0, os.path.join(REPO, "tools", "parity"))
+    from run_parity import GRU_DIMS, gru_init, save_flax_gru, save_torch_gru
+
+    V, E, H, L = (GRU_DIMS["vocab_size"], GRU_DIMS["embed_dim"],
+                  GRU_DIMS["hidden_dim"], 12)
+    init = gru_init(np.random.default_rng(3), V, E, H)
+    pt, mp = str(tmp_path / "g.pt"), str(tmp_path / "g.msgpack")
+    save_torch_gru(init, pt)
+    save_flax_gru(init, mp)
+
+    class GRU2(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.w_ih = nn.Linear(E, 3 * H, True)
+            self.w_hh = nn.Linear(H, 3 * H, True)
+
+        def forward(self, inp):
+            hiddens = [torch.zeros((inp.shape[0], H))]
+            for t in range(inp.shape[1]):
+                g_i = self.w_ih(inp[:, t])
+                g_h = self.w_hh(hiddens[-1])
+                i_r, i_i, i_n = g_i.chunk(3, 1)
+                h_r, h_i, h_n = g_h.chunk(3, 1)
+                r = torch.sigmoid(i_r + h_r)
+                i = torch.sigmoid(i_i + h_i)
+                n = torch.tanh(i_n + r * h_n)
+                hiddens.append(n + i * (hiddens[-1] - n))
+            return torch.stack(hiddens, dim=1)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.table = nn.Parameter(torch.zeros((V, E)))
+            self.unembedding_bias = nn.Parameter(torch.zeros(V))
+            self.rnn = GRU2()
+            self.squeeze = nn.Linear(H, E, bias=False)
+
+        def forward(self, x):
+            hid = self.rnn(nn.functional.embedding(x, self.table))
+            return self.squeeze(hid) @ self.table.t() + \
+                self.unembedding_bias
+
+    net = Net()
+    sd = torch.load(pt)
+    net.load_state_dict({
+        "table": sd["embedding.table"],
+        "unembedding_bias": sd["embedding.unembedding_bias"],
+        "rnn.w_ih.weight": sd["rnn.w_ih.weight"],
+        "rnn.w_ih.bias": sd["rnn.w_ih.bias"],
+        "rnn.w_hh.weight": sd["rnn.w_hh.weight"],
+        "rnn.w_hh.bias": sd["rnn.w_hh.bias"],
+        "squeeze.weight": sd["squeeze.weight"]})
+    x = np.random.default_rng(5).integers(1, V, size=(4, L))
+    xt = torch.tensor(x)
+    with torch.no_grad():
+        out = net(xt[:, :-1])  # [B, L, V] incl. the h0 prediction
+        loss_t = float(nn.functional.cross_entropy(
+            out.reshape(-1, V), xt.reshape(-1)))
+
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    task = make_task(ModelConfig(model_type="GRU", extra=dict(
+        GRU_DIMS, max_num_words=L)))
+    params = task.init_params(jax.random.PRNGKey(0))
+    with open(mp, "rb") as fh:
+        params = serialization.from_state_dict(
+            params, serialization.msgpack_restore(fh.read()))
+    batch = {"x": jnp.asarray(x, jnp.int32),
+             "sample_mask": jnp.ones((4,), jnp.float32)}
+    loss_j = float(task.loss(params, batch, jax.random.PRNGKey(0),
+                             False)[0])
+    assert abs(loss_t - loss_j) < 1e-5, (loss_t, loss_j)
+
+
 @pytest.mark.skipif(not os.path.isdir("/root/reference"),
                     reason="reference mount not available")
 def test_lr_trajectory_exact(tmp_path):
