@@ -24,6 +24,13 @@ timeout first; on failure/hang the harness falls back to a CPU run (numbers
 then only mean "the harness completes", not "vs baseline") and still emits
 its JSON contract.  The probe child is never SIGKILLed — a killed TPU claim
 wedges the tunnel for subsequent processes.
+
+Deadline contract: the JSON line is emitted even if this process is
+SIGTERMed mid-run or its caller's deadline expires — results accumulate in
+a module-global line state, kill-signal handlers flush it, and the
+chip-wait budget is capped by ``BENCH_DEADLINE_SECS`` (default 25 min)
+so probing can never outlive the caller's patience (the round-3 failure).
+``BENCH_PARTIAL.json`` mirrors progress on disk against SIGKILL.
 """
 
 from __future__ import annotations
@@ -31,6 +38,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -53,6 +61,88 @@ HEADLINE = "cnn_femnist"
 # against the bf16 peak even for f32 programs — a deliberately conservative
 # denominator, stated here so the number is interpretable.
 V5E_BF16_PEAK_FLOPS = 197e12
+
+
+# ----------------------------------------------------------------------
+# deadline discipline: the JSON contract must survive being killed
+# ----------------------------------------------------------------------
+# Round-3 failure mode (`BENCH_r03.json` rc=124, no JSON): the driver's
+# `timeout` SIGTERMed this process while it was still inside its own
+# chip-wait budget, so the "always emits its JSON line" promise broke
+# exactly when it mattered.  Three rules now make that impossible:
+#
+#   1. A module-global line state (`_LINE`) is updated incrementally as
+#      each protocol finishes, so a flush at ANY moment carries every
+#      result obtained so far.
+#   2. SIGTERM/SIGALRM handlers flush that state to stdout and exit.
+#      (SIGKILL can't be caught; for that, each update also mirrors the
+#      state to `BENCH_PARTIAL.json` on disk.)
+#   3. The chip-wait budget is subordinate to the caller's deadline:
+#      `BENCH_DEADLINE_SECS` (or the conservative default) caps total
+#      runtime; probing never eats into the margin reserved for a CPU
+#      fallback run + flush.
+_LINE = {
+    "metric": f"{HEADLINE}_secs_per_round",
+    "value": None,
+    "unit": "s/round",
+    "vs_baseline": None,
+    "extras": {},
+}
+_FLUSHED = False
+_START = time.time()
+# If the caller doesn't say how long we may run, assume a driver-style
+# timeout and keep total runtime under it.  35 min outlived the round-3
+# driver's patience; default the *total* ceiling well under that.
+_DEADLINE_SECS = float(os.environ.get("BENCH_DEADLINE_SECS", 25 * 60))
+
+
+def _remaining() -> float:
+    return _DEADLINE_SECS - (time.time() - _START)
+
+
+def _flush(note: str | None = None) -> None:
+    """Emit the JSON contract line exactly once, whatever state we're in."""
+    global _FLUSHED
+    if _FLUSHED:
+        return
+    _FLUSHED = True
+    if note:
+        _LINE["extras"]["flush_note"] = note
+    head = _LINE["extras"].get(HEADLINE, {})
+    if isinstance(head, dict):
+        _LINE["value"] = head.get("secs_per_round")
+        _LINE["vs_baseline"] = head.get("vs_baseline")
+    sys.stdout.write(json.dumps(_LINE) + "\n")
+    sys.stdout.flush()
+
+
+def _mirror_partial() -> None:
+    """Best-effort on-disk mirror of the current line state (survives
+    even SIGKILL; overwritten by every later update)."""
+    try:
+        with open(os.path.join(REPO_ROOT, "BENCH_PARTIAL.json"), "w") as fh:
+            json.dump(_LINE, fh, indent=1)
+    except Exception:
+        pass
+
+
+def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
+    _flush(f"killed by signal {signum} after {time.time() - _START:.0f}s; "
+           "partial results")
+    _mirror_partial()
+    # exit immediately: we may be inside a wedged TPU call that never
+    # returns; os._exit skips atexit/GC that could block on the backend
+    os._exit(0)
+
+
+def install_deadline_guards() -> None:
+    """SIGTERM/SIGALRM -> flush-and-exit; SIGALRM armed a safety margin
+    before the deadline so we self-flush even if nobody signals us."""
+    signal.signal(signal.SIGTERM, _on_kill_signal)
+    signal.signal(signal.SIGALRM, _on_kill_signal)
+    margin = 20.0
+    alarm_in = max(int(_remaining() - margin), 1)
+    signal.alarm(alarm_in)
 
 
 # ----------------------------------------------------------------------
@@ -112,7 +202,12 @@ def select_backend(probe_timeout: float = 180.0):
     if want in ("tpu", "cpu"):
         backend, reason = want, f"BENCH_BACKEND={want} override"
     else:
-        budget = float(os.environ.get("BENCH_TPU_WAIT_SECS", 35 * 60))
+        # the chip-wait budget may not eat the whole caller deadline: a
+        # CPU fallback run still has to fit after a failed wait (round-3
+        # lesson — the 35-min default outlived the driver's timeout)
+        budget = float(os.environ.get("BENCH_TPU_WAIT_SECS", 10 * 60))
+        budget = max(0.0, min(budget, _remaining() * 0.4))
+        probe_timeout = min(probe_timeout, max(budget, 30.0))
         deadline = time.time() + budget
         attempt = 0
         while True:
@@ -533,6 +628,7 @@ def scale_probe(backend: str) -> dict:
 
 
 def main() -> None:
+    install_deadline_guards()
     backend, backend_reason = select_backend()
     on_tpu = backend == "tpu"
     if on_tpu:
@@ -552,7 +648,8 @@ def main() -> None:
     if keep is not None:
         protocols = {k: v for k, v in protocols.items() if k in keep}
 
-    extras = {"backend": backend, "backend_reason": backend_reason}
+    extras = _LINE["extras"]  # global so a kill-signal flush sees updates
+    extras.update({"backend": backend, "backend_reason": backend_reason})
     if not on_tpu:
         # CPU fallback: point at the most recent committed raw on-chip
         # artifact, if any (written only by a fully successful TPU
@@ -577,6 +674,10 @@ def main() -> None:
                 "note": "most recent committed on-chip capture; "
                         "NOT this run's measurement"}
     for name, spec in protocols.items():
+        if _remaining() < 60:
+            extras[name] = {"skipped": "caller deadline imminent"}
+            _mirror_partial()
+            continue
         try:
             extras[name] = bench_protocol(
                 name, spec["cfg"], spec["data"](), eval_users=8,
@@ -585,10 +686,11 @@ def main() -> None:
                 want_mfu=on_tpu)  # MFU on every protocol (judging input)
         except Exception as exc:  # one bad protocol must not kill the line
             extras[name] = {"error": f"{type(exc).__name__}: {exc}"}
+        _mirror_partial()  # SIGKILL-proof evidence of progress so far
 
     # longctx respects the same BENCH_PROTOCOLS narrowing as the others
     if (on_tpu or os.environ.get("BENCH_LONGCTX")) and \
-            (keep is None or "longctx_ringlm" in keep):
+            (keep is None or "longctx_ringlm" in keep) and _remaining() > 60:
         try:
             extras["longctx_ringlm"] = bench_longctx(on_tpu)
         except Exception as exc:
@@ -596,7 +698,7 @@ def main() -> None:
                 "error": f"{type(exc).__name__}: {exc}"}
 
     if (on_tpu or os.environ.get("BENCH_VARLEN")) and \
-            (keep is None or "varlen_bucketing" in keep):
+            (keep is None or "varlen_bucketing" in keep) and _remaining() > 60:
         try:
             extras["varlen_bucketing"] = bench_varlen_bucketing(on_tpu)
         except Exception as exc:
@@ -606,24 +708,26 @@ def main() -> None:
     if os.environ.get("BENCH_SCALE_PROBE"):
         extras["scale_probe"] = scale_probe(backend)
 
-    head = extras.get(HEADLINE, {})
-    line = {
-        "metric": f"{HEADLINE}_secs_per_round",
-        "value": head.get("secs_per_round"),
-        "unit": "s/round",
-        "vs_baseline": head.get("vs_baseline"),
-        "extras": extras,
-    }
     if on_tpu:
         # raw on-chip evidence is a committed artifact, not prose: every
         # successful TPU run leaves a timestamped JSON in the repo root
+        head = extras.get(HEADLINE, {})
         stamp = time.strftime("%Y%m%d_%H%M%S")
         path = os.path.join(REPO_ROOT, f"BENCH_TPU_{stamp}.json")
         with open(path, "w") as fh:
-            json.dump(dict(line, captured_at=stamp), fh, indent=1)
+            json.dump(dict(_LINE, value=head.get("secs_per_round"),
+                           vs_baseline=head.get("vs_baseline"),
+                           captured_at=stamp), fh, indent=1)
         print(f"[bench] raw on-chip artifact: {path}", file=sys.stderr)
-    print(json.dumps(line))
+    signal.alarm(0)  # the line is about to go out; disarm the self-flush
+    _flush()
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 - contract: always emit
+        if not _FLUSHED:
+            _flush(f"crashed: {type(exc).__name__}: {exc}")
+            _mirror_partial()
+        raise
