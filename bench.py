@@ -114,6 +114,13 @@ def _flush(note: str | None = None) -> None:
         _LINE["vs_baseline"] = head.get("vs_baseline")
     sys.stdout.write(json.dumps(_LINE) + "\n")
     sys.stdout.flush()
+    # a fully-delivered line supersedes the on-disk partial mirror: a
+    # stale one would read as evidence of an aborted run
+    if not note:
+        try:
+            os.remove(os.path.join(REPO_ROOT, "BENCH_PARTIAL.json"))
+        except OSError:
+            pass
 
 
 def _mirror_partial() -> None:
@@ -126,10 +133,29 @@ def _mirror_partial() -> None:
         pass
 
 
+#: the live chip-probe subprocess, if one is in flight (see _probe_once) —
+#: the kill handler must SIGTERM it gracefully, never abandon or SIGKILL a
+#: TPU-claiming child (an orphaned/killed claim wedges the tunnel)
+_LIVE_PROBE = None
+
+
 def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
     _flush(f"killed by signal {signum} after {time.time() - _START:.0f}s; "
            "partial results")
+    # _flush no-ops if the main thread already emitted the line but may
+    # not have drained the pipe yet — drain unconditionally, or os._exit
+    # below discards buffered stdio and stdout ends up empty after all
+    try:
+        sys.stdout.flush()
+    except Exception:
+        pass
     _mirror_partial()
+    if _LIVE_PROBE is not None and _LIVE_PROBE.poll() is None:
+        try:
+            _LIVE_PROBE.terminate()  # graceful; give the claim a chance
+            _LIVE_PROBE.wait(timeout=10)
+        except Exception:
+            pass
     # exit immediately: we may be inside a wedged TPU call that never
     # returns; os._exit skips atexit/GC that could block on the backend
     os._exit(0)
@@ -137,10 +163,12 @@ def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
 
 def install_deadline_guards() -> None:
     """SIGTERM/SIGALRM -> flush-and-exit; SIGALRM armed a safety margin
-    before the deadline so we self-flush even if nobody signals us."""
+    before the deadline so we self-flush even if nobody signals us.  The
+    margin scales down with small deadlines so jax import + backend
+    selection still fit inside tiny test budgets."""
     signal.signal(signal.SIGTERM, _on_kill_signal)
     signal.signal(signal.SIGALRM, _on_kill_signal)
-    margin = 20.0
+    margin = min(20.0, _DEADLINE_SECS * 0.2)
     alarm_in = max(int(_remaining() - margin), 1)
     signal.alarm(alarm_in)
 
@@ -160,10 +188,12 @@ print("TPU_PROBE_OK", flush=True)
 def _probe_once(probe_timeout: float):
     """One subprocess chip probe.  Returns ``(ok, reason)``; the child is
     never SIGKILLed (a killed TPU claim wedges the single-client tunnel)."""
+    global _LIVE_PROBE
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_CODE],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        _LIVE_PROBE = proc  # kill handler SIGTERMs it instead of orphaning
         try:
             out, err = proc.communicate(timeout=probe_timeout)
             if proc.returncode == 0 and "TPU_PROBE_OK" in (out or ""):
@@ -181,6 +211,8 @@ def _probe_once(probe_timeout: float):
                 pass  # abandon it; this attempt is over either way
             return False, (f"probe hung >{probe_timeout:.0f}s "
                            "(TPU tunnel init wedged)")
+        finally:
+            _LIVE_PROBE = None
     except Exception as exc:
         return False, f"probe failed to launch: {exc!r}"
 
@@ -207,7 +239,11 @@ def select_backend(probe_timeout: float = 180.0):
         # lesson — the 35-min default outlived the driver's timeout)
         budget = float(os.environ.get("BENCH_TPU_WAIT_SECS", 10 * 60))
         budget = max(0.0, min(budget, _remaining() * 0.4))
-        probe_timeout = min(probe_timeout, max(budget, 30.0))
+        # a single probe may not outlive the wait budget (30s floor so a
+        # cold jax import can still finish) nor run into the self-flush
+        # alarm with a live TPU claim in flight
+        probe_timeout = min(probe_timeout, max(budget, 30.0),
+                            max(_remaining() - 30.0, 5.0))
         deadline = time.time() + budget
         attempt = 0
         while True:

@@ -293,14 +293,21 @@ def seq_length_bucket(batches: Sequence[RoundBatch],
     if not keys:
         return None
     L = max(b.arrays[k].shape[-1] for b in batches for k in keys)
-    # max real length across the chunk: position of the last nonzero column
+    # the padding-efficiency meter counts each real token ONCE, from a
+    # single canonical key — tok_mask when present (it marks real
+    # positions even where x holds id 0), else the first seq key; summing
+    # over all keys would triple-count and the keys legitimately disagree
+    canon = "tok_mask" if "tok_mask" in keys else keys[0]
+    # max real length across the chunk: position of the last nonzero
+    # column over ALL keys (the crop must cover every key's extent)
     need = 1
     tokens_real = 0
     for b in batches:
         for k in keys:
             arr = b.arrays[k]
             nz = arr.reshape(-1, arr.shape[-1]) != 0
-            tokens_real += int(nz.sum())
+            if k == canon:
+                tokens_real += int(nz.sum())
             cols = nz.any(axis=0)
             if cols.any():
                 need = max(need, int(np.max(np.nonzero(cols)[0])) + 1)
@@ -310,8 +317,8 @@ def seq_length_bucket(batches: Sequence[RoundBatch],
         "full_len": int(L),
         "tokens_real": int(tokens_real),
         "tokens_grid_before": int(sum(
-            b.arrays[k].reshape(-1, b.arrays[k].shape[-1]).shape[0] * L
-            for b in batches for k in keys)),
+            b.arrays[canon].reshape(-1, b.arrays[canon].shape[-1]).shape[0]
+            * L for b in batches)),
     }
     stats["cropped"] = bucket < L
     if bucket < L:
@@ -319,6 +326,6 @@ def seq_length_bucket(batches: Sequence[RoundBatch],
             for k in keys:
                 b.arrays[k] = np.ascontiguousarray(b.arrays[k][..., :bucket])
     stats["tokens_grid_after"] = int(sum(
-        b.arrays[k].reshape(-1, b.arrays[k].shape[-1]).shape[0]
-        * b.arrays[k].shape[-1] for b in batches for k in keys))
+        b.arrays[canon].reshape(-1, b.arrays[canon].shape[-1]).shape[0]
+        * b.arrays[canon].shape[-1] for b in batches))
     return stats

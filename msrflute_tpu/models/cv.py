@@ -107,9 +107,13 @@ class ClassificationTask(BaseTask):
               train: bool = False):
         # logits upcast: with a bfloat16 compute dtype the matmuls run on
         # the MXU in bf16, but softmax/xent/metric math stays float32.
-        # Dropout needs an rng stream: train mode without one degrades to
-        # deterministic application instead of crashing at trace time.
-        train = bool(train) and rng is not None
+        # Dropout needs an rng stream: train mode without one is a caller
+        # bug — fail loudly rather than silently dropping dropout (a
+        # quiet train/reference divergence; ADVICE r3).
+        if train and rng is None:
+            raise ValueError(
+                f"{self.name}: apply(train=True) requires an rng for the "
+                "dropout stream; pass rng= or call with train=False")
         rngs = {"dropout": rng} if train else None
         return self.module.apply({"params": params}, x, train,
                                  rngs=rngs).astype(jnp.float32)

@@ -26,8 +26,10 @@ identical weight-for-weight at any ``n_k`` — pinned by test); larger
 ``q`` interpolates toward minimax fairness (AFL).  The weight is
 computed in-jit inside the same vmapped client step every strategy uses
 (``base.client_step``), so the fairness reweighting adds zero host
-round-trips and composes with DP/quantization payload transforms
-unchanged.
+round-trips and composes with the quantization payload transform
+unchanged.  DP does NOT compose (local DP's max_weight clamp squashes
+the heavy tail; global DP's accounting assumes bounded weights) and is
+rejected in ``__init__``.
 
 The ``loss^q`` factor is intentionally heavy-tailed (that is the
 mechanism), so it multiplies OUTSIDE the reference MAX_WEIGHT=100 cap —
@@ -50,6 +52,23 @@ _QFFL_MAX_WEIGHT = 1e9
 class QFFL(FedAvg):
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
+        # DP breaks q-FFL in both directions, so reject loudly (the same
+        # discipline Scaffold applies): local DP clamps the client weight
+        # at dp_config.max_weight, squashing exactly the high-loss heavy
+        # tail the objective depends on (silent degradation back toward
+        # uniform); global DP's RDP accounting assumes a bounded
+        # per-client contribution, which the uncapped loss^q weight
+        # violates — one high-loss client can dominate the normalized
+        # aggregate far beyond the accounted sensitivity.
+        if dp_config is not None and (
+                dp_config.get("enable_local_dp", False) or
+                dp_config.get("enable_global_dp", False)):
+            raise ValueError(
+                "strategy: qffl does not compose with "
+                "dp_config.enable_local_dp / enable_global_dp — local DP "
+                "clamps the loss^q weight at max_weight (degrading q-FFL "
+                "toward uniform), global DP's accounting assumes bounded "
+                "per-client weight; use fedavg/dga for DP runs")
         self.q = float(config.server_config.get("qffl_q", 1.0))
         if self.q < 0:
             raise ValueError(f"server_config.qffl_q must be >= 0, "

@@ -188,3 +188,18 @@ def test_gru_explicit_targets_align_with_initial_prediction():
     assert np.isfinite(float(loss))
     stats = task.eval_stats(params, batch)
     assert float(stats["sample_count"]) == 12  # all L positions real
+
+
+def test_classification_train_without_rng_raises():
+    """train=True without an rng must fail loudly instead of silently
+    disabling dropout (ADVICE r3): a quiet train/reference divergence."""
+    import pytest
+
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+
+    task = make_task(ModelConfig(model_type="CNN"))
+    params = task.init_params(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    with pytest.raises(ValueError, match="requires an rng"):
+        task.apply(params, x, rng=None, train=True)

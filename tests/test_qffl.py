@@ -233,3 +233,20 @@ def test_per_user_stats_cli_metrics(tmp_path):
             names.add(json.loads(line)["name"])
     assert "Val acc (worst user)" in names, sorted(names)
     assert "Val acc (user p50)" in names
+
+
+def test_qffl_rejects_dp_configs():
+    """DP does not compose with q-FFL (local DP clamps the loss^q heavy
+    tail at max_weight; global DP accounting assumes bounded per-client
+    weight) — the strategy must reject loudly, like Scaffold does
+    (ADVICE r3)."""
+    import pytest
+
+    from msrflute_tpu.strategies import select_strategy
+
+    cfg = _cfg("qffl", 1, q=1.0)
+    for key in ("enable_local_dp", "enable_global_dp"):
+        with pytest.raises(ValueError, match="does not compose"):
+            select_strategy("qffl")(cfg, dp_config={key: True})
+    # no DP flags set in the dict -> fine
+    select_strategy("qffl")(cfg, dp_config={"eps": 1.0})
