@@ -51,6 +51,10 @@ class _CNNFEMNISTModule(nn.Module):
 
     num_classes: int = 62
     dtype: Any = jnp.float32
+    # the reference hardcodes 0.25/0.5; configurable here so the parity
+    # harness can run a dropout-free, fully deterministic variant
+    drop1: float = 0.25
+    drop2: float = 0.5
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -60,10 +64,10 @@ class _CNNFEMNISTModule(nn.Module):
         x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
         x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = nn.Dropout(self.drop1, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
-        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dropout(self.drop2, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
@@ -238,7 +242,9 @@ def make_cnn_femnist_task(model_config) -> ClassificationTask:
     side = int(model_config.get("image_size", 28))
     return ClassificationTask(
         _CNNFEMNISTModule(num_classes=num_classes,
-                          dtype=parse_dtype(model_config)),
+                          dtype=parse_dtype(model_config),
+                          drop1=float(model_config.get("dropout1", 0.25)),
+                          drop2=float(model_config.get("dropout2", 0.5))),
         example_shape=(side, side, 1), name="cv_cnn_femnist",
         num_classes=num_classes)
 

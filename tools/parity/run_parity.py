@@ -32,6 +32,13 @@ Design notes:
   and un-transposed for msrflute_tpu, so both models see the same tensors.
 
 Usage: python tools/parity/run_parity.py [--tasks lr,cnn] [--rounds 20]
+
+Extension modes (VERDICT r3 item 2) ride the deterministic LR base and are
+selected through the same --tasks flag: ``dga`` (softmax weighting),
+``dga_quant`` (+8-bit gradient quantization), ``dp_clip`` (clip-only local
+DP, eps<0), ``dp_tiny_noise`` (the full eps>0 dance at vanishing sigma +
+global DP at sigma=0 — near-deterministic, so semantic divergence shows as
+drift), ``dp_envelope`` (real noise, statistical-envelope criteria).
 """
 from __future__ import annotations
 
@@ -48,6 +55,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 REFERENCE = "/root/reference"
 ADAPTERS = os.path.join(REPO, "tools", "parity", "adapters")
+
+#: sequential reference launches need distinct rendezvous ports (TIME_WAIT)
+_REF_RUN_SEQ = 0
 
 
 # ----------------------------------------------------------------------
@@ -158,6 +168,88 @@ def gen_gru_blob(rng, users, seq_len, vocab=60, trans=None, noise=0.15):
         out["num_samples"].append(1)
         out["user_data"][name] = {"x": [[f"w{i}" for i in stream]]}
     return out
+
+
+def gen_bert_blob(rng, users, samples, seq_len, vocab, n_masked=3,
+                  perm=None, n_special=5, mask_id=4):
+    """MLM blob with PRECOMPUTED deterministic masking (VERDICT r3 item 4:
+    "precomputed mask tensors fed as data to sidestep collator RNG").
+
+    Token rule: even positions draw a random id in [n_special, vocab); each
+    odd position is a fixed permutation of its left neighbor — masked
+    tokens are recoverable from context, so MLM training has real signal.
+    Masking: EXACTLY ``n_masked`` positions per sequence (a fixed count
+    makes the reference's batch-size-weighted val loss coincide with the
+    token-weighted mean our sum-form eval computes), HF 80/10/10 rule
+    applied here with numpy RNG; ``x`` ships already masked, labels carry
+    the original ids at masked slots and -100 elsewhere.  Pass the same
+    ``perm`` for train and val."""
+    content = vocab - n_special
+    if perm is None:
+        perm = rng.permutation(content)
+    out = {"users": [], "num_samples": [], "user_data": {},
+           "user_data_label": {}}
+    for u in range(users):
+        xs, ys = [], []
+        for _ in range(samples):
+            seq = np.empty(seq_len, np.int64)
+            for t in range(seq_len):
+                if t % 2 == 0:
+                    seq[t] = n_special + rng.integers(content)
+                else:
+                    seq[t] = n_special + perm[seq[t - 1] - n_special]
+            labels = np.full(seq_len, -100, np.int64)
+            masked = seq.copy()
+            pos = rng.choice(seq_len, size=n_masked, replace=False)
+            for p in pos:
+                labels[p] = seq[p]
+                roll = rng.random()
+                if roll < 0.8:
+                    masked[p] = mask_id
+                elif roll < 0.9:
+                    masked[p] = n_special + rng.integers(content)
+                # else: keep original (the 10% "unchanged" arm)
+            xs.append(masked)
+            ys.append(labels)
+        name = f"{u:04d}"
+        out["users"].append(name)
+        out["num_samples"].append(samples)
+        out["user_data"][name] = {"x": np.stack(xs)}
+        out["user_data_label"][name] = np.stack(ys)
+    return out
+
+
+def make_bert_checkpoint(work, vocab, hidden=32, layers=2, heads=2,
+                         intermediate=64, seed=0):
+    """Build ONE local tiny-BERT checkpoint dir both frameworks load: the
+    reference via ``model_name_or_path`` -> ``AutoModelForMaskedLM
+    .from_pretrained`` (``experiments/mlm_bert/model.py:119-123`` — this
+    exercises its pretrained path end to end), ours via the same config
+    key -> ``FlaxBertForMaskedLM.from_pretrained(..., from_pt=True)``.
+    Loading one torch-saved dir on both sides IS the identical-init
+    transplant (HF owns the layout conversion).  Dropout is zeroed in the
+    saved config so both forwards are deterministic.  The vocab.txt rows
+    count must equal vocab_size: the reference resizes embeddings to
+    ``len(tokenizer)`` (``model.py:137``), which must be a no-op."""
+    import torch
+    from transformers import BertConfig, BertForMaskedLM, BertTokenizer
+    cfg = BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=heads, intermediate_size=intermediate,
+        max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(seed)
+    model = BertForMaskedLM(cfg)
+    ckpt = os.path.join(work, "bert_ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    model.save_pretrained(ckpt)
+    vocab_file = os.path.join(ckpt, "vocab.txt")
+    with open(vocab_file, "w") as fh:
+        for w in (["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                  + [f"tok{i}" for i in range(vocab - 5)]):
+            fh.write(w + "\n")
+    BertTokenizer(vocab_file).save_pretrained(ckpt)
+    return ckpt
 
 
 def write_gru_blob(blob, path):
@@ -381,11 +473,28 @@ def save_flax_cnn(init, path):
 GRU_DIMS = {"vocab_size": 60, "embed_dim": 16, "hidden_dim": 64}
 
 
+BERT_DIMS = {"vocab_size": 96, "hidden_size": 32, "num_hidden_layers": 2,
+             "num_attention_heads": 2, "intermediate_size": 64}
+
+
 def ref_config(task, rounds, users, batch, lr, init_path, outdim):
     model = {"model_type": {"lr": "LR", "cnn": "CNN", "lstm": "RNN",
-                            "gru": "GRU"}[task],
-             "model_folder": f"experiments/parity_{task}/model.py",
-             "pretrained_model_path": init_path}
+                            "gru": "GRU", "bert": "BERT"}[task],
+             "model_folder": f"experiments/parity_{task}/model.py"}
+    if task == "bert":
+        # init_path is the shared local checkpoint DIR (make_bert_checkpoint)
+        # loaded through the reference's own pretrained path; no torch
+        # state-dict transplant needed
+        model["BERT"] = {
+            "model": {"model_name": "bert-tiny-parity",
+                      "model_name_or_path": init_path,
+                      "cache_dir": None, "use_fast_tokenizer": False,
+                      "mask_token_id": 4},
+            "training": {"seed": 0, "label_smoothing_factor": 0,
+                         "batch_size": batch},
+        }
+    else:
+        model["pretrained_model_path"] = init_path
     if task == "lr":
         model.update({"input_dim": 784, "output_dim": outdim})
     elif task == "gru":
@@ -431,8 +540,17 @@ def ref_config(task, rounds, users, batch, lr, init_path, outdim):
 
 def tpu_config(task, rounds, users, batch, lr, init_path, outdim):
     model = {"model_type": {"lr": "LR", "cnn": "CNN", "lstm": "LSTM",
-                            "gru": "GRU"}[task],
-             "pretrained_model_path": init_path}
+                            "gru": "GRU", "bert": "BERT"}[task]}
+    if task == "bert":
+        # same local checkpoint dir as the reference: identical init via
+        # HF's own torch->flax conversion (models/bert.py from_pt fallback)
+        model["BERT"] = {"model": {"model_name_or_path": init_path,
+                                   "max_seq_length": outdim,
+                                   "mask_token_id": 4},
+                         "training": {"seed": 0,
+                                      "label_smoothing_factor": 0}}
+    else:
+        model["pretrained_model_path"] = init_path
     if task == "lr":
         model.update({"input_dim": 784, "num_classes": outdim,
                       "sigmoid_output": True})  # the reference LR quirk
@@ -489,9 +607,10 @@ def build_ref_tree(scratch):
     for name in os.listdir(os.path.join(REFERENCE, "experiments")):
         os.symlink(os.path.join(REFERENCE, "experiments", name),
                    os.path.join(tree, "experiments", name))
-    for task in ("parity_lr", "parity_cnn", "parity_lstm", "parity_gru"):
-        os.symlink(os.path.join(ADAPTERS, task),
-                   os.path.join(tree, "experiments", task))
+    for task in sorted(os.listdir(ADAPTERS)):  # every parity_* adapter
+        if os.path.isdir(os.path.join(ADAPTERS, task)):
+            os.symlink(os.path.join(ADAPTERS, task),
+                       os.path.join(tree, "experiments", task))
     return tree
 
 
@@ -512,19 +631,33 @@ def run_reference(tree, cfg_path, data_dir, out_dir, task, metrics_out):
             [tree, os.path.join(REPO, "tools", "ref_shims")]),
         CUDA_VISIBLE_DEVICES="",
     )
-    # PID-derived rendezvous port: concurrent parity runs (pytest + manual)
-    # must not collide on a fixed port
-    port = 20000 + os.getpid() % 20000
-    cmd = [sys.executable, "-m", "torch.distributed.run",
-           f"--nproc_per_node=2", f"--master-port={port}",
-           os.path.join(REPO, "tools", "parity", "ref_launch.py"),
-           "-dataPath", data_dir,
-           "-outputPath", out_dir, "-config", cfg_path,
-           "-task", task, "-backend", "gloo"]
-    proc = subprocess.run(cmd, cwd=tree, env=env, capture_output=True,
-                          text=True)
+    global _REF_RUN_SEQ
+    proc = None
+    for attempt in range(3):
+        # fresh rendezvous port per invocation AND per attempt: a fixed
+        # PID-derived port lands in TIME_WAIT between back-to-back
+        # sequential torchruns of a multi-task run and the next rendezvous
+        # fails flakily (observed: singles pass, sequences die on task 2+);
+        # concurrent runs (pytest + manual) must not collide either
+        _REF_RUN_SEQ += 1
+        port = 20000 + (os.getpid() * 13 + _REF_RUN_SEQ * 101) % 20000
+        cmd = [sys.executable, "-m", "torch.distributed.run",
+               f"--nproc_per_node=2", f"--master-port={port}",
+               os.path.join(REPO, "tools", "parity", "ref_launch.py"),
+               "-dataPath", data_dir,
+               "-outputPath", out_dir, "-config", cfg_path,
+               "-task", task, "-backend", "gloo"]
+        if os.path.exists(metrics_out):
+            os.remove(metrics_out)  # a retry must not append to old metrics
+        proc = subprocess.run(cmd, cwd=tree, env=env, capture_output=True,
+                              text=True)
+        if proc.returncode == 0:
+            break
+        sys.stderr.write(f"[parity] reference attempt {attempt + 1} failed "
+                         f"rc={proc.returncode} (port {port}); tail:\n"
+                         + proc.stdout[-2000:] + "\n" + proc.stderr[-3000:]
+                         + "\n")
     if proc.returncode != 0:
-        sys.stderr.write(proc.stdout[-4000:] + "\n" + proc.stderr[-6000:])
         raise RuntimeError(f"reference trainer failed rc={proc.returncode}")
     # Vals appear strictly in round order but the "Current iteration" marker
     # flushes late (end-of-round metrics_payload), so align by ORDER: with
@@ -541,7 +674,11 @@ def run_reference(tree, cfg_path, data_dir, out_dir, task, metrics_out):
     return rounds
 
 
-def run_msrflute(cfg_path, data_dir, out_dir, task):
+def run_msrflute(cfg_path, data_dir, out_dir, task, name_map=None):
+    """``name_map`` maps OUR metric names onto the canonical comparison
+    keys ("Val loss"/"Val acc") — the personalization mode compares the
+    reference's personalized Val metrics against our "Personalized val
+    loss/acc" records."""
     env = dict(
         os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
@@ -554,13 +691,14 @@ def run_msrflute(cfg_path, data_dir, out_dir, task):
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-4000:] + "\n" + proc.stderr[-6000:])
         raise RuntimeError(f"msrflute_tpu trainer failed rc={proc.returncode}")
+    name_map = name_map or {"Val loss": "Val loss", "Val acc": "Val acc"}
     rounds = {}
     with open(os.path.join(out_dir, "log", "metrics.jsonl")) as fh:
         for line in fh:
             rec = json.loads(line)
-            if rec.get("name") in ("Val loss", "Val acc"):
-                rounds.setdefault(int(rec["step"]), {})[rec["name"]] = \
-                    float(rec["value"])
+            if rec.get("name") in name_map:
+                rounds.setdefault(int(rec["step"]), {})[
+                    name_map[rec["name"]]] = float(rec["value"])
     return rounds
 
 
@@ -595,6 +733,11 @@ TASKS = {
     # tracking but the "loss halved" learning criterion fails on
     # overfitting, not on mismatch)
     "gru": ((12,), 60, 48, 1, 4, 1.0, None),
+    # BERT (mlm_bert): shape = seq_len, classes = vocab (arch in
+    # BERT_DIMS); pre-masked blobs (gen_bert_blob) + one shared local
+    # checkpoint dir; lr probed offline (full-batch SGD on the pooled
+    # data — see docstring protocol note)
+    "bert": ((16,), 96, 8, 16, 16, 0.5, None),
 }
 
 # per-task default round counts, used when the caller leaves --rounds
@@ -603,15 +746,191 @@ TASKS = {
 # multi-batch rounds would be shuffle-order-incomparable).  An explicit
 # --rounds always wins (smoke tests pass --rounds 3).
 DEFAULT_ROUNDS = 20
-ROUNDS_BY_TASK = {"lstm": 100, "gru": 100}
+ROUNDS_BY_TASK = {"lstm": 100, "gru": 100, "bert": 30}
 
 
-def run_task(task, rounds, scratch):
+# ----------------------------------------------------------------------
+# extension modes (VERDICT r3 item 2): the same deterministic LR
+# protocol with the reference's extensions switched ON — DGA softmax
+# weighting, gradient quantization, and the local/global DP dance.
+# ----------------------------------------------------------------------
+def _dga_strategy(rc, tc):
+    """Run DGA both sides (reference ``core/strategies/dga.py``; ours
+    ``strategies/dga.py``): softmax client weight
+    ``exp(-beta * train_loss / num_samples)`` with beta = softmax_beta.
+    The base ref_config already carries aggregate_median/softmax_beta/
+    weight_train_loss; FedAvg ignores them, DGA consumes them."""
+    rc["strategy"] = "DGA"
+    tc["strategy"] = "DGA"
+    tc["server_config"]["aggregate_median"] = "softmax"
+    tc["server_config"]["softmax_beta"] = 1.0
+    tc["server_config"]["weight_train_loss"] = "train_loss"
+
+
+def _quant(rc, tc, thresh=0.5, bits=8):
+    """Gradient quantization (reference ``extensions/quantization/
+    quant.py:9-50``, invoked from DGA's client payload, ``dga.py:148-149``):
+    per-layer min/max binning into 2**bits levels, components with
+    |g| <= quantile(|g|, thresh) zeroed.  The reference quantizes AFTER the
+    weight multiply, we BEFORE — binning is scale-equivariant for w > 0
+    (labels, bucket indices and the threshold all scale by w), so the two
+    orders agree to f32 rounding."""
+    for c in (rc, tc):
+        c["client_config"]["quant_thresh"] = thresh
+        c["client_config"]["quant_bits"] = bits
+
+
+def _dp(rc, tc, *, eps, max_grad, max_weight=1.0, global_sigma=None):
+    """Local (+optionally global) DP (reference ``extensions/privacy/
+    __init__.py:154-201``): eps < 0 is CLIP-ONLY — fully deterministic;
+    eps > 0 renormalizes the update to exactly max_grad norm, then adds
+    Gaussian noise with sigma = sqrt(2 ln(1.25/delta)) * sensitivity/eps
+    to [update, scaled weight] jointly, clamps the noised weight to
+    [min_weight, max_weight] and unscales.  Huge eps -> vanishing sigma:
+    the FULL eps>0 dance runs near-deterministically, so any semantic
+    divergence (a wrong clamp, scale, or sensitivity) shows as trajectory
+    drift while honest f32 noise stays tiny.  global_sigma=0.0 exercises
+    the global-DP unroll/noise/update path exactly (noise*0)."""
+    dp = {
+        "enable_local_dp": True, "eps": eps, "delta": 1e-7,
+        "max_grad": max_grad, "max_weight": max_weight,
+        "min_weight": 1e-7, "weight_scaler": 1.0,
+    }
+    if global_sigma is not None:
+        # must be > 0: the reference accountant computes (1/sigma)^2 and
+        # crashes on exactly 0 (ZeroDivisionError at privacy/__init__.py:227;
+        # its OverflowError for small sigma IS caught and logged as mu=-1)
+        dp["enable_global_dp"] = True
+        dp["global_sigma"] = global_sigma
+    rc["dp_config"] = dict(dp)
+    tc["dp_config"] = dict(dp)
+
+
+def _personalization(rc, tc):
+    """Personalization server both sides (reference ``core/client.py:
+    387-443`` train path + ``:190-220`` eval path; ours
+    ``engine/personalization.py``).  Alignment choices, each mirrored on
+    both sides: local models cold-start from the SEED FILE (the
+    reference's bare ``make_model`` random init is unreproducible — the
+    parity_pers adapter loads pretrained_model_path, ours sets
+    ``personalization_init: initial``); eval interpolates LOG-probs
+    (``personalization_interp: logprobs``, the cv model contract); val
+    data = the train blob so every val user owns a local model (the
+    reference looks up ``<user>_model.tar`` by val-user NAME)."""
+    rc["server_config"]["type"] = "personalization"
+    rc["model_config"]["model_folder"] = "experiments/parity_pers/model.py"
+    rc["client_config"]["convex_model_interp"] = 0.75
+    rc["server_config"]["data_config"]["val"]["val_data"] = "train.json"
+    rc["server_config"]["data_config"]["test"]["test_data"] = "train.json"
+    tc["server_config"]["type"] = "personalization"
+    tc["server_config"]["personalization_init"] = "initial"
+    tc["server_config"]["personalization_interp"] = "logprobs"
+    tc["client_config"]["convex_model_interp"] = 0.75
+    tc["server_config"]["data_config"]["val"]["val_data"] = "train.json"
+    tc["server_config"]["data_config"]["test"]["test_data"] = "train.json"
+
+
+def _cnn_nodropout(rc, tc):
+    """Dropout zeroed on both sides (reference: the ``parity_cnn_nd``
+    adapter subclasses its CNN and sets both Dropout p=0; ours: the
+    ``dropout1/dropout2`` model-config knobs).  The only RNG in the CNN
+    family disappears, so the comparison is held to trajectory-exact."""
+    rc["model_config"]["model_folder"] = "experiments/parity_cnn_nd/model.py"
+    tc["model_config"]["dropout1"] = 0.0
+    tc["model_config"]["dropout2"] = 0.0
+
+
+MODES = {
+    # deterministic: the CNN family with its one RNG source (dropout)
+    # removed — upgrades the cnn entry from endpoint-grade to
+    # trajectory-exact (VERDICT r3 item 3)
+    "cnn_nodropout": {"base": "cnn", "mutate": [_cnn_nodropout],
+                      "criteria": "exact"},
+    # deterministic: per-user local models + convex-alpha interpolation
+    # (compares the reference's personalized Val metrics against our
+    # "Personalized val loss/acc" records)
+    "pers": {"mutate": [_personalization], "criteria": "near",
+             "tpu_metrics": {"Personalized val loss": "Val loss",
+                             "Personalized val acc": "Val acc"}},
+    # deterministic: DGA softmax weighting only
+    "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
+    # deterministic: DGA + per-layer 8-bit quantization at the 0.5 quantile
+    "dga_quant": {"mutate": [_dga_strategy, _quant], "criteria": "near"},
+    # deterministic: clip-only local DP (eps < 0) under DGA
+    "dp_clip": {"mutate": [_dga_strategy,
+                           lambda rc, tc: _dp(rc, tc, eps=-1.0,
+                                              max_grad=0.05)],
+                "criteria": "near"},
+    # near-deterministic: the full eps>0 dance at vanishing sigma, plus
+    # the global-DP path at near-zero sigma (exactly 0 crashes the
+    # reference accountant; 1e-4 keeps the added noise ~1e-4 relative).
+    # max_grad must be SMALL: the eps>0 path renormalizes every update to
+    # exactly max_grad norm, so a large value forces constant big steps
+    # that blow the sigmoid-output LR up to inf loss -> every weight
+    # filtered to 0 -> the reference divides by zero clients (measured at
+    # max_grad=0.5, round ~8)
+    "dp_tiny_noise": {"mutate": [_dga_strategy,
+                                 lambda rc, tc: _dp(rc, tc, eps=1e8,
+                                                    max_grad=0.05,
+                                                    global_sigma=1e-4)],
+                      "criteria": "near"},
+    # statistical: real noise, RNG incomparable across torch/jax — the
+    # criterion is an envelope (both learn; endpoints in a band)
+    "dp_envelope": {"mutate": [_dga_strategy,
+                               lambda rc, tc: _dp(rc, tc, eps=1000.0,
+                                                  max_grad=0.05,
+                                                  global_sigma=0.1)],
+                    "criteria": "envelope"},
+}
+
+
+def _judge_mode(traj, criteria):
+    """ok/verdict for an extension mode run on the deterministic LR base."""
+    diffs_loss = [r["Val loss"]["abs_diff"] for r in traj
+                  if r["Val loss"]["abs_diff"] is not None]
+    diffs_acc = [r["Val acc"]["abs_diff"] for r in traj
+                 if r["Val acc"]["abs_diff"] is not None]
+    max_dl = max(diffs_loss) if diffs_loss else None
+    max_da = max(diffs_acc) if diffs_acc else None
+    ok, verdict = False, "insufficient data"
+    if max_dl is None or max_da is None or not traj:
+        return ok, verdict, max_dl, max_da
+    if criteria == "exact":
+        ok = max_dl < 1e-4 and max_da == 0.0
+        verdict = ("trajectory-exact (f32 accumulation noise only)" if ok
+                   else "MISMATCH beyond float noise")
+    elif criteria == "near":
+        # deterministic payload transforms, but with hard nonlinearities
+        # (quant bin edges, clip thresholds) that can amplify one-ulp
+        # disagreements into a visible-but-bounded wobble
+        ok = max_dl < 5e-3 and max_da <= 0.02
+        verdict = ("trajectory matched within transform-boundary noise"
+                   if ok else "MISMATCH beyond transform-boundary noise")
+    else:  # envelope
+        ref0 = traj[0]["Val loss"]["reference"]
+        fin = traj[-1]
+        rl = fin["Val loss"]["reference"]
+        tl = fin["Val loss"]["msrflute_tpu"]
+        ra = fin["Val acc"]["reference"]
+        ta = fin["Val acc"]["msrflute_tpu"]
+        if None not in (ref0, rl, tl, ra, ta):
+            learned = rl < 0.8 * ref0 and tl < 0.8 * ref0
+            ok = (learned
+                  and (abs(rl - tl) < 0.15
+                       or abs(rl - tl) / max(rl, tl) < 0.15)
+                  and abs(ra - ta) < 0.1)
+        verdict = ("both learn under matched DP noise scale; endpoints "
+                   "in statistical envelope" if ok
+                   else "MISMATCH beyond DP statistical envelope")
+    return ok, verdict, max_dl, max_da
+
+
+def run_task(task, rounds, scratch, mode=None):
     shape, classes, users, samples, batch, lr, data_classes = TASKS[task]
     if rounds is None:
         rounds = ROUNDS_BY_TASK.get(task, DEFAULT_ROUNDS)
     rng = np.random.default_rng(7)
-    work = os.path.join(scratch, task)
+    work = os.path.join(scratch, mode or task)
     shutil.rmtree(work, ignore_errors=True)
     data_ref = os.path.join(work, "data_ref")
     data_tpu = os.path.join(work, "data_tpu")
@@ -631,6 +950,22 @@ def run_task(task, rounds, scratch):
         init = lstm_init(rng, vocab=classes)
         save_torch_lstm(init, os.path.join(work, "init.pt"))
         save_flax_lstm(init, os.path.join(work, "init.msgpack"))
+    elif task == "bert":
+        seq_len = shape[0]
+        perm = rng.permutation(classes - 5)
+        train = gen_bert_blob(rng, users, samples, seq_len, vocab=classes,
+                              perm=perm)
+        val = gen_bert_blob(rng, 4, 32, seq_len, vocab=classes, perm=perm)
+        for blob, name in ((train, "train.json"), (val, "val.json")):
+            write_blob(blob, os.path.join(data_ref, name))
+            write_blob(blob, os.path.join(data_tpu, name))
+        # one torch-saved checkpoint dir IS the identical init (both
+        # sides' pretrained loaders point at it)
+        bert_ckpt = make_bert_checkpoint(work, vocab=classes,
+                                         hidden=BERT_DIMS["hidden_size"],
+                                         layers=BERT_DIMS["num_hidden_layers"],
+                                         heads=BERT_DIMS["num_attention_heads"],
+                                         intermediate=BERT_DIMS["intermediate_size"])
     elif task == "gru":
         seq_len = shape[0]
         trans = rng.permutation(np.arange(1, classes))
@@ -674,6 +1009,9 @@ def run_task(task, rounds, scratch):
                     os.path.join(work, "init.pt"), outdim)
     tc = tpu_config(task, rounds, users, batch, lr,
                     os.path.join(work, "init.msgpack"), outdim)
+    if mode is not None:
+        for mutate in MODES[mode]["mutate"]:
+            mutate(rc, tc)
     if task == "gru":
         # the nlg_gru loaders read their knobs from the per-split data
         # blocks: plain-txt vocab (absolute path), frames budget ==
@@ -698,8 +1036,11 @@ def run_task(task, rounds, scratch):
                         os.path.join(work, "out_ref"), f"parity_{task}",
                         os.path.join(work, "ref_metrics.jsonl"))
     print(f"[parity:{task}] running msrflute_tpu (8-dev virtual cpu mesh)...")
+    tpu_name_map = None
+    if mode is not None and "tpu_metrics" in MODES[mode]:
+        tpu_name_map = MODES[mode]["tpu_metrics"]
     tpu = run_msrflute(tpu_cfg, data_tpu, os.path.join(work, "out_tpu"),
-                       f"parity_{task}")
+                       f"parity_{task}", name_map=tpu_name_map)
 
     common = sorted(set(ref) & set(tpu))
     traj = []
@@ -718,7 +1059,29 @@ def run_task(task, rounds, scratch):
                  if row["Val acc"]["abs_diff"] is not None]
     max_dl = max(diffs_loss) if diffs_loss else None
     max_da = max(diffs_acc) if diffs_acc else None
-    if task == "lr":
+    if mode is not None:
+        ok, verdict, _, _ = _judge_mode(traj, MODES[mode]["criteria"])
+    elif task == "bert":
+        # fully deterministic protocol (pre-masked data, zero dropout in
+        # the saved config, sequential order): held to trajectory
+        # exactness within an f32 band.  The VERDICT r3 scope for this
+        # family is a short deterministic trajectory + transplant
+        # forward-exactness — NOT a learning demonstration: the 2-layer
+        # 32-wide model cannot learn the 91-way permutation rule in tens
+        # of full-batch steps (probed offline with torch SGD and Adam at
+        # 5 lrs; val stays at the ln(91) chance floor while train loss
+        # moves), so the criterion instead demands material MOVEMENT
+        # (the dynamics are exercised) plus pointwise agreement.
+        ref0 = traj[0]["Val loss"]["reference"] if traj else None
+        rl = traj[-1]["Val loss"]["reference"] if traj else None
+        moved = (ref0 is not None and rl is not None
+                 and abs(rl - ref0) > 5e-3)
+        ok = (max_dl is not None and max_dl < 5e-3
+              and max_da is not None and max_da <= 0.02 and moved)
+        verdict = ("trajectory matched within f32 band; dynamics "
+                   "exercised (loss moves materially)" if ok
+                   else "MISMATCH beyond f32 band (or no movement)")
+    elif task == "lr":
         # fully deterministic protocol: must be trajectory-exact
         ok = max_dl is not None and max_dl < 1e-4 and max_da == 0.0
         verdict = ("trajectory-exact (float32 accumulation noise only)"
@@ -795,14 +1158,22 @@ def run_task(task, rounds, scratch):
         verdict = ("round-0 exact; both learn; endpoints matched within "
                    "dropout noise" if ok
                    else "MISMATCH beyond dropout-noise criteria")
+    protocol = {"users": users, "samples_per_user": samples,
+                "batch_size": batch, "client_lr": lr,
+                "rounds": rounds, "classes": classes,
+                "local_steps_per_round": 1,
+                "full_participation": True,
+                "identical_init": True}
+    if mode is not None:
+        protocol["mode"] = mode
+        protocol["strategy"] = rc["strategy"]
+        protocol["dp_config"] = rc.get("dp_config")
+        protocol["quant_thresh"] = rc["client_config"].get("quant_thresh")
+        protocol["quant_bits"] = rc["client_config"].get("quant_bits")
+        protocol["criteria"] = MODES[mode]["criteria"]
     return {
-        "task": task,
-        "protocol": {"users": users, "samples_per_user": samples,
-                     "batch_size": batch, "client_lr": lr,
-                     "rounds": rounds, "classes": classes,
-                     "local_steps_per_round": 1,
-                     "full_participation": True,
-                     "identical_init": True},
+        "task": f"{task}+{mode}" if mode else task,
+        "protocol": protocol,
         "rounds_compared": len(traj),
         "max_abs_diff_val_loss": max_dl,
         "max_abs_diff_val_acc": max_da,
@@ -832,14 +1203,20 @@ def main():
         with open(args.out) as fh:
             results = json.load(fh)
     for task in args.tasks.split(","):
-        results[task] = run_task(task.strip(), args.rounds, args.scratch)
+        task = task.strip()
+        if task in MODES:  # extension mode riding a deterministic base
+            results[task] = run_task(MODES[task].get("base", "lr"),
+                                     args.rounds, args.scratch, mode=task)
+        else:
+            results[task] = run_task(task, args.rounds, args.scratch)
         r = results[task]
         print(f"[parity:{task}] rounds={r['rounds_compared']} "
               f"max|dloss|={r['max_abs_diff_val_loss']} "
-              f"max|dacc|={r['max_abs_diff_val_acc']}")
-
-    with open(args.out, "w") as fh:
-        json.dump(results, fh, indent=1)
+              f"max|dacc|={r['max_abs_diff_val_acc']} ok={r['ok']}")
+        # write after EVERY task: a flaky later task must not lose the
+        # finished families of a long multi-task run
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
     print(f"wrote {args.out}")
 
 
