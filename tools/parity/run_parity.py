@@ -674,15 +674,21 @@ def run_reference(tree, cfg_path, data_dir, out_dir, task, metrics_out):
     return rounds
 
 
-def run_msrflute(cfg_path, data_dir, out_dir, task, name_map=None):
+def run_msrflute(cfg_path, data_dir, out_dir, task, name_map=None,
+                 env_override=None):
     """``name_map`` maps OUR metric names onto the canonical comparison
     keys ("Val loss"/"Val acc") — the personalization mode compares the
     reference's personalized Val metrics against our "Personalized val
-    loss/acc" records."""
+    loss/acc" records.  ``env_override`` replaces env vars for this run:
+    conv-heavy programs must drop to 2 virtual devices with
+    single-threaded Eigen on this 1-core host, or XLA's in-process
+    AllReduce rendezvous (hard 40 s termination, ``rendezvous.cc:127``)
+    SIGABRTs when a starved device thread misses the collective."""
     env = dict(
         os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
     )
+    env.update(env_override or {})
     cmd = [sys.executable, os.path.join(REPO, "e2e_trainer.py"),
            "-config", cfg_path, "-dataPath", data_dir,
            "-outputPath", out_dir, "-task", task]
@@ -845,7 +851,10 @@ MODES = {
     # removed — upgrades the cnn entry from endpoint-grade to
     # trajectory-exact (VERDICT r3 item 3)
     "cnn_nodropout": {"base": "cnn", "mutate": [_cnn_nodropout],
-                      "criteria": "exact"},
+                      "criteria": "exact",
+                      "tpu_env": {"XLA_FLAGS":
+                                  "--xla_force_host_platform_device_count=2 "
+                                  "--xla_cpu_multi_thread_eigen=false"}},
     # deterministic: per-user local models + convex-alpha interpolation
     # (compares the reference's personalized Val metrics against our
     # "Personalized val loss/acc" records)
@@ -1036,11 +1045,13 @@ def run_task(task, rounds, scratch, mode=None):
                         os.path.join(work, "out_ref"), f"parity_{task}",
                         os.path.join(work, "ref_metrics.jsonl"))
     print(f"[parity:{task}] running msrflute_tpu (8-dev virtual cpu mesh)...")
-    tpu_name_map = None
-    if mode is not None and "tpu_metrics" in MODES[mode]:
-        tpu_name_map = MODES[mode]["tpu_metrics"]
+    tpu_name_map, tpu_env = None, None
+    if mode is not None:
+        tpu_name_map = MODES[mode].get("tpu_metrics")
+        tpu_env = MODES[mode].get("tpu_env")
     tpu = run_msrflute(tpu_cfg, data_tpu, os.path.join(work, "out_tpu"),
-                       f"parity_{task}", name_map=tpu_name_map)
+                       f"parity_{task}", name_map=tpu_name_map,
+                       env_override=tpu_env)
 
     common = sorted(set(ref) & set(tpu))
     traj = []
