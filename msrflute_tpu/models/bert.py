@@ -57,8 +57,15 @@ class BertMLMTask(BaseTask):
         dtype = parse_dtype(bert_cfg if "dtype" in bert_cfg else model_config)
         self._pretrained_params = None
         if path:
-            self.model = FlaxBertForMaskedLM.from_pretrained(path,
-                                                             dtype=dtype)
+            try:
+                self.model = FlaxBertForMaskedLM.from_pretrained(path,
+                                                                 dtype=dtype)
+            except (OSError, EnvironmentError):
+                # torch-format checkpoint dir (pytorch_model.bin /
+                # model.safetensors only): the reference saves these and a
+                # switching user points us at the same path
+                self.model = FlaxBertForMaskedLM.from_pretrained(
+                    path, dtype=dtype, from_pt=True)
             self.config = self.model.config
             self._pretrained_params = self.model.params
         else:
@@ -136,17 +143,40 @@ class BertMLMTask(BaseTask):
         return nll, valid.astype(jnp.float32)
 
     # ------------------------------------------------------------------
-    def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
-             train: bool = True):
+    def _premasked(self, batch: Batch):
+        """Pre-masked mode: when the blob ships ``y`` (MLM labels, -100 at
+        unmasked positions) the input ids are already masked and the
+        collator RNG is bypassed entirely — the parity harness uses this
+        to make the BERT family deterministic (the reference's
+        ``DataCollatorForLanguageModeling`` re-rolls masks per epoch,
+        which no cross-framework RNG can match)."""
+        if "y" not in batch:
+            return None
         input_ids = batch["x"].astype(jnp.int32)
         attention_mask = batch.get(
-            "attention_mask", (input_ids != 0).astype(jnp.int32))
-        attention_mask = attention_mask * batch["sample_mask"][:, None].astype(
-            attention_mask.dtype)
+            "attention_mask", jnp.ones_like(input_ids))
+        attention_mask = (attention_mask
+                          * batch["sample_mask"][:, None].astype(
+                              attention_mask.dtype)).astype(jnp.int32)
+        labels = jnp.where(batch["sample_mask"][:, None] > 0,
+                           batch["y"].astype(jnp.int32), -100)
+        return input_ids, attention_mask, labels
+
+    def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
+             train: bool = True):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         mask_rng, drop_rng = jax.random.split(rng)
-        masked_ids, labels = self._mlm_mask(mask_rng, input_ids,
-                                            attention_mask)
+        pre = self._premasked(batch)
+        if pre is not None:
+            masked_ids, attention_mask, labels = pre
+        else:
+            input_ids = batch["x"].astype(jnp.int32)
+            attention_mask = batch.get(
+                "attention_mask", (input_ids != 0).astype(jnp.int32))
+            attention_mask = attention_mask * batch["sample_mask"][:, None] \
+                .astype(attention_mask.dtype)
+            masked_ids, labels = self._mlm_mask(mask_rng, input_ids,
+                                                attention_mask)
         logits = self._logits(params, masked_ids, attention_mask,
                               deterministic=not train,
                               rng=drop_rng if train else None)
@@ -155,25 +185,48 @@ class BertMLMTask(BaseTask):
         return loss, {"sample_count": jnp.sum(batch["sample_mask"])}
 
     def eval_stats(self, params, batch: Batch) -> Dict[str, jnp.ndarray]:
-        input_ids = batch["x"].astype(jnp.int32)
-        attention_mask = batch.get(
-            "attention_mask", (input_ids != 0).astype(jnp.int32))
-        attention_mask = attention_mask * batch["sample_mask"][:, None].astype(
-            attention_mask.dtype)
-        # deterministic eval masking so metrics are reproducible
-        masked_ids, labels = self._mlm_mask(jax.random.PRNGKey(1234),
-                                            input_ids, attention_mask)
+        pre = self._premasked(batch)
+        if pre is not None:
+            masked_ids, attention_mask, labels = pre
+        else:
+            input_ids = batch["x"].astype(jnp.int32)
+            attention_mask = batch.get(
+                "attention_mask", (input_ids != 0).astype(jnp.int32))
+            attention_mask = attention_mask * batch["sample_mask"][:, None] \
+                .astype(attention_mask.dtype)
+            # deterministic eval masking so metrics are reproducible
+            masked_ids, labels = self._mlm_mask(jax.random.PRNGKey(1234),
+                                                input_ids, attention_mask)
         logits = self._logits(params, masked_ids, attention_mask)
         nll, valid = self._masked_xent(logits, labels)
         pred = jnp.argmax(logits, axis=-1)
         correct = (pred == jnp.where(labels == -100, -1, labels)).astype(
             jnp.float32)
-        return {
+        stats = {
             "loss_sum": jnp.sum(nll * valid),
             "correct_sum": jnp.sum(correct * valid),
             "sample_count": jnp.sum(valid),
             "seq_count": jnp.sum(batch["sample_mask"]),
         }
+        if pre is not None:
+            # reference-compatible accuracy denominator: its ComputeMetrics
+            # divides correct masked predictions by ALL B*L positions, not
+            # by the masked count (experiments/mlm_bert/utils/
+            # trainer_utils.py:86 — `.float().mean()` over the full grid),
+            # so masked accuracy is deflated by the masking rate.  The
+            # pre-masked path mirrors that so cross-framework numbers align.
+            stats["pos_count"] = (jnp.sum(batch["sample_mask"])
+                                  * batch["x"].shape[-1])
+        return stats
+
+    def finalize_metrics(self, sums):
+        metrics = super().finalize_metrics(sums)
+        if "pos_count" in sums and float(sums["pos_count"]) > 0:
+            from ..utils.metrics import Metric
+            metrics["acc"] = Metric(
+                float(sums["correct_sum"]) / float(sums["pos_count"]),
+                higher_is_better=True)
+        return metrics
 
 
 def make_bert_mlm_task(model_config) -> BertMLMTask:

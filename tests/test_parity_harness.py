@@ -181,3 +181,134 @@ def test_lr_trajectory_exact(tmp_path):
     assert res["rounds_compared"] >= 3
     assert res["max_abs_diff_val_loss"] < 1e-4
     assert res["max_abs_diff_val_acc"] == 0.0
+
+
+def test_bert_checkpoint_forward_exact(tmp_path):
+    """Both frameworks load ONE local torch-saved tiny-BERT checkpoint dir
+    (the reference via its model_name_or_path pretrained path,
+    ``/root/reference/experiments/mlm_bert/model.py:119-123``; ours via the
+    same config key with HF's torch->flax conversion) and must produce the
+    same masked-LM loss on the same pre-masked batch (VERDICT r3 item 4).
+    Runs without the reference mount: the torch side is the same HF
+    ``BertForMaskedLM`` the reference wraps."""
+    import numpy as np
+    torch = pytest.importorskip("torch")
+
+    sys.path.insert(0, os.path.join(REPO, "tools", "parity"))
+    from run_parity import BERT_DIMS, gen_bert_blob, make_bert_checkpoint
+
+    rng = np.random.default_rng(11)
+    V, L = BERT_DIMS["vocab_size"], 16
+    ckpt = make_bert_checkpoint(str(tmp_path), vocab=V,
+                                hidden=BERT_DIMS["hidden_size"],
+                                layers=BERT_DIMS["num_hidden_layers"],
+                                heads=BERT_DIMS["num_attention_heads"],
+                                intermediate=BERT_DIMS["intermediate_size"])
+    blob = gen_bert_blob(rng, 1, 8, L, vocab=V)
+    x = np.asarray(blob["user_data"]["0000"]["x"])
+    y = np.asarray(blob["user_data_label"]["0000"])
+
+    from transformers import BertForMaskedLM
+    net = BertForMaskedLM.from_pretrained(ckpt)
+    with torch.no_grad():
+        loss_t = float(net(input_ids=torch.tensor(x),
+                           attention_mask=torch.ones_like(torch.tensor(x)),
+                           labels=torch.tensor(y)).loss)
+
+    import jax
+    import jax.numpy as jnp
+
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    task = make_task(ModelConfig(model_type="BERT", extra={
+        "BERT": {"model": {"model_name_or_path": ckpt,
+                           "max_seq_length": L, "mask_token_id": 4},
+                 "training": {"seed": 0, "label_smoothing_factor": 0}}}))
+    params = task.init_params(jax.random.PRNGKey(0))
+    batch = {"x": jnp.asarray(x, jnp.int32), "y": jnp.asarray(y, jnp.int32),
+             "sample_mask": jnp.ones((len(x),), jnp.float32)}
+    loss_j = float(task.loss(params, batch, jax.random.PRNGKey(0),
+                             False)[0])
+    assert abs(loss_t - loss_j) < 1e-5, (loss_t, loss_j)
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference mount absent")
+def test_resnet_gn_transplant_forward_exact():
+    """GN-configured ResNet cross-check (VERDICT r3 item 6): build the
+    REFERENCE ResNet with group_norm actually honored
+    (``ResNet(BasicBlock, [2,2,2,2], num_classes, group_norm=32)`` —
+    the experiment wrapper ignores its config and calls bare
+    ``resnet18()``, ``experiments/cv_resnet_fedcifar100/model.py:139-152``),
+    transplant its weights into our flax ResNet and demand identical
+    logits.  Transplant notes: the reference GroupNorm affine is
+    per-GROUP (weight shape c/32, ``group_normalization.py:104-112``) —
+    repeated across each group's channels for our per-channel params;
+    conv [O,I,kh,kw] -> [kh,kw,I,O]; fc transposed.  Full-trajectory
+    parity is out of scope BY STRUCTURE: per-group affine receives the
+    summed per-channel gradient, so the two parameterizations diverge
+    from the first update (docs/reference_quirks.md)."""
+    import numpy as np
+    torch = pytest.importorskip("torch")
+    from importlib.machinery import SourceFileLoader
+
+    ref_dir = "/root/reference/experiments/cv_resnet_fedcifar100"
+    sys.path.insert(0, ref_dir)  # model.py imports group_normalization
+    loader = SourceFileLoader(
+        "ref_resnet_model", os.path.join(ref_dir, "model.py"))
+    mod = loader.load_module()
+
+    torch.manual_seed(0)
+    net = mod.ResNet(mod.BasicBlock, [2, 2, 2, 2], num_classes=10,
+                     group_norm=32)
+    net.eval()
+
+    import jax
+    import jax.numpy as jnp
+
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    task = make_task(ModelConfig(model_type="RESNET", extra={
+        "num_classes": 10, "image_size": 32}))
+    params = jax.device_get(task.init_params(jax.random.PRNGKey(0)))
+
+    def conv(w):
+        return np.asarray(w.detach()).transpose(2, 3, 1, 0)
+
+    def gn(w, channels):
+        w = np.asarray(w.detach())
+        return np.repeat(w, channels // len(w))
+
+    sd = net.state_dict()
+    p = params
+    p["Conv_0"]["kernel"] = conv(sd["conv1.weight"])
+    p["GroupNorm_0"]["scale"] = gn(sd["bn1.weight"], 64)
+    p["GroupNorm_0"]["bias"] = gn(sd["bn1.bias"], 64)
+    planes, bi = 64, 0
+    for stage in range(4):
+        for block in range(2):
+            t = f"layer{stage + 1}.{block}"
+            fb = p[f"_BasicBlock_{bi}"]
+            fb["Conv_0"]["kernel"] = conv(sd[f"{t}.conv1.weight"])
+            fb["GroupNorm_0"]["scale"] = gn(sd[f"{t}.bn1.weight"], planes)
+            fb["GroupNorm_0"]["bias"] = gn(sd[f"{t}.bn1.bias"], planes)
+            fb["Conv_1"]["kernel"] = conv(sd[f"{t}.conv2.weight"])
+            fb["GroupNorm_1"]["scale"] = gn(sd[f"{t}.bn2.weight"], planes)
+            fb["GroupNorm_1"]["bias"] = gn(sd[f"{t}.bn2.bias"], planes)
+            if f"{t}.downsample.0.weight" in sd:
+                fb["Conv_2"]["kernel"] = conv(sd[f"{t}.downsample.0.weight"])
+                fb["GroupNorm_2"]["scale"] = gn(
+                    sd[f"{t}.downsample.1.weight"], planes)
+                fb["GroupNorm_2"]["bias"] = gn(
+                    sd[f"{t}.downsample.1.bias"], planes)
+            bi += 1
+        planes = planes * 2 if stage < 3 else planes
+    p["Dense_0"]["kernel"] = np.asarray(sd["fc.weight"].detach()).T
+    p["Dense_0"]["bias"] = np.asarray(sd["fc.bias"].detach())
+
+    x = np.random.default_rng(0).normal(size=(4, 32, 32, 3)).astype(
+        np.float32)
+    with torch.no_grad():
+        logits_t = net(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+    logits_j = np.asarray(task.apply(p, jnp.asarray(x)))
+    np.testing.assert_allclose(logits_j, logits_t, atol=2e-4, rtol=2e-4)

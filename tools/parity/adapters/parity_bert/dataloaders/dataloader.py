@@ -1,0 +1,17 @@
+"""Pre-masked MLM parity dataloader: sequential order, default dict
+collation (each key stacked), no collator RNG — see dataset.py."""
+from core.dataloader import BaseDataLoader
+from experiments.parity_bert.dataloaders.dataset import Dataset
+
+
+class DataLoader(BaseDataLoader):
+    def __init__(self, mode, num_workers=0, **kwargs):
+        args = kwargs["args"]
+        self.batch_size = args["batch_size"]
+        dataset = Dataset(kwargs.get("data"),
+                          test_only=(mode != "train"),
+                          user_idx=kwargs.get("user_idx", 0))
+        self.utt_ids = dataset.user
+        super().__init__(dataset, batch_size=self.batch_size,
+                         shuffle=False, drop_last=False,
+                         num_workers=num_workers)

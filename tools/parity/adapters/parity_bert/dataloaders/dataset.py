@@ -1,0 +1,49 @@
+"""Pre-masked MLM parity dataset.
+
+The reference mlm_bert pipeline tokenizes text and masks it in the HF
+``DataCollatorForLanguageModeling`` with torch RNG — per-epoch re-rolls no
+cross-framework run can match.  This dataset instead reads blobs whose
+``user_data[u]['x']`` are ALREADY-MASKED token id rows and
+``user_data_label[u]`` the MLM labels (-100 at unmasked positions), so the
+training stream is bit-deterministic.  Interface mirrors the reference
+datasets (``experiments/cv_lr_mnist/dataloaders/dataset.py``): user_idx=-1
+enumerates, test_only concatenates all users.
+"""
+import numpy as np
+import torch
+from core.dataset import BaseDataset
+from parity_blob import maybe_load
+
+
+class Dataset(BaseDataset):
+    def __init__(self, data, test_only=False, user_idx=0, **kwargs):
+        # maybe_load flattens user_data[u] to the bare feature array
+        # (token ids here, hence the int dtype)
+        data = maybe_load(data, x_dtype=np.int64)
+        self.test_only = test_only
+        self.user_list = data["users"]
+        self.num_samples = data["num_samples"]
+        self.user_data = data["user_data"]
+        self.user_data_label = data["user_data_label"]
+        if user_idx == -1 or test_only:
+            self.user = self.user_list if user_idx == -1 else "test_only"
+            self.x = np.concatenate([np.asarray(self.user_data[u])
+                                     for u in self.user_list])
+            self.y = np.concatenate([np.asarray(self.user_data_label[u])
+                                     for u in self.user_list])
+        else:
+            self.user = self.user_list[user_idx]
+            self.x = np.asarray(self.user_data[self.user])
+            self.y = np.asarray(self.user_data_label[self.user])
+
+    def load_data(self, **kwargs):  # BaseDataset abstract contract
+        pass
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        ids = torch.as_tensor(self.x[idx], dtype=torch.long)
+        return {"input_ids": ids,
+                "attention_mask": torch.ones_like(ids),
+                "labels": torch.as_tensor(self.y[idx], dtype=torch.long)}

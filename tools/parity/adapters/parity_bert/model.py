@@ -1,0 +1,6 @@
+"""Parity-harness adapter task: re-exports the REFERENCE BERT task class
+unchanged (``experiments/mlm_bert/model.py:39``) so the cross-framework
+comparison trains the reference's own torch code against a LOCAL tiny
+checkpoint dir (``model_name_or_path``) — which also exercises the
+reference's pretrained-loading path end to end."""
+from experiments.mlm_bert.model import BERT  # noqa: F401

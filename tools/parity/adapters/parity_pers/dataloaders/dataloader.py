@@ -1,0 +1,2 @@
+"""Same blob loading as the LR parity adapter."""
+from experiments.parity_lr.dataloaders.dataloader import DataLoader  # noqa: F401

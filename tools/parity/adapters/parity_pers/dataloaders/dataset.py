@@ -1,0 +1,2 @@
+"""Same blob dataset as the LR parity adapter."""
+from experiments.parity_lr.dataloaders.dataset import Dataset  # noqa: F401

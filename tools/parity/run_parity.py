@@ -485,9 +485,21 @@ def ref_config(task, rounds, users, batch, lr, init_path, outdim):
         # init_path is the shared local checkpoint DIR (make_bert_checkpoint)
         # loaded through the reference's own pretrained path; no torch
         # state-dict transplant needed
+        # schema (core/schema.py:24-31) REQUIRES model_name and
+        # process_line_by_line; config validate (core/config.py:753-759)
+        # propagates model_name (NOT model_name_or_path) into every data
+        # config as the tokenizer path — so model_name must also be the
+        # local checkpoint dir.  cache_dir/use_fast_tokenizer are read
+        # unconditionally by the model/dataloaders.
         model["BERT"] = {
-            "model": {"model_name": "bert-tiny-parity",
+            "model": {"model_name": init_path,
                       "model_name_or_path": init_path,
+                      "process_line_by_line": False,
+                      # the model code's own default (True,
+                      # model.py:69) crashes eval at preds.size();
+                      # the experiment config class defaults False
+                      # (experiments/mlm_bert/config.py:43)
+                      "prediction_loss_only": False,
                       "cache_dir": None, "use_fast_tokenizer": False,
                       "mask_token_id": 4},
             "training": {"seed": 0, "label_smoothing_factor": 0,
@@ -1014,10 +1026,14 @@ def run_task(task, rounds, scratch, mode=None):
     import yaml
     tree = build_ref_tree(scratch)
     outdim = shape[0] if task in ("lstm", "gru") else classes  # seq_len
-    rc = ref_config(task, rounds, users, batch, lr,
-                    os.path.join(work, "init.pt"), outdim)
-    tc = tpu_config(task, rounds, users, batch, lr,
-                    os.path.join(work, "init.msgpack"), outdim)
+    if task == "bert":
+        # one shared checkpoint DIR is the init for both sides
+        ref_init = tpu_init = bert_ckpt
+    else:
+        ref_init = os.path.join(work, "init.pt")
+        tpu_init = os.path.join(work, "init.msgpack")
+    rc = ref_config(task, rounds, users, batch, lr, ref_init, outdim)
+    tc = tpu_config(task, rounds, users, batch, lr, tpu_init, outdim)
     if mode is not None:
         for mutate in MODES[mode]["mutate"]:
             mutate(rc, tc)
