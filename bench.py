@@ -630,36 +630,83 @@ def bench_varlen_bucketing(on_tpu: bool) -> dict:
 
 
 def scale_probe(backend: str) -> dict:
-    """K-clients-per-round scaling curve for the CNN protocol (the
-    reference's "tens of thousands sampled" axis, ``README.md:9``): find
+    """K-clients-per-round scaling curve (the reference's "tens of
+    thousands sampled / millions total" axis, ``README.md:9``).  Run via
+    ``BENCH_SCALE_PROBE=1``.
+
+    TPU: the CNN protocol over the device pool at K up to 1024 — find
     where ``[K, S, B, ...]`` staging hits the memory ceiling and how
-    s/round grows.  Run via ``BENCH_SCALE_PROBE=1``."""
+    s/round grows.  CPU: the LR protocol at K=8/100/1000 through the
+    ``LazyHDF5Users``/``LazyUserDataset`` host loader (per-user
+    on-demand IO + bounded LRU — the path a million-client pool rides),
+    recording s/round and host RSS so the curve demonstrates the host
+    side scales sub-linearly in pool size."""
     curve = {}
-    # CPU branch exists only to smoke the code path (tiny K, LR model);
-    # the real curve is a TPU measurement
     on_tpu = backend == "tpu"
-    ks = (64, 128, 256, 512, 1024) if on_tpu else (8,)
-    for k in ks:
-        model = ({"model_type": "CNN", "num_classes": 62} if on_tpu else
-                 {"model_type": "LR", "num_classes": 62, "input_dim": 784})
-        cfg = _flute_config(model, 20, 0.1, fuse=4 if on_tpu else 2)
-        cfg.server_config.num_clients_per_iteration = k
-        spu = 240 if on_tpu else 20
-        try:
-            data = _image_dataset(max(k, 8), spu,
-                                  (28, 28, 1) if on_tpu else (784,), 62,
-                                  np.random.default_rng(0))
-            res = bench_protocol("cnn_femnist", cfg, data, eval_users=4,
-                                 warmup_rounds=4 if on_tpu else 2,
-                                 timed_chunks=2,
-                                 eval_every=50)
-            curve[str(k)] = {"secs_per_round": res["secs_per_round"]}
-        except Exception as exc:
-            curve[str(k)] = {"error": f"{type(exc).__name__}: {exc}"}
-            msg = str(exc).upper()
-            if "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg:
-                break  # memory ceiling found; larger K can only be worse
-            # non-memory failure: keep probing the rest of the curve
+    if on_tpu:
+        ks = (64, 128, 256, 512, 1024)
+        for k in ks:
+            cfg = _flute_config({"model_type": "CNN", "num_classes": 62},
+                                20, 0.1, fuse=4)
+            cfg.server_config.num_clients_per_iteration = k
+            try:
+                data = _image_dataset(max(k, 8), 240, (28, 28, 1), 62,
+                                      np.random.default_rng(0))
+                res = bench_protocol("cnn_femnist", cfg, data, eval_users=4,
+                                     warmup_rounds=4, timed_chunks=2,
+                                     eval_every=50)
+                curve[str(k)] = {"secs_per_round": res["secs_per_round"]}
+            except Exception as exc:
+                curve[str(k)] = {"error": f"{type(exc).__name__}: {exc}"}
+                msg = str(exc).upper()
+                if "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg:
+                    break  # memory ceiling found; larger K is only worse
+        return curve
+
+    import resource
+    import tempfile
+
+    from msrflute_tpu.data.dataset import LazyUserDataset
+    from msrflute_tpu.data.user_blob import (LazyHDF5Users, UserBlob,
+                                             save_user_blob_hdf5)
+
+    pool = 1000
+    spu = 20
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "pool.hdf5")
+        blob = UserBlob(
+            user_list=[f"u{i:05d}" for i in range(pool)],
+            num_samples=[spu] * pool,
+            user_data=[{"x": rng.normal(size=(spu, 784)).astype(np.float32)}
+                       for _ in range(pool)],
+            user_labels=[rng.integers(0, 10, size=(spu,)).astype(np.int64)
+                         for _ in range(pool)],
+        )
+        save_user_blob_hdf5(path, blob)
+        users = LazyHDF5Users(path)
+        for k in (8, 100, 1000):
+            cfg = _flute_config({"model_type": "LR", "num_classes": 10,
+                                 "input_dim": 784}, 10, 0.1, fuse=2)
+            cfg.server_config.num_clients_per_iteration = k
+            try:
+                # fresh lazy view per K: the LRU starts cold, so the
+                # first rounds pay real per-user hdf5 IO like a cold pool
+                data = LazyUserDataset(users, cache_users=128)
+                res = bench_protocol("lr_mnist", cfg, data, eval_users=4,
+                                     warmup_rounds=2, timed_chunks=2,
+                                     eval_every=50)
+                curve[str(k)] = {
+                    "secs_per_round": res["secs_per_round"],
+                    "host_rss_mb": round(
+                        resource.getrusage(resource.RUSAGE_SELF)
+                        .ru_maxrss / 1024.0, 1),
+                }
+            except Exception as exc:
+                curve[str(k)] = {"error": f"{type(exc).__name__}: {exc}"}
+    curve["note"] = ("cpu curve: LR protocol via LazyHDF5Users on-demand "
+                     "host loader, pool=1000 users on disk; host_rss_mb "
+                     "is the process peak (monotone across Ks)")
     return curve
 
 

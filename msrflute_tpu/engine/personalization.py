@@ -121,8 +121,26 @@ class PersonalizationServer(OptimizationServer):
                            f"{len(self.store.alpha)} users")
         self._personal_fn = None
         self._personal_eval_fn = None
-        self._random_init = (self.config.server_config.get(
-            "personalization_init", "global") == "random")
+        init_kind = self.config.server_config.get(
+            "personalization_init", "global")
+        self._random_init = init_kind == "random"
+        # "initial": cold-start local models from the ROUND-0 global
+        # weights.  With a pretrained_model_path this is exactly what a
+        # reference adapter that loads the seed file in its constructor
+        # sees (the reference's own make_model draws a fresh torch-RNG
+        # init, core/client.py:390 + experiments/__init__.py:19 — which no
+        # cross-framework run can reproduce; the parity harness pins both
+        # sides to the seed file instead)
+        self._initial_params = (jax.device_get(self.state.params)
+                                if init_kind == "initial" else None)
+        # interpolation space for the personalized eval: the reference
+        # interpolates LOG-probabilities (cv model.py:294 applies
+        # LogSoftmax, convex_inference mixes those — a geometric prob
+        # mean), while plain "probs" (arithmetic mean, the standard
+        # ensemble) is our default; argmax differs near ties, so parity
+        # runs set personalization_interp: logprobs
+        self._interp_space = self.config.server_config.get(
+            "personalization_interp", "probs")
         # the personal pass reads the CURRENT global params per round, so
         # round fusion would train local models against stale globals
         if int(self.config.server_config.get("rounds_per_step", 1) or 1) > 1:
@@ -222,8 +240,12 @@ class PersonalizationServer(OptimizationServer):
             rng=self._np_rng, pad_clients_to=pad_to_mesh(len(sampled), self.mesh),
             desired_max_samples=self.desired_max_samples)
         k_pad = batch.client_mask.shape[0]
-        default = (self._random_params() if self._random_init
-                   else jax.device_get(self.state.params))
+        if self._random_init:
+            default = self._random_params()
+        elif self._initial_params is not None:
+            default = self._initial_params
+        else:
+            default = jax.device_get(self.state.params)
         locals_, alphas = [], []
         for j in range(k_pad):
             cid = int(batch.client_ids[j])
@@ -262,41 +284,62 @@ class PersonalizationServer(OptimizationServer):
         cspec = P(CLIENTS_AXIS)
         rspec = P()
 
+        logspace = self._interp_space == "logprobs"
+
         def shard_body(gp, lps, alphas, arrays, sample_mask, client_mask):
             def per_user(lp, alpha, arr, mask, cm):
                 x = arr["x"].reshape((-1,) + arr["x"].shape[2:])
                 y = arr["y"].reshape(-1).astype(jnp.int32)
                 m = mask.reshape(-1) * cm
-                probs = (alpha * jax.nn.softmax(task.apply(lp, x)) +
-                         (1.0 - alpha) * jax.nn.softmax(task.apply(gp, x)))
+                squash = jax.nn.log_softmax if logspace else jax.nn.softmax
+                probs = (alpha * squash(task.apply(lp, x)) +
+                         (1.0 - alpha) * squash(task.apply(gp, x)))
                 pred = jnp.argmax(probs, axis=-1)
+                # per-user loss = (global CE + local CE) / 2, sample-
+                # weighted across users — the reference's personalized
+                # "Val loss" definition (core/client.py:218-219: plain
+                # average of the two models' losses; alpha plays no role)
+                flat = {"x": x, "y": y, "sample_mask": m}
+                lg = task.loss(gp, flat, None, False)[0]
+                ll = task.loss(lp, flat, None, False)[0]
+                n = jnp.sum(m)
                 return (jnp.sum((pred == y).astype(jnp.float32) * m),
-                        jnp.sum(m))
+                        jnp.sum(m),
+                        0.5 * (lg + ll) * n * (cm > 0))
 
-            c, t = jax.vmap(per_user)(lps, alphas, arrays, sample_mask,
-                                      client_mask)
+            c, t, ls = jax.vmap(per_user)(lps, alphas, arrays, sample_mask,
+                                          client_mask)
             return (jax.lax.psum(jnp.sum(c), CLIENTS_AXIS),
-                    jax.lax.psum(jnp.sum(t), CLIENTS_AXIS))
+                    jax.lax.psum(jnp.sum(t), CLIENTS_AXIS),
+                    jax.lax.psum(jnp.sum(ls), CLIENTS_AXIS))
 
         fn = shard_map(shard_body, mesh=self.engine.mesh,
                        in_specs=(rspec, cspec, cspec, cspec, cspec, cspec),
-                       out_specs=(rspec, rspec), check_vma=False)
+                       out_specs=(rspec, rspec, rspec), check_vma=False)
         return jax.jit(fn)
 
     def personalized_accuracy(self, dataset) -> Optional[float]:
-        """Convex-interpolated accuracy over users with local state —
-        one compiled program services all users.
+        """Back-compat wrapper: accuracy component of the personalized
+        eval."""
+        res = self.personalized_eval(dataset)
+        return None if res is None else res[0]
+
+    def personalized_eval(self, dataset) -> Optional[Tuple[float, float]]:
+        """Convex-interpolated accuracy + reference-style personalized
+        loss over ALL of the dataset's users — one compiled program
+        services everyone.  Users without local state evaluate with the
+        global model in both slots (interp of identical models == the
+        global model; loss (g+g)/2 == g), exactly the reference's fallback
+        when no ``<user>_model.tar`` exists (core/client.py:197-219).
 
         Chunk width is FIXED at the mesh's client-axis size: one local-model
         replica per device lane bounds the staging memory (K param copies is
         the real cost at ResNet scale), and the constant shape means exactly
         one compilation no matter how the store grows.  ``S`` respects the
         configured ``desired_max_samples`` cap when present."""
-        if not self.store.alpha:
-            return None
         if not hasattr(self.task, "apply"):
             return None
-        uids = sorted(u for u in self.store.alpha if 0 <= u < len(dataset))
+        uids = list(range(len(dataset)))
         if not uids:
             return None
         if self._personal_eval_fn is None:
@@ -308,26 +351,29 @@ class PersonalizationServer(OptimizationServer):
                       self.desired_max_samples)
         chunk_k = self.mesh.shape[CLIENTS_AXIS]
         gp_host = jax.device_get(self.state.params)
-        correct = total = 0.0
+        correct = total = loss_sum = 0.0
         for i in range(0, len(uids), chunk_k):
             part = uids[i:i + chunk_k]
             batch = pack_round_batches(
                 dataset, part, bs, S, shuffle=False, pad_clients_to=chunk_k,
                 desired_max_samples=self.desired_max_samples)
             lps = [self.store.params.get(u, gp_host) for u in part]
-            alphas = [self.store.alpha[u] for u in part]
+            alphas = [self.store.alpha.get(u, self.alpha0) for u in part]
             while len(lps) < chunk_k:  # mesh-padding lanes (client_mask 0)
                 lps.append(gp_host)
                 alphas.append(self.alpha0)
             lps_dev, alphas_dev, arrays_dev, smask, cmask, _ = \
                 self._stage_on_clients_axis(lps, alphas, batch)
-            c, t = self._personal_eval_fn(
+            c, t, ls = self._personal_eval_fn(
                 self.state.params, lps_dev, alphas_dev, arrays_dev,
                 smask, cmask)
             correct += float(c)
             total += float(t)
+            loss_sum += float(ls)
         if total == 0:
             return None
         acc = correct / total
+        loss = loss_sum / total
         log_metric("Personalized val acc", acc, step=self.state.round)
-        return acc
+        log_metric("Personalized val loss", loss, step=self.state.round)
+        return acc, loss

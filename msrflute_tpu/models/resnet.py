@@ -30,8 +30,16 @@ def _gn(channels: int, channels_per_group: int = 32,
         zero_scale: bool = False, dtype=jnp.float32) -> nn.GroupNorm:
     groups = max(channels // max(channels_per_group, 1), 1)
     # flax GroupNorm computes its statistics in float32 regardless of
-    # ``dtype``; passing the compute dtype only keeps activations bf16
-    return nn.GroupNorm(num_groups=groups, dtype=dtype,
+    # ``dtype``; passing the compute dtype only keeps activations bf16.
+    # epsilon matches the reference's F.batch_norm default 1e-5
+    # (group_normalization.py:19 via _BatchNorm) — flax's own default is
+    # 1e-6, a visible round-0 forward delta.  NOTE a deliberate
+    # divergence kept per-channel: the reference's GroupNorm affine is
+    # per-GROUP (weight shape c/32, group_normalization.py:104-112);
+    # ours is flax-standard per-channel (strictly more expressive;
+    # identical at init, transplant repeats each group scalar across its
+    # channels — see tests/test_parity_harness.py resnet transplant).
+    return nn.GroupNorm(num_groups=groups, dtype=dtype, epsilon=1e-5,
                         scale_init=(nn.initializers.zeros if zero_scale
                                     else nn.initializers.ones))
 

@@ -134,6 +134,7 @@ SERVER_KEYS = {
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
+    "personalization_init", "personalization_interp",
 }
 
 CLIENT_KEYS = {
@@ -399,6 +400,10 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
     sc = raw.get("server_config")
     if isinstance(sc, dict):
         _check_enum(errors, sc, "server_config", "type", ALLOWED_SERVER_TYPES)
+        _check_enum(errors, sc, "server_config", "personalization_init",
+                    ["global", "random", "initial"])
+        _check_enum(errors, sc, "server_config", "personalization_interp",
+                    ["probs", "logprobs"])
         _check_unknown(unknown, sc, "server_config", SERVER_KEYS)
         _check_optimizer(errors, sc.get("optimizer_config"), "server_config.optimizer_config", unknown)
         _check_annealing(errors, sc.get("annealing_config"), "server_config.annealing_config", unknown)

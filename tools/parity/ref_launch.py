@@ -20,6 +20,20 @@ def _get_exp_dataloader(task):
 
 du.get_exp_dataloader = _get_exp_dataloader
 
+# PyTorch >= 2.6 defaults torch.load(weights_only=True), which rejects the
+# numpy scalar the reference pickles for the personalization alpha
+# (``torch.save(alpha, ...)``, core/client.py:442 — alpha_update returns an
+# np.clip float64).  Allowlist the numpy globals so the reference's own
+# save/load roundtrip works under the current torch.
+import numpy as _np  # noqa: E402
+import torch as _torch  # noqa: E402
+
+_torch.serialization.add_safe_globals(
+    [_np.dtype, _np.ndarray, _np._core.multiarray.scalar,
+     _np._core.multiarray._reconstruct]
+    + [getattr(_np.dtypes, n) for n in dir(_np.dtypes)
+       if n.endswith("DType")])
+
 sys.argv = ["e2e_trainer.py"] + sys.argv[1:]
 import runpy  # noqa: E402
 

@@ -614,8 +614,30 @@ def build_ref_tree(scratch):
     tree = os.path.join(scratch, "refrun")
     shutil.rmtree(tree, ignore_errors=True)
     os.makedirs(os.path.join(tree, "experiments"))
-    for name in ("core", "utils", "extensions", "e2e_trainer.py"):
+    for name in ("utils", "extensions", "e2e_trainer.py"):
         os.symlink(os.path.join(REFERENCE, name), os.path.join(tree, name))
+    # core is symlinked per FILE so client.py can carry a one-line
+    # runtime repair: the personalization branch unpacks TWO values from
+    # train_desired_samples (core/client.py:427) which returns THREE
+    # (core/trainer.py:339) — the reference's personalization training
+    # crashes out of the box (docs/reference_quirks.md).  Patched in the
+    # scratch tree only; nothing is copied into this repo.
+    os.makedirs(os.path.join(tree, "core"))
+    for name in os.listdir(os.path.join(REFERENCE, "core")):
+        src = os.path.join(REFERENCE, "core", name)
+        dst = os.path.join(tree, "core", name)
+        if name == "client.py":
+            with open(src) as fh:
+                text = fh.read()
+            broken = ("            train_loss, num_samples = "
+                      "local_trainer.train_desired_samples(")
+            fixed = ("            train_loss, num_samples, _ = "
+                     "local_trainer.train_desired_samples(")
+            assert broken in text, "reference client.py drifted; re-check"
+            with open(dst, "w") as fh:
+                fh.write(text.replace(broken, fixed, 1))
+        else:
+            os.symlink(src, dst)
     for name in os.listdir(os.path.join(REFERENCE, "experiments")):
         os.symlink(os.path.join(REFERENCE, "experiments", name),
                    os.path.join(tree, "experiments", name))
@@ -623,6 +645,13 @@ def build_ref_tree(scratch):
         if os.path.isdir(os.path.join(ADAPTERS, task)):
             os.symlink(os.path.join(ADAPTERS, task),
                        os.path.join(tree, "experiments", task))
+    # the personalization server import is hardcoded to experiments/cv
+    # (core/server.py:593-595) and the reference's own class there has a
+    # stale constructor signature that crashes — remap cv to the
+    # signature-current pass-through shim (see cv_server_shim/server.py)
+    cv_link = os.path.join(tree, "experiments", "cv")
+    os.remove(cv_link)
+    os.symlink(os.path.join(ADAPTERS, "cv_server_shim"), cv_link)
     return tree
 
 
