@@ -253,7 +253,12 @@ def test_resnet_gn_transplant_forward_exact():
     from importlib.machinery import SourceFileLoader
 
     ref_dir = "/root/reference/experiments/cv_resnet_fedcifar100"
-    sys.path.insert(0, ref_dir)  # model.py imports group_normalization
+    # model.py does `from experiments.cv_resnet_fedcifar100.group_
+    # normalization import ...` — needs the reference root as package
+    # root; importing the experiments package pulls reference utils,
+    # whose offline deps (easydict et al.) live in tools/ref_shims
+    sys.path.insert(0, "/root/reference")
+    sys.path.insert(0, os.path.join(REPO, "tools", "ref_shims"))
     loader = SourceFileLoader(
         "ref_resnet_model", os.path.join(ref_dir, "model.py"))
     mod = loader.load_module()
