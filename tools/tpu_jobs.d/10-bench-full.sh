@@ -1,4 +1,7 @@
 #!/bin/bash
 # Full on-chip bench: four protocols + bf16 + longctx + MFU.  Writes the
 # timestamped BENCH_TPU_*.json raw artifact itself (bench.py main).
-BENCH_TPU_WAIT_SECS=60 python bench.py > bench_tpu_full.json 2> bench_tpu_full.err
+# The runner has no caller timeout, so raise the self-imposed deadline
+# (default 25 min protects DRIVER runs) well above a full measurement.
+BENCH_DEADLINE_SECS=7200 BENCH_TPU_WAIT_SECS=60 \
+  python bench.py > bench_tpu_full.json 2> bench_tpu_full.err
