@@ -140,6 +140,7 @@ _LIVE_PROBE = None
 
 
 def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
+    was_flushed = _FLUSHED
     _flush(f"killed by signal {signum} after {time.time() - _START:.0f}s; "
            "partial results")
     # _flush no-ops if the main thread already emitted the line but may
@@ -149,7 +150,10 @@ def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
         sys.stdout.flush()
     except Exception:
         pass
-    _mirror_partial()
+    if not was_flushed:
+        # a signal AFTER the successful flush must not resurrect the
+        # partial mirror the flush just removed
+        _mirror_partial()
     if _LIVE_PROBE is not None and _LIVE_PROBE.poll() is None:
         try:
             _LIVE_PROBE.terminate()  # graceful; give the claim a chance

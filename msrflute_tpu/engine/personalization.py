@@ -339,6 +339,12 @@ class PersonalizationServer(OptimizationServer):
         configured ``desired_max_samples`` cap when present."""
         if not hasattr(self.task, "apply"):
             return None
+        if not self.store.alpha:
+            # nothing personalized yet (e.g. initial_val before round 1):
+            # the whole program would reduce to 4 redundant global
+            # forwards per user — skip; the standard global eval already
+            # covers this state
+            return None
         uids = list(range(len(dataset)))
         if not uids:
             return None

@@ -51,6 +51,7 @@ class BertMLMTask(BaseTask):
         self.label_smoothing = float(
             training_cfg.get("label_smoothing_factor", 0.0))
         self.mask_token_id = int(bert_cfg.get("mask_token_id", 103))
+        self.premasked = bool(bert_cfg.get("premasked", False))
         from .base import parse_dtype
         # compute dtype (bf16 MXU path; HF Flax threads it through every
         # layer — params stay f32, logits are upcast in the loss)
@@ -144,17 +145,21 @@ class BertMLMTask(BaseTask):
 
     # ------------------------------------------------------------------
     def _premasked(self, batch: Batch):
-        """Pre-masked mode: when the blob ships ``y`` (MLM labels, -100 at
-        unmasked positions) the input ids are already masked and the
-        collator RNG is bypassed entirely — the parity harness uses this
-        to make the BERT family deterministic (the reference's
+        """Pre-masked mode (config ``BERT.model.premasked: true``): the
+        blob ships already-masked input ids plus MLM labels under ``y``
+        (-100 at unmasked positions) and the collator RNG is bypassed
+        entirely — the parity harness uses this to make the BERT family
+        deterministic (the reference's
         ``DataCollatorForLanguageModeling`` re-rolls masks per epoch,
-        which no cross-framework RNG can match)."""
-        if "y" not in batch:
+        which no cross-framework RNG can match).  The mode is an
+        EXPLICIT opt-in: inferring it from the presence of a ``y`` key
+        would silently disable dynamic masking for any blob that happens
+        to ship labels."""
+        if not self.premasked:
             return None
         input_ids = batch["x"].astype(jnp.int32)
         attention_mask = batch.get(
-            "attention_mask", jnp.ones_like(input_ids))
+            "attention_mask", (input_ids != 0).astype(jnp.int32))
         attention_mask = (attention_mask
                           * batch["sample_mask"][:, None].astype(
                               attention_mask.dtype)).astype(jnp.int32)

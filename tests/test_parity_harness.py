@@ -222,7 +222,8 @@ def test_bert_checkpoint_forward_exact(tmp_path):
     from msrflute_tpu.models import make_task
     task = make_task(ModelConfig(model_type="BERT", extra={
         "BERT": {"model": {"model_name_or_path": ckpt,
-                           "max_seq_length": L, "mask_token_id": 4},
+                           "max_seq_length": L, "mask_token_id": 4,
+                           "premasked": True},
                  "training": {"seed": 0, "label_smoothing_factor": 0}}}))
     params = task.init_params(jax.random.PRNGKey(0))
     batch = {"x": jnp.asarray(x, jnp.int32), "y": jnp.asarray(y, jnp.int32),

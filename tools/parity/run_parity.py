@@ -558,7 +558,8 @@ def tpu_config(task, rounds, users, batch, lr, init_path, outdim):
         # HF's own torch->flax conversion (models/bert.py from_pt fallback)
         model["BERT"] = {"model": {"model_name_or_path": init_path,
                                    "max_seq_length": outdim,
-                                   "mask_token_id": 4},
+                                   "mask_token_id": 4,
+                                   "premasked": True},
                          "training": {"seed": 0,
                                       "label_smoothing_factor": 0}}
     else:
@@ -698,6 +699,16 @@ def run_reference(tree, cfg_path, data_dir, out_dir, task, metrics_out):
                          f"rc={proc.returncode} (port {port}); tail:\n"
                          + proc.stdout[-2000:] + "\n" + proc.stderr[-3000:]
                          + "\n")
+        # only rendezvous/bind flakiness justifies re-running a full
+        # training; a deterministic crash (adapter bug, config typo)
+        # would just burn two more identical multi-minute runs and bury
+        # the real traceback.  NOTE "Connection closed by peer" is NOT
+        # in this list: gloo prints it on rank0 for ANY rank1 crash.
+        transient = ("Address already in use", "EADDRINUSE",
+                     "failed to listen", "rendezvous")
+        blob = proc.stdout + proc.stderr
+        if not any(sig in blob for sig in transient):
+            break
     if proc.returncode != 0:
         raise RuntimeError(f"reference trainer failed rc={proc.returncode}")
     # Vals appear strictly in round order but the "Current iteration" marker
