@@ -318,3 +318,26 @@ def test_resnet_gn_transplant_forward_exact():
         logits_t = net(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
     logits_j = np.asarray(task.apply(p, jnp.asarray(x)))
     np.testing.assert_allclose(logits_j, logits_t, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference mount not available")
+def test_dga_extension_mode_trajectory_exact(tmp_path):
+    """Extension-mode regression: the DGA softmax-weighting mode (the
+    base of all five extensions-ON PARITY.json families) stays
+    trajectory-exact against the actual reference at 2 rounds — keeps
+    the round-4 extension-parity claim continuously verified the same
+    way test_lr_trajectory_exact pins the plain family."""
+    out = tmp_path / "parity.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parity",
+                                      "run_parity.py"),
+         "--tasks", "dga", "--rounds", "2",
+         "--scratch", str(tmp_path / "scratch"), "--out", str(out)],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    res = json.loads(out.read_text())["dga"]
+    assert res["ok"], res["verdict"]
+    assert res["protocol"]["strategy"] == "DGA"
+    assert res["max_abs_diff_val_loss"] < 1e-4
+    assert res["max_abs_diff_val_acc"] == 0.0
