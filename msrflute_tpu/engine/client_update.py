@@ -145,7 +145,8 @@ def build_client_update(task: BaseTask, client_opt_cfg,
                        if hparams.updatable_layers is not None else None)
 
         def one_step(carry, xs):
-            params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc = carry
+            (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc,
+             ns_acc) = carry
             batch_arrays, mask = xs
             batch = dict(batch_arrays)
             batch["sample_mask"] = mask
@@ -172,6 +173,12 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             # by (num_epochs * n_k) later gives a mean that is invariant
             # to how the samples were split into batches (q-FFL weights)
             wloss_acc = wloss_acc + loss * jnp.sum(mask)
+            # the task decides how the trainer COUNTS its samples
+            # (reference core/trainer.py:397-405: rows by default, token
+            # positions for mlm/frame-bearing batches) — this feeds
+            # aggregation weights and DGA's train_loss/num_samples metric
+            ns_acc = ns_acc + has_data * _aux.get(
+                "train_sample_count", jnp.sum(mask))
             updates, new_opt = tx.update(grads, opt_state, params)
             if update_mask is not None:
                 # frozen layers never move at ANY inner step (the per-param
@@ -190,7 +197,7 @@ def build_client_update(task: BaseTask, client_opt_cfg,
                 lambda new, old: jnp.where(has_data > 0, new, old),
                 new_opt, opt_state)
             return (params, opt_state, rng, loss_sum, s, s2, n_acc,
-                    wloss_acc), None
+                    wloss_acc, ns_acc), None
 
         params = global_params
         loss_sum = jnp.zeros(())
@@ -198,11 +205,14 @@ def build_client_update(task: BaseTask, client_opt_cfg,
         s2 = jnp.zeros(())
         n_acc = jnp.zeros(())
         wloss_acc = jnp.zeros(())
-        carry = (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc)
+        ns_acc = jnp.zeros(())
+        carry = (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc,
+                 ns_acc)
         for _ in range(hparams.num_epochs):
             carry, _ = jax.lax.scan(carry_step := one_step, carry,
                                     (arrays, sample_mask))
-        params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc = carry
+        (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc,
+         ns_acc) = carry
 
         pseudo_grad = jax.tree.map(lambda w0, w: w0 - w, global_params, params)
         if freeze:
@@ -215,11 +225,18 @@ def build_client_update(task: BaseTask, client_opt_cfg,
         else:
             stats = _derive_stats(s, s2, n_acc)
 
-        num_samples = jnp.sum(sample_mask)
-        # per-SAMPLE mean training loss, invariant to batch partitioning
-        # (consumed by q-FFL's fairness weights, strategies/qffl.py)
+        rows = jnp.sum(sample_mask)
+        # per-SAMPLE (per-ROW) mean training loss, invariant to batch
+        # partitioning (consumed by q-FFL's fairness weights,
+        # strategies/qffl.py) — rows on purpose: wloss_acc accumulates
+        # row-weighted batch means, regardless of the task's trainer
+        # counting unit below
         stats["mean_sample_loss"] = wloss_acc / jnp.maximum(
-            num_samples * hparams.num_epochs, 1.0)
+            rows * hparams.num_epochs, 1.0)
+        # ns_acc is the task's counting unit for this client — the
+        # epoch loop re-counts per epoch like the reference
+        # (train_desired_samples accumulates per epoch), so divide back
+        num_samples = ns_acc / jnp.maximum(hparams.num_epochs, 1)
         return pseudo_grad, loss_sum, num_samples, stats
 
     return client_update

@@ -187,7 +187,14 @@ class BertMLMTask(BaseTask):
                               rng=drop_rng if train else None)
         nll, valid = self._masked_xent(logits, labels)
         loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
-        return loss, {"sample_count": jnp.sum(batch["sample_mask"])}
+        return loss, {
+            "sample_count": jnp.sum(batch["sample_mask"]),
+            # the reference trainer counts mlm samples as attention
+            # POSITIONS, not sequences (core/trainer.py:400-401) — this
+            # feeds aggregation weights and the DGA softmax metric
+            "train_sample_count": jnp.sum(
+                attention_mask.astype(jnp.float32)),
+        }
 
     def eval_stats(self, params, batch: Batch) -> Dict[str, jnp.ndarray]:
         pre = self._premasked(batch)

@@ -177,6 +177,13 @@ class SequenceLMTask(BaseTask):
         tok_mask = tok_mask * batch["sample_mask"][:, None]
         return logits, targets, tok_mask
 
+    #: how the TRAINER counts this task's samples for aggregation weights
+    #: and the DGA softmax metric (reference ``core/trainer.py:397-405``:
+    #: rows by default, ``total_frames`` — real token positions — when the
+    #: batch ships them, as nlg_gru's does).  fed_shakespeare batches ship
+    #: neither key, so the LSTM task keeps row counting.
+    count_frames = False
+
     def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
              train: bool = True):
         logits, targets, tok_mask = self._logits_targets(params, batch)
@@ -184,6 +191,17 @@ class SequenceLMTask(BaseTask):
         total = jnp.sum(per_tok * tok_mask)
         count = jnp.maximum(jnp.sum(tok_mask), 1.0)
         aux = {"sample_count": jnp.sum(batch["sample_mask"])}
+        if self.count_frames:
+            # reference total_frames = sum of real INPUT positions
+            # (``experiments/nlg_gru/dataloaders/dataloader.py:83``); the
+            # input-position mask counts them regardless of unk ids
+            inp = batch.get("tok_mask")
+            frames = (jnp.sum(inp.astype(jnp.float32)
+                              * batch["sample_mask"][:, None])
+                      if inp is not None else
+                      jnp.sum((batch["x"] != 0).astype(jnp.float32)
+                              * batch["sample_mask"][:, None]))
+            aux["train_sample_count"] = frames
         return total / count, aux
 
     def topk_predictions(self, params, batch: Batch, k: int = 1):
@@ -286,6 +304,11 @@ class GRUWordTask(_TokenDatasetMixin, SequenceLMTask):
     tokenizer = "words"
     # the reference GRU trains position 0 from the zero initial state
     ref_initial_prediction = True
+    # nlg_gru batches carry total_frames: the trainer counts WORDS, not
+    # utterances (invisible under equal-sized users — the normalized
+    # aggregate cancels a constant factor — but load-bearing for FedAvg
+    # weights on unequal users and for DGA's train_loss/num_samples)
+    count_frames = True
 
 
 def make_shakespeare_lstm_task(model_config) -> SequenceLMTask:

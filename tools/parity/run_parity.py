@@ -915,6 +915,15 @@ MODES = {
                              "Personalized val acc": "Val acc"}},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
+    # DGA softmax weighting on the GRU base: exercises the
+    # train_loss/num_samples metric where the COUNTING UNIT matters —
+    # nlg_gru batches carry total_frames, so the reference counts WORDS
+    # (core/trainer.py:402-403) while rows would be utterances; a
+    # counting mismatch shifts every client's softmax weight even with
+    # equal-sized users (unlike FedAvg, where a constant factor cancels
+    # in the normalized aggregate)
+    "gru_dga": {"base": "gru", "mutate": [_dga_strategy],
+                "criteria": "near"},
     # deterministic: DGA + per-layer 8-bit quantization at the 0.5 quantile
     "dga_quant": {"mutate": [_dga_strategy, _quant], "criteria": "near"},
     # deterministic: clip-only local DP (eps < 0) under DGA
