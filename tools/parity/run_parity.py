@@ -68,13 +68,18 @@ def gen_blob(rng, users, samples, shape, classes, sep=2.0, means=None):
 
     Pass the same ``means`` for train and val: a fresh draw per split
     would make validation distributionally unrelated to training and pin
-    val accuracy at chance regardless of learning.
-    """
+    val accuracy at chance regardless of learning.  ``samples`` may be a
+    per-user sequence — UNEVEN sizes make the sample-count aggregation
+    weights load-bearing (equal users cancel any constant factor in the
+    normalized aggregate)."""
     if means is None:
         means = rng.normal(size=(classes,) + shape).astype(np.float32)
+    per_user = (list(samples) if isinstance(samples, (list, tuple))
+                else [samples] * users)
     out = {"users": [], "num_samples": [], "user_data": {},
            "user_data_label": {}}
     for u in range(users):
+        samples = per_user[u]
         y = rng.integers(0, classes, size=(samples,))
         x = (sep * means[y]
              + rng.normal(size=(samples,) + shape)).astype(np.float32)
@@ -913,6 +918,12 @@ MODES = {
     "pers": {"mutate": [_personalization], "criteria": "near",
              "tpu_metrics": {"Personalized val loss": "Val loss",
                              "Personalized val acc": "Val acc"}},
+    # deterministic: UNEVEN user sizes under plain FedAvg — the
+    # sample-count weights (reference fedavg.py:80: weight =
+    # trainer.num_samples) stop cancelling in the normalized aggregate,
+    # so proportional weighting itself is under test; every other family
+    # ships equal-sized users
+    "lr_uneven": {"mutate": [], "criteria": "exact", "uneven_users": True},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
@@ -1052,6 +1063,10 @@ def run_task(task, rounds, scratch, mode=None):
         save_flax_gru(init, os.path.join(work, "init.msgpack"))
     else:
         means = rng.normal(size=(data_classes,) + shape).astype(np.float32)
+        if mode is not None and MODES[mode].get("uneven_users"):
+            # spread 8..(8+3(users-1)) — stays under the one-batch cap
+            # (batch_size 64) so rounds remain shuffle-order-comparable
+            samples = [8 + 3 * u for u in range(users)]
         train = gen_blob(rng, users, samples, shape, data_classes, sep=3.0,
                          means=means)
         val = gen_blob(rng, 4, 64, shape, data_classes, sep=3.0, means=means)
