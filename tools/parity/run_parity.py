@@ -924,6 +924,26 @@ MODES = {
     # so proportional weighting itself is under test; every other family
     # ships equal-sized users
     "lr_uneven": {"mutate": [], "criteria": "exact", "uneven_users": True},
+    # deterministic: non-trivial SERVER optimizers — every other family
+    # runs the canonical SGD(lr=1.0) server step, so the ModelUpdater
+    # semantics (our optax step vs the reference's torch.optim step on
+    # the aggregated pseudo-gradient, core/trainer.py update_model) are
+    # otherwise only exercised in their degenerate form.  torch Adam's
+    # m_hat/(sqrt(v_hat)+eps) == optax.adam(eps_root=0); torch SGD
+    # momentum buf = mu*buf + g == optax trace (nesterov off).
+    "lr_server_adam": {
+        "mutate": [lambda rc, tc: [
+            c["server_config"].update(
+                {"optimizer_config": {"type": "adam", "lr": 0.02}})
+            for c in (rc, tc)]],
+        "criteria": "exact"},
+    "lr_server_momentum": {
+        "mutate": [lambda rc, tc: [
+            c["server_config"].update(
+                {"optimizer_config": {"type": "sgd", "lr": 1.0,
+                                      "momentum": 0.9}})
+            for c in (rc, tc)]],
+        "criteria": "exact"},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
