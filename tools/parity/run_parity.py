@@ -944,6 +944,16 @@ MODES = {
                                       "momentum": 0.9}})
             for c in (rc, tc)]],
         "criteria": "exact"},
+    # deterministic: CLIENT-side Adam — the per-client optimizer state
+    # machinery (fresh optax.adam per round under vmap vs the
+    # reference's fresh torch.optim.Adam per process_round) on real
+    # bias-corrected first steps
+    "lr_client_adam": {
+        "mutate": [lambda rc, tc: [
+            c["client_config"].update(
+                {"optimizer_config": {"type": "adam", "lr": 0.05}})
+            for c in (rc, tc)]],
+        "criteria": "exact"},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
