@@ -954,6 +954,17 @@ MODES = {
                 {"optimizer_config": {"type": "adam", "lr": 0.05}})
             for c in (rc, tc)]],
         "criteria": "exact"},
+    # deterministic: layer freezing — the aggregate skips the frozen
+    # layer's pseudo-gradient (reference zeroes p.grad by exact
+    # named_parameters match, fedavg.py:83-88 reading
+    # model_config.freeze_layer; ours zeroes by flax path fragment from
+    # client_config.freeze_layer) — each side names the SAME layer in
+    # its own parameter vocabulary
+    "lr_freeze": {
+        "mutate": [lambda rc, tc: (
+            rc["model_config"].update({"freeze_layer": "net.linear.weight"}),
+            tc["client_config"].update({"freeze_layer": "Dense_0/kernel"}))],
+        "criteria": "exact"},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
