@@ -60,6 +60,20 @@ def steps_for(max_samples: int, batch_size: int,
     return max(1, math.ceil(cap / batch_size))
 
 
+def _sample_cap(S: int, B: int, desired_max_samples: Optional[int]) -> int:
+    """Per-client sample cap in the reference's BATCH-granular semantics:
+    its epoch loop checks the accumulated count at the TOP of each batch
+    (``core/trainer.py:363-364``), so the batch that crosses
+    ``desired_max_samples`` still trains in full — the effective cap is
+    ``ceil(desired/B)*B``, not ``desired`` (an exact-sample cap would
+    train on fewer samples than the reference whenever the cap is not a
+    batch multiple; with one batch per client a cap below the batch size
+    would wrongly engage at all)."""
+    if desired_max_samples is None:
+        return S * B
+    return min(S * B, -(-int(desired_max_samples) // B) * B)
+
+
 def _pad_feat(sample_count: int, shape: tuple, dtype) -> np.ndarray:
     return np.zeros((sample_count,) + shape, dtype=dtype)
 
@@ -96,7 +110,7 @@ def pack_round_batches(
     client_mask = np.zeros((K_pad,), dtype=np.float32)
     client_ids = np.full((K_pad,), -1, dtype=np.int32)
 
-    cap = S * B if desired_max_samples is None else min(S * B, desired_max_samples)
+    cap = _sample_cap(S, B, desired_max_samples)
     users, takes = [], []
     for j, ci in enumerate(client_indices):
         user = dataset.user_arrays(ci)
@@ -208,8 +222,7 @@ def pack_round_indices(
     client_mask = np.zeros((K_pad,), dtype=np.float32)
     client_ids = np.full((K_pad,), -1, dtype=np.int32)
 
-    cap = S * B if desired_max_samples is None else min(S * B,
-                                                        desired_max_samples)
+    cap = _sample_cap(S, B, desired_max_samples)
     for j, ci in enumerate(client_indices):
         n = int(dataset.num_samples[ci])
         order = rng.permutation(n) if shuffle else np.arange(n)

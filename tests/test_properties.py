@@ -61,8 +61,11 @@ def test_pack_round_batches_masked_padding_algebra(shapes, seed):
     rb2 = pack_round_batches(ds, list(range(n_users)), batch, S,
                              rng=np.random.default_rng(seed + 2),
                              desired_max_samples=cap)
+    # batch-granular cap: the crossing batch trains in full (reference
+    # core/trainer.py:363-364), bounded by S*B and the client's rows
+    eff_cap = min(-(-cap // batch) * batch, S * batch)
     for j, n in enumerate(counts):
-        t = min(n, cap)
+        t = min(n, eff_cap)
         mask = rb2.sample_mask[j].reshape(-1)
         assert mask.sum() == t == rb2.num_samples[j]
         real = rb2.arrays["x"][j].reshape(S * batch, dim)[mask > 0]

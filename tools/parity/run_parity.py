@@ -965,6 +965,16 @@ MODES = {
             rc["model_config"].update({"freeze_layer": "net.linear.weight"}),
             tc["client_config"].update({"freeze_layer": "Dense_0/kernel"}))],
         "criteria": "exact"},
+    # deterministic: desired_max_samples BELOW the per-user sample count
+    # with one batch per client — the reference's batch-granular cap
+    # (loop-top check, core/trainer.py:363-364) means the full batch
+    # still trains; an exact-sample cap would train on fewer samples
+    # and shift both the pseudo-gradient and the num_samples weight
+    "lr_maxsamples": {
+        "mutate": [lambda rc, tc: [
+            c["client_config"]["data_config"]["train"].update(
+                {"desired_max_samples": 25}) for c in (rc, tc)]],
+        "criteria": "exact"},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
