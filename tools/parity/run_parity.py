@@ -975,6 +975,27 @@ MODES = {
             c["client_config"]["data_config"]["train"].update(
                 {"desired_max_samples": 25}) for c in (rc, tc)]],
         "criteria": "exact"},
+    # deterministic: best-model fallback + server momentum — the
+    # reference reloads best_val_<criterion> EVERY val round
+    # (server.py:475,561-571, unconditional), a no-op on improvement
+    # (evaluation.run just overwrote best with current) and a rollback
+    # otherwise; ours folds that into fall-back-iff-worse.  On this
+    # protocol val improves monotonically (probed at lr 1/12 and with
+    # momentum 0.95: the sigmoid LR never overshoots), so what this
+    # family pins is the no-op-reload equivalence with live server
+    # momentum state riding along — the rollback-on-worsening sub-path
+    # remains covered by unit tests only.
+    "lr_fallback": {
+        "mutate": [lambda rc, tc: [
+            (c["server_config"].update({"fall_back_to_best_model": True,
+                                        "best_model_criterion": "loss",
+                                        "initial_lr_client": 1.0,
+                                        "optimizer_config": {
+                                            "type": "sgd", "lr": 1.0,
+                                            "momentum": 0.95}}),
+             c["client_config"]["optimizer_config"].update({"lr": 1.0}))
+            for c in (rc, tc)]],
+        "criteria": "exact"},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
