@@ -1009,6 +1009,16 @@ MODES = {
                 "criteria": "near"},
     # deterministic: DGA + per-layer 8-bit quantization at the 0.5 quantile
     "dga_quant": {"mutate": [_dga_strategy, _quant], "criteria": "near"},
+    # deterministic: the same transforms over CONV pseudo-gradients —
+    # 4-D kernel tensors exercise per-layer min/max binning and the
+    # |g|-quantile threshold on shapes the LR base never produces
+    # (dropout zeroed so the conv family stays deterministic)
+    "cnn_dga_quant": {"base": "cnn",
+                      "mutate": [_cnn_nodropout, _dga_strategy, _quant],
+                      "criteria": "near",
+                      "tpu_env": {"XLA_FLAGS":
+                                  "--xla_force_host_platform_device_count=2 "
+                                  "--xla_cpu_multi_thread_eigen=false"}},
     # deterministic: clip-only local DP (eps < 0) under DGA
     "dp_clip": {"mutate": [_dga_strategy,
                            lambda rc, tc: _dp(rc, tc, eps=-1.0,
