@@ -1,0 +1,15 @@
+#!/bin/bash
+# Commit whatever on-chip evidence exists RIGHT NOW.  Called after every
+# queue job (tools/tpu_jobs.d/*.sh): the chip window can close at any
+# moment, and artifacts that only land in history at end-of-queue are
+# artifacts that may never land at all.
+cd /root/repo
+git add -f BENCH_TPU_*.json bench_tpu_headline.json bench_tpu_headline.err \
+  bench_tpu_full.json bench_tpu_full.err \
+  tpu_flash_validation.log tpu_pallas_tests.log profile_cnn.json \
+  bench_scale.json bench_bert_varlen.json 2>/dev/null
+git diff --cached --quiet && exit 0
+git commit -m "Add raw on-chip measurement artifacts (TPU queue checkpoint)
+
+Committed immediately after a serialized tools/tpu_runner.sh queue job
+so a closing chip window cannot strand the evidence."

@@ -5,3 +5,4 @@
 # (default 25 min protects DRIVER runs) well above a full measurement.
 BENCH_DEADLINE_SECS=7200 BENCH_TPU_WAIT_SECS=60 \
   python bench.py > bench_tpu_full.json 2> bench_tpu_full.err
+bash tools/commit_tpu_artifacts.sh || true

@@ -1,2 +1,3 @@
 #!/bin/bash
 python tools/validate_flash_tpu.py > tpu_flash_validation.log 2>&1
+bash tools/commit_tpu_artifacts.sh || true

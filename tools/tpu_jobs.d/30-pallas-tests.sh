@@ -1,3 +1,4 @@
 #!/bin/bash
 python -m pytest tests/test_pallas_kernels.py tests/test_pallas_attention.py \
   -q -p no:cacheprovider --noconftest > tpu_pallas_tests.log 2>&1
+bash tools/commit_tpu_artifacts.sh || true

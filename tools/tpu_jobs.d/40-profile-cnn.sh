@@ -1,3 +1,4 @@
 #!/bin/bash
 python tools/profile_round.py --protocol cnn_femnist --chunks 3 \
   > profile_cnn.json 2> profile_cnn.err
+bash tools/commit_tpu_artifacts.sh || true

@@ -1,3 +1,4 @@
 #!/bin/bash
 BENCH_DEADLINE_SECS=7200 BENCH_TPU_WAIT_SECS=60 BENCH_SCALE_PROBE=1 BENCH_PROTOCOLS=cnn_femnist \
   python bench.py > bench_scale.json 2> bench_scale.err
+bash tools/commit_tpu_artifacts.sh || true

@@ -1,3 +1,4 @@
 #!/bin/bash
 BENCH_DEADLINE_SECS=3600 BENCH_TPU_WAIT_SECS=60 BENCH_PROTOCOLS=mlm_bert,varlen_bucketing \
   python bench.py > bench_bert_varlen.json 2> bench_bert_varlen.err
+bash tools/commit_tpu_artifacts.sh || true
