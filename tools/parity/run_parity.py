@@ -996,6 +996,18 @@ MODES = {
              c["client_config"]["optimizer_config"].update({"lr": 1.0}))
             for c in (rc, tc)]],
         "criteria": "exact"},
+    # deterministic: the LSTM family at a STABLE lr — the committed lstm
+    # entry needs lr=4.0 for the rule to become learnable, which is
+    # exactly where f32 chaos amplifies mid-trajectory (early-exact +
+    # endpoint criteria); at lr=0.5 the dynamics contract and the deep
+    # recurrence is held to pointwise agreement over the whole run
+    "lstm_stable_lr": {
+        "base": "lstm",
+        "mutate": [lambda rc, tc: [
+            (c["server_config"].update({"initial_lr_client": 0.5}),
+             c["client_config"]["optimizer_config"].update({"lr": 0.5}))
+            for c in (rc, tc)]],
+        "criteria": "near"},
     # deterministic: DGA softmax weighting only
     "dga": {"mutate": [_dga_strategy], "criteria": "exact"},
     # DGA softmax weighting on the GRU base: exercises the
