@@ -35,6 +35,7 @@ so probing can never outlive the caller's patience (the round-3 failure).
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import json
 import os
@@ -118,16 +119,24 @@ def _flush(note: str | None = None) -> None:
     # stale one would read as evidence of an aborted run
     if not note:
         try:
-            os.remove(os.path.join(REPO_ROOT, "BENCH_PARTIAL.json"))
+            os.remove(_partial_path())
         except OSError:
             pass
+
+
+def _partial_path() -> str:
+    # overridable so concurrent bench processes (e.g. the contract tests
+    # running while a real measurement holds the chip) cannot delete each
+    # other's crash evidence
+    return os.environ.get(
+        "BENCH_PARTIAL_PATH", os.path.join(REPO_ROOT, "BENCH_PARTIAL.json"))
 
 
 def _mirror_partial() -> None:
     """Best-effort on-disk mirror of the current line state (survives
     even SIGKILL; overwritten by every later update)."""
     try:
-        with open(os.path.join(REPO_ROOT, "BENCH_PARTIAL.json"), "w") as fh:
+        with open(_partial_path(), "w") as fh:
             json.dump(_LINE, fh, indent=1)
     except Exception:
         pass
@@ -165,6 +174,41 @@ def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
     os._exit(0)
 
 
+#: cap on how long ONE protocol may hold the process without finishing.
+#: The axon tunnel can wedge mid-run (a device call blocks in recvmsg
+#: forever, at zero host CPU); without this, a single hung protocol eats
+#: the entire BENCH_DEADLINE_SECS before the self-flush fires, starving
+#: every later job in the serialized TPU queue.  Healthy on-chip
+#: protocols finish in well under this (compile included).
+_STALL_SECS = float(os.environ.get("BENCH_PROTOCOL_STALL_SECS", 20 * 60))
+
+
+def _rearm(stall: float | None = None) -> None:
+    """Arm SIGALRM for the earlier of (final deadline - margin) and an
+    optional per-protocol stall budget."""
+    margin = min(20.0, _DEADLINE_SECS * 0.2)
+    due = max(_remaining() - margin, 1.0)
+    if stall is not None:
+        due = min(due, stall)
+    signal.alarm(int(max(due, 1.0)))
+
+
+@contextlib.contextmanager
+def _stall_scope(name: str):
+    """One bench section under the stall alarm: `_in_flight` names it in
+    any mid-section flush, the alarm drops back to the final deadline on
+    the way out, and progress is mirrored to disk whatever happened."""
+    extras = _LINE["extras"]
+    extras["_in_flight"] = name
+    _rearm(stall=_STALL_SECS)
+    try:
+        yield
+    finally:
+        extras.pop("_in_flight", None)
+        _rearm()
+        _mirror_partial()
+
+
 def install_deadline_guards() -> None:
     """SIGTERM/SIGALRM -> flush-and-exit; SIGALRM armed a safety margin
     before the deadline so we self-flush even if nobody signals us.  The
@@ -172,9 +216,7 @@ def install_deadline_guards() -> None:
     selection still fit inside tiny test budgets."""
     signal.signal(signal.SIGTERM, _on_kill_signal)
     signal.signal(signal.SIGALRM, _on_kill_signal)
-    margin = min(20.0, _DEADLINE_SECS * 0.2)
-    alarm_in = max(int(_remaining() - margin), 1)
-    signal.alarm(alarm_in)
+    _rearm()
 
 
 # ----------------------------------------------------------------------
@@ -771,34 +813,47 @@ def main() -> None:
             _mirror_partial()
             continue
         try:
-            extras[name] = bench_protocol(
-                name, spec["cfg"], spec["data"](), eval_users=8,
-                warmup_rounds=warmup, timed_chunks=chunks,
-                eval_every=spec["eval_every"],
-                want_mfu=on_tpu)  # MFU on every protocol (judging input)
+            with _stall_scope(name):
+                if os.environ.get("BENCH_TEST_HANG_PROTOCOL") == name:
+                    time.sleep(10 * 3600)  # test hook: a wedged device call
+                extras[name] = bench_protocol(
+                    name, spec["cfg"], spec["data"](), eval_users=8,
+                    warmup_rounds=warmup, timed_chunks=chunks,
+                    eval_every=spec["eval_every"],
+                    want_mfu=on_tpu)  # MFU on every protocol (judging input)
         except Exception as exc:  # one bad protocol must not kill the line
             extras[name] = {"error": f"{type(exc).__name__}: {exc}"}
-        _mirror_partial()  # SIGKILL-proof evidence of progress so far
+            _mirror_partial()
 
     # longctx respects the same BENCH_PROTOCOLS narrowing as the others
     if (on_tpu or os.environ.get("BENCH_LONGCTX")) and \
             (keep is None or "longctx_ringlm" in keep) and _remaining() > 60:
         try:
-            extras["longctx_ringlm"] = bench_longctx(on_tpu)
+            with _stall_scope("longctx_ringlm"):
+                extras["longctx_ringlm"] = bench_longctx(on_tpu)
         except Exception as exc:
             extras["longctx_ringlm"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
 
     if (on_tpu or os.environ.get("BENCH_VARLEN")) and \
             (keep is None or "varlen_bucketing" in keep) and _remaining() > 60:
         try:
-            extras["varlen_bucketing"] = bench_varlen_bucketing(on_tpu)
+            with _stall_scope("varlen_bucketing"):
+                extras["varlen_bucketing"] = bench_varlen_bucketing(on_tpu)
         except Exception as exc:
             extras["varlen_bucketing"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
 
-    if os.environ.get("BENCH_SCALE_PROBE"):
-        extras["scale_probe"] = scale_probe(backend)
+    if os.environ.get("BENCH_SCALE_PROBE") and _remaining() > 60:
+        try:
+            with _stall_scope("scale_probe"):
+                extras["scale_probe"] = scale_probe(backend)
+        except Exception as exc:  # optional extra must not kill the line
+            extras["scale_probe"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
 
     if on_tpu:
         # raw on-chip evidence is a committed artifact, not prose: every

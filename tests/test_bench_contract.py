@@ -29,6 +29,14 @@ BENCH = os.path.join(REPO, "bench.py")
 def _env(**over):
     env = dict(os.environ)
     env.update({"BENCH_BACKEND": "cpu"}, **over)
+    # keep the contract-test subprocesses' partial mirror away from the
+    # repo-root one (and from any operator-exported BENCH_PARTIAL_PATH):
+    # a real measurement may be mid-flight on the chip and its crash
+    # evidence must not be deleted by our successful flushes
+    if "BENCH_PARTIAL_PATH" not in over:
+        env["BENCH_PARTIAL_PATH"] = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"BENCH_PARTIAL_test_{os.getpid()}.json")
     return env
 
 
@@ -71,6 +79,28 @@ def test_sigterm_mid_run_flushes_partial_json():
     out = _json_line(stdout)
     assert "flush_note" in out["extras"], out["extras"]
     assert "signal 15" in out["extras"]["flush_note"]
+
+
+def test_stalled_protocol_flushes_well_before_deadline():
+    """A protocol that wedges (device call never returns) may hold the
+    process only BENCH_PROTOCOL_STALL_SECS, not the whole deadline: the
+    stall alarm flushes the line naming the in-flight protocol.  This is
+    the round-4 on-chip failure mode: the axon tunnel wedged mid-resnet
+    and the run sat in recvmsg at zero CPU for the full 2h budget."""
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(BENCH_DEADLINE_SECS="600",
+                 BENCH_PROTOCOL_STALL_SECS="5",
+                 BENCH_TEST_HANG_PROTOCOL="lr_mnist",
+                 BENCH_PROTOCOLS="lr_mnist"),
+        capture_output=True, text=True, timeout=180)
+    took = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = _json_line(proc.stdout)
+    assert "signal 14" in out["extras"].get("flush_note", ""), out["extras"]
+    assert out["extras"].get("_in_flight") == "lr_mnist", out["extras"]
+    assert took < 120, f"stall budget not honored ({took:.0f}s)"
 
 
 def test_wait_budget_subordinate_to_deadline():

@@ -4,10 +4,18 @@
 # moment, and artifacts that only land in history at end-of-queue are
 # artifacts that may never land at all.
 cd /root/repo
-git add -f BENCH_TPU_*.json bench_tpu_headline.json bench_tpu_headline.err \
+# One add per pathspec: a single missing file must not abort the whole
+# batch (git add fails the entire call on any unmatched pathspec, which
+# is exactly what stranded the first headline artifact).
+for f in BENCH_TPU_*.json bench_tpu_headline.json bench_tpu_headline.err \
   bench_tpu_full.json bench_tpu_full.err \
-  tpu_flash_validation.log tpu_pallas_tests.log profile_cnn.json \
-  bench_scale.json bench_bert_varlen.json 2>/dev/null
+  bench_longctx.json bench_longctx.err \
+  tpu_flash_validation.log tpu_pallas_tests.log \
+  profile_cnn.json profile_cnn.err \
+  bench_scale.json bench_scale.err \
+  bench_bert_varlen.json bench_bert_varlen.err; do
+  [ -e "$f" ] && git add -f "$f"
+done
 git diff --cached --quiet && exit 0
 git commit -m "Add raw on-chip measurement artifacts (TPU queue checkpoint)
 

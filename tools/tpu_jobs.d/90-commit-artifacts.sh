@@ -1,14 +1,5 @@
 #!/bin/bash
 # Last queue job: commit whatever on-chip evidence the queue produced, so
 # raw artifacts are in history even if the round ends while unattended.
-cd /root/repo
-git add -f BENCH_TPU_*.json bench_tpu_full.json bench_tpu_full.err \
-  tpu_flash_validation.log tpu_pallas_tests.log profile_cnn.json \
-  bench_scale.json bench_bert_varlen.json 2>/dev/null
-git diff --cached --quiet && exit 0
-git commit -m "Add raw on-chip measurement artifacts from the TPU queue
-
-Serialized runs from tools/tpu_runner.sh the moment the tunnel cleared:
-full bench (all protocols + bf16 + longctx + MFU), flash-attention
-on-chip validation, Pallas kernel tests incl. the DP-noise PRNG
-statistics, round profile, K-clients scale probe, bert+varlen bench."
+# Single source of truth for the artifact list + per-pathspec add:
+exec bash /root/repo/tools/commit_tpu_artifacts.sh
