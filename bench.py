@@ -42,6 +42,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -101,11 +102,28 @@ def _remaining() -> float:
     return _DEADLINE_SECS - (time.time() - _START)
 
 
-def _flush(note: str | None = None) -> None:
-    """Emit the JSON contract line exactly once, whatever state we're in."""
+#: popped exactly once (atomic under the GIL, safe from signal handlers
+#: and threads alike) — whoever gets the token owns the one stdout line
+_FLUSH_TOKEN = [None]
+
+#: wall-clock of the last section boundary; the watchdog thread measures
+#: stall time against this
+_PROGRESS_TS = time.time()
+
+
+def _note_progress() -> None:
+    global _PROGRESS_TS
+    _PROGRESS_TS = time.time()
+
+
+def _flush(note: str | None = None) -> bool:
+    """Emit the JSON contract line exactly once, whatever state we're in.
+    Returns True iff THIS call owned (and delivered) the line."""
     global _FLUSHED
-    if _FLUSHED:
-        return
+    try:
+        _FLUSH_TOKEN.pop()
+    except IndexError:
+        return False  # another thread/handler already owns the line
     _FLUSHED = True
     if note:
         _LINE["extras"]["flush_note"] = note
@@ -122,6 +140,7 @@ def _flush(note: str | None = None) -> None:
             os.remove(_partial_path())
         except OSError:
             pass
+    return True
 
 
 def _partial_path() -> str:
@@ -183,11 +202,16 @@ def _on_kill_signal(signum, frame):  # noqa: ARG001 - signal API
 _STALL_SECS = float(os.environ.get("BENCH_PROTOCOL_STALL_SECS", 20 * 60))
 
 
+def _margin() -> float:
+    """Safety margin between self-rescue and the caller's deadline;
+    shared by the SIGALRM arming and the watchdog backstop."""
+    return min(20.0, _DEADLINE_SECS * 0.2)
+
+
 def _rearm(stall: float | None = None) -> None:
     """Arm SIGALRM for the earlier of (final deadline - margin) and an
     optional per-protocol stall budget."""
-    margin = min(20.0, _DEADLINE_SECS * 0.2)
-    due = max(_remaining() - margin, 1.0)
+    due = max(_remaining() - _margin(), 1.0)
     if stall is not None:
         due = min(due, stall)
     signal.alarm(int(max(due, 1.0)))
@@ -200,23 +224,71 @@ def _stall_scope(name: str):
     the way out, and progress is mirrored to disk whatever happened."""
     extras = _LINE["extras"]
     extras["_in_flight"] = name
+    _note_progress()
     _rearm(stall=_STALL_SECS)
     try:
         yield
     finally:
         extras.pop("_in_flight", None)
+        _note_progress()
         _rearm()
         _mirror_partial()
+
+
+def _watchdog_loop() -> None:
+    """Daemon-thread deadline/stall backstop.
+
+    Signals are NOT sufficient: a wedged axon tunnel leaves the main
+    thread inside a native recvfrom retry loop that swallows EINTR, so
+    Python-level SIGTERM/SIGALRM handlers never run (observed live in
+    round 4 — the process ignored both for minutes at zero CPU).
+    ``os._exit`` from another thread is the only exit that still works;
+    the flush token keeps the contract line exactly-once either way."""
+    while not _FLUSHED:
+        time.sleep(2.0)
+        if _FLUSHED:
+            return
+        stall_for = time.time() - _PROGRESS_TS
+        # the stall budget is PER SECTION: setup phases (jax import,
+        # backend selection, dataset synthesis) are governed by the
+        # final deadline only, so small stall budgets cannot kill a
+        # healthy run before its first protocol
+        stalled = ("_in_flight" in _LINE["extras"]
+                   and stall_for > _STALL_SECS)
+        if not stalled and _remaining() > _margin() * 0.5:
+            continue
+        why = (f"no section progress for {stall_for:.0f}s"
+               if stalled else "deadline reached")
+        if not _flush(f"watchdog exit: {why}; partial results"):
+            return  # main delivered the line; let it finish normally
+        try:
+            sys.stdout.flush()
+        except Exception:
+            pass
+        _mirror_partial()
+        # never abandon a live chip-claiming probe child (wedges the
+        # single-client tunnel) — same discipline as _on_kill_signal
+        probe = _LIVE_PROBE
+        if probe is not None and probe.poll() is None:
+            try:
+                probe.terminate()
+                probe.wait(timeout=10)
+            except Exception:
+                pass
+        os._exit(0)
 
 
 def install_deadline_guards() -> None:
     """SIGTERM/SIGALRM -> flush-and-exit; SIGALRM armed a safety margin
     before the deadline so we self-flush even if nobody signals us.  The
     margin scales down with small deadlines so jax import + backend
-    selection still fit inside tiny test budgets."""
+    selection still fit inside tiny test budgets.  A watchdog thread
+    backstops both signals (see ``_watchdog_loop``)."""
     signal.signal(signal.SIGTERM, _on_kill_signal)
     signal.signal(signal.SIGALRM, _on_kill_signal)
     _rearm()
+    threading.Thread(target=_watchdog_loop, name="bench-watchdog",
+                     daemon=True).start()
 
 
 # ----------------------------------------------------------------------
@@ -294,6 +366,7 @@ def select_backend(probe_timeout: float = 180.0):
         attempt = 0
         while True:
             attempt += 1
+            _note_progress()  # a live probe-wait loop is not a stall
             ok, reason = _probe_once(probe_timeout)
             if ok:
                 backend = "tpu"
@@ -815,6 +888,13 @@ def main() -> None:
         try:
             with _stall_scope(name):
                 if os.environ.get("BENCH_TEST_HANG_PROTOCOL") == name:
+                    if os.environ.get("BENCH_TEST_HANG_BLOCK_SIGNALS"):
+                        # simulate the REAL wedge: native code that never
+                        # returns to the interpreter, so signal handlers
+                        # cannot run and only the watchdog thread helps
+                        signal.pthread_sigmask(
+                            signal.SIG_BLOCK,
+                            {signal.SIGTERM, signal.SIGALRM})
                     time.sleep(10 * 3600)  # test hook: a wedged device call
                 extras[name] = bench_protocol(
                     name, spec["cfg"], spec["data"](), eval_users=8,
