@@ -7,8 +7,7 @@ cd /root/repo
 # One add per pathspec: a single missing file must not abort the whole
 # batch (git add fails the entire call on any unmatched pathspec, which
 # is exactly what stranded the first headline artifact).
-for f in BENCH_TPU_*.json bench_tpu_headline.json bench_tpu_headline.err \
-  bench_tpu_full.json bench_tpu_full.err \
+for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   bench_longctx.json bench_longctx.err \
   tpu_flash_validation.log tpu_pallas_tests.log \
   profile_cnn.json profile_cnn.err \

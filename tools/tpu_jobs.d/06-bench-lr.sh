@@ -1,0 +1,8 @@
+#!/bin/bash
+# Per-protocol artifact jobs (06-09): each lands its own committed
+# backend:"tpu" capture, so a mid-queue tunnel wedge costs at most one
+# protocol (plus the 20-min stall budget), never the whole bench.
+BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 \
+  BENCH_PROTOCOLS=lr_mnist \
+  python bench.py > bench_tpu_lr.json 2> bench_tpu_lr.err
+bash tools/commit_tpu_artifacts.sh || true

@@ -1,0 +1,5 @@
+#!/bin/bash
+BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 \
+  BENCH_PROTOCOLS=rnn_fedshakespeare \
+  python bench.py > bench_tpu_rnn.json 2> bench_tpu_rnn.err
+bash tools/commit_tpu_artifacts.sh || true

@@ -1,0 +1,7 @@
+#!/bin/bash
+# resnet wedged the tunnel mid-compile on the first attempt this round;
+# run it AFTER lr+rnn so a recurrence cannot cost their artifacts.
+BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 \
+  BENCH_PROTOCOLS=resnet_fedcifar100 \
+  python bench.py > bench_tpu_resnet.json 2> bench_tpu_resnet.err
+bash tools/commit_tpu_artifacts.sh || true

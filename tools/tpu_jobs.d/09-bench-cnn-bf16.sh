@@ -1,0 +1,5 @@
+#!/bin/bash
+BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 \
+  BENCH_PROTOCOLS=cnn_femnist,cnn_femnist_bf16 \
+  python bench.py > bench_tpu_cnn_bf16.json 2> bench_tpu_cnn_bf16.err
+bash tools/commit_tpu_artifacts.sh || true
