@@ -1,0 +1,5 @@
+#!/bin/bash
+BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 \
+  BENCH_PROTOCOLS=longctx_ringlm BENCH_LONGCTX=1 \
+  python bench.py > bench_tpu_longctx.json 2> bench_tpu_longctx.err
+bash tools/commit_tpu_artifacts.sh || true
