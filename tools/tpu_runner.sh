@@ -6,7 +6,12 @@
 # (marker: <job>.done holding the exit code).  Append jobs while running.
 cd /root/repo
 log(){ echo "[tpu_runner $(date +%H:%M:%S)] $*" >> tpu_runner.log; }
-probe(){ python - <<'PYEOF' >/dev/null 2>&1
+# Probe with a timeout: while a stale claim is pending server-side a
+# probe HANGS instead of failing fast (observed live round 4), and a
+# timeout-less probe then blocks the whole runner loop.  SIGTERM only —
+# the graceful path; a probe that never acquired the claim is safe to
+# stop.
+probe(){ timeout -s TERM -k 30 120 python - <<'PYEOF' >/dev/null 2>&1
 import jax, jax.numpy as jnp
 assert jax.default_backend() == "tpu"
 jax.block_until_ready(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
