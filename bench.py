@@ -637,6 +637,10 @@ def build_protocols(on_tpu: bool, rng, with_bf16: bool = False) -> dict:
             data=img(64 if on_tpu else 16, 240 if on_tpu else 40,
                      (28, 28, 1), 62),
             eval_every=50)
+    # the wedge-suspect measures dead last (resnet wedged the tunnel
+    # mid-measurement this round): a wedge there costs no other
+    # protocol's number in THIS process
+    protocols["resnet_fedcifar100"] = protocols.pop("resnet_fedcifar100")
     return protocols
 
 

@@ -129,6 +129,19 @@ def test_wedged_native_call_rescued_by_watchdog_thread():
     assert took < 120, f"watchdog did not rescue the wedge ({took:.0f}s)"
 
 
+def test_tpu_measurement_order_headline_first_wedge_suspect_last():
+    """dict order = measurement order: the driver-scored headline runs
+    first so ANY early flush carries it; resnet (the protocol observed
+    wedging the tunnel) runs last so a wedge costs nothing else."""
+    sys.path.insert(0, REPO)
+    import bench
+    import numpy as np
+    names = list(bench.build_protocols(True, np.random.default_rng(0),
+                                       with_bf16=False))
+    assert names[0] == "cnn_femnist", names
+    assert names[-1] == "resnet_fedcifar100", names
+
+
 def test_wait_budget_subordinate_to_deadline():
     """With no chip and a small deadline, the probe wait gives up well
     before the deadline and the CPU fallback still emits the line."""

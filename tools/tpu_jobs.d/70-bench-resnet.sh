@@ -1,7 +1,12 @@
 #!/bin/bash
 # resnet wedged the tunnel mid-compile on the first attempt this round;
 # run it AFTER lr+rnn so a recurrence cannot cost their artifacts.
-BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 \
+# generous stall budget: a cold server-side resnet compile may be slow.
+# Runs late so every per-protocol/validation artifact lands first; a
+# wedge here can still strand the tunnel for the later all-in-one bench
+# (80-), which is why that one is last and re-measures everything.
+BENCH_DEADLINE_SECS=3600 BENCH_TPU_WAIT_SECS=60 \
+  BENCH_PROTOCOL_STALL_SECS=2400 \
   BENCH_PROTOCOLS=resnet_fedcifar100 \
   python bench.py > bench_tpu_resnet.json 2> bench_tpu_resnet.err
 bash tools/commit_tpu_artifacts.sh || true
