@@ -22,8 +22,8 @@ log "runner started (pid $$)"
 # the chip; the runner must not be mid-job holding the claim then.  Stop
 # starting new jobs after this UTC hour (driver window); touch
 # tools/tpu_jobs.d/.no_deadline to disable.
-DEADLINE_H=${TPU_RUNNER_DEADLINE_H:-7}
-WINDOW_END_H=${TPU_RUNNER_WINDOW_END_H:-12}
+DEADLINE_H=${TPU_RUNNER_DEADLINE_H:-17}
+WINDOW_END_H=${TPU_RUNNER_WINDOW_END_H:-24}
 if [ "$DEADLINE_H" -ge "$WINDOW_END_H" ]; then
   log "DEADLINE_H=$DEADLINE_H >= WINDOW_END_H=$WINDOW_END_H: guard disabled"
 fi
