@@ -1,3 +1,5 @@
 #!/bin/bash
 python tools/validate_flash_tpu.py > tpu_flash_validation.log 2>&1
+rc=$?
 bash tools/commit_tpu_artifacts.sh || true
+exit $rc

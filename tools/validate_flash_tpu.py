@@ -6,7 +6,10 @@ all three gradients, causal + full, odd lengths (padding), and the lse
 cotangent with global-position offsets.  Prints FLASH_TPU_OK on success.
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
