@@ -2,9 +2,10 @@
 # resnet wedged the tunnel mid-compile on the first attempt this round;
 # run it AFTER lr+rnn so a recurrence cannot cost their artifacts.
 # generous stall budget: a cold server-side resnet compile may be slow.
-# Runs late so every per-protocol/validation artifact lands first; a
-# wedge here can still strand the tunnel for the later all-in-one bench
-# (80-), which is why that one is last and re-measures everything.
+# Runs dead LAST (after the all-in-one 80- bench): the all-in-one
+# measures resnet last internally and flushes every other protocol
+# first, so a persistent wedge in this standalone retry strands only
+# the retry — never the all-in-one artifact.
 BENCH_DEADLINE_SECS=3600 BENCH_TPU_WAIT_SECS=60 \
   BENCH_PROTOCOL_STALL_SECS=2400 \
   BENCH_PROTOCOLS=resnet_fedcifar100 \
