@@ -570,7 +570,10 @@ def build_protocols(on_tpu: bool, rng, with_bf16: bool = False) -> dict:
     compute-bound on host cores; shrink so harnesses still complete — the
     recorded number only means "vs baseline" on real TPU.  Shared with
     ``tools/profile_round.py``."""
-    fuse = 25 if on_tpu else 2
+    # BENCH_FUSE: rounds fused per device dispatch (must keep eval_every a
+    # multiple so the eval cadence stays on chunk boundaries). 25 divides
+    # every protocol's eval_every; 50 = one dispatch per eval period.
+    fuse = int(os.environ.get("BENCH_FUSE", 25 if on_tpu else 2))
 
     def img(pool, spu, shape, classes):
         return lambda: _image_dataset(pool, spu, shape, classes, rng)
@@ -848,7 +851,10 @@ def main() -> None:
         from msrflute_tpu.utils.backend import enable_compilation_cache
         enable_compilation_cache(os.path.join(REPO_ROOT, ".jax_cache"))
     rng = np.random.default_rng(0)
-    warmup = 25 if on_tpu else 2
+    # warmup must span at least one fused chunk, else the timed chunks
+    # would compile a program shape warmup never ran
+    warmup = (max(25, int(os.environ.get("BENCH_FUSE", 25)))
+              if on_tpu else 2)
     chunks = 4 if on_tpu else 2
     protocols = build_protocols(on_tpu, rng,
                                 with_bf16=on_tpu or

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Fusion-depth experiment: headline CNN protocol at rounds_per_step=50
+# (one device dispatch per eval period) vs the default 25.  If dispatch
+# latency over the tunnel is a visible share of s/round, this halves it.
+BENCH_DEADLINE_SECS=2400 BENCH_TPU_WAIT_SECS=60 BENCH_FUSE=50 \
+  BENCH_PROTOCOLS=cnn_femnist \
+  python bench.py > bench_tpu_cnn_fuse50.json 2> bench_tpu_cnn_fuse50.err
+bash tools/commit_tpu_artifacts.sh || true
