@@ -1,4 +1,13 @@
 #!/bin/bash
-python -m pytest tests/test_pallas_kernels.py tests/test_pallas_attention.py \
+# TPU-gated kernel tests (flash attention mosaic lowering + on-core PRNG
+# plumbing).  First-ever on-chip compiles are minutes each, so: a hard
+# 50-min ceiling (SIGTERM; a wedged claim clears server-side once the
+# process dies), and the persistent XLA compilation cache so a retry
+# after a timeout starts hot instead of recompiling from zero.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 3000 \
+  python -m pytest tests/test_pallas_kernels.py tests/test_pallas_attention.py \
   -q -p no:cacheprovider --noconftest > tpu_pallas_tests.log 2>&1
+rc=$?
 bash tools/commit_tpu_artifacts.sh || true
+exit $rc
