@@ -12,7 +12,8 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   tpu_flash_validation.log tpu_pallas_tests.log \
   profile_cnn.json profile_cnn.err \
   bench_scale.json bench_scale.err \
-  bench_bert_varlen.json bench_bert_varlen.err; do
+  bench_bert_varlen.json bench_bert_varlen.err \
+  digits_tpu.json digits_tpu.err; do
   [ -e "$f" ] && git add -f "$f"
 done
 git diff --cached --quiet && exit 0

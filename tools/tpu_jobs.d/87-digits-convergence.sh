@@ -1,0 +1,9 @@
+#!/bin/bash
+# Real-data on-chip convergence (sklearn digits through the full engine).
+# Runs late: the resnet family compile is the historical wedge suspect.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 2400 \
+  python tools/digits_tpu_convergence.py > digits_tpu.json 2> digits_tpu.err
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc
