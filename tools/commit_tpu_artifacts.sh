@@ -13,7 +13,8 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   profile_cnn.json profile_cnn.err \
   bench_scale.json bench_scale.err \
   bench_bert_varlen.json bench_bert_varlen.err \
-  digits_tpu.json digits_tpu.err; do
+  digits_tpu.json digits_tpu.err \
+  tpu_pallas_attention.log tpu_quant_kernel_probe.log; do
   [ -e "$f" ] && git add -f "$f"
 done
 git diff --cached --quiet && exit 0

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Isolation A: the attention-kernel tests alone.  Job 20's standalone
+# flash validation passes on-chip, so these should too — a pass pins the
+# 16-failure cascade on the kernels file that test-orders FIRST.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 3000 \
+  python -m pytest tests/test_pallas_attention.py \
+  -q -p no:cacheprovider --noconftest > tpu_pallas_attention.log 2>&1
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc

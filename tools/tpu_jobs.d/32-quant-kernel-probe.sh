@@ -1,0 +1,21 @@
+#!/bin/bash
+# Isolation B: compile ONLY the quant kernel (the first test in the
+# twice-failed pallas job), tightly bounded.  rc=124 = its compile hangs
+# the backend; an error in the log = a real Mosaic lowering bug to fix.
+timeout -s TERM -k 60 600 python - > tpu_quant_kernel_probe.log 2>&1 <<'PYEOF'
+import sys, os
+sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from msrflute_tpu.ops.pallas_kernels import quant_bin_sparsify
+g = jnp.asarray(np.random.default_rng(0).normal(size=(5000,)), jnp.float32)
+out = quant_bin_sparsify(g, jnp.min(g), jnp.max(g),
+                         jnp.quantile(jnp.abs(g), 0.5), n_bins=16,
+                         interpret=False)
+jax.block_until_ready(out)
+print("QUANT_KERNEL_TPU_OK", np.asarray(out)[:4])
+PYEOF
+rc=$?
+echo "probe rc=$rc" >> tpu_quant_kernel_probe.log
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc
