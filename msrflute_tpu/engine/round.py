@@ -102,6 +102,20 @@ class RoundEngine:
         self.dump_norm_stats = bool(config.get("dump_norm_stats",
                                                sc.get("dump_norm_stats",
                                                       False)))
+        # scan-over-client-chunks: bound HBM at large K.  vmap over all K
+        # clients materializes K x (activations + payload tree) at once —
+        # measured OOM at K=1024 on a 16G v5e (bench_scale.json); chunking
+        # scans vmap(chunk) accumulating the weighted sums, so memory is
+        # O(chunk) while the psum'd result is identical up to f32
+        # reassociation (tests/test_client_chunking.py).
+        cpc = sc.get("clients_per_chunk")
+        self.clients_per_chunk = int(cpc) if cpc else None
+        if self.clients_per_chunk and self.dump_norm_stats:
+            raise ValueError(
+                "clients_per_chunk is incompatible with dump_norm_stats: "
+                "per-client cosines need every payload against the final "
+                "aggregate, which chunked accumulation never materializes — "
+                "disable one of them")
 
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
@@ -163,10 +177,12 @@ class RoundEngine:
         rspec = P()
         pool_mode = self._pool is not None
 
+        clients_per_chunk = self.clients_per_chunk
+
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng, pool=None):
-            if pool is not None:
+            def gather_pool(arrays, sample_mask):
                 # device-resident mode: 'arrays' carries pool indices;
                 # gather the feature rows in-program (one XLA gather per
                 # key, HBM-local — no host bytes moved).  Padding slots
@@ -176,11 +192,12 @@ class RoundEngine:
                 # task loss masking perfectly — tests/test_device_pool.py)
                 idx = arrays["__idx__"]
                 m = sample_mask
-                arrays = {
+                return {
                     k: pool[k][idx]
                     * m.reshape(m.shape + (1,) * (pool[k].ndim - 1)
                                 ).astype(pool[k].dtype)
                     for k in pool}
+
             def per_client(arr_c, mask_c, cm_c, cid_c):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
@@ -200,44 +217,83 @@ class RoundEngine:
                     stale = jnp.zeros(())
                 return parts, tl * cm_c, ns * cm_c, stats, stale
 
-            parts, tls, nss, stats, stale = jax.vmap(per_client)(
-                arrays, sample_mask, client_mask, client_ids)
-            # per-client privacy-attack metrics stay per-client (the server
-            # needs the distribution for the adaptive leakage threshold,
-            # core/server.py:397-409)
-            privacy_per_client = {k: v for k, v in stats.items()
-                                  if k.startswith("privacy_")}
-            stats = {k: v for k, v in stats.items()
-                     if not k.startswith("privacy_")}
+            def process_chunk(arr_k, sm_k, cm_k, cid_k):
+                """One chunk of clients -> (summed locals, per-client
+                privacy stats, raw parts).  The whole shard is one chunk in
+                the default path."""
+                if pool is not None:
+                    arr_k = gather_pool(arr_k, sm_k)
+                parts, tls, nss, stats, stale = jax.vmap(per_client)(
+                    arr_k, sm_k, cm_k, cid_k)
+                # per-client privacy-attack metrics stay per-client (the
+                # server needs the distribution for the adaptive leakage
+                # threshold, core/server.py:397-409)
+                privacy_per_client = {k: v for k, v in stats.items()
+                                      if k.startswith("privacy_")}
+                stats = {k: v for k, v in stats.items()
+                         if not k.startswith("privacy_")}
 
-            local = {"parts": {}}
-            for name, (trees, ws) in parts.items():
-                w_now = ws * (1.0 - stale)
-                w_def = ws * stale
-                wsum = lambda w, t: jax.tree.map(
-                    lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), t)
-                local["parts"][name] = {
-                    "grad_sum": wsum(w_now, trees),
-                    "weight_sum": jnp.sum(w_now),
-                    "grad_sum_def": wsum(w_def, trees),
-                    "weight_sum_def": jnp.sum(w_def),
-                    "weight_sum_raw": jnp.sum(ws),
-                }
-            local.update({
-                "train_loss_sum": jnp.sum(tls),
-                "num_samples_sum": jnp.sum(nss),
-                "client_count": jnp.sum(client_mask),
-                "stats_mean_sum": jnp.sum(stats["mean"] * client_mask),
-                "stats_mag_sum": jnp.sum(stats["mag"] * client_mask),
-                "stats_var_sum": jnp.sum(stats["var_corrected"] * client_mask),
-                "stats_norm_sum": jnp.sum(stats["norm"] * client_mask),
-            })
+                local = {"parts": {}}
+                for name, (trees, ws) in parts.items():
+                    w_now = ws * (1.0 - stale)
+                    w_def = ws * stale
+                    wsum = lambda w, t: jax.tree.map(
+                        lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), t)
+                    local["parts"][name] = {
+                        "grad_sum": wsum(w_now, trees),
+                        "weight_sum": jnp.sum(w_now),
+                        "grad_sum_def": wsum(w_def, trees),
+                        "weight_sum_def": jnp.sum(w_def),
+                        "weight_sum_raw": jnp.sum(ws),
+                    }
+                local.update({
+                    "train_loss_sum": jnp.sum(tls),
+                    "num_samples_sum": jnp.sum(nss),
+                    "client_count": jnp.sum(cm_k),
+                    "stats_mean_sum": jnp.sum(stats["mean"] * cm_k),
+                    "stats_mag_sum": jnp.sum(stats["mag"] * cm_k),
+                    "stats_var_sum": jnp.sum(stats["var_corrected"] * cm_k),
+                    "stats_norm_sum": jnp.sum(stats["norm"] * cm_k),
+                })
+                return local, privacy_per_client, parts
+
+            k_local = sample_mask.shape[0]
+            if clients_per_chunk and clients_per_chunk < k_local:
+                if k_local % clients_per_chunk != 0:
+                    raise ValueError(
+                        f"clients_per_chunk={clients_per_chunk} must divide "
+                        f"the per-shard client grid ({k_local}); pad "
+                        "num_clients_per_iteration or pick a divisor")
+
+                def to_chunks(x):
+                    return x.reshape((k_local // clients_per_chunk,
+                                      clients_per_chunk) + x.shape[1:])
+
+                xs = jax.tree.map(to_chunks, (arrays, sample_mask,
+                                              client_mask, client_ids))
+
+                def scan_body(acc, xs_c):
+                    local_c, priv_c, _ = process_chunk(*xs_c)
+                    return jax.tree.map(jnp.add, acc, local_c), priv_c
+
+                zero_local = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype),
+                    jax.eval_shape(lambda c: process_chunk(*c)[0],
+                                   jax.tree.map(lambda x: x[0], xs)))
+                local, priv_chunks = jax.lax.scan(scan_body, zero_local, xs)
+                # [C, chunk] per-client stats back to the flat [K] layout
+                privacy_per_client = jax.tree.map(
+                    lambda y: y.reshape((-1,) + y.shape[2:]), priv_chunks)
+                parts = None  # never materialized across all K — the point
+            else:
+                local, privacy_per_client, parts = process_chunk(
+                    arrays, sample_mask, client_mask, client_ids)
             if self.partition_mode == "shard_map":
                 # the "harvest": one collective instead of K P2P recvs
                 total = jax.lax.psum(local, CLIENTS_AXIS)
             else:
                 total = local
-            if self.dump_norm_stats and "default" in parts:
+            if self.dump_norm_stats and parts and "default" in parts:
                 # per-client PAYLOAD norm + cosine vs the aggregate
                 # direction (reference norm_stats.txt/cosines.txt dumps over
                 # client_parameters_stack — i.e. post-transform payloads —

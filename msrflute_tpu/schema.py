@@ -129,7 +129,7 @@ SERVER_KEYS = {
     "optimizer_config", "annealing_config", "server_replay_config", "RL",
     "nbest_task_scheduler", "best_model_metric",
     # TPU-native extensions
-    "rounds_per_step", "checkpoint_backend", "compilation_cache_dir",
+    "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
@@ -184,6 +184,7 @@ SERVER_FIELD_SPECS = {
     "scaffold_device_controls": ("bool", None, None),
     "dump_norm_stats": ("bool", None, None),
     "rounds_per_step": ("int", 1, None),
+    "clients_per_chunk": ("int", 1, None),
     "model_backup_freq": ("int", 1, None),
     "scaffold_flush_freq": ("int", 1, None),
     "qffl_q": ("num", 0, None),
