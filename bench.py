@@ -782,8 +782,10 @@ def scale_probe(backend: str) -> dict:
             cfg.server_config.num_clients_per_iteration = k
             if k >= 1024:
                 # vmap over 1024 whole clients OOMs the 16G chip (measured:
-                # 20.26G needed); scan-over-chunks bounds activation memory
-                cfg.server_config.clients_per_chunk = 256
+                # 20.26G needed); scan-over-chunks bounds activation memory.
+                # NB item assignment: attribute-set on a non-field lands
+                # outside the MutableMapping view and .get() never sees it
+                cfg.server_config["clients_per_chunk"] = 256
             try:
                 data = _image_dataset(max(k, 8), 240, (28, 28, 1), 62,
                                       np.random.default_rng(0))

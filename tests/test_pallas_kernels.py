@@ -5,7 +5,19 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# interpret-mode reference tests crash the axon remote-compile helper on
+# a real TPU backend and poison it for every later device op (observed
+# twice — docs/RUNBOOK.md tunnel failure mode 3); their on-chip
+# counterparts are test_fused_gaussian_noise_stats_tpu below (real
+# kernel) and the standalone quant probe (tpu_quant_kernel_probe.log,
+# QUANT_KERNEL_TPU_OK).
+_interpret_cpu_only = pytest.mark.skipif(
+    jax.default_backend() == "tpu",
+    reason="interpret-mode reference test; real-kernel on-chip coverage "
+           "is the _tpu test + the queue probes")
 
+
+@_interpret_cpu_only
 def test_quant_bin_sparsify_matches_reference():
     from msrflute_tpu.ops.pallas_kernels import quant_bin_sparsify
     from msrflute_tpu.ops.quantization import quantize_array
@@ -78,6 +90,7 @@ def test_fused_gaussian_noise_stats_tpu():
     assert not np.array_equal(np.asarray(out3), arr)
 
 
+@_interpret_cpu_only
 def test_fused_gaussian_noise_shape_roundtrip():
     """Interpret mode can still validate shapes/padding (PRNG is stubbed)."""
     from msrflute_tpu.ops.pallas_kernels import fused_gaussian_noise
@@ -87,6 +100,7 @@ def test_fused_gaussian_noise_shape_roundtrip():
     assert out.shape == x.shape
 
 
+@_interpret_cpu_only
 def test_noise_zero_sigma_is_pure_scale():
     from msrflute_tpu.ops.pallas_kernels import fused_gaussian_noise
     x = jnp.arange(1000, dtype=jnp.float32)
