@@ -563,6 +563,17 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
     return out
 
 
+def _bench_fuse(on_tpu: bool) -> int:
+    """BENCH_FUSE: rounds fused per device dispatch.  Eval cost is timed
+    separately and amortized per eval_every, so fuse need not divide the
+    eval cadence.  50 measured faster than 25 on-chip (9.55x vs 8.42x
+    baseline on the headline CNN, `bench_tpu_cnn_fuse50.json` — tunnel
+    dispatch latency is a visible share); fused==unfused bit-equality is
+    pinned by tests/test_multi_round.py.  Single source of truth for the
+    default: main()'s warmup must span one fused chunk."""
+    return int(os.environ.get("BENCH_FUSE", 50 if on_tpu else 2))
+
+
 def build_protocols(on_tpu: bool, rng, with_bf16: bool = False) -> dict:
     """The protocol table (BASELINE.md `README.md:22-27`): model cfg,
     batch, lr, samples/user (real-dataset average), data maker, eval
@@ -570,10 +581,7 @@ def build_protocols(on_tpu: bool, rng, with_bf16: bool = False) -> dict:
     compute-bound on host cores; shrink so harnesses still complete — the
     recorded number only means "vs baseline" on real TPU.  Shared with
     ``tools/profile_round.py``."""
-    # BENCH_FUSE: rounds fused per device dispatch (must keep eval_every a
-    # multiple so the eval cadence stays on chunk boundaries). 25 divides
-    # every protocol's eval_every; 50 = one dispatch per eval period.
-    fuse = int(os.environ.get("BENCH_FUSE", 25 if on_tpu else 2))
+    fuse = _bench_fuse(on_tpu)
 
     def img(pool, spu, shape, classes):
         return lambda: _image_dataset(pool, spu, shape, classes, rng)
@@ -859,8 +867,7 @@ def main() -> None:
     rng = np.random.default_rng(0)
     # warmup must span at least one fused chunk, else the timed chunks
     # would compile a program shape warmup never ran
-    warmup = (max(25, int(os.environ.get("BENCH_FUSE", 25)))
-              if on_tpu else 2)
+    warmup = max(25, _bench_fuse(on_tpu)) if on_tpu else 2
     chunks = 4 if on_tpu else 2
     protocols = build_protocols(on_tpu, rng,
                                 with_bf16=on_tpu or

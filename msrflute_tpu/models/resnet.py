@@ -110,6 +110,9 @@ def make_resnet_task(model_config) -> ClassificationTask:
         stage_sizes=depth, num_classes=num_classes,
         channels_per_group=int(model_config.get("channels_per_group", 32)),
         dtype=parse_dtype(model_config))
-    return ClassificationTask(module, example_shape=(side, side, 3),
+    # in_channels: the reference model is RGB-only; grayscale corpora
+    # (e.g. the bundled digits convergence probe) need 1 here
+    chans = int(model_config.get("in_channels", 3))
+    return ClassificationTask(module, example_shape=(side, side, chans),
                               name="cv_resnet_fedcifar100",
                               num_classes=num_classes)

@@ -70,9 +70,14 @@ def bits_to_normal(b1: jnp.ndarray, b2: jnp.ndarray) -> jnp.ndarray:
     kernel feeds the on-core pltpu PRNG — the transform is identical.
     Top 24 bits -> uniform with 2^-24 resolution (f32-exact); the +1e-12
     floor guards ``log(0)`` and caps |z| at ~7.43.
+
+    The float conversion routes through int32: after ``>> 8`` the value
+    fits in 24 bits so the reinterpretation is exact, and mosaic lowers
+    uint32->int32->f32 while rejecting the direct uint32->f32 cast
+    (observed on silicon, ``tpu_pallas_tests.log`` round 4).
     """
-    u1 = (b1 >> 8).astype(jnp.float32) * (1.0 / (1 << 24)) + 1e-12
-    u2 = (b2 >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    u1 = (b1 >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24)) + 1e-12
+    u2 = (b2 >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
     return jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(2.0 * np.pi * u2)
 
 

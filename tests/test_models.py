@@ -78,6 +78,17 @@ def test_resnet_gn_task():
     assert 10_000_000 < n_params < 12_500_000  # ResNet-18 ~11.2M
 
 
+def test_resnet_grayscale_in_channels():
+    # in_channels=1: the grayscale path the on-chip digits convergence
+    # probe drives (tools/digits_tpu_convergence.py) — keep it runnable
+    # in the host suite so a break surfaces before the TPU queue
+    task = make_task(ModelConfig(model_type="RESNET",
+                                 extra={"num_classes": 10, "image_size": 8,
+                                        "in_channels": 1,
+                                        "channels_per_group": 16}))
+    _check_task(task, _img_batch(2, 8, 8, 1, 10))
+
+
 def test_shakespeare_lstm_task():
     task = make_task(ModelConfig(model_type="RNN",
                                  extra={"vocab_size": 90, "seq_len": 20}))

@@ -102,6 +102,7 @@ def main() -> int:
     res["resnet"] = run("resnet",
                         {"model_type": "RESNET", "depth": 18,
                          "num_classes": 10, "image_size": 8,
+                         "in_channels": 1,
                          "channels_per_group": 16}, 30, 0.1,
                         img_train, img_val, 0.55)
     print(json.dumps(res))

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Uncontended re-run of the all-in-one bench at the new fuse=50 default
+# (job 80 ran at fuse=25 and shared the host with a pytest suite): one
+# raw artifact carrying every protocol's best-practice number.
+BENCH_DEADLINE_SECS=7200 BENCH_TPU_WAIT_SECS=60 \
+  python bench.py > bench_tpu_full_fuse50.json 2> bench_tpu_full_fuse50.err
+bash tools/commit_tpu_artifacts.sh || true
