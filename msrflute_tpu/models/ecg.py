@@ -27,6 +27,12 @@ def _swish(x):
     return x * nn.sigmoid(x)
 
 
+def _gn():
+    # epsilon matches the reference's torch GroupNorm default (1e-5) so
+    # weight-transplant forward comparisons are exact
+    return nn.GroupNorm(num_groups=8, epsilon=1e-5)
+
+
 class _ConvNormPool(nn.Module):
     hidden: int
     kernel: int = 5
@@ -35,15 +41,15 @@ class _ConvNormPool(nn.Module):
     def __call__(self, x, train: bool = False):  # x: [B, L, C]
         pad = self.kernel - 1
         conv1 = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(x)
-        y = nn.GroupNorm(num_groups=8)(conv1)
+        y = _gn()(conv1)
         y = _swish(y)
         y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
         y = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(y)
-        y = nn.GroupNorm(num_groups=8)(y)
+        y = _gn()(y)
         y = _swish(y)
         y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
         conv3 = nn.Conv(self.hidden, (self.kernel,), padding="VALID")(y)
-        y = nn.GroupNorm(num_groups=8)(conv1[:, :conv3.shape[1]] + conv3)
+        y = _gn()(conv1[:, :conv3.shape[1]] + conv3)
         y = _swish(y)
         y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
         # maxpool k=2 stride 2

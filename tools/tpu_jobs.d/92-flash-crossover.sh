@@ -1,0 +1,9 @@
+#!/bin/bash
+# Flash-vs-dense crossover sweep: lengths 1k..16k x kernel tile choices.
+# Basis for the ringlm dense/flash auto-select and kernel tile defaults.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 3000 \
+  python tools/flash_crossover_sweep.py > flash_crossover.json 2> flash_crossover.err
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc
