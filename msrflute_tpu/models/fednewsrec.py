@@ -33,12 +33,19 @@ from .base import BaseTask, Batch
 
 
 class _AttentivePooling(nn.Module):
-    """tanh-MLP attention pooling (reference ``AttentivePooling``)."""
+    """tanh-MLP attention pooling (reference ``AttentivePooling``).
+
+    ``dropout > 0`` reproduces the reference's input dropout — and its
+    quirk that the weighted sum runs over the DROPPED vectors
+    (``fednewsrec_model.py:25-31``), not the raw input."""
 
     hidden: int = 200
+    dropout: float = 0.0
 
     @nn.compact
     def __call__(self, x, deterministic=True):  # x: [..., T, D]
+        if self.dropout:
+            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         att = jnp.tanh(nn.Dense(self.hidden)(x))
         att = nn.Dense(1)(att)[..., 0]
         att = jax.nn.softmax(att, axis=-1)
@@ -90,6 +97,112 @@ class _NRMS(nn.Module):
         return jnp.einsum("bcd,bd->bc", cand_vecs, user_vec)  # scores
 
 
+# ----------------------------------------------------------------------
+# Reference-faithful architecture (``arch: fednewsrec``): the exact net
+# the reference ships (``fednewsrec_model.py:316-360`` — the TF port),
+# selected per-config; the NRMS default above is the TPU-first
+# simplification of the same published model family (no conv phase, flax
+# fused attention with output projection).  Faithful pieces:
+# conv1d(300->400, k=3, valid) news phase, PROJECTION-LESS multi-head
+# attention (``Attention``, ``fednewsrec_model.py:44-108``: per-head
+# q/k/v, concat heads, no out-proj), and the dual-path user encoder
+# (attention->pool alongside a tail-20 GRU's last output, the two
+# stacked and attention-pooled, ``fednewsrec_model.py:208-255``).  The
+# word embedding is FROZEN pretrained glove in the reference
+# (``from_pretrained(..., freeze=True)``) — here the matrix is a task
+# constant applied outside the module, so it is never a trainable leaf.
+
+class _RefAttention(nn.Module):
+    """The reference's projection-less multi-head self-attention."""
+
+    heads: int = 20
+    head_dim: int = 20
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, D]
+        od = self.heads * self.head_dim
+        B, T = x.shape[0], x.shape[1]
+
+        def split(t):
+            return t.reshape(B, T, self.heads,
+                             self.head_dim).transpose(0, 2, 1, 3)
+
+        q = split(nn.Dense(od, use_bias=False, name="WQ")(x))
+        k = split(nn.Dense(od, use_bias=False, name="WK")(x))
+        v = split(nn.Dense(od, use_bias=False, name="WV")(x))
+        a = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(self.head_dim, x.dtype))
+        a = jax.nn.softmax(a, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+        return o.transpose(0, 2, 1, 3).reshape(B, T, od)
+
+
+class _RefDocEncoder(nn.Module):
+    heads: int = 20
+    head_dim: int = 20
+    conv_filters: int = 400
+
+    @nn.compact
+    def __call__(self, wv, deterministic=True):  # [B, L, E] title words
+        # dropout sites mirror the reference exactly: phase1 input,
+        # post-relu, post-attention-relu, then the pooling's own input
+        # dropout (``fednewsrec_model.py:131-151``)
+        drop = lambda t: nn.Dropout(0.2)(t, deterministic=deterministic)
+        h = drop(wv)
+        h = nn.Conv(self.conv_filters, (3,), padding="VALID",
+                    name="conv")(h)
+        h = nn.relu(h)
+        h = drop(h)
+        h = _RefAttention(self.heads, self.head_dim)(h)
+        h = nn.relu(h)
+        h = drop(h)
+        return _AttentivePooling(dropout=0.2)(h,
+                                              deterministic=deterministic)
+
+
+class _RefUserEncoder(nn.Module):
+    heads: int = 20
+    head_dim: int = 20
+    gru_tail: int = 20
+
+    @nn.compact
+    def __call__(self, news_vecs, deterministic=True):  # [B, H, D]
+        u2 = _RefAttention(self.heads, self.head_dim)(news_vecs)
+        u2 = nn.Dropout(0.2)(u2, deterministic=deterministic)
+        u2 = _AttentivePooling(dropout=0.2)(u2,
+                                            deterministic=deterministic)
+        # the GRU path reads the RAW input tail (the reference's
+        # dropout1 is commented out, ``fednewsrec_model.py:212-236``)
+        tail = news_vecs[:, -self.gru_tail:, :]
+        outs = nn.RNN(nn.GRUCell(news_vecs.shape[-1]))(tail)
+        u1 = outs[:, -1, :]
+        return _AttentivePooling(dropout=0.2)(
+            jnp.stack([u1, u2], axis=1), deterministic=deterministic)
+
+
+class _RefFedNewsRec(nn.Module):
+    """Reference ``FedNewsRec.forward`` on pre-embedded word vectors."""
+
+    heads: int = 20
+    head_dim: int = 20
+    gru_tail: int = 20
+
+    @nn.compact
+    def __call__(self, clicked_wv, cand_wv, deterministic=True):
+        # clicked_wv [B, H, L, E], cand_wv [B, C, L, E]
+        doc = _RefDocEncoder(self.heads, self.head_dim)
+        B, H, L, E = clicked_wv.shape
+        C = cand_wv.shape[1]
+        clicked_vecs = doc(clicked_wv.reshape(B * H, L, E),
+                           deterministic).reshape(B, H, -1)
+        cand_vecs = doc(cand_wv.reshape(B * C, L, E),
+                        deterministic).reshape(B, C, -1)
+        user_vec = _RefUserEncoder(self.heads, self.head_dim,
+                                   self.gru_tail)(clicked_vecs,
+                                                  deterministic)
+        return jnp.einsum("bcd,bd->bc", cand_vecs, user_vec)
+
+
 class FedNewsRecTask(BaseTask):
 
     name = "fednewsrec"
@@ -99,25 +212,61 @@ class FedNewsRecTask(BaseTask):
         self.seq_len = int(model_config.get("max_title_length", 30))
         self.history = int(model_config.get("max_history", 50))
         self.npratio = int(model_config.get("npratio", 4))
-        self.module = _NRMS(
-            vocab_size=self.vocab_size,
-            embed_dim=int(model_config.get("embed_dim", 300)),
-            heads=int(model_config.get("num_heads", 20)),
-            head_dim=int(model_config.get("head_dim", 20)))
+        embed_dim = int(model_config.get("embed_dim", 300))
+        heads = int(model_config.get("num_heads", 20))
+        head_dim = int(model_config.get("head_dim", 20))
+        self.arch = str(model_config.get("arch", "nrms"))
+        self._frozen_emb = None
+        if self.arch == "fednewsrec":
+            # the reference's exact net; the word table is FROZEN glove
+            # (``nn.Embedding.from_pretrained(..., freeze=True)``) — an
+            # ``embedding_matrix`` config value (ndarray) mirrors the
+            # glove load; absent one, a fixed-seed random table stands in
+            # (zero-egress environments have no glove file)
+            emb = model_config.get("embedding_matrix")
+            if emb is None:
+                import numpy as _np
+                emb = _np.random.default_rng(0).normal(
+                    scale=0.1, size=(self.vocab_size, embed_dim))
+            self._frozen_emb = jnp.asarray(emb, jnp.float32)
+            self.module = _RefFedNewsRec(
+                heads=heads, head_dim=head_dim,
+                gru_tail=int(model_config.get("gru_tail", 20)))
+        elif self.arch == "nrms":
+            self.module = _NRMS(vocab_size=self.vocab_size,
+                                embed_dim=embed_dim, heads=heads,
+                                head_dim=head_dim)
+        else:
+            raise ValueError(
+                f"model_config.arch must be 'nrms' or 'fednewsrec', "
+                f"got {self.arch!r}")
 
     def init_params(self, rng: jax.Array):
+        if self._frozen_emb is not None:
+            E = self._frozen_emb.shape[-1]
+            clicked = jnp.zeros((1, self.history, self.seq_len, E))
+            cands = jnp.zeros((1, self.npratio + 1, self.seq_len, E))
+            return self.module.init(rng, clicked, cands)["params"]
         clicked = jnp.zeros((1, self.history, self.seq_len), jnp.int32)
         cands = jnp.zeros((1, self.npratio + 1, self.seq_len), jnp.int32)
         return self.module.init(rng, clicked, cands)["params"]
 
-    def _scores(self, params, batch):
-        return self.module.apply({"params": params},
-                                 batch["clicked"].astype(jnp.int32),
-                                 batch["cands"].astype(jnp.int32))
+    def _scores(self, params, batch, rng=None, train=False):
+        clicked = batch["clicked"].astype(jnp.int32)
+        cands = batch["cands"].astype(jnp.int32)
+        if self._frozen_emb is not None:
+            train = bool(train) and rng is not None
+            return self.module.apply(
+                {"params": params},
+                jnp.take(self._frozen_emb, clicked, axis=0),
+                jnp.take(self._frozen_emb, cands, axis=0),
+                deterministic=not train,
+                rngs={"dropout": rng} if train else None)
+        return self.module.apply({"params": params}, clicked, cands)
 
     def loss(self, params, batch: Batch, rng: Optional[jax.Array] = None,
              train: bool = True):
-        scores = self._scores(params, batch)
+        scores = self._scores(params, batch, rng=rng, train=train)
         y = batch["y"].astype(jnp.int32)
         logp = jax.nn.log_softmax(scores, axis=-1)
         nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
@@ -204,10 +353,14 @@ class FedNewsRecTask(BaseTask):
     def _pad_history(self, clicked) -> "np.ndarray":
         import numpy as np
         hist = np.zeros((self.history, self.seq_len), np.int32)
-        # most-recent H clicks (reference keeps the trailing window,
-        # preprocess_mind.py click-history truncation)
-        for j, title in enumerate(list(clicked)[-self.history:]):
-            hist[j] = self._pad_title(title)
+        # most-recent H clicks, FRONT-padded so the newest click sits at
+        # the LAST row (reference ``preprocess_mind.py``:
+        # ``click = [0]*(MAX_ALL-len(click)) + click``) — the faithful
+        # user encoder's tail-GRU reads the trailing window, so end
+        # padding would hand it pad vectors for every short history
+        titles = list(clicked)[-self.history:]
+        for j, title in enumerate(titles):
+            hist[self.history - len(titles) + j] = self._pad_title(title)
         return hist
 
     def make_dataset(self, blob, model_config, split, data_config=None):
