@@ -181,7 +181,30 @@ class RingLMTask(_TokenDatasetMixin, SequenceLMTask):
                                  moe_ep_axis=expert_axis)
 
 
+#: dense/flash crossover: below this per-device sequence length XLA's
+#: fused dense-softmax attention beats the Pallas kernels on measured
+#: fwd+bwd wall time (committed `bench_tpu_longctx.json`: flash_speedup
+#: 0.83-0.93 at L=2048); above it flash's O(L) VMEM streaming wins and
+#: dense's O(L^2) score materialization eventually cannot fit at all.
+#: Calibrated against `flash_crossover.json` (tools/
+#: flash_crossover_sweep.py) when the sweep artifact is present.
+FLASH_AUTO_MIN_LEN = 4096
+
+
+def _resolve_flash(flag, seq_len: int) -> bool:
+    """``flash_attention`` config: bool, or "auto" = flash iff the
+    sequence length reaches the measured dense/flash crossover."""
+    if isinstance(flag, str):
+        if flag.lower() != "auto":
+            raise ValueError(
+                f"model_config.flash_attention must be bool or 'auto', "
+                f"got {flag!r}")
+        return seq_len >= FLASH_AUTO_MIN_LEN
+    return bool(flag)
+
+
 def make_ringlm_task(model_config) -> RingLMTask:
+    seq_len = int(model_config.get("seq_len", 128))
     module = _RingLM(
         vocab_size=int(model_config.get("vocab_size", 256)),
         embed_dim=int(model_config.get("embed_dim", 64)),
@@ -191,12 +214,11 @@ def make_ringlm_task(model_config) -> RingLMTask:
         num_layers=int(model_config.get("num_layers", 2)),
         dtype=parse_dtype(model_config),
         remat=bool(model_config.get("remat", False)),
-        max_len=int(model_config.get("seq_len", 128)) - 1,
+        max_len=seq_len - 1,
         moe_experts=int(model_config.get("moe_experts", 0) or 0),
-        use_flash=bool(model_config.get("flash_attention", False)))
-    return RingLMTask(module,
-                      seq_len=int(model_config.get("seq_len", 128)),
-                      name="ringlm")
+        use_flash=_resolve_flash(
+            model_config.get("flash_attention", False), seq_len - 1))
+    return RingLMTask(module, seq_len=seq_len, name="ringlm")
 
 
 def build_sp_train_step(task: RingLMTask, mesh: Mesh,

@@ -136,3 +136,29 @@ def test_ring_flash_grads_match_full_attention(ring2_mesh):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=5e-5, atol=5e-5,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_ringlm_flash_auto_policy():
+    # "auto" resolves by the measured dense/flash crossover length
+    from msrflute_tpu.models.ringlm import (FLASH_AUTO_MIN_LEN,
+                                            _resolve_flash)
+    import pytest as _pytest
+    assert _resolve_flash("auto", FLASH_AUTO_MIN_LEN - 1) is False
+    assert _resolve_flash("auto", FLASH_AUTO_MIN_LEN) is True
+    assert _resolve_flash(True, 8) is True
+    assert _resolve_flash(False, 1 << 20) is False
+    with _pytest.raises(ValueError):
+        _resolve_flash("fastest", 128)
+
+
+def test_ringlm_flash_auto_config_roundtrip():
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.models.ringlm import FLASH_AUTO_MIN_LEN
+    short = make_task(ModelConfig(model_type="RINGLM", extra={
+        "vocab_size": 64, "seq_len": 64, "flash_attention": "auto"}))
+    assert short.module.use_flash is False
+    lng = make_task(ModelConfig(model_type="RINGLM", extra={
+        "vocab_size": 64, "seq_len": FLASH_AUTO_MIN_LEN + 1,
+        "flash_attention": "auto"}))
+    assert lng.module.use_flash is True
