@@ -186,11 +186,13 @@ class _RefFedNewsRec(nn.Module):
     heads: int = 20
     head_dim: int = 20
     gru_tail: int = 20
+    conv_filters: int = 400
 
     @nn.compact
     def __call__(self, clicked_wv, cand_wv, deterministic=True):
         # clicked_wv [B, H, L, E], cand_wv [B, C, L, E]
-        doc = _RefDocEncoder(self.heads, self.head_dim)
+        doc = _RefDocEncoder(self.heads, self.head_dim,
+                             self.conv_filters)
         B, H, L, E = clicked_wv.shape
         C = cand_wv.shape[1]
         clicked_vecs = doc(clicked_wv.reshape(B * H, L, E),
@@ -231,7 +233,8 @@ class FedNewsRecTask(BaseTask):
             self._frozen_emb = jnp.asarray(emb, jnp.float32)
             self.module = _RefFedNewsRec(
                 heads=heads, head_dim=head_dim,
-                gru_tail=int(model_config.get("gru_tail", 20)))
+                gru_tail=int(model_config.get("gru_tail", 20)),
+                conv_filters=int(model_config.get("conv_filters", 400)))
         elif self.arch == "nrms":
             self.module = _NRMS(vocab_size=self.vocab_size,
                                 embed_dim=embed_dim, heads=heads,

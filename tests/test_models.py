@@ -214,3 +214,57 @@ def test_classification_train_without_rng_raises():
     x = jnp.zeros((2, 28, 28, 1), jnp.float32)
     with pytest.raises(ValueError, match="requires an rng"):
         task.apply(params, x, rng=None, train=True)
+
+
+def test_fednewsrec_faithful_arch_through_engine(tmp_path):
+    """The reference-faithful ``arch: fednewsrec`` variant (frozen word
+    table, conv phase, dual-path GRU user encoder) must run through the
+    full federated engine — the frozen embedding is a task constant
+    captured by the jitted round, never a trainable leaf."""
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.parallel import make_mesh
+
+    V, HIST, L, C = 50, 4, 6, 3
+    rng = np.random.default_rng(0)
+    model_cfg = {"model_type": "FEDNEWSREC", "arch": "fednewsrec",
+                 "vocab_size": V, "embed_dim": 16, "num_heads": 2,
+                 "head_dim": 8, "conv_filters": 16, "gru_tail": 2,
+                 "max_title_length": L, "max_history": HIST,
+                 "npratio": C - 1}
+    task = make_task(ModelConfig.from_dict(model_cfg))
+    # frozen table is NOT in params
+    params = task.init_params(jax.random.PRNGKey(0))
+    names = jax.tree_util.tree_leaves_with_path(params)
+    assert not any("Embed" in jax.tree_util.keystr(p) for p, _ in names)
+
+    users, per_user = [], []
+    for u in range(8):
+        users.append(f"u{u}")
+        per_user.append({
+            "clicked": rng.integers(1, V, (4, HIST, L)).astype(np.int32),
+            "cands": rng.integers(1, V, (4, C, L)).astype(np.int32),
+            "y": rng.integers(0, C, (4,)).astype(np.int32)})
+    ds = ArraysDataset(users, per_user)
+    cfg = FLUTEConfig.from_dict({
+        "model_config": model_cfg,
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 2, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.05,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 2, "initial_val": False,
+            "data_config": {"val": {"batch_size": 8}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.05},
+            "data_config": {"train": {"batch_size": 4}},
+        },
+    })
+    server = OptimizationServer(task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), mesh=make_mesh(),
+                                seed=0)
+    state = server.train()
+    assert state.round == 2
+    assert "auc" in server.best_val
