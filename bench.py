@@ -899,18 +899,29 @@ def main() -> None:
         arts = sorted(glob.glob(os.path.join(REPO_ROOT,
                                              "BENCH_TPU_*.json")))
         if arts:
-            latest = arts[-1]
-            stamp = None
-            try:
-                with open(latest) as fh:
-                    stamp = json.load(fh).get("captured_at")
-            except Exception:
-                pass
+            def _payload(path):
+                try:
+                    with open(path) as fh:
+                        d = json.load(fh)
+                    return d if isinstance(d, dict) else {}
+                except Exception:
+                    return {}
+            parsed = {a: _payload(a) for a in arts}
+            # prefer the freshest capture that carries the headline
+            # metric (single-protocol queue jobs commit raw artifacts
+            # whose headline value is null — correct as data, but a
+            # poor provenance pointer)
+            with_headline = [a for a in arts
+                             if parsed[a].get("value") is not None]
+            latest = (with_headline or arts)[-1]
             extras["prior_tpu_artifact"] = {
                 "file": os.path.basename(latest),
-                "captured_at": stamp,
-                "note": "most recent committed on-chip capture; "
-                        "NOT this run's measurement"}
+                "captured_at": parsed[latest].get("captured_at"),
+                "note": ("most recent committed on-chip capture"
+                         if latest == arts[-1] else
+                         "most recent committed on-chip capture WITH the "
+                         "headline metric (newer single-protocol captures "
+                         "exist)") + "; NOT this run's measurement"}
     for name, spec in protocols.items():
         if _remaining() < 60:
             extras[name] = {"skipped": "caller deadline imminent"}
