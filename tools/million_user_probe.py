@@ -1,0 +1,170 @@
+"""Million-user host-loader probe — the "millions of clients" evidence.
+
+The reference claims million-client scale (``/root/reference/README.md:9``)
+but its loaders materialize every user's samples; this framework's scale
+path is ``LazyHDF5Users`` + ``LazyUserDataset`` (header-only eager read,
+per-user on-demand IO, bounded LRU).  This tool measures that path at an
+actual million-user pool:
+
+1. stream-writes a 1e6-user hdf5 blob (reference create-hdf5 layout,
+   group per user) without ever holding the pool in RAM;
+2. opens it (the only eager cost: the 1e6-entry name/count header);
+3. runs LR federated rounds through the REAL engine sampling K users a
+   round from the full pool;
+and reports wall times, file size, and host peak-RSS at each stage.  The
+claim being evidenced: pool size costs disk and a header, not RAM —
+round cost depends on K, not on pool size.
+
+Usage: python tools/million_user_probe.py [pool_size] > million_user.json
+CPU-only by design (the measured quantity is host IO/memory, not chip
+math); run under the virtual-mesh env like the tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _rss_mb() -> float:
+    """CURRENT resident set (VmRSS), not the lifetime peak — per-stage
+    attribution needs the level at the stage boundary."""
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("VmRSS:"):
+                return round(int(line.split()[1]) / 1024.0, 1)
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                 / 1024.0, 1)
+
+
+def _peak_rss_mb() -> float:
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                 / 1024.0, 1)
+
+
+def main() -> int:
+    pool = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    # a small-pool CONTROL measured with the identical method makes the
+    # "round cost is independent of pool size" claim self-contained in
+    # this one artifact
+    control = min(2000, pool)
+    spu, dim, classes = 10, 64, 10
+    out = {"samples_per_user": spu, "input_dim": dim,
+           "rss_mb_baseline": _rss_mb()}
+    for label, n in (("control", control), ("pool", pool)):
+        out[label] = _measure(n, spu, dim, classes)
+    out["rss_mb_process_peak"] = _peak_rss_mb()
+    print(json.dumps(out))
+    return 0
+
+
+def _measure(pool, spu, dim, classes):
+    import h5py
+    import numpy as np
+
+    out = {"pool_users": pool}
+    tmpdir = tempfile.mkdtemp(prefix="million_pool_")
+    path = os.path.join(tmpdir, "pool.hdf5")
+    try:
+        # -- 1. stream-write: a shared separable template plus a cheap
+        # per-user feature shift, never the whole pool in memory
+        t0 = time.time()
+        rng = np.random.default_rng(0)
+        x_template = rng.normal(size=(spu, dim)).astype(np.float32)
+        y_template = (np.arange(spu) % classes).astype(np.int64)
+        x_template[:, 0] += (y_template * 2 - classes + 1) * 0.5
+        # libver="latest": the 1.8 default's symbol-table groups degrade
+        # badly past ~1e5 siblings; the new-format B-tree keeps creation
+        # near-constant-rate at 1e6 groups
+        with h5py.File(path, "w", libver="latest") as fh:
+            fh.create_dataset("users", data=np.array(
+                [f"u{i:07d}" for i in range(pool)], dtype="S"))
+            fh.create_dataset("num_samples",
+                              data=np.full(pool, spu, np.int64))
+            grp = fh.create_group("user_data")
+            lab = fh.create_group("user_data_label")
+            for i in range(pool):
+                u = f"u{i:07d}"
+                # cheap per-user heterogeneity: a per-user feature shift
+                # so FedAvg over K clients is not K copies of one client
+                x = x_template + (i % 97) * 0.01
+                grp.create_group(u).create_dataset("x", data=x)
+                lab.create_dataset(u, data=y_template)
+                if i and i % 100_000 == 0:
+                    print(f"[million_probe] wrote {i} users "
+                          f"({time.time() - t0:.0f}s)", file=sys.stderr)
+        out["write_secs"] = round(time.time() - t0, 1)
+        out["file_mb"] = round(os.path.getsize(path) / 1e6, 1)
+        out["rss_mb_after_write"] = _rss_mb()
+
+        # -- 2. open: the only eager cost is the name/count header
+        from msrflute_tpu.data.dataset import LazyUserDataset
+        from msrflute_tpu.data.user_blob import LazyHDF5Users
+        t0 = time.time()
+        users = LazyHDF5Users(path)
+        out["open_secs"] = round(time.time() - t0, 2)
+        out["num_users_seen"] = len(users.user_list)
+        out["rss_mb_after_open"] = _rss_mb()
+
+        # -- 3. federated rounds sampling K from the full pool (warmed,
+        # so the number excludes the one-off XLA compile)
+        from msrflute_tpu.config import FLUTEConfig
+        from msrflute_tpu.engine import OptimizationServer
+        from msrflute_tpu.models import make_task
+        from msrflute_tpu.parallel import make_mesh
+        K, rounds = 100, 8
+        cfg = FLUTEConfig.from_dict({
+            "model_config": {"model_type": "LR", "num_classes": classes,
+                             "input_dim": dim},
+            "strategy": "fedavg",
+            "server_config": {
+                "max_iteration": rounds,
+                "num_clients_per_iteration": K,
+                "initial_lr_client": 0.1,
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "val_freq": 100, "initial_val": False,
+                "data_config": {"val": {"batch_size": 64}},
+            },
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": 0.1},
+                "data_config": {"train": {"batch_size": 10}},
+            },
+        })
+        task = make_task(cfg.model_config)
+        data = LazyUserDataset(users, cache_users=256)
+        with tempfile.TemporaryDirectory() as mdir:
+            server = OptimizationServer(task, cfg, data, val_dataset=None,
+                                        model_dir=mdir, mesh=make_mesh(),
+                                        seed=0)
+            # warmup: compile + first rounds outside the timed window
+            # (the bench_protocol pattern — extend max_iteration, train
+            # again; the jitted round program is reused)
+            t0 = time.time()
+            server.train()
+            out["warmup_rounds_secs"] = round(time.time() - t0, 2)
+            server.config.server_config.max_iteration += rounds
+            t0 = time.time()
+            server.train()
+            total = time.time() - t0
+        out["rounds_timed"] = rounds
+        out["clients_per_round"] = K
+        out["secs_per_round"] = round(total / rounds, 3)
+        out["rss_mb_after_rounds"] = _rss_mb()
+    finally:
+        try:
+            os.remove(path)
+            os.rmdir(tmpdir)
+        except OSError:
+            pass
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())
