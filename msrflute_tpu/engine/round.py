@@ -181,7 +181,8 @@ class RoundEngine:
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
-                       leakage_threshold, quant_threshold, rng, pool=None):
+                       leakage_threshold, quant_threshold, rng,
+                       cohort_ids=None, cohort_mask=None, pool=None):
             def gather_pool(arrays, sample_mask):
                 # device-resident mode: 'arrays' carries pool indices;
                 # gather the feature rows in-program (one XLA gather per
@@ -202,11 +203,19 @@ class RoundEngine:
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
                 rng_c = jax.random.fold_in(rng, cid_c)
+                cohort_kw = {}
+                if strategy.wants_cohort:
+                    # the FULL round cohort (replicated), plus this
+                    # client's own id/presence — secure aggregation
+                    # derives pairwise masks from these
+                    cohort_kw = dict(cohort_ids=cohort_ids,
+                                     cohort_mask=cohort_mask,
+                                     self_id=cid_c, self_mask=cm_c)
                 parts, tl, ns, stats = strategy.client_step(
                     client_update, params, arr_c, mask_c, client_lr, rng_c,
                     round_idx=round_idx, leakage_threshold=leakage_threshold,
                     quant_threshold=quant_threshold,
-                    strategy_state=strategy_state)
+                    strategy_state=strategy_state, **cohort_kw)
                 parts = {name: (tree, w * cm_c)
                          for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
@@ -239,6 +248,25 @@ class RoundEngine:
                     w_def = ws * stale
                     wsum = lambda w, t: jax.tree.map(
                         lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), t)
+                    if name in strategy.unit_weight_parts:
+                        # masked payloads: every PRESENT slot enters with
+                        # coefficient exactly 1 (else pairwise masks
+                        # cannot cancel); the tensordot runs in the
+                        # tree's own dtype so int32 modular arithmetic
+                        # wraps instead of promoting to float
+                        gsum = jax.tree.map(
+                            lambda g: jnp.tensordot(
+                                cm_k.astype(g.dtype), g, axes=[[0], [0]]),
+                            trees)
+                        local["parts"][name] = {
+                            "grad_sum": gsum,
+                            "weight_sum": jnp.sum(w_now),
+                            "grad_sum_def": jax.tree.map(
+                                jnp.zeros_like, gsum),
+                            "weight_sum_def": jnp.sum(w_def),
+                            "weight_sum_raw": jnp.sum(ws),
+                        }
+                        continue
                     local["parts"][name] = {
                         "grad_sum": wsum(w_now, trees),
                         "weight_sum": jnp.sum(w_now),
@@ -321,7 +349,7 @@ class RoundEngine:
             sharded_collect = shard_map(
                 shard_body, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
-                          rspec, rspec, rspec, rspec) +
+                          rspec, rspec, rspec, rspec, rspec, rspec) +
                          ((rspec,) if pool_mode else ()),
                 out_specs=(rspec, cspec), check_vma=False)
         else:
@@ -341,7 +369,7 @@ class RoundEngine:
             collected, privacy_per_client = sharded_collect(
                 bcast, strategy_state, arrays, sample_mask, client_mask,
                 client_ids, client_lr, round_idx, leakage_threshold,
-                quant_threshold, rng, *pool_args)
+                quant_threshold, rng, client_ids, client_mask, *pool_args)
             part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:

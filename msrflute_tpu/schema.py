@@ -52,6 +52,8 @@ ALLOWED_STRATEGIES = [
     "fedlabels", "FedLabels", "fedac", "FedAC", "scaffold", "Scaffold",
     # net-new: q-FFL fairness weighting (arXiv:1905.10497)
     "qffl", "QFFL",
+    # net-new: secure aggregation simulation (Bonawitz et al., CCS'17)
+    "secure_agg", "secagg", "SecureAgg",
 ]
 
 ALLOWED_SERVER_TYPES = [
@@ -129,7 +131,7 @@ SERVER_KEYS = {
     "optimizer_config", "annealing_config", "server_replay_config", "RL",
     "nbest_task_scheduler", "best_model_metric",
     # TPU-native extensions
-    "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir",
+    "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir", "secure_agg",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",

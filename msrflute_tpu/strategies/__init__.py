@@ -30,4 +30,7 @@ def select_strategy(name: str) -> type:
     if key == "qffl":
         from .qffl import QFFL
         return QFFL
+    if key in ("secure_agg", "secagg", "secureagg"):
+        from .secure_agg import SecureAgg
+        return SecureAgg
     raise ValueError(f"unknown strategy {name!r}")

@@ -66,6 +66,17 @@ class BaseStrategy:
     #: strategies that implement dp_config.adaptive_clipping set this; the
     #: base init fails loudly instead of silently ignoring the config
     supports_adaptive_clipping: bool = False
+    #: part names whose TREES enter the client sum with the 0/1
+    #: participation gate instead of the client weight (pre-weighted or
+    #: masked payloads — secure aggregation, where every mask must enter
+    #: with coefficient exactly 1); ``weight_sum`` still accumulates the
+    #: returned weights for normalization
+    unit_weight_parts: frozenset = frozenset()
+    #: client_step additionally receives ``cohort_ids``/``cohort_mask``
+    #: (the round's FULL sampled-id vector, replicated across shards) and
+    #: ``self_id``/``self_mask`` — what a secure-aggregation client needs
+    #: to derive its pairwise masks
+    wants_cohort: bool = False
 
     def __init__(self, config, dp_config=None):
         self.config = config

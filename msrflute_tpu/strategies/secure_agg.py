@@ -1,0 +1,193 @@
+"""Secure aggregation (Bonawitz et al., CCS'17) — net-new vs the reference.
+
+FLUTE has no secure aggregation; this is the TPU-native simulation of the
+pairwise-masking protocol, for research on SecAgg-composed FL: each
+client adds pairwise one-time masks to a fixed-point encoding of its
+weighted update, the server's sum cancels every mask exactly (modular
+int32 arithmetic — the reason real SecAgg works over a finite group, and
+what float masks cannot do), and no single client's submission reveals
+its update.
+
+What is simulated faithfully:
+
+- **fixed-point group arithmetic**: the weighted pseudo-gradient is
+  clipped to ``+-clip`` and encoded as int32 with ``frac_bits``
+  fractional bits; all masking/summation is int32 with two's-complement
+  wraparound (XLA semantics), decoded once after aggregation.
+- **pairwise masks**: for the round's sampled cohort, each pair (i, j)
+  shares a mask derived from a public pair key (round, min_id, max_id);
+  the lower id adds it, the higher id subtracts it, so the cohort sum
+  telescopes to zero.  Masks are full-range uint32 bits — each
+  submission is uniformly distributed in the group regardless of the
+  payload (perfect hiding within the simulation).
+- **dropped clients**: a client zeroed by the privacy filter
+  (``filter_weight`` / attack-metric dropping) still submits its masks
+  over an encoded zero, exactly like a SecAgg participant that must
+  deliver its masked input (or be reconstructed) once it joined the
+  masking round.  Padding slots (id -1) never enter the protocol.
+
+What is NOT simulated: the key-agreement / Shamir-recovery transport
+(there is no adversarial server in a single-controller simulation — the
+controller runs the clients; mask keys derive from public ids).  The
+simulated property is the aggregate-only dataflow: the summed payload
+is the ONLY place client updates become visible, which is the invariant
+SecAgg research composes against.
+
+Config (``server_config.secure_agg``, bool or dict)::
+
+    strategy: fedavg            # weighting semantics stay FedAvg's
+    server_config:
+      secure_agg: {frac_bits: 16, clip: 32.0, seed: 0}
+
+Range contract: the int32 group must hold ``sum_k |w_k| * clip *
+2^frac``.  Client weights are capped at ``filter_weight``'s
+MAX_WEIGHT=100, and K is known from ``num_clients_per_iteration``, so
+the worst case is static — the init RAISES when ``K * 100 * clip *
+2^frac >= 2^31``, pointing at the clip/frac_bits to lower.  Within that
+bound the decoded sum is exact; there is no silent-overflow regime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .fedavg import FedAvg
+
+
+class SecureAgg(FedAvg):
+
+    supports_staleness = False
+    supports_rl = False
+    wants_cohort = True
+    unit_weight_parts = frozenset({"default"})
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        sa = config.server_config.get("secure_agg", True)
+        if not isinstance(sa, (dict, bool)):
+            raise ValueError(
+                f"server_config.secure_agg must be a bool or an options "
+                f"dict, got {type(sa).__name__}")
+        sa = sa if isinstance(sa, dict) else {}
+        unknown = set(sa) - {"frac_bits", "clip", "seed"}
+        if unknown:
+            raise ValueError(
+                f"server_config.secure_agg has unknown keys {sorted(unknown)}"
+                f" (known: frac_bits, clip, seed)")
+        self.frac_bits = int(sa.get("frac_bits", 16))
+        self.clip = float(sa.get("clip", 32.0))
+        self.seed = int(sa.get("seed", 0))
+        if not 1 <= self.frac_bits <= 24:
+            raise ValueError(
+                f"secure_agg.frac_bits must be in [1, 24], "
+                f"got {self.frac_bits}")
+        if not self.clip > 0:
+            raise ValueError(f"secure_agg.clip must be > 0, got {self.clip}")
+        # static range contract: worst-case round sum must fit int32.
+        # K from config ("lo:hi" takes hi), weights capped by
+        # filter_weight's MAX_WEIGHT=100 (strategies/base.py)
+        raw_k = config.server_config.get("num_clients_per_iteration", 10)
+        k = int(str(raw_k).split(":")[-1])
+        worst = k * 100.0 * self.clip * float(1 << self.frac_bits)
+        if worst >= 2.0 ** 31:
+            raise ValueError(
+                f"secure_agg range contract violated: K={k} clients x "
+                f"MAX_WEIGHT=100 x clip={self.clip} x 2^{self.frac_bits} "
+                f"= {worst:.3g} >= 2^31 — lower clip or frac_bits (the "
+                f"int32 group must hold the worst-case round sum)")
+        if dp_config is not None and (
+                dp_config.get("enable_local_dp", False) or
+                dp_config.get("enable_global_dp", False)):
+            raise ValueError(
+                "strategy: secure_agg does not compose with dp_config DP "
+                "modes yet — local DP noise breaks the fixed-point range "
+                "contract and the RDP accounting assumes the unmasked "
+                "pipeline; run one or the other")
+        if bool(config.get("dump_norm_stats",
+                           config.server_config.get("dump_norm_stats",
+                                                    False))):
+            raise ValueError(
+                "dump_norm_stats reads per-client payloads, which under "
+                "secure_agg are masked int32 group elements — the dumped "
+                "norms/cosines would be noise; disable one of the two")
+
+    # ------------------------------------------------------------------
+    def _pair_masks(self, tree, self_id, cohort_ids, cohort_mask,
+                    round_idx):
+        """Sum of this client's signed pairwise masks, one tree.
+
+        A ``fori_loop`` folds each partner's mask into a running int32
+        sum, so peak memory is ONE mask tree — a vmap over partners
+        would materialize [cohort, n_params] intermediates per client
+        (O(K^2 x n_params) across the round program)."""
+        base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                  jnp.asarray(round_idx, jnp.int32))
+        leaves, treedef = jax.tree.flatten(tree)
+
+        def body(j, acc):
+            jid = cohort_ids[j]
+            jm = cohort_mask[j]
+            lo = jnp.minimum(self_id, jid)
+            hi = jnp.maximum(self_id, jid)
+            # public pair key; clamp: padding ids (-1) are gated out but
+            # fold_in still traces on them
+            key = jax.random.fold_in(
+                jax.random.fold_in(base, jnp.maximum(lo, 0)),
+                jnp.maximum(hi, 0))
+            gate = ((jm > 0) & (jid >= 0) &
+                    (jid != self_id)).astype(jnp.int32)
+            sign = jnp.where(jid > self_id, jnp.int32(1), jnp.int32(-1))
+            out = []
+            for li, (a, leaf) in enumerate(zip(acc, leaves)):
+                bits = jax.random.bits(jax.random.fold_in(key, li),
+                                       leaf.shape, jnp.uint32)
+                # uint32 -> int32 reinterpretation keeps the full group
+                out.append(a + gate * sign *
+                           jax.lax.bitcast_convert_type(bits, jnp.int32))
+            return out
+
+        acc0 = [jnp.zeros(leaf.shape, jnp.int32) for leaf in leaves]
+        summed = jax.lax.fori_loop(0, cohort_ids.shape[0], body, acc0)
+        return jax.tree.unflatten(treedef, summed)
+
+    # ------------------------------------------------------------------
+    def client_step(self, client_update, global_params, arrays, sample_mask,
+                    client_lr, rng, round_idx=None, leakage_threshold=None,
+                    quant_threshold=None, strategy_state=None,
+                    grad_offset=None, cohort_ids=None, cohort_mask=None,
+                    self_id=None, self_mask=None):
+        parts, tl, ns, stats = super().client_step(
+            client_update, global_params, arrays, sample_mask, client_lr,
+            rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
+            quant_threshold=quant_threshold, strategy_state=strategy_state,
+            grad_offset=grad_offset)
+        pg, w = parts["default"]
+        scale = jnp.float32(1 << self.frac_bits)
+        # encode the WEIGHTED update (the weight is public; it rides the
+        # separate weight_sum); a dropped client (w == 0) encodes zero
+        enc = jax.tree.map(
+            lambda g: jnp.round(
+                jnp.clip(g * w, -self.clip, self.clip) * scale
+            ).astype(jnp.int32),
+            pg)
+        masks = self._pair_masks(enc, self_id, cohort_ids, cohort_mask,
+                                 round_idx)
+        present = (self_mask > 0).astype(jnp.int32)
+        masked = jax.tree.map(lambda e, m: (e + m) * present, enc, masks)
+        parts["default"] = (masked, w)
+        return parts, tl, ns, stats
+
+    # ------------------------------------------------------------------
+    def combine_parts(self, part_sums: Dict[str, Dict[str, Any]],
+                      deferred, state, rng, num_clients,
+                      global_params=None) -> Tuple[Any, Any]:
+        enc_sum = part_sums["default"]["grad_sum"]
+        w_sum = part_sums["default"]["weight_sum"]
+        denom = jnp.maximum(w_sum, 1e-12)
+        scale = jnp.float32(1 << self.frac_bits)
+        agg = jax.tree.map(
+            lambda e: e.astype(jnp.float32) / scale / denom, enc_sum)
+        return agg, state
