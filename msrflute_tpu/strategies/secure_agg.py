@@ -33,18 +33,22 @@ simulated property is the aggregate-only dataflow: the summed payload
 is the ONLY place client updates become visible, which is the invariant
 SecAgg research composes against.
 
-Config (``server_config.secure_agg``, bool or dict)::
+Config (``server_config.secure_agg``, bool or dict; weighting
+semantics stay FedAvg's)::
 
-    strategy: fedavg            # weighting semantics stay FedAvg's
+    strategy: secure_agg
     server_config:
-      secure_agg: {frac_bits: 16, clip: 32.0, seed: 0}
+      secure_agg: {frac_bits: 12, clip: 4.0, seed: 0}
 
-Range contract: the int32 group must hold ``sum_k |w_k| * clip *
+Range contract: the clip applies to the PSEUDO-GRADIENT (before the
+public weight), so the int32 group must hold ``sum_k w_k * clip *
 2^frac``.  Client weights are capped at ``filter_weight``'s
-MAX_WEIGHT=100, and K is known from ``num_clients_per_iteration``, so
+MAX_WEIGHT=100 and K is known from ``num_clients_per_iteration``, so
 the worst case is static — the init RAISES when ``K * 100 * clip *
-2^frac >= 2^31``, pointing at the clip/frac_bits to lower.  Within that
-bound the decoded sum is exact; there is no silent-overflow regime.
+2^frac >= 2^31`` (defaults admit K up to 1310), pointing at the
+clip/frac_bits to lower.  Within that bound the int32 SUM is exact;
+decoding splits it into 15-bit halves so the only float rounding is at
+the final aggregate's own magnitude (relative ~2^-24).
 """
 
 from __future__ import annotations
@@ -77,8 +81,8 @@ class SecureAgg(FedAvg):
             raise ValueError(
                 f"server_config.secure_agg has unknown keys {sorted(unknown)}"
                 f" (known: frac_bits, clip, seed)")
-        self.frac_bits = int(sa.get("frac_bits", 16))
-        self.clip = float(sa.get("clip", 32.0))
+        self.frac_bits = int(sa.get("frac_bits", 12))
+        self.clip = float(sa.get("clip", 4.0))
         self.seed = int(sa.get("seed", 0))
         if not 1 <= self.frac_bits <= 24:
             raise ValueError(
@@ -166,11 +170,12 @@ class SecureAgg(FedAvg):
             grad_offset=grad_offset)
         pg, w = parts["default"]
         scale = jnp.float32(1 << self.frac_bits)
-        # encode the WEIGHTED update (the weight is public; it rides the
-        # separate weight_sum); a dropped client (w == 0) encodes zero
+        # clip the pseudo-gradient THEN weight (clipping the product
+        # would silently squash heavy-weight clients and break the
+        # FedAvg-match property); a dropped client (w == 0) encodes zero
         enc = jax.tree.map(
             lambda g: jnp.round(
-                jnp.clip(g * w, -self.clip, self.clip) * scale
+                jnp.clip(g, -self.clip, self.clip) * w * scale
             ).astype(jnp.int32),
             pg)
         masks = self._pair_masks(enc, self_id, cohort_ids, cohort_mask,
@@ -188,6 +193,15 @@ class SecureAgg(FedAvg):
         w_sum = part_sums["default"]["weight_sum"]
         denom = jnp.maximum(w_sum, 1e-12)
         scale = jnp.float32(1 << self.frac_bits)
-        agg = jax.tree.map(
-            lambda e: e.astype(jnp.float32) / scale / denom, enc_sum)
-        return agg, state
+
+        def decode(e):
+            # split decode: a direct int32->f32 cast rounds above 2^24;
+            # 15-bit halves are each f32-exact and the one rounding left
+            # is at the final aggregate's own magnitude
+            hi = jnp.right_shift(e, 15)            # arithmetic: floor
+            lo = e - jnp.left_shift(hi, 15)        # in [0, 2^15)
+            k = 1.0 / scale / denom
+            return (hi.astype(jnp.float32) * (32768.0 * k)
+                    + lo.astype(jnp.float32) * k)
+
+        return jax.tree.map(decode, enc_sum), state

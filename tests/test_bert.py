@@ -159,3 +159,43 @@ def test_bert_pretrained_local_torch_checkpoint(bert_task, tmp_path):
                              jnp.ones((2, 16), jnp.int32))
     np.testing.assert_allclose(np.asarray(jx_logits), pt_logits.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_bert_local_dp_plus_quantization_e2e(bert_task, tmp_path):
+    """The north-star's fifth config (BASELINE.json): BERT MLM federated
+    rounds with LOCAL DP (clip + weight-scaling dance) AND gradient
+    quantization applied to the same payloads — reference
+    ``extensions/privacy`` + ``extensions/quantization`` composed on
+    ``mlm_bert``.  Two rounds through the real engine; the transforms
+    run in-jit inside the vmapped client step."""
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.parallel import make_mesh
+    cfg = FLUTEConfig.from_dict({
+        "model_config": TINY_BERT,
+        "strategy": "dga",
+        "dp_config": {
+            "enable_local_dp": True,
+            "eps": 100.0, "max_grad": 1.0, "max_weight": 100.0,
+            "min_weight": 0.0, "weight_scaler": 1.0, "delta": 1e-5,
+        },
+        "server_config": {
+            "max_iteration": 2, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.05,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "aggregate_median": "softmax", "softmax_beta": 1.0,
+            "val_freq": 2, "initial_val": False,
+            "data_config": {"val": {"batch_size": 8}},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "adamw", "lr": 0.05},
+            "data_config": {"train": {"batch_size": 4}},
+            "quant_thresh": 1e-6, "quant_bits": 8,
+        },
+    })
+    ds = _token_dataset()
+    server = OptimizationServer(bert_task, cfg, ds, val_dataset=ds,
+                                model_dir=str(tmp_path), mesh=make_mesh(),
+                                seed=0)
+    state = server.train()
+    assert state.round == 2
+    assert np.isfinite(float(server.best_val["loss"].value))

@@ -3,6 +3,8 @@ EXACT (int32 group), the aggregate matches plain FedAvg to fixed-point
 resolution, single submissions hide the payload, and the whole protocol
 runs inside the sharded engine round."""
 
+import tempfile
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -16,7 +18,7 @@ from msrflute_tpu.parallel import make_mesh
 from msrflute_tpu.strategies.secure_agg import SecureAgg
 
 
-def _cfg(strategy="secure_agg", users=8, extra_server=None):
+def _cfg(strategy="secure_agg", extra_server=None):
     server = {
         "max_iteration": 2, "num_clients_per_iteration": 6,
         "initial_lr_client": 0.3,
@@ -108,7 +110,6 @@ def test_engine_aggregate_matches_fedavg():
     results = {}
     for strat in ("fedavg", "secure_agg"):
         task = make_task(_cfg().model_config)
-        import tempfile
         with tempfile.TemporaryDirectory() as tmp:
             server = OptimizationServer(task, _cfg(strategy=strat), data,
                                         val_dataset=data, model_dir=tmp,
@@ -119,8 +120,8 @@ def test_engine_aggregate_matches_fedavg():
                              jax.tree.leaves(results["fedavg"])])
     flat_b = np.concatenate([np.ravel(x) for x in
                              jax.tree.leaves(results["secure_agg"])])
-    # two rounds of quantization error: |err| <= K * 0.5 ulp / sum(w)
-    # per round at 2^-16 resolution — far below 1e-4
+    # two rounds of quantization error: |err| <= K * w_max * 0.5 ulp /
+    # sum(w) per round at 2^-12 pre-weight resolution — below 1e-4
     np.testing.assert_allclose(flat_a, flat_b, atol=1e-4)
     assert np.abs(flat_a).max() > 0  # training actually moved
 
@@ -128,7 +129,6 @@ def test_engine_aggregate_matches_fedavg():
 def test_secure_agg_learns():
     data = _data()
     task = make_task(_cfg().model_config)
-    import tempfile
     cfg = _cfg(extra_server={"max_iteration": 8, "val_freq": 8})
     with tempfile.TemporaryDirectory() as tmp:
         server = OptimizationServer(task, cfg, data, val_dataset=data,
