@@ -139,15 +139,18 @@ class OptimizationServer:
         # Requires the dataset to fit in memory (build_sample_pool).
         self._pool_offsets = None
         if bool(cc.data_config.train.get("device_resident", False)):
-            if self.rl is not None or getattr(self.strategy, "host_rounds",
-                                              False):
-                # RL / SCAFFOLD rounds go through the host payload path,
-                # which never consults the pool — uploading the dataset to
-                # HBM would cost memory for zero benefit, silently
+            if self.rl is not None or \
+                    getattr(self.strategy, "host_rounds", False) or \
+                    getattr(self.strategy, "ef_rounds", False):
+                # RL / SCAFFOLD / EF rounds go through the host payload
+                # path, which never consults the pool — uploading the
+                # dataset to HBM would cost memory for zero benefit,
+                # silently
                 raise ValueError(
                     "data_config.train.device_resident does not apply to "
                     "host-orchestrated rounds (wantRL / strategy: "
-                    "scaffold) — drop the flag for this configuration")
+                    "scaffold / strategy: ef_quant) — drop the flag for "
+                    "this configuration")
             from ..data.batching import build_sample_pool
             pool_np, self._pool_offsets = build_sample_pool(train_dataset)
             self.engine.attach_pool(pool_np)
@@ -246,6 +249,27 @@ class OptimizationServer:
                     f"{self.scaffold_store.round()} but the checkpoint "
                     f"resumed at {self.state.round}; resetting controls")
                 self.scaffold_store.reset()
+        # error-feedback quantization residuals (strategies/ef_quant.py):
+        # same durable per-client row-store discipline as the SCAFFOLD
+        # controls — residuals belong to the checkpoint's trajectory
+        self.ef_store = None
+        if getattr(self.strategy, "ef_rounds", False):
+            from ..strategies.ef_quant import ResidualStore
+            n_params = sum(int(np.prod(l.shape))
+                           for l in jax.tree.leaves(self.state.params))
+            self.ef_store = ResidualStore(
+                n_params, store_dir=os.path.join(model_dir, "ef_residuals"),
+                resume=resumed)
+            if resumed and self.ef_store.round() != self.state.round:
+                # residual writes are synchronous but the checkpoint may
+                # land later (async orbax): mismatched trajectories reset
+                # (same marker semantics as the SCAFFOLD controls)
+                print_rank(
+                    f"EF residuals were at round {self.ef_store.round()} "
+                    f"but the checkpoint resumed at {self.state.round}; "
+                    "resetting residuals")
+                self.ef_store.reset()
+
         # device-resident control table (scaffold_device_controls): keep
         # the whole [N, n_params] table in HBM; gather offsets and scatter
         # the option-II update in-program so no model-sized per-round
@@ -372,7 +396,9 @@ class OptimizationServer:
             # controls) share the normal round bookkeeping tail
             host_round = (self._run_rl_round if self.rl is not None else
                           self._run_scaffold_round
-                          if self.scaffold_store is not None else None)
+                          if self.scaffold_store is not None else
+                          self._run_ef_round
+                          if self.ef_store is not None else None)
             if host_round is not None:
                 host_round(round_no)
                 if self.server_replay is not None:
@@ -624,6 +650,10 @@ class OptimizationServer:
                     self.scaffold_store.set_round(int(self.state.round))
             else:
                 self.scaffold_store.set_round(int(self.state.round))
+        if self.ef_store is not None:
+            # same durable-pairing rule as the SCAFFOLD marker above
+            self.ckpt.wait()
+            self.ef_store.set_round(int(self.state.round))
         self.ckpt.update_status({
             "i": round_no,
             "weight": self.lr_weight,
@@ -720,6 +750,65 @@ class OptimizationServer:
         log_metric("Aggregated weights", float(ws_np.sum()), step=round_no)
         log_metric("Control norm (server c)", c_norm,
                    step=round_no)  # latest-checkpoint save: housekeeping
+
+    # ------------------------------------------------------------------
+    def _run_ef_round(self, round_no: int) -> None:
+        """One error-feedback quantized round (``strategies/ef_quant.py``):
+        collect per-client payloads (post local-DP transform), fold in the
+        stored residuals, quantize, aggregate the quantized payloads with
+        the strategy weights, and persist ``corrected - q`` per client."""
+        client_lr, server_lr, batch, rng = self._host_round_setup(round_no)
+        pgs, ws, tls, stats = self.engine.client_payloads(
+            self.state, batch, client_lr, rng,
+            leakage_threshold=self.max_allowed_leakage)
+
+        # per-round threshold annealing (the fused path's quant_anneal
+        # semantics, logged at the same metric name)
+        thresh = self.strategy.next_threshold()
+        if self.strategy.quant_anneal != 1.0:
+            log_metric("Quantization Thresh.", thresh, step=round_no)
+        leaves = jax.tree.leaves(pgs)
+        treedef = jax.tree.structure(pgs)
+        shapes = [l.shape[1:] for l in leaves]
+        sizes = [int(np.prod(sh)) for sh in shapes]
+        if not hasattr(self, "_ef_step_fn"):
+            strategy = self.strategy
+
+            def step(leaves_in, residuals, thresh):
+                flat = jnp.concatenate(
+                    [l.reshape(l.shape[0], -1) for l in leaves_in], axis=1)
+                q, new_res = strategy.ef_step(flat, residuals, thresh)
+                outs, off = [], 0
+                for sh, n in zip(shapes, sizes):
+                    outs.append(q[:, off:off + n].reshape((-1,) + sh))
+                    off += n
+                return outs, new_res
+
+            self._ef_step_fn = jax.jit(step)
+        residuals = self.ef_store.rows(batch.client_ids)
+        # invalidate the marker while residual files mutate: a crash
+        # inside the round window must read as a mismatch on resume
+        self.ef_store.set_round(-1)
+        q_leaves, new_res = self._ef_step_fn(
+            leaves, residuals, jnp.asarray(thresh, jnp.float32))
+        q_tree = jax.tree.unflatten(treedef, q_leaves)
+        self.state = self.engine.apply_custom_weights(self.state, q_tree,
+                                                      ws, server_lr)
+
+        ws_np = np.asarray(jax.device_get(ws))
+        # dropped clients (w == 0) contributed nothing: their residual
+        # must not absorb this round's uncompressed payload
+        keep = (np.asarray(batch.client_ids) >= 0) & (ws_np > 0)
+        self.ef_store.update(batch.client_ids,
+                             np.asarray(jax.device_get(new_res)), keep)
+
+        self._process_privacy_stats(jax.device_get(stats), round_no,
+                                    client_mask=batch.client_mask)
+        tls_np = np.asarray(jax.device_get(tls))
+        n_real = max(float((batch.client_ids >= 0).sum()), 1.0)
+        log_metric("Training loss",
+                   float(tls_np.sum() / n_real), step=round_no)
+        log_metric("Aggregated weights", float(ws_np.sum()), step=round_no)
 
     # ------------------------------------------------------------------
     def _run_rl_round(self, round_no: int) -> None:
@@ -989,6 +1078,11 @@ class OptimizationServer:
                 else:
                     self.scaffold_store.reset()
                 print_rank("reset SCAFFOLD controls after fallback")
+            if self.ef_store is not None:
+                # residuals accumulated since that checkpoint carry the
+                # abandoned trajectory's compression error
+                self.ef_store.reset()
+                print_rank("reset EF residuals after fallback")
 
     def _log_timing(self) -> None:
         """Timing summary (reference ``run_stats``, ``core/server.py:492-521``)

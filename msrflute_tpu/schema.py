@@ -54,6 +54,8 @@ ALLOWED_STRATEGIES = [
     "qffl", "QFFL",
     # net-new: secure aggregation simulation (Bonawitz et al., CCS'17)
     "secure_agg", "secagg", "SecureAgg",
+    # net-new: error-feedback quantization (arXiv:1901.09847)
+    "ef_quant", "efquant", "EFQuant",
 ]
 
 ALLOWED_SERVER_TYPES = [

@@ -33,4 +33,7 @@ def select_strategy(name: str) -> type:
     if key in ("secure_agg", "secagg", "secureagg"):
         from .secure_agg import SecureAgg
         return SecureAgg
+    if key in ("ef_quant", "efquant"):
+        from .ef_quant import EFQuant
+        return EFQuant
     raise ValueError(f"unknown strategy {name!r}")
