@@ -145,3 +145,29 @@ def test_secure_agg_rejects_dp_and_norm_dumps():
     cfg2 = _cfg(extra_server={"dump_norm_stats": True})
     with pytest.raises(ValueError, match="dump_norm_stats"):
         SecureAgg(cfg2)
+
+
+def test_secure_agg_chunked_clients_equivalent():
+    """clients_per_chunk composes with masking: chunk-local int32 sums
+    accumulate across the scan, so pairs SPLIT ACROSS CHUNKS must still
+    cancel — the aggregate has to match the unchunked secure run."""
+    data = _data(users=40)
+    params = {}
+    for chunk in (None, 2):
+        # K=32 on the 8-device mesh -> per-shard grid k_local=4, so
+        # clients_per_chunk=2 genuinely engages the scan path and mask
+        # pairs split across chunks AND shards
+        extra = {"num_clients_per_iteration": 32}
+        if chunk:
+            extra["clients_per_chunk"] = chunk
+        cfg = _cfg(extra_server=extra)
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            server = OptimizationServer(task, cfg, data, val_dataset=data,
+                                        model_dir=tmp, mesh=make_mesh(),
+                                        seed=0)
+            state = server.train()
+        params[chunk] = np.concatenate(
+            [np.ravel(x) for x in jax.tree.leaves(
+                jax.device_get(state.params))])
+    np.testing.assert_allclose(params[None], params[2], atol=1e-6)
