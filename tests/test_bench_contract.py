@@ -163,3 +163,17 @@ def test_wait_budget_subordinate_to_deadline():
     assert (out["extras"].get("backend") == "cpu"
             or "flush_note" in out["extras"]), out["extras"]
     assert took < 120, f"probe wait ignored the caller deadline ({took:.0f}s)"
+    # the CPU fallback's provenance pointer must cite a committed
+    # on-chip capture that CARRIES the headline metric (single-protocol
+    # raw artifacts have value null and make a useless pointer)
+    prior = out["extras"].get("prior_tpu_artifact")
+    if out["extras"].get("backend") == "cpu" and prior is not None:
+        import json as _json
+        with open(os.path.join(REPO, prior["file"])) as fh:
+            cited = _json.load(fh)
+        arts = sorted(os.path.basename(a) for a in
+                      __import__("glob").glob(os.path.join(
+                          REPO, "BENCH_TPU_*.json")))
+        if any(_json.load(open(os.path.join(REPO, a))).get("value")
+               is not None for a in arts):
+            assert cited.get("value") is not None, prior
