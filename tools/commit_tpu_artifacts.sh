@@ -15,6 +15,7 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   bench_bert_varlen.json bench_bert_varlen.err \
   digits_tpu.json digits_tpu.err \
   flash_crossover.json flash_crossover.err \
+  tpu_secagg_ef_tests.log \
   tpu_pallas_attention.log tpu_quant_kernel_probe.log; do
   [ -e "$f" ] && git add -f "$f"
 done

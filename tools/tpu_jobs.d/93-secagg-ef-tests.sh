@@ -1,0 +1,13 @@
+#!/bin/bash
+# On-chip validation of the round-4 net-new strategies: secure
+# aggregation (int32 modular tensordot/psum, fori_loop pairwise masks)
+# and error-feedback quantization (host payload path + jitted EF step).
+# Their CPU tests pass; this proves the TPU lowering of the integer
+# group arithmetic on silicon.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 3000 \
+  python -m pytest tests/test_secure_agg.py tests/test_ef_quant.py \
+  -q -p no:cacheprovider --noconftest > tpu_secagg_ef_tests.log 2>&1
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc
