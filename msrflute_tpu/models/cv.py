@@ -155,8 +155,11 @@ class ClassificationTask(BaseTask):
             "sample_count": jnp.sum(mask),
         }
         if self.with_f1:
-            # per-class tp/fp/fn sums -> macro F1 at finalize (reference
-            # experiments/classif_cnn/model.py custom f1 metric)
+            # per-class tp/fp/fn sums -> F1 at finalize.  The reference
+            # computes sklearn ``f1_score(..., average='micro')`` per
+            # batch (experiments/classif_cnn/model.py:55) — micro, not
+            # macro; global micro from summed tp/fp/fn equals the
+            # reference's sample-weighted batch aggregation exactly
             onehot_true = jax.nn.one_hot(labels, self.num_classes) * mask[..., None]
             onehot_pred = jax.nn.one_hot(pred, self.num_classes) * mask[..., None]
             stats["tp"] = jnp.sum(onehot_true * onehot_pred, axis=0)
@@ -168,8 +171,23 @@ class ClassificationTask(BaseTask):
         metrics = super().finalize_metrics(sums)
         if self.with_f1 and "tp" in sums:
             tp, fp, fn = (jnp.asarray(sums[k]) for k in ("tp", "fp", "fn"))
-            f1 = 2 * tp / jnp.maximum(2 * tp + fp + fn, 1e-8)
-            metrics["f1_score"] = Metric(float(jnp.mean(f1)), higher_is_better=True)
+            # parity: the reference's f1_score is MICRO
+            # (sklearn average='micro', classif_cnn/model.py:55) — from
+            # the global sums, 2*sum(tp)/(2*sum(tp)+sum(fp)+sum(fn))
+            micro = float(2 * jnp.sum(tp) / jnp.maximum(
+                2 * jnp.sum(tp) + jnp.sum(fp) + jnp.sum(fn), 1e-8))
+            metrics["f1_score"] = Metric(micro, higher_is_better=True)
+            # net-new extra: macro (per-class mean) — the fairness-facing
+            # variant micro hides under class imbalance.  sklearn macro
+            # averages only classes OBSERVED in labels or predictions
+            # (2tp+fp+fn > 0); absent classes are excluded, not scored 0
+            denom = 2 * tp + fp + fn
+            f1c = 2 * tp / jnp.maximum(denom, 1e-8)
+            present = (denom > 0).astype(jnp.float32)
+            metrics["f1_macro"] = Metric(
+                float(jnp.sum(f1c * present)
+                      / jnp.maximum(jnp.sum(present), 1.0)),
+                higher_is_better=True)
         return metrics
 
     def make_dataset(self, blob, model_config, split, data_config=None):

@@ -268,3 +268,43 @@ def test_fednewsrec_faithful_arch_through_engine(tmp_path):
     state = server.train()
     assert state.round == 2
     assert "auc" in server.best_val
+
+
+def test_f1_micro_matches_sklearn_reference_semantics():
+    """classif_cnn parity: the reference's metric is sklearn
+    f1_score(average='micro') per batch (model.py:55), aggregated
+    sample-weighted — identical to micro-F1 over the global tp/fp/fn
+    sums.  Cross-check our finalize against sklearn on the same
+    predictions; macro rides along as the net-new extra."""
+    from sklearn.metrics import f1_score as sk_f1
+
+    task = make_task(ModelConfig(model_type="CIFAR_CNN"))
+    params = task.init_params(jax.random.PRNGKey(0))
+    batch = _img_batch(32, 32, 32, 3, 10, key=3)
+    sums = jax.device_get(jax.jit(task.eval_stats)(params, batch))
+    metrics = task.finalize_metrics(sums)
+    logits = task.apply(params, batch["x"])
+    pred = np.asarray(jnp.argmax(logits, axis=-1))
+    y = np.asarray(batch["y"])
+    np.testing.assert_allclose(metrics["f1_score"].value,
+                               sk_f1(y, pred, average="micro"), atol=1e-6)
+    np.testing.assert_allclose(metrics["f1_macro"].value,
+                               sk_f1(y, pred, average="macro"), atol=1e-4)
+
+
+def test_f1_macro_excludes_absent_classes():
+    """sklearn macro semantics: a class in neither labels nor predictions
+    is excluded from the average, not scored zero."""
+    from sklearn.metrics import f1_score as sk_f1
+
+    task = make_task(ModelConfig(model_type="CIFAR_CNN"))
+    # fabricate sums where class 9 never occurs: 9 perfect classes
+    tp = np.zeros(10); tp[:9] = 5
+    sums = {"tp": tp, "fp": np.zeros(10), "fn": np.zeros(10),
+            "loss_sum": np.float32(1.0), "correct": np.float32(45.0),
+            "sample_count": np.float32(45.0)}
+    metrics = task.finalize_metrics(sums)
+    y = np.repeat(np.arange(9), 5)
+    assert metrics["f1_macro"].value == pytest.approx(
+        sk_f1(y, y, average="macro"), abs=1e-6)
+    assert metrics["f1_macro"].value == pytest.approx(1.0, abs=1e-6)
