@@ -177,3 +177,36 @@ def test_wait_budget_subordinate_to_deadline():
         if any(_json.load(open(os.path.join(REPO, a))).get("value")
                is not None for a in arts):
             assert cited.get("value") is not None, prior
+
+
+def test_protocol_geometry_pinned_to_reference():
+    """The comparability contract behind every vs_baseline claim: the
+    bench replays the reference's protocol geometry (10 clients/round —
+    core/server.py sampling; the experiment configs' batch sizes and
+    client LRs; K=10 at `README.md:22-41`'s published wall-clocks).  A
+    drifted geometry would silently invalidate the on-chip speedup
+    table, so pin it."""
+    import importlib.util
+
+    import numpy as np
+    spec = importlib.util.spec_from_file_location("bench_geom", BENCH)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    ps = b.build_protocols(True, np.random.default_rng(0), with_bf16=True)
+    expected = {
+        # protocol: (client batch, client lr)
+        "lr_mnist": (10, 0.03),
+        "cnn_femnist": (20, 0.1),
+        "cnn_femnist_bf16": (20, 0.1),
+        "resnet_fedcifar100": (20, 0.1),
+        "rnn_fedshakespeare": (4, 0.8),
+    }
+    for name, (bs, lr) in expected.items():
+        cfg = ps[name]["cfg"]
+        assert cfg.server_config["num_clients_per_iteration"] == 10, name
+        assert cfg.client_config.data_config.train["batch_size"] == bs, name
+        assert float(cfg.client_config.optimizer_config["lr"]) == lr, name
+        assert cfg.server_config.optimizer_config["type"] == "sgd", name
+        assert float(cfg.server_config.optimizer_config["lr"]) == 1.0, name
+    # headline-first ordering is part of the driver contract
+    assert next(iter(ps)) == "cnn_femnist"
