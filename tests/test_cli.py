@@ -117,3 +117,59 @@ def test_summarize_run_tool(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "Val acc" in proc.stdout and "0.8" in proc.stdout
     assert "Training loss" in proc.stdout
+
+
+def test_cli_secure_agg_and_ef_quant(tmp_path):
+    """The round-4 net-new strategies through the FULL user path:
+    YAML -> schema -> select_strategy -> engine, one CLI run each."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _write_blob(data_dir / "train.json", 12)
+    _write_blob(data_dir / "val.json", 4, seed=1)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    for strategy, server_extra, client_extra in (
+            ("secure_agg", {"secure_agg": {"frac_bits": 12, "clip": 4.0}},
+             {}),
+            ("ef_quant", {}, {"quant_bits": 4})):
+        cfg = {
+            "model_config": {"model_type": "LR", "num_classes": 3,
+                             "input_dim": 6},
+            "strategy": strategy,
+            "server_config": {
+                "max_iteration": 2, "num_clients_per_iteration": 4,
+                "initial_lr_client": 0.3,
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "val_freq": 2, "initial_val": False,
+                "data_config": {"val": {"batch_size": 8,
+                                        "val_data": "val.json"}},
+                **server_extra,
+            },
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": 0.3},
+                "data_config": {"train": {"batch_size": 4,
+                                          "list_of_train_data":
+                                          "train.json"}},
+                **client_extra,
+            },
+        }
+        cfg_path = tmp_path / f"cfg_{strategy}.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg))
+        out_dir = tmp_path / f"out_{strategy}"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "e2e_trainer.py"),
+             "-config", str(cfg_path), "-dataPath", str(data_dir),
+             "-outputPath", str(out_dir), "-task", "cv_lr_mnist"],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, (strategy, proc.stderr[-3000:])
+        status = json.loads(
+            (out_dir / "models" / "status_log.json").read_text())
+        assert status["i"] == 2, strategy
+        if strategy == "ef_quant":
+            stored = list((out_dir / "models" / "ef_residuals").iterdir())
+            assert any(f.name.startswith("residual_") for f in stored)
