@@ -399,6 +399,18 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
     strategy = raw.get("strategy")
     if strategy is not None and strategy not in ALLOWED_STRATEGIES:
         errors.append(f"strategy: {strategy!r} not in {ALLOWED_STRATEGIES}")
+    # cross-field: secure_agg options without the strategy would be
+    # SILENTLY ignored — the user believes masking is on when per-client
+    # payloads flow unmasked (the exact quiet failure this schema exists
+    # to prevent)
+    sc_raw = raw.get("server_config")
+    if isinstance(sc_raw, dict) and sc_raw.get("secure_agg") is not None \
+            and str(strategy or "fedavg").lower() not in (
+                "secure_agg", "secagg", "secureagg"):
+        errors.append(
+            "server_config.secure_agg is set but strategy is "
+            f"{strategy!r} — only strategy: secure_agg reads it; "
+            "payloads would flow UNMASKED")
 
     _check_unknown(unknown, raw, "config", TOP_KEYS)
 

@@ -171,3 +171,13 @@ def test_secure_agg_chunked_clients_equivalent():
             [np.ravel(x) for x in jax.tree.leaves(
                 jax.device_get(state.params))])
     np.testing.assert_allclose(params[None], params[2], atol=1e-6)
+
+
+def test_secure_agg_options_without_strategy_rejected():
+    """secure_agg options under a different strategy would be silently
+    ignored (unmasked payloads while the user believes SecAgg is on) —
+    the schema must reject the combination."""
+    from msrflute_tpu.schema import SchemaError
+    with pytest.raises(SchemaError, match="UNMASKED"):
+        _cfg(strategy="fedavg",
+             extra_server={"secure_agg": {"frac_bits": 12}})
