@@ -48,3 +48,14 @@ Package map:
 """
 
 __version__ = "0.1.0"
+
+# Tunnel-claim guardrail: in agent shells, importing the framework with the
+# ambient axon env (instead of the sanctioned CPU env or a queue job) fails
+# fast, BEFORE anything can dial the single-client TPU relay.  No-op for the
+# round driver and human operators.  ``_guard`` is a leaf module (os-only)
+# so no other package code — and no module-level jax import anywhere in the
+# tree — can initialize a backend before this check runs.
+from msrflute_tpu._guard import guard_tunnel_claim as _guard_tunnel_claim
+
+_guard_tunnel_claim()
+del _guard_tunnel_claim

@@ -1,0 +1,45 @@
+"""Tunnel-claim guardrail (docs/RUNBOOK.md failure mode 4).
+
+Leaf module: imports nothing but ``os`` so the check runs before ANY other
+package code (in particular before ``msrflute_tpu.utils``'s module-level
+imports) — the root ``__init__`` calls it first, and a future module-level
+``import jax`` elsewhere can never beat it to backend initialization.
+Re-exported as ``utils.backend.guard_tunnel_claim``.
+"""
+
+import os
+
+
+def guard_tunnel_claim() -> None:
+    """Refuse to run toward the single-client TPU tunnel from an agent shell.
+
+    Round 4 lost a six-hour chip window because an interactively launched
+    ``python`` (ambient axon env) was killed mid-claim and the stale claim
+    wedged the relay (docs/RUNBOOK.md failure mode 4).  The queue runner
+    (``tools/tpu_runner.sh``) is the only sanctioned path to the chip from
+    an agent shell; it marks its jobs with ``MSRFLUTE_CHIP_JOB=1``.
+
+    Fires only in agent shells (``CLAUDECODE`` / ``AI_AGENT`` env markers):
+    the round driver and human operators run without those and are never
+    blocked.  The unsafe shape is a non-empty ``PALLAS_AXON_POOL_IPS`` —
+    sitecustomize registers the axon plugin from that alone — unless
+    ``JAX_PLATFORMS`` explicitly names an axon-free platform (an UNSET
+    ``JAX_PLATFORMS`` lets jax auto-select the registered plugin).
+    """
+    if os.environ.get("MSRFLUTE_CHIP_JOB") == "1":
+        return
+    if not (os.environ.get("CLAUDECODE") or os.environ.get("AI_AGENT")):
+        return
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip()
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and \
+            (not platforms or "axon" in platforms):
+        raise RuntimeError(
+            "refusing to initialize the axon TPU backend from an agent "
+            "shell: the tunnel is single-client and a killed claimant "
+            "wedges it (docs/RUNBOOK.md failure mode 4).  For local work "
+            "use the CPU env -- `tools/py <script>` or `env "
+            "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 python ...`.  Chip "
+            "work goes through the queue: append a job to "
+            "tools/tpu_jobs.d/ and let tools/tpu_runner.sh run it."
+        )

@@ -45,6 +45,12 @@ def force_cpu_backend(n_devices: Optional[int] = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+# Canonical home of the tunnel-claim guardrail is the leaf module
+# ``msrflute_tpu._guard`` (so the root __init__ can run it before any other
+# package code); re-exported here next to its sibling backend disciplines.
+from msrflute_tpu._guard import guard_tunnel_claim  # noqa: F401
+
+
 def enable_compilation_cache(cache_dir: str) -> bool:
     """Turn on jax's persistent XLA compilation cache (best-effort: an
     unwritable path must not abort a training run — it only forfeits the

@@ -1,0 +1,77 @@
+"""Tunnel-claim guardrail contract (docs/RUNBOOK.md failure mode 4).
+
+Round 4 lost a six-hour chip window when an interactively launched python
+with the ambient axon env was killed mid-claim and wedged the single-client
+relay.  The guard (``utils/backend.py::guard_tunnel_claim``, invoked on
+``import msrflute_tpu``) must:
+
+- refuse the import in an agent shell with the ambient axon env,
+- pass for queue-runner jobs (``MSRFLUTE_CHIP_JOB=1``),
+- pass for the round driver / humans (no agent env markers),
+- pass for any shell that set the sanctioned CPU env.
+
+Each case runs in a subprocess with a constructed environment.  PYTHONPATH
+is stripped so the system axon sitecustomize never runs — the guard reads
+only env vars, which is the point: it fires before anything can dial the
+relay.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_rc(extra_env):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "CLAUDECODE", "AI_AGENT",
+                        "MSRFLUTE_CHIP_JOB", "PALLAS_AXON_POOL_IPS",
+                        "JAX_PLATFORMS")}
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, "-c", "import msrflute_tpu"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    return proc.returncode, proc.stderr
+
+
+AXON_ENV = {"PALLAS_AXON_POOL_IPS": "127.0.0.1", "JAX_PLATFORMS": "axon"}
+
+
+def test_agent_shell_with_axon_env_refused():
+    rc, err = _import_rc({**AXON_ENV, "CLAUDECODE": "1"})
+    assert rc != 0
+    assert "single-client" in err and "tpu_jobs.d" in err
+
+
+def test_ai_agent_marker_alone_refused():
+    rc, err = _import_rc({**AXON_ENV, "AI_AGENT": "1"})
+    assert rc != 0
+    assert "refusing to initialize the axon TPU backend" in err
+
+
+def test_pool_ips_with_unset_jax_platforms_refused():
+    # The most dangerous ambient shape: sitecustomize registers the axon
+    # plugin from PALLAS_AXON_POOL_IPS alone, and an UNSET JAX_PLATFORMS
+    # lets jax auto-select the registered plugin.
+    rc, err = _import_rc(
+        {"PALLAS_AXON_POOL_IPS": "127.0.0.1", "CLAUDECODE": "1"})
+    assert rc != 0
+    assert "refusing to initialize the axon TPU backend" in err
+
+
+def test_queue_job_marker_sanctions_the_claim():
+    rc, err = _import_rc(
+        {**AXON_ENV, "CLAUDECODE": "1", "MSRFLUTE_CHIP_JOB": "1"})
+    assert rc == 0, err
+
+
+def test_driver_without_agent_markers_unblocked():
+    rc, err = _import_rc(AXON_ENV)
+    assert rc == 0, err
+
+
+def test_agent_shell_with_cpu_env_unblocked():
+    rc, err = _import_rc(
+        {"CLAUDECODE": "1", "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    assert rc == 0, err

@@ -6,6 +6,11 @@
 # (marker: <job>.done holding the exit code).  Append jobs while running.
 cd /root/repo
 log(){ echo "[tpu_runner $(date +%H:%M:%S)] $*" >> tpu_runner.log; }
+# Sanction this process tree to claim the tunnel: the framework's
+# tunnel-claim guardrail (utils/backend.py::guard_tunnel_claim) refuses
+# axon init in agent shells UNLESS this marker is set, so queue jobs are
+# the only agent-launched path to the chip.
+export MSRFLUTE_CHIP_JOB=1
 # Probe with a timeout: while a stale claim is pending server-side a
 # probe HANGS instead of failing fast (observed live round 4), and a
 # timeout-less probe then blocks the whole runner loop.  SIGTERM only —
