@@ -226,6 +226,20 @@ class OptimizationServer:
                 status = self.ckpt.read_status()
                 self.lr_weight = float(status.get("weight", 1.0))
                 print_rank(f"resumed from checkpoint at round {self.state.round}")
+                # fast-forward the quantization-threshold annealing to the
+                # resumed round: the schedule is a pure geometric series
+                # (thresh_R = thresh_0 * anneal^R), but the running value
+                # lives only in memory — without this, a resume restarts
+                # the anneal from the config value and the post-resume
+                # trajectory diverges from an uninterrupted run (both the
+                # fused path's self.quant_thresh and the EF strategy's own
+                # copy, strategies/ef_quant.py::next_threshold)
+                if self.state.round > 0 and self.quant_anneal != 1.0:
+                    ff = self.quant_anneal ** self.state.round
+                    if self.quant_thresh is not None:
+                        self.quant_thresh = float(self.quant_thresh) * ff
+                    if getattr(self.strategy, "ef_rounds", False):
+                        self.strategy.quant_thresh *= ff
 
         # SCAFFOLD control variates (strategies/scaffold.py): host-side
         # store under the model dir.  Controls are reloaded ONLY when the
@@ -292,6 +306,29 @@ class OptimizationServer:
             print_rank(f"SCAFFOLD device control table: "
                        f"{self.scaffold_device.n_rows} x "
                        f"{self.scaffold_store.n_params} ({gb:.2f} GiB HBM)")
+
+        # device-resident EF residual table (ef_device_residuals): same
+        # transfer-vs-HBM tradeoff as the SCAFFOLD table — the per-round
+        # [K, n_params] residual matrix stops crossing the host boundary
+        # in either direction (strategies/ef_quant.py DeviceResidualTable).
+        # Built AFTER the resume/reset decision so it warms from exactly
+        # the residuals the run keeps.
+        self.ef_device = None
+        if sc.get("ef_device_residuals", False):
+            if self.ef_store is None:
+                raise ValueError(
+                    "server_config.ef_device_residuals requires "
+                    "strategy: ef_quant — with "
+                    f"{type(self.strategy).__name__} there are no "
+                    "residuals to keep on device; drop the flag")
+            from ..strategies.ef_quant import DeviceResidualTable
+            self.ef_device = DeviceResidualTable(
+                self.ef_store, len(train_dataset), self.mesh)
+            gb = 4.0 * self.ef_device.n_rows * \
+                self.ef_store.n_params / 2**30
+            print_rank(f"EF device residual table: "
+                       f"{self.ef_device.n_rows} x "
+                       f"{self.ef_store.n_params} ({gb:.2f} GiB HBM)")
 
     # ------------------------------------------------------------------
     def _sample(self) -> list:
@@ -653,7 +690,19 @@ class OptimizationServer:
         if self.ef_store is not None:
             # same durable-pairing rule as the SCAFFOLD marker above
             self.ckpt.wait()
-            self.ef_store.set_round(int(self.state.round))
+            if self.ef_device is not None:
+                # mirror the scaffold_flush_freq tradeoff: between flushes
+                # the marker stays at the -1 sentinel, so a stop inside
+                # the window resets ALL residuals on resume (graceful —
+                # EF degrades to memoryless for one participation)
+                flush_freq = int(self.config.server_config.get(
+                    "ef_flush_freq", 1) or 1)
+                final = round_no >= self._max_iteration
+                if flush_freq <= 1 or round_no % flush_freq == 0 or final:
+                    self.ef_device.flush()
+                    self.ef_store.set_round(int(self.state.round))
+            else:
+                self.ef_store.set_round(int(self.state.round))
         self.ckpt.update_status({
             "i": round_no,
             "weight": self.lr_weight,
@@ -758,6 +807,18 @@ class OptimizationServer:
         stored residuals, quantize, aggregate the quantized payloads with
         the strategy weights, and persist ``corrected - q`` per client."""
         client_lr, server_lr, batch, rng = self._host_round_setup(round_no)
+        # the residual store keeps ONE row per client: a duplicate id in a
+        # round batch would aggregate both quantized payloads but keep only
+        # the last slot's residual, silently losing the other occurrence's
+        # compression error.  Sampling is without replacement, so this is
+        # a contract check, not a code path.
+        real_ids = np.asarray(batch.client_ids)
+        real_ids = real_ids[real_ids >= 0]
+        if len(np.unique(real_ids)) != len(real_ids):
+            raise ValueError(
+                "ef_quant round batch contains duplicate client ids "
+                f"({sorted(real_ids.tolist())}); per-client EF residuals "
+                "require without-replacement sampling")
         pgs, ws, tls, stats = self.engine.client_payloads(
             self.state, batch, client_lr, rng,
             leakage_threshold=self.max_allowed_leakage)
@@ -785,7 +846,9 @@ class OptimizationServer:
                 return outs, new_res
 
             self._ef_step_fn = jax.jit(step)
-        residuals = self.ef_store.rows(batch.client_ids)
+        residuals = (self.ef_device.rows(batch.client_ids)
+                     if self.ef_device is not None else
+                     self.ef_store.rows(batch.client_ids))
         # invalidate the marker while residual files mutate: a crash
         # inside the round window must read as a mismatch on resume
         self.ef_store.set_round(-1)
@@ -796,11 +859,16 @@ class OptimizationServer:
                                                       ws, server_lr)
 
         ws_np = np.asarray(jax.device_get(ws))
-        # dropped clients (w == 0) contributed nothing: their residual
-        # must not absorb this round's uncompressed payload
-        keep = (np.asarray(batch.client_ids) >= 0) & (ws_np > 0)
-        self.ef_store.update(batch.client_ids,
-                             np.asarray(jax.device_get(new_res)), keep)
+        if self.ef_device is not None:
+            # new_res and ws stay on device; the scatter gates on
+            # participation (id >= 0, w > 0) in-program
+            self.ef_device.update(batch.client_ids, new_res, ws, ws_np)
+        else:
+            # dropped clients (w == 0) contributed nothing: their residual
+            # must not absorb this round's uncompressed payload
+            keep = (np.asarray(batch.client_ids) >= 0) & (ws_np > 0)
+            self.ef_store.update(batch.client_ids,
+                                 np.asarray(jax.device_get(new_res)), keep)
 
         self._process_privacy_stats(jax.device_get(stats), round_no,
                                     client_mask=batch.client_mask)
@@ -1081,7 +1149,10 @@ class OptimizationServer:
             if self.ef_store is not None:
                 # residuals accumulated since that checkpoint carry the
                 # abandoned trajectory's compression error
-                self.ef_store.reset()
+                if self.ef_device is not None:
+                    self.ef_device.reset()  # also resets the store
+                else:
+                    self.ef_store.reset()
                 print_rank("reset EF residuals after fallback")
 
     def _log_timing(self) -> None:

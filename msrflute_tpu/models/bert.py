@@ -52,6 +52,29 @@ class BertMLMTask(BaseTask):
             training_cfg.get("label_smoothing_factor", 0.0))
         self.mask_token_id = int(bert_cfg.get("mask_token_id", 103))
         self.premasked = bool(bert_cfg.get("premasked", False))
+        # MLM head mode: "full" projects every position into vocab space
+        # (HF semantics); "gathered" projects ONLY the masked positions —
+        # MLM loss reads ~mlm_probability of positions, so the full-vocab
+        # logits tensor ([B, L, V] f32, the model's dominant HBM traffic
+        # AND a large FLOP share) shrinks by ~1/p.  See _gather_masked.
+        self.mlm_head = str(bert_cfg.get("mlm_head", "full")).lower()
+        if self.mlm_head not in ("full", "gathered"):
+            raise ValueError(
+                f"BERT.model.mlm_head must be 'full' or 'gathered', "
+                f"got {self.mlm_head!r}")
+        # static per-sequence slot budget for the gathered head: 2x the
+        # expected Binomial(L, p) masked count (≈5 sigma at L=128, p=.15)
+        # rounded up to a lane-friendly multiple of 8
+        default_slots = int(
+            -(-(self.seq_len * self.mlm_probability * 2.0) // 8) * 8)
+        self.gathered_slots = int(
+            bert_cfg.get("gathered_slots",
+                         min(max(default_slots, 8), self.seq_len)))
+        if not 1 <= self.gathered_slots <= self.seq_len:
+            raise ValueError(
+                f"BERT.model.gathered_slots must be in [1, "
+                f"{self.seq_len}] (seq_len), got {self.gathered_slots} — "
+                "0 slots would silently train on an empty loss")
         from .base import parse_dtype
         # compute dtype (bf16 MXU path; HF Flax threads it through every
         # layer — params stay f32, logits are upcast in the loss)
@@ -96,21 +119,112 @@ class BertMLMTask(BaseTask):
             jnp.broadcast_to(jnp.arange(self.seq_len), (1, self.seq_len)),
             None, deterministic=True, return_dict=False)["params"]
 
-    def _logits(self, params, input_ids, attention_mask, deterministic=True,
-                rng=None):
+    def _apply(self, params, input_ids, attention_mask, deterministic=True,
+               rng=None, output_hidden_states=False):
         rngs = {"dropout": rng} if rng is not None else {}
-        out = self.model.module.apply(
+        return self.model.module.apply(
             {"params": params}, input_ids, attention_mask,
             jnp.zeros_like(input_ids),
             jnp.broadcast_to(jnp.arange(input_ids.shape[-1]),
                              input_ids.shape),
-            None, deterministic=deterministic, return_dict=True, rngs=rngs)
+            None, deterministic=deterministic,
+            output_hidden_states=output_hidden_states, return_dict=True,
+            rngs=rngs)
+
+    def _logits(self, params, input_ids, attention_mask, deterministic=True,
+                rng=None):
+        out = self._apply(params, input_ids, attention_mask,
+                          deterministic=deterministic, rng=rng)
         # f32 logits regardless of compute dtype (bf16 matmuls, f32 xent)
         return out.logits.astype(jnp.float32)
 
     def apply(self, params, input_ids):
         return self._logits(params, input_ids.astype(jnp.int32),
                             jnp.ones_like(input_ids, jnp.int32))
+
+    # ------------------------------------------------------------------
+    # gathered MLM head: encoder hidden states -> vocab logits at masked
+    # positions only
+    # ------------------------------------------------------------------
+    def _hidden_states(self, params, input_ids, attention_mask,
+                       deterministic=True, rng=None):
+        """Final-layer encoder hidden states ``[B, L, H]`` (the tensor the
+        HF cls head consumes), without running the vocab projection."""
+        out = self._apply(params, input_ids, attention_mask,
+                          deterministic=deterministic, rng=rng,
+                          output_hidden_states=True)
+        return out.hidden_states[-1]
+
+    def _head_params(self, params):
+        """The HF Flax BertForMaskedLM head leaves (transform dense +
+        LayerNorm, decoder kernel, decoder bias).  With
+        ``tie_word_embeddings`` (the BERT default) the decoder kernel is
+        the word-embedding matrix transposed; an UNTIED checkpoint stores
+        its own ``cls/predictions/decoder/kernel``, which takes
+        precedence.  Raises with the actual tree layout on mismatch so a
+        transformers version bump fails loudly, not with a silent wrong
+        projection."""
+        try:
+            pred = params["cls"]["predictions"]
+            dense = pred["transform"]["dense"]
+            ln = pred["transform"]["LayerNorm"]
+            decoder = pred.get("decoder", {})
+            if "kernel" in decoder:
+                kernel = decoder["kernel"]          # untied checkpoint
+            elif getattr(self.config, "tie_word_embeddings", True):
+                kernel = params["bert"]["embeddings"][
+                    "word_embeddings"]["embedding"].T
+            else:
+                raise KeyError(
+                    "'cls/predictions/decoder/kernel' (config says "
+                    "tie_word_embeddings=False but no decoder kernel "
+                    "is stored)")
+            bias = pred["bias"]
+        except KeyError as exc:
+            raise ValueError(
+                "unexpected FlaxBertForMaskedLM param layout (missing "
+                f"{exc}); the gathered MLM head mirrors cls/predictions/"
+                "{transform,decoder,bias} — fix _head_params for this "
+                "transformers version or use mlm_head: full") from exc
+        return dense, ln, kernel, bias
+
+    def _mlm_head_logits(self, params, hidden):
+        """Apply the MLM head to ``hidden [..., H]`` exactly as HF's
+        ``FlaxBertLMPredictionHead`` does (dense -> activation ->
+        LayerNorm -> tied-embedding decoder + bias), in the model's
+        compute dtype with f32 logits out."""
+        from transformers.modeling_flax_utils import ACT2FN
+        dense, ln, kernel, bias = self._head_params(params)
+        dtype = self.model.dtype
+        h = hidden.astype(dtype) @ dense["kernel"].astype(dtype) \
+            + dense["bias"].astype(dtype)
+        h = ACT2FN[self.config.hidden_act](h)
+        # HF FlaxBertPredictionHeadTransform LayerNorm (eps from config)
+        mean = jnp.mean(h.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(h.astype(jnp.float32), axis=-1, keepdims=True)
+        h = ((h.astype(jnp.float32) - mean)
+             * jax.lax.rsqrt(var + self.config.layer_norm_eps))
+        h = h.astype(dtype) * ln["scale"].astype(dtype) \
+            + ln["bias"].astype(dtype)
+        logits = h @ kernel.astype(dtype)
+        return logits.astype(jnp.float32) + bias.astype(jnp.float32)
+
+    def _gather_masked(self, hidden, labels):
+        """Pack each sequence's masked positions (label != -100) into a
+        static ``[B, gathered_slots]`` window, selected-first in original
+        order (stable sort).  The Binomial(L, p) masked count exceeds the
+        2x-mean slot budget with ~5-sigma rarity; overflow positions are
+        DROPPED from the loss (documented deviation of the gathered mode;
+        raise ``gathered_slots`` to trade memory for exactness — at
+        ``gathered_slots == seq_len`` the mode is exact)."""
+        m = self.gathered_slots
+        sel = labels != -100
+        idx = jnp.argsort(~sel, axis=-1, stable=True)[:, :m]
+        g_labels = jnp.where(
+            jnp.take_along_axis(sel, idx, axis=1),
+            jnp.take_along_axis(labels, idx, axis=1), -100)
+        g_hidden = jnp.take_along_axis(hidden, idx[..., None], axis=1)
+        return g_hidden, g_labels
 
     # ------------------------------------------------------------------
     def _mlm_mask(self, rng, input_ids, attention_mask):
@@ -133,13 +247,19 @@ class BertMLMTask(BaseTask):
 
     def _masked_xent(self, logits, labels):
         """Label-smoothed CE over positions with label != -100 (HF
-        LabelSmoother semantics)."""
+        LabelSmoother semantics), in logsumexp form:
+        ``-logp[y] = lse(logits) - logits[y]`` and
+        ``-mean(logp) = lse - mean(logits)`` — mathematically identical
+        to ``log_softmax`` + gather but never materializes the
+        ``[..., V]`` log-prob tensor, which for a 30k vocab is the
+        loss's dominant HBM traffic."""
         valid = labels != -100
         safe = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        at = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = lse - at
         if self.label_smoothing > 0:
-            smooth = -jnp.mean(logp, axis=-1)
+            smooth = lse - jnp.mean(logits, axis=-1)
             nll = (1 - self.label_smoothing) * nll + self.label_smoothing * smooth
         return nll, valid.astype(jnp.float32)
 
@@ -182,10 +302,18 @@ class BertMLMTask(BaseTask):
                 .astype(attention_mask.dtype)
             masked_ids, labels = self._mlm_mask(mask_rng, input_ids,
                                                 attention_mask)
-        logits = self._logits(params, masked_ids, attention_mask,
-                              deterministic=not train,
-                              rng=drop_rng if train else None)
-        nll, valid = self._masked_xent(logits, labels)
+        if self.mlm_head == "gathered":
+            hidden = self._hidden_states(params, masked_ids, attention_mask,
+                                         deterministic=not train,
+                                         rng=drop_rng if train else None)
+            g_hidden, g_labels = self._gather_masked(hidden, labels)
+            logits = self._mlm_head_logits(params, g_hidden)
+            nll, valid = self._masked_xent(logits, g_labels)
+        else:
+            logits = self._logits(params, masked_ids, attention_mask,
+                                  deterministic=not train,
+                                  rng=drop_rng if train else None)
+            nll, valid = self._masked_xent(logits, labels)
         loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
         return loss, {
             "sample_count": jnp.sum(batch["sample_mask"]),
@@ -209,7 +337,12 @@ class BertMLMTask(BaseTask):
             # deterministic eval masking so metrics are reproducible
             masked_ids, labels = self._mlm_mask(jax.random.PRNGKey(1234),
                                                 input_ids, attention_mask)
-        logits = self._logits(params, masked_ids, attention_mask)
+        if self.mlm_head == "gathered":
+            hidden = self._hidden_states(params, masked_ids, attention_mask)
+            g_hidden, labels = self._gather_masked(hidden, labels)
+            logits = self._mlm_head_logits(params, g_hidden)
+        else:
+            logits = self._logits(params, masked_ids, attention_mask)
         nll, valid = self._masked_xent(logits, labels)
         pred = jnp.argmax(logits, axis=-1)
         correct = (pred == jnp.where(labels == -100, -1, labels)).astype(

@@ -170,15 +170,30 @@ class RingLMTask(_TokenDatasetMixin, SequenceLMTask):
 
     tokenizer = "chars"
 
+    #: the raw ``model_config.flash_attention`` value (bool or "auto"),
+    #: kept so sequence-parallel cloning can RE-resolve "auto" against the
+    #: per-device sequence length — the crossover constant is calibrated
+    #: per device, and under SP each shard sees only L/shards tokens
+    flash_flag = None
+
     def sp_module(self, mesh: Mesh, seq_axis: str = "sequence",
                   batch_axis: Optional[str] = None,
                   expert_axis: Optional[str] = None) -> _RingLM:
         """Clone into sequence-parallel mode; ``expert_axis`` additionally
         engages expert-parallel MoE dispatch on that mesh axis (requires
         ``moe_experts == mesh.shape[expert_axis]``)."""
-        return self.module.clone(ring_mesh=mesh, seq_axis=seq_axis,
-                                 batch_axis=batch_axis,
-                                 moe_ep_axis=expert_axis)
+        kwargs = dict(ring_mesh=mesh, seq_axis=seq_axis,
+                      batch_axis=batch_axis, moe_ep_axis=expert_axis)
+        if isinstance(self.flash_flag, str):
+            # "auto" was resolved against the GLOBAL length at task build;
+            # under sequence parallelism the kernel runs on per-device
+            # blocks of L/shards, which is the length the crossover was
+            # measured at — re-resolve so 'auto' cannot pick flash in the
+            # regime where dense measured faster
+            shards = int(mesh.shape[seq_axis])
+            kwargs["use_flash"] = _resolve_flash(
+                self.flash_flag, max(self.module.max_len // shards, 1))
+        return self.module.clone(**kwargs)
 
 
 #: dense/flash crossover: below this per-device sequence length XLA's
@@ -186,8 +201,10 @@ class RingLMTask(_TokenDatasetMixin, SequenceLMTask):
 #: fwd+bwd wall time (committed `bench_tpu_longctx.json`: flash_speedup
 #: 0.83-0.93 at L=2048); above it flash's O(L) VMEM streaming wins and
 #: dense's O(L^2) score materialization eventually cannot fit at all.
-#: Calibrated against `flash_crossover.json` (tools/
-#: flash_crossover_sweep.py) when the sweep artifact is present.
+#: The constant is STATIC — nothing reads a sweep artifact at runtime; it
+#: was chosen from the committed L=2048 measurements and is re-derived by
+#: hand from `flash_crossover.json` (tools/flash_crossover_sweep.py)
+#: whenever a new sweep lands.
 FLASH_AUTO_MIN_LEN = 4096
 
 
@@ -218,7 +235,9 @@ def make_ringlm_task(model_config) -> RingLMTask:
         moe_experts=int(model_config.get("moe_experts", 0) or 0),
         use_flash=_resolve_flash(
             model_config.get("flash_attention", False), seq_len - 1))
-    return RingLMTask(module, seq_len=seq_len, name="ringlm")
+    task = RingLMTask(module, seq_len=seq_len, name="ringlm")
+    task.flash_flag = model_config.get("flash_attention", False)
+    return task
 
 
 def build_sp_train_step(task: RingLMTask, mesh: Mesh,

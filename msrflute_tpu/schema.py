@@ -135,6 +135,7 @@ SERVER_KEYS = {
     # TPU-native extensions
     "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir", "secure_agg",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
+    "ef_device_residuals", "ef_flush_freq",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
@@ -191,6 +192,8 @@ SERVER_FIELD_SPECS = {
     "clients_per_chunk": ("int", 1, None),
     "model_backup_freq": ("int", 1, None),
     "scaffold_flush_freq": ("int", 1, None),
+    "ef_device_residuals": ("bool", None, None),
+    "ef_flush_freq": ("int", 1, None),
     "qffl_q": ("num", 0, None),
 }
 

@@ -65,6 +65,9 @@ class ResidualStore:
         self.n_params = int(n_params)
         self.store_dir = store_dir
         self._rows: Dict[int, np.ndarray] = {}
+        #: residual rows dropped by store-less eviction (each drop degrades
+        #: that client to memoryless quantization for its next round)
+        self.dropped_rows = 0
         if store_dir is not None:
             os.makedirs(store_dir, exist_ok=True)
             if not resume:
@@ -76,10 +79,18 @@ class ResidualStore:
         return os.path.join(self.store_dir, f"residual_{cid}.npy")
 
     def _evict(self) -> None:
-        if self.store_dir is None:
-            return
+        # RAM is bounded in BOTH modes.  With a disk store eviction is
+        # free (the durable copy is the record).  Without one there is
+        # nowhere to spill: evicting DROPS the LRU client's residual —
+        # that client quantizes memorylessly next time (the EF guarantee
+        # degrades gracefully, never the aggregate's correctness).  The
+        # server always runs with a store_dir; store-less mode is the
+        # library/test path, where unbounded growth past _MAX_RESIDENT
+        # rows of n_params f32 would be the worse failure.
         while len(self._rows) > self._MAX_RESIDENT:
             self._rows.pop(next(iter(self._rows)))
+            if self.store_dir is None:
+                self.dropped_rows += 1
 
     def _touch(self, cid: int, row: np.ndarray) -> None:
         # true LRU: re-insert at the tail on every read AND write, like
@@ -146,6 +157,133 @@ class ResidualStore:
             for name in os.listdir(self.store_dir):
                 if name.startswith("residual_"):
                     os.remove(os.path.join(self.store_dir, name))
+
+    def persisted_client_ids(self):
+        """Client ids with a durable residual file (device-table warm-up)."""
+        if self.store_dir is None:
+            return sorted(self._rows)
+        ids = []
+        for name in os.listdir(self.store_dir):
+            if name.startswith("residual_") and name.endswith(".npy"):
+                key = name[len("residual_"):-len(".npy")]
+                if key.lstrip("-").isdigit():
+                    ids.append(int(key))
+        return sorted(ids)
+
+
+class DeviceResidualTable:
+    """HBM-resident EF residuals (``server_config.ef_device_residuals``).
+
+    The host ``ResidualStore`` path materializes a dense ``[K, n_params]``
+    f32 matrix on the host every EF round and ships it to the device (and
+    the new residuals back) — at BERT scale that is GB-class host traffic
+    per round, the exact transfer profile the SCAFFOLD
+    ``DeviceControlTable`` was built to kill.  This is the same cure on
+    the same pattern: the full ``[N_clients, n_params]`` residual table
+    lives in HBM sharded over the clients mesh axis; per round
+
+    - ``rows(ids)`` gathers the K sampled residual rows as a
+      client-sharded device array that feeds the jitted EF step directly,
+    - ``update(...)`` scatters the step's new-residual output (already a
+      device array) back in-program with the table buffer donated —
+      participation-gated (id >= 0 and aggregation weight > 0) with
+      out-of-bounds drop for padding slots,
+
+    so the ROUND PATH no longer stages residuals through the host in
+    either direction.  Durability: the wrapped :class:`ResidualStore`
+    stays the format of record; dirty rows flush through when the
+    residual-round marker commits — and that flush is itself a
+    ``[K, n_params]`` fetch + K file writes, so at the default
+    ``ef_flush_freq: 1`` roughly half of the host traffic remains.  The
+    full transfer win needs ``ef_flush_freq > 1`` (amortizes the flush;
+    the rounds in between keep the -1 marker sentinel, so a crash inside
+    the window resets ALL residuals on resume — the same
+    durability-vs-transfer tradeoff as ``scaffold_flush_freq``).  HBM
+    cost is ``4·N·n_params`` bytes — worth it when per-round residual
+    transfers dominate, not when the client pool is huge and the model
+    small.
+    """
+
+    def __init__(self, store: ResidualStore, n_clients: int, mesh):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import CLIENTS_AXIS
+
+        self.store = store
+        self.n_clients = int(n_clients)
+        axis = int(mesh.shape[CLIENTS_AXIS])
+        # pad rows to shard evenly; padding rows are never gathered
+        # (valid ids < N) and scatters to them drop out of bounds
+        self.n_rows = ((self.n_clients + axis - 1) // axis) * axis
+        self._row_sharding = NamedSharding(mesh, P(CLIENTS_AXIS, None))
+        self._rep = NamedSharding(mesh, P())
+        n_rows, n_params = self.n_rows, store.n_params
+        self._zeros = jax.jit(
+            lambda: jnp.zeros((n_rows, n_params), jnp.float32),
+            out_shardings=self._row_sharding)
+        self.table = self._zeros()
+        self._scatter = jax.jit(
+            lambda t, i, v: t.at[i].set(v), donate_argnums=(0,),
+            out_shardings=self._row_sharding)
+        warm = [cid for cid in store.persisted_client_ids()
+                if 0 <= cid < self.n_clients]
+        for lo in range(0, len(warm), 512):
+            chunk = warm[lo:lo + 512]
+            rows = store.rows(np.asarray(chunk, np.int64))
+            self.table = self._scatter(
+                self.table, jnp.asarray(chunk, jnp.int32),
+                jax.device_put(rows, self._rep))
+        self._dirty = set()
+
+        def gather_fn(table, ids):
+            rows = table[jnp.clip(ids, 0, n_rows - 1)]
+            valid = (ids >= 0).astype(jnp.float32)[:, None]
+            return rows * valid
+
+        self._gather = jax.jit(gather_fn, out_shardings=self._row_sharding)
+
+        def update_fn(table, ids, new_res, ws):
+            valid = (ids >= 0) & (ws > 0.0)
+            return table.at[jnp.where(valid, ids, n_rows)].set(
+                new_res, mode="drop")
+
+        self._update = jax.jit(
+            update_fn, donate_argnums=(0,),
+            out_shardings=self._row_sharding)
+
+    def rows(self, client_ids):
+        """Client-sharded ``[K, n_params]`` residual rows (zeros for
+        padding ids) — a device array, no host staging."""
+        import jax.numpy as jnp
+        return self._gather(self.table,
+                            jnp.asarray(np.asarray(client_ids), jnp.int32))
+
+    def update(self, client_ids, new_res, ws, ws_np) -> None:
+        """Scatter the EF step's new residuals in-program.  ``new_res``
+        and ``ws`` stay on device; ``ws_np`` (fetched for logging anyway)
+        only marks dirty rows for ``flush()``."""
+        import jax.numpy as jnp
+        ids_np = np.asarray(client_ids)
+        self.table = self._update(
+            self.table, jnp.asarray(ids_np, jnp.int32), new_res, ws)
+        for row, cid in enumerate(ids_np):
+            if int(cid) >= 0 and float(ws_np[row]) > 0.0:
+                self._dirty.add(int(cid))
+
+    def flush(self) -> None:
+        """Write dirty rows through to the durable ResidualStore."""
+        if self._dirty:
+            ids = np.asarray(sorted(self._dirty), np.int32)
+            rows = np.asarray(jax.device_get(self.table[ids]))
+            self.store.update(ids, rows, np.ones(len(ids), bool))
+            self._dirty.clear()
+
+    def reset(self) -> None:
+        """Zero table + durable store (fallback semantics)."""
+        self.table = self._zeros()
+        self._dirty.clear()
+        self.store.reset()
 
 
 class EFQuant(FedAvg):

@@ -33,12 +33,25 @@ simulated property is the aggregate-only dataflow: the summed payload
 is the ONLY place client updates become visible, which is the invariant
 SecAgg research composes against.
 
+**Mask graph**: ``graph: "full"`` (default) pairs every two cohort
+members — O(K²) mask generations per round, the CCS'17 baseline.
+``graph: "log"`` is the log-degree topology of Bell et al. (CCS'20,
+"Secure Single-Server Aggregation with (Poly)Logarithmic Overhead"):
+each cohort slot masks only toward slots at circulant offsets
+``±2^t mod K``, so the per-round cost drops to O(K·log K) mask trees
+while the offset set's closure under negation keeps every edge
+symmetric — the cohort sum still telescopes to zero exactly.  The
+hiding argument weakens from "any K-1 colluders" to "each client has
+at least one honest present neighbor", the standard log-degree
+tradeoff; for the aggregate-only dataflow this simulation exists to
+study, the sums are identical (tested bit-for-bit against "full").
+
 Config (``server_config.secure_agg``, bool or dict; weighting
 semantics stay FedAvg's)::
 
     strategy: secure_agg
     server_config:
-      secure_agg: {frac_bits: 12, clip: 4.0, seed: 0}
+      secure_agg: {frac_bits: 12, clip: 4.0, seed: 0, graph: full}
 
 Range contract: the clip applies to the PSEUDO-GRADIENT (before the
 public weight), so the int32 group must hold ``sum_k w_k * clip *
@@ -76,14 +89,19 @@ class SecureAgg(FedAvg):
                 f"server_config.secure_agg must be a bool or an options "
                 f"dict, got {type(sa).__name__}")
         sa = sa if isinstance(sa, dict) else {}
-        unknown = set(sa) - {"frac_bits", "clip", "seed"}
+        unknown = set(sa) - {"frac_bits", "clip", "seed", "graph"}
         if unknown:
             raise ValueError(
                 f"server_config.secure_agg has unknown keys {sorted(unknown)}"
-                f" (known: frac_bits, clip, seed)")
+                f" (known: frac_bits, clip, seed, graph)")
         self.frac_bits = int(sa.get("frac_bits", 12))
         self.clip = float(sa.get("clip", 4.0))
         self.seed = int(sa.get("seed", 0))
+        self.graph = str(sa.get("graph", "full")).lower()
+        if self.graph not in ("full", "log"):
+            raise ValueError(
+                f"secure_agg.graph must be 'full' or 'log', "
+                f"got {self.graph!r}")
         if not 1 <= self.frac_bits <= 24:
             raise ValueError(
                 f"secure_agg.frac_bits must be in [1, 24], "
@@ -119,6 +137,23 @@ class SecureAgg(FedAvg):
                 "norms/cosines would be noise; disable one of the two")
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _log_offsets(k: int):
+        """Circulant offsets ``±2^t mod K`` (Bell et al. CCS'20 topology),
+        deduplicated and with 0 removed — a STATIC python list (K is the
+        cohort array length, known at trace time).  The set is closed
+        under negation mod K, so slot ``p`` lists slot ``q`` iff ``q``
+        lists ``p`` — every edge is symmetric and the cohort sum
+        telescopes exactly like the full graph's."""
+        offs = set()
+        t = 1
+        while t < k:
+            offs.add(t % k)
+            offs.add((-t) % k)
+            t *= 2
+        offs.discard(0)
+        return sorted(offs)
+
     def _pair_masks(self, tree, self_id, cohort_ids, cohort_mask,
                     round_idx):
         """Sum of this client's signed pairwise masks, one tree.
@@ -126,14 +161,19 @@ class SecureAgg(FedAvg):
         A ``fori_loop`` folds each partner's mask into a running int32
         sum, so peak memory is ONE mask tree — a vmap over partners
         would materialize [cohort, n_params] intermediates per client
-        (O(K^2 x n_params) across the round program)."""
+        (O(K^2 x n_params) across the round program).
+
+        ``graph: "full"`` iterates every cohort slot (O(K) masks per
+        client); ``graph: "log"`` iterates only the circulant ``±2^t``
+        neighbor slots (O(log K) masks per client).  Mask keys derive
+        from the PAIR's public ids either way, so which endpoint computes
+        an edge never matters."""
         base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
                                   jnp.asarray(round_idx, jnp.int32))
         leaves, treedef = jax.tree.flatten(tree)
+        k = cohort_ids.shape[0]
 
-        def body(j, acc):
-            jid = cohort_ids[j]
-            jm = cohort_mask[j]
+        def fold_edge(jid, jm, acc):
             lo = jnp.minimum(self_id, jid)
             hi = jnp.maximum(self_id, jid)
             # public pair key; clamp: padding ids (-1) are gated out but
@@ -154,7 +194,23 @@ class SecureAgg(FedAvg):
             return out
 
         acc0 = [jnp.zeros(leaf.shape, jnp.int32) for leaf in leaves]
-        summed = jax.lax.fori_loop(0, cohort_ids.shape[0], body, acc0)
+        if self.graph == "log" and k > 1:
+            # own slot: cohort ids are unique for real clients, so argmax
+            # finds it; padding submissions are zeroed by ``present``
+            # downstream, their mask sum is irrelevant
+            pos = jnp.argmax(
+                (cohort_ids == self_id).astype(jnp.int32)).astype(jnp.int32)
+            offs = jnp.asarray(self._log_offsets(k), jnp.int32)
+
+            def body(t, acc):
+                jidx = jnp.mod(pos + offs[t], k)
+                return fold_edge(cohort_ids[jidx], cohort_mask[jidx], acc)
+
+            summed = jax.lax.fori_loop(0, offs.shape[0], body, acc0)
+        else:
+            summed = jax.lax.fori_loop(
+                0, k, lambda j, acc: fold_edge(cohort_ids[j],
+                                               cohort_mask[j], acc), acc0)
         return jax.tree.unflatten(treedef, summed)
 
     # ------------------------------------------------------------------

@@ -63,6 +63,33 @@ def test_expired_deadline_still_emits_json():
     assert skipped, out["extras"]
 
 
+def test_cpu_fallback_embeds_prior_tpu_extras_verbatim():
+    """Driver-proofing (VERDICT r4 missing #4): a CPU-fallback line must
+    CONTAIN the freshest committed on-chip capture verbatim, so the
+    driver's per-round record carries the evidence itself even when the
+    tunnel is wedged at driver time."""
+    import glob
+    arts = sorted(glob.glob(os.path.join(REPO, "BENCH_TPU_*.json")))
+    if not arts:
+        pytest.skip("no committed on-chip artifact in this tree")
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=_env(BENCH_DEADLINE_SECS="25"),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = _json_line(proc.stdout)
+    prior = out["extras"]["prior_tpu_artifact"]
+    embedded = prior["line"]
+    with open(os.path.join(REPO, prior["file"])) as fh:
+        on_disk = json.load(fh)
+    assert embedded == on_disk  # verbatim, not a summary
+    assert embedded["extras"]["backend"] == "tpu"
+    assert embedded.get("value") is not None  # headline-bearing capture
+    assert "NOT this run" in prior["note"]
+    # the fallback's own top-level numbers remain the CPU run's — the
+    # embedded block is evidence, not attribution
+    assert out["extras"]["backend"] == "cpu"
+
+
 def test_sigterm_mid_run_flushes_partial_json():
     """SIGTERM while protocols are running -> partial results + flush_note
     on stdout, clean exit."""

@@ -173,6 +173,90 @@ def test_secure_agg_chunked_clients_equivalent():
     np.testing.assert_allclose(params[None], params[2], atol=1e-6)
 
 
+def test_range_contract_k_bound():
+    """The int32 group bound is a STATIC init-time contract: defaults
+    (clip=4, frac_bits=12, MAX_WEIGHT=100) admit K <= 1310 — the
+    documented limit must hold exactly, and lowering frac_bits must
+    reopen the headroom (the advertised remediation)."""
+    SecureAgg(_cfg(extra_server={"num_clients_per_iteration": 1310}))
+    with pytest.raises(ValueError, match="range contract"):
+        SecureAgg(_cfg(extra_server={"num_clients_per_iteration": 1311}))
+    with pytest.raises(ValueError, match="range contract"):
+        SecureAgg(_cfg(extra_server={"num_clients_per_iteration": 2048}))
+    SecureAgg(_cfg(extra_server={
+        "num_clients_per_iteration": 2048,
+        "secure_agg": {"frac_bits": 8}}))
+
+
+def test_log_offsets_symmetric_and_logarithmic():
+    """The circulant offset set must be closed under negation mod K
+    (edge symmetry = exact cancellation) and O(log K)-sized."""
+    for k in (2, 3, 7, 8, 16, 100, 512, 1310):
+        offs = SecureAgg._log_offsets(k)
+        assert offs, k
+        assert 0 not in offs
+        assert set(offs) == {(-o) % k for o in offs}, k
+        assert len(offs) <= 2 * max(1, int(np.ceil(np.log2(k)))), k
+        # connectivity: offset 1 is always present (t=1 term)
+        assert 1 in offs or k <= 1
+    assert len(SecureAgg._log_offsets(512)) <= 18  # vs 511 full-graph
+
+
+def _log_strategy(extra=None):
+    server = {"secure_agg": {"graph": "log"}}
+    server.update(extra or {})
+    return SecureAgg(_cfg(extra_server=server))
+
+
+def test_log_graph_masks_cancel_exactly_k512():
+    """K=512 cohort on the virtual mesh env: every present client's
+    O(log K) mask sum telescopes to EXACTLY zero over the cohort, with
+    padding and absent slots mixed in."""
+    strat = _log_strategy()
+    k = 512
+    rng = np.random.default_rng(3)
+    ids = rng.permutation(4 * k)[:k].astype(np.int32)
+    ids[-7:] = -1                      # padding tail
+    mask = (ids >= 0).astype(np.float32)
+    mask[5] = 0.0                      # a real id that is absent
+    tree = {"w": jnp.zeros((64,), jnp.int32)}
+    cohort_ids = jnp.asarray(ids)
+    cohort_mask = jnp.asarray(mask)
+
+    def one(cid, cm):
+        return strat._pair_masks(tree, cid, cohort_ids, cohort_mask, 9)
+
+    masks = jax.vmap(one)(cohort_ids, cohort_mask)
+    gate = (cohort_mask > 0).astype(jnp.int32)
+    total = jnp.tensordot(gate, masks["w"], axes=[[0], [0]])
+    np.testing.assert_array_equal(np.asarray(total), 0)
+    # a present client's own mask is non-zero (it hides)
+    assert np.abs(np.asarray(masks["w"][0])).max() > 0
+
+
+def test_log_graph_engine_bit_matches_full_graph():
+    """Through the sharded engine, the log-degree and full graphs must
+    produce BIT-IDENTICAL aggregates: both mask sums cancel exactly, so
+    the decoded int32 sums are the same array."""
+    data = _data(users=40)
+    params = {}
+    for graph in ("full", "log"):
+        cfg = _cfg(extra_server={
+            "num_clients_per_iteration": 32,
+            "secure_agg": {"graph": graph}})
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            server = OptimizationServer(task, cfg, data, val_dataset=data,
+                                        model_dir=tmp, mesh=make_mesh(),
+                                        seed=0)
+            state = server.train()
+        params[graph] = np.concatenate(
+            [np.ravel(x) for x in jax.tree.leaves(
+                jax.device_get(state.params))])
+    np.testing.assert_array_equal(params["full"], params["log"])
+    assert np.abs(params["full"]).max() > 0
+
+
 def test_secure_agg_options_without_strategy_rejected():
     """secure_agg options under a different strategy would be silently
     ignored (unmasked payloads while the user believes SecAgg is on) —

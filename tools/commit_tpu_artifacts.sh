@@ -16,6 +16,9 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   digits_tpu.json digits_tpu.err \
   flash_crossover.json flash_crossover.err \
   tpu_secagg_ef_tests.log \
+  FULLRUN_TPU_*.json fullrun_tpu.log \
+  PROFILE_BERT_TPU.json PROFILE_BERT_GATHERED_TPU.json profile_bert_tpu.log \
+  PARITY_LONGRUN.json parity_longrun.log \
   tpu_pallas_attention.log tpu_quant_kernel_probe.log; do
   [ -e "$f" ] && git add -f "$f"
 done

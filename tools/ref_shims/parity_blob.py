@@ -14,9 +14,28 @@ import numpy as np
 
 
 def maybe_load(data, x_dtype=np.float32):
-    """str path -> blob dict shaped like the reference loaders expect."""
+    """str path -> blob dict shaped like the reference loaders expect.
+
+    ``.hdf5`` blobs (the long-horizon corpus is ~1 GB — json text would
+    be several GB and minutes of parsing) use the layout
+    ``users / num_samples / user_data/<u>/{x,y}``."""
     if not isinstance(data, str):
         return data
+    if data.endswith((".hdf5", ".h5")):
+        import h5py
+        with h5py.File(data, "r") as fh:
+            users = [u.decode() if isinstance(u, bytes) else str(u)
+                     for u in fh["users"][()]]
+            return {
+                "users": users,
+                "num_samples": [int(n) for n in fh["num_samples"][()]],
+                "user_data": {
+                    u: np.asarray(fh["user_data"][u]["x"][()],
+                                  dtype=x_dtype) for u in users},
+                "user_data_label": {
+                    u: np.asarray(fh["user_data"][u]["y"][()],
+                                  dtype=np.int64) for u in users},
+            }
     with open(data) as fh:
         blob = json.load(fh)
     users = list(blob["users"])

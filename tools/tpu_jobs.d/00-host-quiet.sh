@@ -1,0 +1,10 @@
+#!/bin/bash
+# Timing hygiene: hold the queue while a host test suite / heavy local
+# job is running — host contention skews on-chip s/round (job 80's cnn
+# read 4.46x contended vs 9.98x clean; docs/RUNBOOK.md).  Local work
+# touches /root/repo/.scratch/host_busy while it runs; this job (re-armed
+# by `rm 00-host-quiet.sh.done`) blocks the queue until it clears.
+while [ -f /root/repo/.scratch/host_busy ]; do
+  echo "[00-host-quiet] host busy; queue held"; sleep 30
+done
+exit 0

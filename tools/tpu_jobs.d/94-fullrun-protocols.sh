@@ -1,0 +1,13 @@
+#!/bin/bash
+# Full-length end-to-end protocol runs (VERDICT r4 missing #1): the four
+# reference protocols through the real CLI at published geometry —
+# 100/1500/4000/1200 rounds, per-round latest checkpointing, eval at
+# published cadence, full-size synthetic blobs.  Whole-run wall-clock vs
+# the published FLUTE NCCL totals.  Also records a fused (TPU-best-
+# practice) variant per protocol.  Per-protocol wedge budgets live inside
+# the tool (published + headroom).
+FULLRUN_FUSED=50 \
+  python tools/fullrun_protocols.py > fullrun_tpu.log 2>&1
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc

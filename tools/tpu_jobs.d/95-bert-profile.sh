@@ -1,0 +1,16 @@
+#!/bin/bash
+# BERT MFU work (VERDICT r4 next #4): profile the mlm_bert round on chip
+# (full head vs round-5's gathered MLM head), so the committed artifact
+# pins where the time goes and what the head change bought.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 2400 \
+  python tools/profile_round.py --protocol mlm_bert --chunks 2 \
+  > PROFILE_BERT_TPU.json 2> profile_bert_tpu.log
+rc=$?
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 2400 \
+  python tools/profile_round.py --protocol mlm_bert_gathered --chunks 2 \
+  > PROFILE_BERT_GATHERED_TPU.json 2>> profile_bert_tpu.log
+rc2=$?
+bash tools/commit_tpu_artifacts.sh || true
+[ "$rc" -eq 0 ] && [ "$rc2" -eq 0 ]
