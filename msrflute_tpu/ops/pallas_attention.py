@@ -7,12 +7,19 @@ with an online softmax, O(L) memory instead of the O(L^2) score
 materialization of the jnp path (``models/ringlm.py`` local mode).  Both
 passes are Pallas kernels (FlashAttention-2 style tiling):
 
-- forward: grid ``(B, H, Lq/block_q)``; each program streams key/value
-  blocks through VMEM, carrying ``(m, l, acc)`` in registers and writing
-  the output block plus the log-sum-exp row statistics for the backward.
-- backward: ``dq`` on the same grid; ``dk``/``dv`` on a
-  ``(B, H, Lk/block_k)`` grid — each recomputes the probabilities from
-  the saved ``lse`` (no O(L^2) residuals).
+- forward: grid ``(B, H, Lq/block_q, Lk/block_k)`` with the key/value
+  block index INNERMOST and ``arbitrary`` semantics — mosaic pipelines
+  the next K/V block's HBM→VMEM fetch under the current block's MXU
+  work, and the ``(m, l, acc)`` online-softmax carry lives in VMEM
+  scratch across the inner sweep.  VMEM residency is O(block), never
+  O(L): the round-4 kernels loaded the WHOLE key sequence per program
+  (the kv BlockSpec spanned padded Lk), which both capped L at VMEM
+  size and serialized HBM fetches behind compute — the measured reason
+  dense beat flash at every length.
+- backward: ``dq`` on the same grid shape; ``dk``/``dv`` on
+  ``(B, H, Lk/block_k, Lq/block_q)`` (query blocks innermost), both
+  accumulating into VMEM scratch and recomputing probabilities from the
+  saved ``lse`` (no O(L^2) residuals).
 
 Causal masking is GLOBAL-position based: dynamic ``q_offset``/``k_offset``
 scalars (SMEM scalar-prefetch) shift the row/column ids, which is what
@@ -70,95 +77,110 @@ def _ceil_to(n, m):
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
-def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
-                scale, block_q, block_k, l_q, l_k):
-    qi = pl.program_id(2)
-    q_off, k_off = offs_ref[0], offs_ref[1]
-    q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, D]
-    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    num_k = pl.cdiv(l_k, block_k)
-    if causal:
-        # k blocks entirely above the (global) diagonal contribute nothing
-        num_k = jnp.clip(
-            (q_off + (qi + 1) * block_q - k_off + block_k - 1) // block_k,
-            0, num_k)
+def _rows(stat_ref):
+    """Recover a per-row vector from a lane-broadcast [rows, _STAT_LANES]
+    scratch/stream (all lanes hold the same value)."""
+    return jnp.max(stat_ref[...], axis=-1)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
-            jnp.float32)                                # [bk, D]
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
-            jnp.float32)
+
+def _bcast_rows(vec, rows):
+    return jax.lax.broadcast_in_dim(vec, (rows, _STAT_LANES), (0,))
+
+
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_s, l_s, acc_s, *, causal, scale, block_q, block_k,
+                l_q, l_k, num_k):
+    qi, kj = pl.program_id(2), pl.program_id(3)
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    def _accumulate():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)       # [bq, D]
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)   # [bk, D]
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        k_loc = j * block_k + jax.lax.broadcasted_iota(
+        k_loc = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_loc < l_k
         if causal:
+            q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, q_pos >= k_off + k_loc)
         s = jnp.where(mask, s, _NEG)
-        m_blk = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m, m_blk)
+        m = _rows(m_s)
+        l = _rows(l_s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
         # mask p explicitly: for fully-masked rows s == m_new == _NEG and
         # exp(0) would resurrect the masked entries
         p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        acc_new = acc * corr[:, None] + jnp.dot(
+        m_s[...] = jax.lax.broadcast_in_dim(m_new, m_s.shape, (0,))
+        l_s[...] = jax.lax.broadcast_in_dim(
+            l * corr + jnp.sum(p, axis=1), l_s.shape, (0,))
+        acc_s[...] = acc_s[...] * corr[:, None] + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((block_q,), _NEG, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
-    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
-    # TPU mosaic requires the last two BLOCK dims be (8k, 128m)-aligned, so
-    # the per-row lse is stored lane-broadcast as [bq, _STAT_LANES] (the
-    # trick as jax's own tpu flash kernel's l/m outputs)
-    lse_ref[0, 0, :, :] = jax.lax.broadcast_in_dim(
-        lse, (block_q, _STAT_LANES), (0,))
+    if causal:
+        # whole key blocks above the (global) diagonal contribute nothing;
+        # their fetch still pipelines but the MXU work is skipped
+        @pl.when(k_off + kj * block_k <= q_off + (qi + 1) * block_q - 1)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        m = _rows(m_s)
+        l = _rows(l_s)
+        out = acc_s[...] / jnp.maximum(l, 1e-30)[:, None]
+        o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
+        # TPU mosaic requires the last two BLOCK dims be (8k, 128m)-
+        # aligned, so the per-row lse is stored lane-broadcast as
+        # [bq, _STAT_LANES] (same trick as jax's own tpu flash kernel)
+        lse_ref[0, 0, :, :] = _bcast_rows(lse, block_q)
 
 
 # ----------------------------------------------------------------------
 # backward
 # ----------------------------------------------------------------------
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               glse_ref, dq_ref, *, causal, scale, block_q, block_k,
-               l_q, l_k):
-    qi = pl.program_id(2)
+               glse_ref, dq_ref, dq_s, *, causal, scale, block_q, block_k,
+               l_q, l_k, num_k):
+    qi, kj = pl.program_id(2), pl.program_id(3)
     q_off, k_off = offs_ref[0], offs_ref[1]
-    q = q_ref[0, 0, :, :].astype(jnp.float32)
-    do = do_ref[0, 0, :, :].astype(jnp.float32)
-    # lse/delta/glse arrive lane-broadcast [bq, _STAT_LANES]; any lane-reduce
-    # that preserves the (identical) value recovers the row vector
-    lse = jnp.max(lse_ref[0, 0, :, :], axis=1)
-    delta = jnp.max(delta_ref[0, 0, :, :], axis=1)
-    glse = jnp.max(glse_ref[0, 0, :, :], axis=1)
-    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    num_k = pl.cdiv(l_k, block_k)
-    if causal:
-        num_k = jnp.clip(
-            (q_off + (qi + 1) * block_q - k_off + block_k - 1) // block_k,
-            0, num_k)
 
-    def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
-            jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    def _accumulate():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)   # [bk, D]
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        # lse/delta/glse arrive lane-broadcast [bq, _STAT_LANES]; any
+        # lane-reduce that preserves the (identical) value recovers rows
+        lse = _rows(lse_ref[0, 0])
+        delta = _rows(delta_ref[0, 0])
+        glse = _rows(glse_ref[0, 0])
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        k_loc = j * block_k + jax.lax.broadcasted_iota(
+        k_loc = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_loc < l_k
         if causal:
+            q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, q_pos >= k_off + k_loc)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
@@ -166,87 +188,106 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         # d lse / d s = p, so the lse cotangent adds straight into ds
         ds = p * (dp - delta[:, None] + glse[:, None]) * scale
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dq_s[...] = dq_s[...] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
 
-    dq0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    dq = jax.lax.fori_loop(0, num_k, body, dq0)
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    if causal:
+        @pl.when(k_off + kj * block_k <= q_off + (qi + 1) * block_q - 1)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                glse_ref, dk_ref, dv_ref, *, causal, scale, block_q,
-                block_k, l_q, l_k):
-    ki = pl.program_id(2)
+                glse_ref, dk_ref, dv_ref, dk_s, dv_s, *, causal, scale,
+                block_q, block_k, l_q, l_k, num_q):
+    ki, qj = pl.program_id(2), pl.program_id(3)
     q_off, k_off = offs_ref[0], offs_ref[1]
-    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)       # [bk, D]
-    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
-    k_pos = k_off + ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    num_q = pl.cdiv(l_q, block_q)
-    if causal:
-        # q blocks strictly above this key block's (global) diagonal start
-        # see nothing: first candidate block index, clipped into range
-        i0 = jnp.clip((k_off + ki * block_k - q_off) // block_q, 0, num_q)
-    else:
-        i0 = 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = jnp.max(
-            lse_ref[0, 0, pl.ds(i * block_q, block_q), :], axis=1)
-        delta = jnp.max(
-            delta_ref[0, 0, pl.ds(i * block_q, block_q), :], axis=1)
-        glse = jnp.max(
-            glse_ref[0, 0, pl.ds(i * block_q, block_q), :], axis=1)
+    @pl.when(qj == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    def _accumulate():
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)   # [bk, D]
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)       # [bq, D]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = _rows(lse_ref[0, 0])
+        delta = _rows(delta_ref[0, 0])
+        glse = _rows(glse_ref[0, 0])
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        q_loc = i * block_q + jax.lax.broadcasted_iota(
+        q_loc = qj * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_loc = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_loc < l_k
         if causal:
-            mask = jnp.logical_and(mask, q_off + q_loc >= k_pos)
+            mask = jnp.logical_and(
+                mask, q_off + q_loc >= k_off + k_loc)
         # padded q rows carry lse = _NEG -> exp(s - _NEG) would overflow;
         # mask on the valid-q side too
         mask = jnp.logical_and(mask, q_loc < l_q)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dv = dv + jax.lax.dot_general(
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, D]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bq, bk]
         ds = p * (dp - delta[:, None] + glse[:, None]) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_s[...] = dk_s[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, D]
-        return dk, dv
 
-    dk0 = jnp.zeros((block_k, k_blk.shape[1]), jnp.float32)
-    dv0 = jnp.zeros((block_k, v_blk.shape[1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(i0, num_q, body, (dk0, dv0))
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    if causal:
+        # q blocks strictly above this key block's (global) diagonal
+        # start see nothing
+        @pl.when(q_off + (qj + 1) * block_q - 1 >= k_off + ki * block_k)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(qj == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_s[...].astype(dv_ref.dtype)
 
 
 # ----------------------------------------------------------------------
 # pallas_call plumbing
 # ----------------------------------------------------------------------
-def _specs(block_q, block_k, lk_p, d_p):
+def _specs(block_q, block_k, d_p):
     # kernel-side layout is [B, H, S, D]: the blocked dims (S, D) sit in
-    # the last two positions, as TPU mosaic tiling requires
+    # the last two positions, as TPU mosaic tiling requires.  Grid is
+    # (B, H, q_block, kv_block) — the kv index j is INNERMOST so mosaic
+    # double-buffers the kv fetches while q/out/stat blocks (index maps
+    # ignoring j) stay resident across the inner sweep.
     q_spec = pl.BlockSpec((1, 1, block_q, d_p),
-                          lambda b, h, i, *_: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, lk_p, d_p),
-                           lambda b, h, i, *_: (b, h, 0, 0))
+                          lambda b, h, i, j, *_: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d_p),
+                           lambda b, h, i, j, *_: (b, h, j, 0))
     # per-row lse rides lane-broadcast as [B, H, lq_p, _STAT_LANES]
     lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
-                            lambda b, h, i, *_: (b, h, i, 0))
+                            lambda b, h, i, j, *_: (b, h, i, 0))
     return q_spec, kv_spec, lse_spec
+
+
+#: grid semantics: batch/head/outer-block axes are parallel; the inner
+#: accumulation axis must execute in order (scratch carry)
+_SEMANTICS = (pltpu.GridDimensionSemantics.PARALLEL,
+              pltpu.GridDimensionSemantics.PARALLEL,
+              pltpu.GridDimensionSemantics.PARALLEL,
+              pltpu.GridDimensionSemantics.ARBITRARY)
 
 
 def _bhsd(x):
@@ -275,20 +316,31 @@ def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k,
     qp = _bhsd(_pad_axis(_pad_axis(q, 1, lq_p), 3, d_p))
     kp = _bhsd(_pad_axis(_pad_axis(k, 1, lk_p), 3, d_p))
     vp = _bhsd(_pad_axis(_pad_axis(v, 1, lk_p), 3, d_p))
-    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lk_p, d_p)
+    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, d_p)
+    nq, nk = lq_p // block_q, lk_p // block_k
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
-                               l_q=Lq, l_k=Lk)
+                               l_q=Lq, l_k=Lk, num_k=nk)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, H, lq_p // block_q),
+            grid=(B, H, nq, nk),
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=[q_spec, lse_spec],
+            # m/l scratch at full 128 lanes (the proven shape of jax's
+            # own tpu flash kernel's carry scratch); the lse OUTPUT keeps
+            # _STAT_LANES — it is a block of a real array, where the
+            # equal-to-array-dim rule applies
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d_p), jnp.float32),
+            ],
         ),
         out_shape=[jax.ShapeDtypeStruct(qp.shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, lq_p, _STAT_LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=_SEMANTICS),
         interpret=_resolve_interpret(interpret),
     )(_offs(q_offset, k_offset), qp, kp, vp)
     return _bhsd(out)[:, :Lq, :, :D], lse[:, :, :Lq, 0]
@@ -312,45 +364,52 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
     delta = _lanes(delta.transpose(0, 2, 1), lq_p)
     interp = _resolve_interpret(interpret)
     offs = _offs(q_offset, k_offset)
-    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, lk_p, d_p)
+    q_spec, kv_spec, lse_spec = _specs(block_q, block_k, d_p)
+    nq, nk = lq_p // block_q, lk_p // block_k
 
     dq_kernel = functools.partial(_dq_kernel, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
-                                  l_q=Lq, l_k=Lk)
+                                  l_q=Lq, l_k=Lk, num_k=nk)
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, H, lq_p // block_q),
+            grid=(B, H, nq, nk),
             in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec,
                       lse_spec],
             out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=_SEMANTICS),
         interpret=interp,
     )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
 
-    # dk/dv: grid over key blocks; q/do/lse/delta stream in full
-    kq_spec = pl.BlockSpec((1, 1, lq_p, d_p),
-                           lambda b, h, i, *_: (b, h, 0, 0))
+    # dk/dv: key blocks on the outer grid axis, query blocks streamed
+    # innermost (same pipelining story, axes swapped)
+    kq_spec = pl.BlockSpec((1, 1, block_q, d_p),
+                           lambda b, h, i, j, *_: (b, h, j, 0))
     kk_spec = pl.BlockSpec((1, 1, block_k, d_p),
-                           lambda b, h, i, *_: (b, h, i, 0))
-    full_lse_spec = pl.BlockSpec((1, 1, lq_p, _STAT_LANES),
-                                 lambda b, h, i, *_: (b, h, 0, 0))
+                           lambda b, h, i, j, *_: (b, h, i, 0))
+    kq_lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                               lambda b, h, i, j, *_: (b, h, j, 0))
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal, scale=scale,
                                    block_q=block_q, block_k=block_k,
-                                   l_q=Lq, l_k=Lk)
+                                   l_q=Lq, l_k=Lk, num_q=nq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, H, lk_p // block_k),
-            in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, full_lse_spec,
-                      full_lse_spec, full_lse_spec],
+            grid=(B, H, nk, nq),
+            in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, kq_lse_spec,
+                      kq_lse_spec, kq_lse_spec],
             out_specs=[kk_spec, kk_spec],
+            scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                            pltpu.VMEM((block_k, d_p), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=_SEMANTICS),
         interpret=interp,
     )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
     return (_bhsd(dq)[:, :Lq, :, :D], _bhsd(dk)[:, :Lk, :, :D],
@@ -442,10 +501,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Exact attention over ``[B, L, H, D]`` tensors, tiled in VMEM.
 
     Softmax scale is ``1/sqrt(D)`` (matching ``models/ringlm.py``).
-    ``D`` is padded to the 128-lane width and ``L`` to the block size; the
-    key/value stream for one head must fit VMEM, which bounds local
-    sequence length at roughly 16k (f32) per chip — beyond that, shard the
-    sequence axis over a mesh and run these kernels per ring rotation
+    ``D`` is padded to the 128-lane width and ``L`` to the block size;
+    key/value blocks STREAM through VMEM (O(block_k) residency, see
+    module docstring), so single-chip ``L`` is bounded by the HBM
+    footprint of the tensors themselves, not by VMEM — for lengths
+    beyond one chip's HBM, shard the sequence axis over a mesh and run
+    these kernels per ring rotation
     (``ring_self_attention(..., use_flash=True)``).
 
     On a non-TPU backend with ``interpret=None`` this op computes the SAME
