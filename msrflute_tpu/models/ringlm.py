@@ -49,6 +49,11 @@ class _MHA(nn.Module):
     #: rotation's chunk pair runs through the same kernels with position
     #: offsets (ring_flash_attention_local) — the two levers compose.
     use_flash: bool = False
+    #: Pallas kernel tiles (``flash_block_q`` x ``flash_block_k``) — the
+    #: knobs tools/flash_crossover_sweep.py searches; config-settable so
+    #: a sweep's winning tiles apply without code edits
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, x):  # [B, L, E]
@@ -60,9 +65,13 @@ class _MHA(nn.Module):
             attn = ring_self_attention(q, k, v, self.ring_mesh,
                                        axis=self.seq_axis, causal=True,
                                        batch_axis=self.batch_axis,
-                                       use_flash=self.use_flash)
+                                       use_flash=self.use_flash,
+                                       flash_block_q=self.flash_block_q,
+                                       flash_block_k=self.flash_block_k)
         elif self.use_flash:
-            attn = flash_attention(q, k, v, causal=True)
+            attn = flash_attention(q, k, v, causal=True,
+                                   block_q=self.flash_block_q,
+                                   block_k=self.flash_block_k)
         else:
             scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
             scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
@@ -90,13 +99,17 @@ class _Block(nn.Module):
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
     use_flash: bool = False
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, x):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + _MHA(self.heads, self.head_dim, self.dtype, self.ring_mesh,
                      self.seq_axis, self.batch_axis,
-                     use_flash=self.use_flash)(h)
+                     use_flash=self.use_flash,
+                     flash_block_q=self.flash_block_q,
+                     flash_block_k=self.flash_block_k)(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
             ep_mesh = (self.ring_mesh if self.moe_ep_axis is not None
@@ -137,6 +150,8 @@ class _RingLM(nn.Module):
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
     use_flash: bool = False
+    flash_block_q: int = 128
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, x):  # [B, L] int32
@@ -157,7 +172,8 @@ class _RingLM(nn.Module):
                           self.dtype, self.ring_mesh, self.seq_axis,
                           self.batch_axis, self.moe_experts,
                           self.moe_ep_axis, self.moe_capacity_factor,
-                          self.use_flash, name=f"block_{i}")(h)
+                          self.use_flash, self.flash_block_q,
+                          self.flash_block_k, name=f"block_{i}")(h)
         h = nn.LayerNorm(dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=self.dtype)(h)
 
@@ -234,7 +250,9 @@ def make_ringlm_task(model_config) -> RingLMTask:
         max_len=seq_len - 1,
         moe_experts=int(model_config.get("moe_experts", 0) or 0),
         use_flash=_resolve_flash(
-            model_config.get("flash_attention", False), seq_len - 1))
+            model_config.get("flash_attention", False), seq_len - 1),
+        flash_block_q=int(model_config.get("flash_block_q", 128)),
+        flash_block_k=int(model_config.get("flash_block_k", 128)))
     task = RingLMTask(module, seq_len=seq_len, name="ringlm")
     task.flash_flag = model_config.get("flash_attention", False)
     return task
