@@ -716,15 +716,24 @@ def run_reference(tree, cfg_path, data_dir, out_dir, task, metrics_out):
             break
     if proc.returncode != 0:
         raise RuntimeError(f"reference trainer failed rc={proc.returncode}")
-    # Vals appear strictly in round order but the "Current iteration" marker
-    # flushes late (end-of-round metrics_payload), so align by ORDER: with
-    # initial_val on, the j-th val record is the state after j rounds.
+    return parse_ref_val_metrics(metrics_out)
+
+
+def parse_ref_val_metrics(path):
+    """Order-based alignment of a reference metrics.jsonl: Vals appear
+    strictly in round order but the "Current iteration" marker flushes
+    late (end-of-round metrics_payload), so align by ORDER — with
+    initial_val on, the j-th val record is the state after j EVAL POINTS
+    (round ``j * val_freq``; the parity harness runs val_freq=1 so j is
+    the round directly, ``longrun.py`` rescales).  Shared by
+    :func:`run_reference` and the longrun's reuse-from-disk path — ONE
+    copy of the alignment logic."""
     rounds = {}
     j = {"Val loss": 0, "Val acc": 0}
-    with open(metrics_out) as fh:
+    with open(path) as fh:
         for line in fh:
             rec = json.loads(line)
-            name = rec["name"]
+            name = rec.get("name")
             if name in j:
                 rounds.setdefault(j[name], {})[name] = float(rec["value"])
                 j[name] += 1

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Long-horizon cross-framework accuracy (VERDICT r4 next #5), our side ON
+# CHIP: 300 sampled rounds of the CNN protocol over the 3400-user hard
+# corpus (the reference side ran on host torch; tools/parity/longrun.py
+# --phase ref).  Requires ref_rounds.json in the scratch — skip (rc 0,
+# no .done removal needed) if the ref phase hasn't landed yet.
+SCRATCH=/root/repo/.scratch/parity_longrun
+# the ref phase runs ~30 min on the host; this is the LAST queue job, so
+# a bounded wait holds nothing else up.  Exiting early would burn the
+# job's one run (.done) with nothing re-arming it.
+waited=0
+while [ ! -f "$SCRATCH/ref_rounds.json" ] && [ "$waited" -lt 5400 ]; do
+  sleep 60; waited=$((waited + 60))
+done
+if [ ! -f "$SCRATCH/ref_rounds.json" ]; then
+  echo "[96-longrun] ref phase never landed after ${waited}s" >&2
+  exit 1
+fi
+timeout -s TERM -k 60 3000 \
+  python tools/parity/longrun.py --phase tpu --backend ambient \
+  --scratch "$SCRATCH" > parity_longrun.log 2>&1
+rc=$?
+if [ "$rc" -eq 0 ]; then
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python tools/parity/longrun.py --phase compare --scratch "$SCRATCH" \
+    >> parity_longrun.log 2>&1
+  rc=$?
+fi
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc
