@@ -237,3 +237,24 @@ def test_protocol_geometry_pinned_to_reference():
         assert float(cfg.server_config.optimizer_config["lr"]) == 1.0, name
     # headline-first ordering is part of the driver contract
     assert next(iter(ps)) == "cnn_femnist"
+
+
+def test_bench_bert_gathered_entry_configures_the_gathered_head():
+    """The round-5 mlm_bert_gathered TPU entry must actually select the
+    gathered MLM head (and keep the base mlm_bert entry untouched so
+    rounds stay comparable)."""
+    import importlib.util
+
+    import numpy as np
+    spec = importlib.util.spec_from_file_location("bench_gather", BENCH)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    ps = b.build_protocols(True, np.random.default_rng(0), with_bf16=False)
+    gathered = ps["mlm_bert_gathered"]["cfg"].model_config["BERT"]["model"]
+    base = ps["mlm_bert"]["cfg"].model_config["BERT"]["model"]
+    assert gathered.get("mlm_head") == "gathered"
+    assert "mlm_head" not in base or base["mlm_head"] == "full"
+    # same geometry otherwise: any drift would confound the A/B
+    for key in ("vocab_size", "hidden_size", "num_hidden_layers",
+                "max_seq_length", "dtype"):
+        assert gathered[key] == base[key], key

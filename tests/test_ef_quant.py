@@ -226,6 +226,36 @@ def test_ef_device_table_k512_round(tmp_path):
     assert len(stored) >= 500  # ~all sampled clients flushed through
 
 
+def test_ef_flush_freq_defers_durability(tmp_path):
+    """ef_flush_freq > 1: between flushes the durable marker stays at
+    the -1 sentinel (a crash inside the window resets residuals on
+    resume — never a silent mismatch), and the final round always
+    flushes."""
+    data = _data()
+    cfg = _cfg(rounds=3, server_extra={
+        "ef_device_residuals": True, "ef_flush_freq": 10})
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, data, val_dataset=data,
+                                model_dir=str(tmp_path), mesh=make_mesh(),
+                                seed=0)
+    server.train()
+    # final=True at round 3 forces the flush + marker commit
+    assert server.ef_store.round() == 3
+    stored = [f for f in (tmp_path / "ef_residuals").iterdir()
+              if f.name.startswith("residual_") and
+              f.name[len("residual_"):-len(".npy")].lstrip("-").isdigit()]
+    assert stored  # dirty rows written through at the final flush
+    # resume with a crashed-window sentinel: reset semantics (as host path)
+    server.ef_store.set_round(-1)
+    cfg2 = _cfg(rounds=3, server_extra={
+        "ef_device_residuals": True, "ef_flush_freq": 10})
+    cfg2.server_config["resume_from_checkpoint"] = True
+    server2 = OptimizationServer(task, cfg2, data, val_dataset=data,
+                                 model_dir=str(tmp_path), mesh=make_mesh(),
+                                 seed=0)
+    assert np.abs(server2.ef_store.rows(list(range(8)))).max() == 0
+
+
 def test_storeless_eviction_bounds_ram():
     """Without a disk store there is nowhere to spill: eviction DROPS
     LRU residuals (graceful EF degradation) instead of growing RAM

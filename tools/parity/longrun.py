@@ -230,22 +230,34 @@ def _load_rounds(path):
 
 def phase_ref(args, data_dir, rcfg):
     metrics_path = os.path.join(args.scratch, "ref_metrics.jsonl")
+    proto_path = os.path.join(args.scratch, "ref_metrics_protocol.json")
     out_path = os.path.join(args.scratch, "ref_rounds.json")
     expected_evals = args.rounds // args.val_freq + 1  # + initial_val
     if os.path.exists(metrics_path) and os.path.getsize(metrics_path):
-        # reuse ONLY a complete capture: run_reference writes metrics
-        # incrementally, so a crashed run leaves a partial file whose
-        # truncated curve must not masquerade as the reference
+        # reuse ONLY a complete capture FROM THIS PROTOCOL: the metrics
+        # are written incrementally (a crashed run leaves a truncated
+        # curve), and an eval-point count alone cannot tell 300/25 from
+        # 120/10 — the protocol sidecar written alongside a successful
+        # run is the authority
+        have_proto = None
+        if os.path.exists(proto_path):
+            try:
+                with open(proto_path) as fh:
+                    have_proto = json.load(fh)
+            except Exception:
+                have_proto = None
         parsed = parse_ref_val_metrics(metrics_path)
-        if len(parsed) == expected_evals:
-            print("[longrun] complete reference metrics already on disk; "
-                  "parsing without re-running", file=sys.stderr)
+        if have_proto == _protocol(args) and len(parsed) == expected_evals:
+            print("[longrun] complete reference metrics for this protocol "
+                  "already on disk; parsing without re-running",
+                  file=sys.stderr)
             _save_rounds(out_path,
                          {j * args.val_freq: v for j, v in parsed.items()},
                          None, _protocol(args))
             return
-        print(f"[longrun] on-disk reference metrics are partial "
-              f"({len(parsed)}/{expected_evals} eval points); re-running",
+        print(f"[longrun] on-disk reference metrics unusable (protocol "
+              f"match: {have_proto == _protocol(args)}; "
+              f"{len(parsed)}/{expected_evals} eval points); re-running",
               file=sys.stderr)
     tree = build_ref_tree(args.scratch)
     ref_cfg_path = os.path.join(args.scratch, "ref_cnn_longrun.yaml")
@@ -258,6 +270,8 @@ def phase_ref(args, data_dir, rcfg):
     # run_reference's order alignment assumes the parity harness's
     # val_freq=1; at cadence F the j-th record is round j*F
     ref_rounds = {r * args.val_freq: v for r, v in ref_rounds.items()}
+    with open(proto_path, "w") as fh:
+        json.dump(_protocol(args), fh)  # marks the capture's protocol
     _save_rounds(out_path, ref_rounds, round(time.time() - tic, 1),
                  _protocol(args))
 
@@ -287,7 +301,11 @@ def phase_tpu(args, data_dir, tcfg):
         tpu_cfg_path, data_dir, os.path.join(args.scratch, "tpu_out"),
         # a label with no experiments/<name>/task.py: the run must not
         # pick up a plugin's config overrides
-        "parity_cnn_longrun", env_override=env_override)
+        "parity_cnn_longrun", env_override=env_override,
+        # the budget must kill the TRAINER (the tunnel claimant), not an
+        # outer orchestrator — queue jobs therefore pass it HERE instead
+        # of wrapping this tool in a shell `timeout`
+        timeout=args.tpu_timeout_secs)
     _save_rounds(os.path.join(args.scratch, "tpu_rounds.json"),
                  tpu_rounds, round(time.time() - tic, 1), _protocol(args))
 
@@ -388,16 +406,26 @@ def main():
                     choices=["cpu", "ambient"],
                     help="tpu phase: cpu = virtual-mesh env (smoke/CI); "
                          "ambient = keep the caller's backend (chip jobs)")
+    ap.add_argument("--tpu-timeout-secs", type=float, default=None,
+                    help="kill the tpu-phase TRAINER after this budget "
+                         "(the trainer holds the tunnel claim; an outer "
+                         "shell timeout would orphan it)")
     args = ap.parse_args()
     if args.smoke:
         args.rounds, args.users, args.val_freq = 6, 24, 2
 
+    if args.phase == "compare":
+        # compare reads only the saved curves; running prepare() here
+        # could regenerate the GB corpus for nothing — or, on a flag
+        # mismatch, DELETE the very curves it is about to compare
+        phase_compare(args)
+        return
     data_dir, rcfg, tcfg = prepare(args)
     if args.phase in ("all", "ref"):
         phase_ref(args, data_dir, rcfg)
     if args.phase in ("all", "tpu"):
         phase_tpu(args, data_dir, tcfg)
-    if args.phase in ("all", "compare"):
+    if args.phase == "all":
         phase_compare(args)
 
 

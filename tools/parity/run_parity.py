@@ -741,7 +741,7 @@ def parse_ref_val_metrics(path):
 
 
 def run_msrflute(cfg_path, data_dir, out_dir, task, name_map=None,
-                 env_override=None):
+                 env_override=None, timeout=None):
     """``name_map`` maps OUR metric names onto the canonical comparison
     keys ("Val loss"/"Val acc") — the personalization mode compares the
     reference's personalized Val metrics against our "Personalized val
@@ -749,7 +749,11 @@ def run_msrflute(cfg_path, data_dir, out_dir, task, name_map=None,
     conv-heavy programs must drop to 2 virtual devices with
     single-threaded Eigen on this 1-core host, or XLA's in-process
     AllReduce rendezvous (hard 40 s termination, ``rendezvous.cc:127``)
-    SIGABRTs when a starved device thread misses the collective."""
+    SIGABRTs when a starved device thread misses the collective.
+    ``timeout`` (secs) kills the TRAINER ITSELF on expiry — a queue job
+    must not wrap this call in a shell ``timeout``, which would kill
+    only the orchestrator and orphan the trainer holding the
+    single-client tunnel claim (docs/RUNBOOK.md failure mode 4)."""
     env = dict(
         os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
@@ -759,7 +763,7 @@ def run_msrflute(cfg_path, data_dir, out_dir, task, name_map=None,
            "-config", cfg_path, "-dataPath", data_dir,
            "-outputPath", out_dir, "-task", task]
     proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
-                          text=True)
+                          text=True, timeout=timeout)
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout[-4000:] + "\n" + proc.stderr[-6000:])
         raise RuntimeError(f"msrflute_tpu trainer failed rc={proc.returncode}")

@@ -2,22 +2,28 @@
 # Long-horizon cross-framework accuracy (VERDICT r4 next #5), our side ON
 # CHIP: 300 sampled rounds of the CNN protocol over the 3400-user hard
 # corpus (the reference side ran on host torch; tools/parity/longrun.py
-# --phase ref).  Requires ref_rounds.json in the scratch — skip (rc 0,
-# no .done removal needed) if the ref phase hasn't landed yet.
+# --phase ref).  The trainer budget is passed IN-TOOL
+# (--tpu-timeout-secs): a shell `timeout` here would kill only the
+# orchestrator and orphan the e2e_trainer child HOLDING the single-client
+# tunnel claim (docs/RUNBOOK.md failure mode 4).
 SCRATCH=/root/repo/.scratch/parity_longrun
 # the ref phase runs ~30 min on the host; this is the LAST queue job, so
-# a bounded wait holds nothing else up.  Exiting early would burn the
-# job's one run (.done) with nothing re-arming it.
+# a bounded wait holds nothing else up.  If it expires, RE-ARM: the
+# runner stamps .done for any exit code, so a detached sleeper removes
+# the stamp and the runner retries on a later pass.
 waited=0
 while [ ! -f "$SCRATCH/ref_rounds.json" ] && [ "$waited" -lt 5400 ]; do
   sleep 60; waited=$((waited + 60))
 done
 if [ ! -f "$SCRATCH/ref_rounds.json" ]; then
-  echo "[96-longrun] ref phase never landed after ${waited}s" >&2
+  echo "[96-longrun] ref phase not landed after ${waited}s; re-arming" >&2
+  ( sleep 300; rm -f "/root/repo/tools/tpu_jobs.d/96-parity-longrun-tpu.sh.done" ) \
+    >/dev/null 2>&1 &
+  disown
   exit 1
 fi
-timeout -s TERM -k 60 3000 \
-  python tools/parity/longrun.py --phase tpu --backend ambient \
+python tools/parity/longrun.py --phase tpu --backend ambient \
+  --tpu-timeout-secs 2700 \
   --scratch "$SCRATCH" > parity_longrun.log 2>&1
 rc=$?
 if [ "$rc" -eq 0 ]; then
