@@ -65,25 +65,35 @@ PUBLISHED_SECS = {
 #: reference geometry (README.md:22-27; BASELINE.md table).  spu = samples
 #: per user, matched to the real corpora's averages (MNIST 60k/1000,
 #: federated EMNIST ~100/user, Fed-CIFAR-100 100/user, Shakespeare lines).
+#: ``tscale``/``flip`` set corpus difficulty, ridge-probed offline so the
+#: attached accuracy curves live in each protocol's published
+#: neighborhood instead of saturating instantly (LR ~81%, CNN ~83%,
+#: ResNet ~33%, Shakespeare next-char ~57% — README.md:38-41).  The
+#: probes (0.79 / 0.78 / 0.30 at 6-8k samples) are small-sample LOWER
+#: bounds — full-pool training lands somewhat higher (measured: LR
+#: 0.847 at 60k samples/100 rounds, `FULLRUN_CPU_*.json`); the token
+#: walk's flip rate caps next-char accuracy at ~1-flip.
 PROTOCOLS = {
     "lr_mnist": dict(
         model={"model_type": "LR", "num_classes": 10, "input_dim": 784},
         pool=1000, spu=60, batch=10, lr=0.03, rounds=100, freq=20,
-        shape=(784,), classes=10, val_users=100, val_spu=100),
+        shape=(784,), classes=10, val_users=100, val_spu=100, tscale=0.1),
     "cnn_femnist": dict(
         model={"model_type": "CNN", "num_classes": 62},
         pool=3400, spu=100, batch=20, lr=0.1, rounds=1500, freq=50,
-        shape=(28, 28, 1), classes=62, val_users=340, val_spu=100),
+        shape=(28, 28, 1), classes=62, val_users=340, val_spu=100,
+        tscale=0.15),
     "resnet_fedcifar100": dict(
         model={"model_type": "RESNET", "num_classes": 100,
                "image_size": 32},
         pool=500, spu=100, batch=20, lr=0.1, rounds=4000, freq=50,
-        shape=(32, 32, 3), classes=100, val_users=100, val_spu=100),
+        shape=(32, 32, 3), classes=100, val_users=100, val_spu=100,
+        tscale=0.08),
     "rnn_fedshakespeare": dict(
         model={"model_type": "RNN", "vocab_size": 90, "embed_dim": 8,
                "hidden_dim": 256, "seq_len": 80},
         pool=715, spu=50, batch=4, lr=0.8, rounds=1200, freq=50,
-        shape=None, classes=90, val_users=100, val_spu=30),
+        shape=None, classes=90, val_users=100, val_spu=30, flip=0.45),
 }
 
 SMOKE_OVERRIDES = dict(pool=12, spu=10, rounds=4, freq=2,
@@ -100,13 +110,15 @@ def _shrink(spec: dict) -> dict:
 # synthetic full-size data, learnable (class-structured): accuracy curves
 # must move, the compute per sample matches the real corpus shapes
 # ----------------------------------------------------------------------
-def _write_image_blob(path, pool, spu, shape, classes, seed):
+def _write_image_blob(path, pool, spu, shape, classes, seed, tscale):
     import h5py
     dim = int(np.prod(shape))
     rng = np.random.default_rng(seed)
-    # one shared class template bank: classification is learnable but not
-    # trivial (templates overlap through gaussian noise)
-    templates = rng.normal(size=(classes, dim)).astype(np.float32) * 0.6
+    # ONE class template bank for every split (fixed seed, independent of
+    # the per-split sample seed): train and val must share the label rule
+    # or val accuracy measures an unrelated function and sits at chance
+    templates = np.random.default_rng(12345).normal(
+        size=(classes, dim)).astype(np.float32) * tscale
     with h5py.File(path, "w") as fh:
         users_grp = fh.create_group("user_data")
         names, counts = [], []
@@ -124,12 +136,14 @@ def _write_image_blob(path, pool, spu, shape, classes, seed):
         fh.create_dataset("num_samples", data=np.asarray(counts))
 
 
-def _write_token_blob(path, pool, spu, seq_len, vocab, seed):
+def _write_token_blob(path, pool, spu, seq_len, vocab, seed, flip):
     import h5py
     rng = np.random.default_rng(seed)
-    # learnable next-char rule: a fixed random walk over the vocab with
-    # noise, like the parity harness's synthetic shakespeare
-    step = rng.integers(1, 7, size=vocab)
+    # learnable next-char rule: a FIXED random walk over the vocab (seed
+    # independent of the split, same reason as the image templates) with
+    # per-split sample noise, like the parity harness's synthetic
+    # shakespeare; the flip rate caps next-char accuracy at ~1-flip
+    step = np.random.default_rng(54321).integers(1, 7, size=vocab)
     with h5py.File(path, "w") as fh:
         users_grp = fh.create_group("user_data")
         names, counts = [], []
@@ -139,8 +153,9 @@ def _write_token_blob(path, pool, spu, seq_len, vocab, seed):
             x[:, :1] = start
             for t in range(1, seq_len):
                 nxt = (x[:, t - 1] + step[x[:, t - 1] % vocab]) % vocab
-                flip = rng.random(spu) < 0.1
-                nxt = np.where(flip, rng.integers(1, vocab, size=spu), nxt)
+                flipped = rng.random(spu) < flip
+                nxt = np.where(flipped, rng.integers(1, vocab, size=spu),
+                               nxt)
                 x[:, t] = np.maximum(nxt, 1)
             g = users_grp.create_group(f"u{u:05d}")
             g.create_dataset("x", data=x)
@@ -158,17 +173,29 @@ def _ensure_data(name: str, spec: dict, data_dir: str) -> dict:
             "train": (spec["pool"], spec["spu"]),
             "val": (spec["val_users"], spec["val_spu"]),
             "test": (spec["val_users"], spec["val_spu"])}.items():
-        fname = f"{name}_{split}_{pool}x{spu}.hdf5"
+        # v3: shared-template corpus (split-independent label rule) at
+        # ridge-probed difficulty; the version tag invalidates caches
+        # from earlier generators
+        hardness = spec.get("tscale", spec.get("flip"))
+        fname = f"{name}_{split}_{pool}x{spu}_h{hardness}_v3.hdf5"
         fpath = os.path.join(data_dir, fname)
+        # prune superseded generations of this split (a difficulty retune
+        # or generator bump renames the cache; the orphans are GB-class)
+        import glob as _glob
+        for old in _glob.glob(os.path.join(data_dir,
+                                           f"{name}_{split}_*.hdf5")):
+            if os.path.basename(old) != fname:
+                os.remove(old)
         if not os.path.exists(fpath):
             seed = {"train": 0, "val": 1, "test": 2}[split]
             if spec["shape"] is None:
                 _write_token_blob(fpath, pool, spu,
                                   spec["model"]["seq_len"],
-                                  spec["model"]["vocab_size"], seed)
+                                  spec["model"]["vocab_size"], seed,
+                                  spec["flip"])
             else:
                 _write_image_blob(fpath, pool, spu, spec["shape"],
-                                  spec["classes"], seed)
+                                  spec["classes"], seed, spec["tscale"])
         paths[split] = fname
     return paths
 
@@ -238,6 +265,10 @@ def run_protocol(name: str, spec: dict, data_dir: str, out_root: str,
     paths = _ensure_data(name, spec, data_dir)
     tag = f"{name}_fuse{fuse}"
     out_dir = os.path.join(out_root, tag)
+    # a reused output dir APPENDS to metrics.jsonl and the parsed curve
+    # then interleaves runs — each invocation starts clean
+    import shutil
+    shutil.rmtree(out_dir, ignore_errors=True)
     cfg_path = os.path.join(out_root, f"{tag}.yaml")
     with open(cfg_path, "w") as fh:
         yaml.safe_dump(_config(name, spec, paths, fuse, on_tpu), fh)
