@@ -56,6 +56,8 @@ ALLOWED_STRATEGIES = [
     "secure_agg", "secagg", "SecureAgg",
     # net-new: error-feedback quantization (arXiv:1901.09847)
     "ef_quant", "efquant", "EFQuant",
+    # net-new: buffered async aggregation (arXiv:2106.06639)
+    "fedbuff", "FedBuff",
 ]
 
 ALLOWED_SERVER_TYPES = [
@@ -133,7 +135,7 @@ SERVER_KEYS = {
     "optimizer_config", "annealing_config", "server_replay_config", "RL",
     "nbest_task_scheduler", "best_model_metric",
     # TPU-native extensions
-    "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir", "secure_agg",
+    "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir", "secure_agg", "fedbuff",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "ef_device_residuals", "ef_flush_freq",
     "semisupervision", "updatable_names",
@@ -414,6 +416,15 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
             "server_config.secure_agg is set but strategy is "
             f"{strategy!r} — only strategy: secure_agg reads it; "
             "payloads would flow UNMASKED")
+    # same quiet-failure rule for fedbuff: its options under another
+    # strategy would leave the run fully synchronous while the user
+    # believes they are simulating async staleness
+    if isinstance(sc_raw, dict) and sc_raw.get("fedbuff") is not None \
+            and str(strategy or "fedavg").lower() != "fedbuff":
+        errors.append(
+            "server_config.fedbuff is set but strategy is "
+            f"{strategy!r} — only strategy: fedbuff reads it; the run "
+            "would be fully synchronous")
 
     _check_unknown(unknown, raw, "config", TOP_KEYS)
 

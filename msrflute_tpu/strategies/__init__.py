@@ -36,4 +36,7 @@ def select_strategy(name: str) -> type:
     if key in ("ef_quant", "efquant"):
         from .ef_quant import EFQuant
         return EFQuant
+    if key == "fedbuff":
+        from .fedbuff import FedBuff
+        return FedBuff
     raise ValueError(f"unknown strategy {name!r}")

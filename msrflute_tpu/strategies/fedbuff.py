@@ -1,0 +1,137 @@
+"""FedBuff — buffered asynchronous aggregation (Nguyen et al., AISTATS'22,
+arXiv:2106.06639) — net-new vs the reference.
+
+FLUTE's orchestration is synchronous (its ``stale_prob`` defers whole
+AGGREGATES server-side, ``core/strategies/dga.py:260-284``); real async
+FL is different: each client trains from whatever model version it was
+handed, so by the time its update arrives the server has moved on.
+FedBuff is the standard simulation of that regime — the server applies a
+buffer of client deltas that were computed against versions up to
+``max_staleness`` steps old, each discounted by a staleness weight
+``(1 + s)^(-staleness_exponent)``.
+
+TPU mapping (single jitted round, no async runtime needed):
+
+- the strategy state carries a device-resident HISTORY of the last
+  ``max_staleness`` broadcast versions — stacked leaves
+  ``[S, ...param]``, index 0 = current, exactly the round-fusion-safe
+  shape (the state threads through the ``lax.scan`` like every other
+  strategy state);
+- per client, IN-JIT: draw ``s_i ~ Uniform{0..S-1}`` from the client's
+  rng fold, start local training from ``history[s_i]`` (a dynamic
+  leading-axis index inside the vmapped client program — no ``[K,
+  n_params]`` materialization), and scale the aggregation weight by
+  ``(1 + s_i)^(-rho)``;
+- the server update is owned: plain SGD on the aggregate (the paper's
+  server step), then the history rolls — ``concat([new_params, ...,
+  drop oldest])``.
+
+Faithfulness notes: the pseudo-gradient a client returns is
+``history[s_i] - y_T`` (its OWN version minus its trained weights) and
+the server applies the discounted average to the CURRENT params — which
+is precisely FedBuff's gradient-style application of stale deltas.  The
+buffer size of the paper maps onto ``num_clients_per_iteration`` (K
+arrivals trigger one server step).  ``max_staleness: 1`` is exactly
+FedAvg (every client reads index 0) — pinned by test.
+
+Config::
+
+    strategy: fedbuff
+    server_config:
+      fedbuff: {max_staleness: 4, staleness_exponent: 0.5}
+      optimizer_config: {type: sgd, lr: ...}   # server step is owned SGD
+
+HBM cost: ``max_staleness`` extra param copies in the strategy state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .fedavg import FedAvg
+
+
+class FedBuff(FedAvg):
+
+    supports_staleness = False   # DGA's aggregate deferral doesn't compose
+    supports_rl = False
+    owns_server_update = True
+    stateful = True
+    # the strategy state is the version history; FedAvg's adaptive-clip
+    # state ("dp_clip") cannot share it — the base init then rejects
+    # adaptive_clipping configs loudly (same stance as FedAC/Scaffold)
+    supports_adaptive_clipping = False
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        fb = config.server_config.get("fedbuff", True)
+        if not isinstance(fb, (dict, bool)):
+            raise ValueError(
+                f"server_config.fedbuff must be a bool or an options dict, "
+                f"got {type(fb).__name__}")
+        fb = fb if isinstance(fb, dict) else {}
+        unknown = set(fb) - {"max_staleness", "staleness_exponent"}
+        if unknown:
+            raise ValueError(
+                f"server_config.fedbuff has unknown keys {sorted(unknown)} "
+                f"(known: max_staleness, staleness_exponent)")
+        self.max_staleness = int(fb.get("max_staleness", 4))
+        self.rho = float(fb.get("staleness_exponent", 0.5))
+        if self.max_staleness < 1:
+            raise ValueError(
+                f"fedbuff.max_staleness must be >= 1 (1 == synchronous "
+                f"FedAvg), got {self.max_staleness}")
+        if self.rho < 0:
+            raise ValueError(
+                f"fedbuff.staleness_exponent must be >= 0, got {self.rho}")
+        opt = config.server_config.optimizer_config
+        if str(opt.get("type", "sgd")).lower() != "sgd":
+            raise ValueError(
+                "strategy: fedbuff owns its server update (the paper's "
+                "SGD step + history roll) — server optimizer_config.type "
+                f"must be sgd, got {opt.get('type')!r}")
+
+    # ---- engine hooks -------------------------------------------------
+    def init_state(self, params_like: Any) -> Any:
+        # stack materializes fresh buffers, so the state never aliases the
+        # params it was built from (the round step donates both — same
+        # donation rule FedAC's init documents)
+        s = self.max_staleness
+        return {"history": jax.tree.map(
+            lambda p: jnp.stack([p] * s), params_like)}
+
+    def client_step(self, client_update, global_params, arrays, sample_mask,
+                    client_lr, rng, round_idx=None, leakage_threshold=None,
+                    quant_threshold=None, strategy_state=None,
+                    grad_offset=None):
+        # per-client staleness: this client trains from the version it
+        # "received" s_i server-steps ago.  Early rounds have identical
+        # history slots (init_state), matching a cold-start system where
+        # nothing has moved yet.
+        s_i = jax.random.randint(jax.random.fold_in(rng, 23), (), 0,
+                                 self.max_staleness)
+        start = jax.tree.map(lambda h: h[s_i],
+                             strategy_state["history"])
+        parts, tl, ns, stats = super().client_step(
+            client_update, start, arrays, sample_mask, client_lr, rng,
+            round_idx=round_idx, leakage_threshold=leakage_threshold,
+            quant_threshold=quant_threshold, strategy_state=strategy_state,
+            grad_offset=grad_offset)
+        pg, w = parts["default"]
+        discount = (1.0 + s_i.astype(jnp.float32)) ** (-self.rho)
+        parts["default"] = (pg, w * discount)
+        return parts, tl, ns, stats
+
+    def apply_server_update(self, params: Any, agg: Any, state: Any,
+                            server_lr) -> Tuple[Any, Any]:
+        lr = jnp.asarray(server_lr, jnp.float32)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, agg)
+        # roll the version history: index 0 = the params clients of the
+        # NEXT round may read as "current"
+        new_hist = jax.tree.map(
+            lambda p, h: jnp.concatenate([p[None], h[:-1]], axis=0),
+            new_params, state["history"])
+        return new_params, {"history": new_hist}

@@ -162,3 +162,32 @@ def test_ringlm_flash_auto_config_roundtrip():
         "vocab_size": 64, "seq_len": FLASH_AUTO_MIN_LEN + 1,
         "flash_attention": "auto"}))
     assert lng.module.use_flash is True
+
+
+def test_ringlm_flash_auto_re_resolves_per_device_under_sp(seq_mesh):
+    """ADVICE r4: the crossover constant is calibrated on PER-DEVICE
+    length; under sequence parallelism each shard sees L/shards tokens,
+    so sp_module must re-resolve "auto" — and must NOT touch an explicit
+    bool."""
+    from msrflute_tpu.config import ModelConfig
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.models.ringlm import FLASH_AUTO_MIN_LEN
+
+    shards = seq_mesh.shape["sequence"]
+    # global L clears the crossover, per-device L = L/shards does not:
+    # 'auto' picks flash locally but dense per-shard
+    auto = make_task(ModelConfig(model_type="RINGLM", extra={
+        "vocab_size": 64, "seq_len": FLASH_AUTO_MIN_LEN + 1,
+        "flash_attention": "auto"}))
+    assert auto.module.use_flash is True
+    assert auto.sp_module(seq_mesh).use_flash is False
+    # per-device length still clears the crossover -> flash stays on
+    big = make_task(ModelConfig(model_type="RINGLM", extra={
+        "vocab_size": 64, "seq_len": shards * FLASH_AUTO_MIN_LEN + 1,
+        "flash_attention": "auto"}))
+    assert big.sp_module(seq_mesh).use_flash is True
+    # explicit bools are the user's call on BOTH paths
+    forced = make_task(ModelConfig(model_type="RINGLM", extra={
+        "vocab_size": 64, "seq_len": FLASH_AUTO_MIN_LEN + 1,
+        "flash_attention": True}))
+    assert forced.sp_module(seq_mesh).use_flash is True
