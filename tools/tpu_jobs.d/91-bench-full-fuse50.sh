@@ -2,6 +2,9 @@
 # Uncontended re-run of the all-in-one bench at the new fuse=50 default
 # (job 80 ran at fuse=25 and shared the host with a pytest suite): one
 # raw artifact carrying every protocol's best-practice number.
-BENCH_DEADLINE_SECS=7200 BENCH_TPU_WAIT_SECS=60 \
+# 3600s cap (typical full run ~40 min): a start near the runner's
+# 05:00 cutoff must not spill deep into the 06:00 driver bench window —
+# the internal watchdog flushes whatever sections completed
+BENCH_DEADLINE_SECS=3600 BENCH_TPU_WAIT_SECS=60 \
   python bench.py > bench_tpu_full_fuse50.json 2> bench_tpu_full_fuse50.err
 bash tools/commit_tpu_artifacts.sh || true
