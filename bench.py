@@ -479,6 +479,17 @@ def grad_step_cost(task, params, batch):
         return None
 
 
+def make_val_ds(dataset, eval_users):
+    """Val split used by the bench's ``secs_eval`` measurement: the first
+    ``eval_users`` users of the train pool.  Shared with
+    ``tools/profile_round.py``'s eval breakdown so the breakdown explains
+    the same eval the bench times."""
+    from msrflute_tpu.data import ArraysDataset
+    n = min(int(eval_users), len(dataset.user_list))
+    return ArraysDataset(dataset.user_list[:n],
+                         [dataset.user_arrays(i) for i in range(n)])
+
+
 def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
                    timed_chunks, eval_every, want_mfu=False):
     """Run one protocol; return its result dict.
@@ -505,8 +516,7 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
     mesh = make_mesh()
     task = make_task(cfg.model_config)
     fuse = int(cfg.server_config.get("rounds_per_step", 1))
-    val_ds = ArraysDataset(dataset.user_list[:eval_users],
-                           [dataset.user_arrays(i) for i in range(eval_users)])
+    val_ds = make_val_ds(dataset, eval_users)
     with tempfile.TemporaryDirectory() as tmp:
         server = OptimizationServer(task, cfg, dataset, val_dataset=val_ds,
                                     model_dir=tmp, mesh=mesh, seed=0)

@@ -19,6 +19,7 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   FULLRUN_TPU_*.json fullrun_tpu.log \
   PROFILE_BERT_TPU.json PROFILE_BERT_GATHERED_TPU.json profile_bert_tpu.log \
   PARITY_LONGRUN.json parity_longrun.log \
+  PROFILE_EVAL_LR_TPU.json PROFILE_EVAL_CNN_TPU.json profile_eval_tpu.log \
   tpu_pallas_attention.log tpu_quant_kernel_probe.log; do
   [ -e "$f" ] && git add -f "$f"
 done

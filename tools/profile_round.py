@@ -158,6 +158,51 @@ def main(argv=None) -> None:
         else:
             out["cost_analysis_error"] = "cost analysis unavailable"
 
+        # ---- eval cost breakdown: bench.py's secs_eval is an absolute
+        # (~0.07 s even for tiny protocols) larger than a train round;
+        # split it into its parts so the absolute is explained, not just
+        # amortized away by the eval cadence ----
+        try:
+            from msrflute_tpu.engine.evaluation import evaluate
+            # the profiled server is built without a val split; use the
+            # SAME val_ds bench.py times as secs_eval
+            server.val_dataset = bench.make_val_ds(dataset, 8)
+            server._eval_batches_cache.pop("val", None)
+            tic = time.time()
+            staged = server._packed_eval_batches("val")
+            jax.block_until_ready(staged)
+            cold_pack = time.time() - tic
+            first = next(iter(staged.values()))
+            ev = {"split": "val",
+                  "grid_steps_T": int(first.shape[0]),
+                  "batch_B": int(first.shape[1]),
+                  "grid_bytes": int(sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                                        for v in staged.values())),
+                  "cold_pack_and_stage_secs": round(cold_pack, 5)}
+            # device-only: the jitted scan+psum program on staged arrays
+            server._eval_fn(server.state.params, staged)  # compile
+            times = []
+            for _ in range(10):
+                tic = time.time()
+                jax.block_until_ready(
+                    server._eval_fn(server.state.params, staged))
+                times.append(time.time() - tic)
+            ev["device_secs_p50"] = round(float(np.percentile(times, 50)), 5)
+            # full path as the server pays it each cadence hit: device_put
+            # no-ops + device run + device_get + host metric finalize
+            times = []
+            for _ in range(10):
+                tic = time.time()
+                evaluate(task, server._eval_fn, server.state.params,
+                         staged, mesh, server.engine.partition_mode)
+                times.append(time.time() - tic)
+            ev["full_eval_secs_p50"] = round(float(np.percentile(times, 50)), 5)
+            ev["host_overhead_secs"] = round(
+                ev["full_eval_secs_p50"] - ev["device_secs_p50"], 5)
+            out["eval_breakdown"] = ev
+        except Exception as exc:  # breakdown must not kill the tool
+            out["eval_breakdown_error"] = f"{type(exc).__name__}: {exc}"
+
     print(json.dumps(out))
 
 

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Eval-cost decomposition on chip (VERDICT r4 weak #3 tail): bench.py's
+# secs_eval (~0.07 s) exceeds a train round for the small protocols; this
+# splits it into staged-grid size, device program time, and host overhead
+# so the absolute is explained (expected: the single-client tunnel's
+# dispatch floor, not eval compute).
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 1800 \
+  python tools/profile_round.py --protocol lr_mnist --chunks 2 \
+  > PROFILE_EVAL_LR_TPU.json 2> profile_eval_tpu.log
+rc=$?
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 1800 \
+  python tools/profile_round.py --protocol cnn_femnist --chunks 2 \
+  > PROFILE_EVAL_CNN_TPU.json 2>> profile_eval_tpu.log
+rc2=$?
+bash tools/commit_tpu_artifacts.sh || true
+[ "$rc" -eq 0 ] && [ "$rc2" -eq 0 ]
