@@ -1,0 +1,35 @@
+#!/bin/bash
+# Auto-policy proof (VERDICT r4 next #3 tail): after job 92's sweep and
+# the FLASH_AUTO_MIN_LEN recalibration, show the ringlm "auto" select
+# picking the measured-faster branch on BOTH sides of the crossover.
+# Exit 1 (and a committed JSON showing the mismatch) if the shipped
+# constant disagrees with a fresh measurement.
+ATTEMPTS=/root/repo/.scratch/flash_auto_attempts
+n=$(cat "$ATTEMPTS" 2>/dev/null || echo 0)
+rearm() {
+  if [ "$n" -ge 3 ]; then
+    echo "[98-flash-auto] giving up after $n re-arms" >&2
+    exit 1
+  fi
+  echo $((n + 1)) > "$ATTEMPTS"
+  ( sleep 600; rm -f /root/repo/tools/tpu_jobs.d/98-flash-auto-validate.sh.done ) \
+    >/dev/null 2>&1 &
+  disown
+  exit 1
+}
+# -s: job 92's stdout redirect creates the file at launch, so a timed-out
+# sweep leaves it empty — that is a re-arm, not a run
+if [ ! -s /root/repo/flash_crossover.json ]; then
+  echo "[98-flash-auto] no usable sweep artifact yet; re-arming" >&2
+  rearm
+fi
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 2400 \
+  python tools/validate_flash_auto.py > FLASH_AUTO_VALIDATION.json 2> flash_auto_validation.err
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+if [ "$rc" -eq 2 ]; then
+  echo "[98-flash-auto] sweep artifact unusable (rc 2); re-arming" >&2
+  rearm
+fi
+exit $rc
