@@ -716,18 +716,27 @@ def bench_longctx(on_tpu: bool) -> dict:
         batch = {"x": tokens,
                  "sample_mask": jnp.ones((B,), jnp.float32)}
 
+        # the step returns a SCALAR tree-sum of the grads, fetched to host
+        # each rep: on the remote axon backend block_until_ready can
+        # return before execution finishes (the first committed
+        # flash_crossover.json read a flat dispatch-floor ~0.045 ms at
+        # every length), and a float() round-trip cannot lie; the
+        # full-reduction sum also keeps XLA from dead-code-eliminating
+        # any part of the backward pass
         @jax.jit
         def step(p):
             def loss(pp):
                 return task.loss(pp, batch, jax.random.PRNGKey(0), True)[0]
-            return jax.grad(loss)(p)
+            g = jax.grad(loss)(p)
+            return jax.tree_util.tree_reduce(
+                lambda a, b: a + jnp.sum(b.astype(jnp.float32)),
+                g, jnp.float32(0))
 
-        jax.block_until_ready(step(params))  # compile
+        float(step(params))  # compile + first run
         reps = 5 if on_tpu else 1
         tic = time.time()
         for _ in range(reps):
-            g = step(params)
-        jax.block_until_ready(g)
+            float(step(params))
         return (time.time() - tic) / reps
 
     dense = step_time(False)
@@ -783,12 +792,19 @@ def bench_varlen_bucketing(on_tpu: bool) -> dict:
             else None
         args = (params, {"x": batch.arrays["x"]}, batch.sample_mask,
                 np.float32(0.5), jax.random.PRNGKey(1))
-        jax.block_until_ready(upd(*args))  # compile
+
+        # scalar-fetch sync (see bench_longctx): tree-sum of the full
+        # client-update output, fetched per rep — block_until_ready is
+        # not a trustworthy fence on the remote backend
+        import jax.numpy as jnp
+        probe = jax.jit(lambda *a: jax.tree_util.tree_reduce(
+            lambda acc, x: acc + jnp.sum(x.astype(jnp.float32)),
+            upd(*a), jnp.float32(0)))
+        float(probe(*args))  # compile + first run
         reps = 10 if on_tpu else 2
         tic = time.time()
         for _ in range(reps):
-            res = upd(*args)
-        jax.block_until_ready(res)
+            float(probe(*args))
         out[tag] = {"secs_per_round": round((time.time() - tic) / reps, 5),
                     "grid_L": int(batch.arrays["x"].shape[-1])}
         if stats:

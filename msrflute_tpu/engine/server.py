@@ -489,6 +489,12 @@ class OptimizationServer:
                 print_rank(f"wrote profiler trace to {self._profile_dir}")
             self._chunks_run += 1
 
+            # fetch the chunk's stats BEFORE stopping the timer: dispatch
+            # is async and block_until_ready is not a trustworthy fence on
+            # the remote backend, so the host-side read of the stats is
+            # the only honest end-of-chunk sync — recording toc first
+            # would time the dispatch, not the execution
+            stats = jax.device_get(stats)
             toc = time.time()
             self.run_stats["secsPerRound"].append((toc - tic) / R)
 

@@ -178,8 +178,13 @@ def test_ef_device_table_unit_semantics(tmp_path):
     from msrflute_tpu.strategies.ef_quant import DeviceResidualTable
     store = ResidualStore(5, store_dir=str(tmp_path))
     store.update(np.asarray([2]), np.full((1, 5), 7.0, np.float32), [True])
-    table = DeviceResidualTable(store, n_clients=10, mesh=make_mesh())
-    assert table.n_rows % 8 == 0           # shards evenly over 8 devices
+    mesh = make_mesh()
+    table = DeviceResidualTable(store, n_clients=10, mesh=mesh)
+    # shards evenly over the clients axis (8 virtual devices in the CPU
+    # suite; 1 on the single real chip — the assert must not bake in 8)
+    from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+    axis = int(mesh.shape[CLIENTS_AXIS])
+    assert table.n_rows % axis == 0 and table.n_rows >= 10
     # gathers/scatters take the engine's cohort shape: K is always padded
     # to a multiple of the clients axis
     ids = np.asarray([2, -1, 3, -1, -1, -1, -1, -1])

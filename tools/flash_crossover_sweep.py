@@ -20,20 +20,13 @@ import functools
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _time(fn, *args, iters=20):
-    import jax
-    out = jax.block_until_ready(fn(*args))  # compile
-    tic = time.perf_counter()
-    for _ in range(iters):
-        out = jax.block_until_ready(fn(*args))
-    return (time.perf_counter() - tic) / iters
+from tools.timing_probe import grad_wall as _grad_wall  # noqa: E402
 
 
 def main() -> int:
@@ -70,11 +63,7 @@ def main() -> int:
         p = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhlm,bmhd->blhd", p, v)
 
-    def grad_wall(attn_fn, q, k, v):
-        def loss(q, k, v):
-            return jnp.sum(attn_fn(q, k, v) ** 2)
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        return _time(g, q, k, v)
+    grad_wall = _grad_wall
 
     for L in (1024, 2048, 4096, 8192, 16384):
         # flash_attention takes [B, L, H, D] (pallas_attention.py:427)

@@ -170,7 +170,10 @@ def main(argv=None) -> None:
             server._eval_batches_cache.pop("val", None)
             tic = time.time()
             staged = server._packed_eval_batches("val")
-            jax.block_until_ready(staged)
+            # sync the staging transfers with an indexed scalar fetch per
+            # leaf — block_until_ready is not a trustworthy fence on the
+            # remote backend
+            jax.device_get({k: v[(0,) * v.ndim] for k, v in staged.items()})
             cold_pack = time.time() - tic
             first = next(iter(staged.values()))
             ev = {"split": "val",
@@ -179,13 +182,18 @@ def main(argv=None) -> None:
                   "grid_bytes": int(sum(int(np.prod(v.shape)) * v.dtype.itemsize
                                         for v in staged.values())),
                   "cold_pack_and_stage_secs": round(cold_pack, 5)}
-            # device-only: the jitted scan+psum program on staged arrays
-            server._eval_fn(server.state.params, staged)  # compile
+            # device-only: the jitted scan+psum program on staged arrays.
+            # Sync by fetching the (tiny) stat sums — block_until_ready is
+            # not a trustworthy fence on the remote backend (see
+            # flash_crossover.json history); evaluate() itself device_gets,
+            # so this matches what the server's eval path actually pays
+            # compile + first run, synced so the warm-up execution cannot
+            # drain into the first timed sample
+            jax.device_get(server._eval_fn(server.state.params, staged))
             times = []
             for _ in range(10):
                 tic = time.time()
-                jax.block_until_ready(
-                    server._eval_fn(server.state.params, staged))
+                jax.device_get(server._eval_fn(server.state.params, staged))
                 times.append(time.time() - tic)
             ev["device_secs_p50"] = round(float(np.percentile(times, 50)), 5)
             # full path as the server pays it each cadence hit: device_put

@@ -19,21 +19,12 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def _time(fn, *args, iters=20):
-    import jax
-    jax.block_until_ready(fn(*args))  # compile
-    tic = time.perf_counter()
-    for _ in range(iters):
-        out = jax.block_until_ready(fn(*args))
-    del out
-    return (time.perf_counter() - tic) / iters
+from tools.timing_probe import grad_wall  # noqa: E402
 
 
 def main() -> int:
@@ -77,11 +68,6 @@ def main() -> int:
 
     def flash(q, k, v):
         return flash_attention(q, k, v, causal=True)
-
-    def grad_wall(attn_fn, q, k, v):
-        def loss(q, k, v):
-            return jnp.sum(attn_fn(q, k, v) ** 2)
-        return _time(jax.jit(jax.grad(loss, argnums=(0, 1, 2))), q, k, v)
 
     out = {"backend": "tpu", "flash_auto_min_len": FLASH_AUTO_MIN_LEN,
            "sweep_crossover": cross, "sides": {}}
