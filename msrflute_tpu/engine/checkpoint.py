@@ -18,6 +18,7 @@ import json
 import logging
 import os
 import shutil
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -109,7 +110,7 @@ class CheckpointManager:
     """
 
     def __init__(self, model_dir: str, backup_freq: int = 100,
-                 backend: str = "msgpack"):
+                 backend: str = "msgpack", async_latest: bool = False):
         self.model_dir = model_dir
         self.backup_freq = max(int(backup_freq), 1)
         if backend not in ("msgpack", "orbax"):
@@ -122,6 +123,18 @@ class CheckpointManager:
             import orbax.checkpoint as ocp
             self._ocp = ocp
             self._orbax = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        # msgpack async-latest: per-round ``latest`` saves hand a DEVICE
+        # snapshot to a writer thread, so the device->host transfer and
+        # the disk write overlap the next rounds' compute (the per-round
+        # sync fetch is the faithful-mode fullrun's dominant cost on a
+        # remote-attached chip; SURVEY §7 explicitly budgets for async
+        # checkpointing).  Same durability contract as the orbax path: a
+        # hard crash can lose at most the in-flight save.
+        self.async_latest = bool(async_latest) and backend == "msgpack"
+        self._mp_cond = threading.Condition()
+        self._mp_mailbox = None   # latest-wins device snapshot
+        self._mp_busy = False
+        self._mp_worker = None
         os.makedirs(model_dir, exist_ok=True)
 
     # -- orbax helpers -------------------------------------------------
@@ -217,6 +230,65 @@ class CheckpointManager:
         checkpoint files externally or at process exit)."""
         if self._orbax is not None:
             self._commit_pending_latest()
+        self._mp_wait()
+
+    # -- msgpack async-latest writer ------------------------------------
+    def _mp_wait(self) -> None:
+        if self._mp_worker is None:
+            return
+        with self._mp_cond:
+            while self._mp_mailbox is not None or self._mp_busy:
+                self._mp_cond.wait()
+
+    def _mp_loop(self) -> None:
+        path = os.path.join(self.model_dir, LATEST)
+        while True:
+            with self._mp_cond:
+                while self._mp_mailbox is None:
+                    self._mp_cond.wait()
+                snap = self._mp_mailbox
+                self._mp_mailbox = None
+                self._mp_busy = True
+            try:
+                blob = serialization.msgpack_serialize(
+                    serialization.to_state_dict(jax.device_get(snap)))
+                del snap  # release the HBM snapshot before the disk write
+                self._write_blob(path, blob)
+                del blob
+            except Exception as exc:  # never kill training from the writer
+                print_rank(f"async latest save failed: {exc!r}",
+                           loglevel=logging.WARNING)
+            finally:
+                with self._mp_cond:
+                    self._mp_busy = False
+                    self._mp_cond.notify_all()
+
+    def _mp_submit(self, state: ServerState) -> None:
+        # single-slot, not latest-wins: wait for the in-flight save first,
+        # so the on-disk latest can lag the status log by AT MOST the one
+        # in-flight round — the same durability window the orbax path
+        # documents.  (Latest-wins would let a slow disk stack unbounded
+        # skew between latest_model and status_log.json, and resume pairs
+        # the two.)  The wait also bounds snapshot HBM to one extra copy.
+        if self._mp_worker is None:
+            self._mp_worker = threading.Thread(
+                target=self._mp_loop, name="ckpt-latest-writer", daemon=True)
+            self._mp_worker.start()
+        with self._mp_cond:
+            while self._mp_mailbox is not None or self._mp_busy:
+                self._mp_cond.wait()
+        # device-side copy: the round step donates the live param/opt
+        # buffers, so the snapshot must be arrays nothing else consumes.
+        # The copies are enqueued on the device stream BEFORE any later
+        # donating program, so they read the pre-donation values; the
+        # writer thread's device_get then overlaps the next rounds.
+        import jax.numpy as jnp
+        snap = jax.tree.map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            _payload(state))
+        with self._mp_cond:
+            self._mp_mailbox = snap
+            self._mp_cond.notify()
 
     # -- save ----------------------------------------------------------
     def save_latest(self, state: ServerState) -> None:
@@ -228,6 +300,9 @@ class CheckpointManager:
                     else self._LATEST_SLOTS[0])
             self._orbax_save(self._orbax_path(slot), state)
             self._pending_slot = slot
+            return
+        if self.async_latest:
+            self._mp_submit(state)
             return
         self._write(os.path.join(self.model_dir, LATEST), state)
 
@@ -252,6 +327,7 @@ class CheckpointManager:
                 if os.path.isdir(best) and not os.path.isdir(dst):
                     shutil.copytree(best, dst)
             return
+        self._mp_wait()  # the epoch copy must see the newest latest file
         src = os.path.join(self.model_dir, LATEST)
         if os.path.exists(src):
             shutil.copyfile(src, os.path.join(self.model_dir,
@@ -278,14 +354,19 @@ class CheckpointManager:
         self._write(os.path.join(
             self.model_dir, f"best_val_{metric_name}_model.msgpack"), state)
 
-    def _write(self, path: str, state: ServerState) -> None:
-        blob = _state_to_bytes(state)
+    @staticmethod
+    def _write_blob(path: str, blob: bytes) -> None:
+        """Atomic tmp-write + rename, with the retry policy — THE write
+        recipe, shared by the sync and async-latest paths."""
         def _save():
             tmp = path + ".tmp"
             with open(tmp, "wb") as fh:
                 fh.write(blob)
             os.replace(tmp, path)
         try_except_save(_save)
+
+    def _write(self, path: str, state: ServerState) -> None:
+        self._write_blob(path, _state_to_bytes(state))
 
     # -- load ----------------------------------------------------------
     def load(self, template: ServerState,
@@ -303,6 +384,7 @@ class CheckpointManager:
                 # crash mid-swap: the previous version is parked at .old
                 restored = self._orbax_load(path + ".old", template)
             return restored
+        self._mp_wait()  # an in-flight async latest must land first
         path = os.path.join(self.model_dir, name)
         if not os.path.exists(path):
             return None

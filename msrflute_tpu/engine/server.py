@@ -67,7 +67,8 @@ class OptimizationServer:
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
         self.ckpt = CheckpointManager(
             model_dir, backup_freq=sc.get("model_backup_freq", 100),
-            backend=str(sc.get("checkpoint_backend", "msgpack")))
+            backend=str(sc.get("checkpoint_backend", "msgpack")),
+            async_latest=bool(sc.get("checkpoint_async", False)))
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -664,8 +665,9 @@ class OptimizationServer:
             # checkpoint is DURABLE (async orbax saves land out of band):
             # clean restarts then keep accumulated controls; a crash inside
             # the round window leaves the -1 sentinel and resets safely.
-            # The wait() (no-op on msgpack) deliberately serializes orbax's
-            # async save for SCAFFOLD runs: committing the marker lazily
+            # The wait() (a real stall under orbax OR checkpoint_async —
+            # and load-bearing in both) deliberately serializes the async
+            # save for SCAFFOLD rounds: committing the marker lazily
             # against the previous durable slot would let the control files
             # run one round ahead of the marker — the silent controls/params
             # mismatch this marker exists to prevent — and scaffold rounds

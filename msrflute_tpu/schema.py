@@ -135,7 +135,8 @@ SERVER_KEYS = {
     "optimizer_config", "annealing_config", "server_replay_config", "RL",
     "nbest_task_scheduler", "best_model_metric",
     # TPU-native extensions
-    "rounds_per_step", "clients_per_chunk", "checkpoint_backend", "compilation_cache_dir", "secure_agg", "fedbuff",
+    "rounds_per_step", "clients_per_chunk", "checkpoint_backend",
+    "checkpoint_async", "compilation_cache_dir", "secure_agg", "fedbuff",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "ef_device_residuals", "ef_flush_freq",
     "semisupervision", "updatable_names",

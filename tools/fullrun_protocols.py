@@ -220,6 +220,12 @@ def _config(name: str, spec: dict, paths: dict, fuse: int,
             "initial_val": False, "initial_rec": False,
             "best_model_criterion": "acc",
             "rounds_per_step": fuse,
+            # per-round latest saves overlap the next round's compute
+            # (same durability contract as orbax async: a crash can lose
+            # only the in-flight save) — without this the faithful fuse=1
+            # mode pays a synchronous full-state device->host fetch every
+            # round, the dominant cost on a remote-attached chip
+            "checkpoint_async": True,
             # warm repeat compiles across protocols/runs
             "compilation_cache_dir": ".jax_cache",
             "data_config": {
