@@ -132,7 +132,7 @@ class CheckpointManager:
         # hard crash can lose at most the in-flight save.
         self.async_latest = bool(async_latest) and backend == "msgpack"
         self._mp_cond = threading.Condition()
-        self._mp_mailbox = None   # latest-wins device snapshot
+        self._mp_mailbox = None   # single-slot device snapshot (see _mp_submit)
         self._mp_busy = False
         self._mp_worker = None
         os.makedirs(model_dir, exist_ok=True)

@@ -211,6 +211,37 @@ def main(argv=None) -> None:
         except Exception as exc:  # breakdown must not kill the tool
             out["eval_breakdown_error"] = f"{type(exc).__name__}: {exc}"
 
+        # ---- per-round checkpoint cost: the faithful fullrun saves
+        # ``latest`` every round (reference cadence); on a remote-attached
+        # chip the full-state fetch is the suspected dominant cost.  Time
+        # the synchronous save (fetch + serialize + write) and the
+        # device->host fetch alone, so FULLRUN numbers decompose ----
+        try:
+            from msrflute_tpu.engine.checkpoint import LATEST, _payload
+            state = server.state
+            nbytes = sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                         for x in jax.tree.leaves(_payload(state))
+                         if hasattr(x, "shape"))
+            times_f, times_s = [], []
+            for _ in range(5):
+                tic = time.time()
+                jax.device_get(_payload(state))
+                times_f.append(time.time() - tic)
+                tic = time.time()
+                server.ckpt._write(os.path.join(
+                    server.ckpt.model_dir, LATEST), state)
+                times_s.append(time.time() - tic)
+            out["checkpoint_cost"] = {
+                "state_bytes": int(nbytes),
+                "fetch_secs_p50": round(float(np.percentile(times_f, 50)), 5),
+                "sync_save_secs_p50": round(float(np.percentile(times_s, 50)), 5),
+                "device_to_host_mb_per_s": round(
+                    nbytes / 1e6 / max(float(np.percentile(times_f, 50)),
+                                       1e-9), 2),
+            }
+        except Exception as exc:
+            out["checkpoint_cost_error"] = f"{type(exc).__name__}: {exc}"
+
     print(json.dumps(out))
 
 
