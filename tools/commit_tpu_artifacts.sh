@@ -21,6 +21,7 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   PARITY_LONGRUN.json parity_longrun.log \
   PROFILE_EVAL_LR_TPU.json PROFILE_EVAL_CNN_TPU.json profile_eval_tpu.log \
   FLASH_AUTO_VALIDATION.json flash_auto_validation.err \
+  DISPATCH_COST_TPU.json dispatch_cost.err \
   tpu_pallas_attention.log tpu_quant_kernel_probe.log; do
   [ -e "$f" ] && git add -f "$f"
 done

@@ -1,0 +1,88 @@
+"""Per-dispatch overhead vs argument/result buffer count on the chip.
+
+The faithful (fuse=1) fullrun measured ~88 ms per round DISPATCH for the
+LR protocol (``.scratch/fullrun_out/lr_mnist_fuse1`` secsPerRound p50)
+against a 0.14 ms trivial-op dispatch floor — suggesting the remote
+runtime pays per-BUFFER, not per-call.  This probe times a no-op-ish jit
+at varying output-buffer counts and input-tree sizes, with and without
+donation, so the engine's stats-packing decision (one flat stats vector
+vs a ~15-leaf dict) rests on a measurement.
+
+Fence discipline: every case syncs by fetching ONE scalar from the FIRST
+output leaf — a fence whose cost is constant in the buffer count, so the
+case timings differ only by what the dispatch itself pays.
+
+Writes one JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _sync(out) -> None:
+    """Constant-cost fence: fetch one scalar from the first output leaf
+    (block_until_ready is not a trustworthy fence on this backend)."""
+    import jax
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[0])
+
+
+def _fetch_time(fn, args, iters=30):
+    _sync(fn(*args))  # compile + first run
+    tic = time.perf_counter()
+    for _ in range(iters):
+        _sync(fn(*args))
+    return (time.perf_counter() - tic) / iters
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    res = {"backend": "tpu", "cases": {}}
+
+    # output-buffer scaling: one [8,128] input, N small outputs
+    x = jnp.ones((8, 128), jnp.float32)
+    for n_out in (1, 4, 16, 64):
+        fn = jax.jit(lambda x, n=n_out: [x[:1, :1] * (i + 1)
+                                         for i in range(n)])
+        res["cases"][f"outputs_{n_out}"] = round(
+            1e3 * _fetch_time(fn, (x,)), 4)
+
+    # input-tree scaling: N small inputs, one output
+    for n_in in (1, 4, 16, 64):
+        args = [jnp.full((8, 8), float(i)) for i in range(n_in)]
+        fn = jax.jit(lambda *a: sum(x[0, 0] for x in a)[None])
+        res["cases"][f"inputs_{n_in}"] = round(
+            1e3 * _fetch_time(fn, args), 4)
+
+    # donation: does donating a 16-leaf tree change per-dispatch cost?
+    # Identical single-leaf fence on both sides; the donated case threads
+    # its output back in (the engine's own state-carry pattern).
+    tree = [jnp.full((64, 64), float(i)) for i in range(16)]
+
+    def roll(*a):
+        return [t + 1.0 for t in a]
+
+    res["cases"]["tree16_no_donate"] = round(
+        1e3 * _fetch_time(jax.jit(roll), tuple(tree)), 4)
+    fn_don = jax.jit(roll, donate_argnums=tuple(range(16)))
+    out = fn_don(*tree)
+    _sync(out)
+    tic = time.perf_counter()
+    iters = 30
+    for _ in range(iters):
+        out = fn_don(*out)
+        _sync(out)
+    res["cases"]["tree16_donated_threaded"] = round(
+        1e3 * (time.perf_counter() - tic) / iters, 4)
+
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Per-dispatch overhead vs buffer count (faithful-fullrun diagnosis):
+# the fuse=1 LR round dispatched in ~88 ms against a 0.14 ms trivial-op
+# floor; this pins whether the cost is per-buffer so the stats-packing
+# engine change rests on data.  Numbered 89 to run BEFORE the re-armed
+# bench jobs: its result decides an engine refactor this round.
+JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache \
+  timeout -s TERM -k 60 1200 \
+  python tools/dispatch_cost_probe.py > DISPATCH_COST_TPU.json 2> dispatch_cost.err
+rc=$?
+bash tools/commit_tpu_artifacts.sh || true
+exit $rc
