@@ -266,9 +266,67 @@ def _parse_metrics(out_dir: str):
     return curve, timing
 
 
+#: set once _wait_chip exhausts a full budget — later protocols fail fast
+_CHIP_GAVE_UP = False
+
+
+def _wait_chip(on_tpu: bool, budget_secs: float = 1800.0) -> bool:
+    """Block until the chip answers a real matmul, or the budget expires.
+
+    Observed live (FULLRUN_TPU 2026-08-01): one trainer dying mid-claim
+    wedges the single-client tunnel, and every LATER protocol in the same
+    job then hangs at its first device op until the axon client's ~25 min
+    internal deadline kills it — a cascade that burned three protocol
+    slots.  The queue runner probes between JOBS; this is the same probe
+    between PROTOCOLS."""
+    global _CHIP_GAVE_UP
+    if not on_tpu:
+        return True
+    if _CHIP_GAVE_UP:
+        return False  # one exhausted budget is enough; don't re-wait per protocol
+    deadline = time.time() + budget_secs
+    probe = ("import jax, jax.numpy as jnp\n"
+             "assert jax.default_backend() == 'tpu'\n"
+             "jax.block_until_ready(jnp.ones((128,128)) @ jnp.ones((128,128)))\n")
+    # graceful timeout via coreutils (TERM, then KILL only after a 30s
+    # grace): subprocess.run(timeout=...) SIGKILLs on expiry, and a
+    # SIGKILLed claimant is exactly what wedges the tunnel (the runner's
+    # own probe uses this same shell form)
+    cmd = ["timeout", "-s", "TERM", "-k", "30", "120",
+           sys.executable, "-c", probe]
+    instant_failures = 0
+    while time.time() < deadline:
+        tic = time.time()
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+        if r.returncode == 0:
+            return True
+        took = time.time() - tic
+        print(f"[fullrun] chip probe rc={r.returncode} after {took:.0f}s; "
+              f"stderr: {(r.stderr or '')[-300:]}", file=sys.stderr)
+        if r.returncode != 124 and took < 10:
+            # instant non-timeout failure = misconfiguration (bad env,
+            # missing plugin), not a wedged claim — sleeping can't fix it
+            instant_failures += 1
+            if instant_failures >= 3:
+                break
+        if time.time() + 180 >= deadline:
+            break
+        print("[fullrun] waiting 180s for the claim to age out",
+              file=sys.stderr)
+        time.sleep(180)
+    _CHIP_GAVE_UP = True
+    return False
+
+
 def run_protocol(name: str, spec: dict, data_dir: str, out_root: str,
                  fuse: int, on_tpu: bool) -> dict:
     paths = _ensure_data(name, spec, data_dir)
+    if not _wait_chip(on_tpu):
+        return {"rounds": spec["rounds"], "total_secs": None,
+                "published_secs": PUBLISHED_SECS.get(name),
+                "vs_published": None, "rounds_per_step": fuse,
+                "returncode": "chip-unreachable", "timing": {},
+                "val_acc_curve": []}
     tag = f"{name}_fuse{fuse}"
     out_dir = os.path.join(out_root, tag)
     # a reused output dir APPENDS to metrics.jsonl and the parsed curve
