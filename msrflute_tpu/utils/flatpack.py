@@ -1,0 +1,94 @@
+"""Dtype-grouped pytree flattening for dispatch-boundary packing.
+
+On the remote-attached chip, per-dispatch overhead scales with the
+argument/result BUFFER count (measured: the fuse=1 LR round dispatches in
+~88 ms against a 0.14 ms trivial-op floor; `tools/dispatch_cost_probe.py`
+pins the per-buffer cost).  A ResNet server state is ~100+ leaves; packed
+it is one buffer per distinct dtype (usually 1-3).
+
+Why not ``jax.flatten_util.ravel_pytree``: it promotes mixed dtypes to a
+common dtype, which corrupts uint32 PRNG keys and large int32 counters
+when the common type is floating.  Here leaves are grouped BY DTYPE and
+concatenated raveled within each group — the round-trip is bit-exact for
+every dtype, and inside jit the pack/unpack lowers to pure
+reshape/slice/concat that XLA fuses away.
+
+Usage::
+
+    packer = build_packer(template_tree)
+    vecs = packer.pack(tree)      # {dtype_str: 1-D array}, jit-safe
+    tree2 = packer.unpack(vecs)   # original structure, bit-identical
+
+The packer is built once from a template (shapes/dtypes must match later
+trees — the jit retrace guard the engine already lives by).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FlatPacker:
+    """Pack/unpack a fixed-structure pytree into one 1-D array per dtype."""
+
+    def __init__(self, template: Any):
+        leaves, treedef = jax.tree.flatten(template)
+        self.treedef = treedef
+        #: per-leaf (dtype_str, offset, size, shape) in flatten order
+        self._slots: List[Tuple[str, int, int, Tuple[int, ...]]] = []
+        sizes: Dict[str, int] = {}
+        for leaf in leaves:
+            # jnp.asarray, not np: python scalars must get the same dtype
+            # (int32/float32 under default jax config) that jnp.ravel will
+            # produce at pack time, or the group keys/sizes are mislabeled
+            arr = leaf if hasattr(leaf, "dtype") else jnp.asarray(leaf)
+            dt = str(arr.dtype)
+            size = int(np.prod(arr.shape)) if arr.shape else 1
+            off = sizes.get(dt, 0)
+            self._slots.append((dt, off, size, tuple(arr.shape)))
+            sizes[dt] = off + size
+        self.sizes = sizes  # {dtype_str: total elements}
+
+    def pack(self, tree: Any) -> Dict[str, jnp.ndarray]:
+        """One 1-D array per dtype, concatenated in flatten order."""
+        leaves, treedef = jax.tree.flatten(tree)
+        if len(leaves) != len(self._slots):
+            raise ValueError(
+                f"tree has {len(leaves)} leaves, packer built for "
+                f"{len(self._slots)}")
+        if treedef != self.treedef:
+            raise ValueError(
+                f"tree structure {treedef} != packer template "
+                f"{self.treedef}")
+        groups: Dict[str, list] = {}
+        for leaf, (dt, _, _, shape) in zip(leaves, self._slots):
+            leaf = leaf if hasattr(leaf, "dtype") else jnp.asarray(leaf)
+            if tuple(leaf.shape) != shape:
+                raise ValueError(
+                    f"leaf shape {tuple(leaf.shape)} != packer "
+                    f"template shape {shape}")
+            if str(leaf.dtype) != dt:
+                # a drifted dtype would silently promote its whole group
+                # through jnp.concatenate — the exact corruption this
+                # module exists to prevent
+                raise ValueError(
+                    f"leaf dtype {leaf.dtype} != packer template dtype {dt}")
+            groups.setdefault(dt, []).append(jnp.ravel(leaf))
+        return {dt: (jnp.concatenate(parts) if len(parts) > 1 else parts[0])
+                for dt, parts in groups.items()}
+
+    def unpack(self, vecs: Dict[str, jnp.ndarray]) -> Any:
+        """Inverse of :meth:`pack` — bit-identical leaves, original tree."""
+        leaves = []
+        for dt, off, size, shape in self._slots:
+            part = vecs[dt][off:off + size]  # static slice — XLA fuses it
+            leaves.append(jnp.reshape(part, shape))
+        return jax.tree.unflatten(self.treedef, leaves)
+
+
+def build_packer(template: Any) -> FlatPacker:
+    return FlatPacker(template)

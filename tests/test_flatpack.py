@@ -1,0 +1,83 @@
+"""Dtype-grouped flat packing: bit-exact round trip, jit-safety, donation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from msrflute_tpu.utils.flatpack import build_packer
+
+
+def _tree():
+    return {
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "b": jnp.ones((4,), jnp.float32) * 0.5,
+        "emb": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3),
+        "count": jnp.asarray(2 ** 30 + 7, jnp.int32),  # > 2^24: f32 would corrupt
+        "key": jax.random.PRNGKey(42),                  # uint32 pair
+        "nested": {"m": jnp.full((2, 2), -3.25, jnp.float32)},
+    }
+
+
+def test_round_trip_bit_exact():
+    tree = _tree()
+    p = build_packer(tree)
+    vecs = p.pack(tree)
+    # one buffer per distinct dtype, not per leaf
+    assert set(vecs) == {"float32", "bfloat16", "int32", "uint32"}
+    back = p.unpack(vecs)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_unpack_inside_jit_and_donation():
+    tree = _tree()
+    p = build_packer(tree)
+
+    @jax.jit
+    def step(vecs):
+        t = p.unpack(vecs)
+        t = jax.tree.map(
+            lambda x: x + 1 if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+        return p.pack(t)
+
+    out = p.unpack(step(p.pack(tree)))
+    np.testing.assert_array_equal(np.asarray(out["count"]),
+                                  np.asarray(tree["count"]))
+    np.testing.assert_array_equal(np.asarray(out["key"]),
+                                  np.asarray(tree["key"]))
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.asarray(tree["w"]) + 1)
+
+    # donation of the packed buffers compiles and threads state
+    don = jax.jit(step, donate_argnums=0)
+    vecs = p.pack(tree)
+    for _ in range(3):
+        vecs = don(vecs)
+    assert float(p.unpack(vecs)["b"][0]) == pytest.approx(0.5 + 3)
+
+
+def test_shape_and_leafcount_mismatch_loud():
+    tree = _tree()
+    p = build_packer(tree)
+    bad = dict(tree, w=jnp.zeros((4, 3), jnp.float32))
+    with pytest.raises(ValueError, match="shape"):
+        p.pack(bad)
+    with pytest.raises(ValueError, match="leaves"):
+        p.pack({"only": jnp.zeros(3)})
+    # dtype drift must be loud, not a silent group promotion
+    with pytest.raises(ValueError, match="dtype"):
+        p.pack(dict(tree, count=jnp.asarray(5, jnp.float32)))
+    # different structure with compatible leaf count/shapes must be loud
+    t2 = dict(tree)
+    t2["zz_extra"] = t2.pop("nested")["m"]
+    with pytest.raises(ValueError, match="structure|shape|dtype"):
+        p.pack(t2)
+
+
+def test_python_scalar_template_normalized():
+    p = build_packer({"n": 7, "m": jnp.arange(2, dtype=jnp.int32)})
+    vecs = p.pack({"n": jnp.asarray(7, jnp.int32),
+                   "m": jnp.arange(2, dtype=jnp.int32)})
+    assert set(vecs) == {"int32"} and vecs["int32"].shape == (3,)
