@@ -5,9 +5,19 @@
 # published cadence, full-size synthetic blobs.  Whole-run wall-clock vs
 # the published FLUTE NCCL totals.  Also records a fused (TPU-best-
 # practice) variant per protocol.  Per-protocol wedge budgets live inside
-# the tool (published + headroom).
-FULLRUN_FUSED=50 \
+# the tool (published + headroom), and the tool probes the chip between
+# protocols (RUNBOOK failure mode 5).
+#
+# Rerun order: resnet+rnn FIRST — the 2026-08-01 first capture lost both
+# to a wedge cascade while lr+cnn landed; if the window closes early the
+# missing evidence lands first.  lr+cnn rerun after, with the faithful-
+# mode fixes (batched stats fetch, checkpoint_async) in effect.
+FULLRUN_FUSED=50 FULLRUN_PROTOCOLS=resnet_fedcifar100,rnn_fedshakespeare \
   python tools/fullrun_protocols.py > fullrun_tpu.log 2>&1
 rc=$?
 bash tools/commit_tpu_artifacts.sh || true
-exit $rc
+FULLRUN_FUSED=50 FULLRUN_PROTOCOLS=lr_mnist,cnn_femnist \
+  python tools/fullrun_protocols.py >> fullrun_tpu.log 2>&1
+rc2=$?
+bash tools/commit_tpu_artifacts.sh || true
+[ "$rc" -eq 0 ] && [ "$rc2" -eq 0 ]
