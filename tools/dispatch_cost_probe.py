@@ -59,6 +59,25 @@ def main() -> int:
         res["cases"][f"inputs_{n_in}"] = round(
             1e3 * _fetch_time(fn, args), 4)
 
+    # host->device staging: the faithful round device_puts ~8-10 small
+    # host arrays per round (masks/ids/lrs/rngs) — is each put an RPC?
+    import numpy as np
+    for n_put in (1, 4, 16):
+        host = [np.full((8, 8), float(i), np.float32) for i in range(n_put)]
+        # one put call per array (the engine's shape) vs one call on the list
+        tic = time.perf_counter()
+        for _ in range(30):
+            staged = [jax.device_put(h) for h in host]
+            _sync(staged)
+        res["cases"][f"put_each_{n_put}"] = round(
+            1e3 * (time.perf_counter() - tic) / 30, 4)
+        tic = time.perf_counter()
+        for _ in range(30):
+            staged = jax.device_put(host)
+            _sync(staged)
+        res["cases"][f"put_tree_{n_put}"] = round(
+            1e3 * (time.perf_counter() - tic) / 30, 4)
+
     # donation: does donating a 16-leaf tree change per-dispatch cost?
     # Identical single-leaf fence on both sides; the donated case threads
     # its output back in (the engine's own state-carry pattern).
