@@ -290,10 +290,15 @@ def phase_tpu(args, data_dir, tcfg):
         }
     else:
         # conv-heavy on a small host: 2 virtual devices, single-thread
-        # eigen (run_msrflute docstring)
+        # eigen (run_msrflute docstring).  Overridable: on hosts with
+        # real cores the single-thread default makes the 300-round CNN
+        # protocol ~176 s/round (measured 2026-08-01) — hopeless; let
+        # the operator trade SIGABRT risk for throughput explicitly.
         env_override = {
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=2 "
-                         "--xla_cpu_multi_thread_eigen=false"}
+            "XLA_FLAGS": os.environ.get(
+                "LONGRUN_CPU_XLA_FLAGS",
+                "--xla_force_host_platform_device_count=2 "
+                "--xla_cpu_multi_thread_eigen=false")}
     print(f"[longrun] msrflute_tpu: {args.rounds} rounds "
           f"(backend={args.backend})", file=sys.stderr)
     tic = time.time()
