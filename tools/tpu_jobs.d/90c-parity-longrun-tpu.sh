@@ -17,7 +17,7 @@ while [ ! -f "$SCRATCH/ref_rounds.json" ] && [ "$waited" -lt 5400 ]; do
 done
 if [ ! -f "$SCRATCH/ref_rounds.json" ]; then
   echo "[96-longrun] ref phase not landed after ${waited}s; re-arming" >&2
-  ( sleep 300; rm -f "/root/repo/tools/tpu_jobs.d/96-parity-longrun-tpu.sh.done" ) \
+  ( sleep 300; rm -f "/root/repo/tools/tpu_jobs.d/90c-parity-longrun-tpu.sh.done" ) \
     >/dev/null 2>&1 &
   disown
   exit 1

@@ -12,7 +12,7 @@ rearm() {
     exit 1
   fi
   echo $((n + 1)) > "$ATTEMPTS"
-  ( sleep 600; rm -f /root/repo/tools/tpu_jobs.d/98-flash-auto-validate.sh.done ) \
+  ( sleep 600; rm -f /root/repo/tools/tpu_jobs.d/90d-flash-auto-validate.sh.done ) \
     >/dev/null 2>&1 &
   disown
   exit 1
