@@ -81,3 +81,52 @@ def test_python_scalar_template_normalized():
     vecs = p.pack({"n": jnp.asarray(7, jnp.int32),
                    "m": jnp.arange(2, dtype=jnp.int32)})
     assert set(vecs) == {"int32"} and vecs["int32"].shape == (3,)
+
+
+# ---------------------------------------------------------------------
+# checkpoint_async single-slot contract (rides here to avoid a new file:
+# both exist for the dispatch/transfer-overhead workstream)
+
+def test_async_latest_single_slot_bounds_skew(tmp_path, monkeypatch):
+    """A second submit must WAIT for the in-flight save: the on-disk
+    ``latest`` can lag by at most the one in-flight snapshot, never by
+    an unbounded latest-wins pileup (resume pairs latest_model with
+    status_log.json, so unbounded skew would double-apply decays)."""
+    import time as _time
+
+    from msrflute_tpu.engine.checkpoint import CheckpointManager
+    from msrflute_tpu.engine.round import ServerState
+
+    def state(r):
+        return ServerState(params={"w": jnp.full((4,), float(r))},
+                           opt_state={}, strategy_state={}, round=r)
+
+    mgr = CheckpointManager(str(tmp_path), backend="msgpack",
+                            async_latest=True)
+    assert mgr.async_latest
+
+    writes = []
+    real = CheckpointManager._write_blob  # staticmethod -> plain function
+
+    def slow_write(path, blob):
+        _time.sleep(0.25)
+        writes.append(path)
+        real(path, blob)
+
+    monkeypatch.setattr(CheckpointManager, "_write_blob",
+                        staticmethod(slow_write))
+
+    tic = _time.time()
+    mgr.save_latest(state(1))     # async: returns ~immediately
+    first_submit = _time.time() - tic
+    tic = _time.time()
+    mgr.save_latest(state(2))     # must BLOCK until save(1) lands
+    second_submit = _time.time() - tic
+    assert first_submit < 0.2, "first submit should not wait for the write"
+    assert second_submit > 0.2, "second submit must wait out the in-flight save"
+
+    mgr.wait()
+    assert len(writes) == 2, "single-slot: no snapshot may be dropped here"
+    restored = mgr.load(state(0))
+    assert restored is not None and restored.round == 2
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), 2.0)
