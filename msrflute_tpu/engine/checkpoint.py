@@ -282,9 +282,15 @@ class CheckpointManager:
         # The copies are enqueued on the device stream BEFORE any later
         # donating program, so they read the pre-donation values; the
         # writer thread's device_get then overlaps the next rounds.
+        # Host numpy leaves (e.g. mutable strategy_state arrays) are
+        # np.copy'd for the same reason: a by-reference share would let
+        # an in-place mutation on the training thread reach the writer's
+        # serialize mid-flight and persist a torn value.
         import jax.numpy as jnp
+        import numpy as _np
         snap = jax.tree.map(
-            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array)
+            else (_np.copy(x) if isinstance(x, _np.ndarray) else x),
             _payload(state))
         with self._mp_cond:
             self._mp_mailbox = snap

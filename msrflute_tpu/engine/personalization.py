@@ -149,8 +149,10 @@ class PersonalizationServer(OptimizationServer):
             # key, and a plain attribute would be invisible to .get()
             self.config.server_config["rounds_per_step"] = 1
 
-    def _round_housekeeping(self, round_no, val_freq, rec_freq):
-        super()._round_housekeeping(round_no, val_freq, rec_freq)
+    def _round_housekeeping(self, round_no, val_freq, rec_freq,
+                            skip_latest=False):
+        super()._round_housekeeping(round_no, val_freq, rec_freq,
+                                    skip_latest=skip_latest)
         # personalized eval: convex logit interpolation over users with
         # local state (reference convex_inference during run_testvalidate,
         # core/client.py:167-183)
@@ -166,7 +168,7 @@ class PersonalizationServer(OptimizationServer):
         client_update = engine.client_update
         cspec = P(CLIENTS_AXIS)
         rspec = P()
-        from jax import shard_map
+        from ..utils.compat import shard_map
 
         def shard_body(global_params, local_params, alphas, arrays,
                        sample_mask, client_mask, client_ids, client_lr, rng):
@@ -280,7 +282,7 @@ class PersonalizationServer(OptimizationServer):
         ``utils/utils.py:600-605``) — users ride the clients mesh axis with
         their local params stacked, exactly like the round path."""
         task = self.task
-        from jax import shard_map
+        from ..utils.compat import shard_map
         cspec = P(CLIENTS_AXIS)
         rspec = P()
 

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from ..config import FLUTEConfig
 from ..data.batching import RoundBatch
@@ -43,7 +43,38 @@ from ..models.base import BaseTask
 from ..optim import make_optimizer
 from ..parallel.mesh import CLIENTS_AXIS, MODEL_AXIS, make_mesh
 from ..strategies.base import BaseStrategy
+from ..utils.flatpack import FlatPacker
 from .client_update import ClientHParams, build_client_update, _clip_by_global_norm
+
+
+@dataclass
+class PackedStats:
+    """Lazy handle to one chunk's round stats, packed on device.
+
+    The round program returns its ~dozen per-round scalars / per-client
+    vectors as ONE 1-D buffer per distinct dtype (``utils/flatpack.py``),
+    so the host pays one ``device_get`` per dtype group per chunk instead
+    of one per stat (the per-buffer dispatch overhead measured by
+    ``tools/dispatch_cost_probe.py``).  Nothing is fetched until
+    :meth:`fetch` — the server's pipelined loop holds this handle while
+    the device executes the next chunk and drains it afterwards.
+    """
+
+    vecs: Dict[str, jax.Array]  #: {dtype_str: 1-D (or [R, n]) device buffer}
+    packer: FlatPacker          #: single-round slot table
+    rounds: int                 #: R rounds in this chunk
+    stacked: bool               #: True if ``vecs`` carry a leading [R] axis
+
+    def fetch(self) -> Dict[str, np.ndarray]:
+        """Fetch + decode: ONE host transfer per dtype group (the honest
+        end-of-chunk fence), then pure numpy views.  Leaves come back
+        with a leading ``[R]`` round axis like ``run_rounds`` always
+        returned."""
+        host = jax.device_get(self.vecs)
+        if self.stacked:
+            return self.packer.unpack_np_stacked(host)
+        tree = self.packer.unpack_np(host)
+        return {k: np.asarray(v)[None] for k, v in tree.items()}
 
 
 @dataclass
@@ -129,6 +160,9 @@ class RoundEngine:
                         else "shard_map")
         self.partition_mode = mesh_cfg.get("partition", default_mode)
         self._multi_cache = {}
+        #: {geometry key: FlatPacker} — slot tables for decoding the
+        #: packed stats buffers, recorded when the round program traces
+        self._stats_packers: Dict[Any, FlatPacker] = {}
         self._round_step = self._build_round_step()
 
     # ------------------------------------------------------------------
@@ -165,6 +199,7 @@ class RoundEngine:
         self._pool = {k: jax.device_put(np.asarray(v), self._replicated)
                       for k, v in pool_arrays.items()}
         self._multi_cache = {}
+        self._stats_packers = {}
         self._round_step = self._build_round_step()
 
     # ------------------------------------------------------------------
@@ -411,7 +446,19 @@ class RoundEngine:
             }
             for k, v in privacy_per_client.items():
                 round_stats[k] = v
-            return new_params, new_opt_state, new_strategy_state, round_stats
+            # single-transfer stats: pack the whole stats tree into one
+            # 1-D buffer per dtype INSIDE the program (pure reshape/concat,
+            # XLA fuses it), so the host fetches one buffer per dtype group
+            # per round instead of ~a dozen scalars.  The packer (the slot
+            # table the host decodes with) is recorded at trace time under
+            # a key both sides can compute from the round geometry alone —
+            # for one engine the stats tree is a function of K only.
+            packer = FlatPacker(round_stats)
+            # sample_mask is [K, S, B] here (scan slices the leading round
+            # axis off before core runs), so K = shape[-3]
+            self._stats_packers[("single", sample_mask.shape[-3])] = packer
+            return (new_params, new_opt_state, new_strategy_state,
+                    packer.pack(round_stats))
 
         self._round_step_core = round_step
         return jax.jit(round_step, donate_argnums=(0, 1, 2))
@@ -569,14 +616,18 @@ class RoundEngine:
                   rng: jax.Array,
                   leakage_threshold: Optional[float] = None,
                   quant_threshold: Optional[float] = None
-                  ) -> Tuple[ServerState, Dict[str, float]]:
-        """Stage one round's data onto the mesh and execute the program."""
+                  ) -> Tuple[ServerState, PackedStats]:
+        """Stage one round's data onto the mesh and execute the program.
+
+        Dispatch is async; the returned :class:`PackedStats` is a lazy
+        handle — nothing crosses the host boundary until ``.fetch()``.
+        """
         arrays, pool_args = self._stage_arrays([batch], self._client_sharding)
         sample_mask = jax.device_put(batch.sample_mask, self._client_sharding)
         client_mask = jax.device_put(batch.client_mask, self._client_sharding)
         client_ids = jax.device_put(batch.client_ids, self._client_sharding)
 
-        params, opt_state, strategy_state, stats = self._round_step(
+        params, opt_state, strategy_state, vecs = self._round_step(
             state.params, state.opt_state, state.strategy_state,
             arrays, sample_mask, client_mask, client_ids,
             jnp.asarray(client_lr, jnp.float32),
@@ -588,7 +639,8 @@ class RoundEngine:
                         else -1.0, jnp.float32), rng, *pool_args)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
-        return new_state, stats
+        packer = self._stats_packers[("single", batch.sample_mask.shape[0])]
+        return new_state, PackedStats(vecs, packer, rounds=1, stacked=False)
 
     # ------------------------------------------------------------------
     def _stage_arrays(self, batches: list, sharding):
@@ -618,25 +670,25 @@ class RoundEngine:
                 for k in batches[0].arrays}, ()
 
     # ------------------------------------------------------------------
-    def run_rounds(self, state: ServerState, batches: list,
-                   client_lrs: list, server_lrs: list,
-                   rng: jax.Array,
-                   leakage_threshold: Optional[float] = None,
-                   quant_thresholds: Optional[list] = None
-                   ) -> Tuple[ServerState, Dict[str, np.ndarray]]:
-        """Run ``len(batches)`` rounds in ONE device program (scan).
-
-        Returns per-round stats stacked on a leading axis.
-        """
+    def dispatch_rounds(self, state: ServerState, batches: list,
+                        client_lrs: list, server_lrs: list,
+                        rng: jax.Array,
+                        leakage_threshold: Optional[float] = None,
+                        quant_thresholds: Optional[list] = None
+                        ) -> Tuple[ServerState, PackedStats]:
+        """Dispatch ``len(batches)`` rounds as ONE device program (the
+        single-round program for R==1, a scan otherwise) WITHOUT blocking:
+        the returned state is the async program output and the stats are a
+        lazy :class:`PackedStats` handle.  This is the dispatch half of
+        the server's software-pipelined loop — the host is free to consume
+        the previous chunk's results while this one executes."""
         R = len(batches)
         if R == 1:
-            new_state, stats = self.run_round(
+            return self.run_round(
                 state, batches[0], client_lrs[0], server_lrs[0], rng,
                 leakage_threshold=leakage_threshold,
                 quant_threshold=(quant_thresholds[0] if quant_thresholds
                                  else None))
-            return new_state, {k: np.asarray([v]) for k, v in
-                               jax.device_get(stats).items()}
         stacked_sharding = NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
         arrays, pool_args = self._stage_arrays(batches, stacked_sharding)
         sample_mask = jax.device_put(
@@ -648,7 +700,7 @@ class RoundEngine:
         rngs = jax.random.split(rng, R)
 
         fn = self._multi_round_fn(R)
-        params, opt_state, strategy_state, stats = fn(
+        params, opt_state, strategy_state, vecs = fn(
             state.params, state.opt_state, state.strategy_state,
             arrays, sample_mask, client_mask, client_ids,
             jnp.asarray(client_lrs, jnp.float32),
@@ -660,4 +712,26 @@ class RoundEngine:
                         else [-1.0] * R, jnp.float32), rngs, *pool_args)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
-        return new_state, jax.device_get(stats)
+        # the scan stacks the core program's packed per-round vecs into
+        # [R, n] buffers; the slot table is the single-round packer the
+        # core trace recorded (the scan body traced it just above)
+        packer = self._stats_packers[
+            ("single", batches[0].sample_mask.shape[0])]
+        return new_state, PackedStats(vecs, packer, rounds=R, stacked=True)
+
+    def run_rounds(self, state: ServerState, batches: list,
+                   client_lrs: list, server_lrs: list,
+                   rng: jax.Array,
+                   leakage_threshold: Optional[float] = None,
+                   quant_thresholds: Optional[list] = None
+                   ) -> Tuple[ServerState, Dict[str, np.ndarray]]:
+        """Run ``len(batches)`` rounds in ONE device program (scan) and
+        fetch the stats (one transfer per dtype group).
+
+        Returns per-round stats stacked on a leading axis.
+        """
+        new_state, packed = self.dispatch_rounds(
+            state, batches, client_lrs, server_lrs, rng,
+            leakage_threshold=leakage_threshold,
+            quant_thresholds=quant_thresholds)
+        return new_state, packed.fetch()

@@ -36,7 +36,7 @@ from ..models.base import BaseTask
 from ..optim import PlateauTracker, make_lr_schedule
 from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
 from ..strategies import select_strategy
-from ..utils.logging import log_metric, print_rank
+from ..utils.logging import flush_metrics, log_metric, print_rank
 from ..utils.metrics import Metric, MetricsDict
 from .checkpoint import CheckpointManager
 from .evaluation import build_eval_fn, evaluate
@@ -65,10 +65,47 @@ class OptimizationServer:
         strategy_cls = select_strategy(config.strategy)
         self.strategy = strategy_cls(config, dp)
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
+
+        # ---- overlapped host/device round pipeline -------------------
+        # pipeline_depth (schema knob, default 1): with depth >= 1 the
+        # host drains round k's tail (stats decode, metric logging,
+        # privacy processing, checkpoint submit) AFTER dispatching round
+        # k+1, so the TPU never idles behind host bookkeeping.  Depth 0
+        # restores the serial loop.  Host-orchestrated paths (RL,
+        # SCAFFOLD, EF, server replay, personalization's per-round
+        # personal pass) and the adaptive leakage threshold feed host
+        # results back into the NEXT dispatch, so they force serial —
+        # computed here, up front, because the checkpoint-async default
+        # below depends on it.
+        self.pipeline_depth = min(int(sc.get("pipeline_depth", 1) or 0), 1)
+        pm_cfg = config.privacy_metrics_config
+        wants_adaptive = bool(
+            pm_cfg is not None and pm_cfg.get("apply_metrics", False)
+            and pm_cfg.get("adaptive_leakage_threshold"))
+        self._pipeline_capable = (
+            not sc.get("wantRL", False) and
+            not getattr(self.strategy, "host_rounds", False) and
+            not getattr(self.strategy, "ef_rounds", False) and
+            not (sc.server_replay_config is not None and
+                 server_train_dataset is not None) and
+            not wants_adaptive and
+            type(self)._sample is OptimizationServer._sample)
+        # pipelined loops route the per-round `latest` save through the
+        # async writer by default so serialization never blocks the next
+        # dispatch; an explicit `checkpoint_async:` in the config wins.
+        # NOTE the documented skew window (docs/RUNBOOK.md): under async
+        # saves, status_log.json can run one round ahead of the on-disk
+        # latest_model after a hard crash.
+        ckpt_async = sc.get("checkpoint_async")
+        if ckpt_async is None:
+            ckpt_async = (self.pipeline_depth > 0 and
+                          self._pipeline_capable and
+                          str(sc.get("checkpoint_backend",
+                                     "msgpack")) == "msgpack")
         self.ckpt = CheckpointManager(
             model_dir, backup_freq=sc.get("model_backup_freq", 100),
             backend=str(sc.get("checkpoint_backend", "msgpack")),
-            async_latest=bool(sc.get("checkpoint_async", False)))
+            async_latest=bool(ckpt_async))
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -197,7 +234,11 @@ class OptimizationServer:
         self._rng = jax.random.PRNGKey(seed)
         self.run_stats: Dict[str, list] = {
             "secsPerRound": [], "secsPerRoundHousekeeping": [],
-            "hostToDeviceBytesPerRound": []}
+            "secsPerRoundHostTail": [], "hostToDeviceBytesPerRound": []}
+        #: chunks whose host tail overlapped the next chunk's device
+        #: execution (observability + the equivalence tests' proof that
+        #: the pipelined run actually pipelined)
+        self.pipelined_chunks = 0
 
         self.state = self.engine.init_state(self._rng)
         pretrained = config.model_config.get("pretrained_model_path")
@@ -425,6 +466,14 @@ class OptimizationServer:
                        type(self)._sample is OptimizationServer._sample)
         prefetched = None  # (R, batches) for the upcoming round_no
 
+        # pipelined mode subsumes prefetch: packing ALREADY overlaps the
+        # device because the whole host tail is deferred past dispatch
+        pipelined = self.pipeline_depth > 0 and self._pipeline_ok()
+        if pipelined:
+            prefetch_ok = False
+        pending = None  # the dispatched-but-undrained chunk (depth-1 slot)
+        self._last_fence = 0.0
+
         round_no = self.state.round
         while round_no < max_iteration:
             tic = time.time()
@@ -475,12 +524,33 @@ class OptimizationServer:
                     quant_thresholds.append(self.quant_thresh)
                     log_metric("Quantization Thresh.", self.quant_thresh,
                                step=round_no + j)
-            self.state, stats = self.engine.run_rounds(
+
+            if pending is not None:
+                # submit the pending chunk's `latest` checkpoint BEFORE
+                # this dispatch donates its state buffers: the async
+                # writer enqueues device-side copies that execute in
+                # stream order, ahead of the donating program
+                self.ckpt.save_latest(pending["state"])
+                pending["latest_saved"] = True
+            self.state, packed = self.engine.dispatch_rounds(
                 self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
                 leakage_threshold=self.max_allowed_leakage,
                 quant_thresholds=quant_thresholds)
+            chunk = {
+                "round0": round_no, "R": R, "state": self.state,
+                "stats": packed, "batches": batches,
+                "client_lr": client_lr, "server_lrs": server_lrs,
+                "tic": tic, "latest_saved": False,
+                # adaptive-DP observability: stash a device-side copy of
+                # the post-chunk clip NOW — the next dispatch donates the
+                # strategy_state buffers this scalar lives in
+                "dp_clip": (jnp.copy(self.state.strategy_state["dp_clip"])
+                            if isinstance(self.state.strategy_state, dict)
+                            and "dp_clip" in self.state.strategy_state
+                            else None),
+            }
             # dispatch is async: pack the next chunk NOW, while the device
-            # executes this one (reading ``stats`` below is what blocks)
+            # executes this one (reading the stats below is what blocks)
             if prefetch_ok and round_no + R < max_iteration:
                 next_R = chunk_R(round_no + R)
                 prefetched = (next_R, pack_chunk(next_R))
@@ -489,46 +559,90 @@ class OptimizationServer:
                 jax.profiler.stop_trace()
                 print_rank(f"wrote profiler trace to {self._profile_dir}")
             self._chunks_run += 1
-
-            # fetch the chunk's stats BEFORE stopping the timer: dispatch
-            # is async and block_until_ready is not a trustworthy fence on
-            # the remote backend, so the host-side read of the stats is
-            # the only honest end-of-chunk sync — recording toc first
-            # would time the dispatch, not the execution
-            stats = jax.device_get(stats)
-            toc = time.time()
-            self.run_stats["secsPerRound"].append((toc - tic) / R)
-
-            # per-round logging (reference core/server.py:362-395 + AzureML)
-            for j in range(R):
-                r = round_no + j
-                n_clients = max(float(stats["client_count"][j]), 1.0)
-                log_metric("Training loss",
-                           float(stats["train_loss_sum"][j]) / n_clients, step=r)
-                log_metric("LR for agg. opt.", server_lrs[j], step=r)
-                log_metric("Client learning rate", client_lr, step=r)
-                log_metric("Agg. grad norm",
-                           float(stats["agg_grad_norm"][j]), step=r)
-            self._process_privacy_stats(
-                stats, round_no,
-                client_mask=np.stack([b.client_mask for b in batches]))
-            if isinstance(self.state.strategy_state, dict) and \
-                    "dp_clip" in self.state.strategy_state:
-                # adaptive DP clipping observability (arXiv:1905.03871);
-                # the post-chunk value is the clip the NEXT round applies,
-                # so it logs at that round's step
-                log_metric("DP clip norm",
-                           float(self.state.strategy_state["dp_clip"]),
-                           step=round_no + R)
-            if self.engine.dump_norm_stats and "norm" in stats:
-                self._dump_norm_stats(stats, batches)
             round_no += R
-            if self.server_replay is not None:
-                self._run_server_replay()
-            self._round_housekeeping(round_no, val_freq, rec_freq)
+
+            if pending is not None:
+                # drain the PREVIOUS chunk's host tail while the device
+                # executes the chunk just dispatched — the pipeline
+                self._drain_chunk(pending, val_freq, rec_freq)
+                self.pipelined_chunks += 1
+                pending = None
+            # the tail at an eval/housekeeping boundary can change LRs,
+            # params (fall-back), and sampling-relevant state for the
+            # NEXT round, so the pipeline must drain before dispatching
+            # past it; the final chunk always drains here too
+            boundary = (round_no >= max_iteration or
+                        round_no % val_freq == 0 or
+                        (round_no % rec_freq == 0 and
+                         self.test_dataset is not None))
+            if pipelined and not boundary:
+                pending = chunk
+            else:
+                self._drain_chunk(chunk, val_freq, rec_freq)
         self.ckpt.wait()  # async checkpoint saves must be durable on return
         self._log_timing()
+        flush_metrics()
         return self.state
+
+    # ------------------------------------------------------------------
+    def _pipeline_ok(self) -> bool:
+        """Whether the overlapped host/device loop may run: everything the
+        host tail feeds back into the NEXT dispatch (RL rewards, SCAFFOLD/
+        EF stores, replay training, the adaptive leakage threshold,
+        personalization's model-dependent sampling) forces serial."""
+        return self._pipeline_capable and self.rl is None and \
+            self.scaffold_store is None and self.ef_store is None and \
+            self.server_replay is None and self.adaptive_leakage is None
+
+    # ------------------------------------------------------------------
+    def _drain_chunk(self, chunk: Dict[str, Any], val_freq: int,
+                     rec_freq: int) -> None:
+        """Consume one dispatched chunk's results: fetch the packed stats
+        (the honest end-of-chunk fence — ONE transfer per dtype group),
+        emit the per-round metrics, process privacy stats, dump norms, and
+        run the round housekeeping.  In the pipelined loop this runs while
+        the device executes the NEXT chunk; in serial mode it runs
+        immediately after dispatch (identical side-effect order either
+        way, which the pipeline equivalence tests pin)."""
+        R = chunk["R"]
+        round0 = chunk["round0"]
+        stats = chunk["stats"].fetch()
+        toc = time.time()
+        # serial chunks: prep-to-fence (chunk tic follows the previous
+        # fence).  Pipelined chunks: fence-to-fence — this chunk's prep
+        # started BEFORE the previous chunk's fence, so tic-based timing
+        # would double-count the overlapped span.
+        self.run_stats["secsPerRound"].append(
+            (toc - max(chunk["tic"], self._last_fence)) / R)
+        self._last_fence = toc
+
+        # per-round logging (reference core/server.py:362-395 + AzureML)
+        for j in range(R):
+            r = round0 + j
+            n_clients = max(float(stats["client_count"][j]), 1.0)
+            log_metric("Training loss",
+                       float(stats["train_loss_sum"][j]) / n_clients, step=r)
+            log_metric("LR for agg. opt.", chunk["server_lrs"][j], step=r)
+            log_metric("Client learning rate", chunk["client_lr"], step=r)
+            log_metric("Agg. grad norm",
+                       float(stats["agg_grad_norm"][j]), step=r)
+        self._process_privacy_stats(
+            stats, round0,
+            client_mask=np.stack([b.client_mask for b in chunk["batches"]]))
+        if chunk["dp_clip"] is not None:
+            # adaptive DP clipping observability (arXiv:1905.03871); the
+            # post-chunk value is the clip the NEXT round applies, so it
+            # logs at that round's step
+            log_metric("DP clip norm", float(chunk["dp_clip"]),
+                       step=round0 + R)
+        if self.engine.dump_norm_stats and "norm" in stats:
+            self._dump_norm_stats(stats, chunk["batches"])
+        if self.server_replay is not None:
+            self._run_server_replay()
+        self._round_housekeeping(round0 + R, val_freq, rec_freq,
+                                 skip_latest=chunk["latest_saved"])
+        self.run_stats["secsPerRoundHostTail"].append(
+            (time.time() - toc) / R)
 
     # ------------------------------------------------------------------
     def _record_staged_bytes(self, batches: list, rounds: int) -> None:
@@ -640,9 +754,12 @@ class OptimizationServer:
 
     # ------------------------------------------------------------------
     def _round_housekeeping(self, round_no: int, val_freq: int,
-                            rec_freq: int) -> None:
+                            rec_freq: int,
+                            skip_latest: bool = False) -> None:
         """Eval cadence, LR plateau decay, fallback, checkpoint, status log
-        (reference ``core/server.py:448-490``)."""
+        (reference ``core/server.py:448-490``).  ``skip_latest``: the
+        pipelined loop already submitted this round's ``latest`` save
+        before the next dispatch donated the state buffers."""
         housekeeping_tic = time.time()
         improved = False
         if round_no % val_freq == 0:
@@ -658,7 +775,8 @@ class OptimizationServer:
         if round_no % rec_freq == 0 and self.test_dataset is not None:
             self._maybe_eval("test", round_no)
 
-        self.ckpt.save_latest(self.state)
+        if not skip_latest:
+            self.ckpt.save_latest(self.state)
         self.ckpt.backup(self.state, round_no, best_names=tuple(self.best_val))
         if self.scaffold_store is not None:
             # commit the control-round marker only once the paired model
@@ -716,6 +834,10 @@ class OptimizationServer:
             "weight": self.lr_weight,
             **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
         })
+        # one buffered-metrics flush per chunk instead of one per metric
+        # line — the jsonl stream stays observable at round granularity
+        # while the host tail stops paying a syscall per scalar
+        flush_metrics()
         self.run_stats["secsPerRoundHousekeeping"].append(
             time.time() - housekeeping_tic)
 

@@ -21,7 +21,9 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 EXPERT_AXIS = "expert"

@@ -283,11 +283,16 @@ def _specs(block_q, block_k, d_p):
 
 
 #: grid semantics: batch/head/outer-block axes are parallel; the inner
-#: accumulation axis must execute in order (scratch carry)
-_SEMANTICS = (pltpu.GridDimensionSemantics.PARALLEL,
-              pltpu.GridDimensionSemantics.PARALLEL,
-              pltpu.GridDimensionSemantics.PARALLEL,
-              pltpu.GridDimensionSemantics.ARBITRARY)
+#: accumulation axis must execute in order (scratch carry).  Older jax
+#: spells these as strings and the params class TPUCompilerParams.
+if hasattr(pltpu, "GridDimensionSemantics"):
+    _PARALLEL = pltpu.GridDimensionSemantics.PARALLEL
+    _ARBITRARY = pltpu.GridDimensionSemantics.ARBITRARY
+else:
+    _PARALLEL, _ARBITRARY = "parallel", "arbitrary"
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+_SEMANTICS = (_PARALLEL, _PARALLEL, _PARALLEL, _ARBITRARY)
 
 
 def _bhsd(x):
@@ -340,7 +345,7 @@ def _fwd(q, k, v, q_offset, k_offset, causal, scale, block_q, block_k,
         ),
         out_shape=[jax.ShapeDtypeStruct(qp.shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, lq_p, _STAT_LANES), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_SEMANTICS),
+        compiler_params=_CompilerParams(dimension_semantics=_SEMANTICS),
         interpret=_resolve_interpret(interpret),
     )(_offs(q_offset, k_offset), qp, kp, vp)
     return _bhsd(out)[:, :Lq, :, :D], lse[:, :, :Lq, 0]
@@ -381,7 +386,7 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
             scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_SEMANTICS),
+        compiler_params=_CompilerParams(dimension_semantics=_SEMANTICS),
         interpret=interp,
     )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
 
@@ -409,7 +414,7 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
         ),
         out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_SEMANTICS),
+        compiler_params=_CompilerParams(dimension_semantics=_SEMANTICS),
         interpret=interp,
     )(offs, qp, kp, vp, gp, lse_p, delta, glse_p)
     return (_bhsd(dq)[:, :Lq, :, :D], _bhsd(dk)[:, :Lk, :, :D],

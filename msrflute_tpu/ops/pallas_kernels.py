@@ -43,19 +43,26 @@ def _pad_to_grid(flat: jnp.ndarray):
     return x.reshape(padded // _LANES, _LANES), n
 
 
+def _interpret_params():
+    """TPU-interpreter params when this jax has them (they implement the
+    pltpu PRNG primitives, unlike generic interpret mode); plain
+    ``interpret=True`` on older releases that predate InterpretParams."""
+    ip = getattr(pltpu, "InterpretParams", None)
+    return ip() if ip is not None else True
+
+
 def _interpret_default():
-    """Off-TPU, run kernels under the TPU interpreter (which implements the
-    pltpu PRNG primitives, unlike generic interpret mode)."""
+    """Off-TPU, run kernels under the TPU interpreter."""
     if jax.default_backend() == "tpu":
         return False
-    return pltpu.InterpretParams()
+    return _interpret_params()
 
 
 def _resolve_interpret(interpret):
     if interpret is None:
         return _interpret_default()
     if interpret is True:
-        return pltpu.InterpretParams()
+        return _interpret_params()
     return interpret
 
 
@@ -97,6 +104,16 @@ def fused_gaussian_noise(flat: jnp.ndarray, scale: jnp.ndarray,
                          interpret: Optional[bool] = None) -> jnp.ndarray:
     """``flat * scale + sigma * N(0,1)`` with on-core noise generation."""
     interpret = _resolve_interpret(interpret)
+    if interpret is True:
+        # old-jax off-TPU path: generic interpret mode cannot lower the
+        # pltpu PRNG primitives, so run the SAME Box-Muller math on
+        # jax.random bits (different stream than the on-core PRNG, same
+        # distribution — the DP-critical transform is shared)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(jnp.asarray(seed)))
+        b1 = jax.random.bits(k1, flat.shape, jnp.uint32)
+        b2 = jax.random.bits(k2, flat.shape, jnp.uint32)
+        x = flat.astype(jnp.float32)
+        return (x * scale + sigma * bits_to_normal(b1, b2)).astype(flat.dtype)
     x2d, n = _pad_to_grid(flat.astype(jnp.float32))
     rows = x2d.shape[0]
     grid = rows // _BLOCK_ROWS

@@ -23,7 +23,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 STAGE_AXIS = "stage"

@@ -135,6 +135,18 @@ SERVER_KEYS = {
     "optimizer_config", "annealing_config", "server_replay_config", "RL",
     "nbest_task_scheduler", "best_model_metric",
     # TPU-native extensions
+    # pipeline_depth: overlapped host/device round pipeline (0 = serial
+    # loop, 1 = default: drain round k's host tail — stats decode, metric
+    # logging, privacy processing, checkpoint submit — while the device
+    # executes round k+1).  Bit-identical params/metrics either way
+    # (tests/test_server_pipeline.py); host-orchestrated paths (wantRL,
+    # scaffold/ef strategies, server replay, personalization) and the
+    # adaptive leakage threshold fall back to serial automatically.  Set
+    # 0 to debug host-tail timing or to keep the per-round `latest`
+    # checkpoint synchronous (pipelined mode defaults checkpoint_async on,
+    # which widens the crash window: after a hard crash status_log.json
+    # may be one round ahead of latest_model — see docs/RUNBOOK.md).
+    "pipeline_depth",
     "rounds_per_step", "clients_per_chunk", "checkpoint_backend",
     "checkpoint_async", "compilation_cache_dir", "secure_agg", "fedbuff",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
@@ -191,6 +203,7 @@ SERVER_FIELD_SPECS = {
     "resume_from_checkpoint": ("bool", None, None),
     "scaffold_device_controls": ("bool", None, None),
     "dump_norm_stats": ("bool", None, None),
+    "pipeline_depth": ("int", 0, None),
     "rounds_per_step": ("int", 1, None),
     "clients_per_chunk": ("int", 1, None),
     "model_backup_freq": ("int", 1, None),

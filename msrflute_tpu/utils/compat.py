@@ -1,0 +1,30 @@
+"""jax version compatibility shims.
+
+Leaf module (imports only jax): the package targets the current jax API
+surface, but the container's baked-in toolchain may lag — ``jax.shard_map``
+was promoted out of ``jax.experimental.shard_map`` (and its replication
+check renamed ``check_rep`` -> ``check_vma``) after 0.4.x.  Every internal
+module imports :func:`shard_map` from here so the call sites can stay
+written against the modern signature.
+"""
+
+from __future__ import annotations
+
+try:  # modern jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax <= 0.4.x: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+              check_vma=None, **kwargs):
+    """``jax.shard_map`` with the modern signature on every jax we run on
+    (``check_vma`` maps to ``check_rep`` on older releases)."""
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -89,6 +89,27 @@ class FlatPacker:
             leaves.append(jnp.reshape(part, shape))
         return jax.tree.unflatten(self.treedef, leaves)
 
+    def unpack_np(self, vecs: Dict[str, np.ndarray]) -> Any:
+        """Host-side inverse of :meth:`pack` over already-fetched numpy
+        buffers — pure views/reshapes, no device round-trip (the decode
+        half of the one-transfer-per-round stats contract)."""
+        leaves = []
+        for dt, off, size, shape in self._slots:
+            part = np.asarray(vecs[dt])[off:off + size]
+            leaves.append(part.reshape(shape))
+        return jax.tree.unflatten(self.treedef, leaves)
+
+    def unpack_np_stacked(self, vecs: Dict[str, np.ndarray]) -> Any:
+        """Like :meth:`unpack_np` but for buffers with a leading stack
+        axis (``[R, n]``, e.g. a scanned multi-round program's per-round
+        packed stats): each leaf comes back as ``[R, *slot_shape]``."""
+        leaves = []
+        for dt, off, size, shape in self._slots:
+            arr = np.asarray(vecs[dt])
+            leaves.append(arr[:, off:off + size].reshape(
+                (arr.shape[0],) + shape))
+        return jax.tree.unflatten(self.treedef, leaves)
+
 
 def build_packer(template: Any) -> FlatPacker:
     return FlatPacker(template)

@@ -74,6 +74,7 @@ def test_benchmark_cnn_learns_digits(digits_images, mesh8, tmp_path):
     assert final > 0.8, f"federated CNN only reached {final:.3f} on digits"
 
 
+@pytest.mark.slow
 def test_benchmark_resnet_learns_digits(digits_images, mesh8, tmp_path):
     """The RESNET_FEDCIFAR100 benchmark model (ResNet-18 + GroupNorm)
     through the federated stack on real images (narrow groups to keep the

@@ -149,6 +149,7 @@ def test_gathered_head_federated_engine(tmp_path):
     assert np.isfinite(float(server.best_val["loss"].value))
 
 
+@pytest.mark.slow
 def test_bert_federated_round_model_sharded(bert_task, tmp_path):
     from msrflute_tpu.engine import OptimizationServer
     from msrflute_tpu.parallel import make_mesh
@@ -256,6 +257,7 @@ def test_bert_pretrained_local_torch_checkpoint(bert_task, tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_local_dp_plus_quantization_e2e(bert_task, tmp_path):
     """The north-star's fifth config (BASELINE.json): BERT MLM federated
     rounds with LOCAL DP (clip + weight-scaling dance) AND gradient

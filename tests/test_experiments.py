@@ -84,6 +84,7 @@ def test_nlg_gru_e2e_from_config(tmp_path):
     assert status["i"] == 2
 
 
+@pytest.mark.slow
 def test_cv_personalization_e2e_from_config(tmp_path):
     """Dirichlet + rotation-wedge partitioned blob through the
     PersonalizationServer (reference experiments/cv; the partitioner is
@@ -106,6 +107,7 @@ def test_cv_personalization_e2e_from_config(tmp_path):
                for n in os.listdir(out / "models" / "personalization"))
 
 
+@pytest.mark.slow
 def test_semisupervision_e2e_from_config(tmp_path):
     """FedLabels uda:1 path end-to-end: the blob's unlabeled ``ux`` gets a
     RandAugment view (``ux_rand``) at featurize time via the config's
@@ -124,6 +126,7 @@ def test_semisupervision_e2e_from_config(tmp_path):
     assert status["i"] == 2
 
 
+@pytest.mark.slow
 def test_fednewsrec_e2e_from_config(tmp_path):
     """MIND-style featurizer end-to-end: clicked/impressions blob ->
     npratio train slates + padded eval slates -> NRMS federated rounds with
@@ -174,6 +177,7 @@ def test_ringlm_e2e_from_config(tmp_path):
     assert status["i"] == 2
 
 
+@pytest.mark.slow
 def test_shakespeare_e2e_from_config(tmp_path):
     out = _run_cli("nlp_rnn_fedshakespeare", {
         "server_config.max_iteration": 2,

@@ -100,6 +100,7 @@ def _mc():
                        extra={"vocab_size": 30, "seq_len": 32})
 
 
+@pytest.mark.slow
 def test_e2e_server_buckets(tmp_path):
     """Through OptimizationServer: a varlen LSTM round trains with
     length_bucketing on and off to the same val loss."""

@@ -216,6 +216,7 @@ def test_classification_train_without_rng_raises():
         task.apply(params, x, rng=None, train=True)
 
 
+@pytest.mark.slow
 def test_fednewsrec_faithful_arch_through_engine(tmp_path):
     """The reference-faithful ``arch: fednewsrec`` variant (frozen word
     table, conv phase, dual-path GRU user encoder) must run through the

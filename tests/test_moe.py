@@ -165,6 +165,7 @@ def test_moe_ringlm_federated_round(mesh8, tmp_path):
     assert "loss" in server.best_val
 
 
+@pytest.mark.slow
 def test_ringlm_sp_with_expert_parallel_moe():
     """Ring attention (sp) + expert-parallel MoE dispatch in ONE model:
     sp_module(expert_axis=...) must match the local module exactly when
