@@ -891,9 +891,17 @@ def bench_pipeline_ab(on_tpu: bool) -> dict:
     from msrflute_tpu.models import make_task
     from msrflute_tpu.parallel import make_mesh
 
+    from msrflute_tpu.utils.strict import strict_transfers_enabled
+
     warm, rounds = (5, 40) if on_tpu else (3, 30)
+    # under MSRFLUTE_STRICT_TRANSFERS=1 both arms run with implicit
+    # device->host transfers DISALLOWED (utils/strict.py, applied by
+    # server.train itself): completing the A/B proves zero
+    # transfer_guard violations per round — the runtime counterpart of
+    # the fluteguard host-sync lint, pinned by tests/test_bench_contract
     out = {"rounds_per_arm": rounds,
-           "protocol": "cnn_femnist" if on_tpu else "lr_mnist"}
+           "protocol": "cnn_femnist" if on_tpu else "lr_mnist",
+           "strict_transfers": strict_transfers_enabled()}
     tails = {}
     for depth in (0, 1):
         if on_tpu:

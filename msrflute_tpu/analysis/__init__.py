@@ -1,0 +1,35 @@
+"""fluteguard — TPU-safety static analysis for msrflute_tpu.
+
+Five checkers, one CLI::
+
+    python -m msrflute_tpu.analysis msrflute_tpu/     # or: tools/flint
+
+- **host-sync**        implicit device->host syncs in hot-path modules
+  (``engine/``, ``ops/``, ``strategies/``); the flatpack packed-stats
+  fetch is the single sanctioned per-round transfer.
+- **donation-aliasing** reads of a buffer after ``donate_argnums``
+  handed it to a dispatch.
+- **jit-purity**       side effects / host-state reads inside traced
+  function bodies.
+- **pallas-shape**     TPU tile alignment of kernel block shapes and
+  tracer-dependent Python loop bounds.
+- **schema-drift**     ``schema.py`` vs ``config.py`` vs docs
+  cross-consistency.
+
+Static findings pair with a runtime strict mode: under
+``MSRFLUTE_STRICT_TRANSFERS=1`` the server round loop runs inside a
+``jax.transfer_guard_device_to_host("disallow")`` scope
+(``utils/strict.py``), so any implicit sync the linter's same-module
+view cannot see raises at the offending line in e2e tests.
+
+Suppression: ``# flint: disable=RULE reason`` (linted for staleness).
+Baseline: ``analysis/baseline.json`` (shipped empty; the tier-1 gate
+``tests/test_flint_clean.py`` fails on any non-baselined finding).
+"""
+
+from .core import (Finding, analyze, default_baseline_path,  # noqa: F401
+                   filter_baseline, load_baseline, write_baseline)
+
+RULES = ("host-sync", "donation-aliasing", "jit-purity", "pallas-shape",
+         "schema-drift", "stale-suppression", "bare-suppression",
+         "parse-error")

@@ -1,0 +1,82 @@
+"""fluteguard CLI: ``python -m msrflute_tpu.analysis [paths]``.
+
+Exit codes: 0 clean (after baseline), 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import RULES
+from .core import (analyze, default_baseline_path, filter_baseline,
+                   load_baseline, write_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="flint",
+        description="fluteguard — TPU-safety static analysis "
+                    "(host-sync, donation-aliasing, jit-purity, "
+                    "pallas-shape, schema-drift)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to analyze (default: the "
+                             "msrflute_tpu package)")
+    parser.add_argument("--root", default=None,
+                        help="path findings are reported relative to "
+                             "(default: cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: the packaged "
+                             "analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baseline ignored")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept current findings into the baseline "
+                             "file and exit 0")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset to run")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print("\n".join(RULES))
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rules: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    findings = analyze(paths, root=root, rules=rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+    if not args.no_baseline:
+        findings = filter_baseline(findings, load_baseline(baseline_path))
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"fluteguard: {len(findings)} finding(s)"
+              + ("" if args.no_baseline else " (after baseline)"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,326 @@
+"""fluteguard core — findings, suppressions, baseline, runner.
+
+Pure stdlib (``ast`` + ``json``): the analyzer must import in any
+environment — including shells where jax would claim the TPU tunnel —
+and finish in seconds, because ``tests/test_flint_clean.py`` runs it
+inside tier-1 on every verify.
+
+Machinery:
+
+- :class:`Finding` — one violation: rule id, file:line, message, fix
+  hint.  The baseline key deliberately omits the line number so an
+  unrelated edit above a baselined finding does not resurrect it.
+- **Suppressions** — ``# flint: disable=RULE[,RULE2] reason`` on the
+  offending line, or alone on the line directly above it.  A reason is
+  mandatory and suppressions are themselves linted: one that stops
+  matching any finding raises ``stale-suppression`` so dead pragmas
+  cannot accumulate (the classic lint-rot failure mode).
+- **Baseline** — ``analysis/baseline.json`` records accepted findings;
+  the CLI exits non-zero only for findings outside it.  The shipped
+  baseline is empty: new debt needs an inline suppression with a reason
+  or a fix, never a silent baseline append.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: modules whose per-round cost rides the TPU queue — the host-sync rule
+#: only applies here (cold paths may sync freely)
+HOT_PATH_PARTS = ("engine", "ops", "strategies")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*flint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+(\S.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at file:line."""
+
+    rule: str      #: rule id, e.g. ``host-sync``
+    path: str      #: path relative to the analysis root, '/'-separated
+    line: int      #: 1-based line number
+    message: str   #: what is wrong, specific to the site
+    hint: str = ""  #: how to fix it
+
+    @property
+    def baseline_key(self) -> str:
+        # line-free on purpose: baselines must survive edits elsewhere
+        # in the file
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# flint: disable=`` pragma."""
+
+    path: str
+    line: int            #: line the pragma sits on
+    rules: Tuple[str, ...]
+    reason: str
+    applies_to: int      #: line the pragma suppresses (itself, or next)
+    used: bool = False
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file handed to every per-file checker."""
+
+    path: str            #: relative path ('/'-separated)
+    abspath: str
+    src: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    @property
+    def is_hot_path(self) -> bool:
+        parts = self.path.split("/")
+        return any(p in parts for p in HOT_PATH_PARTS)
+
+
+# ----------------------------------------------------------------------
+# suppressions
+# ----------------------------------------------------------------------
+def parse_suppressions(info: ModuleInfo) -> List[Suppression]:
+    """Pragmas from real COMMENT tokens only — a docstring QUOTING the
+    syntax (this package's own docs) must not register as a pragma."""
+    import io
+    import tokenize
+
+    out: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(info.src).readline))
+    except (tokenize.TokenError, IndentationError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        # a pragma-only line shields the NEXT line; a trailing pragma
+        # shields its own line
+        own = info.lines[lineno - 1][: tok.start[1]].strip() \
+            if lineno <= len(info.lines) else ""
+        applies_to = lineno + 1 if not own else lineno
+        out.append(Suppression(info.path, lineno, rules, reason, applies_to))
+    return out
+
+
+def apply_suppressions(findings: List[Finding],
+                       suppressions: List[Suppression],
+                       active_rules: Optional[Set[str]] = None
+                       ) -> List[Finding]:
+    """Drop suppressed findings, then append the suppression-hygiene
+    findings (missing reason, stale pragma).  ``active_rules`` (a
+    ``--rules`` subset) limits hygiene judgment to pragmas whose rules
+    actually ran — a jit-purity pragma is not stale just because this
+    invocation only ran host-sync."""
+    by_site: Dict[Tuple[str, int], List[Suppression]] = {}
+    for sup in suppressions:
+        by_site.setdefault((sup.path, sup.applies_to), []).append(sup)
+
+    kept: List[Finding] = []
+    for f in findings:
+        sups = [s for s in by_site.get((f.path, f.line), [])
+                if f.rule in s.rules]
+        if sups:
+            for s in sups:
+                s.used = True
+            continue
+        kept.append(f)
+
+    for sup in suppressions:
+        if active_rules is not None and \
+                not set(sup.rules) & active_rules:
+            continue
+        if not sup.reason:
+            kept.append(Finding(
+                "bare-suppression", sup.path, sup.line,
+                f"suppression of {','.join(sup.rules)} has no reason",
+                hint="write `# flint: disable=RULE why it is safe here`"))
+        if not sup.used:
+            kept.append(Finding(
+                "stale-suppression", sup.path, sup.line,
+                f"suppression of {','.join(sup.rules)} matches no finding",
+                hint="the code it shielded is gone or fixed — delete the "
+                     "pragma"))
+    return kept
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: Optional[str]) -> Set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as fh:
+        raw = json.load(fh)
+    keys = set()
+    for entry in raw.get("entries", []):
+        keys.add(f"{entry['rule']}::{entry['path']}::{entry['message']}")
+    return keys
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "message": f.message} for f in findings]
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["line"]))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def filter_baseline(findings: List[Finding],
+                    baseline: Set[str]) -> List[Finding]:
+    return [f for f in findings if f.baseline_key not in baseline]
+
+
+# ----------------------------------------------------------------------
+# AST helpers shared by the checkers
+# ----------------------------------------------------------------------
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def const_int(node: ast.AST,
+              consts: Optional[Dict[str, int]] = None) -> Optional[int]:
+    """Fold an int literal, a module-constant Name, or +-* of those."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name) and consts and node.id in consts:
+        return consts[node.id]
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv)):
+        lhs = const_int(node.left, consts)
+        rhs = const_int(node.right, consts)
+        if lhs is None or rhs is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return lhs + rhs
+        if isinstance(node.op, ast.Sub):
+            return lhs - rhs
+        if isinstance(node.op, ast.Mult):
+            return lhs * rhs
+        return lhs // rhs if rhs else None
+    return None
+
+
+def module_int_constants(tree: ast.Module) -> Dict[str, int]:
+    """Top-level ``NAME = <int expr>`` bindings (folded iteratively so
+    constants may reference earlier ones)."""
+    consts: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            val = const_int(node.value, consts)
+            if val is not None:
+                consts[node.targets[0].id] = val
+    return consts
+
+
+# ----------------------------------------------------------------------
+# runner
+# ----------------------------------------------------------------------
+def _iter_py_files(paths: List[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            files.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for base, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        files.append(os.path.abspath(
+                            os.path.join(base, name)))
+    return sorted(set(files))
+
+
+def load_module(abspath: str, root: str) -> ModuleInfo:
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    with open(abspath, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=abspath)
+    except SyntaxError as exc:
+        info = ModuleInfo(rel, abspath, src, ast.Module(body=[],
+                                                        type_ignores=[]),
+                          src.splitlines())
+        info.parse_error = exc  # type: ignore[attr-defined]
+        return info
+    return ModuleInfo(rel, abspath, src, tree, src.splitlines())
+
+
+def analyze(paths: List[str], root: Optional[str] = None,
+            rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Run every checker over ``paths``; returns suppression-filtered
+    findings (baseline NOT applied — that is the caller's policy)."""
+    from . import donation, host_sync, jit_purity, pallas_shape, \
+        schema_drift
+
+    root = os.path.abspath(root or os.getcwd())
+    per_file_checkers = [
+        (host_sync.RULE, host_sync.check),
+        (donation.RULE, donation.check),
+        (jit_purity.RULE, jit_purity.check),
+        (pallas_shape.RULE, pallas_shape.check),
+    ]
+
+    findings: List[Finding] = []
+    suppressions: List[Suppression] = []
+    for abspath in _iter_py_files(paths):
+        info = load_module(abspath, root)
+        if getattr(info, "parse_error", None) is not None:
+            exc = info.parse_error  # type: ignore[attr-defined]
+            findings.append(Finding("parse-error", info.path,
+                                    exc.lineno or 1, str(exc.msg)))
+            continue
+        suppressions.extend(parse_suppressions(info))
+        for rule, check in per_file_checkers:
+            if rules and rule not in rules:
+                continue
+            findings.extend(check(info))
+
+    if rules is None or schema_drift.RULE in rules:
+        findings.extend(schema_drift.check_project(root))
+        # schema-drift findings live in .py/.md files that may carry
+        # inline pragmas too; only .py pragmas are parsed, which is fine
+        # because the actionable end of a drift is always the schema.
+
+    return apply_suppressions(findings, suppressions, active_rules=rules)

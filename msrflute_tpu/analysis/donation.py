@@ -1,0 +1,181 @@
+"""donation-aliasing — reads of donated buffers after dispatch.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's buffer to XLA:
+after the (async) dispatch the old array is logically dead, and reading
+it from host code races the in-place program — the torn-buffer class of
+bug PR 1's checkpoint-before-donation ordering dodged by hand
+(``engine/server.py``: the pending chunk's ``latest`` save is submitted
+BEFORE the next dispatch donates the state buffers).
+
+The checker tracks, per module:
+
+1. bindings created from ``jax.jit(fn, donate_argnums=(...))`` (names
+   and ``self.<attr>``s), remembering the donated positions — keyword
+   ``donate_argnames`` is flagged as unanalyzable rather than ignored;
+2. per function scope, calls through those bindings: the argument
+   expressions at donated positions (bare or dotted names) become dead;
+3. any later read of a dead name in the same scope — before a
+   rebinding clears it — is a finding.
+
+Scope-local and flow-naive by design (no branch joins): a read after a
+donation in straight-line order is a bug in every execution that
+reaches it.  Loop bodies are safe because the donating statement
+normally also rebinds the name (``state = step(state, ...)``), which
+clears deadness in statement order.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleInfo, call_name, dotted_name
+
+RULE = "donation-aliasing"
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            if isinstance(kw.value, ast.Tuple):
+                vals = []
+                for elt in kw.value.elts:
+                    if not (isinstance(elt, ast.Constant) and
+                            isinstance(elt.value, int)):
+                        return None
+                    vals.append(elt.value)
+                return tuple(vals)
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int):
+                return (kw.value.value,)
+            return None
+    return None
+
+
+def _collect_donating_bindings(tree: ast.Module, info: ModuleInfo,
+                               findings: List[Finding]):
+    """{binding name: donated positions} for jit-with-donation results;
+    ``self.x`` bindings are keyed as ``"self.x"``."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call) and
+                call_name(value) in _JIT_NAMES):
+            continue
+        if any(kw.arg == "donate_argnames" for kw in value.keywords):
+            findings.append(Finding(
+                RULE, info.path, value.lineno,
+                "donate_argnames is not analyzable by position",
+                hint="use donate_argnums so fluteguard can track the "
+                     "donated bindings"))
+            continue
+        pos = _donated_positions(value)
+        if not pos:
+            continue
+        for tgt in node.targets:
+            name = dotted_name(tgt)
+            if name is not None:
+                donors[name] = pos
+    return donors
+
+
+class _ScopeWalk(ast.NodeVisitor):
+    def __init__(self, info: ModuleInfo, donors: Dict[str, Tuple[int, ...]],
+                 findings: List[Finding]):
+        self.info = info
+        self.donors = donors
+        self.findings = findings
+        #: {dead binding: line of the donating call}
+        self.dead: Dict[str, int] = {}
+
+    def _clear(self, target: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._clear(elt)
+            return
+        name = dotted_name(target)
+        if name is None:
+            return
+        # rebinding `state` also revives `state.params` etc.
+        for dead in [d for d in self.dead
+                     if d == name or d.startswith(name + ".")]:
+            del self.dead[dead]
+
+    def _check_read(self, node: ast.AST) -> None:
+        name = dotted_name(node)
+        if name is None:
+            return
+        parts = name.split(".")
+        for i in range(1, len(parts) + 1):
+            prefix = ".".join(parts[:i])
+            if prefix in self.dead:
+                self.findings.append(Finding(
+                    RULE, self.info.path, node.lineno,
+                    f"`{name}` is read after its buffer was donated to "
+                    f"the dispatch at line {self.dead[prefix]}",
+                    hint="copy what you need BEFORE the donating call "
+                         "(jnp.copy / checkpoint submit), or rebind the "
+                         "name from the call's result"))
+                return
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # arguments are read before the donation takes effect
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        # a method call READS its receiver (`self.table.sum()` touches
+        # the donated table just as surely as a bare load)
+        if isinstance(node.func, ast.Attribute):
+            self._check_read(node.func.value)
+        name = call_name(node)
+        if name in self.donors:
+            for pos in self.donors[name]:
+                if pos < len(node.args):
+                    donated = dotted_name(node.args[pos])
+                    if donated is not None:
+                        self.dead[donated] = node.lineno
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._check_read(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load) and \
+                dotted_name(node) is not None:
+            self._check_read(node)
+        else:
+            # non-name base (e.g. ``f(x).attr``): recurse so the call
+            # inside is still seen
+            self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for tgt in node.targets:
+            self._clear(tgt)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        self._check_read(node.target)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested scopes walked separately
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check(info: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    donors = _collect_donating_bindings(info.tree, info, findings)
+    if not donors:
+        return findings
+    for node in ast.walk(info.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walker = _ScopeWalk(info, donors, findings)
+            for stmt in node.body:
+                walker.visit(stmt)
+    return findings

@@ -1,0 +1,210 @@
+"""jit-purity — side effects inside traced function bodies.
+
+A function handed to ``jax.jit`` / ``shard_map`` / ``jax.vmap`` /
+``jax.lax.scan`` / ``pl.pallas_call`` runs ONCE at trace time; its
+Python side effects do not re-execute per call, and host-state reads
+(`time.time()`, ``np.random``) bake a single stale value into the
+compiled program.  Both are classic silent-wrongness bugs: the program
+"works" and the effect/entropy is simply absent from round 2 onward.
+
+Flagged inside traced bodies (and same-module functions they call,
+transitively):
+
+- wall-clock reads: ``time.time/perf_counter/monotonic``,
+  ``datetime.now``;
+- host RNG: ``np.random.*`` / ``random.*`` (use ``jax.random`` with an
+  explicit key);
+- I/O: ``open``, ``os.remove/replace/rename/makedirs``, ``print``,
+  logging sinks (effects belong outside the trace; use
+  ``jax.debug.print`` / ``io_callback`` when output is really needed);
+- mutation of enclosing object state: assignment/augassign to a
+  ``self.*`` target, ``global`` / ``nonlocal`` declarations.
+
+Traced roots are resolved same-module only: named function arguments
+to the trace entry points, including decorator form (``@jax.jit``) and
+``functools.partial(fn, ...)`` wrapping.  A *deliberate* trace-time
+effect (e.g. recording a slot table the host decodes with) takes an
+inline ``# flint: disable=jit-purity <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, ModuleInfo, call_name, dotted_name
+
+RULE = "jit-purity"
+
+_TRACE_ENTRY = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+                "jax.experimental.shard_map.shard_map", "jax.vmap", "vmap",
+                "jax.lax.scan", "lax.scan", "jax.lax.while_loop",
+                "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
+                "jax.lax.cond", "lax.cond", "jax.checkpoint", "jax.remat",
+                "pl.pallas_call", "pallas_call", "jax.grad",
+                "jax.value_and_grad"}
+
+_IMPURE_CALLS = {
+    "time.time": "wall-clock read bakes ONE trace-time value into the "
+                 "compiled program",
+    "time.perf_counter": "wall-clock read inside a traced body",
+    "time.monotonic": "wall-clock read inside a traced body",
+    "time.sleep": "sleeping inside a traced body only delays tracing",
+    "datetime.now": "wall-clock read inside a traced body",
+    "datetime.datetime.now": "wall-clock read inside a traced body",
+    "open": "file I/O inside a traced body runs once, at trace time",
+    "os.remove": "filesystem mutation inside a traced body",
+    "os.replace": "filesystem mutation inside a traced body",
+    "os.rename": "filesystem mutation inside a traced body",
+    "os.makedirs": "filesystem mutation inside a traced body",
+    "print": "print() inside a traced body fires once at trace time",
+}
+_IMPURE_PREFIXES = {
+    "np.random.": "host RNG inside a traced body — one draw at trace "
+                  "time, frozen thereafter; thread a jax.random key",
+    "numpy.random.": "host RNG inside a traced body; thread a "
+                     "jax.random key",
+    "random.": "host RNG inside a traced body; thread a jax.random key",
+    "logging.": "logging inside a traced body fires once at trace time",
+    "logger.": "logging inside a traced body fires once at trace time",
+}
+
+
+def _named_function_args(call: ast.Call) -> List[str]:
+    """Function names passed (positionally or via partial) to a trace
+    entry point."""
+    out: List[str] = []
+    for arg in call.args:
+        name = dotted_name(arg)
+        if name is not None:
+            out.append(name)
+        elif isinstance(arg, ast.Call) and call_name(arg) in (
+                "functools.partial", "partial"):
+            inner = arg.args and dotted_name(arg.args[0])
+            if inner:
+                out.append(inner)
+    return out
+
+
+def _collect_traced_roots(tree: ast.Module) -> Set[str]:
+    """Function names that reach a trace entry point in this module."""
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) in _TRACE_ENTRY:
+            roots.update(_named_function_args(node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dec_call = dec.func if isinstance(dec, ast.Call) else dec
+                if dotted_name(dec_call) in _TRACE_ENTRY:
+                    roots.add(node.name)
+    return roots
+
+
+def _function_index(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """Every (possibly nested) def in the module by bare name — last
+    definition wins, which matches runtime shadowing."""
+    index: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index[node.name] = node
+    return index
+
+
+def _called_names(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and "." not in name:
+                out.add(name)
+    return out
+
+
+def _expand_reachable(roots: Set[str],
+                      index: Dict[str, ast.FunctionDef]) -> Set[str]:
+    seen: Set[str] = set()
+    frontier = [r for r in roots if r in index]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for callee in _called_names(index[name]):
+            if callee in index and callee not in seen:
+                frontier.append(callee)
+    return seen
+
+
+def _own_body_nodes(fn: ast.FunctionDef) -> List[ast.AST]:
+    """All nodes of ``fn`` excluding nested function subtrees — nested
+    defs are analyzed on their own when they are traced/reached, so
+    walking them here would double-report."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _check_body(fn: ast.FunctionDef, info: ModuleInfo,
+                findings: List[Finding]) -> None:
+    for node in _own_body_nodes(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _IMPURE_CALLS:
+                findings.append(Finding(
+                    RULE, info.path, node.lineno,
+                    f"`{name}(...)` in traced `{fn.name}`: "
+                    f"{_IMPURE_CALLS[name]}",
+                    hint="hoist the effect out of the traced body (or "
+                         "jax.debug.print / io_callback for output)"))
+            elif name:
+                for prefix, why in _IMPURE_PREFIXES.items():
+                    if name.startswith(prefix):
+                        findings.append(Finding(
+                            RULE, info.path, node.lineno,
+                            f"`{name}(...)` in traced `{fn.name}`: {why}",
+                            hint="hoist the effect out of the traced "
+                                 "body"))
+                        break
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                base = tgt
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                root = dotted_name(tgt) or (
+                    dotted_name(tgt.value) if isinstance(
+                        tgt, ast.Subscript) else None)
+                if isinstance(base, ast.Name) and base.id == "self" and \
+                        not isinstance(tgt, ast.Name):
+                    findings.append(Finding(
+                        RULE, info.path, node.lineno,
+                        f"traced `{fn.name}` mutates `{root or 'self'}` — "
+                        "runs once at trace time, not per call",
+                        hint="thread the value through the function's "
+                             "return instead, or suppress with a reason "
+                             "if the trace-time effect is the point"))
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            findings.append(Finding(
+                RULE, info.path, node.lineno,
+                f"traced `{fn.name}` declares "
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                f" {', '.join(node.names)} — trace-time-only mutation",
+                hint="return the value instead of mutating outer state"))
+
+
+def check(info: ModuleInfo) -> List[Finding]:
+    roots = _collect_traced_roots(info.tree)
+    if not roots:
+        return []
+    index = _function_index(info.tree)
+    findings: List[Finding] = []
+    for name in sorted(_expand_reachable(roots, index)):
+        _check_body(index[name], info, findings)
+    return findings

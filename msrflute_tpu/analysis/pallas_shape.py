@@ -1,0 +1,148 @@
+"""pallas-shape — TPU tile geometry + static-bound checks for kernels.
+
+TPU vector memory is tiled (sublane x lane); a block whose trailing
+dims do not align wastes VMEM and, for several op/dtype combos, fails
+to lower at all (mosaic's misaligned-tile errors surface only on real
+silicon — the exact class of chip-day surprise the queue discipline in
+docs/RUNBOOK.md exists to avoid).  Minimum tiles by dtype:
+
+    float32  (8, 128)      bfloat16 (16, 128)      int8/fp8 (32, 128)
+
+Checked, in modules that import ``jax.experimental.pallas``:
+
+- ``pl.BlockSpec`` shapes whose trailing dim is a resolvable int that
+  is neither 1 (degenerate/scalar spec) nor a multiple of 128, and
+  whose second-to-last resolvable int is neither 1 nor a multiple of 8
+  (the f32 floor; bf16 kernels need 16 — the hint says so);
+- ``pltpu.VMEM((..., ...), dtype)`` scratch shapes, same rule;
+- Python ``for`` loops inside kernel bodies whose ``range()`` bound
+  reads a *value* out of a Ref (``x_ref[...]``): trace-time unrollable
+  only if the bound is static — a value-dependent bound cannot compile.
+  (``ref.shape`` / grid constants are static and pass.)
+
+Module-level int constants are folded (``_LANES = 128`` etc.), so the
+common named-constant style is fully checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import (Finding, ModuleInfo, call_name, const_int,
+                   module_int_constants)
+
+RULE = "pallas-shape"
+
+_LANE = 128
+_SUBLANE_F32 = 8
+
+
+def _imports_pallas(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "pallas" in mod or any("pallas" in alias.name
+                                      for alias in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("pallas" in alias.name for alias in node.names):
+                return True
+    return False
+
+
+def _check_shape_tuple(node: ast.AST, consts, info: ModuleInfo,
+                       findings: List[Finding], what: str) -> None:
+    if not isinstance(node, ast.Tuple) or len(node.elts) < 2:
+        return
+    last = const_int(node.elts[-1], consts)
+    second = const_int(node.elts[-2], consts)
+    if last is not None and last != 1 and last % _LANE != 0:
+        findings.append(Finding(
+            RULE, info.path, node.lineno,
+            f"{what} trailing dim {last} is not a multiple of the "
+            f"{_LANE}-lane TPU tile",
+            hint="pad the block's last dim to a multiple of 128 (mask "
+                 "the tail in-kernel)"))
+    if second is not None and second != 1 and second % _SUBLANE_F32 != 0:
+        findings.append(Finding(
+            RULE, info.path, node.lineno,
+            f"{what} sublane dim {second} is not a multiple of "
+            f"{_SUBLANE_F32}",
+            hint="use a multiple of 8 for f32 (16 for bf16, 32 for "
+                 "int8/fp8) so blocks land on whole tiles"))
+
+
+def _kernel_functions(tree: ast.Module) -> Set[str]:
+    """Functions passed (directly or via functools.partial) to
+    ``pl.pallas_call``."""
+    from .jit_purity import _named_function_args
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) in (
+                "pl.pallas_call", "pallas_call"):
+            out.update(_named_function_args(node))
+    return out
+
+
+def _ref_params(fn: ast.FunctionDef) -> Set[str]:
+    """Kernel Ref args, by the ``*_ref`` naming convention plus 'every
+    positional arg' as the conservative fallback when none match."""
+    names = [a.arg for a in fn.args.args]
+    refs = {n for n in names if n.endswith("_ref")}
+    return refs or set(names)
+
+
+def _reads_ref_value(node: ast.AST, refs: Set[str]) -> bool:
+    """True if the expression subscripts a Ref (a VALUE read — dynamic
+    at compile time), as opposed to touching only ``ref.shape``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript) and \
+                isinstance(sub.value, ast.Name) and sub.value.id in refs:
+            return True
+    return False
+
+
+def check(info: ModuleInfo) -> List[Finding]:
+    if not _imports_pallas(info.tree):
+        return []
+    consts = module_int_constants(info.tree)
+    findings: List[Finding] = []
+
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in ("pl.BlockSpec", "BlockSpec") and node.args:
+            _check_shape_tuple(node.args[0], consts, info, findings,
+                               "BlockSpec block shape")
+        elif name in ("pltpu.VMEM", "VMEM") and node.args:
+            _check_shape_tuple(node.args[0], consts, info, findings,
+                               "VMEM scratch shape")
+
+    kernels = _kernel_functions(info.tree)
+    if kernels:
+        index = {}
+        for node in ast.walk(info.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                index[node.name] = node
+        for kname in sorted(kernels):
+            fn = index.get(kname)
+            if fn is None:
+                continue
+            refs = _ref_params(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.For) and \
+                        isinstance(node.iter, ast.Call) and \
+                        call_name(node.iter) == "range" and \
+                        any(_reads_ref_value(a, refs)
+                            for a in node.iter.args):
+                    findings.append(Finding(
+                        RULE, info.path, node.lineno,
+                        f"kernel `{kname}` loops over a bound read from "
+                        "a Ref — tracer-dependent Python loops cannot "
+                        "compile",
+                        hint="make the bound static (block shape / grid "
+                             "constant) or use jax.lax.fori_loop with a "
+                             "masked tail"))
+    return findings

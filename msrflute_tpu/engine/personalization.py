@@ -262,8 +262,8 @@ class PersonalizationServer(OptimizationServer):
             stage(batch.client_ids),
             jnp.asarray(self.initial_lr_client * self.lr_weight, jnp.float32),
             rng)
-        new_lp = jax.device_get(new_lp)
-        new_alpha = jax.device_get(new_alpha)
+        # one bundled fetch (two separate device_gets paid two transfers)
+        new_lp, new_alpha = jax.device_get((new_lp, new_alpha))
         for j in range(k_pad):
             cid = int(batch.client_ids[j])
             if cid < 0:

@@ -455,7 +455,10 @@ class RoundEngine:
             # for one engine the stats tree is a function of K only.
             packer = FlatPacker(round_stats)
             # sample_mask is [K, S, B] here (scan slices the leading round
-            # axis off before core runs), so K = shape[-3]
+            # axis off before core runs), so K = shape[-3].  Deliberate
+            # trace-time effect: the packer IS this trace's slot table —
+            # written once per compile, read only by the host decoder.
+            # flint: disable=jit-purity trace-time slot-table recording is the flatpack contract (one write per compile, host-side reads only)
             self._stats_packers[("single", sample_mask.shape[-3])] = packer
             return (new_params, new_opt_state, new_strategy_state,
                     packer.pack(round_stats))

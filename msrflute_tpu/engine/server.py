@@ -38,6 +38,7 @@ from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
 from ..strategies import select_strategy
 from ..utils.logging import flush_metrics, log_metric, print_rank
 from ..utils.metrics import Metric, MetricsDict
+from ..utils.strict import strict_transfer_scope
 from .checkpoint import CheckpointManager
 from .evaluation import build_eval_fn, evaluate
 from .round import RoundEngine, ServerState
@@ -387,6 +388,16 @@ class OptimizationServer:
         return self.train()
 
     def train(self) -> ServerState:
+        # strict transfer mode (MSRFLUTE_STRICT_TRANSFERS=1, fluteguard's
+        # runtime half): the whole round loop — fused, pipelined, and the
+        # host-orchestrated RL/SCAFFOLD/EF paths — runs with implicit
+        # device->host transfers disallowed; the explicit device_get
+        # fetches (packed stats, eval, host tails) are the only sanctioned
+        # crossings.  No-op without the env flag.
+        with strict_transfer_scope():
+            return self._train_loop()
+
+    def _train_loop(self) -> ServerState:
         sc = self.config.server_config
         max_iteration = int(sc.get("max_iteration", 100))
         # single source of truth for "is this the final round" decisions
@@ -632,8 +643,10 @@ class OptimizationServer:
         if chunk["dp_clip"] is not None:
             # adaptive DP clipping observability (arXiv:1905.03871); the
             # post-chunk value is the clip the NEXT round applies, so it
-            # logs at that round's step
-            log_metric("DP clip norm", float(chunk["dp_clip"]),
+            # logs at that round's step.  Explicit fetch: float() on the
+            # device scalar was an implicit sync (strict transfer mode)
+            log_metric("DP clip norm",
+                       float(jax.device_get(chunk["dp_clip"])),
                        step=round0 + R)
         if self.engine.dump_norm_stats and "norm" in stats:
             self._dump_norm_stats(stats, chunk["batches"])
@@ -733,7 +746,9 @@ class OptimizationServer:
         new_params, tl = self._replay_fn(self.state.params, arrays, mask, rng)
         self.state = ServerState(new_params, self.state.opt_state,
                                  self.state.strategy_state, self.state.round)
-        print_rank(f"server replay loss {float(tl):.4f}")
+        # explicit fetch: float(tl) was an implicit sync on the in-flight
+        # replay program (host-sync lint + strict transfer mode)
+        print_rank(f"server replay loss {float(jax.device_get(tl)):.4f}")
 
     def _dump_norm_stats(self, stats, batches) -> None:
         """Append per-round client grad norms + cosines-vs-aggregate
@@ -914,20 +929,23 @@ class OptimizationServer:
                 weights=ws_np)
             c_norm = float(np.linalg.norm(self.scaffold_store.c))
 
-        # attack metrics + adaptive leakage threshold run here too
-        # (the fused path does this on its own stats)
-        self._process_privacy_stats(jax.device_get(stats), round_no,
+        # ONE fetch for the whole host tail (stats + losses + the device
+        # branch's control norm — device_get passes the host branch's
+        # python float through untouched); separate per-value pulls paid
+        # a transfer each.  The -1 sentinel stays in place until
+        # _round_housekeeping commits the marker AFTER the paired model
+        # checkpoint is durable — resume keeps the controls whenever a
+        # matching checkpoint exists and resets only on a crash inside
+        # the round window
+        stats_np, tls_np, c_norm = jax.device_get((stats, tls, c_norm))
+        self._process_privacy_stats(stats_np, round_no,
                                     client_mask=batch.client_mask)
-        # the -1 sentinel stays in place until _round_housekeeping commits
-        # the marker AFTER the paired model checkpoint is durable — resume
-        # keeps the controls whenever a matching checkpoint exists and
-        # resets only on a crash inside the round window
-        tls_np = np.asarray(jax.device_get(tls))
+        tls_np = np.asarray(tls_np)
         n_real = max(float((batch.client_ids >= 0).sum()), 1.0)
         log_metric("Training loss",
                    float(tls_np.sum() / n_real), step=round_no)
         log_metric("Aggregated weights", float(ws_np.sum()), step=round_no)
-        log_metric("Control norm (server c)", c_norm,
+        log_metric("Control norm (server c)", float(c_norm),
                    step=round_no)  # latest-checkpoint save: housekeeping
 
     # ------------------------------------------------------------------
@@ -1000,9 +1018,12 @@ class OptimizationServer:
             self.ef_store.update(batch.client_ids,
                                  np.asarray(jax.device_get(new_res)), keep)
 
-        self._process_privacy_stats(jax.device_get(stats), round_no,
+        # one fetch for the EF tail's stats + losses (same single-
+        # transfer discipline as the scaffold round)
+        stats_np, tls_np = jax.device_get((stats, tls))
+        self._process_privacy_stats(stats_np, round_no,
                                     client_mask=batch.client_mask)
-        tls_np = np.asarray(jax.device_get(tls))
+        tls_np = np.asarray(tls_np)
         n_real = max(float((batch.client_ids >= 0).sum()), 1.0)
         log_metric("Training loss",
                    float(tls_np.sum() / n_real), step=round_no)
@@ -1019,13 +1040,16 @@ class OptimizationServer:
         pgs, ws, _tls, stats = self.engine.client_payloads(
             self.state, batch, client_lr, rng,
             leakage_threshold=self.max_allowed_leakage)
-        ws_np = np.asarray(jax.device_get(ws))
+        # ONE fetch for everything the RL head reads — per-field
+        # device_get of stats members paid a transfer per stat
+        ws_np, stats_np = jax.device_get((ws, stats))
+        ws_np = np.asarray(ws_np)
         k = int((batch.client_ids >= 0).sum())
         state_vec = np.concatenate([
             ws_np[:k],
-            np.asarray(jax.device_get(stats["mag"]))[:k],
-            np.asarray(jax.device_get(stats["mean"]))[:k],
-            np.asarray(jax.device_get(stats["var_corrected"]))[:k]])
+            np.asarray(stats_np["mag"])[:k],
+            np.asarray(stats_np["mean"])[:k],
+            np.asarray(stats_np["var_corrected"])[:k]])
 
         # candidate A: strategy weights; candidate B: RL weights
         baseline_state = self.engine.apply_custom_weights(
@@ -1054,7 +1078,7 @@ class OptimizationServer:
         # attack metrics + adaptive leakage threshold, same as the fused
         # and scaffold paths — without this the adaptive threshold could
         # never update and the leakage-based dropping would stay inert
-        self._process_privacy_stats(jax.device_get(stats), round_no,
+        self._process_privacy_stats(stats_np, round_no,
                                     client_mask=batch.client_mask)
         self.rl.train(state_vec, action, reward)
         self.rl.save()
@@ -1229,9 +1253,12 @@ class OptimizationServer:
                             f"predictions_{split}_r{round_no}.jsonl")
         T = batches["sample_mask"].shape[0]
         # the cache holds staged DEVICE arrays; pull the two bookkeeping
-        # grids to host once instead of one transfer per step
-        mask_np = np.asarray(jax.device_get(batches["sample_mask"])) > 0
-        uids_np = np.asarray(jax.device_get(batches["user_idx"]))
+        # grids to host in ONE fetch instead of one transfer per grid
+        # (and none per step)
+        mask_np, uids_np = jax.device_get(
+            (batches["sample_mask"], batches["user_idx"]))
+        mask_np = np.asarray(mask_np) > 0
+        uids_np = np.asarray(uids_np)
         with open(path, "w", encoding="utf-8") as fh:
             for t in range(T):
                 mask = mask_np[t]

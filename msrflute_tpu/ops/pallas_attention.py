@@ -277,6 +277,7 @@ def _specs(block_q, block_k, d_p):
     kv_spec = pl.BlockSpec((1, 1, block_k, d_p),
                            lambda b, h, i, j, *_: (b, h, j, 0))
     # per-row lse rides lane-broadcast as [B, H, lq_p, _STAT_LANES]
+    # flint: disable=pallas-shape 8-lane stat blocks are deliberate (lane-broadcast lse, jax's own tpu flash kernel trick); validated on silicon round 4
     lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
                             lambda b, h, i, j, *_: (b, h, i, 0))
     return q_spec, kv_spec, lse_spec
@@ -396,6 +397,7 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
                            lambda b, h, i, j, *_: (b, h, j, 0))
     kk_spec = pl.BlockSpec((1, 1, block_k, d_p),
                            lambda b, h, i, j, *_: (b, h, i, 0))
+    # flint: disable=pallas-shape 8-lane stat blocks are deliberate (lane-broadcast lse, see _specs); validated on silicon round 4
     kq_lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
                                lambda b, h, i, j, *_: (b, h, j, 0))
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal, scale=scale,

@@ -287,8 +287,11 @@ class DeviceControlTable:
                             jnp.asarray(np.asarray(client_ids), jnp.int32))
 
     def update(self, client_ids, steps, pgs, ws, ws_np, client_lr: float,
-               total_clients: int) -> float:
-        """In-program option-II update; returns ``‖c‖`` for logging.
+               total_clients: int):
+        """In-program option-II update; returns ``‖c‖`` for logging as a
+        DEVICE scalar — ``float()`` here blocked the host on the freshly
+        dispatched update program (fluteguard host-sync); the server
+        fetches it bundled with the round's other host-tail reads.
 
         ``ws`` is the device weight vector from the payload program and
         ``ws_np`` its host copy (the server fetches it for logging anyway)
@@ -304,18 +307,22 @@ class DeviceControlTable:
         for row, cid in enumerate(ids_np):
             if int(cid) >= 0 and float(ws_np[row]) > 0.0:
                 self._dirty.add(int(cid))
-        return float(c_norm)
+        return c_norm
 
     def flush(self) -> None:
-        """Write dirty rows + server ``c`` through to the ControlStore."""
+        """Write dirty rows + server ``c`` through to the ControlStore
+        (one bundled fetch — the gather and ``c`` used to pay separate
+        transfers)."""
         import jax
         if self._dirty:
             ids = np.asarray(sorted(self._dirty), np.int32)
-            rows = np.asarray(jax.device_get(self.table[ids]))
-            for cid, row in zip(ids, rows):
+            rows, c = jax.device_get((self.table[ids], self.c))
+            for cid, row in zip(ids, np.asarray(rows)):
                 self.store.set_ci(int(cid), row)
             self._dirty.clear()
-        self.store.set_c(np.asarray(jax.device_get(self.c)))
+        else:
+            c = jax.device_get(self.c)
+        self.store.set_c(np.asarray(c))
 
     def reset(self) -> None:
         """Zero table + ``c`` and the durable store (fallback semantics)."""

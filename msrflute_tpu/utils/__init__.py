@@ -1,3 +1,5 @@
 from .logging import init_logging, print_rank, log_metric  # noqa: F401
 from .metrics import Metric, MetricsDict, weighted_merge  # noqa: F401
 from .io import try_except_save, update_json_log, write_yaml  # noqa: F401
+from .strict import (strict_transfer_scope,  # noqa: F401
+                     strict_transfers_enabled)

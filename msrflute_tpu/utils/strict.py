@@ -1,0 +1,49 @@
+"""Strict transfer mode — the runtime half of fluteguard.
+
+``MSRFLUTE_STRICT_TRANSFERS=1`` wraps the server round loop in a
+``jax.transfer_guard_device_to_host("disallow")`` scope: every IMPLICIT
+device->host sync (``float()``/``int()`` on a device value, ``.item()``,
+``np.asarray`` of a device array, stringification for logging) raises
+at the offending line, while the sanctioned EXPLICIT fetches
+(``jax.device_get`` — the flatpack packed-stats path, eval, the async
+checkpoint writer) pass untouched.
+
+This is what keeps the static model honest: fluteguard's host-sync
+checker sees one module at a time, so a device value that crosses a
+function boundary before being ``float()``ed is invisible to it — but
+not to the guard.  Tier-1 runs the pipeline A/B equivalence under this
+mode (``tests/test_bench_contract.py``), so "zero implicit syncs per
+round" is a tested property, not a review convention.
+
+Only the device->host direction is guarded: host->device staging of
+round batches legitimately rides implicit transfers (``jnp.asarray`` on
+scalars, jit argument staging), and the expensive direction on a
+remote-attached chip is the blocking fetch anyway.
+
+The scope is also thread-local by jax's design — the async checkpoint
+writer's explicit fetches on its own thread are unaffected either way.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+ENV_FLAG = "MSRFLUTE_STRICT_TRANSFERS"
+
+
+def strict_transfers_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+@contextlib.contextmanager
+def strict_transfer_scope():
+    """Disallow implicit device->host transfers when the env flag is
+    set; no-op (and jax-import-free) otherwise."""
+    if not strict_transfers_enabled():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield

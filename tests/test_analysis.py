@@ -1,0 +1,515 @@
+"""fluteguard checker corpus: every rule must fire on its bad snippets
+and stay silent on the good ones, suppressions must work and be linted
+for staleness, and the baseline must round-trip.
+
+The snippets are written to a temp tree because rule applicability is
+path-aware (host-sync fires only under ``engine/``/``ops/``/
+``strategies/``; schema-drift reads a project layout).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from msrflute_tpu.analysis import analyze
+from msrflute_tpu.analysis.core import (Finding, filter_baseline,
+                                        load_baseline, write_baseline)
+from msrflute_tpu.analysis.schema_drift import check_project
+
+
+def run_on(tmp_path, rel, src, rules=None):
+    """Write ``src`` at ``tmp_path/rel`` and analyze just that file."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return analyze([str(path)], root=str(tmp_path),
+                   rules=set(rules) if rules else None)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ======================================================================
+# host-sync
+# ======================================================================
+def test_host_sync_flags_item_call(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.sum(x)
+            return y.item()
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["host-sync"]
+    assert ".item()" in found[0].message
+
+
+def test_host_sync_flags_float_of_jitted_attr_result(tmp_path):
+    # the scaffold.py shape: __init__ builds the jitted callable, a
+    # different method float()s its result
+    found = run_on(tmp_path, "strategies/mod.py", """\
+        import jax
+
+        class Table:
+            def __init__(self):
+                self._update = jax.jit(lambda t: (t, t.sum()))
+
+            def update(self, t):
+                self.table, norm = self._update(t)
+                return float(norm)
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["host-sync"]
+    assert "float(norm)" in found[0].message
+
+
+def test_host_sync_flags_per_field_device_get(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def f(stats):
+            a = jax.device_get(stats["mag"])
+            b = jax.device_get(stats["mean"])
+            return a, b
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["host-sync", "host-sync"]
+
+
+def test_host_sync_flags_np_asarray_and_print_of_device_value(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(x):
+            y = jnp.dot(x, x)
+            host = np.asarray(y)
+            print(f"result {y}")
+            return host
+        """, rules=["host-sync"])
+    assert sorted(rules_of(found)) == ["host-sync", "host-sync"]
+    assert any("np.asarray" in f.message for f in found)
+    assert any("stringifies" in f.message for f in found)
+
+
+def test_host_sync_ignores_config_floats_and_cold_paths(tmp_path):
+    clean = """\
+        import jax.numpy as jnp
+
+        def f(cfg, x):
+            lr = float(cfg.get("lr", 0.1))
+            n = int(cfg["n"])
+            return jnp.asarray(lr) * x
+        """
+    assert run_on(tmp_path, "engine/mod.py", clean,
+                  rules=["host-sync"]) == []
+    # .item() outside engine/ops/strategies is not hot-path business
+    assert run_on(tmp_path, "utils/mod.py", """\
+        def f(v):
+            return v.item()
+        """, rules=["host-sync"]) == []
+
+
+def test_host_sync_explicit_whole_tree_fetch_is_sanctioned(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        class Eng:
+            def __init__(self):
+                self._step = jax.jit(lambda s: (s, {"loss": s.sum()}))
+
+            def round(self, s):
+                s, stats = self._step(s)
+                host = jax.device_get(stats)
+                return float(host["loss"])
+        """, rules=["host-sync"])
+    assert found == []
+
+
+def test_host_sync_lone_dict_pick_fetch_is_one_honest_transfer(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def drain(chunk):
+            return jax.device_get(chunk["dp_clip"])
+        """, rules=["host-sync"])
+    assert found == []
+
+
+# ======================================================================
+# donation-aliasing
+# ======================================================================
+def test_donation_flags_read_after_donating_dispatch(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+
+        step = jax.jit(lambda s, x: s, donate_argnums=(0,))
+
+        def round(state, x):
+            new = step(state, x)
+            return state.params
+        """, rules=["donation-aliasing"])
+    assert rules_of(found) == ["donation-aliasing"]
+    assert "state.params" in found[0].message
+
+
+def test_donation_flags_self_attr_donor_binding(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+
+        class T:
+            def __init__(self):
+                self._scatter = jax.jit(lambda t, v: t,
+                                        donate_argnums=(0,))
+
+            def go(self, v):
+                out = self._scatter(self.table, v)
+                return self.table.sum()
+        """, rules=["donation-aliasing"])
+    assert rules_of(found) == ["donation-aliasing"]
+
+
+def test_donation_rebind_clears_and_non_donated_args_are_free(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+
+        step = jax.jit(lambda s, x: s, donate_argnums=(0,))
+        tail = jax.jit(lambda a, b: a, donate_argnums=(1,))
+
+        def round(state, x):
+            state = step(state, x)
+            return state.params
+
+        def other(a, b):
+            out = tail(a, b)
+            return a + out
+        """, rules=["donation-aliasing"])
+    assert found == []
+
+
+def test_donation_argnames_is_reported_unanalyzable(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnames=("s",))
+        """, rules=["donation-aliasing"])
+    assert rules_of(found) == ["donation-aliasing"]
+    assert "donate_argnames" in found[0].message
+
+
+# ======================================================================
+# jit-purity
+# ======================================================================
+def test_jit_purity_flags_wall_clock_in_traced_body(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+        import time
+
+        def body(x):
+            return x * time.time()
+
+        fn = jax.jit(body)
+        """, rules=["jit-purity"])
+    assert rules_of(found) == ["jit-purity"]
+    assert "time.time" in found[0].message
+
+
+def test_jit_purity_flags_self_mutation_and_host_rng_via_helper(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return x + np.random.rand()
+
+        class Eng:
+            def build(self):
+                def step(x):
+                    self.cache["k"] = x
+                    return helper(x)
+                return jax.jit(step)
+        """, rules=["jit-purity"])
+    assert sorted(rules_of(found)) == ["jit-purity", "jit-purity"]
+    assert any("np.random" in f.message for f in found)
+    assert any("mutates" in f.message for f in found)
+
+
+def test_jit_purity_untraced_effects_and_jax_random_are_fine(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+        import time
+
+        def body(x, key):
+            return x + jax.random.normal(key, x.shape)
+
+        fn = jax.jit(body)
+
+        def host_tail():
+            return time.time()
+        """, rules=["jit-purity"])
+    assert found == []
+
+
+def test_jit_purity_decorator_form_and_scan_body_are_roots(tmp_path):
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("tracing", x)
+            return x
+
+        def outer(xs):
+            def body(c, x):
+                global COUNT
+                return c, x
+            return jax.lax.scan(body, 0, xs)
+        """, rules=["jit-purity"])
+    assert sorted(rules_of(found)) == ["jit-purity", "jit-purity"]
+
+
+# ======================================================================
+# pallas-shape
+# ======================================================================
+def test_pallas_shape_flags_misaligned_block_dims(tmp_path):
+    found = run_on(tmp_path, "ops/pallas_bad.py", """\
+        from jax.experimental import pallas as pl
+
+        BAD_LANES = 100
+
+        spec_a = pl.BlockSpec((8, BAD_LANES), lambda i: (i, 0))
+        spec_b = pl.BlockSpec((7, 128), lambda i: (i, 0))
+        """, rules=["pallas-shape"])
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "trailing dim 100" in msgs and "sublane dim 7" in msgs
+
+
+def test_pallas_shape_flags_tracer_dependent_loop_bound(tmp_path):
+    found = run_on(tmp_path, "ops/pallas_loop.py", """\
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            for i in range(x_ref[0]):
+                o_ref[i] = 0.0
+
+        def call(x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+        """, rules=["pallas-shape"])
+    assert rules_of(found) == ["pallas-shape"]
+    assert "tracer-dependent" in found[0].message
+
+
+def test_pallas_shape_aligned_constants_and_static_bounds_pass(tmp_path):
+    found = run_on(tmp_path, "ops/pallas_good.py", """\
+        import jax
+        from jax.experimental import pallas as pl
+
+        _LANES = 128
+        _ROWS = 2 * 128
+
+        spec = pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0))
+
+        def kern(x_ref, o_ref):
+            for i in range(x_ref.shape[0]):
+                o_ref[i] = x_ref[i]
+
+        def call(x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+        """, rules=["pallas-shape"])
+    assert found == []
+
+
+def test_pallas_shape_only_runs_on_pallas_importing_modules(tmp_path):
+    found = run_on(tmp_path, "ops/not_pallas.py", """\
+        spec = ((8, 100), (7, 128))
+        """, rules=["pallas-shape"])
+    assert found == []
+
+
+# ======================================================================
+# schema-drift
+# ======================================================================
+def _write_project(tmp_path, server_keys, fields, specs, runbook,
+                   doc_extra=""):
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    keys = ", ".join(repr(k) for k in server_keys)
+    spec_items = ", ".join(f"{k!r}: ('int', 0, None)" for k in specs)
+    (pkg / "schema.py").write_text(
+        f"SERVER_KEYS = {{{keys}}}\n"
+        f"SERVER_FIELD_SPECS = {{{spec_items}}}\n")
+    field_lines = "\n".join(f"    {f}: int = 0" for f in fields)
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n" + (field_lines or "    pass") + "\n")
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "RUNBOOK.md").write_text(runbook + "\n" + doc_extra)
+    return str(tmp_path)
+
+
+def test_schema_drift_clean_project_passes(tmp_path):
+    root = _write_project(
+        tmp_path,
+        server_keys=["max_iteration", "pipeline_depth"],
+        fields=["max_iteration"],
+        specs=["pipeline_depth"],
+        runbook="`server_config.pipeline_depth` controls the overlap.",
+    )
+    assert check_project(root, documented_knobs=("pipeline_depth",)) == []
+
+
+def test_schema_drift_flags_dataclass_field_missing_from_schema(tmp_path):
+    root = _write_project(
+        tmp_path,
+        server_keys=["max_iteration"],
+        fields=["max_iteration", "new_knob"],
+        specs=[],
+        runbook="nothing relevant",
+    )
+    found = check_project(root, documented_knobs=())
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "new_knob" in found[0].message
+
+
+def test_schema_drift_flags_spec_for_unknown_key_and_doc_mention(tmp_path):
+    root = _write_project(
+        tmp_path,
+        server_keys=["max_iteration"],
+        fields=["max_iteration"],
+        specs=["ghost_knob"],
+        runbook="set `server_config.dropped_knob` for extra speed",
+    )
+    found = check_project(root, documented_knobs=())
+    kinds = sorted(f.message.split()[0] for f in found)
+    assert len(found) == 2
+    assert any("ghost_knob" in f.message for f in found)
+    assert any("dropped_knob" in f.message for f in found)
+
+
+def test_schema_drift_flags_undocumented_operator_knob(tmp_path):
+    root = _write_project(
+        tmp_path,
+        server_keys=["pipeline_depth", "max_iteration"],
+        fields=["max_iteration"],
+        specs=[],
+        runbook="no knobs documented here",
+    )
+    found = check_project(root, documented_knobs=("pipeline_depth",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "pipeline_depth" in found[0].message
+
+
+def test_schema_drift_real_tree_is_consistent():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    found = check_project(repo)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+# ======================================================================
+# suppressions + baseline
+# ======================================================================
+def test_inline_suppression_with_reason_silences_the_finding(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.sum(x)
+            # flint: disable=host-sync summary scalar, end of run only
+            return y.item()
+        """, rules=["host-sync"])
+    assert found == []
+
+
+def test_suppression_without_reason_is_flagged(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.sum(x)
+            return y.item()  # flint: disable=host-sync
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["bare-suppression"]
+
+
+def test_stale_suppression_is_flagged(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        def f(x):
+            # flint: disable=host-sync this code was fixed long ago
+            return x + 1
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["stale-suppression"]
+
+
+def test_rules_subset_does_not_stale_other_rules_pragmas(tmp_path):
+    """A jit-purity pragma is not stale just because this invocation
+    only ran host-sync — staleness is judged per rules that ran."""
+    src = """\
+        import jax
+        import time
+
+        def body(x):
+            # flint: disable=jit-purity deliberate trace-time stamp
+            return x * time.time()
+
+        fn = jax.jit(body)
+        """
+    assert run_on(tmp_path, "mod.py", src, rules=["host-sync"]) == []
+    # the full run still honors (and uses) the pragma
+    assert run_on(tmp_path, "mod.py", src) == []
+    # and a genuinely stale pragma still fires when its rule runs
+    stale = run_on(tmp_path, "mod.py", """\
+        def f(x):
+            # flint: disable=jit-purity nothing traced here anymore
+            return x
+        """, rules=["jit-purity"])
+    assert rules_of(stale) == ["stale-suppression"]
+
+
+def test_docstring_quoting_the_pragma_is_not_a_suppression(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", '''\
+        """Docs: write `# flint: disable=host-sync reason` to suppress."""
+
+        def f(v):
+            return v
+        ''', rules=["host-sync"])
+    assert found == []
+
+
+def test_baseline_round_trip(tmp_path):
+    src = """\
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x).item()
+        """
+    found = run_on(tmp_path, "engine/mod.py", src, rules=["host-sync"])
+    assert len(found) == 1
+
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), found)
+    again = run_on(tmp_path, "engine/mod.py", src, rules=["host-sync"])
+    assert filter_baseline(again, load_baseline(str(baseline))) == []
+    # the baseline key survives the finding moving to another line
+    moved = run_on(tmp_path, "engine/mod.py", "\n\n" + textwrap.dedent(src),
+                   rules=["host-sync"])
+    assert filter_baseline(moved, load_baseline(str(baseline))) == []
+    # an empty/missing baseline resurrects it
+    assert len(filter_baseline(again, load_baseline(None))) == 1
+    entries = json.loads(baseline.read_text())["entries"]
+    assert entries and entries[0]["rule"] == "host-sync"
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from msrflute_tpu.analysis.__main__ import main
+    bad = tmp_path / "engine" / "mod.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import jax.numpy as jnp\n"
+                   "def f(x):\n"
+                   "    return jnp.sum(x).item()\n")
+    assert main([str(bad), "--root", str(tmp_path), "--no-baseline"]) == 1
+    good = tmp_path / "engine" / "ok.py"
+    good.write_text("def f():\n    return 1\n")
+    assert main([str(good), "--root", str(tmp_path), "--no-baseline"]) == 0

@@ -307,6 +307,120 @@ def test_packed_stats_one_host_fetch_per_round(tmp_path, monkeypatch):
     assert set(next(iter(packers.values())).sizes) == {"float32"}
 
 
+def test_pipeline_ab_zero_transfer_guard_violations_under_strict_mode(
+        tmp_path, monkeypatch):
+    """The faithful-mode pipeline A/B's strict-transfers contract
+    (fluteguard's runtime half): under ``MSRFLUTE_STRICT_TRANSFERS=1``
+    both arms — serial (pipeline_depth=0) and pipelined (depth=1) — run
+    with implicit device->host transfers disallowed, finish
+    bit-identically, and the bench A/B records the mode.
+
+    jax's own ``transfer_guard`` cannot fire on the CPU backend (device
+    memory IS host memory, no transfer exists), so the zero-violation
+    assertion is enforced directly at jax's host-materialization points:
+    ``ArrayImpl._value`` / ``__array__`` accesses on the training thread
+    that do NOT come through an explicit ``jax.device_get`` are implicit
+    syncs, and there must be none."""
+    import threading
+
+    import jax
+    import jax._src.array as jarray
+    import numpy as np
+
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.utils.strict import strict_transfers_enabled
+
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    assert strict_transfers_enabled()
+
+    rng = np.random.default_rng(0)
+    users, per = [], []
+    for u in range(8):
+        users.append(f"u{u}")
+        per.append({"x": rng.normal(size=(8, 8)).astype(np.float32),
+                    "y": rng.integers(0, 4, 8).astype(np.int32)})
+
+    # sanctioned-fetch shim: explicit device_get sets a thread-local
+    # flag; any _value/__array__ materialization without it is implicit
+    sanctioned = threading.local()
+    real_get = jax.device_get
+
+    def sanctioning_get(x):
+        sanctioned.on = True
+        try:
+            return real_get(x)
+        finally:
+            sanctioned.on = False
+
+    implicit = []
+    train_thread = threading.current_thread()
+    real_value = jarray.ArrayImpl._value
+    real_array = jarray.ArrayImpl.__array__
+
+    def spy_value(self):
+        if not getattr(sanctioned, "on", False) and \
+                threading.current_thread() is train_thread:
+            implicit.append("_value")
+        return real_value.fget(self)
+
+    def spy_array(self, *args, **kwargs):
+        if not getattr(sanctioned, "on", False) and \
+                threading.current_thread() is train_thread:
+            implicit.append("__array__")
+        return real_array(self, *args, **kwargs)
+
+    params_by_depth = {}
+    for depth in (0, 1):
+        cfg = FLUTEConfig.from_dict({
+            "model_config": {"model_type": "LR", "num_classes": 4,
+                             "input_dim": 8},
+            "strategy": "fedavg",
+            "server_config": {
+                "max_iteration": 6, "num_clients_per_iteration": 4,
+                "initial_lr_client": 0.2, "rounds_per_step": 1,
+                "pipeline_depth": depth,
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "val_freq": 100, "initial_val": False, "data_config": {}},
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": 0.2},
+                "data_config": {"train": {"batch_size": 4}}},
+        })
+        ds = ArraysDataset(list(users), [dict(p) for p in per])
+        server = OptimizationServer(make_task(cfg.model_config), cfg, ds,
+                                    model_dir=str(tmp_path / f"d{depth}"),
+                                    seed=0)
+        monkeypatch.setattr(jax, "device_get", sanctioning_get)
+        monkeypatch.setattr(jarray.ArrayImpl, "_value",
+                            property(spy_value))
+        monkeypatch.setattr(jarray.ArrayImpl, "__array__", spy_array)
+        try:
+            state = server.train()
+        finally:
+            monkeypatch.setattr(jarray.ArrayImpl, "_value", real_value)
+            monkeypatch.setattr(jarray.ArrayImpl, "__array__", real_array)
+            monkeypatch.setattr(jax, "device_get", real_get)
+        assert state.round == 6
+        params_by_depth[depth] = jax.device_get(state.params)
+        if depth:
+            assert server.pipelined_chunks > 0  # the A arm really overlapped
+
+    assert implicit == [], (
+        f"implicit device->host syncs under strict mode: {implicit}")
+    # bit-identical across arms — the A/B's standing equivalence contract
+    a = jax.tree.leaves(params_by_depth[0])
+    b = jax.tree.leaves(params_by_depth[1])
+    for la, lb in zip(a, b):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+    # and bench.py's A/B section reports the mode it measured under
+    sys.path.insert(0, REPO)
+    import bench  # noqa: F401  (import proves the flag plumbing exists)
+    import inspect
+    assert "strict_transfers" in inspect.getsource(bench.bench_pipeline_ab)
+
+
 def test_bench_bert_gathered_entry_configures_the_gathered_head():
     """The round-5 mlm_bert_gathered TPU entry must actually select the
     gathered MLM head (and keep the base mlm_bert entry untouched so

@@ -1,0 +1,47 @@
+"""Tier-1 lint gate: the shipped tree must be fluteguard-clean.
+
+Runs the analyzer in-process over the whole ``msrflute_tpu`` package —
+the exact check ``python -m msrflute_tpu.analysis msrflute_tpu/`` (alias
+``tools/flint``) performs — and fails on ANY finding outside the
+committed baseline (``analysis/baseline.json``, shipped empty).  New
+hot-path debt therefore needs either a fix or an inline
+``# flint: disable=RULE reason`` that survives review; a silent
+baseline append does not ride along.
+
+Budget: the gate must stay trivially cheap (<20 s — it is pure-ast, no
+jax import) so it can sit inside tier-1's wall-clock budget forever.
+"""
+
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "msrflute_tpu")
+
+
+def test_package_tree_is_flint_clean_against_committed_baseline():
+    from msrflute_tpu.analysis import analyze
+    from msrflute_tpu.analysis.core import (default_baseline_path,
+                                            filter_baseline, load_baseline)
+
+    tic = time.time()
+    findings = analyze([PKG], root=REPO)
+    fresh = filter_baseline(findings,
+                            load_baseline(default_baseline_path()))
+    took = time.time() - tic
+    assert fresh == [], (
+        "fluteguard found non-baselined violations (fix them or add an "
+        "inline `# flint: disable=RULE reason`):\n"
+        + "\n".join(f.render() for f in fresh))
+    assert took < 20.0, f"lint gate too slow for tier-1 ({took:.1f}s)"
+
+
+def test_every_checker_is_exercised_by_the_real_tree_or_corpus():
+    """The suite's five rules all exist and are wired into analyze() —
+    a checker that silently fell out of the registry would leave its
+    rule permanently green."""
+    from msrflute_tpu.analysis import RULES
+
+    for rule in ("host-sync", "donation-aliasing", "jit-purity",
+                 "pallas-shape", "schema-drift"):
+        assert rule in RULES

@@ -75,3 +75,65 @@ def test_agent_shell_with_cpu_env_unblocked():
     rc, err = _import_rc(
         {"CLAUDECODE": "1", "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
     assert rc == 0, err
+
+
+# ----------------------------------------------------------------------
+# direct unit coverage of msrflute_tpu/_guard.py::guard_tunnel_claim —
+# the subprocess tests above pin the import-time contract; these pin the
+# function's own env-marker logic (all four bypass combinations plus the
+# two refusal shapes) without paying a subprocess per case.
+# ----------------------------------------------------------------------
+import pytest  # noqa: E402
+
+from msrflute_tpu._guard import guard_tunnel_claim  # noqa: E402
+
+_GUARD_VARS = ("MSRFLUTE_CHIP_JOB", "CLAUDECODE", "AI_AGENT",
+               "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+
+
+def _set_env(monkeypatch, **vals):
+    for var in _GUARD_VARS:
+        monkeypatch.delenv(var, raising=False)
+    for var, val in vals.items():
+        monkeypatch.setenv(var, val)
+
+
+def test_unit_chip_job_marker_bypasses(monkeypatch):
+    # sanctioned queue job: everything else screams "unsafe" and the
+    # marker still wins (tools/tpu_runner.sh exports it)
+    _set_env(monkeypatch, MSRFLUTE_CHIP_JOB="1", CLAUDECODE="1",
+             PALLAS_AXON_POOL_IPS="127.0.0.1", JAX_PLATFORMS="axon")
+    guard_tunnel_claim()  # must not raise
+
+
+def test_unit_non_agent_shell_bypasses(monkeypatch):
+    # the round driver / human operators carry no agent markers
+    _set_env(monkeypatch, PALLAS_AXON_POOL_IPS="127.0.0.1",
+             JAX_PLATFORMS="axon")
+    guard_tunnel_claim()  # must not raise
+
+
+def test_unit_axon_env_unset_bypasses(monkeypatch):
+    # agent shell but no pool IPs: sitecustomize never registers axon,
+    # nothing to protect
+    _set_env(monkeypatch, CLAUDECODE="1")
+    guard_tunnel_claim()  # must not raise
+
+
+def test_unit_explicit_cpu_platform_bypasses(monkeypatch):
+    # agent shell with pool IPs but an axon-free platform pinned
+    _set_env(monkeypatch, AI_AGENT="1",
+             PALLAS_AXON_POOL_IPS="127.0.0.1", JAX_PLATFORMS="cpu")
+    guard_tunnel_claim()  # must not raise
+
+
+@pytest.mark.parametrize("platforms", ["", "axon", "axon,cpu"])
+def test_unit_agent_plus_pool_refused(monkeypatch, platforms):
+    # the unsafe shape: agent marker + pool IPs, with JAX_PLATFORMS
+    # unset (auto-select picks the registered plugin) or naming axon
+    env = {"CLAUDECODE": "1", "PALLAS_AXON_POOL_IPS": "127.0.0.1"}
+    if platforms:
+        env["JAX_PLATFORMS"] = platforms
+    _set_env(monkeypatch, **env)
+    with pytest.raises(RuntimeError, match="single-client"):
+        guard_tunnel_claim()
