@@ -610,7 +610,11 @@ def _server_overhead_extras(server) -> dict:
     """Host-side overhead observability riding every protocol entry:
     staged host->device bytes per round (the communication story) and the
     per-round host-tail seconds (what the pipelined loop overlaps with
-    device execution — ISSUE 1 satellite)."""
+    device execution — ISSUE 1 satellite).  When the run injected faults
+    (``server_config.chaos``), the chaos config + fault counters ride
+    along too, so a chaos run can never be silently compared against a
+    clean baseline (ISSUE 3 satellite — the ``strict_transfers``
+    discipline applied to fault injection)."""
     out = {}
     staged = server.run_stats.get("hostToDeviceBytesPerRound") or []
     tail = server.run_stats.get("secsPerRoundHostTail") or []
@@ -620,6 +624,12 @@ def _server_overhead_extras(server) -> dict:
     if tail:
         out["host_tail_secs_p50"] = round(
             float(np.percentile(tail, 50)), 5)
+    chaos = getattr(server, "chaos", None)
+    if chaos is not None:
+        out["chaos"] = dict(chaos.describe(),
+                            fault_counters={k: round(float(v), 1)
+                                            for k, v in
+                                            chaos.counters.items()})
     return out
 
 
@@ -1078,6 +1088,23 @@ def main() -> None:
 
     extras = _LINE["extras"]  # global so a kill-signal flush sees updates
     extras.update({"backend": backend, "backend_reason": backend_reason})
+    # chaos mode is part of the bench CONTRACT: always recorded, so a
+    # fault-injected run can never be silently compared against a clean
+    # baseline.  BENCH_CHAOS enables it for every protocol — "1" for the
+    # default drill (dropout + straggling + checkpoint IO faults), or a
+    # JSON server_config.chaos block for a custom schedule.
+    chaos_env = os.environ.get("BENCH_CHAOS")
+    if chaos_env:
+        chaos_cfg = (json.loads(chaos_env)
+                     if chaos_env.strip().startswith("{") else
+                     {"seed": 0, "dropout_rate": 0.1,
+                      "straggler_rate": 0.1, "straggler_inflation": 2.0,
+                      "ckpt_io_error_rate": 0.05})
+        for spec in protocols.values():
+            spec["cfg"].server_config["chaos"] = dict(chaos_cfg)
+        extras["chaos"] = dict(chaos_cfg, enabled=True)
+    else:
+        extras["chaos"] = {"enabled": False}
     if not on_tpu:
         # CPU fallback: carry the most recent committed raw on-chip
         # artifact, if any (written only by a fully successful TPU

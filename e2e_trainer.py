@@ -127,6 +127,18 @@ def main() -> None:
                         model_dir=model_dir, mesh=mesh)
     server.run()
 
+    # graceful preemption (SIGTERM/SIGINT mid-run, or the chaos drill's
+    # preempt_at_round): the server drained the in-flight round, wrote a
+    # durable checkpoint + rng resume anchors, and returned.  Exit with
+    # EX_TEMPFAIL (75) so schedulers re-queue the job rather than scoring
+    # it as success or crash; re-launching the same command with
+    # server_config.resume_from_checkpoint: true continues bit-exactly
+    # (docs/RUNBOOK.md "Preemption & recovery drill").
+    if getattr(server, "preempted", False):
+        print_rank("exiting preempted (EX_TEMPFAIL); resume with "
+                   "server_config.resume_from_checkpoint: true")
+        raise SystemExit(os.EX_TEMPFAIL)
+
 
 if __name__ == "__main__":
     main()

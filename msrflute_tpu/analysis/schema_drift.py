@@ -43,6 +43,10 @@ _SPEC_MAP = {
     "SERVER_FIELD_SPECS": "SERVER_KEYS",
     "CLIENT_FIELD_SPECS": "CLIENT_KEYS",
     "DATASET_FIELD_SPECS": "DATASET_KEYS",
+    # resilience blocks (PR 3): their type rules must describe keys the
+    # unknown-key pass knows, like every other section
+    "CHAOS_FIELD_SPECS": "CHAOS_KEYS",
+    "CHECKPOINT_RETRY_FIELD_SPECS": "CHECKPOINT_RETRY_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -55,6 +59,10 @@ _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
 DOCUMENTED_KNOBS = (
     "pipeline_depth", "rounds_per_step", "checkpoint_async",
     "checkpoint_backend", "compilation_cache_dir", "step_bucketing",
+    # resilience knobs: an operator who cannot find the preemption /
+    # fault-injection drill in the runbook will learn about it from a
+    # lost run instead
+    "chaos", "checkpoint_retry",
 )
 
 _DOC_MENTION_RE = re.compile(

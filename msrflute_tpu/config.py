@@ -393,6 +393,14 @@ class ServerConfig(Config):
     server_replay_config: Optional[ServerReplayConfig] = None
     RL: Optional[RLConfig] = None
     nbest_task_scheduler: Optional[Dict[str, Any]] = None
+    # TPU-native resilience extensions (no reference equivalent):
+    # seeded deterministic fault injection (resilience/chaos.py) and the
+    # checkpoint retry/backoff/escalation policy
+    # (resilience/integrity.py::RetryPolicy) — both free-form dicts whose
+    # keys the schema validates (schema.CHAOS_KEYS /
+    # CHECKPOINT_RETRY_KEYS)
+    chaos: Optional[Dict[str, Any]] = None
+    checkpoint_retry: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -413,7 +421,8 @@ class ServerConfig(Config):
             "resume_from_checkpoint", "send_dicts", "max_grad_norm",
             "do_profiling", "wantRL", "aggregate_median", "softmax_beta",
             "initial_lr", "weight_train_loss", "stale_prob",
-            "num_skip_decoding", "nbest_task_scheduler"]))
+            "num_skip_decoding", "nbest_task_scheduler", "chaos",
+            "checkpoint_retry"]))
         out.data_config = data
         out.optimizer_config = opt
         out.annealing_config = ann

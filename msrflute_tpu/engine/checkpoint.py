@@ -8,8 +8,11 @@ model/optimizer/lr-scheduler state (``core/trainer.py:753-775``),
 fallback-to-best (``core/server.py:561-578``).
 
 Format: flax msgpack serialization of the full :class:`ServerState` pytree
-(+ a sidecar JSON with round/best-metric bookkeeping).  Saves use the
-3-retry wrapper (reference ``utils/utils.py:348-359``).
+(+ a sidecar JSON with round/best-metric bookkeeping).  Saves run under
+the bounded retry-with-backoff policy (``server_config.checkpoint_retry``,
+generalizing the reference's fixed 3-retry wrapper,
+``utils/utils.py:348-359``) with crc32 integrity sidecars and two-slot
+fallback on load — see :mod:`msrflute_tpu.resilience.integrity`.
 """
 
 from __future__ import annotations
@@ -19,16 +22,25 @@ import logging
 import os
 import shutil
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 from flax import serialization
 
-from ..utils.io import try_except_save, update_json_log
+from ..resilience.integrity import (CheckpointCorruptionError,
+                                    FailureEscalator, RetryPolicy,
+                                    blob_checksum, run_with_retry,
+                                    tree_checksum, verify_blob,
+                                    write_sidecar)
+from ..utils.io import update_json_log
 from ..utils.logging import print_rank
 from .round import ServerState
 
 LATEST = "latest_model.msgpack"
+#: previous-generation latest (two-slot msgpack scheme): rotated into
+#: place on every latest save, so a corrupted/torn ``latest_model`` falls
+#: back one round instead of losing the run
+LATEST_PREV = LATEST + ".prev"
 STATUS_LOG = "status_log.json"
 
 
@@ -105,17 +117,40 @@ class CheckpointManager:
     Async durability contract: a round's checkpoint becomes the committed
     resume anchor at the NEXT save/load/wait (two-slot + pointer for
     ``latest``, tmp-dir + rename for ``best``), so a hard crash can lose
-    at most the one most recent round — the inherent async window.  Save
-    failures warn and training continues, mirroring ``try_except_save``.
+    at most the one most recent round — the inherent async window.
+
+    Resilience contract (resilience/integrity.py): every physical write
+    retries under the bounded backoff policy
+    (``server_config.checkpoint_retry``); a fully-failed save warns and
+    training continues UNTIL ``escalation_threshold`` consecutive
+    failures, which abort via :class:`CheckpointEscalationError`.  Saves
+    record crc32 checksums (``.sum`` sidecars / the orbax pointer);
+    loads verify them and fall back to the surviving slot
+    (``latest_model.msgpack.prev`` / the other orbax slot) on
+    corruption, logging a recovery event.
     """
 
     def __init__(self, model_dir: str, backup_freq: int = 100,
-                 backend: str = "msgpack", async_latest: bool = False):
+                 backend: str = "msgpack", async_latest: bool = False,
+                 retry: Optional[RetryPolicy] = None,
+                 io_fault: Optional[Callable[[], None]] = None):
         self.model_dir = model_dir
         self.backup_freq = max(int(backup_freq), 1)
         if backend not in ("msgpack", "orbax"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.backend = backend
+        #: bounded retry + backoff for transient IO failures
+        #: (``server_config.checkpoint_retry``) and the consecutive-
+        #: failure escalation that aborts instead of training
+        #: uncheckpointed forever
+        self.retry = retry or RetryPolicy()
+        self.escalator = FailureEscalator(self.retry.escalation_threshold)
+        #: chaos hook: called at the start of every physical write
+        #: attempt; raises to inject a deterministic IO fault
+        self._io_fault = io_fault or (lambda: None)
+        #: load-time integrity/fallback observability: one dict per
+        #: recovery (corrupted slot skipped, backup slot used, ...)
+        self.recovery_events: List[Dict[str, str]] = []
         self._orbax = None
         self._pending_slot = None
         self._pending_renames = []  # [(tmp_dir, final_dir)] after async save
@@ -147,29 +182,54 @@ class CheckpointManager:
         return os.path.join(os.path.abspath(self.model_dir),
                             name.replace(".msgpack", ".orbax"))
 
+    def _recover(self, event: str, path: str) -> None:
+        """Record + log one integrity-recovery event (corrupt slot
+        skipped, fallback slot used)."""
+        self.recovery_events.append({"event": event, "path": path})
+        print_rank(f"checkpoint recovery: {event} ({path})",
+                   loglevel=logging.WARNING)
+
     def _orbax_save(self, path: str, state: ServerState) -> None:
-        """Issue one async save (best-effort: failures warn, training goes
-        on — the orbax analogue of the msgpack path's try_except_save)."""
+        """Issue one async save, with the bounded-retry policy on the
+        submit itself (actual IO failures surface later in ``_drain``);
+        a fully-failed submit counts toward the failure escalation."""
         payload = serialization.to_state_dict(_payload(state))
         self._drain()  # one in-flight save at a time + commit renames
-        try:
+
+        def _submit():
+            self._io_fault()
             self._orbax.save(path, args=self._ocp.args.StandardSave(payload),
                              force=True)
-        except Exception as exc:  # disk-full/NFS blip: warn, keep training
-            print_rank(f"orbax save to {path} failed: {exc!r}",
-                       loglevel=logging.WARNING)
+
+        if run_with_retry(_submit, self.retry,
+                          what=f"orbax save {os.path.basename(path)}"):
+            self.escalator.record_success()
+        else:
+            self.escalator.record_failure(f"orbax save {path}")
+        self.escalator.check()
 
     def _drain(self) -> None:
-        """Finish the in-flight save (tolerating failure) and perform any
-        deferred directory renames."""
+        """Finish the in-flight save (tolerating failure, which counts
+        toward the escalation threshold) and perform any deferred
+        directory renames.  Failed renames are RE-QUEUED for the next
+        drain — a transient NFS error must not strand a completed save
+        in its tmp dir forever."""
         try:
             self._orbax.wait_until_finished()
+        except (KeyboardInterrupt, SystemExit):
+            # fatal signals propagate — a Ctrl-C mid-wait must kill the
+            # run, not be logged away as a failed save
+            raise
         except Exception as exc:
             print_rank(f"async checkpoint save failed: {exc!r}",
                        loglevel=logging.WARNING)
             self._pending_slot = None
-            self._pending_renames.clear()
+            self.escalator.record_failure("orbax async save")
+            # pending renames are NOT cleared: they reference tmp dirs of
+            # earlier, possibly successful saves — the isdir() guard below
+            # skips any whose save really did fail
             return
+        survivors = []
         for tmp, final in self._pending_renames:
             if not os.path.isdir(tmp):
                 continue
@@ -185,8 +245,10 @@ class CheckpointManager:
                 shutil.rmtree(old, ignore_errors=True)
             except OSError as exc:
                 print_rank(f"checkpoint rename {tmp} -> {final} failed: "
-                           f"{exc!r}", loglevel=logging.WARNING)
-        self._pending_renames.clear()
+                           f"{exc!r}; re-queued for the next drain",
+                           loglevel=logging.WARNING)
+                survivors.append((tmp, final))
+        self._pending_renames = survivors
 
     def _orbax_load(self, path: str,
                     template: ServerState) -> Optional[ServerState]:
@@ -203,27 +265,46 @@ class CheckpointManager:
         """Point the latest-pointer at the slot whose async save has now
         finished (two-slot scheme: the previous committed slot stays valid
         through the entire save window, so a crash mid-save never loses
-        the resume anchor — the async analogue of tmp+os.replace)."""
+        the resume anchor — the async analogue of tmp+os.replace).  The
+        pointer records the slot's tree checksum, verified at load."""
         if self._pending_slot is None:
             self._drain()
             return
         slot = self._pending_slot
         self._pending_slot = None
         self._drain()
-        if not os.path.isdir(self._orbax_path(slot)):
+        slot_dir = self._orbax_path(slot)
+        if not os.path.isdir(slot_dir):
             return  # the save failed; keep pointing at the old slot
+        self.escalator.record_success()
         ptr = os.path.join(self.model_dir, self._LATEST_PTR)
         tmp = ptr + ".tmp"
         with open(tmp, "w") as fh:
-            fh.write(slot)
+            json.dump({"slot": slot, "crc32": tree_checksum(slot_dir)}, fh)
         os.replace(tmp, ptr)
 
-    def _latest_slot(self) -> Optional[str]:
+    def _latest_ptr(self) -> Optional[Dict[str, Any]]:
+        """Parse the latest pointer: new JSON form ``{"slot", "crc32"}``
+        or the legacy bare slot-name string (no checksum -> no
+        verification, so pre-integrity checkpoints keep loading)."""
         ptr = os.path.join(self.model_dir, self._LATEST_PTR)
         if not os.path.exists(ptr):
             return None
         with open(ptr) as fh:
-            return fh.read().strip()
+            text = fh.read().strip()
+        if not text:
+            return None
+        try:
+            parsed = json.loads(text)
+            if isinstance(parsed, dict) and "slot" in parsed:
+                return parsed
+        except json.JSONDecodeError:
+            pass
+        return {"slot": text, "crc32": None}
+
+    def _latest_slot(self) -> Optional[str]:
+        parsed = self._latest_ptr()
+        return None if parsed is None else parsed.get("slot")
 
     def wait(self) -> None:
         """Block until pending async saves are durable (call before reading
@@ -239,6 +320,10 @@ class CheckpointManager:
         with self._mp_cond:
             while self._mp_mailbox is not None or self._mp_busy:
                 self._mp_cond.wait()
+        # surface the writer thread's accumulated failures HERE, on the
+        # training thread — an exception raised inside the daemon writer
+        # would vanish and the run would train uncheckpointed forever
+        self.escalator.check()
 
     def _mp_loop(self) -> None:
         path = os.path.join(self.model_dir, LATEST)
@@ -253,11 +338,18 @@ class CheckpointManager:
                 blob = serialization.msgpack_serialize(
                     serialization.to_state_dict(jax.device_get(snap)))
                 del snap  # release the HBM snapshot before the disk write
-                self._write_blob(path, blob)
+                # _write_blob already retries + counts the failure toward
+                # escalation; the abort itself surfaces at the training
+                # thread's next submit/wait (escalator.check there), never
+                # out of this daemon thread where it would vanish
+                self._write_blob(path, blob, keep_prev=True)
                 del blob
+            except (KeyboardInterrupt, SystemExit):
+                raise  # fatal signals must not be logged away
             except Exception as exc:  # never kill training from the writer
                 print_rank(f"async latest save failed: {exc!r}",
                            loglevel=logging.WARNING)
+                self.escalator.record_failure("async latest serialize")
             finally:
                 with self._mp_cond:
                     self._mp_busy = False
@@ -270,6 +362,7 @@ class CheckpointManager:
         # documents.  (Latest-wins would let a slow disk stack unbounded
         # skew between latest_model and status_log.json, and resume pairs
         # the two.)  The wait also bounds snapshot HBM to one extra copy.
+        self.escalator.check()  # abort on the training thread, not the writer
         if self._mp_worker is None:
             self._mp_worker = threading.Thread(
                 target=self._mp_loop, name="ckpt-latest-writer", daemon=True)
@@ -310,7 +403,8 @@ class CheckpointManager:
         if self.async_latest:
             self._mp_submit(state)
             return
-        self._write(os.path.join(self.model_dir, LATEST), state)
+        self._write(os.path.join(self.model_dir, LATEST), state,
+                    keep_prev=True)
 
     def backup(self, state: ServerState, round_no: int,
                best_names: Tuple[str, ...] = ()) -> None:
@@ -360,19 +454,61 @@ class CheckpointManager:
         self._write(os.path.join(
             self.model_dir, f"best_val_{metric_name}_model.msgpack"), state)
 
-    @staticmethod
-    def _write_blob(path: str, blob: bytes) -> None:
-        """Atomic tmp-write + rename, with the retry policy — THE write
-        recipe, shared by the sync and async-latest paths."""
+    def _write_blob(self, path: str, blob: bytes,
+                    keep_prev: bool = False) -> bool:
+        """Atomic tmp-write + rename under the bounded-retry policy —
+        THE write recipe, shared by the sync and async-latest paths.
+        Records a crc32 sidecar (verified at load) and, for the latest
+        slot (``keep_prev``), rotates the previous generation to
+        ``.prev`` first so corruption always has a fallback.  Returns
+        success; the failure is already counted toward escalation (the
+        CALLER decides where the abort surfaces — training thread only).
+        """
+        checksum = blob_checksum(blob)
+
+        def _rotate(src: str, dst: str) -> None:
+            # LINK-based rotation (fall back to a copy where hardlinks
+            # are unsupported): `src` — the committed latest — never
+            # disappears, so at every instant of the rotate+write
+            # sequence at least one slot passes its integrity check (a
+            # plain rename here would open a crash window with NO
+            # loadable latest at all)
+            lnk = dst + ".lnk"
+            try:
+                if os.path.exists(lnk):
+                    os.remove(lnk)
+                os.link(src, lnk)
+            except OSError:
+                shutil.copyfile(src, lnk)
+            os.replace(lnk, dst)
+
         def _save():
+            self._io_fault()
             tmp = path + ".tmp"
             with open(tmp, "wb") as fh:
                 fh.write(blob)
+            if keep_prev and os.path.exists(path):
+                # blob then sidecar: a crash between the two leaves
+                # .prev's sidecar one generation stale, which the
+                # integrity check REJECTS (fail-safe) — the still-intact
+                # `path` remains the loadable anchor through that window
+                _rotate(path, path + ".prev")
+                if os.path.exists(path + ".sum"):
+                    _rotate(path + ".sum", path + ".prev.sum")
             os.replace(tmp, path)
-        try_except_save(_save)
+            write_sidecar(path, checksum, len(blob))
 
-    def _write(self, path: str, state: ServerState) -> None:
-        self._write_blob(path, _state_to_bytes(state))
+        if run_with_retry(_save, self.retry,
+                          what=f"checkpoint save {os.path.basename(path)}"):
+            self.escalator.record_success()
+            return True
+        self.escalator.record_failure(f"save {path}")
+        return False
+
+    def _write(self, path: str, state: ServerState,
+               keep_prev: bool = False) -> None:
+        self._write_blob(path, _state_to_bytes(state), keep_prev=keep_prev)
+        self.escalator.check()
 
     # -- load ----------------------------------------------------------
     def load(self, template: ServerState,
@@ -380,10 +516,7 @@ class CheckpointManager:
         if self.backend == "orbax":
             self._commit_pending_latest()
             if name == LATEST:
-                slot = self._latest_slot()
-                if slot is None:
-                    return None
-                return self._orbax_load(self._orbax_path(slot), template)
+                return self._orbax_load_latest(template)
             path = self._orbax_path(name)
             restored = self._orbax_load(path, template)
             if restored is None:
@@ -392,10 +525,69 @@ class CheckpointManager:
             return restored
         self._mp_wait()  # an in-flight async latest must land first
         path = os.path.join(self.model_dir, name)
-        if not os.path.exists(path):
+        candidates = [path]
+        if name == LATEST:
+            # two-slot fallback: the previous generation survives at
+            # .prev; a corrupted/torn latest resumes one round back
+            # instead of not at all
+            candidates.append(os.path.join(self.model_dir, LATEST_PREV))
+        for cand in candidates:
+            if not os.path.exists(cand):
+                continue
+            with open(cand, "rb") as fh:
+                blob = fh.read()
+            try:
+                verify_blob(cand, blob)
+                state = _state_from_bytes(blob, template)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except CheckpointCorruptionError as exc:
+                self._recover(f"integrity check failed: {exc}", cand)
+                continue
+            except Exception as exc:  # torn/truncated msgpack
+                self._recover(f"unreadable checkpoint: {exc!r}", cand)
+                continue
+            if cand != path:
+                self._recover("restored from backup slot", cand)
+            return state
+        return None
+
+    def _orbax_load_latest(self, template: ServerState
+                           ) -> Optional[ServerState]:
+        """Latest via the pointer, with checksum verification and
+        automatic fallback to the OTHER slot on corruption/torn-write
+        (the previous committed generation keeps living there until the
+        slot is reused two saves later)."""
+        parsed = self._latest_ptr()
+        if parsed is None:
             return None
-        with open(path, "rb") as fh:
-            return _state_from_bytes(fh.read(), template)
+        slot = parsed.get("slot")
+        other = (self._LATEST_SLOTS[1] if slot == self._LATEST_SLOTS[0]
+                 else self._LATEST_SLOTS[0])
+        for cand in (slot, other):
+            path = self._orbax_path(cand)
+            if not os.path.isdir(path):
+                continue
+            if cand == slot and parsed.get("crc32"):
+                actual = tree_checksum(path)
+                if actual != parsed["crc32"]:
+                    self._recover(
+                        f"slot checksum {actual} != recorded "
+                        f"{parsed['crc32']}", path)
+                    continue
+            try:
+                restored = self._orbax_load(path, template)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as exc:
+                self._recover(f"unreadable orbax slot: {exc!r}", path)
+                continue
+            if restored is None:
+                continue
+            if cand != slot:
+                self._recover("restored from backup slot", path)
+            return restored
+        return None
 
     def load_best(self, template: ServerState,
                   metric_name: str) -> Optional[ServerState]:

@@ -150,9 +150,10 @@ class PersonalizationServer(OptimizationServer):
             self.config.server_config["rounds_per_step"] = 1
 
     def _round_housekeeping(self, round_no, val_freq, rec_freq,
-                            skip_latest=False):
+                            skip_latest=False, rng_snapshot=None):
         super()._round_housekeeping(round_no, val_freq, rec_freq,
-                                    skip_latest=skip_latest)
+                                    skip_latest=skip_latest,
+                                    rng_snapshot=rng_snapshot)
         # personalized eval: convex logit interpolation over users with
         # local state (reference convex_inference during run_testvalidate,
         # core/client.py:167-183)
@@ -256,7 +257,7 @@ class PersonalizationServer(OptimizationServer):
             alphas.append(a)
         lps_dev, alphas_dev, arrays_dev, smask, cmask, stage = \
             self._stage_on_clients_axis(locals_, alphas, batch)
-        self._rng, rng = jax.random.split(self._rng)
+        rng = self._next_rng()
         new_lp, new_alpha, tl = self._personal_fn(
             self.state.params, lps_dev, alphas_dev, arrays_dev, smask, cmask,
             stage(batch.client_ids),
@@ -272,7 +273,7 @@ class PersonalizationServer(OptimizationServer):
                            float(new_alpha[j]))
 
     def _random_params(self):
-        self._rng, sub = jax.random.split(self._rng)
+        sub = self._next_rng()
         return jax.device_get(self.task.init_params(sub))
 
     # -- personalized eval ---------------------------------------------

@@ -148,6 +148,22 @@ class RoundEngine:
                 "aggregate, which chunked accumulation never materializes — "
                 "disable one of them")
 
+        # deterministic chaos client faults (server_config.chaos): when the
+        # schedule injects dropout/straggling, the round program takes two
+        # extra per-round data operands — drop [K] and keep_steps [K] —
+        # and folds them into client_mask / sample_mask IN-program, so the
+        # faults cost no recompile and the injected-fault counters ride
+        # the packed-stats single-transfer path (resilience/chaos.py).
+        # Static at engine build: a chaos-free config compiles the exact
+        # program it always did.  Read straight from the config block —
+        # the ONE live ChaosSchedule (counters, IO-fault stream) belongs
+        # to the server; a second instance here would silently diverge.
+        _chaos_raw = sc.get("chaos") or {}
+        self.chaos_client_faults = bool(
+            _chaos_raw and _chaos_raw.get("enable", True) and
+            (float(_chaos_raw.get("dropout_rate", 0.0) or 0.0) > 0.0 or
+             float(_chaos_raw.get("straggler_rate", 0.0) or 0.0) > 0.0))
+
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
         #: device-resident sample pool (build_sample_pool); when set, round
@@ -394,10 +410,43 @@ class RoundEngine:
             # (enables tensor-parallel BERT, which the reference lacks).
             sharded_collect = shard_body
 
+        chaos_faults = self.chaos_client_faults
+
         def round_step(params, opt_state, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, server_lr,
                        round_idx, leakage_threshold, quant_threshold, rng,
-                       *pool_args):
+                       *extra_args):
+            # chaos client faults (extra data operands, present only when
+            # the engine was built with them): dropout multiplies into
+            # client_mask — downstream everything (strategy weights, psum
+            # denominators, stats) renormalizes exactly like mesh padding
+            # — and straggling truncates sample_mask's step grid, so a
+            # straggler's PARTIAL local work still aggregates
+            # (CLIP/FedBuff-style partial participation).  The injected-
+            # fault counters join round_stats and leave through the same
+            # packed single-transfer buffer as every other stat.
+            chaos_stats = {}
+            if chaos_faults:
+                chaos_drop, chaos_keep = extra_args[0], extra_args[1]
+                pool_args = extra_args[2:]
+                step_live = (jnp.sum(sample_mask, axis=-1) > 0)      # [K, S]
+                real_steps = jnp.sum(step_live, axis=-1)             # [K]
+                keep_f = (jnp.arange(sample_mask.shape[-2])[None, :]
+                          < chaos_keep[:, None]).astype(jnp.float32)  # [K, S]
+                live_cm = client_mask * (1.0 - chaos_drop)
+                chaos_stats = {
+                    "chaos_dropped": jnp.sum(client_mask * chaos_drop),
+                    "chaos_straggled": jnp.sum(
+                        live_cm * (chaos_keep < real_steps)),
+                    "chaos_steps_lost": jnp.sum(
+                        step_live.astype(jnp.float32) * (1.0 - keep_f)
+                        * live_cm[:, None]),
+                }
+                sample_mask = sample_mask * keep_f[..., None].astype(
+                    sample_mask.dtype)
+                client_mask = live_cm
+            else:
+                pool_args = extra_args
             # strategies may move the broadcast point off the canonical
             # params (e.g. FedAC's momentum-like md point); default identity
             bcast = strategy.broadcast_params(params, strategy_state)
@@ -444,6 +493,7 @@ class RoundEngine:
                 "grad_norm": collected["stats_norm_sum"] / jnp.maximum(collected["client_count"], 1.0),
                 "agg_grad_norm": optax.global_norm(agg),
             }
+            round_stats.update(chaos_stats)
             for k, v in privacy_per_client.items():
                 round_stats[k] = v
             # single-transfer stats: pack the whole stats tree into one
@@ -482,23 +532,41 @@ class RoundEngine:
         if cached is not None:
             return cached
         core = self._round_step_core
+        chaos_faults = self.chaos_client_faults
 
         def multi(params, opt_state, strategy_state, arrays, sample_mask,
                   client_mask, client_ids, client_lrs, server_lrs,
                   round_idxs, leakage_threshold, quant_thresholds, rngs,
-                  *pool_args):
+                  *extra_args):
+            # chaos operands are per-round ([R, K]) and scan with the rest
+            # of the round inputs; the resident pool stays a carried
+            # constant like before
+            if chaos_faults:
+                chaos_drops, chaos_keeps = extra_args[0], extra_args[1]
+                pool_args = extra_args[2:]
+            else:
+                pool_args = extra_args
+
             def body(carry, xs):
                 p, o, s = carry
-                arr, sm, cm, cid, clr, slr, ridx, qt, rng = xs
+                if chaos_faults:
+                    (arr, sm, cm, cid, clr, slr, ridx, qt, rng,
+                     cdrop, ckeep) = xs
+                    chaos_xs = (cdrop, ckeep)
+                else:
+                    arr, sm, cm, cid, clr, slr, ridx, qt, rng = xs
+                    chaos_xs = ()
                 p, o, s, stats = core(p, o, s, arr, sm, cm, cid, clr, slr,
                                       ridx, leakage_threshold, qt, rng,
-                                      *pool_args)
+                                      *chaos_xs, *pool_args)
                 return (p, o, s), stats
 
+            xs = (arrays, sample_mask, client_mask, client_ids,
+                  client_lrs, server_lrs, round_idxs, quant_thresholds, rngs)
+            if chaos_faults:
+                xs = xs + (chaos_drops, chaos_keeps)
             (p, o, s), stats = jax.lax.scan(
-                body, (params, opt_state, strategy_state),
-                (arrays, sample_mask, client_mask, client_ids,
-                 client_lrs, server_lrs, round_idxs, quant_thresholds, rngs))
+                body, (params, opt_state, strategy_state), xs)
             return p, o, s, stats
 
         fn = jax.jit(multi, donate_argnums=(0, 1, 2))
@@ -614,17 +682,44 @@ class RoundEngine:
                            state.round + 1)
 
     # ------------------------------------------------------------------
+    def _stage_chaos(self, chaos_vecs: Optional[list], sharding,
+                     stacked: bool) -> tuple:
+        """Device-stage the chaos fault vectors (``[(drop [K], keep [K])]``
+        per round) as trailing program operands — or nothing when the
+        engine compiled without client faults.  Mismatches are
+        programming errors and raise."""
+        if not self.chaos_client_faults:
+            if chaos_vecs:
+                raise ValueError(
+                    "chaos vectors supplied but the engine was built "
+                    "without chaos client faults (server_config.chaos)")
+            return ()
+        if not chaos_vecs:
+            raise ValueError(
+                "engine built with chaos client faults: every dispatch "
+                "needs per-round (drop, keep_steps) vectors")
+        drops = [np.asarray(d, np.float32) for d, _ in chaos_vecs]
+        keeps = [np.asarray(k, np.float32) for _, k in chaos_vecs]
+        drop = np.stack(drops) if stacked else drops[0]
+        keep = np.stack(keeps) if stacked else keeps[0]
+        return (jax.device_put(drop, sharding),
+                jax.device_put(keep, sharding))
+
+    # ------------------------------------------------------------------
     def run_round(self, state: ServerState, batch: RoundBatch,
                   client_lr: float, server_lr: float,
                   rng: jax.Array,
                   leakage_threshold: Optional[float] = None,
-                  quant_threshold: Optional[float] = None
+                  quant_threshold: Optional[float] = None,
+                  chaos_vecs: Optional[list] = None
                   ) -> Tuple[ServerState, PackedStats]:
         """Stage one round's data onto the mesh and execute the program.
 
         Dispatch is async; the returned :class:`PackedStats` is a lazy
         handle — nothing crosses the host boundary until ``.fetch()``.
         """
+        chaos_args = self._stage_chaos(chaos_vecs, self._client_sharding,
+                                       stacked=False)
         arrays, pool_args = self._stage_arrays([batch], self._client_sharding)
         sample_mask = jax.device_put(batch.sample_mask, self._client_sharding)
         client_mask = jax.device_put(batch.client_mask, self._client_sharding)
@@ -639,7 +734,8 @@ class RoundEngine:
             jnp.asarray(leakage_threshold if leakage_threshold is not None
                         else jnp.inf, jnp.float32),
             jnp.asarray(quant_threshold if quant_threshold is not None
-                        else -1.0, jnp.float32), rng, *pool_args)
+                        else -1.0, jnp.float32), rng, *chaos_args,
+            *pool_args)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
         packer = self._stats_packers[("single", batch.sample_mask.shape[0])]
@@ -677,7 +773,8 @@ class RoundEngine:
                         client_lrs: list, server_lrs: list,
                         rng: jax.Array,
                         leakage_threshold: Optional[float] = None,
-                        quant_thresholds: Optional[list] = None
+                        quant_thresholds: Optional[list] = None,
+                        chaos_vecs: Optional[list] = None
                         ) -> Tuple[ServerState, PackedStats]:
         """Dispatch ``len(batches)`` rounds as ONE device program (the
         single-round program for R==1, a scan otherwise) WITHOUT blocking:
@@ -691,8 +788,11 @@ class RoundEngine:
                 state, batches[0], client_lrs[0], server_lrs[0], rng,
                 leakage_threshold=leakage_threshold,
                 quant_threshold=(quant_thresholds[0] if quant_thresholds
-                                 else None))
+                                 else None),
+                chaos_vecs=chaos_vecs)
         stacked_sharding = NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
+        chaos_args = self._stage_chaos(chaos_vecs, stacked_sharding,
+                                       stacked=True)
         arrays, pool_args = self._stage_arrays(batches, stacked_sharding)
         sample_mask = jax.device_put(
             np.stack([b.sample_mask for b in batches]), stacked_sharding)
@@ -712,7 +812,8 @@ class RoundEngine:
             jnp.asarray(leakage_threshold if leakage_threshold is not None
                         else jnp.inf, jnp.float32),
             jnp.asarray(quant_thresholds if quant_thresholds is not None
-                        else [-1.0] * R, jnp.float32), rngs, *pool_args)
+                        else [-1.0] * R, jnp.float32), rngs, *chaos_args,
+            *pool_args)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
         # the scan stacks the core program's packed per-round vecs into
@@ -726,7 +827,8 @@ class RoundEngine:
                    client_lrs: list, server_lrs: list,
                    rng: jax.Array,
                    leakage_threshold: Optional[float] = None,
-                   quant_thresholds: Optional[list] = None
+                   quant_thresholds: Optional[list] = None,
+                   chaos_vecs: Optional[list] = None
                    ) -> Tuple[ServerState, Dict[str, np.ndarray]]:
         """Run ``len(batches)`` rounds in ONE device program (scan) and
         fetch the stats (one transfer per dtype group).
@@ -736,5 +838,5 @@ class RoundEngine:
         new_state, packed = self.dispatch_rounds(
             state, batches, client_lrs, server_lrs, rng,
             leakage_threshold=leakage_threshold,
-            quant_thresholds=quant_thresholds)
+            quant_thresholds=quant_thresholds, chaos_vecs=chaos_vecs)
         return new_state, packed.fetch()

@@ -35,6 +35,8 @@ from ..data.dataset import BaseDataset
 from ..models.base import BaseTask
 from ..optim import PlateauTracker, make_lr_schedule
 from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
+from ..resilience import PreemptionHandler, make_chaos
+from ..resilience.integrity import RetryPolicy
 from ..strategies import select_strategy
 from ..utils.logging import flush_metrics, log_metric, print_rank
 from ..utils.metrics import Metric, MetricsDict
@@ -66,6 +68,34 @@ class OptimizationServer:
         strategy_cls = select_strategy(config.strategy)
         self.strategy = strategy_cls(config, dp)
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
+
+        # ---- resilience: chaos schedule + graceful preemption --------
+        # server_config.chaos (resilience/chaos.py): seeded deterministic
+        # fault injection.  Client faults (dropout/straggling) ride the
+        # fused round program as data operands, so they need the fused
+        # path — the host-orchestrated rounds (RL, SCAFFOLD, EF) and
+        # personalization's model-dependent sampling build their payloads
+        # elsewhere and would silently ignore them.
+        self.chaos = make_chaos(sc)
+        if self.chaos is not None and self.chaos.has_client_faults:
+            host_orchestrated = (
+                sc.get("wantRL", False) or
+                getattr(self.strategy, "host_rounds", False) or
+                getattr(self.strategy, "ef_rounds", False) or
+                type(self)._sample is not OptimizationServer._sample)
+            if host_orchestrated:
+                raise ValueError(
+                    "server_config.chaos dropout_rate/straggler_rate "
+                    "require the fused round path — wantRL, strategy: "
+                    "scaffold / ef_quant, and personalization orchestrate "
+                    "rounds host-side and would ignore the injected "
+                    "faults; zero those rates (IO faults and "
+                    "preempt_at_round still apply) or drop the feature")
+        #: SIGTERM/SIGINT -> drain in-flight round -> emergency
+        #: checkpoint -> resumable exit (resilience/preemption.py); the
+        #: loop polls `requested` at chunk boundaries
+        self.preemption = PreemptionHandler()
+        self.preempted = False
 
         # ---- overlapped host/device round pipeline -------------------
         # pipeline_depth (schema knob, default 1): with depth >= 1 the
@@ -106,7 +136,10 @@ class OptimizationServer:
         self.ckpt = CheckpointManager(
             model_dir, backup_freq=sc.get("model_backup_freq", 100),
             backend=str(sc.get("checkpoint_backend", "msgpack")),
-            async_latest=bool(ckpt_async))
+            async_latest=bool(ckpt_async),
+            retry=RetryPolicy.from_config(sc.get("checkpoint_retry")),
+            io_fault=(self.chaos.io_fault_hook if self.chaos is not None
+                      else None))
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -232,7 +265,14 @@ class OptimizationServer:
         self._eval_batches_cache: Dict[str, Any] = {}
         self._per_user_fns: Dict[str, Any] = {}
         self._np_rng = np.random.default_rng(seed)
+        # device-side randomness: a CONSTANT base key + a host-side use
+        # counter; every consumer takes fold_in(base, n) via _next_rng().
+        # The counter (not the key) is what resume persists — restoring
+        # it re-anchors every later stream bit-exactly WITHOUT fetching
+        # key material from the device (which would add a host transfer
+        # per round to the pipelined loop's single-fetch contract).
         self._rng = jax.random.PRNGKey(seed)
+        self._rng_uses = 0
         self.run_stats: Dict[str, list] = {
             "secsPerRound": [], "secsPerRoundHousekeeping": [],
             "secsPerRoundHostTail": [], "hostToDeviceBytesPerRound": []}
@@ -268,6 +308,26 @@ class OptimizationServer:
                 resumed = True
                 status = self.ckpt.read_status()
                 self.lr_weight = float(status.get("weight", 1.0))
+                # re-anchor the RNG streams (client sampling order + the
+                # device-key counter) so the post-resume trajectory is
+                # bit-identical to an uninterrupted run — the core of the
+                # preemption contract (tests/test_preempt_resume.py)
+                self._restore_rng(status)
+                # plateau-LR tracker + best-val metrics live only in
+                # memory; restore them so the post-resume LR schedule and
+                # best-checkpoint decisions re-anchor too
+                if self.plateau is not None and "plateau" in status:
+                    pl = status["plateau"]
+                    self.plateau.lr = float(pl.get("lr", self.plateau.lr))
+                    self.plateau.best = pl.get("best")
+                    self.plateau.bad_rounds = int(pl.get("bad_rounds", 0))
+                hib = status.get("best_val_hib", {})
+                for key, value in status.items():
+                    if key.startswith("best_val_") and key != "best_val_hib" \
+                            and isinstance(value, (int, float)):
+                        name = key[len("best_val_"):]
+                        self.best_val[name] = Metric(
+                            float(value), bool(hib.get(name, name != "loss")))
                 print_rank(f"resumed from checkpoint at round {self.state.round}")
                 # fast-forward the quantization-threshold annealing to the
                 # resumed round: the schedule is a pure geometric series
@@ -374,6 +434,38 @@ class OptimizationServer:
                        f"{self.ef_store.n_params} ({gb:.2f} GiB HBM)")
 
     # ------------------------------------------------------------------
+    def _next_rng(self) -> jax.Array:
+        """The run's next device RNG stream: ``fold_in(base, n)`` with a
+        host-side monotone counter.  Deterministic in EVENT ORDER (which
+        the config fixes), and resumable by persisting the single int —
+        see ``_rng_snapshot``."""
+        key = jax.random.fold_in(self._rng, self._rng_uses)
+        self._rng_uses += 1
+        return key
+
+    def _rng_snapshot(self) -> Dict[str, Any]:
+        """Host-RNG resume anchor: the numpy bit-generator state (client
+        sampling + packing shuffles) and the device-key use counter.
+        MUST be captured after all randomness attributable to the
+        checkpointed rounds is drawn and before any later round draws —
+        the caller picks the point (dispatch time when lookahead packing
+        overlaps, housekeeping time otherwise)."""
+        import copy
+        return {
+            "np_rng_state": copy.deepcopy(self._np_rng.bit_generator.state),
+            "rng_uses": int(self._rng_uses),
+        }
+
+    def _restore_rng(self, status: Dict[str, Any]) -> None:
+        """Re-anchor both RNG streams from a status-log snapshot (absent
+        in pre-resilience status logs -> streams restart, matching the
+        old resume behavior)."""
+        if "np_rng_state" in status:
+            self._np_rng.bit_generator.state = status["np_rng_state"]
+        if "rng_uses" in status:
+            self._rng_uses = int(status["rng_uses"])
+
+    # ------------------------------------------------------------------
     def _sample(self) -> list:
         sc = self.config.server_config
         n = parse_clients_per_round(sc.get("num_clients_per_iteration", 10),
@@ -388,14 +480,25 @@ class OptimizationServer:
         return self.train()
 
     def train(self) -> ServerState:
-        # strict transfer mode (MSRFLUTE_STRICT_TRANSFERS=1, fluteguard's
-        # runtime half): the whole round loop — fused, pipelined, and the
-        # host-orchestrated RL/SCAFFOLD/EF paths — runs with implicit
-        # device->host transfers disallowed; the explicit device_get
-        # fetches (packed stats, eval, host tails) are the only sanctioned
-        # crossings.  No-op without the env flag.
-        with strict_transfer_scope():
-            return self._train_loop()
+        # graceful-preemption window: SIGTERM/SIGINT during the loop flip
+        # the handler's flag (polled at chunk boundaries) instead of
+        # killing the process mid-round; previous dispositions are
+        # restored on the way out
+        self.preempted = False
+        self.preemption.reset()  # a past preemption must not latch forever
+        self.preemption.install()
+        try:
+            # strict transfer mode (MSRFLUTE_STRICT_TRANSFERS=1,
+            # fluteguard's runtime half): the whole round loop — fused,
+            # pipelined, and the host-orchestrated RL/SCAFFOLD/EF paths —
+            # runs with implicit device->host transfers disallowed; the
+            # explicit device_get fetches (packed stats, eval, host
+            # tails) are the only sanctioned crossings.  No-op without
+            # the env flag.
+            with strict_transfer_scope():
+                return self._train_loop()
+        finally:
+            self.preemption.uninstall()
 
     def _train_loop(self) -> ServerState:
         sc = self.config.server_config
@@ -486,7 +589,25 @@ class OptimizationServer:
         self._last_fence = 0.0
 
         round_no = self.state.round
+        start_round = round_no
         while round_no < max_iteration:
+            # preemption poll (chunk granularity): a SIGTERM between
+            # chunks, or the chaos drill's preempt_at_round, stops BEFORE
+            # dispatching new device work; the in-flight pending chunk is
+            # drained after the loop so its rounds are kept, checkpointed,
+            # and the exit is resumable.  The drill fires only when this
+            # run CROSSES the threshold from below — a resumed run that
+            # starts at/past it (the RUNBOOK drill relaunches with the
+            # same config) trains on instead of re-preempting forever.
+            if (self.chaos is not None and
+                    self.chaos.preempt_at_round is not None and
+                    start_round < self.chaos.preempt_at_round <= round_no
+                    and not self.preemption.requested):
+                self.preemption.request(
+                    f"chaos preempt_at_round="
+                    f"{self.chaos.preempt_at_round}")
+            if self.preemption.requested:
+                break
             tic = time.time()
             R = chunk_R(round_no)
 
@@ -519,7 +640,7 @@ class OptimizationServer:
             prefetched = None
             self._record_staged_bytes(batches, R)
 
-            self._rng, chunk_rng = jax.random.split(self._rng)
+            chunk_rng = self._next_rng()
             # flag-gated profiling (reference cProfile hooks, SURVEY §5.1)
             profile_this = (self._profile_dir is not None and
                             self._chunks_run == profile_chunk)
@@ -543,15 +664,32 @@ class OptimizationServer:
                 # stream order, ahead of the donating program
                 self.ckpt.save_latest(pending["state"])
                 pending["latest_saved"] = True
+            chaos_vecs = None
+            if self.engine.chaos_client_faults:
+                # deterministic per-round fault vectors (seeded on the
+                # round index, resilience/chaos.py) — data operands of
+                # the compiled program, so no recompile ever
+                chaos_vecs = [
+                    self.chaos.client_faults(round_no + j,
+                                             batches[j].sample_mask)
+                    for j in range(R)]
             self.state, packed = self.engine.dispatch_rounds(
                 self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
                 leakage_threshold=self.max_allowed_leakage,
-                quant_thresholds=quant_thresholds)
+                quant_thresholds=quant_thresholds, chaos_vecs=chaos_vecs)
             chunk = {
                 "round0": round_no, "R": R, "state": self.state,
                 "stats": packed, "batches": batches,
                 "client_lr": client_lr, "server_lrs": server_lrs,
                 "tic": tic, "latest_saved": False,
+                # resume anchor: with lookahead packing (pipeline /
+                # prefetch) the NEXT chunk's sampling happens before this
+                # chunk's housekeeping, so the rng state belonging to
+                # this chunk's checkpoint must be captured NOW; the plain
+                # serial loop snapshots at housekeeping time instead
+                # (after any server-replay randomness for these rounds)
+                "rng_snapshot": (self._rng_snapshot()
+                                 if (pipelined or prefetch_ok) else None),
                 # adaptive-DP observability: stash a device-side copy of
                 # the post-chunk clip NOW — the next dispatch donates the
                 # strategy_state buffers this scalar lives in
@@ -590,7 +728,33 @@ class OptimizationServer:
                 pending = chunk
             else:
                 self._drain_chunk(chunk, val_freq, rec_freq)
+        if pending is not None:
+            # preemption landed with a chunk in flight: the device work
+            # is already done, so drain it normally — its housekeeping
+            # writes the per-round `latest` checkpoint, making those
+            # rounds part of the resume anchor instead of lost work.
+            # (Nothing speculative beyond this slot is ever dispatched.)
+            self._drain_chunk(pending, val_freq, rec_freq)
+            self.pipelined_chunks += 1
+            pending = None
         self.ckpt.wait()  # async checkpoint saves must be durable on return
+        if self.preemption.requested and round_no < max_iteration:
+            # resumable exit: every completed round is checkpointed and
+            # durable; status_log carries the rng anchors written by the
+            # last housekeeping.  e2e_trainer turns this flag into
+            # os.EX_TEMPFAIL so schedulers re-queue the job.
+            self.preempted = True
+            self.ckpt.update_status(
+                {"preempted": self.preemption.reason or "requested"})
+            print_rank(
+                f"preempted at round {round_no}/{max_iteration} "
+                f"({self.preemption.reason}); checkpoint durable — resume "
+                "with server_config.resume_from_checkpoint: true",
+                loglevel=logging.WARNING)
+        elif "preempted" in self.ckpt.read_status():
+            # a resumed run that COMPLETED: clear the stale marker so the
+            # final status log doesn't read as an interrupted run
+            self.ckpt.update_status({"preempted": None})
         self._log_timing()
         flush_metrics()
         return self.state
@@ -637,6 +801,22 @@ class OptimizationServer:
             log_metric("Client learning rate", chunk["client_lr"], step=r)
             log_metric("Agg. grad norm",
                        float(stats["agg_grad_norm"][j]), step=r)
+        if self.chaos is not None and "chaos_dropped" in stats:
+            # injected-fault observability: counters computed inside the
+            # round program, fetched through the SAME packed single
+            # transfer as every other stat (no extra host syncs)
+            counters = self.chaos.counters
+            for j in range(R):
+                r = round0 + j
+                dropped = float(stats["chaos_dropped"][j])
+                straggled = float(stats["chaos_straggled"][j])
+                lost = float(stats["chaos_steps_lost"][j])
+                counters["dropped"] += dropped
+                counters["straggled"] += straggled
+                counters["steps_lost"] += lost
+                log_metric("Chaos dropped clients", dropped, step=r)
+                log_metric("Chaos stragglers", straggled, step=r)
+                log_metric("Chaos steps lost", lost, step=r)
         self._process_privacy_stats(
             stats, round0,
             client_mask=np.stack([b.client_mask for b in chunk["batches"]]))
@@ -653,7 +833,8 @@ class OptimizationServer:
         if self.server_replay is not None:
             self._run_server_replay()
         self._round_housekeeping(round0 + R, val_freq, rec_freq,
-                                 skip_latest=chunk["latest_saved"])
+                                 skip_latest=chunk["latest_saved"],
+                                 rng_snapshot=chunk.get("rng_snapshot"))
         self.run_stats["secsPerRoundHostTail"].append(
             (time.time() - toc) / R)
 
@@ -738,7 +919,7 @@ class OptimizationServer:
                     params, arrays, mask, jnp.asarray(lr, jnp.float32), rng)
                 return jax.tree.map(lambda w, g: w - g, params, pg), tl
             self._replay_fn = jax.jit(fn)
-        self._rng, rng = jax.random.split(self._rng)
+        rng = self._next_rng()
         one, bs, steps = self._replay_pack
         batch = pack_round_batches(one, [0], bs, steps, rng=self._np_rng)
         arrays = {k: v[0] for k, v in batch.arrays.items()}
@@ -770,11 +951,16 @@ class OptimizationServer:
     # ------------------------------------------------------------------
     def _round_housekeeping(self, round_no: int, val_freq: int,
                             rec_freq: int,
-                            skip_latest: bool = False) -> None:
+                            skip_latest: bool = False,
+                            rng_snapshot: Optional[Dict[str, Any]] = None
+                            ) -> None:
         """Eval cadence, LR plateau decay, fallback, checkpoint, status log
         (reference ``core/server.py:448-490``).  ``skip_latest``: the
         pipelined loop already submitted this round's ``latest`` save
-        before the next dispatch donated the state buffers."""
+        before the next dispatch donated the state buffers.
+        ``rng_snapshot``: the resume anchor captured at dispatch time when
+        lookahead packing overlaps (see ``_rng_snapshot``); None means
+        "capture now" (plain serial loop, host-orchestrated rounds)."""
         housekeeping_tic = time.time()
         improved = False
         if round_no % val_freq == 0:
@@ -844,11 +1030,25 @@ class OptimizationServer:
                     self.ef_store.set_round(int(self.state.round))
             else:
                 self.ef_store.set_round(int(self.state.round))
-        self.ckpt.update_status({
+        status_update = {
             "i": round_no,
             "weight": self.lr_weight,
+            # rng resume anchors: numpy bit-generator state + device-key
+            # use counter, captured at the point all randomness for
+            # rounds <= round_no (and none beyond) has been drawn
+            **(rng_snapshot if rng_snapshot is not None
+               else self._rng_snapshot()),
             **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
-        })
+        }
+        if self.best_val:
+            status_update["best_val_hib"] = {
+                k: bool(m.higher_is_better)
+                for k, m in self.best_val.items()}
+        if self.plateau is not None:
+            status_update["plateau"] = {
+                "lr": self.plateau.lr, "best": self.plateau.best,
+                "bad_rounds": self.plateau.bad_rounds}
+        self.ckpt.update_status(status_update)
         # one buffered-metrics flush per chunk instead of one per metric
         # line — the jsonl stream stays observable at round granularity
         # while the host tail stops paying a syscall per scalar
@@ -881,7 +1081,7 @@ class OptimizationServer:
             desired_max_samples=self.desired_max_samples)
         self._maybe_length_bucket([batch])
         self._record_staged_bytes([batch], 1)
-        self._rng, rng = jax.random.split(self._rng)
+        rng = self._next_rng()
         return client_lr, server_lr, batch, rng
 
     def _run_scaffold_round(self, round_no: int) -> None:

@@ -1,0 +1,36 @@
+"""Resilience layer: graceful preemption, checkpoint integrity/retry,
+and deterministic fault injection.
+
+The production deployments this simulator targets (preemptible TPU
+slices, remote filesystems, flaky client populations — PAPER.md's
+"millions of clients, tens of thousands per round") fail constantly and
+partially.  This package is the engine's answer:
+
+- :mod:`.preemption` — SIGTERM/SIGINT-driven graceful shutdown: the
+  server loop drains the in-flight device round, writes an emergency
+  checkpoint through the existing two-slot path, and exits resumable.
+- :mod:`.integrity` — checkpoint checksums + sidecars, bounded
+  retry-with-backoff, and the consecutive-failure escalation that turns
+  "silently training uncheckpointed forever" into a loud abort.
+- :mod:`.chaos` — seeded, config-driven fault schedule
+  (``server_config.chaos``): client dropout and straggler step
+  truncation fold into the fused round program's ``client_mask`` /
+  ``sample_mask`` (no recompile; aggregation weights renormalize on
+  device), checkpoint IO faults exercise the retry/fallback machinery,
+  and ``preempt_at_round`` drives the kill/resume drill deterministically.
+"""
+
+from .chaos import ChaosSchedule, make_chaos
+from .integrity import (CheckpointCorruptionError, CheckpointEscalationError,
+                        FailureEscalator, RetryPolicy, blob_checksum,
+                        read_sidecar, tree_checksum, verify_blob,
+                        write_sidecar)
+from .preemption import GracefulPreemption, PreemptionHandler
+
+__all__ = [
+    "ChaosSchedule", "make_chaos",
+    "CheckpointCorruptionError", "CheckpointEscalationError",
+    "FailureEscalator", "RetryPolicy", "blob_checksum", "read_sidecar",
+    "tree_checksum", "verify_blob", "write_sidecar",
+    "GracefulPreemption", "PreemptionHandler",
+]

@@ -1,0 +1,176 @@
+"""Deterministic fault injection (``server_config.chaos``).
+
+A seeded, config-driven fault schedule for rehearsing the failure modes
+real federated deployments hit constantly: clients that drop out
+mid-round, stragglers that miss the synchronous barrier with only part
+of their local steps done, checkpoint IO that errors transiently, and
+the scheduler preempting the whole job at an inconvenient round.
+
+Determinism guarantee (pinned by ``tests/test_resilience.py``): every
+fault decision is a pure function of ``(chaos.seed, fault stream, round
+index or call index)`` via ``np.random.SeedSequence`` — NOT of any
+process-global RNG, the training RNG, wall-clock, or call order across
+streams.  Same seed + same chaos config => identical dropout/straggler
+schedule — whether the run is serial or pipelined, fresh or resumed
+mid-run (round-keyed, so resume-stable).  The IO-fault stream is
+call-indexed from PROCESS start: deterministic within a process, but a
+resumed process restarts it at call 0 — acceptable because injected IO
+faults exercise the retry machinery and never touch model state (the
+write-attempt ordering under the async checkpoint writer is itself not
+resume-reproducible, so a persisted counter could not restore the
+original alignment anyway).  The schedule is also firewalled
+FROM training randomness: enabling chaos never perturbs client sampling
+or model RNG streams; a ``dropout_rate: 0`` chaos block is bit-identical
+to no chaos block at all.
+
+How the client faults land (see ``engine/round.py``): the per-round
+``drop``/``keep_steps`` vectors are data operands of the fused round
+program — dropout multiplies into the existing ``client_mask`` (so
+aggregation weights renormalize on device exactly like mesh padding) and
+straggler truncation multiplies a step-bound mask into ``sample_mask``
+(partial work still aggregates, CLIP/FedBuff-style).  No shape changes,
+no recompile; the injected-fault counters ride the packed-stats
+single-transfer path back to the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+#: stream tags keeping the fault streams independent of each other (and
+#: of anything else seeded from small ints)
+_CLIENT_STREAM = 0xC7A05C11
+_IO_STREAM = 0xC7A051F0
+
+#: "no straggler bound" sentinel — far above any realistic step grid
+NO_BOUND = 1e9
+
+
+class ChaosSchedule:
+    """Seeded fault schedule.  One instance per run; all methods are
+    deterministic given the construction args (see module docstring)."""
+
+    def __init__(self, seed: int = 0, dropout_rate: float = 0.0,
+                 straggler_rate: float = 0.0,
+                 straggler_inflation: float = 2.0,
+                 ckpt_io_error_rate: float = 0.0,
+                 preempt_at_round: Optional[int] = None):
+        if not 0.0 <= float(dropout_rate) <= 1.0:
+            raise ValueError("chaos.dropout_rate must be in [0, 1]")
+        if not 0.0 <= float(straggler_rate) <= 1.0:
+            raise ValueError("chaos.straggler_rate must be in [0, 1]")
+        if float(straggler_inflation) < 1.0:
+            raise ValueError("chaos.straggler_inflation must be >= 1 "
+                             "(it divides the steps a straggler completes "
+                             "before the round barrier)")
+        if not 0.0 <= float(ckpt_io_error_rate) <= 1.0:
+            raise ValueError("chaos.ckpt_io_error_rate must be in [0, 1]")
+        self.seed = int(seed)
+        self.dropout_rate = float(dropout_rate)
+        self.straggler_rate = float(straggler_rate)
+        self.straggler_inflation = float(straggler_inflation)
+        self.ckpt_io_error_rate = float(ckpt_io_error_rate)
+        self.preempt_at_round = (None if preempt_at_round is None
+                                 else int(preempt_at_round))
+        self._io_calls = 0
+        #: injected-fault observability, accumulated by the server from
+        #: the packed round stats (dropped/straggled/steps_lost) and by
+        #: :meth:`io_fault` locally
+        self.counters: Dict[str, float] = {
+            "dropped": 0.0, "straggled": 0.0, "steps_lost": 0.0,
+            "ckpt_io_faults": 0.0,
+        }
+
+    # ------------------------------------------------------------------
+    @property
+    def has_client_faults(self) -> bool:
+        return self.dropout_rate > 0.0 or self.straggler_rate > 0.0
+
+    def _round_rng(self, round_no: int) -> np.random.Generator:
+        return np.random.default_rng(np.random.SeedSequence(
+            [self.seed, _CLIENT_STREAM, int(round_no)]))
+
+    def client_faults(self, round_no: int,
+                      sample_mask: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-round fault vectors for one packed round batch.
+
+        ``sample_mask``: the host-packed ``[K, S, B]`` grid (padded client
+        slots included).  Returns ``(drop [K] f32 in {0,1},
+        keep_steps [K] f32)`` — ``keep_steps`` is the step budget a
+        straggler completes before the barrier
+        (``ceil(real_steps / straggler_inflation)``, min 1) and
+        :data:`NO_BOUND` for everyone else.  Decisions are keyed on
+        (seed, round, client SLOT), so the schedule is identical however
+        the host loop is arranged (serial, pipelined, resumed)."""
+        k = int(sample_mask.shape[0])
+        rng = self._round_rng(round_no)
+        # one per-round stream, fixed draw order (drop then straggle):
+        # the determinism guarantee is per (seed, chaos config)
+        drop = (rng.random(k) < self.dropout_rate).astype(np.float32)
+        straggle = rng.random(k) < self.straggler_rate
+        real_steps = (np.asarray(sample_mask).sum(axis=2) > 0).sum(axis=1)
+        keep = np.where(
+            straggle,
+            np.maximum(np.ceil(real_steps / self.straggler_inflation), 1.0),
+            NO_BOUND).astype(np.float32)
+        return drop, keep
+
+    # ------------------------------------------------------------------
+    def io_fault(self) -> bool:
+        """One checkpoint-IO fault decision (call-indexed stream): True
+        means "this physical write attempt fails".  The counter advances
+        on every call, so retries of the same save draw fresh decisions —
+        a fault schedule that always re-failed the retry would make
+        ``ckpt_io_error_rate < 1`` untestable."""
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, _IO_STREAM, self._io_calls]))
+        self._io_calls += 1
+        if rng.random() < self.ckpt_io_error_rate:
+            self.counters["ckpt_io_faults"] += 1
+            return True
+        return False
+
+    def io_fault_hook(self) -> None:
+        """The :class:`~..engine.checkpoint.CheckpointManager` write hook:
+        raises a synthetic ``OSError`` when the schedule says so."""
+        if self.io_fault():
+            raise OSError(
+                f"chaos: injected checkpoint IO fault "
+                f"#{int(self.counters['ckpt_io_faults'])} "
+                f"(ckpt_io_error_rate={self.ckpt_io_error_rate})")
+
+    # ------------------------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        """The bench-contract record: enough to make a chaos run
+        impossible to confuse with a clean baseline."""
+        return {
+            "enabled": True,
+            "seed": self.seed,
+            "dropout_rate": self.dropout_rate,
+            "straggler_rate": self.straggler_rate,
+            "straggler_inflation": self.straggler_inflation,
+            "ckpt_io_error_rate": self.ckpt_io_error_rate,
+            "preempt_at_round": self.preempt_at_round,
+        }
+
+
+def make_chaos(server_config) -> Optional[ChaosSchedule]:
+    """Build the run's :class:`ChaosSchedule` from
+    ``server_config.chaos`` (None when absent or ``enable: false``)."""
+    raw = server_config.get("chaos") if server_config is not None else None
+    if not raw:
+        return None
+    raw = dict(raw)
+    if not raw.pop("enable", True):
+        return None
+    return ChaosSchedule(
+        seed=raw.get("seed", 0),
+        dropout_rate=raw.get("dropout_rate", 0.0),
+        straggler_rate=raw.get("straggler_rate", 0.0),
+        straggler_inflation=raw.get("straggler_inflation", 2.0),
+        ckpt_io_error_rate=raw.get("ckpt_io_error_rate", 0.0),
+        preempt_at_round=raw.get("preempt_at_round"),
+    )

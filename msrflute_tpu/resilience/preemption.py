@@ -1,0 +1,128 @@
+"""Graceful preemption: SIGTERM/SIGINT -> drain -> checkpoint -> exit.
+
+Preemptible TPU slices get a SIGTERM and a short grace window.  The
+handler here does NOT abort anything itself — it flips a flag the server
+round loop polls at chunk boundaries.  On seeing it the loop drains the
+in-flight device chunk (the dispatched-but-undrained slot in pipelined
+mode — nothing speculative beyond it is ever dispatched), runs that
+chunk's normal housekeeping (which writes the per-round ``latest``
+checkpoint through the existing two-slot path), forces the async writers
+durable, commits the resume anchor (round + rng snapshots) to
+``status_log.json``, and returns.  ``e2e_trainer.py`` then exits with
+``os.EX_TEMPFAIL`` (75) so schedulers distinguish "preempted, resume me"
+from success and from crashes.
+
+Signal handlers only install from the main thread (CPython restriction);
+anywhere else — tests driving ``train()`` from a worker thread, notebook
+kernels — the handler degrades to the polling flag alone, which the
+deterministic ``server_config.chaos.preempt_at_round`` drill and direct
+``request()`` calls still exercise end to end.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+from ..utils.logging import print_rank
+
+
+class GracefulPreemption(Exception):
+    """Raised by entry points that want stack unwinding on preemption
+    (the server loop itself returns normally instead)."""
+
+
+class PreemptionHandler:
+    """Install/uninstall SIGTERM+SIGINT handlers around a training run.
+
+    Usage::
+
+        handler = PreemptionHandler()
+        handler.install()
+        try:
+            while ...:
+                if handler.requested:
+                    ...drain + emergency checkpoint...
+                    break
+        finally:
+            handler.uninstall()
+
+    Repeated signals stay graceful until ``escalate_after`` arrivals,
+    after which the previous (default) disposition is restored so a
+    second Ctrl-C actually kills a wedged run.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, escalate_after: int = 2):
+        self.escalate_after = max(int(escalate_after), 1)
+        self._event = threading.Event()
+        self._reason: Optional[str] = None
+        self._prev = {}
+        self._installed = False
+        self._hits = 0
+
+    # -- flag side -----------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def reason(self) -> Optional[str]:
+        return self._reason
+
+    def reset(self) -> None:
+        """Clear a latched request + the signal hit-count — called at the
+        start of each training window so a server that preempted once
+        (drill or real signal) can train again instead of exiting its
+        next ``train()`` instantly with zero progress."""
+        self._event.clear()
+        self._reason = None
+        self._hits = 0
+
+    def request(self, reason: str) -> None:
+        """Programmatic preemption — the chaos drill
+        (``preempt_at_round``) and tests come through here; the signal
+        handler is a thin wrapper around it."""
+        if not self._event.is_set():
+            self._reason = reason
+            print_rank(f"preemption requested ({reason}); draining and "
+                       "checkpointing", loglevel=logging.WARNING)
+        self._event.set()
+
+    # -- signal side ---------------------------------------------------
+    def _on_signal(self, signum, frame):  # noqa: ARG002 - signal API
+        self._hits += 1
+        self.request(f"signal {signal.Signals(signum).name}")
+        if self._hits >= self.escalate_after:
+            # a stuck drain must stay killable: restore the previous
+            # dispositions so the NEXT signal behaves as if we were
+            # never here
+            self.uninstall()
+            print_rank("repeated preemption signal: handlers restored; "
+                       "the next signal is fatal", loglevel=logging.WARNING)
+
+    def install(self) -> bool:
+        """Install handlers; True when actually installed (main thread
+        only — elsewhere the polling flag still works, signals don't)."""
+        if self._installed:
+            return True
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        for sig in self.SIGNALS:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # non-main thread teardown
+                pass
+        self._prev.clear()
+        self._installed = False

@@ -118,6 +118,35 @@ PRIVACY_METRICS_KEYS = {
 
 SERVER_REPLAY_KEYS = {"server_iterations", "optimizer_config", "data_config"}
 
+CHAOS_KEYS = {
+    "enable", "seed", "dropout_rate", "straggler_rate",
+    "straggler_inflation", "ckpt_io_error_rate", "preempt_at_round",
+}
+
+CHECKPOINT_RETRY_KEYS = {
+    "retries", "backoff_base_s", "backoff_max_s", "jitter",
+    "escalation_threshold",
+}
+
+CHAOS_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    "seed": ("int", 0, None),
+    "dropout_rate": ("num", 0.0, 1.0),
+    "straggler_rate": ("num", 0.0, 1.0),
+    # divides the steps a straggler completes before the round barrier
+    "straggler_inflation": ("num", 1.0, None),
+    "ckpt_io_error_rate": ("num", 0.0, 1.0),
+    "preempt_at_round": ("int", 0, None),
+}
+
+CHECKPOINT_RETRY_FIELD_SPECS = {
+    "retries": ("int", 1, None),
+    "backoff_base_s": ("num", 0, None),
+    "backoff_max_s": ("num", 0, None),
+    "jitter": ("num", 0, 1.0),
+    "escalation_threshold": ("int", 1, None),
+}
+
 RL_KEYS = {
     "marginal_update_RL", "RL_path", "RL_path_global", "model_descriptor_RL",
     "network_params", "initial_epsilon", "final_epsilon", "epsilon_gamma",
@@ -151,6 +180,12 @@ SERVER_KEYS = {
     "checkpoint_async", "compilation_cache_dir", "secure_agg", "fedbuff",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
     "ef_device_residuals", "ef_flush_freq",
+    # resilience: seeded deterministic fault injection (dropout/straggler
+    # faults fold into the fused round program; IO faults exercise the
+    # checkpoint retry/fallback machinery; preempt_at_round drives the
+    # kill/resume drill) and the checkpoint retry/backoff/escalation
+    # policy — see docs/config_extensions.md and docs/RUNBOOK.md
+    "chaos", "checkpoint_retry",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
@@ -466,6 +501,20 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
         rl = sc.get("RL")
         if isinstance(rl, dict):
             _check_unknown(unknown, rl, "server_config.RL", RL_KEYS)
+        chaos = sc.get("chaos")
+        if isinstance(chaos, dict):
+            _check_unknown(unknown, chaos, "server_config.chaos",
+                           CHAOS_KEYS)
+            _check_fields(errors, chaos, "server_config.chaos",
+                          CHAOS_FIELD_SPECS)
+        ckpt_retry = sc.get("checkpoint_retry")
+        if isinstance(ckpt_retry, dict):
+            _check_unknown(unknown, ckpt_retry,
+                           "server_config.checkpoint_retry",
+                           CHECKPOINT_RETRY_KEYS)
+            _check_fields(errors, ckpt_retry,
+                          "server_config.checkpoint_retry",
+                          CHECKPOINT_RETRY_FIELD_SPECS)
         ncpi = sc.get("num_clients_per_iteration")
         if ncpi is not None and not isinstance(ncpi, int):
             if not (isinstance(ncpi, str) and ":" in ncpi):

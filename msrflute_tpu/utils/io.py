@@ -21,20 +21,33 @@ _LOGGER = logging.getLogger("msrflute_tpu")
 
 def try_except_save(save_fn: Callable[[], None], retries: int = 3,
                     delay_s: float = 1.0) -> bool:
-    """Retry a save callable (reference ``utils/utils.py:348-359``)."""
+    """Retry a save callable (reference ``utils/utils.py:348-359``).
+
+    Fatal control-flow exceptions (``KeyboardInterrupt``/``SystemExit``)
+    always propagate — a Ctrl-C mid-save must kill the process, not burn
+    the retry budget.  The checkpoint manager uses the richer
+    exponential-backoff policy in
+    :mod:`msrflute_tpu.resilience.integrity` instead; this helper stays
+    for simple best-effort persistence call sites.
+    """
     for attempt in range(retries):
         try:
             save_fn()
             return True
+        except (KeyboardInterrupt, SystemExit):
+            raise
         except Exception as exc:  # noqa: BLE001 - deliberate: persist best-effort
             _LOGGER.warning("save attempt %d/%d failed: %s", attempt + 1, retries, exc)
-            time.sleep(delay_s)
+            if attempt < retries - 1:
+                time.sleep(delay_s)
     return False
 
 
 def update_json_log(path: str, update: Dict[str, Any]) -> Dict[str, Any]:
     """Merge ``update`` into a JSON log file (reference
-    ``utils/utils.py:546-560``), returning the merged dict."""
+    ``utils/utils.py:546-560``), returning the merged dict.  A ``None``
+    value DELETES the key (used to clear one-shot markers like the
+    preemption flag once a resumed run completes)."""
     data: Dict[str, Any] = {}
     if os.path.exists(path):
         try:
@@ -42,7 +55,11 @@ def update_json_log(path: str, update: Dict[str, Any]) -> Dict[str, Any]:
                 data = json.load(fh)
         except (json.JSONDecodeError, OSError):
             data = {}
-    data.update(update)
+    for key, value in update.items():
+        if value is None:
+            data.pop(key, None)
+        else:
+            data[key] = value
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(data, fh, indent=2)

@@ -402,6 +402,52 @@ def test_schema_drift_flags_undocumented_operator_knob(tmp_path):
     assert "pipeline_depth" in found[0].message
 
 
+def test_schema_drift_covers_chaos_and_checkpoint_retry_specs(tmp_path):
+    """PR 3 corpus: the resilience blocks' field specs are drift-checked
+    like every other section — a CHAOS_FIELD_SPECS / CHECKPOINT_RETRY_
+    FIELD_SPECS rule for a key the unknown-key pass doesn't know is dead
+    and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'chaos', 'checkpoint_retry'}\n"
+        "CHAOS_KEYS = {'seed', 'dropout_rate'}\n"
+        "CHECKPOINT_RETRY_KEYS = {'retries'}\n"
+        "CHAOS_FIELD_SPECS = {'dropout_rate': ('num', 0, 1),"
+        " 'ghost_rate': ('num', 0, 1)}\n"
+        "CHECKPOINT_RETRY_FIELD_SPECS = {'retries': ('int', 1, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.chaos` and `server_config.checkpoint_retry` "
+        "are the resilience knobs.")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("chaos", "checkpoint_retry"))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "ghost_rate" in found[0].message and "CHAOS_KEYS" in found[0].message
+
+
+def test_schema_drift_flags_undocumented_resilience_knob(tmp_path):
+    """``chaos`` in the schema but absent from the runbook is exactly the
+    operator-facing desync the documented-knobs rule exists for."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'chaos', 'checkpoint_retry'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text("no resilience documented here")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("chaos", "checkpoint_retry"))
+    assert sorted(f.rule for f in found) == ["schema-drift", "schema-drift"]
+    msgs = " ".join(f.message for f in found)
+    assert "chaos" in msgs and "checkpoint_retry" in msgs
+
+
 def test_schema_drift_real_tree_is_consistent():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     found = check_project(repo)

@@ -157,3 +157,50 @@ def test_schema_quant_thresh_is_a_quantile():
                                      "quant_thresh": 1.5}}
     with pytest.raises(SchemaError, match="quant_thresh"):
         FLUTEConfig.from_dict(bad)
+
+
+def test_schema_chaos_block_is_validated():
+    """The resilience fault-injection block: typed keys, ranged rates,
+    unknown keys rejected with a did-you-mean (PR 3)."""
+    ok = {**MINI, "server_config": {
+        **MINI["server_config"],
+        "chaos": {"seed": 3, "dropout_rate": 0.2, "straggler_rate": 0.1,
+                  "straggler_inflation": 2.0, "ckpt_io_error_rate": 0.05,
+                  "preempt_at_round": 10}}}
+    cfg = FLUTEConfig.from_dict(ok)
+    assert cfg.server_config.get("chaos")["dropout_rate"] == 0.2
+
+    bad_rate = {**MINI, "server_config": {**MINI["server_config"],
+                                          "chaos": {"dropout_rate": 1.5}}}
+    with pytest.raises(SchemaError, match="dropout_rate"):
+        FLUTEConfig.from_dict(bad_rate)
+
+    typo = {**MINI, "server_config": {**MINI["server_config"],
+                                      "chaos": {"dropout_rte": 0.1}}}
+    with pytest.raises(SchemaError, match="dropout_rte"):
+        FLUTEConfig.from_dict(typo)
+
+    # inflation < 1 would mean stragglers do MORE work than the barrier
+    bad_inf = {**MINI, "server_config": {
+        **MINI["server_config"], "chaos": {"straggler_inflation": 0.5}}}
+    with pytest.raises(SchemaError, match="straggler_inflation"):
+        FLUTEConfig.from_dict(bad_inf)
+
+
+def test_schema_checkpoint_retry_block_is_validated():
+    ok = {**MINI, "server_config": {
+        **MINI["server_config"],
+        "checkpoint_retry": {"retries": 5, "backoff_base_s": 0.1,
+                             "backoff_max_s": 10, "jitter": 0.5,
+                             "escalation_threshold": 4}}}
+    FLUTEConfig.from_dict(ok)
+
+    bad = {**MINI, "server_config": {**MINI["server_config"],
+                                     "checkpoint_retry": {"retries": 0}}}
+    with pytest.raises(SchemaError, match="retries"):
+        FLUTEConfig.from_dict(bad)
+
+    typo = {**MINI, "server_config": {**MINI["server_config"],
+                                      "checkpoint_retry": {"retrys": 2}}}
+    with pytest.raises(SchemaError, match="retrys"):
+        FLUTEConfig.from_dict(typo)

@@ -145,8 +145,11 @@ def test_orbax_async_checkpoint_backend(synth_dataset, mesh8, tmp_path):
                                 model_dir=str(tmp_path), mesh=mesh8, seed=0)
     state = server.train()
     # two-slot latest: pointer file names the committed slot directory
-    ptr = (tmp_path / "latest_model.orbax.ptr").read_text().strip()
-    assert os.path.isdir(tmp_path / ptr)
+    # and (since the resilience PR) records its tree checksum
+    import json as _json
+    ptr = _json.loads((tmp_path / "latest_model.orbax.ptr").read_text())
+    assert os.path.isdir(tmp_path / ptr["slot"])
+    assert ptr["crc32"]
     assert any(n.startswith("best_val_") and n.endswith(".orbax")
                for n in os.listdir(tmp_path))
 

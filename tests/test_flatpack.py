@@ -113,16 +113,15 @@ def test_async_latest_single_slot_bounds_skew(tmp_path, monkeypatch):
     gate = threading.Event()      # test-held: lets the in-flight write land
     entered = threading.Event()   # writer reached the (gated) blob write
     writes = []                   # (path, writing thread name)
-    real = CheckpointManager._write_blob  # staticmethod -> plain function
+    real = CheckpointManager._write_blob  # instance method -> plain function
 
-    def gated_write(path, blob):
+    def gated_write(self, path, blob, keep_prev=False):
         entered.set()
         assert gate.wait(timeout=30), "test gate never opened"
         writes.append((path, threading.current_thread().name))
-        real(path, blob)
+        return real(self, path, blob, keep_prev=keep_prev)
 
-    monkeypatch.setattr(CheckpointManager, "_write_blob",
-                        staticmethod(gated_write))
+    monkeypatch.setattr(CheckpointManager, "_write_blob", gated_write)
 
     mgr.save_latest(state(1))
     # the submit returned with the gate still closed, so the write MUST

@@ -1,0 +1,200 @@
+"""Graceful preemption + bit-exact resume (ISSUE 3 tentpole).
+
+The contract: killing a run at round k (SIGTERM, or the deterministic
+``chaos.preempt_at_round`` drill) drains the in-flight device chunk,
+leaves a durable checkpoint + rng resume anchors, and a resumed run
+finishes with params BIT-IDENTICAL to an uninterrupted run — in
+faithful mode (rounds_per_step=1), serial AND pipelined.
+"""
+
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.resilience.preemption import PreemptionHandler
+
+
+def _cfg(depth, rounds=6, **over):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": 4,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "rounds_per_step": 1,  # faithful mode
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "val_freq": 100, "initial_val": False, "data_config": {},
+    }
+    sc.update(over)
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _server(cfg, synth_dataset, model_dir):
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    return OptimizationServer(make_task(cfg.model_config), cfg,
+                              synth_dataset, model_dir=model_dir, seed=11)
+
+
+def _flat(state):
+    import jax
+    from jax.flatten_util import ravel_pytree
+    return np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+
+
+@pytest.fixture(scope="module")
+def uninterrupted_flat(synth_dataset, tmp_path_factory):
+    """One uninterrupted reference run, shared by both depth arms —
+    serial and pipelined trained params are bit-identical by the pinned
+    pipeline contract (tests/test_server_pipeline.py), so one reference
+    serves both comparisons."""
+    root = tmp_path_factory.mktemp("ref")
+    ref = _server(_cfg(1), synth_dataset, str(root))
+    state = ref.train()
+    assert state.round == 6
+    return _flat(state)
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["serial", "pipelined"])
+def test_kill_at_round_k_then_resume_is_bit_identical(depth, synth_dataset,
+                                                      uninterrupted_flat,
+                                                      tmp_path):
+    root = str(tmp_path / f"d{depth}")
+
+    # kill at round 3 via the deterministic drill...
+    pre = _server(_cfg(depth, chaos={"preempt_at_round": 3}),
+                  synth_dataset, root + "/run")
+    pre_state = pre.train()
+    assert pre.preempted
+    assert pre_state.round == 3
+    status = json.load(open(os.path.join(root, "run", "status_log.json")))
+    assert status["i"] == 3
+    assert "preempted" in status
+    assert "np_rng_state" in status and "rng_uses" in status
+
+    # ...and resume — with the SAME chaos block, exactly like the
+    # RUNBOOK drill relaunch: preempt_at_round fires only when crossed
+    # from below, so the resumed run must train on, not re-preempt
+    res = _server(_cfg(depth, resume_from_checkpoint=True,
+                       chaos={"preempt_at_round": 3}),
+                  synth_dataset, root + "/run")
+    assert res.state.round == 3
+    res_state = res.train()
+    assert res_state.round == 6
+    assert not res.preempted
+    np.testing.assert_array_equal(uninterrupted_flat, _flat(res_state))
+
+    # in-process continuation: calling train() again on the PREEMPTED
+    # server must reset the latched preemption (not exit instantly with
+    # zero progress) and, since its live rng state equals the snapshot,
+    # land on the same bits
+    cont_state = pre.train()
+    assert not pre.preempted
+    assert cont_state.round == 6
+    np.testing.assert_array_equal(uninterrupted_flat, _flat(cont_state))
+
+
+def test_corrupted_latest_slot_falls_back_and_still_resumes(synth_dataset,
+                                                            tmp_path):
+    """Acceptance: a flipped byte in the latest checkpoint auto-falls
+    back to the backup slot with a logged recovery event, and the run
+    resumes (one round back, re-training forward)."""
+    root = str(tmp_path)
+    srv = _server(_cfg(0), synth_dataset, root)
+    srv.train()
+
+    latest = os.path.join(root, "latest_model.msgpack")
+    blob = bytearray(open(latest, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(latest, "wb").write(bytes(blob))
+
+    res = _server(_cfg(0, resume_from_checkpoint=True), synth_dataset, root)
+    events = [e["event"] for e in res.ckpt.recovery_events]
+    assert any("integrity check failed" in e for e in events)
+    assert any("backup slot" in e for e in events)
+    # the .prev slot holds the previous round's anchor
+    assert res.state.round == 5
+
+
+def test_sigterm_handler_requests_and_restores(tmp_path):
+    """Real-signal wiring: SIGTERM flips the flag (no exception), the
+    previous disposition comes back on uninstall, and a repeat signal
+    re-arms the default so a wedged drain stays killable."""
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        handler = PreemptionHandler(escalate_after=2)
+        assert handler.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        # wait for delivery (synchronous on the main thread, but be safe)
+        for _ in range(100):
+            if handler.requested:
+                break
+        assert handler.requested
+        assert "SIGTERM" in handler.reason
+        assert seen == []  # our handler intercepted, not the previous one
+        # second signal escalates: handlers restored -> the PREVIOUS
+        # disposition (our recording lambda) sees the third signal
+        os.kill(os.getpid(), signal.SIGTERM)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [signal.SIGTERM]
+        handler.uninstall()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+@pytest.mark.slow
+def test_sigterm_mid_training_exits_resumable(synth_dataset, tmp_path):
+    """End-to-end signal drill: a real SIGTERM lands mid-``train()``; the
+    loop drains, checkpoints, and returns with ``preempted`` set and a
+    resumable status log.  (Round of arrival is timing-dependent; the
+    resumability contract is not.)  ``slow``: the handler wiring and the
+    deterministic preempt_at_round drill above cover the same contract
+    inside tier-1's budget; this wall-clock-timed variant runs with the
+    full suite."""
+    srv = _server(_cfg(1, rounds=2), synth_dataset, str(tmp_path))
+    srv.train()  # compile + 2 rounds, so the signal lands mid-LOOP below
+    srv.config.server_config.max_iteration = 400
+    timer = threading.Timer(1.0, os.kill, (os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        state = srv.train()
+    finally:
+        timer.cancel()
+    assert srv.preempted
+    assert 2 < state.round < 400
+    status = json.load(open(tmp_path / "status_log.json"))
+    assert status["i"] == state.round
+    assert "preempted" in status and "np_rng_state" in status
+    # and the checkpoint actually loads at that round
+    res = _server(_cfg(1, rounds=400, resume_from_checkpoint=True),
+                  synth_dataset, str(tmp_path))
+    assert res.state.round == state.round
+    assert res._rng_uses == state.round  # one chunk key per faithful round
+
+
+def test_preemption_install_degrades_off_main_thread():
+    """Signal handlers cannot install off the main thread; the polling
+    flag must still work there (the chaos drill path)."""
+    results = {}
+
+    def worker():
+        handler = PreemptionHandler()
+        results["installed"] = handler.install()
+        handler.request("test")
+        results["requested"] = handler.requested
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert results == {"installed": False, "requested": True}
