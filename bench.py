@@ -544,6 +544,7 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
     from msrflute_tpu.models import make_task
     from msrflute_tpu.parallel import make_mesh
     from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+    from msrflute_tpu.telemetry.timing import Stopwatch
 
     mesh = make_mesh()
     task = make_task(cfg.model_config)
@@ -556,14 +557,17 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
         # ---- warmup (compiles the fused-round program) ----
         server.config.server_config.max_iteration = warmup_rounds
         server.train()
-        # ---- timed chunks ----
+        # ---- timed chunks (telemetry.timing.Stopwatch: the same
+        # perf_counter stopwatch as the server spans and the tools, so
+        # bench numbers and trace spans share one clock; JSON field
+        # names unchanged) ----
         per_chunk = []
         for _ in range(timed_chunks):
             server.config.server_config.max_iteration += fuse
-            tic = time.time()
-            server.train()
-            jax.block_until_ready(server.state.params)
-            per_chunk.append((time.time() - tic) / fuse)
+            with Stopwatch() as sw:
+                server.train()
+                jax.block_until_ready(server.state.params)
+            per_chunk.append(sw.secs / fuse)
 
         # ---- eval cost (pure jitted eval; no checkpoint I/O).  Batches
         # are pre-staged on device like the server's per-split cache, so
@@ -571,10 +575,10 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
         batches = server._packed_eval_batches("val")
         evaluate(task, server._eval_fn, server.state.params, batches, mesh,
                  server.engine.partition_mode)  # compile
-        tic = time.time()
-        evaluate(task, server._eval_fn, server.state.params, batches, mesh,
-                 server.engine.partition_mode)
-        secs_eval = time.time() - tic
+        with Stopwatch() as sw:
+            evaluate(task, server._eval_fn, server.state.params, batches,
+                     mesh, server.engine.partition_mode)
+        secs_eval = sw.secs
 
         mfu = None
         if want_mfu:
@@ -630,6 +634,16 @@ def _server_overhead_extras(server) -> dict:
                             fault_counters={k: round(float(v), 1)
                                             for k, v in
                                             chaos.counters.items()})
+    # telemetry mode is part of the bench CONTRACT (the chaos-mode rule
+    # applied to instrumentation): an instrumented run can never be
+    # silently compared against an uninstrumented baseline
+    scope = getattr(server, "scope", None)
+    out["telemetry"] = ({"enabled": False} if scope is None else
+                        {"enabled": True,
+                         "trace": scope.tracer is not None,
+                         "devbus": server.engine.devbus.enabled,
+                         "watchdog_findings":
+                             len(scope.watchdog.findings)})
     return out
 
 
@@ -960,6 +974,56 @@ def bench_pipeline_ab(on_tpu: bool) -> dict:
     return out
 
 
+def bench_telemetry_ab(on_tpu: bool) -> dict:
+    """Telemetry-off vs telemetry-on A/B (flutescope's zero-overhead
+    acceptance, ISSUE 4): the SAME faithful-mode protocol run with no
+    ``server_config.telemetry`` block and with the full subsystem on
+    (spans + trace export + devbus + watchdogs), many rounds inside one
+    ``train()`` call.  Records steady-state s/round per arm and the
+    ratio; params are bit-identical by contract
+    (tests/test_telemetry_contract.py pins that plus the
+    zero-implicit-materialization property)."""
+    import tempfile
+
+    import jax
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.telemetry.timing import Stopwatch
+
+    warm, rounds = (5, 40) if on_tpu else (3, 30)
+    out = {"rounds_per_arm": rounds,
+           "protocol": "cnn_femnist" if on_tpu else "lr_mnist"}
+    for arm in ("off", "on"):
+        if on_tpu:
+            cfg = _flute_config({"model_type": "CNN", "num_classes": 62},
+                                20, 0.1, fuse=1)
+            data = _image_dataset(64, 240, (28, 28, 1), 62,
+                                  np.random.default_rng(0))
+        else:
+            cfg = _flute_config({"model_type": "LR", "num_classes": 10,
+                                 "input_dim": 784}, 10, 0.03, fuse=1)
+            data = _image_dataset(16, 60, (784,), 10,
+                                  np.random.default_rng(0))
+        if arm == "on":
+            cfg.server_config["telemetry"] = {"enable": True}
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            server = OptimizationServer(task, cfg, data, model_dir=tmp,
+                                        mesh=make_mesh(), seed=0)
+            cfg.server_config.max_iteration = warm
+            server.train()  # compile + steady state
+            cfg.server_config.max_iteration = warm + rounds
+            with Stopwatch() as sw:
+                server.train()
+                jax.block_until_ready(server.state.params)
+        out[f"telemetry_{arm}_secs_per_round"] = round(sw.secs / rounds, 5)
+    off = out["telemetry_off_secs_per_round"]
+    out["overhead_ratio"] = round(
+        out["telemetry_on_secs_per_round"] / max(off, 1e-9), 3)
+    return out
+
+
 def scale_probe(backend: str) -> dict:
     """K-clients-per-round scaling curve (the reference's "tens of
     thousands sampled / millions total" axis, ``README.md:9``).  Run via
@@ -1105,6 +1169,21 @@ def main() -> None:
         extras["chaos"] = dict(chaos_cfg, enabled=True)
     else:
         extras["chaos"] = {"enabled": False}
+    # telemetry mode mirrors the chaos guard: always recorded, so an
+    # instrumented run (BENCH_TELEMETRY=1, or a JSON
+    # server_config.telemetry block) can never be silently compared
+    # against an uninstrumented baseline.  Per-protocol entries also
+    # carry the mode via _server_overhead_extras.
+    telemetry_env = os.environ.get("BENCH_TELEMETRY")
+    if telemetry_env:
+        telemetry_cfg = (json.loads(telemetry_env)
+                         if telemetry_env.strip().startswith("{") else
+                         {"enable": True})
+        for spec in protocols.values():
+            spec["cfg"].server_config["telemetry"] = dict(telemetry_cfg)
+        extras["telemetry"] = dict(telemetry_cfg, enabled=True)
+    else:
+        extras["telemetry"] = {"enabled": False}
     if not on_tpu:
         # CPU fallback: carry the most recent committed raw on-chip
         # artifact, if any (written only by a fully successful TPU
@@ -1201,6 +1280,20 @@ def main() -> None:
                 extras["faithful_pipeline_ab"] = bench_pipeline_ab(on_tpu)
         except Exception as exc:
             extras["faithful_pipeline_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # flutescope overhead A/B: default-on for CPU runs (the acceptance
+    # harness for the zero-overhead claim), env-gated on TPU like the
+    # pipeline A/B
+    if (not on_tpu or os.environ.get("BENCH_TELEMETRY_AB")) and \
+            (keep is None or "telemetry_overhead_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("telemetry_overhead_ab"):
+                extras["telemetry_overhead_ab"] = bench_telemetry_ab(on_tpu)
+        except Exception as exc:
+            extras["telemetry_overhead_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

@@ -31,8 +31,11 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 #: modules whose per-round cost rides the TPU queue — the host-sync rule
-#: only applies here (cold paths may sync freely)
-HOT_PATH_PARTS = ("engine", "ops", "strategies")
+#: only applies here (cold paths may sync freely).  telemetry/ is in the
+#: set because its whole contract is zero device syncs: a devbus
+#: publisher spelled `.item()`/`float(...)` would silently turn the
+#: packed-stats ride-along into per-scalar transfers.
+HOT_PATH_PARTS = ("engine", "ops", "strategies", "telemetry")
 
 _PRAGMA_RE = re.compile(
     r"#\s*flint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+(\S.*))?")

@@ -47,6 +47,9 @@ _SPEC_MAP = {
     # unknown-key pass knows, like every other section
     "CHAOS_FIELD_SPECS": "CHAOS_KEYS",
     "CHECKPOINT_RETRY_FIELD_SPECS": "CHECKPOINT_RETRY_KEYS",
+    # flutescope telemetry blocks (PR 4)
+    "TELEMETRY_FIELD_SPECS": "TELEMETRY_KEYS",
+    "WATCHDOG_FIELD_SPECS": "WATCHDOG_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -63,6 +66,9 @@ DOCUMENTED_KNOBS = (
     # fault-injection drill in the runbook will learn about it from a
     # lost run instead
     "chaos", "checkpoint_retry",
+    # flutescope: an operator who cannot find the trace/watchdog knobs
+    # will keep debugging round time from log lines
+    "telemetry",
 )
 
 _DOC_MENTION_RE = re.compile(

@@ -401,6 +401,11 @@ class ServerConfig(Config):
     # CHECKPOINT_RETRY_KEYS)
     chaos: Optional[Dict[str, Any]] = None
     checkpoint_retry: Optional[Dict[str, Any]] = None
+    # flutescope telemetry (telemetry/): spans + trace export, the
+    # device-metric bus, opt-in jax.profiler windows, and watchdogs —
+    # free-form dict validated by schema.TELEMETRY_KEYS /
+    # WATCHDOG_KEYS; absent (the default) means telemetry fully off
+    telemetry: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -422,7 +427,7 @@ class ServerConfig(Config):
             "do_profiling", "wantRL", "aggregate_median", "softmax_beta",
             "initial_lr", "weight_train_loss", "stale_prob",
             "num_skip_decoding", "nbest_task_scheduler", "chaos",
-            "checkpoint_retry"]))
+            "checkpoint_retry", "telemetry"]))
         out.data_config = data
         out.optimizer_config = opt
         out.annealing_config = ann

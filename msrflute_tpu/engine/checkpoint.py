@@ -32,6 +32,7 @@ from ..resilience.integrity import (CheckpointCorruptionError,
                                     blob_checksum, run_with_retry,
                                     tree_checksum, verify_blob,
                                     write_sidecar)
+from ..telemetry import NULL_SPAN, emit_event
 from ..utils.io import update_json_log
 from ..utils.logging import print_rank
 from .round import ServerState
@@ -145,9 +146,24 @@ class CheckpointManager:
         #: uncheckpointed forever
         self.retry = retry or RetryPolicy()
         self.escalator = FailureEscalator(self.retry.escalation_threshold)
+        #: optional flutescope scope (assigned by the server): writer-
+        #: thread spans + structured recovery/fault events; None keeps
+        #: every emission a metrics-stream-only record or a no-op
+        self.telemetry = None
         #: chaos hook: called at the start of every physical write
-        #: attempt; raises to inject a deterministic IO fault
-        self._io_fault = io_fault or (lambda: None)
+        #: attempt; raises to inject a deterministic IO fault — wrapped
+        #: so every injected fault leaves a structured event record
+        #: (tools/chaos_smoke.py asserts these reach the trace)
+        base_fault = io_fault or (lambda: None)
+
+        def _fault_probe():
+            try:
+                base_fault()
+            except Exception:
+                emit_event(self.telemetry, "ckpt_io_fault")
+                raise
+
+        self._io_fault = _fault_probe
         #: load-time integrity/fallback observability: one dict per
         #: recovery (corrupted slot skipped, backup slot used, ...)
         self.recovery_events: List[Dict[str, str]] = []
@@ -184,8 +200,12 @@ class CheckpointManager:
 
     def _recover(self, event: str, path: str) -> None:
         """Record + log one integrity-recovery event (corrupt slot
-        skipped, fallback slot used)."""
+        skipped, fallback slot used) — also a structured record in the
+        metrics stream (and the trace, when telemetry is on) instead of
+        a log-line-only breadcrumb."""
         self.recovery_events.append({"event": event, "path": path})
+        emit_event(self.telemetry, "checkpoint_recovery", detail=event,
+                   path=path)
         print_rank(f"checkpoint recovery: {event} ({path})",
                    loglevel=logging.WARNING)
 
@@ -335,15 +355,22 @@ class CheckpointManager:
                 self._mp_mailbox = None
                 self._mp_busy = True
             try:
-                blob = serialization.msgpack_serialize(
-                    serialization.to_state_dict(jax.device_get(snap)))
-                del snap  # release the HBM snapshot before the disk write
-                # _write_blob already retries + counts the failure toward
-                # escalation; the abort itself surfaces at the training
-                # thread's next submit/wait (escalator.check there), never
-                # out of this daemon thread where it would vanish
-                self._write_blob(path, blob, keep_prev=True)
-                del blob
+                # flutescope: the async writer's fetch+serialize+write
+                # appears on ITS OWN thread track in the trace — the
+                # direct visual of checkpoint IO overlapping (or
+                # stalling) device rounds
+                with (self.telemetry.span("ckpt_async_write")
+                      if self.telemetry is not None else NULL_SPAN):
+                    blob = serialization.msgpack_serialize(
+                        serialization.to_state_dict(jax.device_get(snap)))
+                    del snap  # release the HBM snapshot before the write
+                    # _write_blob already retries + counts the failure
+                    # toward escalation; the abort itself surfaces at the
+                    # training thread's next submit/wait (escalator.check
+                    # there), never out of this daemon thread where it
+                    # would vanish
+                    self._write_blob(path, blob, keep_prev=True)
+                    del blob
             except (KeyboardInterrupt, SystemExit):
                 raise  # fatal signals must not be logged away
             except Exception as exc:  # never kill training from the writer
@@ -503,6 +530,9 @@ class CheckpointManager:
             self.escalator.record_success()
             return True
         self.escalator.record_failure(f"save {path}")
+        emit_event(self.telemetry, "checkpoint_save_failed",
+                   path=os.path.basename(path),
+                   consecutive=self.escalator.consecutive)
         return False
 
     def _write(self, path: str, state: ServerState,

@@ -125,16 +125,25 @@ def per_user_accuracy(per_user_fn: Callable, params: Any,
 
 def evaluate(task: BaseTask, eval_fn: Callable, params: Any,
              batches: Dict[str, np.ndarray], mesh: Mesh,
-             partition_mode: str = "shard_map") -> MetricsDict:
+             partition_mode: str = "shard_map",
+             telemetry=None) -> MetricsDict:
     """Run the jitted eval program and finalize metrics host-side.
 
     In shard_map mode the batch-step axis T is sharded over ``clients``
     (data-parallel eval); in gspmd mode batches stay replicated and the
     model axis shards the compute instead (a scan cannot iterate a sharded
     leading axis without resharding every step).
+
+    ``telemetry``: optional flutescope scope — the device program +
+    stat-sums fetch becomes its own ``eval_device`` span so a trace
+    separates eval device time from the host metric finalize.
     """
     spec = P(CLIENTS_AXIS) if partition_mode == "shard_map" else P()
     sharding = NamedSharding(mesh, spec)
     staged = {k: jax.device_put(v, sharding) for k, v in batches.items()}
-    sums = jax.device_get(eval_fn(params, staged))
+    if telemetry is not None:
+        with telemetry.span("eval_device"):
+            sums = jax.device_get(eval_fn(params, staged))
+    else:
+        sums = jax.device_get(eval_fn(params, staged))
     return task.finalize_metrics(sums)

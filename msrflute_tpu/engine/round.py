@@ -43,6 +43,8 @@ from ..models.base import BaseTask
 from ..optim import make_optimizer
 from ..parallel.mesh import CLIENTS_AXIS, MODEL_AXIS, make_mesh
 from ..strategies.base import BaseStrategy
+from ..telemetry import devbus_config_enabled
+from ..telemetry.devbus import DeviceMetricBus
 from ..utils.flatpack import FlatPacker
 from .client_update import ClientHParams, build_client_update, _clip_by_global_norm
 
@@ -163,6 +165,18 @@ class RoundEngine:
             _chaos_raw and _chaos_raw.get("enable", True) and
             (float(_chaos_raw.get("dropout_rate", 0.0) or 0.0) > 0.0 or
              float(_chaos_raw.get("straggler_rate", 0.0) or 0.0) > 0.0))
+
+        # flutescope device-metric bus (server_config.telemetry.devbus):
+        # engine/strategy code publishes per-round device scalars at
+        # TRACE time; round_step drains them into round_stats just
+        # before the flatpack pack, so every published value rides the
+        # existing single per-dtype-group transfer — zero new
+        # device_gets.  Static at engine build like the chaos flag: a
+        # telemetry-free config compiles the exact program it always
+        # did.  Strategies publish through their `devbus` attribute.
+        self.devbus = DeviceMetricBus(
+            devbus_config_enabled(sc.get("telemetry")))
+        strategy.devbus = self.devbus
 
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
@@ -496,6 +510,23 @@ class RoundEngine:
             round_stats.update(chaos_stats)
             for k, v in privacy_per_client.items():
                 round_stats[k] = v
+            if self.devbus.enabled:
+                # engine's own publisher: relative APPLIED update size
+                # ‖Δθ‖/‖θ‖ — the training-health scalar a grad norm
+                # alone hides (a huge gradient into huge weights is
+                # fine; into tiny ones is a blow-up).  Δθ is the
+                # post-optimizer delta (new - old), NOT the aggregate
+                # pseudo-gradient: the server lr / momentum transform
+                # scales the actual step, and this scalar must report
+                # what was applied.  Published like any strategy scalar
+                # and drained into the packed stats below.
+                applied = jax.tree.map(lambda a, b: a - b,
+                                       new_params, params)
+                self.devbus.publish(
+                    "update_ratio",
+                    optax.global_norm(applied)
+                    / (optax.global_norm(new_params) + 1e-12))
+                round_stats.update(self.devbus.drain())
             # single-transfer stats: pack the whole stats tree into one
             # 1-D buffer per dtype INSIDE the program (pure reshape/concat,
             # XLA fuses it), so the host fetches one buffer per dtype group

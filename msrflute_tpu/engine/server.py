@@ -38,6 +38,7 @@ from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
 from ..resilience import PreemptionHandler, make_chaos
 from ..resilience.integrity import RetryPolicy
 from ..strategies import select_strategy
+from ..telemetry import NULL_SPAN, emit_event, make_telemetry
 from ..utils.logging import flush_metrics, log_metric, print_rank
 from ..utils.metrics import Metric, MetricsDict
 from ..utils.strict import strict_transfer_scope
@@ -140,6 +141,22 @@ class OptimizationServer:
             retry=RetryPolicy.from_config(sc.get("checkpoint_retry")),
             io_fault=(self.chaos.io_fault_hook if self.chaos is not None
                       else None))
+
+        # ---- flutescope telemetry (server_config.telemetry) ----------
+        # None when the block is absent/disabled — the default, and the
+        # zero-cost contract: every instrumentation point below is one
+        # is-None check, no spans, no tracer, no watchdog state
+        # (tests/test_telemetry_contract.py).  When on, all host-side
+        # consumption reads only values the loop ALREADY fetched (the
+        # packed stats, wall clocks), so strict transfer mode and the
+        # one-fetch-per-round guard hold unchanged.
+        self.scope = make_telemetry(sc.get("telemetry"), model_dir)
+        if self.scope is not None:
+            self.ckpt.telemetry = self.scope
+            self.scope.watchdog.on_mark = self._watchdog_mark
+            # a SIGTERM must make the trace/metrics durable BEFORE the
+            # drain starts (the drain itself may wedge)
+            self.preemption.add_flush_hook(self.scope.flush)
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -434,6 +451,18 @@ class OptimizationServer:
                        f"{self.ef_store.n_params} ({gb:.2f} GiB HBM)")
 
     # ------------------------------------------------------------------
+    def _tspan(self, name: str, **args):
+        """One flutescope span — the shared no-op context when telemetry
+        is off (the off path costs one attribute read + None check)."""
+        return self.scope.span(name, **args) if self.scope is not None \
+            else NULL_SPAN
+
+    def _watchdog_mark(self, kind: str, fields: Dict[str, Any]) -> None:
+        """Watchdog ``mark`` action: persist the finding to the status
+        log so a post-mortem sees it without the metrics stream."""
+        self.ckpt.update_status({f"watchdog_{kind}": dict(fields)})
+
+    # ------------------------------------------------------------------
     def _next_rng(self) -> jax.Array:
         """The run's next device RNG stream: ``fold_in(base, n)`` with a
         host-side monotone counter.  Deterministic in EVENT ORDER (which
@@ -497,7 +526,26 @@ class OptimizationServer:
             # the env flag.
             with strict_transfer_scope():
                 return self._train_loop()
+        except BaseException:
+            # a mid-loop abort (WatchdogAbort, checkpoint escalation,
+            # Ctrl-C) skips _train_loop's normal tail: await in-flight
+            # async checkpoint saves so the resume anchor is not missing
+            # rounds — best-effort, never masking the original abort
+            try:
+                self.ckpt.wait()
+            except Exception:
+                pass
+            raise
         finally:
+            if self.scope is not None:
+                # the trace of an ABORTED run is exactly the trace the
+                # operator needs; close any open profiler window and
+                # materialize trace.json whatever path exited the loop
+                self.scope.profiler.finish()
+                try:
+                    self.scope.flush()
+                except Exception:
+                    pass
             self.preemption.uninstall()
 
     def _train_loop(self) -> ServerState:
@@ -546,6 +594,10 @@ class OptimizationServer:
                        until_val, until_rec)
 
         def pack_chunk(R: int) -> list:
+            with self._tspan("pack", rounds=R):
+                return _pack_chunk_inner(R)
+
+        def _pack_chunk_inner(R: int) -> list:
             # sample the whole chunk first so every round pads to a common
             # client count (ranged num_clients_per_iteration draws differ)
             chunk_samples = [self._sample() for _ in range(R)]
@@ -607,9 +659,19 @@ class OptimizationServer:
                     f"chaos preempt_at_round="
                     f"{self.chaos.preempt_at_round}")
             if self.preemption.requested:
+                # a signal-context request deferred its observability
+                # flush (file IO is unsafe in a handler); run it here,
+                # outside signal context, BEFORE the drain starts
+                self.preemption.flush_now()
                 break
             tic = time.time()
             R = chunk_R(round_no)
+            if self.scope is not None:
+                # opt-in jax.profiler window (telemetry.profile_rounds):
+                # chunk boundaries are the only safe start/stop points;
+                # the chunk's round RANGE decides, so a window inside a
+                # fused chunk still captures (the whole chunk)
+                self.scope.profiler.observe(round_no, rounds=R)
 
             # host-orchestrated per-round paths (RL re-weighting, SCAFFOLD
             # controls) share the normal round bookkeeping tail
@@ -619,7 +681,8 @@ class OptimizationServer:
                           self._run_ef_round
                           if self.ef_store is not None else None)
             if host_round is not None:
-                host_round(round_no)
+                with self._tspan("host_round", round=round_no):
+                    host_round(round_no)
                 if self.server_replay is not None:
                     # the reference runs replay after EVERY round
                     # (core/server.py:429)
@@ -673,11 +736,22 @@ class OptimizationServer:
                     self.chaos.client_faults(round_no + j,
                                              batches[j].sample_mask)
                     for j in range(R)]
-            self.state, packed = self.engine.dispatch_rounds(
-                self.state, batches, [client_lr] * R, server_lrs, chunk_rng,
-                leakage_threshold=self.max_allowed_leakage,
-                quant_thresholds=quant_thresholds, chaos_vecs=chaos_vecs)
+            # the device window span opens at dispatch and is ended by
+            # whoever drains this chunk — the explicit begin/end API
+            # exists exactly for this overlap (round k's window stays
+            # open while the host packs/dispatches k+1)
+            device_span = (self.scope.begin("round_device",
+                                            round0=round_no, rounds=R)
+                           if self.scope is not None else None)
+            with self._tspan("dispatch", round0=round_no, rounds=R):
+                self.state, packed = self.engine.dispatch_rounds(
+                    self.state, batches, [client_lr] * R, server_lrs,
+                    chunk_rng,
+                    leakage_threshold=self.max_allowed_leakage,
+                    quant_thresholds=quant_thresholds,
+                    chaos_vecs=chaos_vecs)
             chunk = {
+                "span": device_span,
                 "round0": round_no, "R": R, "state": self.state,
                 "stats": packed, "batches": batches,
                 "client_lr": client_lr, "server_lrs": server_lrs,
@@ -734,7 +808,12 @@ class OptimizationServer:
             # writes the per-round `latest` checkpoint, making those
             # rounds part of the resume anchor instead of lost work.
             # (Nothing speculative beyond this slot is ever dispatched.)
-            self._drain_chunk(pending, val_freq, rec_freq)
+            # The drain window is a first-class span: checkpoint stalls
+            # inside a preemption grace period are exactly what a trace
+            # reader needs to see.
+            with self._tspan("preempt_drain", round0=pending["round0"],
+                             rounds=pending["R"]):
+                self._drain_chunk(pending, val_freq, rec_freq)
             self.pipelined_chunks += 1
             pending = None
         self.ckpt.wait()  # async checkpoint saves must be durable on return
@@ -744,8 +823,13 @@ class OptimizationServer:
             # last housekeeping.  e2e_trainer turns this flag into
             # os.EX_TEMPFAIL so schedulers re-queue the job.
             self.preempted = True
+            # covers a signal that landed after the loop's last poll
+            # (e.g. during the final drain): idempotent no-op otherwise
+            self.preemption.flush_now()
             self.ckpt.update_status(
                 {"preempted": self.preemption.reason or "requested"})
+            emit_event(self.scope, "preempted_exit", round=round_no,
+                       reason=self.preemption.reason or "requested")
             print_rank(
                 f"preempted at round {round_no}/{max_iteration} "
                 f"({self.preemption.reason}); checkpoint durable — resume "
@@ -757,6 +841,12 @@ class OptimizationServer:
             self.ckpt.update_status({"preempted": None})
         self._log_timing()
         flush_metrics()
+        if self.scope is not None:
+            # close any open profiler window and make trace.json
+            # complete/loadable; the tracer stays open so a later
+            # train() on the same server appends to the same trace
+            self.scope.profiler.finish()
+            self.scope.flush()
         return self.state
 
     # ------------------------------------------------------------------
@@ -781,7 +871,12 @@ class OptimizationServer:
         way, which the pipeline equivalence tests pin)."""
         R = chunk["R"]
         round0 = chunk["round0"]
-        stats = chunk["stats"].fetch()
+        with self._tspan("stats_fetch", round0=round0, rounds=R):
+            stats = chunk["stats"].fetch()
+        if self.scope is not None:
+            # the fetch is the honest end-of-chunk fence: the device
+            # window that opened at dispatch closes here
+            self.scope.end(chunk.get("span"))
         toc = time.time()
         # serial chunks: prep-to-fence (chunk tic follows the previous
         # fence).  Pipelined chunks: fence-to-fence — this chunk's prep
@@ -791,6 +886,30 @@ class OptimizationServer:
             (toc - max(chunk["tic"], self._last_fence)) / R)
         self._last_fence = toc
 
+        with self._tspan("host_tail", round0=round0, rounds=R):
+            self._drain_host_tail(chunk, stats, val_freq, rec_freq)
+        self.run_stats["secsPerRoundHostTail"].append(
+            (time.time() - toc) / R)
+        if self.scope is not None:
+            # watchdogs run over values this tail ALREADY holds: the
+            # fetched per-round losses, the wall clock, the checkpoint
+            # escalator's consecutive-failure count.  A configured
+            # `abort` raises WatchdogAbort out of the round loop.
+            secs = self.run_stats["secsPerRound"][-1]
+            for j in range(R):
+                n = max(float(stats["client_count"][j]), 1.0)
+                self.scope.watchdog.observe_round(
+                    round0 + j,
+                    train_loss=float(stats["train_loss_sum"][j]) / n,
+                    round_secs=secs,
+                    ckpt_failures=self.ckpt.escalator.consecutive)
+
+    def _drain_host_tail(self, chunk: Dict[str, Any], stats,
+                         val_freq: int, rec_freq: int) -> None:
+        """The decode/log/housekeeping half of :meth:`_drain_chunk`
+        (split out so the whole region is one ``host_tail`` span)."""
+        R = chunk["R"]
+        round0 = chunk["round0"]
         # per-round logging (reference core/server.py:362-395 + AzureML)
         for j in range(R):
             r = round0 + j
@@ -801,6 +920,10 @@ class OptimizationServer:
             log_metric("Client learning rate", chunk["client_lr"], step=r)
             log_metric("Agg. grad norm",
                        float(stats["agg_grad_norm"][j]), step=r)
+        if self.scope is not None:
+            # bus-published device scalars: decoded from the SAME packed
+            # fetch as everything above (zero extra transfers)
+            self.scope.consume_devbus(stats, round0, R)
         if self.chaos is not None and "chaos_dropped" in stats:
             # injected-fault observability: counters computed inside the
             # round program, fetched through the SAME packed single
@@ -817,6 +940,12 @@ class OptimizationServer:
                 log_metric("Chaos dropped clients", dropped, step=r)
                 log_metric("Chaos stragglers", straggled, step=r)
                 log_metric("Chaos steps lost", lost, step=r)
+                if dropped or straggled or lost:
+                    # structured fault record (metrics stream + trace
+                    # instant), not just greppable metric lines
+                    emit_event(self.scope, "chaos_faults", round=r,
+                               dropped=dropped, straggled=straggled,
+                               steps_lost=lost)
         self._process_privacy_stats(
             stats, round0,
             client_mask=np.stack([b.client_mask for b in chunk["batches"]]))
@@ -835,8 +964,6 @@ class OptimizationServer:
         self._round_housekeeping(round0 + R, val_freq, rec_freq,
                                  skip_latest=chunk["latest_saved"],
                                  rng_snapshot=chunk.get("rng_snapshot"))
-        self.run_stats["secsPerRoundHostTail"].append(
-            (time.time() - toc) / R)
 
     # ------------------------------------------------------------------
     def _record_staged_bytes(self, batches: list, rounds: int) -> None:
@@ -961,6 +1088,14 @@ class OptimizationServer:
         ``rng_snapshot``: the resume anchor captured at dispatch time when
         lookahead packing overlaps (see ``_rng_snapshot``); None means
         "capture now" (plain serial loop, host-orchestrated rounds)."""
+        with self._tspan("housekeeping", round=round_no):
+            self._round_housekeeping_inner(round_no, val_freq, rec_freq,
+                                           skip_latest, rng_snapshot)
+
+    def _round_housekeeping_inner(self, round_no: int, val_freq: int,
+                                  rec_freq: int, skip_latest: bool,
+                                  rng_snapshot: Optional[Dict[str, Any]]
+                                  ) -> None:
         housekeeping_tic = time.time()
         improved = False
         if round_no % val_freq == 0:
@@ -976,9 +1111,11 @@ class OptimizationServer:
         if round_no % rec_freq == 0 and self.test_dataset is not None:
             self._maybe_eval("test", round_no)
 
-        if not skip_latest:
-            self.ckpt.save_latest(self.state)
-        self.ckpt.backup(self.state, round_no, best_names=tuple(self.best_val))
+        with self._tspan("ckpt_submit", round=round_no):
+            if not skip_latest:
+                self.ckpt.save_latest(self.state)
+            self.ckpt.backup(self.state, round_no,
+                             best_names=tuple(self.best_val))
         if self.scaffold_store is not None:
             # commit the control-round marker only once the paired model
             # checkpoint is DURABLE (async orbax saves land out of band):
@@ -1053,6 +1190,11 @@ class OptimizationServer:
         # line — the jsonl stream stays observable at round granularity
         # while the host tail stops paying a syscall per scalar
         flush_metrics()
+        if self.scope is not None:
+            # keep the on-disk trace fresh for long runs (throttled:
+            # the rewrite is O(events), paid at most every
+            # Tracer.FLUSH_INTERVAL_SECS)
+            self.scope.flush_throttled()
         self.run_stats["secsPerRoundHousekeeping"].append(
             time.time() - housekeeping_tic)
 
@@ -1147,6 +1289,11 @@ class OptimizationServer:
         log_metric("Aggregated weights", float(ws_np.sum()), step=round_no)
         log_metric("Control norm (server c)", float(c_norm),
                    step=round_no)  # latest-checkpoint save: housekeeping
+        if self.scope is not None:
+            # host-side bus publish: c_norm came through the bundled
+            # single fetch above — a counter sample, not a new transfer
+            self.scope.devbus_host("scaffold_c_norm", float(c_norm),
+                                   step=round_no)
 
     # ------------------------------------------------------------------
     def _run_ef_round(self, round_no: int) -> None:
@@ -1176,6 +1323,11 @@ class OptimizationServer:
         thresh = self.strategy.next_threshold()
         if self.strategy.quant_anneal != 1.0:
             log_metric("Quantization Thresh.", thresh, step=round_no)
+        if self.scope is not None:
+            # host-side bus publish: the annealed threshold is a host
+            # float (no device value involved)
+            self.scope.devbus_host("ef_quant_thresh", float(thresh),
+                                   step=round_no)
         leaves = jax.tree.leaves(pgs)
         treedef = jax.tree.structure(pgs)
         shapes = [l.shape[1:] for l in leaves]
@@ -1355,9 +1507,11 @@ class OptimizationServer:
         dataset = self.val_dataset if split == "val" else self.test_dataset
         if dataset is None or len(dataset) == 0:
             return False
-        metrics = evaluate(self.task, self._eval_fn, self.state.params,
-                           self._packed_eval_batches(split), self.mesh,
-                           self.engine.partition_mode)
+        with self._tspan("eval", split=split, round=round_no):
+            metrics = evaluate(self.task, self._eval_fn, self.state.params,
+                               self._packed_eval_batches(split), self.mesh,
+                               self.engine.partition_mode,
+                               telemetry=self.scope)
         for name, metric in metrics.items():
             log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
         if self._split_cfg(split).get("wantLogits", False):

@@ -63,6 +63,16 @@ class PreemptionHandler:
         self._prev = {}
         self._installed = False
         self._hits = 0
+        #: telemetry flush callbacks (trace writer etc.) run at
+        #: flush_now(), so a SIGTERM'd run's observability is durable
+        #: even if the drain itself later wedges
+        self._flush_hooks: list = []
+        self._flush_pending = False
+
+    def add_flush_hook(self, fn) -> None:
+        """Register a callable run (best-effort) when preemption is
+        requested — the server wires the telemetry scope's flush here."""
+        self._flush_hooks.append(fn)
 
     # -- flag side -----------------------------------------------------
     @property
@@ -81,21 +91,56 @@ class PreemptionHandler:
         self._event.clear()
         self._reason = None
         self._hits = 0
+        self._flush_pending = False
 
-    def request(self, reason: str) -> None:
+    def request(self, reason: str, _from_signal: bool = False) -> None:
         """Programmatic preemption — the chaos drill
         (``preempt_at_round``) and tests come through here; the signal
-        handler is a thin wrapper around it."""
+        handler is a thin wrapper around it.
+
+        ``_from_signal``: the telemetry flush (file IO + tracer locks)
+        is DEFERRED to :meth:`flush_now`, which the round loop calls at
+        its next poll — a Python signal handler interrupting the main
+        thread mid-``Tracer._emit_complete`` would self-deadlock on the
+        tracer lock, and a buffered ``fh.write`` interrupted mid-call
+        raises a reentrancy error.  Programmatic requests flush inline.
+        """
         if not self._event.is_set():
             self._reason = reason
+            self._flush_pending = True
             print_rank(f"preemption requested ({reason}); draining and "
                        "checkpointing", loglevel=logging.WARNING)
+            if not _from_signal:
+                self.flush_now()
         self._event.set()
+
+    def flush_now(self) -> None:
+        """Run the deferred observability flush exactly once per
+        request: structured ``preemption`` record + metrics-stream flush
+        + registered trace-writer hooks.  Safe to call repeatedly; the
+        round loop calls it when it observes ``requested`` (i.e. OUTSIDE
+        signal-handler context), before starting the drain, so a
+        SIGTERM'd run's streams are durable even if the drain wedges."""
+        if not getattr(self, "_flush_pending", False):
+            return
+        self._flush_pending = False
+        try:
+            from ..telemetry.metrics import flush_metrics, log_event
+            log_event("preemption", reason=self._reason or "requested")
+            flush_metrics()
+        except Exception:  # flushing may never block the drain
+            pass
+        for hook in self._flush_hooks:
+            try:
+                hook()
+            except Exception:
+                pass
 
     # -- signal side ---------------------------------------------------
     def _on_signal(self, signum, frame):  # noqa: ARG002 - signal API
         self._hits += 1
-        self.request(f"signal {signal.Signals(signum).name}")
+        self.request(f"signal {signal.Signals(signum).name}",
+                     _from_signal=True)
         if self._hits >= self.escalate_after:
             # a stuck drain must stay killable: restore the previous
             # dispositions so the NEXT signal behaves as if we were

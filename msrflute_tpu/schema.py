@@ -128,6 +128,33 @@ CHECKPOINT_RETRY_KEYS = {
     "escalation_threshold",
 }
 
+TELEMETRY_KEYS = {
+    "enable", "trace", "devbus", "profile_rounds", "watchdog",
+}
+
+WATCHDOG_KEYS = {
+    "nan_loss", "round_time_action", "round_time_factor",
+    "round_time_window", "ckpt_failure_action", "ckpt_failure_streak",
+}
+
+TELEMETRY_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    "trace": ("bool", None, None),
+    "devbus": ("bool", None, None),
+    # profile_rounds keeps a bespoke check in validate(): int | "lo:hi"
+    # | [lo, hi] is a union type the scalar spec table cannot express
+}
+
+WATCHDOG_FIELD_SPECS = {
+    # a slowdown factor < 1 would flag every round faster than median
+    "round_time_factor": ("num", 1.0, None),
+    "round_time_window": ("int", 4, None),
+    "ckpt_failure_streak": ("int", 1, None),
+}
+
+#: watchdog detector actions (telemetry/watchdog.py ACTIONS)
+ALLOWED_WATCHDOG_ACTIONS = ["off", "log", "mark", "abort"]
+
 CHAOS_FIELD_SPECS = {
     "enable": ("bool", None, None),
     "seed": ("int", 0, None),
@@ -186,6 +213,11 @@ SERVER_KEYS = {
     # kill/resume drill) and the checkpoint retry/backoff/escalation
     # policy — see docs/config_extensions.md and docs/RUNBOOK.md
     "chaos", "checkpoint_retry",
+    # flutescope telemetry: round spans + Perfetto trace export, the
+    # packed-stats device-metric bus, opt-in jax.profiler round windows,
+    # and the NaN/round-time/checkpoint watchdogs — default off, zero
+    # overhead when absent (docs/observability.md)
+    "telemetry",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
@@ -515,6 +547,47 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
             _check_fields(errors, ckpt_retry,
                           "server_config.checkpoint_retry",
                           CHECKPOINT_RETRY_FIELD_SPECS)
+        telemetry = sc.get("telemetry")
+        if telemetry is not None and not isinstance(telemetry, dict):
+            errors.append(
+                "server_config.telemetry: must be a mapping "
+                f"(see docs/observability.md), got "
+                f"{type(telemetry).__name__}")
+        if isinstance(telemetry, dict):
+            _check_unknown(unknown, telemetry, "server_config.telemetry",
+                           TELEMETRY_KEYS)
+            _check_fields(errors, telemetry, "server_config.telemetry",
+                          TELEMETRY_FIELD_SPECS)
+            if telemetry.get("profile_rounds") is not None:
+                # union type (int | "lo:hi" | [lo, hi]) — reuse the one
+                # parser the profiler itself runs, so config load and
+                # round `lo` can never disagree about validity
+                from .telemetry.profiling import parse_profile_rounds
+                try:
+                    parse_profile_rounds(telemetry["profile_rounds"])
+                except (ValueError, TypeError) as exc:
+                    errors.append(
+                        f"server_config.telemetry.profile_rounds: {exc}")
+            wd = telemetry.get("watchdog")
+            if wd is not None and not isinstance(wd, dict):
+                # a bare string like `watchdog: abort` would otherwise
+                # sail through here and die cryptically in
+                # Watchdog.__init__ at server construction
+                errors.append(
+                    "server_config.telemetry.watchdog: must be a mapping "
+                    f"of detector knobs, got {type(wd).__name__}")
+            if isinstance(wd, dict):
+                _check_unknown(unknown, wd,
+                               "server_config.telemetry.watchdog",
+                               WATCHDOG_KEYS)
+                _check_fields(errors, wd,
+                              "server_config.telemetry.watchdog",
+                              WATCHDOG_FIELD_SPECS)
+                for key in ("nan_loss", "round_time_action",
+                            "ckpt_failure_action"):
+                    _check_enum(errors, wd,
+                                "server_config.telemetry.watchdog", key,
+                                ALLOWED_WATCHDOG_ACTIONS)
         ncpi = sc.get("num_clients_per_iteration")
         if ncpi is not None and not isinstance(ncpi, int):
             if not (isinstance(ncpi, str) and ":" in ncpi):

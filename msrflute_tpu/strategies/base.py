@@ -89,6 +89,15 @@ class BaseStrategy:
 
     #: set by RoundEngine so strategies can reach model apply()/loss()
     task: Any = None
+    #: set by RoundEngine: the flutescope device-metric bus.  Strategies
+    #: publish per-round device SCALARS at trace time
+    #: (``self.devbus.publish(name, value)`` — combine_parts is the
+    #: natural site; from inside vmap'd client_step, psum/mean to a
+    #: round scalar first, or the host consumer skips the vector with a
+    #: warning) and the values ride the packed-stats single transfer —
+    #: NEVER publish via ``.item()``/``float(...)`` (host-sync lint).
+    #: A disabled bus no-ops every publish.
+    devbus: Any = None
 
     # ---- traced, per-client (inside vmap) ----------------------------
     def client_step(self, client_update, global_params, arrays, sample_mask,

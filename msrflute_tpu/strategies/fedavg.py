@@ -146,4 +146,12 @@ class FedAvg(BaseStrategy):
         new_clip = state["dp_clip"] * jnp.exp(-ac["lr"] * (b - ac["target"]))
         new_clip = jnp.minimum(
             new_clip, float(self.dp_config.get("max_grad", 1.0)))
+        bus = getattr(self, "devbus", None)
+        if bus is not None and bus.enabled:
+            # flutescope ride-along: the (noised) below-clip fraction and
+            # the adapted clip leave through the packed-stats single
+            # transfer — the dp observability the server previously only
+            # had via its separately-stashed dp_clip copy
+            bus.publish("dp_clip_frac", b)
+            bus.publish("dp_clip", new_clip)
         return agg, {"dp_clip": new_clip}

@@ -1,0 +1,178 @@
+"""flutescope — round-structured telemetry for the TPU round loop.
+
+Four parts, one config block (``server_config.telemetry``, default OFF
+with a measured-zero-overhead fast path — see docs/observability.md):
+
+- :mod:`.spans` — thread-aware span tracer emitting Perfetto-loadable
+  ``trace.json`` + a crash-safe ``events.jsonl`` stream;
+- :mod:`.devbus` — the device-metric bus: per-round device scalars that
+  ride the EXISTING flatpack packed-stats single transfer (zero new
+  ``device_get``s);
+- :mod:`.profiling` — opt-in ``jax.profiler`` capture for a configured
+  round window, compat-guarded for old jax;
+- :mod:`.watchdog` — NaN-loss / round-time-regression /
+  checkpoint-failure-streak detectors with log/mark/abort actions.
+
+Plus :mod:`.metrics` (the always-on ``metrics.jsonl`` writer + structured
+event records, re-exported by ``utils.logging``) and :mod:`.timing` (the
+bench/tools stopwatch primitives).
+
+This package imports no jax at import time (``bench.py`` must pick a
+backend before jax loads); :mod:`.profiling` touches jax only through
+``utils.compat`` when a capture actually starts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from . import metrics
+from .devbus import DeviceMetricBus
+from .spans import NULL_SPAN, SpanToken, Tracer
+from .timing import Stopwatch, scalar_time
+from .watchdog import Watchdog, WatchdogAbort
+
+__all__ = [
+    "DeviceMetricBus", "NULL_SPAN", "SpanToken",
+    "Stopwatch", "Telemetry", "Tracer", "Watchdog", "WatchdogAbort",
+    "devbus_config_enabled", "emit_event", "make_telemetry",
+    "scalar_time", "telemetry_config_enabled",
+]
+
+#: subdirectory of the model dir holding trace.json/events.jsonl/profiles
+TELEMETRY_DIRNAME = "telemetry"
+
+
+def telemetry_config_enabled(raw: Optional[Dict[str, Any]]) -> bool:
+    """Whether a raw ``server_config.telemetry`` block turns the
+    subsystem on (absent or ``enable: false`` => off)."""
+    return bool(raw) and bool(dict(raw).get("enable", True))
+
+
+def devbus_config_enabled(raw: Optional[Dict[str, Any]]) -> bool:
+    """Whether the device-metric bus is on for this config — the engine
+    reads this at build time (a disabled bus leaves the compiled round
+    program byte-identical to a telemetry-free build)."""
+    return telemetry_config_enabled(raw) and \
+        bool(dict(raw).get("devbus", True))
+
+
+class Telemetry:
+    """One run's telemetry scope: tracer + watchdog + profiler handles.
+
+    Constructed only when ``server_config.telemetry`` enables the
+    subsystem — the round loop holds ``None`` otherwise and pays a single
+    is-None check per instrumentation point (the zero-cost contract,
+    ``tests/test_telemetry_contract.py``).
+    """
+
+    def __init__(self, raw: Dict[str, Any], model_dir: str):
+        self.raw = dict(raw)
+        self.out_dir = os.path.join(model_dir, TELEMETRY_DIRNAME)
+        self.tracer: Optional[Tracer] = (
+            Tracer(self.out_dir) if self.raw.get("trace", True) else None)
+        self.watchdog = Watchdog(self.raw.get("watchdog"),
+                                 on_event=self.event)
+        self._nonscalar_warned: set = set()
+        # lazy import: profiling reaches for jax (via utils.compat) only
+        # when a capture window is configured and actually starts
+        from .profiling import RoundProfiler
+        self.profiler = RoundProfiler(self.raw.get("profile_rounds"),
+                                      self.out_dir)
+
+    # -- spans ----------------------------------------------------------
+    def span(self, name: str, **args: Any):
+        return self.tracer.span(name, **args) if self.tracer is not None \
+            else NULL_SPAN
+
+    def begin(self, name: str, **args: Any) -> Optional[SpanToken]:
+        return self.tracer.begin(name, **args) if self.tracer is not None \
+            else None
+
+    def end(self, token: Optional[SpanToken]) -> None:
+        if self.tracer is not None:
+            self.tracer.end(token)
+
+    # -- events / devbus ------------------------------------------------
+    def event(self, kind: str, **fields: Any) -> None:
+        """Structured record in BOTH streams: the always-on metrics
+        stream and (when tracing) the trace's instant-event track."""
+        metrics.log_event(kind, **fields)
+        if self.tracer is not None:
+            self.tracer.instant(kind, **fields)
+
+    def devbus_host(self, name: str, value: float,
+                    step: Optional[int] = None) -> None:
+        """Host-side bus publish for values ALREADY fetched through a
+        bundled ``device_get`` (scaffold ``c_norm``, the stashed
+        ``dp_clip``): metric line + counter sample, no device access."""
+        metrics.log_metric(f"devbus/{name}", float(value), step=step)
+        if self.tracer is not None:
+            self.tracer.counter(f"devbus/{name}", float(value))
+
+    def consume_devbus(self, stats: Dict[str, Any], round0: int,
+                       rounds: int) -> None:
+        """Decode bus-published entries of one FETCHED stats dict (numpy,
+        ``[R]``-leading) into per-round metric lines + counter samples.
+
+        Non-scalar publishes (e.g. an un-reduced per-client vector from
+        inside ``vmap``) are skipped with a one-time warning instead of
+        crashing the host tail — the bus contract is per-round SCALARS;
+        reduce (psum/mean) before publishing."""
+        import numpy as np
+        for name, arr in DeviceMetricBus.split_fetched(stats):
+            for j in range(rounds):
+                value = np.asarray(arr[j] if getattr(arr, "ndim", 0)
+                                   else arr)
+                if value.size != 1:
+                    if name not in self._nonscalar_warned:
+                        self._nonscalar_warned.add(name)
+                        self.event("devbus_nonscalar_skipped",
+                                   metric=name, shape=list(value.shape))
+                    break
+                value = float(value.reshape(()))
+                metrics.log_metric(f"devbus/{name}", value, step=round0 + j)
+                if self.tracer is not None:
+                    self.tracer.counter(f"devbus/{name}", value)
+
+    # -- lifecycle ------------------------------------------------------
+    def flush(self) -> None:
+        if self.tracer is not None:
+            self.tracer.flush()
+        metrics.flush_metrics()
+
+    def flush_throttled(self) -> None:
+        """Round-housekeeping flush point: keeps the on-disk trace
+        reasonably fresh (Tracer.FLUSH_INTERVAL_SECS throttle) without
+        paying the full-rewrite cost every round.  Metrics flush
+        separately at their own cadence."""
+        if self.tracer is not None:
+            self.tracer.flush_throttled()
+
+    def close(self) -> None:
+        self.profiler.finish()
+        if self.tracer is not None:
+            self.tracer.close()
+        metrics.flush_metrics()
+
+
+def make_telemetry(raw: Optional[Dict[str, Any]],
+                   model_dir: str) -> Optional[Telemetry]:
+    """Build the run's :class:`Telemetry` scope, or None when the config
+    block is absent/disabled (the default — and the fast path: the round
+    loop then contains no telemetry state at all)."""
+    if not telemetry_config_enabled(raw):
+        return None
+    return Telemetry(dict(raw), model_dir)
+
+
+def emit_event(scope: Optional[Telemetry], kind: str, **fields: Any) -> None:
+    """Structured event that works with or without a telemetry scope:
+    always a metrics-stream record; additionally a trace instant when
+    tracing is on.  The chaos/checkpoint/preemption paths emit through
+    here so their events are never log-lines-only again."""
+    if scope is not None:
+        scope.event(kind, **fields)
+    else:
+        metrics.log_event(kind, **fields)

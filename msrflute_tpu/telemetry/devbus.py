@@ -1,0 +1,73 @@
+"""Device-metric bus — per-round device scalars riding the packed stats.
+
+The one sanctioned way for engine/strategy code to get a device scalar
+into the host-side telemetry stream.  The contract that makes it safe:
+
+- **publish at trace time, inside the round program.**  A publisher
+  calls ``bus.publish("dp_clip_frac", b)`` while ``round_step`` is being
+  traced; the engine drains the pending values into ``round_stats`` just
+  before the flatpack pack, so every published scalar leaves the device
+  through the SAME single per-dtype-group transfer as the built-in
+  stats.  Zero new ``device_get``s, clean under
+  ``MSRFLUTE_STRICT_TRANSFERS=1`` and ``tools/flint`` by construction.
+- **never** publish via ``.item()`` / ``float(device_value)`` /
+  ``np.asarray(device_value)`` — that is a per-scalar host sync, exactly
+  what the bus exists to avoid.  The host-sync lint flags those
+  spellings in engine/ops/strategies/telemetry modules
+  (``tests/test_analysis.py`` corpus).
+- host-side values that were ALREADY fetched through a bundled
+  ``device_get`` (the scaffold/EF round tails' ``c_norm``, the stashed
+  ``dp_clip``) go through :meth:`publish_host` — a pure bookkeeping call
+  that emits the metric/counter without touching the device.
+
+No jax import: published values are opaque to the bus (jnp arrays at
+trace time, python floats host-side); the engine owns staging them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+#: round-stats key prefix for bus-published scalars — the host consumer
+#: recognizes (and strips) it after the packed fetch
+PREFIX = "devbus_"
+
+
+class DeviceMetricBus:
+    """Trace-time registry of per-round device scalars.
+
+    One instance per :class:`~msrflute_tpu.engine.round.RoundEngine`;
+    ``enabled`` is decided once at engine build from
+    ``server_config.telemetry`` (off => every publish is a no-op and the
+    compiled round program is byte-identical to a telemetry-free build).
+    """
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self._pending: Dict[str, Any] = {}
+
+    def publish(self, name: str, value: Any) -> None:
+        """Register one per-round scalar (trace time, device value).
+        Later publishes under the same name in the same round replace
+        earlier ones."""
+        if not self.enabled:
+            return
+        self._pending[str(name)] = value
+
+    def drain(self) -> Dict[str, Any]:
+        """The engine's hook, called once per ``round_step`` trace just
+        before the flatpack pack: pending values keyed for the stats
+        tree."""
+        if not self._pending:
+            return {}
+        out = {PREFIX + k: v for k, v in self._pending.items()}
+        self._pending.clear()
+        return out
+
+    @staticmethod
+    def split_fetched(stats: Dict[str, Any]) -> List[Tuple[str, Any]]:
+        """Host side: the bus-published entries of one FETCHED stats dict
+        (numpy, post flatpack decode), with the prefix stripped —
+        ``[(name, per-round array), ...]``."""
+        return [(k[len(PREFIX):], v) for k, v in sorted(stats.items())
+                if k.startswith(PREFIX)]

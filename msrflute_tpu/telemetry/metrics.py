@@ -1,0 +1,120 @@
+"""The crash-safe metrics stream (``metrics.jsonl``) + structured events.
+
+Moved here from ``utils/logging.py`` (which keeps its public
+``log_metric``/``flush_metrics`` names as re-exports) so the run's whole
+observability surface lives under ``telemetry/``:
+
+- scalar metrics: one JSON line per value, buffered with a time-based
+  flush cadence plus the explicit flush points (round housekeeping,
+  train exit, process exit, and — new — the preemption drain path, so a
+  SIGTERM'd run never loses the in-flight round's metrics);
+- structured EVENT records (``{"event": kind, ...}`` lines in the same
+  stream): preemption requests, chaos fault rounds, checkpoint
+  fallback/recovery — previously only greppable log text, now records a
+  reader (``tools/scope``) can tabulate.
+
+No jax import, no telemetry-object dependency: this module is the
+always-on half of flutescope (the span tracer is the opt-in half), so
+event emission works identically whether ``server_config.telemetry`` is
+configured or not.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+_LOGGER = logging.getLogger("msrflute_tpu")
+_METRICS_FH = None
+#: seconds between forced metrics-stream flushes; between them lines sit
+#: in the file buffer (the server also flushes at every round-housekeeping
+#: boundary, at train() exit, and from the preemption drain path, so
+#: round granularity is never lost)
+_FLUSH_INTERVAL_SECS = 1.0
+_LAST_FLUSH = 0.0
+
+
+def open_metrics(log_dir: str) -> None:
+    """Open (append) ``<log_dir>/metrics.jsonl`` as the process's metric
+    stream and register the at-exit flush."""
+    global _METRICS_FH
+    os.makedirs(log_dir, exist_ok=True)
+    _METRICS_FH = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+    # buffered lines must still land if the process exits without a
+    # final explicit flush (e.g. a CLI run killed between rounds)
+    import atexit
+    atexit.register(flush_metrics)
+
+
+def metrics_open() -> bool:
+    return _METRICS_FH is not None
+
+
+def _write_line(record: Dict[str, Any]) -> None:
+    global _LAST_FLUSH
+    if _METRICS_FH is not None:
+        _METRICS_FH.write(json.dumps(record) + "\n")
+        if record["ts"] - _LAST_FLUSH >= _FLUSH_INTERVAL_SECS:
+            _METRICS_FH.flush()
+            _LAST_FLUSH = record["ts"]
+
+
+def log_metric(name: str, value: Any, step: Optional[int] = None,
+               extra: Optional[Dict[str, Any]] = None) -> None:
+    """Scalar metric emission (replaces AzureML ``run.log`` at reference
+    ``core/server.py:261-264,523-525``).
+
+    Writes are BUFFERED: a flush-per-line put one syscall per scalar on
+    the server's host tail (~6+ per round); lines flush on a time-based
+    cadence plus the explicit :func:`flush_metrics` points.
+    """
+    record = {"ts": time.time(), "name": name, "value": _to_py(value)}
+    if step is not None:
+        record["step"] = step
+    if extra:
+        record.update(extra)
+    _write_line(record)
+    _LOGGER.info("metric %s=%s%s", name, record["value"],
+                 f" @ {step}" if step is not None else "")
+
+
+def log_event(kind: str, **fields: Any) -> None:
+    """One structured event record in the metrics stream (preemption,
+    chaos faults, checkpoint recovery, watchdog findings).  Replaces the
+    grep-a-log-line observability those paths had before flutescope."""
+    record = {"ts": time.time(), "event": kind}
+    record.update({k: _to_py(v) for k, v in fields.items()})
+    _write_line(record)
+    _LOGGER.info("event %s %s", kind,
+                 {k: v for k, v in record.items()
+                  if k not in ("ts", "event")})
+
+
+def flush_metrics() -> None:
+    """Force buffered metric/event lines to disk (no-op without a
+    writer).  The preemption drain path calls this so a SIGTERM'd run's
+    in-flight round records are durable before the process exits."""
+    global _LAST_FLUSH
+    if _METRICS_FH is not None:
+        _METRICS_FH.flush()
+        _LAST_FLUSH = time.time()
+
+
+def _to_py(value: Any) -> Any:
+    """JSON-serializable python scalar from an already-HOST value (the
+    metric contract: callers ``device_get`` first — the host-sync lint
+    polices the call sites; these ``.item()``s only ever see numpy)."""
+    try:
+        import numpy as np
+        if isinstance(value, (np.generic,)):
+            # flint: disable=host-sync np.generic is a host scalar; .item() is a pure python-type conversion
+            return value.item()
+        if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
+            # flint: disable=host-sync 0-d numpy array handed in by callers that already fetched; json needs the python scalar
+            return value.item()
+    except Exception:
+        pass
+    return value

@@ -1,0 +1,110 @@
+"""Opt-in ``jax.profiler`` capture for a configured round window.
+
+``server_config.telemetry.profile_rounds`` names the window — an int
+(``5``: profile the chunk containing round 5), a ``"lo:hi"`` string, or
+a two-element list — and the server calls :meth:`RoundProfiler.observe`
+at every chunk boundary.  The capture starts at the first chunk whose
+round range reaches ``lo`` and stops at the first boundary at or past
+``hi``, so a fused chunk spanning the window edge profiles whole (the
+profiler cannot cut a compiled program in half).
+
+Degrades gracefully on old jax (the container's 0.4.37) through the
+:mod:`msrflute_tpu.utils.compat` wrappers: a failed start/stop logs one
+warning and disables further attempts instead of killing the run.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Tuple
+
+_LOGGER = logging.getLogger("msrflute_tpu")
+
+
+def parse_profile_rounds(spec: Any) -> Optional[Tuple[int, int]]:
+    """``None`` | int | ``"lo:hi"`` | [lo, hi] -> half-open round window
+    ``(lo, hi)`` or None.  Raises ValueError on garbage (the schema calls
+    this too, so a bad spec fails at config load, not round ``lo``)."""
+    if spec is None:
+        return None
+    if isinstance(spec, bool):
+        raise ValueError("telemetry.profile_rounds: must be an int, "
+                         "'lo:hi', or [lo, hi] — got a boolean")
+    if isinstance(spec, int):
+        return (spec, spec + 1)
+    if isinstance(spec, str):
+        if ":" not in spec:
+            raise ValueError(
+                f"telemetry.profile_rounds: {spec!r} is not 'lo:hi'")
+        lo_s, hi_s = spec.split(":", 1)
+        lo, hi = int(lo_s), int(hi_s)
+    elif isinstance(spec, (list, tuple)) and len(spec) == 2:
+        lo, hi = int(spec[0]), int(spec[1])
+    else:
+        raise ValueError(
+            f"telemetry.profile_rounds: {spec!r} must be an int, "
+            "'lo:hi', or [lo, hi]")
+    if lo < 0 or hi <= lo:
+        raise ValueError(
+            f"telemetry.profile_rounds: window [{lo}, {hi}) is empty or "
+            "negative")
+    return (lo, hi)
+
+
+class RoundProfiler:
+    """Drives one ``jax.profiler`` trace over the configured window."""
+
+    def __init__(self, spec: Any, out_dir: str):
+        self.window = parse_profile_rounds(spec)
+        self.out_dir = os.path.join(out_dir, "xla_profile")
+        self.active = False
+        self.captured = False
+        self.failed = False
+
+    def observe(self, round_no: int, rounds: int = 1) -> None:
+        """Chunk-boundary hook: the chunk about to dispatch covers
+        ``[round_no, round_no + rounds)``.  The capture starts when that
+        range INTERSECTS the window — not only when it starts exactly at
+        ``lo`` — so a window falling inside a fused chunk still fires
+        (the chunk profiles whole; a compiled program cannot be cut)."""
+        if self.window is None or self.failed or self.captured:
+            if self.active:
+                self._stop()
+            return
+        lo, hi = self.window
+        if self.active and round_no >= hi:
+            self._stop()
+        elif not self.active and round_no < hi and round_no + max(
+                int(rounds), 1) > lo:
+            self._start()
+
+    def finish(self) -> None:
+        """Train-exit hook: a window still open (run ended inside it)
+        stops here so the capture is flushed."""
+        if self.active:
+            self._stop()
+
+    # ------------------------------------------------------------------
+    def _start(self) -> None:
+        from ..utils.compat import profiler_start_trace
+        if profiler_start_trace(self.out_dir):
+            self.active = True
+            _LOGGER.info("flutescope: jax.profiler capture started -> %s",
+                         self.out_dir)
+        else:
+            self.failed = True
+            _LOGGER.warning(
+                "flutescope: jax.profiler trace unavailable on this jax "
+                "version/backend; telemetry.profile_rounds disabled for "
+                "this run")
+
+    def _stop(self) -> None:
+        from ..utils.compat import profiler_stop_trace
+        self.active = False
+        if profiler_stop_trace():
+            self.captured = True
+            _LOGGER.info("flutescope: jax.profiler capture written to %s",
+                         self.out_dir)
+        else:
+            self.failed = True

@@ -1,0 +1,222 @@
+"""``tools/scope`` — summarize one run directory's flutescope output.
+
+Input: a model dir (or its ``telemetry/`` subdir) holding any of
+``telemetry/trace.json``, ``telemetry/events.jsonl``, ``metrics.jsonl``.
+Output: ONE JSON object answering the questions a round trace exists
+for —
+
+- **phase-time breakdown**: total/count/p50 per span name (pack,
+  dispatch, stats_fetch, host_tail, housekeeping, ckpt_submit,
+  ckpt_async_write, eval, round_device, ...) — where the round time
+  went;
+- **overlap efficiency**: the fraction of host-tail time that ran while
+  a device round was in flight (pipeline health: ~100% means the host
+  tail is fully hidden; ~0% means the pipeline isn't pipelining);
+- **fault/event table**: chaos faults, checkpoint recovery/IO faults,
+  preemption, watchdog findings — counts per kind;
+- **round span + counters/metrics inventory** so a reader knows what
+  else the run recorded.
+
+Pure stdlib (the flint discipline: safe from any shell, never touches
+jax); deterministic for a fixed input, which the golden-output test
+(``tests/test_scope_cli.py``) pins against a recorded fixture run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: span names that constitute the host tail for the overlap metric
+_HOST_TAIL_SPANS = ("host_tail",)
+#: span name of the device in-flight window
+_DEVICE_SPAN = "round_device"
+
+
+def _load_trace(path: str) -> List[Dict[str, Any]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        parsed = json.loads(text)
+    except json.JSONDecodeError:
+        # a run killed mid-write can leave a truncated JSON array;
+        # salvage the complete prefix rather than refusing the file
+        cut = text.rfind("}")
+        if cut < 0:
+            return []
+        salvage = text[: cut + 1] + "]}" if text.lstrip().startswith("{") \
+            else text[: cut + 1] + "]"
+        try:
+            parsed = json.loads(salvage)
+        except json.JSONDecodeError:
+            return []
+    if isinstance(parsed, dict):
+        return list(parsed.get("traceEvents", []))
+    return list(parsed) if isinstance(parsed, list) else []
+
+
+def _jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line of a killed run
+    return out
+
+
+def _p50(values: List[float]) -> float:
+    return sorted(values)[len(values) // 2] if values else 0.0
+
+
+def _interval_overlap(a: List[Tuple[float, float]],
+                      b: List[Tuple[float, float]]) -> float:
+    """Total length of ``a`` intervals covered by the union of ``b``."""
+    events = sorted(b)
+    merged: List[Tuple[float, float]] = []
+    for lo, hi in events:
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    covered = 0.0
+    for lo, hi in a:
+        for mlo, mhi in merged:
+            if mhi <= lo:
+                continue
+            if mlo >= hi:
+                break
+            covered += min(hi, mhi) - max(lo, mlo)
+    return covered
+
+
+def summarize(run_dir: str) -> Dict[str, Any]:
+    """The scope summary for one run directory (see module docstring)."""
+    tdir = run_dir
+    if os.path.isdir(os.path.join(run_dir, "telemetry")):
+        tdir = os.path.join(run_dir, "telemetry")
+    trace_path = os.path.join(tdir, "trace.json")
+    events_path = os.path.join(tdir, "events.jsonl")
+    # metrics.jsonl lives at the run root (init_logging), but tolerate a
+    # copy next to the trace (fixtures, relocated runs)
+    metrics_path = os.path.join(run_dir, "metrics.jsonl")
+    if not os.path.exists(metrics_path):
+        metrics_path = os.path.join(tdir, "metrics.jsonl")
+
+    out: Dict[str, Any] = {"run_dir": os.path.basename(
+        os.path.abspath(run_dir))}
+
+    # ---- spans + overlap from the trace --------------------------------
+    spans: Dict[str, List[float]] = {}
+    host_tail_iv: List[Tuple[float, float]] = []
+    device_iv: List[Tuple[float, float]] = []
+    rounds: List[int] = []
+    counters: Dict[str, Dict[str, Any]] = {}
+    trace_events: Dict[str, int] = {}
+    if os.path.exists(trace_path):
+        for ev in _load_trace(trace_path):
+            ph = ev.get("ph")
+            name = str(ev.get("name", ""))
+            if ph == "X":
+                dur_s = float(ev.get("dur", 0.0)) / 1e6
+                spans.setdefault(name, []).append(dur_s)
+                iv = (float(ev.get("ts", 0.0)),
+                      float(ev.get("ts", 0.0)) + float(ev.get("dur", 0.0)))
+                if name in _HOST_TAIL_SPANS:
+                    host_tail_iv.append(iv)
+                elif name == _DEVICE_SPAN:
+                    device_iv.append(iv)
+                    args = ev.get("args") or {}
+                    if "round0" in args:
+                        r0 = int(args["round0"])
+                        rounds.extend(
+                            range(r0, r0 + int(args.get("rounds", 1))))
+            elif ph == "i":
+                trace_events[name] = trace_events.get(name, 0) + 1
+            elif ph == "C":
+                args = ev.get("args") or {}
+                c = counters.setdefault(name, {"samples": 0, "last": None})
+                c["samples"] += 1
+                c["last"] = args.get("value")
+        out["phase_secs"] = {
+            name: {"count": len(vals),
+                   "total_s": round(sum(vals), 6),
+                   "p50_s": round(_p50(vals), 6)}
+            for name, vals in sorted(spans.items())}
+        if rounds:
+            out["rounds"] = {"count": len(set(rounds)),
+                             "first": min(rounds), "last": max(rounds)}
+        tail_total = sum(hi - lo for lo, hi in host_tail_iv) / 1e6
+        overlapped = _interval_overlap(host_tail_iv, device_iv) / 1e6
+        out["overlap"] = {
+            "host_tail_s": round(tail_total, 6),
+            "overlapped_s": round(overlapped, 6),
+            "efficiency_pct": round(100.0 * overlapped / tail_total, 1)
+            if tail_total > 0 else 0.0,
+        }
+        if counters:
+            out["counters"] = {k: dict(v) for k, v in sorted(
+                counters.items())}
+    else:
+        out["trace"] = "absent"
+
+    # ---- event table: one structured event is emitted to up to three
+    # streams (trace instant, events.jsonl, metrics.jsonl record) — take
+    # the per-name MAX across sources so nothing is double-counted and a
+    # stream a killed run lost does not under-count ------------------
+    sources: List[Dict[str, int]] = [trace_events]
+    if os.path.exists(events_path):
+        counts: Dict[str, int] = {}
+        for rec in _jsonl(events_path):
+            if rec.get("kind") == "event":
+                name = str(rec.get("name", "?"))
+                counts[name] = counts.get(name, 0) + 1
+        sources.append(counts)
+    if os.path.exists(metrics_path):
+        counts = {}
+        metric_names: Dict[str, int] = {}
+        for rec in _jsonl(metrics_path):
+            if "event" in rec:
+                name = str(rec["event"])
+                counts[name] = counts.get(name, 0) + 1
+            elif "name" in rec:
+                metric_names[str(rec["name"])] = \
+                    metric_names.get(str(rec["name"]), 0) + 1
+        sources.append(counts)
+        if metric_names:
+            out["metrics"] = {"lines": sum(metric_names.values()),
+                              "names": sorted(metric_names)}
+    events: Dict[str, int] = {}
+    for counts in sources:
+        for name, count in counts.items():
+            events[name] = max(events.get(name, 0), count)
+    if events:
+        out["events"] = dict(sorted(events.items()))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a run directory's flutescope telemetry")
+    ap.add_argument("run_dir", help="model dir (or its telemetry/ subdir)")
+    ap.add_argument("--indent", type=int, default=None,
+                    help="pretty-print with this JSON indent")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"scope: {args.run_dir!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(summarize(args.run_dir), indent=args.indent,
+                     sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Host-side watchdogs over the per-round telemetry stream.
+
+Detectors (all pure host math over values the round loop ALREADY holds —
+fetched train losses, wall-clock round times, the checkpoint
+escalator's consecutive-failure count; never a device read):
+
+- **nan_loss** — NaN/inf per-round training loss;
+- **round_time** — a round slower than ``round_time_factor`` x the
+  trailing-window median (the "where did my throughput go" tripwire);
+- **ckpt_failures** — a consecutive checkpoint-save failure streak
+  reaching ``ckpt_failure_streak`` (reads the
+  :class:`~msrflute_tpu.resilience.integrity.FailureEscalator` counter —
+  this fires WARNINGS well before the escalator's own abort threshold
+  would kill the run).
+
+Each detector has a configurable action (``server_config.telemetry.
+watchdog``): ``off`` | ``log`` (event only) | ``mark`` (event + durable
+``status_log.json`` marker via the server's mark callback) | ``abort``
+(raise :class:`WatchdogAbort` out of the round loop).  Every firing is
+emitted as a structured event whatever the action.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+ACTIONS = ("off", "log", "mark", "abort")
+
+_DEFAULTS = {
+    "nan_loss": "abort",
+    "round_time_action": "log",
+    "round_time_factor": 3.0,
+    "round_time_window": 16,
+    "ckpt_failure_action": "mark",
+    "ckpt_failure_streak": 3,
+}
+
+
+class WatchdogAbort(RuntimeError):
+    """A watchdog with action ``abort`` fired — the run stops with the
+    finding in the message instead of training on garbage."""
+
+
+class Watchdog:
+    """Per-run detector state.  ``on_event(kind, **fields)`` receives
+    every finding (trace instant + metrics-stream event); ``on_mark``
+    persists a finding to the status log for ``mark``/``abort``."""
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None,
+                 on_event: Optional[Callable[..., None]] = None,
+                 on_mark: Optional[Callable[[str, Dict[str, Any]], None]]
+                 = None):
+        raw = dict(raw or {})
+        cfg = dict(_DEFAULTS)
+        cfg.update({k: raw[k] for k in _DEFAULTS if k in raw})
+        for key in ("nan_loss", "round_time_action", "ckpt_failure_action"):
+            if cfg[key] not in ACTIONS:
+                raise ValueError(
+                    f"telemetry.watchdog.{key}: {cfg[key]!r} not in "
+                    f"{ACTIONS}")
+        self.cfg = cfg
+        self.on_event = on_event or (lambda kind, **f: None)
+        self.on_mark = on_mark or (lambda kind, fields: None)
+        window = max(int(cfg["round_time_window"]), 4)
+        self._times: deque = deque(maxlen=window)
+        self._last_ckpt_streak = 0
+        #: findings fired this run (observability + tests)
+        self.findings: list = []
+
+    # ------------------------------------------------------------------
+    def observe_round(self, round_no: int,
+                      train_loss: Optional[float] = None,
+                      round_secs: Optional[float] = None,
+                      ckpt_failures: int = 0) -> None:
+        """Feed one completed round's host-side observations; applies
+        every enabled detector and its configured action."""
+        if train_loss is not None and self.cfg["nan_loss"] != "off" and \
+                not math.isfinite(float(train_loss)):
+            self._fire("nan_loss", self.cfg["nan_loss"],
+                       round=round_no, train_loss=float(train_loss))
+        if round_secs is not None and \
+                self.cfg["round_time_action"] != "off":
+            factor = float(self.cfg["round_time_factor"])
+            if len(self._times) >= self._times.maxlen // 2:
+                med = sorted(self._times)[len(self._times) // 2]
+                if med > 0 and round_secs > factor * med:
+                    self._fire("round_time_regression",
+                               self.cfg["round_time_action"],
+                               round=round_no,
+                               round_secs=round(float(round_secs), 4),
+                               trailing_median_secs=round(float(med), 4),
+                               factor=factor)
+            self._times.append(float(round_secs))
+        streak = int(self.cfg["ckpt_failure_streak"])
+        if self.cfg["ckpt_failure_action"] != "off" and streak > 0 and \
+                ckpt_failures >= streak and \
+                ckpt_failures > self._last_ckpt_streak:
+            # fire once per new failure in the streak, not once per round
+            # forever after; a success resets the escalator counter and
+            # therefore re-arms this detector
+            self._fire("ckpt_failure_streak",
+                       self.cfg["ckpt_failure_action"],
+                       round=round_no, consecutive_failures=ckpt_failures)
+        self._last_ckpt_streak = int(ckpt_failures)
+
+    # ------------------------------------------------------------------
+    def _fire(self, kind: str, action: str, **fields: Any) -> None:
+        self.findings.append({"kind": kind, "action": action, **fields})
+        self.on_event(f"watchdog_{kind}", action=action, **fields)
+        if action in ("mark", "abort"):
+            self.on_mark(kind, fields)
+        if action == "abort":
+            raise WatchdogAbort(
+                f"watchdog {kind} fired ({fields}); configured action is "
+                "abort — set server_config.telemetry.watchdog to 'mark' "
+                "or 'log' to continue through this condition")

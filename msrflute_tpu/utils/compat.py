@@ -28,3 +28,27 @@ def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
         kwargs[_CHECK_KW] = check_vma
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kwargs)
+
+
+def profiler_start_trace(log_dir: str) -> bool:
+    """Start a ``jax.profiler`` trace, tolerating old-jax/backend quirks
+    (0.4.x raises from a second start or on backends without profiler
+    support).  Returns success — telemetry's profiling window degrades
+    to a logged warning instead of killing a run."""
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        return True
+    except Exception:
+        return False
+
+
+def profiler_stop_trace() -> bool:
+    """Stop the active ``jax.profiler`` trace; False when no trace was
+    running or the profiler is unavailable on this jax."""
+    try:
+        import jax
+        jax.profiler.stop_trace()
+        return True
+    except Exception:
+        return False

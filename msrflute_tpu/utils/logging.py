@@ -3,40 +3,38 @@
 Parity target: reference ``utils/utils.py:299-332`` (``init_logging``,
 timestamped ``print_rank``) and the AzureML ``run.log`` channel
 (``core/server.py:43-44``).  The TPU build replaces AzureML with a JSONL
-metric writer (one line per scalar) plus optional TensorBoard if available;
-both are observable offline.
+metric writer plus structured event records — both of which now live in
+:mod:`msrflute_tpu.telemetry.metrics` (flutescope owns the run's
+observability surface); this module keeps the historical import path
+(``log_metric``/``flush_metrics``) as re-exports and the plain logger
+setup.
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import os
-import time
-from typing import Any, Dict, Optional
+from typing import Optional
+
+# canonical implementations live under telemetry/ — re-exported here so
+# the dozens of existing call sites (and plugins) keep importing from
+# utils.logging unchanged
+from ..telemetry.metrics import (flush_metrics, log_event,  # noqa: F401
+                                 log_metric)
 
 _LOGGER = logging.getLogger("msrflute_tpu")
-_METRICS_FH = None
-#: seconds between forced metrics-stream flushes; between them lines sit
-#: in the file buffer (the server also flushes at every round-housekeeping
-#: boundary and at train() exit, so round granularity is never lost)
-_FLUSH_INTERVAL_SECS = 1.0
-_LAST_FLUSH = 0.0
 
 
 def init_logging(log_dir: Optional[str] = None, loglevel: int = logging.INFO) -> None:
     """File + stdout logging (reference ``utils/utils.py:299-307``), and a
     ``metrics.jsonl`` writer in place of AzureML ``run.log``."""
-    global _METRICS_FH
+    from ..telemetry.metrics import open_metrics
+
     handlers: list = [logging.StreamHandler()]
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
         handlers.append(logging.FileHandler(os.path.join(log_dir, "log.out")))
-        _METRICS_FH = open(os.path.join(log_dir, "metrics.jsonl"), "a")
-        # buffered lines must still land if the process exits without a
-        # final explicit flush (e.g. a CLI run killed between rounds)
-        import atexit
-        atexit.register(flush_metrics)
+        open_metrics(log_dir)
     logging.basicConfig(
         level=loglevel,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
@@ -51,48 +49,3 @@ def print_rank(msg: str, loglevel: int = logging.INFO) -> None:
     the controller instead when running multi-host)."""
     pid = os.environ.get("JAX_PROCESS_INDEX", "0")
     _LOGGER.log(loglevel, "p%s: %s", pid, msg)
-
-
-def log_metric(name: str, value: Any, step: Optional[int] = None,
-               extra: Optional[Dict[str, Any]] = None) -> None:
-    """Scalar metric emission (replaces AzureML ``run.log`` at reference
-    ``core/server.py:261-264,523-525``).
-
-    Writes are BUFFERED: a flush-per-line put one syscall per scalar on
-    the server's host tail (~6+ per round); lines now flush on a
-    time-based cadence plus the explicit :func:`flush_metrics` points
-    (round housekeeping, train exit, process exit).
-    """
-    global _LAST_FLUSH
-    record = {"ts": time.time(), "name": name, "value": _to_py(value)}
-    if step is not None:
-        record["step"] = step
-    if extra:
-        record.update(extra)
-    if _METRICS_FH is not None:
-        _METRICS_FH.write(json.dumps(record) + "\n")
-        if record["ts"] - _LAST_FLUSH >= _FLUSH_INTERVAL_SECS:
-            _METRICS_FH.flush()
-            _LAST_FLUSH = record["ts"]
-    _LOGGER.info("metric %s=%s%s", name, record["value"],
-                 f" @ {step}" if step is not None else "")
-
-
-def flush_metrics() -> None:
-    """Force buffered metric lines to disk (no-op without a writer)."""
-    global _LAST_FLUSH
-    if _METRICS_FH is not None:
-        _METRICS_FH.flush()
-        _LAST_FLUSH = time.time()
-
-
-def _to_py(value: Any) -> Any:
-    try:
-        import numpy as np
-        if isinstance(value, (np.generic,)):
-            return value.item()
-        if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
-            return value.item()
-    except Exception:
-        pass
-    return value

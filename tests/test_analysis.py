@@ -559,3 +559,89 @@ def test_cli_exit_codes(tmp_path, capsys):
     good = tmp_path / "engine" / "ok.py"
     good.write_text("def f():\n    return 1\n")
     assert main([str(good), "--root", str(tmp_path), "--no-baseline"]) == 0
+
+
+# ======================================================================
+# PR 4 corpus: flutescope telemetry coverage
+# ======================================================================
+def test_host_sync_flags_devbus_publish_via_item_and_float(tmp_path):
+    """devbus misuse: publishing through `.item()` / `float(...)` turns
+    the packed-stats ride-along into a per-scalar host sync — the exact
+    failure mode the bus exists to prevent.  telemetry/ is a hot-path
+    part, so the rule applies to bus-owning modules too."""
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def round_step(devbus, agg):
+            norm = jnp.sum(agg ** 2)
+            devbus.publish("agg_norm", norm.item())
+            devbus.publish("agg_norm_f", float(norm))
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["host-sync", "host-sync"]
+    assert ".item()" in found[0].message
+    assert "float(norm)" in found[1].message
+
+
+def test_host_sync_applies_inside_telemetry_package(tmp_path):
+    found = run_on(tmp_path, "telemetry/devbus_user.py", """\
+        import jax.numpy as jnp
+
+        def consume(x):
+            y = jnp.sum(x)
+            return y.item()
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["host-sync"]
+
+
+def test_host_sync_silent_on_correct_devbus_publish(tmp_path):
+    """The sanctioned pattern: hand the DEVICE value to the bus; it
+    rides the packed transfer and the host decodes post-fetch."""
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def round_step(devbus, agg, round_stats):
+            devbus.publish("agg_norm", jnp.sum(agg ** 2))
+            round_stats.update(devbus.drain())
+        """, rules=["host-sync"])
+    assert found == []
+
+
+def test_schema_drift_covers_telemetry_and_watchdog_specs(tmp_path):
+    """A TELEMETRY_FIELD_SPECS / WATCHDOG_FIELD_SPECS rule for a key the
+    unknown-key pass doesn't know is dead and must be flagged (the PR 3
+    chaos-spec rule extended to the flutescope blocks)."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'telemetry'}\n"
+        "TELEMETRY_KEYS = {'enable', 'trace'}\n"
+        "WATCHDOG_KEYS = {'nan_loss'}\n"
+        "TELEMETRY_FIELD_SPECS = {'enable': ('bool', None, None),"
+        " 'ghost_flag': ('bool', None, None)}\n"
+        "WATCHDOG_FIELD_SPECS = {'ghost_streak': ('int', 1, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.telemetry` is the flutescope block.")
+    found = check_project(str(tmp_path), documented_knobs=("telemetry",))
+    msgs = sorted(f.message for f in found)
+    assert [f.rule for f in found] == ["schema-drift", "schema-drift"]
+    assert any("ghost_flag" in m and "TELEMETRY_KEYS" in m for m in msgs)
+    assert any("ghost_streak" in m and "WATCHDOG_KEYS" in m for m in msgs)
+
+
+def test_schema_drift_flags_undocumented_telemetry_knob(tmp_path):
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'telemetry'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text("no observability documented here")
+    found = check_project(str(tmp_path), documented_knobs=("telemetry",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "telemetry" in found[0].message

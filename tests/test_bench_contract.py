@@ -61,6 +61,10 @@ def test_expired_deadline_still_emits_json():
     skipped = [k for k, v in out["extras"].items()
                if isinstance(v, dict) and "skipped" in v]
     assert skipped, out["extras"]
+    # chaos AND telemetry modes are part of the contract on every line,
+    # even a deadline-skipped one — uninstrumented here
+    assert out["extras"]["chaos"] == {"enabled": False}
+    assert out["extras"]["telemetry"] == {"enabled": False}
 
 
 def test_cpu_fallback_embeds_prior_tpu_extras_verbatim():
@@ -88,6 +92,19 @@ def test_cpu_fallback_embeds_prior_tpu_extras_verbatim():
     # the fallback's own top-level numbers remain the CPU run's — the
     # embedded block is evidence, not attribution
     assert out["extras"]["backend"] == "cpu"
+
+
+def test_bench_telemetry_mode_recorded_when_instrumented():
+    """BENCH_TELEMETRY=1 must brand the line as instrumented (the PR 3
+    chaos-mode guard applied to flutescope): an instrumented run can
+    never be silently compared against an uninstrumented baseline."""
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(BENCH_DEADLINE_SECS="25", BENCH_TELEMETRY="1"),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = _json_line(proc.stdout)
+    assert out["extras"]["telemetry"].get("enabled") is True
 
 
 def test_sigterm_mid_run_flushes_partial_json():

@@ -65,6 +65,9 @@ def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
             # between retries would only burn the tier-1 budget
             "checkpoint_retry": {"retries": 3, "backoff_base_s": 0.0,
                                  "jitter": 0.0},
+            # flutescope on: the smoke also proves injected faults reach
+            # the TRACE as structured events, not just the counters
+            "telemetry": {"enable": True},
             "data_config": {},
         },
         "client_config": {
@@ -85,18 +88,35 @@ def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
                                     seed=seed)
         state = server.train()
         counters = {k: float(v) for k, v in server.chaos.counters.items()}
+        # ---- flutescope assertion: the injected faults must appear in
+        # the trace as structured events (tools/scope's fault table) ----
+        import json as _json
+        server.scope.close()
+        with open(os.path.join(tmp, "telemetry", "trace.json")) as fh:
+            trace = _json.load(fh)
+        trace_events = {}
+        for ev in trace["traceEvents"]:
+            if ev.get("ph") == "i":
+                trace_events[ev["name"]] = trace_events.get(ev["name"], 0) + 1
         record = {
             "tool": "chaos_smoke",
             "rounds": int(state.round),
             "chaos": server.chaos.describe(),
             "fault_counters": counters,
             "checkpoint_recovery_events": len(server.ckpt.recovery_events),
+            "trace_fault_events": {
+                k: v for k, v in sorted(trace_events.items())
+                if k in ("chaos_faults", "ckpt_io_fault")},
         }
     assert state.round == rounds, f"run stopped early at {state.round}"
     for key in ("dropped", "straggled", "steps_lost", "ckpt_io_faults"):
         assert counters[key] > 0, (
             f"fault class {key!r} never fired — the injection path is "
             f"dead ({counters})")
+    for name in ("chaos_faults", "ckpt_io_fault"):
+        assert record["trace_fault_events"].get(name, 0) > 0, (
+            f"fault event {name!r} fired but never reached the trace — "
+            f"the telemetry event path is dead ({trace_events})")
     return record
 
 

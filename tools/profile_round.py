@@ -25,7 +25,6 @@ import json
 import os
 import sys
 import tempfile
-import time
 
 import numpy as np
 
@@ -59,6 +58,7 @@ def main(argv=None) -> None:
     from msrflute_tpu.engine import OptimizationServer
     from msrflute_tpu.models import make_task
     from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.telemetry.timing import Stopwatch
 
     mesh = make_mesh()
     task = make_task(cfg.model_config)
@@ -70,11 +70,14 @@ def main(argv=None) -> None:
         server = OptimizationServer(task, cfg, dataset, model_dir=tmp,
                                     mesh=mesh, seed=0)
         # ---- compile (first chunk) ----
-        tic = time.time()
-        server.config.server_config.max_iteration = fuse
-        server.train()
-        jax.block_until_ready(server.state.params)
-        out["compile_plus_first_chunk_secs"] = round(time.time() - tic, 3)
+        # telemetry.timing.Stopwatch everywhere below: the same clock
+        # the server spans and bench.py use (one timing source of
+        # truth); JSON field names unchanged
+        with Stopwatch() as sw:
+            server.config.server_config.max_iteration = fuse
+            server.train()
+            jax.block_until_ready(server.state.params)
+        out["compile_plus_first_chunk_secs"] = round(sw.secs, 3)
 
         # ---- host packing cost, measured alone — with the SAME client
         # padding the server uses (pad_to_mesh), or the share is
@@ -85,7 +88,7 @@ def main(argv=None) -> None:
         bs = int(cfg.client_config.data_config.train["batch_size"])
         pad_to = pad_to_mesh(len(sampled), mesh)
         pool_mode = server._pool_offsets is not None
-        tic = time.time()
+        sw = Stopwatch().__enter__()
         for _ in range(5):
             if pool_mode:
                 # device-resident pool: the server packs int32 indices,
@@ -99,28 +102,36 @@ def main(argv=None) -> None:
                 pack_round_batches(dataset, sampled, bs, server.max_steps,
                                    rng=np.random.default_rng(0),
                                    pad_clients_to=pad_to)
-        pack_secs = (time.time() - tic) / 5
+        sw.__exit__()
+        pack_secs = sw.secs / 5
         out["pack_secs_per_round"] = round(pack_secs, 5)
         out["device_resident_pool"] = pool_mode
 
         # ---- optional trace chunk: profiler instrumentation inflates
-        # wall time, so it is NOT counted into the steady-state stats ----
+        # wall time, so it is NOT counted into the steady-state stats.
+        # Capture goes through the compat wrappers (telemetry's
+        # profile_rounds path) so old jax degrades to a note, not a
+        # crash ----
         if args.trace:
-            jax.profiler.start_trace(args.trace)
-            server.config.server_config.max_iteration += fuse
-            server.train()
-            jax.block_until_ready(server.state.params)
-            jax.profiler.stop_trace()
-            out["trace_dir"] = args.trace
+            from msrflute_tpu.utils.compat import (profiler_start_trace,
+                                                   profiler_stop_trace)
+            if profiler_start_trace(args.trace):
+                server.config.server_config.max_iteration += fuse
+                server.train()
+                jax.block_until_ready(server.state.params)
+                profiler_stop_trace()
+                out["trace_dir"] = args.trace
+            else:
+                out["trace_error"] = "jax.profiler unavailable"
 
         # ---- timed chunks (the steady state) ----
         per_round = []
         for _ in range(max(args.chunks, 1)):
             server.config.server_config.max_iteration += fuse
-            tic = time.time()
-            server.train()
-            jax.block_until_ready(server.state.params)
-            per_round.append((time.time() - tic) / fuse)
+            with Stopwatch() as sw:
+                server.train()
+                jax.block_until_ready(server.state.params)
+            per_round.append(sw.secs / fuse)
         out["secs_per_round_p50"] = round(float(np.percentile(per_round, 50)), 5)
         out["secs_per_round_p90"] = round(float(np.percentile(per_round, 90)), 5)
         out["pack_share"] = round(pack_secs / max(np.median(per_round), 1e-9), 3)
@@ -168,13 +179,14 @@ def main(argv=None) -> None:
             # SAME val_ds bench.py times as secs_eval
             server.val_dataset = bench.make_val_ds(dataset, 8)
             server._eval_batches_cache.pop("val", None)
-            tic = time.time()
-            staged = server._packed_eval_batches("val")
-            # sync the staging transfers with an indexed scalar fetch per
-            # leaf — block_until_ready is not a trustworthy fence on the
-            # remote backend
-            jax.device_get({k: v[(0,) * v.ndim] for k, v in staged.items()})
-            cold_pack = time.time() - tic
+            with Stopwatch() as sw:
+                staged = server._packed_eval_batches("val")
+                # sync the staging transfers with an indexed scalar fetch
+                # per leaf — block_until_ready is not a trustworthy fence
+                # on the remote backend
+                jax.device_get({k: v[(0,) * v.ndim]
+                                for k, v in staged.items()})
+            cold_pack = sw.secs
             first = next(iter(staged.values()))
             ev = {"split": "val",
                   "grid_steps_T": int(first.shape[0]),
@@ -192,18 +204,19 @@ def main(argv=None) -> None:
             jax.device_get(server._eval_fn(server.state.params, staged))
             times = []
             for _ in range(10):
-                tic = time.time()
-                jax.device_get(server._eval_fn(server.state.params, staged))
-                times.append(time.time() - tic)
+                with Stopwatch() as sw:
+                    jax.device_get(server._eval_fn(server.state.params,
+                                                   staged))
+                times.append(sw.secs)
             ev["device_secs_p50"] = round(float(np.percentile(times, 50)), 5)
             # full path as the server pays it each cadence hit: device_put
             # no-ops + device run + device_get + host metric finalize
             times = []
             for _ in range(10):
-                tic = time.time()
-                evaluate(task, server._eval_fn, server.state.params,
-                         staged, mesh, server.engine.partition_mode)
-                times.append(time.time() - tic)
+                with Stopwatch() as sw:
+                    evaluate(task, server._eval_fn, server.state.params,
+                             staged, mesh, server.engine.partition_mode)
+                times.append(sw.secs)
             ev["full_eval_secs_p50"] = round(float(np.percentile(times, 50)), 5)
             ev["host_overhead_secs"] = round(
                 ev["full_eval_secs_p50"] - ev["device_secs_p50"], 5)
@@ -224,13 +237,13 @@ def main(argv=None) -> None:
                          if hasattr(x, "shape"))
             times_f, times_s = [], []
             for _ in range(5):
-                tic = time.time()
-                jax.device_get(_payload(state))
-                times_f.append(time.time() - tic)
-                tic = time.time()
-                server.ckpt._write(os.path.join(
-                    server.ckpt.model_dir, LATEST), state)
-                times_s.append(time.time() - tic)
+                with Stopwatch() as sw:
+                    jax.device_get(_payload(state))
+                times_f.append(sw.secs)
+                with Stopwatch() as sw:
+                    server.ckpt._write(os.path.join(
+                        server.ckpt.model_dir, LATEST), state)
+                times_s.append(sw.secs)
             out["checkpoint_cost"] = {
                 "state_bytes": int(nbytes),
                 "fetch_secs_p50": round(float(np.percentile(times_f, 50)), 5),

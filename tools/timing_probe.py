@@ -1,49 +1,23 @@
 """Honest on-chip wall-time measurement for the tools/ scripts.
 
-``jax.block_until_ready`` is NOT a trustworthy fence on the remote axon
-backend: the first committed ``flash_crossover.json`` read a flat
-~0.045 ms at every length/tile — dense attention fwd+bwd "in 60 us" at
-L=8192 against >4 GB of HBM traffic — i.e. the call returned before the
-device finished.  A host ``float()`` of a scalar result cannot lie: the
-4-byte transfer completes only after the producing program does.  Cost:
-one dispatch floor (~0.14 ms) per iteration, paid identically on both
-sides of any comparison these tools make.
+The implementation moved to :mod:`msrflute_tpu.telemetry.timing` (the
+one timing source of truth — bench.py and tools/profile_round.py sit on
+the same primitives); this module keeps the import path
+``flash_crossover_sweep.py`` / ``validate_flash_auto.py`` were written
+against.
 
-Shared by ``flash_crossover_sweep.py`` (queue job 92) and
-``validate_flash_auto.py`` (queue job 98) so the timing methodology
-cannot drift between the sweep and its validator.
+Why a scalar fence at all: ``jax.block_until_ready`` is NOT trustworthy
+on the remote axon backend — the first committed ``flash_crossover.json``
+read a flat ~0.045 ms at every length/tile (the call returned before the
+device finished).  A host ``float()`` of a scalar result cannot lie; see
+the telemetry.timing docstrings for the full methodology.
 """
 
 from __future__ import annotations
 
-import time
+import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def scalar_time(fn, *args, iters: int = 20) -> float:
-    """Mean wall seconds per call of ``fn`` (which must return a SCALAR),
-    fetching the value to host each iteration as the sync fence."""
-    float(fn(*args))  # compile + first run
-    tic = time.perf_counter()
-    for _ in range(iters):
-        float(fn(*args))
-    return (time.perf_counter() - tic) / iters
-
-
-def grad_wall(attn_fn, q, k, v, iters: int = 20) -> float:
-    """Fwd+bwd wall time of ``sum(attn_fn(q,k,v)**2)`` w.r.t. all three
-    inputs.  The jitted probe returns full-reduction sums of every grad —
-    a scalar for :func:`scalar_time`'s fence that also keeps XLA from
-    dead-code-eliminating any part of the backward pass."""
-    import jax
-    import jax.numpy as jnp
-
-    def loss(q, k, v):
-        return jnp.sum(attn_fn(q, k, v) ** 2)
-
-    def probe(q, k, v):
-        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return (jnp.sum(dq.astype(jnp.float32)) +
-                jnp.sum(dk.astype(jnp.float32)) +
-                jnp.sum(dv.astype(jnp.float32)))
-
-    return scalar_time(jax.jit(probe), q, k, v, iters=iters)
+from msrflute_tpu.telemetry.timing import grad_wall, scalar_time  # noqa: E402,F401
