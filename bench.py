@@ -644,6 +644,17 @@ def _server_overhead_extras(server) -> dict:
                          "devbus": server.engine.devbus.enabled,
                          "watchdog_findings":
                              len(scope.watchdog.findings)})
+    # robust mode completes the trio: a fluteshield-defended run pays
+    # screening (and possibly a sort-based robust combine) per round —
+    # comparing it against an undefended baseline without the marker
+    # would misattribute that cost (or hide that a "baseline" was
+    # silently quarantining clients)
+    shield = getattr(server, "shield", None)
+    out["robust"] = ({"enabled": False} if shield is None else
+                     dict(shield.describe(),
+                          quarantine_counters={
+                              k: round(float(v), 1)
+                              for k, v in shield.counters.items()}))
     return out
 
 
@@ -974,15 +985,14 @@ def bench_pipeline_ab(on_tpu: bool) -> dict:
     return out
 
 
-def bench_telemetry_ab(on_tpu: bool) -> dict:
-    """Telemetry-off vs telemetry-on A/B (flutescope's zero-overhead
-    acceptance, ISSUE 4): the SAME faithful-mode protocol run with no
-    ``server_config.telemetry`` block and with the full subsystem on
-    (spans + trace export + devbus + watchdogs), many rounds inside one
-    ``train()`` call.  Records steady-state s/round per arm and the
-    ratio; params are bit-identical by contract
-    (tests/test_telemetry_contract.py pins that plus the
-    zero-implicit-materialization property)."""
+def _config_block_ab(on_tpu: bool, key: str, arms: dict) -> dict:
+    """Shared off-vs-on overhead harness: run the SAME faithful-mode
+    protocol once per arm with ``server_config[key]`` set to that arm's
+    block (``None`` = block absent), many rounds inside one ``train()``
+    call, and record steady-state ``{key}_{arm}_secs_per_round``.  Both
+    subsystem A/Bs (telemetry, robust) ride this so their warm-up and
+    measurement protocols can never drift apart; ratio keys are the
+    caller's job (arm sets differ)."""
     import tempfile
 
     import jax
@@ -994,7 +1004,7 @@ def bench_telemetry_ab(on_tpu: bool) -> dict:
     warm, rounds = (5, 40) if on_tpu else (3, 30)
     out = {"rounds_per_arm": rounds,
            "protocol": "cnn_femnist" if on_tpu else "lr_mnist"}
-    for arm in ("off", "on"):
+    for arm, block in arms.items():
         if on_tpu:
             cfg = _flute_config({"model_type": "CNN", "num_classes": 62},
                                 20, 0.1, fuse=1)
@@ -1005,8 +1015,8 @@ def bench_telemetry_ab(on_tpu: bool) -> dict:
                                  "input_dim": 784}, 10, 0.03, fuse=1)
             data = _image_dataset(16, 60, (784,), 10,
                                   np.random.default_rng(0))
-        if arm == "on":
-            cfg.server_config["telemetry"] = {"enable": True}
+        if block is not None:
+            cfg.server_config[key] = dict(block)
         task = make_task(cfg.model_config)
         with tempfile.TemporaryDirectory() as tmp:
             server = OptimizationServer(task, cfg, data, model_dir=tmp,
@@ -1017,10 +1027,49 @@ def bench_telemetry_ab(on_tpu: bool) -> dict:
             with Stopwatch() as sw:
                 server.train()
                 jax.block_until_ready(server.state.params)
-        out[f"telemetry_{arm}_secs_per_round"] = round(sw.secs / rounds, 5)
+        out[f"{key}_{arm}_secs_per_round"] = round(sw.secs / rounds, 5)
+    return out
+
+
+def bench_telemetry_ab(on_tpu: bool) -> dict:
+    """Telemetry-off vs telemetry-on A/B (flutescope's zero-overhead
+    acceptance, ISSUE 4): the SAME faithful-mode protocol run with no
+    ``server_config.telemetry`` block and with the full subsystem on
+    (spans + trace export + devbus + watchdogs), many rounds inside one
+    ``train()`` call.  Records steady-state s/round per arm and the
+    ratio; params are bit-identical by contract
+    (tests/test_telemetry_contract.py pins that plus the
+    zero-implicit-materialization property)."""
+    out = _config_block_ab(on_tpu, "telemetry",
+                           {"off": None, "on": {"enable": True}})
     off = out["telemetry_off_secs_per_round"]
     out["overhead_ratio"] = round(
         out["telemetry_on_secs_per_round"] / max(off, 1e-9), 3)
+    return out
+
+
+def bench_robust_ab(on_tpu: bool) -> dict:
+    """fluteshield overhead A/B (ISSUE 5 satellite): the SAME
+    faithful-mode protocol run undefended, with screened mean
+    (finite + median-of-norms quarantine inside the round program), and
+    with coordinate-wise trimmed mean on top.  Records steady-state
+    s/round per arm and the ratios vs the undefended baseline — the
+    screening cost is a handful of fused reductions + one all_gather of
+    per-client norm scalars; the trimmed-mean arm adds the K-way
+    coordinate sort, the estimator's real price.  Firewall bit-identity
+    of the off arm is pinned by tests/test_robust.py, not timed here."""
+    out = _config_block_ab(on_tpu, "robust", {
+        "off": None,
+        "screened_mean": {"screen_nonfinite": True, "norm_multiplier": 5.0,
+                          "aggregator": "mean"},
+        "trimmed_mean": {"screen_nonfinite": True, "norm_multiplier": 5.0,
+                         "aggregator": "trimmed_mean",
+                         "trim_fraction": 0.1},
+    })
+    off = out["robust_off_secs_per_round"]
+    for arm in ("screened_mean", "trimmed_mean"):
+        out[f"{arm}_overhead_ratio"] = round(
+            out[f"robust_{arm}_secs_per_round"] / max(off, 1e-9), 3)
     return out
 
 
@@ -1152,38 +1201,33 @@ def main() -> None:
 
     extras = _LINE["extras"]  # global so a kill-signal flush sees updates
     extras.update({"backend": backend, "backend_reason": backend_reason})
-    # chaos mode is part of the bench CONTRACT: always recorded, so a
-    # fault-injected run can never be silently compared against a clean
-    # baseline.  BENCH_CHAOS enables it for every protocol — "1" for the
-    # default drill (dropout + straggling + checkpoint IO faults), or a
-    # JSON server_config.chaos block for a custom schedule.
-    chaos_env = os.environ.get("BENCH_CHAOS")
-    if chaos_env:
-        chaos_cfg = (json.loads(chaos_env)
-                     if chaos_env.strip().startswith("{") else
-                     {"seed": 0, "dropout_rate": 0.1,
-                      "straggler_rate": 0.1, "straggler_inflation": 2.0,
-                      "ckpt_io_error_rate": 0.05})
+    # subsystem modes are part of the bench CONTRACT: always recorded,
+    # so a fault-injected / instrumented / fluteshield-defended run can
+    # never be silently compared against a clean, uninstrumented, or
+    # undefended baseline.  BENCH_<X> enables the block for every
+    # protocol — "1" for the subsystem's default drill, or a JSON
+    # server_config.<key> block for a custom one.  The marker honours an
+    # explicit `"enable": false` (it must say what the run actually
+    # was, not that the env var was set); per-protocol entries also
+    # carry the modes via _server_overhead_extras.
+    def _env_block(key, env_var, default_block):
+        env = os.environ.get(env_var)
+        if not env:
+            extras[key] = {"enabled": False}
+            return
+        block = (json.loads(env) if env.strip().startswith("{")
+                 else dict(default_block))
         for spec in protocols.values():
-            spec["cfg"].server_config["chaos"] = dict(chaos_cfg)
-        extras["chaos"] = dict(chaos_cfg, enabled=True)
-    else:
-        extras["chaos"] = {"enabled": False}
-    # telemetry mode mirrors the chaos guard: always recorded, so an
-    # instrumented run (BENCH_TELEMETRY=1, or a JSON
-    # server_config.telemetry block) can never be silently compared
-    # against an uninstrumented baseline.  Per-protocol entries also
-    # carry the mode via _server_overhead_extras.
-    telemetry_env = os.environ.get("BENCH_TELEMETRY")
-    if telemetry_env:
-        telemetry_cfg = (json.loads(telemetry_env)
-                         if telemetry_env.strip().startswith("{") else
-                         {"enable": True})
-        for spec in protocols.values():
-            spec["cfg"].server_config["telemetry"] = dict(telemetry_cfg)
-        extras["telemetry"] = dict(telemetry_cfg, enabled=True)
-    else:
-        extras["telemetry"] = {"enabled": False}
+            spec["cfg"].server_config[key] = dict(block)
+        extras[key] = dict(block, enabled=block.get("enable", True))
+
+    _env_block("chaos", "BENCH_CHAOS",
+               {"seed": 0, "dropout_rate": 0.1, "straggler_rate": 0.1,
+                "straggler_inflation": 2.0, "ckpt_io_error_rate": 0.05})
+    _env_block("telemetry", "BENCH_TELEMETRY", {"enable": True})
+    _env_block("robust", "BENCH_ROBUST",
+               {"screen_nonfinite": True, "norm_multiplier": 5.0,
+                "aggregator": "mean"})
     if not on_tpu:
         # CPU fallback: carry the most recent committed raw on-chip
         # artifact, if any (written only by a fully successful TPU
@@ -1294,6 +1338,19 @@ def main() -> None:
                 extras["telemetry_overhead_ab"] = bench_telemetry_ab(on_tpu)
         except Exception as exc:
             extras["telemetry_overhead_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # fluteshield overhead A/B: default-on for CPU runs (the defended
+    # vs undefended cost evidence), env-gated on TPU like the others
+    if (not on_tpu or os.environ.get("BENCH_ROBUST_AB")) and \
+            (keep is None or "robust_overhead_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("robust_overhead_ab"):
+                extras["robust_overhead_ab"] = bench_robust_ab(on_tpu)
+        except Exception as exc:
+            extras["robust_overhead_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

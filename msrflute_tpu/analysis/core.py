@@ -35,7 +35,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 #: set because its whole contract is zero device syncs: a devbus
 #: publisher spelled `.item()`/`float(...)` would silently turn the
 #: packed-stats ride-along into per-scalar transfers.
-HOT_PATH_PARTS = ("engine", "ops", "strategies", "telemetry")
+HOT_PATH_PARTS = ("engine", "ops", "strategies", "telemetry", "robust")
 
 _PRAGMA_RE = re.compile(
     r"#\s*flint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+(\S.*))?")

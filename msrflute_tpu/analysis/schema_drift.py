@@ -50,6 +50,8 @@ _SPEC_MAP = {
     # flutescope telemetry blocks (PR 4)
     "TELEMETRY_FIELD_SPECS": "TELEMETRY_KEYS",
     "WATCHDOG_FIELD_SPECS": "WATCHDOG_KEYS",
+    # fluteshield screened aggregation (PR 5)
+    "ROBUST_FIELD_SPECS": "ROBUST_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -69,6 +71,9 @@ DOCUMENTED_KNOBS = (
     # flutescope: an operator who cannot find the trace/watchdog knobs
     # will keep debugging round time from log lines
     "telemetry",
+    # fluteshield: an operator who cannot find the screened-aggregation
+    # drill will learn about poisoned cohorts from a diverged model
+    "robust",
 )
 
 _DOC_MENTION_RE = re.compile(

@@ -406,6 +406,12 @@ class ServerConfig(Config):
     # free-form dict validated by schema.TELEMETRY_KEYS /
     # WATCHDOG_KEYS; absent (the default) means telemetry fully off
     telemetry: Optional[Dict[str, Any]] = None
+    # fluteshield screened aggregation (robust/): on-device NaN/Inf +
+    # norm-outlier quarantine and Byzantine-robust aggregator variants
+    # (strategies/robust.py) — free-form dict validated by
+    # schema.ROBUST_KEYS; absent (the default) is the firewall path:
+    # the exact pre-fluteshield round program
+    robust: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -427,7 +433,7 @@ class ServerConfig(Config):
             "do_profiling", "wantRL", "aggregate_median", "softmax_beta",
             "initial_lr", "weight_train_loss", "stale_prob",
             "num_skip_decoding", "nbest_task_scheduler", "chaos",
-            "checkpoint_retry", "telemetry"]))
+            "checkpoint_retry", "telemetry", "robust"]))
         out.data_config = data
         out.optimizer_config = opt
         out.annealing_config = ann

@@ -43,16 +43,37 @@ def build_eval_fn(task: BaseTask, mesh: Mesh,
         batches = {k: v for k, v in batches.items() if k != "user_idx"}
 
         def body(carry, batch):
-            sums = task.eval_stats(params, batch)
-            return jax.tree.map(jnp.add, carry, sums), None
+            sums, skipped = carry
+            step = task.eval_stats(params, batch)
+            # eval-side non-finite guard (fluteshield): a single client
+            # batch producing a NaN/Inf stat would otherwise poison the
+            # whole split's sums — and through best_val/plateau, the LR
+            # schedule's history, permanently.  A poisoned step's ENTIRE
+            # contribution (including its sample_count) is excluded, so
+            # the surviving weighted average stays consistent; the
+            # skipped-step count rides out with the sums for the
+            # structured `eval_nonfinite_skipped` event.  All-finite
+            # evals are numerically identical (where(True) is identity).
+            finite = jnp.asarray(True)
+            for leaf in jax.tree.leaves(step):
+                if jnp.issubdtype(leaf.dtype, jnp.floating):
+                    finite = finite & jnp.all(jnp.isfinite(leaf))
+            step = jax.tree.map(
+                lambda s: jnp.where(finite, s, jnp.zeros_like(s)), step)
+            return (jax.tree.map(jnp.add, sums, step),
+                    skipped + (1.0 - finite.astype(jnp.float32))), None
 
         # zero-initialize the carry; zeros_like only needs shapes, so the
         # extra eval_stats trace is dead-code-eliminated by XLA
         first = {k: v[0] for k, v in batches.items()}
         zero = jax.tree.map(jnp.zeros_like, task.eval_stats(params, first))
-        sums, _ = jax.lax.scan(body, zero, batches)
+        (sums, skipped), _ = jax.lax.scan(
+            body, (zero, jnp.zeros((), jnp.float32)), batches)
         if partition_mode == "shard_map":
             sums = jax.lax.psum(sums, CLIENTS_AXIS)
+            skipped = jax.lax.psum(skipped, CLIENTS_AXIS)
+        sums = dict(sums)
+        sums["__eval_nonfinite_steps__"] = skipped
         return sums
 
     if partition_mode == "shard_map":
@@ -146,4 +167,20 @@ def evaluate(task: BaseTask, eval_fn: Callable, params: Any,
             sums = jax.device_get(eval_fn(params, staged))
     else:
         sums = jax.device_get(eval_fn(params, staged))
-    return task.finalize_metrics(sums)
+    sums = dict(sums)
+    skipped = float(sums.pop("__eval_nonfinite_steps__", 0.0))
+    metrics = task.finalize_metrics(sums)
+    if skipped:
+        from ..telemetry import emit_event
+        # structured record in the metrics stream (and trace when on):
+        # the split's aggregate EXCLUDED this many poisoned batch steps
+        emit_event(telemetry, "eval_nonfinite_skipped",
+                   steps=int(skipped))
+        if float(sums.get("sample_count", 0.0)) <= 0.0:
+            # EVERY step was poisoned: the zero-sum "metrics" would read
+            # as a perfect loss of 0.0 and hijack best_val — surface NaN
+            # so the server's finite gate skips best/plateau updates
+            from ..utils.metrics import Metric
+            metrics = {name: Metric(float("nan"), m.higher_is_better)
+                       for name, m in metrics.items()}
+    return metrics

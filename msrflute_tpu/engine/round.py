@@ -42,6 +42,9 @@ from ..data.batching import RoundBatch
 from ..models.base import BaseTask
 from ..optim import make_optimizer
 from ..parallel.mesh import CLIENTS_AXIS, MODEL_AXIS, make_mesh
+from ..resilience.chaos import (CORRUPT_NAN, CORRUPT_SCALE,
+                                CORRUPT_SIGN_FLIP)
+from ..robust import make_shield
 from ..strategies.base import BaseStrategy
 from ..telemetry import devbus_config_enabled
 from ..telemetry.devbus import DeviceMetricBus
@@ -161,10 +164,74 @@ class RoundEngine:
         # the ONE live ChaosSchedule (counters, IO-fault stream) belongs
         # to the server; a second instance here would silently diverge.
         _chaos_raw = sc.get("chaos") or {}
+        _chaos_on = bool(_chaos_raw and _chaos_raw.get("enable", True))
         self.chaos_client_faults = bool(
-            _chaos_raw and _chaos_raw.get("enable", True) and
+            _chaos_on and
             (float(_chaos_raw.get("dropout_rate", 0.0) or 0.0) > 0.0 or
              float(_chaos_raw.get("straggler_rate", 0.0) or 0.0) > 0.0))
+        # adversarial corruption streams (fluteshield's attack half):
+        # when any corrupt_* rate is non-zero the program takes ONE more
+        # per-round data operand — mode [K] int32 — and applies the
+        # NaN/scale/sign-flip transform to the default payload inside
+        # the vmap'd client body.  Same static-at-build discipline as
+        # the fault flag above: zero rates compile the exact program a
+        # corruption-free config always had.
+        self.chaos_corruption = bool(
+            _chaos_on and
+            any(float(_chaos_raw.get(k, 0.0) or 0.0) > 0.0
+                for k in ("corrupt_nan_rate", "corrupt_scale_rate",
+                          "corrupt_sign_flip_rate")))
+        self._corrupt_scale = float(
+            _chaos_raw.get("corrupt_scale_factor", 10.0) or 10.0)
+        self._corrupt_flip_scale = float(
+            _chaos_raw.get("corrupt_sign_flip_scale", 1.0) or 1.0)
+
+        # fluteshield screened aggregation (server_config.robust): the
+        # quarantine mask is computed INSIDE the round program from the
+        # per-client payloads (robust/shield.py) and folds into
+        # client_mask/weights as data — no recompile, counters ride the
+        # packed-stats single transfer.  None (no block / enable: false)
+        # is the firewall path: the exact pre-fluteshield program.
+        self.shield = make_shield(sc)
+        if self.shield is not None:
+            from ..strategies.fedavg import FedAvg
+            from ..strategies.robust import RobustFedAvg
+            # exact-class check: SecureAgg/QFFL/FedBuff/... subclass
+            # FedAvg but combine through their own payload parts, which
+            # quarantine zeroing would silently corrupt (e.g. SecureAgg's
+            # pairwise-mask cancellation) — isinstance would admit them
+            if type(strategy) not in (FedAvg, RobustFedAvg):
+                raise ValueError(
+                    "server_config.robust requires strategy: fedavg/"
+                    f"fedprox — {type(strategy).__name__} aggregates "
+                    "through its own payload parts and would bypass the "
+                    "screening")
+            if self.clients_per_chunk:
+                raise ValueError(
+                    "server_config.robust is incompatible with "
+                    "clients_per_chunk: median-of-norms screening (and "
+                    "the trimmed-mean/median payload stack) needs every "
+                    "client's payload against the full cohort, which "
+                    "chunked accumulation never materializes — disable "
+                    "one of them")
+            if getattr(strategy, "adaptive_clip", None) is not None:
+                # screening zeroes only the default payload part; the
+                # adaptive-clip quantile aggregates per-client below-clip
+                # votes that quarantine cannot retract, so the clip would
+                # drift off the population actually being aggregated
+                raise ValueError(
+                    "server_config.robust is incompatible with "
+                    "dp_config.adaptive_clipping: quarantined clients' "
+                    "below-clip votes would still steer the clip "
+                    "quantile — use a fixed max_grad or drop the robust "
+                    "block")
+            if self.shield.wants_stack and \
+                    not getattr(strategy, "wants_client_stack", False):
+                raise ValueError(
+                    f"robust.aggregator={self.shield.aggregator!r} needs "
+                    "the stack-combining RobustFedAvg strategy "
+                    "(strategies/robust.py); the server wires this — "
+                    "constructing RoundEngine directly, pass it yourself")
 
         # flutescope device-metric bus (server_config.telemetry.devbus):
         # engine/strategy code publishes per-round device scalars at
@@ -243,11 +310,29 @@ class RoundEngine:
         pool_mode = self._pool is not None
 
         clients_per_chunk = self.clients_per_chunk
+        # fluteshield statics: all compile-time branches — a config
+        # without robust/corruption traces the exact legacy program
+        shield = self.shield
+        robust_stack = shield is not None and shield.wants_stack
+        chaos_corruption = self.chaos_corruption
+        corrupt_scale = self._corrupt_scale
+        corrupt_flip_scale = self._corrupt_flip_scale
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng,
-                       cohort_ids=None, cohort_mask=None, pool=None):
+                       cohort_ids=None, cohort_mask=None,
+                       corrupt_mode=None, pool=None):
+            if self.partition_mode == "shard_map":
+                # shard-local [K_local] -> full replicated [K] cohort
+                # (the median vote and the robust payload stack need
+                # every client, not this shard's slice)
+                def gather_axis(x):
+                    return jax.lax.all_gather(x, CLIENTS_AXIS, axis=0,
+                                              tiled=True)
+            else:
+                def gather_axis(x):
+                    return x
             def gather_pool(arrays, sample_mask):
                 # device-resident mode: 'arrays' carries pool indices;
                 # gather the feature rows in-program (one XLA gather per
@@ -264,7 +349,7 @@ class RoundEngine:
                                 ).astype(pool[k].dtype)
                     for k in pool}
 
-            def per_client(arr_c, mask_c, cm_c, cid_c):
+            def per_client(arr_c, mask_c, cm_c, cid_c, corrupt_c=None):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
                 rng_c = jax.random.fold_in(rng, cid_c)
@@ -281,6 +366,27 @@ class RoundEngine:
                     round_idx=round_idx, leakage_threshold=leakage_threshold,
                     quant_threshold=quant_threshold,
                     strategy_state=strategy_state, **cohort_kw)
+                if chaos_corruption:
+                    # adversarial chaos (resilience/chaos.py corrupt
+                    # modes, already gated on the live client_mask):
+                    # the DEFAULT payload this client would transmit is
+                    # what gets corrupted — local training, stats, and
+                    # the claimed weight stay honest-looking, exactly
+                    # the threat fluteshield screens for
+                    pg0, w0 = parts["default"]
+                    mult = jnp.where(
+                        corrupt_c == CORRUPT_SCALE, corrupt_scale,
+                        jnp.where(corrupt_c == CORRUPT_SIGN_FLIP,
+                                  -corrupt_flip_scale, 1.0))
+                    bad = corrupt_c == CORRUPT_NAN
+                    pg0 = jax.tree.map(
+                        lambda g: (jnp.where(
+                            bad, jnp.asarray(jnp.nan, g.dtype),
+                            g * mult.astype(g.dtype))
+                            if jnp.issubdtype(g.dtype, jnp.floating)
+                            else g), pg0)
+                    parts = dict(parts)
+                    parts["default"] = (pg0, w0)
                 parts = {name: (tree, w * cm_c)
                          for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
@@ -291,14 +397,16 @@ class RoundEngine:
                     stale = jnp.zeros(())
                 return parts, tl * cm_c, ns * cm_c, stats, stale
 
-            def process_chunk(arr_k, sm_k, cm_k, cid_k):
+            def process_chunk(arr_k, sm_k, cm_k, cid_k, corrupt_k=None):
                 """One chunk of clients -> (summed locals, per-client
-                privacy stats, raw parts).  The whole shard is one chunk in
-                the default path."""
+                privacy stats, raw parts, effective client mask).  The
+                whole shard is one chunk in the default path."""
                 if pool is not None:
                     arr_k = gather_pool(arr_k, sm_k)
+                vmap_args = (arr_k, sm_k, cm_k, cid_k) + \
+                    ((corrupt_k,) if chaos_corruption else ())
                 parts, tls, nss, stats, stale = jax.vmap(per_client)(
-                    arr_k, sm_k, cm_k, cid_k)
+                    *vmap_args)
                 # per-client privacy-attack metrics stay per-client (the
                 # server needs the distribution for the adaptive leakage
                 # threshold, core/server.py:397-409)
@@ -306,6 +414,34 @@ class RoundEngine:
                                       if k.startswith("privacy_")}
                 stats = {k: v for k, v in stats.items()
                          if not k.startswith("privacy_")}
+
+                shield_counts = None
+                if shield is not None:
+                    # fluteshield screening: quarantine from the ACTUAL
+                    # would-be-aggregated payloads, then exclude the
+                    # quarantined clients from every downstream sum via
+                    # jnp.where — a `0 *` multiply would let a NaN leaf
+                    # re-poison the very aggregate it was caught in
+                    pg_k, w_k = parts["default"]
+                    keep, q_nonfinite, q_norm = shield.screen(
+                        pg_k, tls, w_k, cm_k, gather_axis)
+                    keep_b = keep > 0
+                    pg_k = jax.tree.map(
+                        lambda g: jnp.where(
+                            keep_b.reshape((-1,) + (1,) * (g.ndim - 1)),
+                            g, jnp.zeros_like(g)), pg_k)
+                    parts = dict(parts)
+                    parts["default"] = (pg_k, jnp.where(keep_b, w_k, 0.0))
+                    tls = jnp.where(keep_b, tls, 0.0)
+                    nss = jnp.where(keep_b, nss, 0.0)
+                    stats = {k: jnp.where(keep_b, v, 0.0)
+                             for k, v in stats.items()}
+                    # fold into the client mask: counts, stat means, and
+                    # aggregation weights renormalize on device exactly
+                    # like mesh padding / chaos dropout
+                    cm_k = cm_k * keep
+                    shield_counts = (jnp.sum(q_nonfinite),
+                                     jnp.sum(q_norm))
 
                 local = {"parts": {}}
                 for name, (trees, ws) in parts.items():
@@ -348,7 +484,13 @@ class RoundEngine:
                     "stats_var_sum": jnp.sum(stats["var_corrected"] * cm_k),
                     "stats_norm_sum": jnp.sum(stats["norm"] * cm_k),
                 })
-                return local, privacy_per_client, parts
+                if shield_counts is not None:
+                    # per-cause quarantine counters: psum'd with the
+                    # other locals and packed into the single-transfer
+                    # stats buffer — zero new device_gets
+                    local["shield_nonfinite"] = shield_counts[0]
+                    local["shield_norm_outlier"] = shield_counts[1]
+                return local, privacy_per_client, parts, cm_k
 
             k_local = sample_mask.shape[0]
             if clients_per_chunk and clients_per_chunk < k_local:
@@ -363,10 +505,12 @@ class RoundEngine:
                                       clients_per_chunk) + x.shape[1:])
 
                 xs = jax.tree.map(to_chunks, (arrays, sample_mask,
-                                              client_mask, client_ids))
+                                              client_mask, client_ids) +
+                                  ((corrupt_mode,) if chaos_corruption
+                                   else ()))
 
                 def scan_body(acc, xs_c):
-                    local_c, priv_c, _ = process_chunk(*xs_c)
+                    local_c, priv_c, _, _ = process_chunk(*xs_c)
                     return jax.tree.map(jnp.add, acc, local_c), priv_c
 
                 zero_local = jax.tree.map(
@@ -378,9 +522,11 @@ class RoundEngine:
                 privacy_per_client = jax.tree.map(
                     lambda y: y.reshape((-1,) + y.shape[2:]), priv_chunks)
                 parts = None  # never materialized across all K — the point
+                cm_eff = None
             else:
-                local, privacy_per_client, parts = process_chunk(
-                    arrays, sample_mask, client_mask, client_ids)
+                local, privacy_per_client, parts, cm_eff = process_chunk(
+                    arrays, sample_mask, client_mask, client_ids,
+                    corrupt_mode if chaos_corruption else None)
             if self.partition_mode == "shard_map":
                 # the "harvest": one collective instead of K P2P recvs
                 total = jax.lax.psum(local, CLIENTS_AXIS)
@@ -408,21 +554,51 @@ class RoundEngine:
                 privacy_per_client["norm"] = pg_norm
                 privacy_per_client["cosine"] = dot / jnp.maximum(
                     pg_norm * gnorm, 1e-12)
+            if robust_stack:
+                # the Byzantine-robust combine (coordinate-wise trimmed
+                # mean / median, strategies/robust.py) needs the full
+                # SCREENED per-client payload stack replicated: the
+                # estimator's inherent K x model memory cost, paid in
+                # HBM inside the program — nothing crosses to the host
+                stack_tree = jax.tree.map(gather_axis,
+                                          parts["default"][0])
+                stack_keep = gather_axis(cm_eff)
+                return total, privacy_per_client, stack_tree, stack_keep
             return total, privacy_per_client
+
+        def shard_entry(params, strategy_state, arrays, sample_mask,
+                        client_mask, client_ids, client_lr, round_idx,
+                        leakage_threshold, quant_threshold, rng,
+                        cohort_ids, cohort_mask, *rest):
+            # trailing operands are positional through shard_map, so
+            # which slot means what depends on the compile-time flags —
+            # route them to the right keyword here (with corruption off
+            # and the pool on, the pool must not land in corrupt_mode)
+            rest = list(rest)
+            corrupt = rest.pop(0) if chaos_corruption else None
+            pool_arg = rest.pop(0) if pool_mode else None
+            return shard_body(params, strategy_state, arrays, sample_mask,
+                              client_mask, client_ids, client_lr,
+                              round_idx, leakage_threshold,
+                              quant_threshold, rng, cohort_ids,
+                              cohort_mask, corrupt_mode=corrupt,
+                              pool=pool_arg)
 
         if self.partition_mode == "shard_map":
             sharded_collect = shard_map(
-                shard_body, mesh=mesh,
+                shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec, rspec, rspec) +
+                         ((cspec,) if chaos_corruption else ()) +
                          ((rspec,) if pool_mode else ()),
-                out_specs=(rspec, cspec), check_vma=False)
+                out_specs=((rspec, cspec, rspec, rspec) if robust_stack
+                           else (rspec, cspec)), check_vma=False)
         else:
             # GSPMD mode: plain jit — client data stays sharded on the
             # 'clients' axis, params sharded per infer_model_sharding on the
             # 'model' axis; XLA's SPMD partitioner inserts the collectives
             # (enables tensor-parallel BERT, which the reference lacks).
-            sharded_collect = shard_body
+            sharded_collect = shard_entry
 
         chaos_faults = self.chaos_client_faults
 
@@ -440,9 +616,10 @@ class RoundEngine:
             # fault counters join round_stats and leave through the same
             # packed single-transfer buffer as every other stat.
             chaos_stats = {}
+            n_used = 0
             if chaos_faults:
                 chaos_drop, chaos_keep = extra_args[0], extra_args[1]
-                pool_args = extra_args[2:]
+                n_used = 2
                 step_live = (jnp.sum(sample_mask, axis=-1) > 0)      # [K, S]
                 real_steps = jnp.sum(step_live, axis=-1)             # [K]
                 keep_f = (jnp.arange(sample_mask.shape[-2])[None, :]
@@ -459,25 +636,59 @@ class RoundEngine:
                 sample_mask = sample_mask * keep_f[..., None].astype(
                     sample_mask.dtype)
                 client_mask = live_cm
-            else:
-                pool_args = extra_args
+            corrupt_args = ()
+            if chaos_corruption:
+                # adversarial corruption modes (one more per-round data
+                # operand): gated on the LIVE mask — a dropped client
+                # never transmits, and a padding slot's zero payload
+                # must not be NaN'd into the sum (0-weight x NaN is
+                # still NaN through a tensordot)
+                corrupt_mode = extra_args[n_used]
+                n_used += 1
+                corrupt_mode = jnp.where(client_mask > 0, corrupt_mode, 0)
+                f32 = jnp.float32
+                chaos_stats.update({
+                    "chaos_nan_injected": jnp.sum(
+                        (corrupt_mode == CORRUPT_NAN).astype(f32)),
+                    "chaos_scaled": jnp.sum(
+                        (corrupt_mode == CORRUPT_SCALE).astype(f32)),
+                    "chaos_sign_flipped": jnp.sum(
+                        (corrupt_mode == CORRUPT_SIGN_FLIP).astype(f32)),
+                })
+                corrupt_args = (corrupt_mode,)
+            pool_args = extra_args[n_used:]
             # strategies may move the broadcast point off the canonical
             # params (e.g. FedAC's momentum-like md point); default identity
             bcast = strategy.broadcast_params(params, strategy_state)
-            collected, privacy_per_client = sharded_collect(
+            collect_out = sharded_collect(
                 bcast, strategy_state, arrays, sample_mask, client_mask,
                 client_ids, client_lr, round_idx, leakage_threshold,
-                quant_threshold, rng, client_ids, client_mask, *pool_args)
+                quant_threshold, rng, client_ids, client_mask,
+                *corrupt_args, *pool_args)
+            if robust_stack:
+                (collected, privacy_per_client,
+                 stack_tree, stack_keep) = collect_out
+            else:
+                collected, privacy_per_client = collect_out
             part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:
                 default = part_sums["default"]
                 deferred = {"grad_sum": default["grad_sum_def"],
                             "weight_sum": default["weight_sum_def"]}
-            agg, new_strategy_state = strategy.combine_parts(
-                part_sums, deferred, strategy_state,
-                jax.random.fold_in(rng, 17),
-                num_clients=collected["client_count"], global_params=bcast)
+            if robust_stack:
+                # Byzantine-robust combine over the screened stack
+                # (strategies/robust.py); strategy state passes through
+                # untouched — RobustFedAvg is stateless by construction
+                agg = strategy.combine_stack(stack_tree, stack_keep,
+                                             jax.random.fold_in(rng, 17))
+                new_strategy_state = strategy_state
+            else:
+                agg, new_strategy_state = strategy.combine_parts(
+                    part_sums, deferred, strategy_state,
+                    jax.random.fold_in(rng, 17),
+                    num_clients=collected["client_count"],
+                    global_params=bcast)
             if self.server_max_grad_norm is not None:
                 agg = _clip_by_global_norm(agg, float(self.server_max_grad_norm))
             if strategy.owns_server_update:
@@ -508,6 +719,13 @@ class RoundEngine:
                 "agg_grad_norm": optax.global_norm(agg),
             }
             round_stats.update(chaos_stats)
+            if shield is not None:
+                # per-cause quarantine counters out through the same
+                # packed single transfer as every other stat
+                round_stats["shield_nonfinite"] = \
+                    collected["shield_nonfinite"]
+                round_stats["shield_norm_outlier"] = \
+                    collected["shield_norm_outlier"]
             for k, v in privacy_per_client.items():
                 round_stats[k] = v
             if self.devbus.enabled:
@@ -564,38 +782,31 @@ class RoundEngine:
             return cached
         core = self._round_step_core
         chaos_faults = self.chaos_client_faults
+        chaos_corruption = self.chaos_corruption
+        n_chaos = (2 if chaos_faults else 0) + (1 if chaos_corruption else 0)
 
         def multi(params, opt_state, strategy_state, arrays, sample_mask,
                   client_mask, client_ids, client_lrs, server_lrs,
                   round_idxs, leakage_threshold, quant_thresholds, rngs,
                   *extra_args):
-            # chaos operands are per-round ([R, K]) and scan with the rest
-            # of the round inputs; the resident pool stays a carried
-            # constant like before
-            if chaos_faults:
-                chaos_drops, chaos_keeps = extra_args[0], extra_args[1]
-                pool_args = extra_args[2:]
-            else:
-                pool_args = extra_args
+            # chaos operands (drop/keep and/or corrupt modes) are
+            # per-round ([R, K]) and scan with the rest of the round
+            # inputs; the resident pool stays a carried constant
+            chaos_args = extra_args[:n_chaos]
+            pool_args = extra_args[n_chaos:]
 
             def body(carry, xs):
                 p, o, s = carry
-                if chaos_faults:
-                    (arr, sm, cm, cid, clr, slr, ridx, qt, rng,
-                     cdrop, ckeep) = xs
-                    chaos_xs = (cdrop, ckeep)
-                else:
-                    arr, sm, cm, cid, clr, slr, ridx, qt, rng = xs
-                    chaos_xs = ()
+                arr, sm, cm, cid, clr, slr, ridx, qt, rng = xs[:9]
+                chaos_xs = xs[9:]
                 p, o, s, stats = core(p, o, s, arr, sm, cm, cid, clr, slr,
                                       ridx, leakage_threshold, qt, rng,
                                       *chaos_xs, *pool_args)
                 return (p, o, s), stats
 
             xs = (arrays, sample_mask, client_mask, client_ids,
-                  client_lrs, server_lrs, round_idxs, quant_thresholds, rngs)
-            if chaos_faults:
-                xs = xs + (chaos_drops, chaos_keeps)
+                  client_lrs, server_lrs, round_idxs, quant_thresholds,
+                  rngs) + tuple(chaos_args)
             (p, o, s), stats = jax.lax.scan(
                 body, (params, opt_state, strategy_state), xs)
             return p, o, s, stats
@@ -715,26 +926,38 @@ class RoundEngine:
     # ------------------------------------------------------------------
     def _stage_chaos(self, chaos_vecs: Optional[list], sharding,
                      stacked: bool) -> tuple:
-        """Device-stage the chaos fault vectors (``[(drop [K], keep [K])]``
-        per round) as trailing program operands — or nothing when the
-        engine compiled without client faults.  Mismatches are
-        programming errors and raise."""
-        if not self.chaos_client_faults:
+        """Device-stage the chaos fault vectors as trailing program
+        operands: per round a tuple of ``(drop [K], keep_steps [K])``
+        when client faults compiled in, followed by ``(corrupt_mode
+        [K],)`` when corruption compiled in — or nothing when the engine
+        compiled without either.  Mismatches are programming errors and
+        raise."""
+        dtypes = ([np.float32, np.float32] if self.chaos_client_faults
+                  else []) + \
+                 ([np.int32] if self.chaos_corruption else [])
+        if not dtypes:
             if chaos_vecs:
                 raise ValueError(
                     "chaos vectors supplied but the engine was built "
-                    "without chaos client faults (server_config.chaos)")
+                    "without chaos client faults or corruption "
+                    "(server_config.chaos)")
             return ()
         if not chaos_vecs:
             raise ValueError(
-                "engine built with chaos client faults: every dispatch "
-                "needs per-round (drop, keep_steps) vectors")
-        drops = [np.asarray(d, np.float32) for d, _ in chaos_vecs]
-        keeps = [np.asarray(k, np.float32) for _, k in chaos_vecs]
-        drop = np.stack(drops) if stacked else drops[0]
-        keep = np.stack(keeps) if stacked else keeps[0]
-        return (jax.device_put(drop, sharding),
-                jax.device_put(keep, sharding))
+                "engine built with chaos client faults/corruption: every "
+                "dispatch needs the per-round fault vectors")
+        if any(len(entry) != len(dtypes) for entry in chaos_vecs):
+            raise ValueError(
+                f"chaos vector arity mismatch: engine expects "
+                f"{len(dtypes)} per-round vectors "
+                f"(faults={self.chaos_client_faults}, "
+                f"corruption={self.chaos_corruption})")
+        out = []
+        for i, dt in enumerate(dtypes):
+            vals = [np.asarray(entry[i], dt) for entry in chaos_vecs]
+            arr = np.stack(vals) if stacked else vals[0]
+            out.append(jax.device_put(arr, sharding))
+        return tuple(out)
 
     # ------------------------------------------------------------------
     def run_round(self, state: ServerState, batch: RoundBatch,

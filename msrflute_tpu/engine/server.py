@@ -67,8 +67,39 @@ class OptimizationServer:
         sc = config.server_config
         dp = config.dp_config
         strategy_cls = select_strategy(config.strategy)
-        self.strategy = strategy_cls(config, dp)
+        if sc.get("robust"):
+            # fluteshield (server_config.robust): a stack aggregator
+            # (trimmed_mean / median) swaps in the stack-combining
+            # RobustFedAvg; screening-only configs keep the plain
+            # strategy.  Non-FedAvg strategies are refused loudly — a
+            # robust block that silently aggregated unscreened payloads
+            # is the quiet failure this layer exists to prevent.
+            from ..strategies.robust import select_robust_strategy
+            self.strategy = select_robust_strategy(config, dp, strategy_cls)
+        else:
+            self.strategy = strategy_cls(config, dp)
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
+        #: fluteshield screening policy (None = firewall path); the ONE
+        #: live Shield belongs to the engine — the server reads its
+        #: counters/describe() for telemetry + the bench contract
+        self.shield = self.engine.shield
+        # Host-orchestrated round paths (RL, SCAFFOLD/EF host rounds,
+        # personalization's overridden sampling) build their payloads
+        # outside the fused round program — the ONE predicate both the
+        # fluteshield and the chaos guards below key off.
+        host_orchestrated = (
+            sc.get("wantRL", False) or
+            getattr(self.strategy, "host_rounds", False) or
+            getattr(self.strategy, "ef_rounds", False) or
+            type(self)._sample is not OptimizationServer._sample)
+        if self.shield is not None:
+            if host_orchestrated:
+                raise ValueError(
+                    "server_config.robust requires the fused round path "
+                    "— wantRL, strategy: scaffold / ef_quant, and "
+                    "personalization orchestrate rounds host-side and "
+                    "would aggregate unscreened payloads; drop the "
+                    "robust block for this configuration")
 
         # ---- resilience: chaos schedule + graceful preemption --------
         # server_config.chaos (resilience/chaos.py): seeded deterministic
@@ -78,20 +109,17 @@ class OptimizationServer:
         # personalization's model-dependent sampling build their payloads
         # elsewhere and would silently ignore them.
         self.chaos = make_chaos(sc)
-        if self.chaos is not None and self.chaos.has_client_faults:
-            host_orchestrated = (
-                sc.get("wantRL", False) or
-                getattr(self.strategy, "host_rounds", False) or
-                getattr(self.strategy, "ef_rounds", False) or
-                type(self)._sample is not OptimizationServer._sample)
+        if self.chaos is not None and (self.chaos.has_client_faults or
+                                       self.chaos.has_corruption):
             if host_orchestrated:
                 raise ValueError(
-                    "server_config.chaos dropout_rate/straggler_rate "
-                    "require the fused round path — wantRL, strategy: "
-                    "scaffold / ef_quant, and personalization orchestrate "
-                    "rounds host-side and would ignore the injected "
-                    "faults; zero those rates (IO faults and "
-                    "preempt_at_round still apply) or drop the feature")
+                    "server_config.chaos dropout_rate/straggler_rate/"
+                    "corrupt_* rates require the fused round path — "
+                    "wantRL, strategy: scaffold / ef_quant, and "
+                    "personalization orchestrate rounds host-side and "
+                    "would ignore the injected faults; zero those rates "
+                    "(IO faults and preempt_at_round still apply) or "
+                    "drop the feature")
         #: SIGTERM/SIGINT -> drain in-flight round -> emergency
         #: checkpoint -> resumable exit (resilience/preemption.py); the
         #: loop polls `requested` at chunk boundaries
@@ -728,14 +756,25 @@ class OptimizationServer:
                 self.ckpt.save_latest(pending["state"])
                 pending["latest_saved"] = True
             chaos_vecs = None
-            if self.engine.chaos_client_faults:
+            if self.engine.chaos_client_faults or \
+                    self.engine.chaos_corruption:
                 # deterministic per-round fault vectors (seeded on the
                 # round index, resilience/chaos.py) — data operands of
-                # the compiled program, so no recompile ever
-                chaos_vecs = [
-                    self.chaos.client_faults(round_no + j,
-                                             batches[j].sample_mask)
-                    for j in range(R)]
+                # the compiled program, so no recompile ever.  Each
+                # entry carries (drop, keep_steps) and/or the
+                # adversarial corruption modes, matching what the
+                # engine compiled in.
+                chaos_vecs = []
+                for j in range(R):
+                    entry = ()
+                    if self.engine.chaos_client_faults:
+                        entry += self.chaos.client_faults(
+                            round_no + j, batches[j].sample_mask)
+                    if self.engine.chaos_corruption:
+                        entry += (self.chaos.corrupt_modes(
+                            round_no + j,
+                            batches[j].sample_mask.shape[0]),)
+                    chaos_vecs.append(entry)
             # the device window span opens at dispatch and is ended by
             # whoever drains this chunk — the explicit begin/end API
             # exists exactly for this overlap (round k's window stays
@@ -898,11 +937,22 @@ class OptimizationServer:
             secs = self.run_stats["secsPerRound"][-1]
             for j in range(R):
                 n = max(float(stats["client_count"][j]), 1.0)
+                quarantine_frac = None
+                if "shield_nonfinite" in stats:
+                    # quarantined / live cohort (client_count is the
+                    # POST-screen count, so the cohort adds them back) —
+                    # the quarantine_rate detector's "a few bad clients
+                    # vs the model itself diverging" signal
+                    q = (float(stats["shield_nonfinite"][j]) +
+                         float(stats["shield_norm_outlier"][j]))
+                    quarantine_frac = q / max(
+                        q + float(stats["client_count"][j]), 1.0)
                 self.scope.watchdog.observe_round(
                     round0 + j,
                     train_loss=float(stats["train_loss_sum"][j]) / n,
                     round_secs=secs,
-                    ckpt_failures=self.ckpt.escalator.consecutive)
+                    ckpt_failures=self.ckpt.escalator.consecutive,
+                    quarantine_frac=quarantine_frac)
 
     def _drain_host_tail(self, chunk: Dict[str, Any], stats,
                          val_freq: int, rec_freq: int) -> None:
@@ -946,6 +996,44 @@ class OptimizationServer:
                     emit_event(self.scope, "chaos_faults", round=r,
                                dropped=dropped, straggled=straggled,
                                steps_lost=lost)
+        if self.chaos is not None and "chaos_nan_injected" in stats:
+            # adversarial corruption counters (fluteshield's attack
+            # half): same packed-transfer discipline as the fault
+            # counters above
+            counters = self.chaos.counters
+            for j in range(R):
+                r = round0 + j
+                nans = float(stats["chaos_nan_injected"][j])
+                scaled = float(stats["chaos_scaled"][j])
+                flipped = float(stats["chaos_sign_flipped"][j])
+                counters["nan_injected"] += nans
+                counters["scaled"] += scaled
+                counters["sign_flipped"] += flipped
+                log_metric("Chaos NaN-injected clients", nans, step=r)
+                log_metric("Chaos scaled clients", scaled, step=r)
+                log_metric("Chaos sign-flipped clients", flipped, step=r)
+                if nans or scaled or flipped:
+                    emit_event(self.scope, "chaos_corruption", round=r,
+                               nan_injected=nans, scaled=scaled,
+                               sign_flipped=flipped)
+        if self.shield is not None and "shield_nonfinite" in stats:
+            # fluteshield quarantine observability: per-cause counters
+            # computed inside the round program, fetched through the
+            # SAME packed single transfer as every other stat
+            counters = self.shield.counters
+            for j in range(R):
+                r = round0 + j
+                nonfinite = float(stats["shield_nonfinite"][j])
+                outlier = float(stats["shield_norm_outlier"][j])
+                counters["quarantined_nonfinite"] += nonfinite
+                counters["quarantined_norm_outlier"] += outlier
+                log_metric("Quarantined clients (non-finite)", nonfinite,
+                           step=r)
+                log_metric("Quarantined clients (norm outlier)", outlier,
+                           step=r)
+                if nonfinite or outlier:
+                    emit_event(self.scope, "quarantine", round=r,
+                               nonfinite=nonfinite, norm_outlier=outlier)
         self._process_privacy_stats(
             stats, round0,
             client_mask=np.stack([b.client_mask for b in chunk["batches"]]))
@@ -1104,7 +1192,12 @@ class OptimizationServer:
             if not improved and self.lr_decay_factor != 1.0:
                 self.lr_weight *= float(self.lr_decay_factor)
                 print_rank(f"decayed client lr weight to {self.lr_weight}")
-            if self.plateau is not None and "loss" in self._last_val:
+            if self.plateau is not None and "loss" in self._last_val and \
+                    np.isfinite(self._last_val["loss"].value):
+                # non-finite val loss: skip the plateau step rather than
+                # corrupt its best/bad_rounds history (NaN compares
+                # False against everything — the tracker would count a
+                # permanent plateau and decay the LR to the floor)
                 self.plateau.step(self._last_val["loss"].value)
             if self.fall_back_to_best and not improved:
                 self._fall_back()
@@ -1523,6 +1616,16 @@ class OptimizationServer:
         if split == "val":
             self._last_val = metrics
             for name, metric in metrics.items():
+                if not np.isfinite(metric.value):
+                    # eval-side non-finite guard, host half: a NaN/Inf
+                    # metric must never enter best_val (it would poison
+                    # every later is_better_than comparison and the
+                    # fall-back-to-best target) — today's value simply
+                    # doesn't compete
+                    emit_event(self.scope, "eval_nonfinite_skipped",
+                               split=split, metric=name, round=round_no,
+                               value=str(metric.value))
+                    continue
                 prev = self.best_val.get(name)
                 if prev is None or metric.is_better_than(prev):
                     self.best_val[name] = metric

@@ -3,8 +3,12 @@
 A seeded, config-driven fault schedule for rehearsing the failure modes
 real federated deployments hit constantly: clients that drop out
 mid-round, stragglers that miss the synchronous barrier with only part
-of their local steps done, checkpoint IO that errors transiently, and
-the scheduler preempting the whole job at an inconvenient round.
+of their local steps done, checkpoint IO that errors transiently, the
+scheduler preempting the whole job at an inconvenient round — and,
+since fluteshield (``msrflute_tpu/robust/``), ADVERSARIAL update
+corruption: clients whose pseudo-gradient comes back NaN, scaled up, or
+sign-flipped (:meth:`ChaosSchedule.corrupt_modes`), the attack streams
+the screened-aggregation defense is tested against end-to-end.
 
 Determinism guarantee (pinned by ``tests/test_resilience.py``): every
 fault decision is a pure function of ``(chaos.seed, fault stream, round
@@ -43,6 +47,17 @@ import numpy as np
 #: of anything else seeded from small ints)
 _CLIENT_STREAM = 0xC7A05C11
 _IO_STREAM = 0xC7A051F0
+#: adversarial update-corruption stream (fluteshield's attack half) —
+#: its OWN tag so enabling corruption never moves the dropout/straggler
+#: schedule an existing seed produces
+_CORRUPT_STREAM = 0xC7A0C0DE
+
+#: corruption mode encoding for the per-round ``[K]`` int32 operand the
+#: fused round program consumes (engine/round.py); 0 = clean
+CORRUPT_NONE = 0
+CORRUPT_NAN = 1        # payload leaves become NaN (corrupted transfer)
+CORRUPT_SCALE = 2      # payload x corrupt_scale_factor (scaling attack)
+CORRUPT_SIGN_FLIP = 3  # payload x -corrupt_sign_flip_scale (sign flip)
 
 #: "no straggler bound" sentinel — far above any realistic step grid
 NO_BOUND = 1e9
@@ -56,7 +71,12 @@ class ChaosSchedule:
                  straggler_rate: float = 0.0,
                  straggler_inflation: float = 2.0,
                  ckpt_io_error_rate: float = 0.0,
-                 preempt_at_round: Optional[int] = None):
+                 preempt_at_round: Optional[int] = None,
+                 corrupt_nan_rate: float = 0.0,
+                 corrupt_scale_rate: float = 0.0,
+                 corrupt_sign_flip_rate: float = 0.0,
+                 corrupt_scale_factor: float = 10.0,
+                 corrupt_sign_flip_scale: float = 1.0):
         if not 0.0 <= float(dropout_rate) <= 1.0:
             raise ValueError("chaos.dropout_rate must be in [0, 1]")
         if not 0.0 <= float(straggler_rate) <= 1.0:
@@ -67,6 +87,20 @@ class ChaosSchedule:
                              "before the round barrier)")
         if not 0.0 <= float(ckpt_io_error_rate) <= 1.0:
             raise ValueError("chaos.ckpt_io_error_rate must be in [0, 1]")
+        for key, val in (("corrupt_nan_rate", corrupt_nan_rate),
+                         ("corrupt_scale_rate", corrupt_scale_rate),
+                         ("corrupt_sign_flip_rate", corrupt_sign_flip_rate)):
+            if not 0.0 <= float(val) <= 1.0:
+                raise ValueError(f"chaos.{key} must be in [0, 1]")
+        if float(corrupt_nan_rate) + float(corrupt_scale_rate) + \
+                float(corrupt_sign_flip_rate) > 1.0:
+            raise ValueError(
+                "chaos corruption rates must sum to <= 1 (each client "
+                "draws at most one corruption mode per round)")
+        if float(corrupt_scale_factor) <= 0.0:
+            raise ValueError("chaos.corrupt_scale_factor must be > 0")
+        if float(corrupt_sign_flip_scale) <= 0.0:
+            raise ValueError("chaos.corrupt_sign_flip_scale must be > 0")
         self.seed = int(seed)
         self.dropout_rate = float(dropout_rate)
         self.straggler_rate = float(straggler_rate)
@@ -74,19 +108,31 @@ class ChaosSchedule:
         self.ckpt_io_error_rate = float(ckpt_io_error_rate)
         self.preempt_at_round = (None if preempt_at_round is None
                                  else int(preempt_at_round))
+        self.corrupt_nan_rate = float(corrupt_nan_rate)
+        self.corrupt_scale_rate = float(corrupt_scale_rate)
+        self.corrupt_sign_flip_rate = float(corrupt_sign_flip_rate)
+        self.corrupt_scale_factor = float(corrupt_scale_factor)
+        self.corrupt_sign_flip_scale = float(corrupt_sign_flip_scale)
         self._io_calls = 0
         #: injected-fault observability, accumulated by the server from
-        #: the packed round stats (dropped/straggled/steps_lost) and by
-        #: :meth:`io_fault` locally
+        #: the packed round stats (dropped/straggled/steps_lost +
+        #: corruption modes) and by :meth:`io_fault` locally
         self.counters: Dict[str, float] = {
             "dropped": 0.0, "straggled": 0.0, "steps_lost": 0.0,
             "ckpt_io_faults": 0.0,
+            "nan_injected": 0.0, "scaled": 0.0, "sign_flipped": 0.0,
         }
 
     # ------------------------------------------------------------------
     @property
     def has_client_faults(self) -> bool:
         return self.dropout_rate > 0.0 or self.straggler_rate > 0.0
+
+    @property
+    def has_corruption(self) -> bool:
+        return (self.corrupt_nan_rate > 0.0 or
+                self.corrupt_scale_rate > 0.0 or
+                self.corrupt_sign_flip_rate > 0.0)
 
     def _round_rng(self, round_no: int) -> np.random.Generator:
         return np.random.default_rng(np.random.SeedSequence(
@@ -117,6 +163,35 @@ class ChaosSchedule:
             np.maximum(np.ceil(real_steps / self.straggler_inflation), 1.0),
             NO_BOUND).astype(np.float32)
         return drop, keep
+
+    # ------------------------------------------------------------------
+    def corrupt_modes(self, round_no: int, k: int) -> np.ndarray:
+        """Per-round adversarial corruption assignment for one packed
+        round batch: ``[K] int32`` of :data:`CORRUPT_NONE` /
+        :data:`CORRUPT_NAN` / :data:`CORRUPT_SCALE` /
+        :data:`CORRUPT_SIGN_FLIP`.
+
+        Keyed per ``(seed, corrupt stream, round)`` — its OWN
+        SeedSequence stream, so adding corruption to an existing chaos
+        config never moves the dropout/straggler schedule, and the
+        decisions are call-order independent (serial == pipelined ==
+        resumed) exactly like :meth:`client_faults`.  One uniform draw
+        per client slot partitions into modes, so each client suffers at
+        most one corruption per round.  Padding/dropped slots draw too
+        (slot-keyed determinism) — the round program gates corruption on
+        the live ``client_mask`` so their draws are inert.
+        """
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [self.seed, _CORRUPT_STREAM, int(round_no)]))
+        u = rng.random(int(k))
+        mode = np.full(int(k), CORRUPT_NONE, np.int32)
+        hi = self.corrupt_nan_rate + self.corrupt_scale_rate + \
+            self.corrupt_sign_flip_rate
+        mode[u < hi] = CORRUPT_SIGN_FLIP
+        mode[u < self.corrupt_nan_rate + self.corrupt_scale_rate] = \
+            CORRUPT_SCALE
+        mode[u < self.corrupt_nan_rate] = CORRUPT_NAN
+        return mode
 
     # ------------------------------------------------------------------
     def io_fault(self) -> bool:
@@ -154,6 +229,11 @@ class ChaosSchedule:
             "straggler_inflation": self.straggler_inflation,
             "ckpt_io_error_rate": self.ckpt_io_error_rate,
             "preempt_at_round": self.preempt_at_round,
+            "corrupt_nan_rate": self.corrupt_nan_rate,
+            "corrupt_scale_rate": self.corrupt_scale_rate,
+            "corrupt_sign_flip_rate": self.corrupt_sign_flip_rate,
+            "corrupt_scale_factor": self.corrupt_scale_factor,
+            "corrupt_sign_flip_scale": self.corrupt_sign_flip_scale,
         }
 
 
@@ -173,4 +253,9 @@ def make_chaos(server_config) -> Optional[ChaosSchedule]:
         straggler_inflation=raw.get("straggler_inflation", 2.0),
         ckpt_io_error_rate=raw.get("ckpt_io_error_rate", 0.0),
         preempt_at_round=raw.get("preempt_at_round"),
+        corrupt_nan_rate=raw.get("corrupt_nan_rate", 0.0),
+        corrupt_scale_rate=raw.get("corrupt_scale_rate", 0.0),
+        corrupt_sign_flip_rate=raw.get("corrupt_sign_flip_rate", 0.0),
+        corrupt_scale_factor=raw.get("corrupt_scale_factor", 10.0),
+        corrupt_sign_flip_scale=raw.get("corrupt_sign_flip_scale", 1.0),
     )

@@ -1,0 +1,47 @@
+"""fluteshield — screened aggregation for poisoned / broken cohorts.
+
+FLUTE's premise is simulation over millions of UNRELIABLE clients, but
+the aggregation path historically trusted every pseudo-gradient that
+came back: one client emitting a NaN/Inf leaf (a diverged local run, a
+corrupted transfer, an adversary) poisons the weighted sum, the global
+model, and — through the logged train loss — trips the NaN watchdog's
+whole-run abort.  fluteshield puts the defense INSIDE the fused round
+program, mirroring the chaos-mask mechanics (``resilience/chaos.py``):
+
+- **per-client screening** (:meth:`Shield.screen`): any-NaN/Inf finite
+  checks over the post-transform payload tree + train loss + weight,
+  and median-of-norms outlier screening (``norm_multiplier`` x the
+  cohort's masked median payload norm).  The resulting quarantine mask
+  folds into ``client_mask`` as data INSIDE the program — aggregation
+  weights renormalize on device exactly like mesh padding, quarantined
+  payloads are zeroed with ``jnp.where`` (a ``0 * NaN`` multiply would
+  re-poison the sum), and per-cause counters ride the packed-stats
+  single transfer (zero new ``device_get``s, clean under
+  ``MSRFLUTE_STRICT_TRANSFERS=1``).
+- **robust aggregators** (``strategies/robust.py``): coordinate-wise
+  trimmed mean and coordinate-wise median over the screened per-client
+  payload stack, for adversaries screening cannot catch (sign-flips at
+  benign norm).
+- **adversarial chaos streams** (``resilience/chaos.py``): seeded
+  NaN-injection / gradient-scale / sign-flip corruption keyed per
+  ``(seed, stream, round)``, so the defense is testable end-to-end
+  (``tests/test_robust.py``, ``tools/chaos_smoke.py``).
+
+Config (``server_config.robust``, schema ``ROBUST_KEYS``)::
+
+    robust:
+      screen_nonfinite: true     # quarantine any-NaN/Inf payloads
+      norm_multiplier: 5.0       # quarantine norm > mult x median (0/None: off)
+      aggregator: mean           # mean | trimmed_mean | median
+      trim_fraction: 0.1         # per-side trim for trimmed_mean
+
+The firewall contract: no ``robust`` block (or ``enable: false``)
+compiles the exact round program this repo always had — bit-identical
+params, serial and pipelined (``tests/test_robust.py``).
+"""
+
+from __future__ import annotations
+
+from .shield import Shield, make_shield, masked_median  # noqa: F401
+
+__all__ = ["Shield", "make_shield", "masked_median"]

@@ -1,0 +1,181 @@
+"""The screening half of fluteshield: traced quarantine math.
+
+Everything in this module that touches arrays is PURE TRACED code that
+runs inside the fused round program (``engine/round.py``): the finite
+checks, the masked median, and the quarantine mask are ordinary XLA ops
+over values that never visit the host.  The host-side surface is the
+config parse (:func:`make_shield`) and the run-level counters the
+server accumulates from the packed round stats.
+
+Numerical contract (pinned by ``tests/test_robust.py``):
+
+- quarantined clients contribute EXACTLY zero to every aggregate —
+  payload leaves, train-loss sum, sample counts, stat sums — via
+  ``jnp.where`` on the keep mask (never a ``0 *`` multiply, which NaN
+  survives);
+- the median-of-norms vote counts only live, finite clients (padding
+  slots and non-finite payloads cannot drag the threshold down);
+- a degenerate all-zero-norm cohort disables the norm screen for that
+  round instead of quarantining everyone (``median == 0`` guard);
+- screening decisions are a pure function of this round's payloads, so
+  serial and pipelined loops quarantine identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: robust aggregator vocabulary (schema ALLOWED_ROBUST_AGGREGATORS
+#: mirrors this — schema_drift keeps them from desyncing via the docs)
+AGGREGATORS = ("mean", "trimmed_mean", "median")
+
+
+def masked_median(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Median of ``values[mask > 0]`` with static shapes (traced).
+
+    Masked-out and non-finite entries sort to the top as ``+inf`` and
+    are excluded by rank; even counts interpolate the two middle ranks.
+    Returns 0.0 for an empty vote (the caller treats that as "no
+    threshold this round").
+    """
+    finite = jnp.isfinite(values) & (mask > 0)
+    srt = jnp.sort(jnp.where(finite, values, jnp.inf))
+    n = jnp.sum(finite.astype(jnp.int32))
+    i_lo = jnp.maximum((n - 1) // 2, 0)
+    i_hi = jnp.maximum(n // 2, 0)
+    ranks = jnp.arange(srt.shape[0])
+    ind = 0.5 * ((ranks == i_lo).astype(srt.dtype)
+                 + (ranks == i_hi).astype(srt.dtype))
+    med = jnp.sum(jnp.where(jnp.isfinite(srt), srt, 0.0) * ind)
+    return jnp.where(n > 0, med, 0.0)
+
+
+class Shield:
+    """One run's screening policy + counters.
+
+    Traced entry point is :meth:`screen`; the object itself is static
+    engine-build state (like the chaos flags): a config without a
+    ``robust`` block never constructs one, and the engine compiles the
+    exact pre-fluteshield program.
+    """
+
+    def __init__(self, screen_nonfinite: bool = True,
+                 norm_multiplier: Optional[float] = 5.0,
+                 aggregator: str = "mean",
+                 trim_fraction: float = 0.1):
+        if aggregator not in AGGREGATORS:
+            raise ValueError(
+                f"robust.aggregator must be one of {AGGREGATORS}, "
+                f"got {aggregator!r}")
+        if norm_multiplier is not None and float(norm_multiplier) < 1.0 \
+                and float(norm_multiplier) != 0.0:
+            raise ValueError(
+                "robust.norm_multiplier must be >= 1 (it scales the "
+                "median payload norm) or 0/absent to disable")
+        if not 0.0 <= float(trim_fraction) < 0.5:
+            raise ValueError(
+                "robust.trim_fraction must be in [0, 0.5) — trimming "
+                "half or more from each side leaves nothing to average")
+        self.screen_nonfinite = bool(screen_nonfinite)
+        self.norm_multiplier = (float(norm_multiplier)
+                                if norm_multiplier else 0.0)
+        self.aggregator = str(aggregator)
+        self.trim_fraction = float(trim_fraction)
+        #: run-level quarantine observability, accumulated by the server
+        #: from the packed round stats (the same discipline as
+        #: ``ChaosSchedule.counters``)
+        self.counters: Dict[str, float] = {
+            "quarantined_nonfinite": 0.0,
+            "quarantined_norm_outlier": 0.0,
+        }
+
+    # ------------------------------------------------------------------
+    @property
+    def wants_stack(self) -> bool:
+        """Whether the aggregator needs the per-client payload stack
+        materialized (trimmed mean / median cannot ride psum'd sums)."""
+        return self.aggregator in ("trimmed_mean", "median")
+
+    # ------------------------------------------------------------------
+    def screen(self, payload: Any, train_loss: jnp.ndarray,
+               weight: jnp.ndarray, client_mask: jnp.ndarray,
+               gather: Callable[[jnp.ndarray], jnp.ndarray]
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """TRACED: per-client quarantine decision for one round batch.
+
+        ``payload``: the ``[K, ...]``-leading per-client pseudo-gradient
+        tree (post strategy transform — what would actually aggregate);
+        ``train_loss``/``weight``: per-client ``[K]``; ``client_mask``:
+        live mask ``[K]`` (mesh padding + chaos dropout already folded).
+        ``gather``: assembles a shard-local ``[K_local]`` vector into the
+        full replicated ``[K]`` cohort (``all_gather`` over the clients
+        axis in shard_map mode, identity under GSPMD/jit).
+
+        Returns ``(keep [K] f32 in {0,1}, q_nonfinite [K],
+        q_norm_outlier [K])`` — the q vectors are disjoint per-cause
+        counts gated on ``client_mask`` (padding never counts).
+        """
+        k = client_mask.shape[0]
+        ones = jnp.ones((k,), bool)
+        finite = ones
+        if self.screen_nonfinite:
+            flags = [jnp.all(jnp.isfinite(
+                        leaf.reshape(leaf.shape[0], -1)), axis=1)
+                     for leaf in jax.tree.leaves(payload)
+                     if jnp.issubdtype(leaf.dtype, jnp.floating)]
+            flags.append(jnp.isfinite(train_loss))
+            flags.append(jnp.isfinite(weight))
+            for f in flags:
+                finite = finite & f
+        norm_ok = ones
+        if self.norm_multiplier > 0.0:
+            sq = sum(jnp.sum(leaf.reshape(leaf.shape[0], -1) ** 2, axis=1)
+                     for leaf in jax.tree.leaves(payload)
+                     if jnp.issubdtype(leaf.dtype, jnp.floating))
+            norms = jnp.sqrt(sq)
+            # only live, finite clients vote for the median — a NaN norm
+            # or a padding slot must not drag the threshold around
+            vote = client_mask * finite.astype(client_mask.dtype)
+            med = masked_median(gather(norms), gather(vote))
+            # degenerate all-zero cohort (round 0 freeze, empty round):
+            # no threshold rather than quarantining every non-zero norm
+            norm_ok = jnp.where(med > 0.0,
+                                norms <= self.norm_multiplier * med, True)
+        keep = finite & norm_ok
+        finite_f = finite.astype(client_mask.dtype)
+        q_nonfinite = client_mask * (1.0 - finite_f)
+        q_norm = client_mask * finite_f * \
+            (1.0 - norm_ok.astype(client_mask.dtype))
+        return keep.astype(client_mask.dtype), q_nonfinite, q_norm
+
+    # ------------------------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        """The bench-contract record: a shielded run can never be
+        silently compared against an undefended baseline."""
+        return {
+            "enabled": True,
+            "screen_nonfinite": self.screen_nonfinite,
+            "norm_multiplier": self.norm_multiplier,
+            "aggregator": self.aggregator,
+            "trim_fraction": self.trim_fraction,
+        }
+
+
+def make_shield(server_config) -> Optional[Shield]:
+    """Build the run's :class:`Shield` from ``server_config.robust``
+    (None when absent or ``enable: false`` — the firewall path)."""
+    raw = server_config.get("robust") if server_config is not None else None
+    if not raw:
+        return None
+    raw = dict(raw)
+    if not raw.pop("enable", True):
+        return None
+    return Shield(
+        screen_nonfinite=raw.get("screen_nonfinite", True),
+        norm_multiplier=raw.get("norm_multiplier", 5.0),
+        aggregator=raw.get("aggregator", "mean"),
+        trim_fraction=raw.get("trim_fraction", 0.1),
+    )

@@ -121,6 +121,30 @@ SERVER_REPLAY_KEYS = {"server_iterations", "optimizer_config", "data_config"}
 CHAOS_KEYS = {
     "enable", "seed", "dropout_rate", "straggler_rate",
     "straggler_inflation", "ckpt_io_error_rate", "preempt_at_round",
+    # adversarial update-corruption streams (fluteshield's attack half,
+    # resilience/chaos.py corrupt_modes)
+    "corrupt_nan_rate", "corrupt_scale_rate", "corrupt_sign_flip_rate",
+    "corrupt_scale_factor", "corrupt_sign_flip_scale",
+}
+
+ROBUST_KEYS = {
+    "enable", "screen_nonfinite", "norm_multiplier", "aggregator",
+    "trim_fraction",
+}
+
+#: robust aggregator vocabulary (mirrors robust.shield.AGGREGATORS)
+ALLOWED_ROBUST_AGGREGATORS = ["mean", "trimmed_mean", "median"]
+
+ROBUST_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    "screen_nonfinite": ("bool", None, None),
+    # scales the cohort's median payload norm; 0 disables the norm
+    # screen.  The (0, 1) gap is rejected by a bespoke check in
+    # validate() — the inclusive range table cannot express {0} ∪ [1,∞)
+    "norm_multiplier": ("num", 0.0, None),
+    # per-side trim; == 0.5 (nothing left to average) is rejected by a
+    # bespoke check in validate() — the range table is inclusive
+    "trim_fraction": ("num", 0.0, 0.5),
 }
 
 CHECKPOINT_RETRY_KEYS = {
@@ -135,6 +159,7 @@ TELEMETRY_KEYS = {
 WATCHDOG_KEYS = {
     "nan_loss", "round_time_action", "round_time_factor",
     "round_time_window", "ckpt_failure_action", "ckpt_failure_streak",
+    "quarantine_rate_action", "quarantine_rate_threshold",
 }
 
 TELEMETRY_FIELD_SPECS = {
@@ -150,6 +175,8 @@ WATCHDOG_FIELD_SPECS = {
     "round_time_factor": ("num", 1.0, None),
     "round_time_window": ("int", 4, None),
     "ckpt_failure_streak": ("int", 1, None),
+    # fluteshield: fraction of the live cohort quarantined in one round
+    "quarantine_rate_threshold": ("num", 0.0, 1.0),
 }
 
 #: watchdog detector actions (telemetry/watchdog.py ACTIONS)
@@ -164,6 +191,13 @@ CHAOS_FIELD_SPECS = {
     "straggler_inflation": ("num", 1.0, None),
     "ckpt_io_error_rate": ("num", 0.0, 1.0),
     "preempt_at_round": ("int", 0, None),
+    "corrupt_nan_rate": ("num", 0.0, 1.0),
+    "corrupt_scale_rate": ("num", 0.0, 1.0),
+    "corrupt_sign_flip_rate": ("num", 0.0, 1.0),
+    # the multiplier a scaling attacker applies (also useful < 1 to
+    # rehearse shrink attacks); strictly positive
+    "corrupt_scale_factor": ("num", 0.0, None),
+    "corrupt_sign_flip_scale": ("num", 0.0, None),
 }
 
 CHECKPOINT_RETRY_FIELD_SPECS = {
@@ -218,6 +252,11 @@ SERVER_KEYS = {
     # and the NaN/round-time/checkpoint watchdogs — default off, zero
     # overhead when absent (docs/observability.md)
     "telemetry",
+    # fluteshield screened aggregation: on-device NaN/Inf + norm-outlier
+    # quarantine and Byzantine-robust aggregators (trimmed mean /
+    # median) — default off; disabled is bit-identical to pre-fluteshield
+    # behavior (docs/config_extensions.md)
+    "robust",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
@@ -539,6 +578,60 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                            CHAOS_KEYS)
             _check_fields(errors, chaos, "server_config.chaos",
                           CHAOS_FIELD_SPECS)
+            # the spec table's ranges are inclusive; ChaosSchedule
+            # requires these strictly positive, and the validation layer
+            # must not bless a config the constructor will refuse
+            for key in ("corrupt_scale_factor", "corrupt_sign_flip_scale"):
+                val = chaos.get(key)
+                if isinstance(val, (int, float)) and \
+                        not isinstance(val, bool) and float(val) == 0.0:
+                    errors.append(
+                        f"server_config.chaos.{key}: must be > 0")
+        robust = sc.get("robust")
+        if robust is not None and not isinstance(robust, dict):
+            errors.append(
+                "server_config.robust: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(robust).__name__}")
+        if isinstance(robust, dict):
+            _check_unknown(unknown, robust, "server_config.robust",
+                           ROBUST_KEYS)
+            _check_fields(errors, robust, "server_config.robust",
+                          ROBUST_FIELD_SPECS)
+            _check_enum(errors, robust, "server_config.robust",
+                        "aggregator", ALLOWED_ROBUST_AGGREGATORS)
+            # valid domain is {0} ∪ [1, inf) — a union the inclusive
+            # spec table cannot express; Shield.__init__ enforces the
+            # same invariant, this keeps config load from blessing a
+            # value server construction will refuse
+            nm = robust.get("norm_multiplier")
+            if isinstance(nm, (int, float)) and not isinstance(nm, bool) \
+                    and 0.0 < float(nm) < 1.0:
+                errors.append(
+                    "server_config.robust.norm_multiplier: must be >= 1 "
+                    "(it scales the cohort's median payload norm; < 1 "
+                    "would quarantine the median client itself) or 0 to "
+                    "disable the norm screen")
+            # the range table is inclusive but Shield requires < 0.5
+            tf = robust.get("trim_fraction")
+            if isinstance(tf, (int, float)) and not isinstance(tf, bool) \
+                    and float(tf) == 0.5:
+                errors.append(
+                    "server_config.robust.trim_fraction: must be < 0.5 "
+                    "— trimming half or more from each side leaves "
+                    "nothing to average")
+            # quiet-failure rule (the secure_agg/fedbuff discipline): a
+            # robust block under a strategy whose combine it cannot
+            # screen means the user believes the cohort is defended
+            # while poisoned payloads aggregate untouched
+            if robust.get("enable", True) and \
+                    str(strategy or "fedavg").lower() not in (
+                        "fedavg", "fedprox"):
+                errors.append(
+                    "server_config.robust is set but strategy is "
+                    f"{strategy!r} — screened aggregation plugs into the "
+                    "fedavg/fedprox combine only; payloads would "
+                    "aggregate UNSCREENED")
         ckpt_retry = sc.get("checkpoint_retry")
         if isinstance(ckpt_retry, dict):
             _check_unknown(unknown, ckpt_retry,
@@ -584,7 +677,8 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                               "server_config.telemetry.watchdog",
                               WATCHDOG_FIELD_SPECS)
                 for key in ("nan_loss", "round_time_action",
-                            "ckpt_failure_action"):
+                            "ckpt_failure_action",
+                            "quarantine_rate_action"):
                     _check_enum(errors, wd,
                                 "server_config.telemetry.watchdog", key,
                                 ALLOWED_WATCHDOG_ACTIONS)

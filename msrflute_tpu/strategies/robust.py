@@ -1,0 +1,163 @@
+"""Byzantine-robust aggregation (fluteshield's aggregator half).
+
+Coordinate-wise trimmed mean and coordinate-wise median (Yin et al.,
+arXiv:1803.01498) over the SCREENED per-client payload stack, selectable
+via ``server_config.robust.aggregator``.  Unlike every other strategy in
+this package, these estimators are not decomposable into the engine's
+weighted ``psum`` — each coordinate needs the full sorted cohort — so
+:class:`RobustFedAvg` sets ``wants_client_stack`` and the round program
+``all_gather``s the sanitized per-client payloads (``[K, ...]`` per
+leaf, replicated) before combining.  That is the estimator's inherent
+memory cost: K x model size per device, the same order the RL/norm-dump
+paths already pay; it stays inside the fused program, so the one-packed-
+fetch-per-round and strict-transfer contracts hold unchanged.
+
+Both estimators are UNWEIGHTED over the kept clients (the literature's
+setting: sample-count weighting would let an adversary buy influence by
+claiming samples).  FedAvg's weighted mean remains available as
+``aggregator: mean`` — screening only.
+
+All functions here are pure traced code composed into the jitted round
+program; masked clients are excluded by rank against ``+inf`` sentinels
+(never a ``0 * inf`` multiply, which would mint NaNs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import BaseStrategy
+from .fedavg import FedAvg
+
+
+def _rank_shape(g: jnp.ndarray) -> Tuple[int, ...]:
+    return (g.shape[0],) + (1,) * (g.ndim - 1)
+
+
+def coordinate_trimmed_mean(stack: Any, keep: jnp.ndarray,
+                            trim_fraction: float) -> Any:
+    """Coordinate-wise ``trim_fraction``-trimmed mean over the kept
+    clients of a ``[K, ...]``-leading payload stack.
+
+    ``keep [K]``: 1 for clients that participate (live AND unscreened).
+    Per coordinate: masked AND non-finite entries sort to the top as
+    ``+inf``, the finite kept entries occupy ranks ``[0, n)``, and ranks
+    ``[t, n - t)`` average, with ``t = floor(trim_fraction * n)``.  The
+    finite check must happen BEFORE the sort: ``jnp.sort`` ranks NaN
+    above ``+inf``, so a kept NaN coordinate (screening off) would
+    otherwise push a sentinel into the averaged window.  ``n`` is a
+    traced per-coordinate count, so a round with a different live count
+    reuses the same compiled program; an all-non-finite coordinate
+    contributes zero (a no-op for that coordinate).
+    """
+    def leaf(g):
+        live = keep.reshape(_rank_shape(g)) > 0
+        part = live & jnp.isfinite(g)
+        n = jnp.sum(part, axis=0, keepdims=True).astype(g.dtype)
+        t = jnp.floor(trim_fraction * n)
+        denom = jnp.maximum(n - 2.0 * t, 1.0)
+        srt = jnp.sort(jnp.where(part, g, jnp.inf), axis=0)
+        ranks = jnp.arange(g.shape[0]).reshape(_rank_shape(g))
+        ind = (ranks >= t) & (ranks < n - t)
+        return (jnp.sum(jnp.where(ind, srt, 0.0), axis=0)
+                / jnp.squeeze(denom, axis=0))
+
+    return jax.tree.map(leaf, stack)
+
+
+def coordinate_median(stack: Any, keep: jnp.ndarray) -> Any:
+    """Coordinate-wise median over the finite kept clients of a
+    ``[K, ...]`` stack (even counts interpolate the two middle ranks).
+    Non-finite kept coordinates are excluded per coordinate BEFORE the
+    sort (NaN ranks above ``+inf``, so it cannot be excluded after); an
+    empty vote yields zero for that coordinate (a no-op server step),
+    matching the weighted-mean path's ``max(weight_sum, eps)``
+    behavior."""
+    def leaf(g):
+        live = keep.reshape(_rank_shape(g)) > 0
+        part = live & jnp.isfinite(g)
+        n = jnp.sum(part.astype(jnp.int32), axis=0, keepdims=True)
+        i_lo = jnp.maximum((n - 1) // 2, 0)
+        i_hi = jnp.maximum(n // 2, 0)
+        srt = jnp.sort(jnp.where(part, g, jnp.inf), axis=0)
+        ranks = jnp.arange(g.shape[0]).reshape(_rank_shape(g))
+        ind = 0.5 * ((ranks == i_lo).astype(g.dtype)
+                     + (ranks == i_hi).astype(g.dtype))
+        med = jnp.sum(jnp.where(ind > 0, srt, 0.0) * ind, axis=0)
+        return jnp.where(jnp.squeeze(n, axis=0) > 0, med,
+                         jnp.zeros_like(med))
+
+    return jax.tree.map(leaf, stack)
+
+
+class RobustFedAvg(FedAvg):
+    """FedAvg plumbing with a Byzantine-robust combine.
+
+    Client side is UNCHANGED (local SGD, DP transform, privacy metrics,
+    strategy weights) — the robustness is entirely in how the cohort's
+    payload stack reduces.  The engine detects ``wants_client_stack``
+    and calls :meth:`combine_stack` on the gathered, screened stack
+    instead of :meth:`combine` on the psum'd sums.
+    """
+
+    wants_client_stack = True
+    # the payload stack reduces as one cohort; deferring a slice of it a
+    # round (DGA staleness) or re-weighting it post hoc (RL) would
+    # reintroduce exactly the single-client leverage this estimator
+    # removes
+    supports_staleness = False
+    supports_rl = False
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        raw = dict(config.server_config.get("robust") or {})
+        self.aggregator = str(raw.get("aggregator", "mean"))
+        self.trim_fraction = float(raw.get("trim_fraction", 0.1))
+        if self.aggregator not in ("trimmed_mean", "median"):
+            raise ValueError(
+                "RobustFedAvg is the stack-combining strategy — "
+                f"aggregator {self.aggregator!r} does not need it "
+                "(screened mean rides the plain FedAvg sum path)")
+        if self.adaptive_clip is not None:
+            raise ValueError(
+                "dp_config.adaptive_clipping tracks its quantile through "
+                "the weighted-sum combine, which a robust aggregator "
+                "bypasses — disable one of them")
+
+    def combine_stack(self, stack: Any, keep: jnp.ndarray,
+                      rng: jax.Array) -> Any:
+        """TRACED: reduce the gathered ``[K, ...]`` payload stack to the
+        aggregate pseudo-gradient.  ``keep`` is the live-and-unscreened
+        mask the round program folded (padding, chaos dropout, and
+        quarantine are all already zeros)."""
+        if self.aggregator == "median":
+            return coordinate_median(stack, keep)
+        return coordinate_trimmed_mean(stack, keep, self.trim_fraction)
+
+
+def select_robust_strategy(config, dp_config, base_cls) -> BaseStrategy:
+    """Server-side selection: swap FedAvg for :class:`RobustFedAvg` when
+    ``server_config.robust`` asks for a stack aggregator.  Non-FedAvg
+    strategies are refused loudly (schema enforces this too) — silently
+    aggregating unscreened payloads under a ``robust`` block is the
+    quiet failure fluteshield exists to prevent."""
+    raw = dict(config.server_config.get("robust") or {})
+    if not raw or not raw.get("enable", True):
+        return base_cls(config, dp_config)
+    # exact-class check: every specialised strategy (SecureAgg, QFFL,
+    # FedBuff, Scaffold, EFQuant, ...) SUBCLASSES FedAvg but aggregates
+    # through its own payload parts / reweighting, which screening and
+    # the stack combine would silently corrupt — issubclass would wave
+    # them all through when the schema layer is bypassed
+    if base_cls is not FedAvg:
+        raise ValueError(
+            "server_config.robust requires strategy: fedavg/fedprox — "
+            f"{base_cls.__name__} aggregates through its own parts and "
+            "would ignore the screening; drop the robust block or the "
+            "strategy")
+    if str(raw.get("aggregator", "mean")) in ("trimmed_mean", "median"):
+        return RobustFedAvg(config, dp_config)
+    return base_cls(config, dp_config)

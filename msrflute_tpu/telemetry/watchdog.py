@@ -11,7 +11,14 @@ escalator's consecutive-failure count; never a device read):
   reaching ``ckpt_failure_streak`` (reads the
   :class:`~msrflute_tpu.resilience.integrity.FailureEscalator` counter —
   this fires WARNINGS well before the escalator's own abort threshold
-  would kill the run).
+  would kill the run);
+- **quarantine_rate** — the fluteshield-quarantined fraction of a
+  round's live cohort exceeds ``quarantine_rate_threshold``.  A few
+  quarantined clients is the defense working; most of the cohort
+  quarantined means the GLOBAL model is what's diverging (every honest
+  client returns garbage) — the distinction between "screen and carry
+  on" and "stop the run".  Fed only when ``server_config.robust``
+  screening is on (the fraction rides the packed round stats).
 
 Each detector has a configurable action (``server_config.telemetry.
 watchdog``): ``off`` | ``log`` (event only) | ``mark`` (event + durable
@@ -35,6 +42,8 @@ _DEFAULTS = {
     "round_time_window": 16,
     "ckpt_failure_action": "mark",
     "ckpt_failure_streak": 3,
+    "quarantine_rate_action": "mark",
+    "quarantine_rate_threshold": 0.5,
 }
 
 
@@ -55,7 +64,8 @@ class Watchdog:
         raw = dict(raw or {})
         cfg = dict(_DEFAULTS)
         cfg.update({k: raw[k] for k in _DEFAULTS if k in raw})
-        for key in ("nan_loss", "round_time_action", "ckpt_failure_action"):
+        for key in ("nan_loss", "round_time_action", "ckpt_failure_action",
+                    "quarantine_rate_action"):
             if cfg[key] not in ACTIONS:
                 raise ValueError(
                     f"telemetry.watchdog.{key}: {cfg[key]!r} not in "
@@ -73,13 +83,24 @@ class Watchdog:
     def observe_round(self, round_no: int,
                       train_loss: Optional[float] = None,
                       round_secs: Optional[float] = None,
-                      ckpt_failures: int = 0) -> None:
+                      ckpt_failures: int = 0,
+                      quarantine_frac: Optional[float] = None) -> None:
         """Feed one completed round's host-side observations; applies
         every enabled detector and its configured action."""
         if train_loss is not None and self.cfg["nan_loss"] != "off" and \
                 not math.isfinite(float(train_loss)):
             self._fire("nan_loss", self.cfg["nan_loss"],
                        round=round_no, train_loss=float(train_loss))
+        if quarantine_frac is not None and \
+                self.cfg["quarantine_rate_action"] != "off":
+            thresh = float(self.cfg["quarantine_rate_threshold"])
+            if float(quarantine_frac) > thresh:
+                self._fire("quarantine_rate",
+                           self.cfg["quarantine_rate_action"],
+                           round=round_no,
+                           quarantined_frac=round(float(quarantine_frac),
+                                                  4),
+                           threshold=thresh)
         if round_secs is not None and \
                 self.cfg["round_time_action"] != "off":
             factor = float(self.cfg["round_time_factor"])

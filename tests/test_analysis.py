@@ -411,9 +411,12 @@ def test_schema_drift_covers_chaos_and_checkpoint_retry_specs(tmp_path):
     pkg.mkdir(parents=True)
     (pkg / "schema.py").write_text(
         "SERVER_KEYS = {'max_iteration', 'chaos', 'checkpoint_retry'}\n"
-        "CHAOS_KEYS = {'seed', 'dropout_rate'}\n"
+        # corrupt_nan_rate present in both sets (the PR 5 corruption keys
+        # ride the same coverage contract); ghost_rate only in the specs
+        "CHAOS_KEYS = {'seed', 'dropout_rate', 'corrupt_nan_rate'}\n"
         "CHECKPOINT_RETRY_KEYS = {'retries'}\n"
         "CHAOS_FIELD_SPECS = {'dropout_rate': ('num', 0, 1),"
+        " 'corrupt_nan_rate': ('num', 0, 1),"
         " 'ghost_rate': ('num', 0, 1)}\n"
         "CHECKPOINT_RETRY_FIELD_SPECS = {'retries': ('int', 1, None)}\n")
     (pkg / "config.py").write_text(
@@ -645,3 +648,43 @@ def test_schema_drift_flags_undocumented_telemetry_knob(tmp_path):
     found = check_project(str(tmp_path), documented_knobs=("telemetry",))
     assert [f.rule for f in found] == ["schema-drift"]
     assert "telemetry" in found[0].message
+
+
+def test_schema_drift_covers_robust_specs(tmp_path):
+    """PR 5 corpus: the fluteshield block's field specs are drift-checked
+    like the chaos/telemetry sections — a ROBUST_FIELD_SPECS rule for a
+    key the unknown-key pass doesn't know is dead and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'robust'}\n"
+        "ROBUST_KEYS = {'enable', 'norm_multiplier'}\n"
+        "ROBUST_FIELD_SPECS = {'norm_multiplier': ('num', 0, None),"
+        " 'ghost_multiplier': ('num', 0, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.robust` is the fluteshield block.")
+    found = check_project(str(tmp_path), documented_knobs=("robust",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "ghost_multiplier" in found[0].message
+    assert "ROBUST_KEYS" in found[0].message
+
+
+def test_schema_drift_flags_undocumented_robust_knob(tmp_path):
+    """An operator who cannot find the screened-aggregation knob in the
+    runbook learns about poisoned cohorts from a diverged model."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'robust'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text("no defense documented here")
+    found = check_project(str(tmp_path), documented_knobs=("robust",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "robust" in found[0].message

@@ -1,0 +1,587 @@
+"""fluteshield: screened aggregation + robust aggregators (ISSUE 5).
+
+Contracts pinned here:
+
+- **Firewall**: ``robust: {enable: false}`` (and no block at all) is
+  bit-identical to pre-fluteshield behavior — serial AND pipelined —
+  the chaos zero-rate discipline applied to the defense layer;
+- **Zero-cost**: screening + quarantine counters add no implicit host
+  materializations and keep the one-packed-fetch-per-round guard under
+  ``MSRFLUTE_STRICT_TRANSFERS=1`` (the ArrayImpl interception harness
+  from the PR 2/4 contracts);
+- **Determinism**: quarantine counters are a pure function of
+  ``(seed, stream, round)`` + the data — identical serial vs pipelined;
+- **End-to-end defense**: under seeded NaN-injection + sign-flip chaos
+  on a meaningful cohort fraction, screened-mean and trimmed-mean runs
+  reach near-clean final val loss while undefended FedAvg goes
+  non-finite;
+- the coordinate-wise estimators match their numpy references, the
+  eval-side non-finite guard keeps poisoned clients out of
+  ``best_val``/plateau state, and the ``quarantine_rate`` watchdog
+  fires per its action enum.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.models import make_task
+from msrflute_tpu.robust import masked_median
+from msrflute_tpu.robust.shield import Shield
+from msrflute_tpu.schema import SchemaError
+from msrflute_tpu.strategies.robust import (coordinate_median,
+                                            coordinate_trimmed_mean)
+
+
+def _cfg(robust=None, chaos=None, depth=1, rounds=5, extra_sc=None):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": 6,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "val_freq": 100, "initial_val": False, "data_config": {},
+    }
+    if robust is not None:
+        sc["robust"] = robust
+    if chaos is not None:
+        sc["chaos"] = chaos
+    if extra_sc:
+        sc.update(extra_sc)
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _run(synth_dataset, tmp_path, tag, val_dataset=None, **kw):
+    from jax.flatten_util import ravel_pytree
+
+    cfg = _cfg(**kw)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                synth_dataset, val_dataset=val_dataset,
+                                model_dir=str(tmp_path / tag), seed=7)
+    state = server.train()
+    flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return server, flat
+
+
+def _val_loss(server) -> float:
+    """Final val loss of a finished server over a clean eval split."""
+    from msrflute_tpu.engine.evaluation import evaluate
+
+    metrics = evaluate(server.task, server._eval_fn, server.state.params,
+                       server._packed_eval_batches("val"), server.mesh,
+                       server.engine.partition_mode)
+    return float(metrics["loss"].value)
+
+
+# the attack: 2 of ~6 sampled clients corrupted per round on average
+ATTACK = {"seed": 11, "corrupt_nan_rate": 0.2,
+          "corrupt_sign_flip_rate": 0.15}
+
+
+# ======================================================================
+# estimator units (numpy references)
+# ======================================================================
+def test_masked_median_matches_numpy():
+    vals = jnp.asarray([5.0, 1.0, 9.0, 3.0, 7.0, 100.0])
+    mask = jnp.asarray([1.0, 1.0, 1.0, 1.0, 1.0, 0.0])  # 100 masked out
+    assert float(masked_median(vals, mask)) == 5.0
+    # even count interpolates
+    mask2 = jnp.asarray([1.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+    assert float(masked_median(vals, mask2)) == 4.0
+    # NaN entries are excluded even when their mask is live
+    vals3 = vals.at[0].set(jnp.nan)
+    assert float(masked_median(vals3, mask)) == 5.0
+    # empty vote -> 0 (caller disables the screen)
+    assert float(masked_median(vals, jnp.zeros(6))) == 0.0
+
+
+def test_coordinate_trimmed_mean_matches_numpy():
+    rng = np.random.default_rng(0)
+    stack = {"w": rng.normal(size=(10, 3, 2)).astype(np.float32),
+             "b": rng.normal(size=(10, 4)).astype(np.float32)}
+    keep = np.ones(10, np.float32)
+    keep[7:] = 0.0  # 3 masked clients
+    out = coordinate_trimmed_mean(
+        jax.tree.map(jnp.asarray, stack), jnp.asarray(keep), 0.2)
+    # numpy reference: per coordinate, sort the 7 kept, drop
+    # floor(.2*7)=1 from each side, average the middle 5
+    for key in stack:
+        kept = stack[key][:7]
+        srt = np.sort(kept, axis=0)
+        ref = srt[1:6].mean(axis=0)
+        np.testing.assert_allclose(np.asarray(out[key]), ref, rtol=1e-5)
+
+
+def test_coordinate_median_matches_numpy():
+    rng = np.random.default_rng(1)
+    stack = {"w": rng.normal(size=(9, 5)).astype(np.float32)}
+    keep = np.ones(9, np.float32)
+    keep[6:] = 0.0  # 6 kept -> even count interpolates
+    out = coordinate_median(jax.tree.map(jnp.asarray, stack),
+                            jnp.asarray(keep))
+    ref = np.median(stack["w"][:6], axis=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), ref, rtol=1e-5)
+    # masked/NaN rows must not shift any coordinate
+    stack["w"][7] = np.nan
+    out2 = coordinate_median(jax.tree.map(jnp.asarray, stack),
+                             jnp.asarray(keep))
+    np.testing.assert_allclose(np.asarray(out2["w"]), ref, rtol=1e-5)
+
+
+def test_stack_estimators_survive_kept_nonfinite_clients():
+    # screening OFF is a schema-valid config, so a KEPT client may carry
+    # NaN/Inf payloads; jnp.sort ranks NaN above the +inf mask sentinels,
+    # so the finite check must happen before the sort or a sentinel
+    # slides into the rank window and the aggregate goes inf/NaN
+    vals = np.array([1.0, 1.0, 1.0, 1.0, 1.0, np.nan, 7.0],
+                    np.float32)
+    keep = np.array([1, 1, 1, 1, 1, 1, 0], np.float32)  # NaN client KEPT
+    stack = {"w": jnp.asarray(vals)[:, None]}
+    out_tm = coordinate_trimmed_mean(stack, jnp.asarray(keep), 0.1)
+    np.testing.assert_allclose(np.asarray(out_tm["w"]), [1.0],
+                               rtol=1e-6)
+    out_med = coordinate_median(stack, jnp.asarray(keep))
+    np.testing.assert_allclose(np.asarray(out_med["w"]), [1.0],
+                               rtol=1e-6)
+    # inf payloads are excluded by the same per-coordinate finite vote
+    vals[5] = np.inf
+    out_inf = coordinate_trimmed_mean({"w": jnp.asarray(vals)[:, None]},
+                                      jnp.asarray(keep), 0.1)
+    np.testing.assert_allclose(np.asarray(out_inf["w"]), [1.0],
+                               rtol=1e-6)
+    # an all-non-finite coordinate contributes zero, not inf/NaN
+    allbad = {"w": jnp.asarray(np.full((4, 1), np.nan, np.float32))}
+    k4 = jnp.ones(4, jnp.float32)
+    assert float(coordinate_trimmed_mean(allbad, k4, 0.1)["w"][0]) == 0.0
+    assert float(coordinate_median(allbad, k4)["w"][0]) == 0.0
+
+
+def test_shield_validates_config():
+    with pytest.raises(ValueError, match="aggregator"):
+        Shield(aggregator="krum")
+    with pytest.raises(ValueError, match="trim_fraction"):
+        Shield(trim_fraction=0.5)
+    with pytest.raises(ValueError, match="norm_multiplier"):
+        Shield(norm_multiplier=0.5)
+    assert Shield(norm_multiplier=None).norm_multiplier == 0.0
+    assert Shield(aggregator="median").wants_stack
+
+
+# ======================================================================
+# corruption schedule units
+# ======================================================================
+def test_corrupt_modes_deterministic_and_partitioned():
+    from msrflute_tpu.resilience.chaos import (CORRUPT_NAN, CORRUPT_SCALE,
+                                               CORRUPT_SIGN_FLIP,
+                                               ChaosSchedule)
+
+    a = ChaosSchedule(seed=5, corrupt_nan_rate=0.3, corrupt_scale_rate=0.3,
+                      corrupt_sign_flip_rate=0.3)
+    b = ChaosSchedule(seed=5, corrupt_nan_rate=0.3, corrupt_scale_rate=0.3,
+                      corrupt_sign_flip_rate=0.3)
+    for r in (0, 3, 17):
+        np.testing.assert_array_equal(a.corrupt_modes(r, 64),
+                                      b.corrupt_modes(r, 64))
+    modes = a.corrupt_modes(0, 4096)
+    assert set(np.unique(modes)) <= {0, CORRUPT_NAN, CORRUPT_SCALE,
+                                     CORRUPT_SIGN_FLIP}
+    # each mode fires roughly at its rate (one partitioned draw)
+    for mode in (CORRUPT_NAN, CORRUPT_SCALE, CORRUPT_SIGN_FLIP):
+        frac = float((modes == mode).mean())
+        assert 0.2 < frac < 0.4, (mode, frac)
+    # corruption draws ride their OWN stream: enabling them must not
+    # move an existing dropout schedule
+    plain = ChaosSchedule(seed=5, dropout_rate=0.5)
+    mask = np.ones((8, 2, 2), np.float32)
+    d0, _ = plain.client_faults(3, mask)
+    d1, _ = ChaosSchedule(seed=5, dropout_rate=0.5,
+                          corrupt_nan_rate=0.3).client_faults(3, mask)
+    np.testing.assert_array_equal(d0, d1)
+
+
+def test_corruption_rate_validation():
+    from msrflute_tpu.resilience.chaos import ChaosSchedule
+
+    with pytest.raises(ValueError, match="corrupt_nan_rate"):
+        ChaosSchedule(corrupt_nan_rate=1.5)
+    with pytest.raises(ValueError, match="sum to <= 1"):
+        ChaosSchedule(corrupt_nan_rate=0.5, corrupt_scale_rate=0.4,
+                      corrupt_sign_flip_rate=0.2)
+    with pytest.raises(ValueError, match="corrupt_scale_factor"):
+        ChaosSchedule(corrupt_scale_factor=0.0)
+
+
+# ======================================================================
+# firewall: disabled robust is bit-identical, serial AND pipelined
+# ======================================================================
+@pytest.mark.parametrize("depth", [0, 1])
+def test_robust_disabled_is_bit_identical(synth_dataset, tmp_path, depth):
+    _, base = _run(synth_dataset, tmp_path, f"base{depth}", depth=depth)
+    _, off = _run(synth_dataset, tmp_path, f"off{depth}", depth=depth,
+                  robust={"enable": False})
+    np.testing.assert_array_equal(base, off)
+
+
+# ======================================================================
+# determinism: quarantine identical serial vs pipelined
+# ======================================================================
+def test_quarantine_deterministic_and_pipeline_invariant(synth_dataset,
+                                                         tmp_path):
+    chaos = dict(ATTACK, corrupt_scale_rate=0.15, corrupt_scale_factor=50.0)
+    robust = {"norm_multiplier": 4.0}
+    srv_p, flat_p = _run(synth_dataset, tmp_path, "p", robust=dict(robust),
+                         chaos=dict(chaos), depth=1)
+    srv_s, flat_s = _run(synth_dataset, tmp_path, "s", robust=dict(robust),
+                         chaos=dict(chaos), depth=0)
+    assert srv_p.shield.counters["quarantined_nonfinite"] > 0
+    assert srv_p.shield.counters["quarantined_norm_outlier"] > 0
+    assert srv_p.shield.counters == srv_s.shield.counters
+    assert srv_p.chaos.counters == srv_s.chaos.counters
+    np.testing.assert_array_equal(flat_p, flat_s)
+    # the counters rode the packed stats: the slot table carries them
+    packer = next(iter(srv_p.engine._stats_packers.values()))
+    stats = packer.unpack_np({dt: np.zeros(n, dtype=dt)
+                              for dt, n in packer.sizes.items()})
+    assert "shield_nonfinite" in stats
+    assert "shield_norm_outlier" in stats
+    assert "chaos_nan_injected" in stats
+
+
+# ======================================================================
+# zero-cost: no implicit syncs, one packed fetch per round
+# ======================================================================
+def test_robust_zero_implicit_syncs_one_fetch_per_round(tmp_path,
+                                                        monkeypatch,
+                                                        synth_dataset):
+    import jax._src.array as jarray
+
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    cfg = _cfg(robust={"norm_multiplier": 4.0,
+                       "aggregator": "trimmed_mean"},
+               chaos=dict(ATTACK), depth=1, rounds=3)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                synth_dataset,
+                                model_dir=str(tmp_path), seed=0)
+
+    sanctioned = threading.local()
+    real_get = jax.device_get
+    fetches = []
+    implicit = []
+    train_thread = threading.current_thread()
+    real_value = jarray.ArrayImpl._value
+    real_array = jarray.ArrayImpl.__array__
+
+    def sanctioning_get(x):
+        if threading.current_thread() is train_thread:
+            fetches.append(len(jax.tree.leaves(x)))
+        sanctioned.on = True
+        try:
+            return real_get(x)
+        finally:
+            sanctioned.on = False
+
+    def spy_value(self):
+        if not getattr(sanctioned, "on", False) and \
+                threading.current_thread() is train_thread:
+            implicit.append("_value")
+        return real_value.fget(self)
+
+    def spy_array(self, *args, **kwargs):
+        if not getattr(sanctioned, "on", False) and \
+                threading.current_thread() is train_thread:
+            implicit.append("__array__")
+        return real_array(self, *args, **kwargs)
+
+    monkeypatch.setattr(jax, "device_get", sanctioning_get)
+    monkeypatch.setattr(jarray.ArrayImpl, "_value", property(spy_value))
+    monkeypatch.setattr(jarray.ArrayImpl, "__array__", spy_array)
+    try:
+        state = server.train()
+    finally:
+        monkeypatch.setattr(jarray.ArrayImpl, "_value", real_value)
+        monkeypatch.setattr(jarray.ArrayImpl, "__array__", real_array)
+        monkeypatch.setattr(jax, "device_get", real_get)
+
+    assert state.round == 3
+    assert implicit == [], (
+        f"fluteshield run performed implicit host syncs: {implicit}")
+    assert server.pipelined_chunks > 0
+    assert fetches == [1, 1, 1], fetches
+
+
+# ======================================================================
+# the acceptance: defended runs converge where plain FedAvg degrades
+# ======================================================================
+def test_defense_end_to_end(synth_dataset, tmp_path):
+    from tests.conftest import make_synthetic_classification
+
+    val = make_synthetic_classification(num_users=4, seed=1)
+    rounds = 8
+
+    clean = _run(synth_dataset, tmp_path, "clean", rounds=rounds,
+                 val_dataset=val)
+    clean_loss = _val_loss(clean[0])
+
+    undefended = _run(synth_dataset, tmp_path, "undef", rounds=rounds,
+                      val_dataset=val, chaos=dict(ATTACK))
+    undef_loss = _val_loss(undefended[0])
+
+    screened = _run(synth_dataset, tmp_path, "screen", rounds=rounds,
+                    val_dataset=val, chaos=dict(ATTACK),
+                    robust={"norm_multiplier": 4.0, "aggregator": "mean"})
+    screened_loss = _val_loss(screened[0])
+
+    trimmed = _run(synth_dataset, tmp_path, "trim", rounds=rounds,
+                   val_dataset=val, chaos=dict(ATTACK),
+                   robust={"norm_multiplier": 4.0,
+                           "aggregator": "trimmed_mean",
+                           "trim_fraction": 0.2})
+    trimmed_loss = _val_loss(trimmed[0])
+
+    # undefended FedAvg measurably degrades: the first NaN-injected
+    # client poisons the aggregate and the model never recovers
+    assert not np.isfinite(undef_loss), undef_loss
+    assert not np.isfinite(undefended[1]).all()
+    # the defended arms stay finite and land near the clean loss
+    assert np.isfinite(screened[1]).all()
+    assert np.isfinite(trimmed[1]).all()
+    assert screened_loss <= clean_loss * 1.5 + 0.1, \
+        (screened_loss, clean_loss)
+    assert trimmed_loss <= clean_loss * 1.5 + 0.1, \
+        (trimmed_loss, clean_loss)
+    # and the defense actually fired
+    assert screened[0].shield.counters["quarantined_nonfinite"] > 0
+    assert trimmed[0].shield.counters["quarantined_nonfinite"] > 0
+
+
+def test_median_aggregator_end_to_end(synth_dataset, tmp_path):
+    srv, flat = _run(synth_dataset, tmp_path, "median", rounds=4,
+                     chaos=dict(ATTACK),
+                     robust={"aggregator": "median"})
+    assert np.isfinite(flat).all()
+    assert srv.shield.counters["quarantined_nonfinite"] > 0
+
+
+# ======================================================================
+# guardrails
+# ======================================================================
+def test_robust_block_refused_for_non_fedavg_strategy():
+    with pytest.raises(SchemaError, match="UNSCREENED"):
+        FLUTEConfig.from_dict({
+            "model_config": {"model_type": "LR", "num_classes": 4,
+                             "input_dim": 8},
+            "strategy": "qffl",
+            "server_config": {"robust": {"norm_multiplier": 4.0}},
+        })
+    # a disabled block under another strategy is inert, not an error
+    FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "qffl",
+        "server_config": {"robust": {"enable": False}},
+    })
+
+
+def test_schema_matches_constructor_invariants():
+    # config load must refuse exactly what Shield.__init__ and
+    # ChaosSchedule.__init__ refuse — the inclusive range table can't
+    # express norm_multiplier's {0} ∪ [1, ∞) domain or the strictly
+    # positive corrupt scales, so bespoke checks cover the gap
+    base = {"model_config": {"model_type": "LR", "num_classes": 4,
+                             "input_dim": 8}}
+    with pytest.raises(SchemaError, match="norm_multiplier"):
+        FLUTEConfig.from_dict(
+            {**base,
+             "server_config": {"robust": {"norm_multiplier": 0.5}}})
+    with pytest.raises(SchemaError, match="corrupt_scale_factor"):
+        FLUTEConfig.from_dict(
+            {**base,
+             "server_config": {"chaos": {"corrupt_scale_factor": 0.0}}})
+    with pytest.raises(SchemaError, match="corrupt_sign_flip_scale"):
+        FLUTEConfig.from_dict(
+            {**base,
+             "server_config": {"chaos": {"corrupt_sign_flip_scale": 0}}})
+    with pytest.raises(SchemaError, match="trim_fraction"):
+        FLUTEConfig.from_dict(
+            {**base,
+             "server_config": {"robust": {"aggregator": "trimmed_mean",
+                                          "trim_fraction": 0.5}}})
+
+
+def test_robust_refused_with_clients_per_chunk(synth_dataset, tmp_path):
+    cfg = _cfg(robust={"norm_multiplier": 4.0},
+               extra_sc={"clients_per_chunk": 2, "rounds_per_step": 1})
+    with pytest.raises(ValueError, match="clients_per_chunk"):
+        OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
+                           model_dir=str(tmp_path), seed=0)
+
+
+def test_robust_refused_with_rl(synth_dataset, tmp_path):
+    cfg = _cfg(robust={"norm_multiplier": 4.0})
+    cfg.server_config["wantRL"] = True
+    cfg.server_config["RL"] = None
+    with pytest.raises(ValueError, match="fused round path"):
+        OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
+                           model_dir=str(tmp_path), seed=0)
+
+
+def test_robust_refused_for_fedavg_subclass_strategy(synth_dataset,
+                                                     tmp_path):
+    # the schema layer is bypassed here (post-load mutation, as a
+    # programmatic caller could): the runtime guard must still refuse
+    # FedAvg SUBCLASSES — SecureAgg/QFFL/... inherit from FedAvg but
+    # combine through their own payload parts, which quarantine zeroing
+    # would silently corrupt (e.g. pairwise-mask cancellation)
+    cfg = _cfg(robust={"norm_multiplier": 4.0})
+    cfg.strategy = "secure_agg"
+    with pytest.raises(ValueError, match="fedavg/fedprox"):
+        OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
+                          model_dir=str(tmp_path), seed=0)
+
+
+def test_screened_mean_refused_with_adaptive_clipping(synth_dataset,
+                                                      tmp_path):
+    # not just the stack aggregators: screening zeroes only the default
+    # payload part, so even aggregator: mean would let quarantined
+    # clients' below-clip votes keep steering the adaptive-clip quantile
+    from msrflute_tpu.config import DPConfig
+
+    cfg = _cfg(robust={"norm_multiplier": 4.0, "aggregator": "mean"})
+    cfg.dp_config = DPConfig.from_dict(
+        {"enable_local_dp": True, "eps": -1.0, "max_grad": 1.0,
+         "adaptive_clipping": {"target_quantile": 0.5}})
+    with pytest.raises(ValueError, match="adaptive_clipping"):
+        OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
+                           model_dir=str(tmp_path), seed=0)
+
+
+def test_stack_aggregator_refused_with_adaptive_clipping():
+    from msrflute_tpu.strategies.robust import RobustFedAvg
+
+    cfg = _cfg(robust={"aggregator": "trimmed_mean"})
+    dp = {"enable_local_dp": True, "eps": -1.0, "max_grad": 1.0,
+          "adaptive_clipping": {"target_quantile": 0.5}}
+    from msrflute_tpu.config import DPConfig
+    with pytest.raises(ValueError, match="adaptive_clipping"):
+        RobustFedAvg(cfg, DPConfig.from_dict(dp))
+
+
+# ======================================================================
+# eval-side non-finite guard
+# ======================================================================
+def _poisoned_val(poison_all=False):
+    rng = np.random.default_rng(3)
+    users, per = [], []
+    n_users = 3
+    for u in range(n_users):
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        if u == 0 or poison_all:
+            x[:] = np.nan  # the one broken client's eval features
+        users.append(f"v{u}")
+        per.append({"x": x,
+                    "y": rng.integers(0, 4, 8).astype(np.int32)})
+    return ArraysDataset(users, per)
+
+
+def test_eval_nonfinite_guard_excludes_poisoned_steps(synth_dataset,
+                                                      tmp_path):
+    cfg = _cfg(rounds=2, extra_sc={
+        "val_freq": 1, "initial_val": False,
+        "telemetry": {"enable": True},
+        # small eval batches so the poisoned client occupies its OWN
+        # steps (one huge batch would mix it with every healthy sample)
+        "data_config": {"val": {"batch_size": 4}}})
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                synth_dataset,
+                                val_dataset=_poisoned_val(),
+                                model_dir=str(tmp_path), seed=0)
+    state = server.train()
+    assert state.round == 2
+    # one broken val client no longer poisons best_val / plateau state
+    assert "loss" in server.best_val
+    assert np.isfinite(server.best_val["loss"].value)
+    server.scope.close()
+    with open(os.path.join(str(tmp_path), "telemetry",
+                           "trace.json")) as fh:
+        trace = json.load(fh)
+    names = [ev["name"] for ev in trace["traceEvents"]
+             if ev.get("ph") == "i"]
+    assert "eval_nonfinite_skipped" in names
+
+
+def test_eval_all_poisoned_never_claims_best(synth_dataset, tmp_path):
+    """Every val step poisoned: the guarded sums are all-zero, which
+    must surface as NaN metrics (skipped), NOT a perfect 0.0 loss."""
+    cfg = _cfg(rounds=2, extra_sc={"val_freq": 1, "initial_val": False})
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                synth_dataset,
+                                val_dataset=_poisoned_val(poison_all=True),
+                                model_dir=str(tmp_path), seed=0)
+    server.train()
+    assert "loss" not in server.best_val
+
+
+# ======================================================================
+# quarantine_rate watchdog
+# ======================================================================
+def test_quarantine_rate_watchdog_actions():
+    from msrflute_tpu.telemetry.watchdog import Watchdog, WatchdogAbort
+
+    events = []
+    marks = []
+    wd = Watchdog({"quarantine_rate_action": "mark",
+                   "quarantine_rate_threshold": 0.4},
+                  on_event=lambda kind, **f: events.append((kind, f)),
+                  on_mark=lambda kind, fields: marks.append(kind))
+    wd.observe_round(1, quarantine_frac=0.3)   # below threshold
+    assert not wd.findings
+    wd.observe_round(2, quarantine_frac=0.6)
+    assert [f["kind"] for f in wd.findings] == ["quarantine_rate"]
+    assert marks == ["quarantine_rate"]
+    assert events and events[0][0] == "watchdog_quarantine_rate"
+    # None (shield off) never fires whatever the config
+    wd.observe_round(3, quarantine_frac=None)
+    assert len(wd.findings) == 1
+
+    wd_abort = Watchdog({"quarantine_rate_action": "abort",
+                         "quarantine_rate_threshold": 0.1})
+    with pytest.raises(WatchdogAbort, match="quarantine_rate"):
+        wd_abort.observe_round(1, quarantine_frac=0.9)
+    with pytest.raises(ValueError, match="quarantine_rate_action"):
+        Watchdog({"quarantine_rate_action": "explode"})
+
+
+def test_quarantine_rate_watchdog_fires_from_round_loop(synth_dataset,
+                                                        tmp_path):
+    """End-to-end: a heavily-poisoned cohort trips the detector through
+    the real drain path (mark -> status_log)."""
+    chaos = {"seed": 2, "corrupt_nan_rate": 0.6}
+    cfg = _cfg(rounds=3, chaos=chaos,
+               robust={"screen_nonfinite": True, "norm_multiplier": 0},
+               extra_sc={"telemetry": {
+                   "enable": True,
+                   "watchdog": {"quarantine_rate_action": "mark",
+                                "quarantine_rate_threshold": 0.3,
+                                "nan_loss": "abort"}}})
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                synth_dataset,
+                                model_dir=str(tmp_path), seed=0)
+    state = server.train()  # screening keeps the loss finite: no abort
+    assert state.round == 3
+    kinds = {f["kind"] for f in server.scope.watchdog.findings}
+    assert "quarantine_rate" in kinds
+    assert "watchdog_quarantine_rate" in server.ckpt.read_status()

@@ -1,18 +1,29 @@
 """Chaos smoke: an N-round run with dropout + straggler + checkpoint-IO
-faults enabled, asserting the injected-fault counters actually moved.
+faults AND adversarial update corruption enabled (fluteshield screening
+on), asserting every injected-fault class fired and the quarantine
+counters exactly match the seeded injection schedule.
 
 The cheap end-to-end proof that the deterministic fault-injection path
 (``server_config.chaos`` -> fused-round fault operands -> packed-stats
 counters -> bench contract) is alive: dropout/straggling fold into the
-round program, IO faults exercise the checkpoint retry machinery, and
-the emitted JSON carries the chaos block + counters exactly like a
-``BENCH_CHAOS=1`` bench line would (so the two can never be confused
-with clean baselines).
+round program, NaN/scale/sign-flip corruption hits the transmitted
+payloads, fluteshield quarantines what the schedule poisoned, IO faults
+exercise the checkpoint retry machinery, and the emitted JSON carries
+the chaos + robust blocks + counters exactly like a ``BENCH_CHAOS=1``
+bench line would (so the two can never be confused with clean
+baselines).
+
+The quarantine match is the determinism pin (PR 3's fault-class
+discipline extended to the defense): NaN-corrupted live clients are
+caught by the finite screen bit-for-bit per the ``(seed, stream,
+round)`` schedule, and with ``corrupt_scale_factor`` far above the
+benign norm spread, scale-corrupted live clients are exactly the
+norm-outlier quarantines.
 
 Run: ``python tools/chaos_smoke.py`` (CPU, seconds — sized for tier-1's
 budget; ``tests/test_resilience.py`` drives :func:`run_smoke`
-in-process).  Exit code 0 iff every fault class fired and the run
-completed.
+in-process).  Exit code 0 iff every fault class fired, the quarantine
+counters match the schedule, and the run completed.
 """
 
 from __future__ import annotations
@@ -27,20 +38,57 @@ sys.path.insert(0, REPO_ROOT)
 
 #: the drill schedule: rates high enough that a short run fires every
 #: fault class with probability ~1 (8 clients x N rounds, io fault per
-#: checkpoint write attempt), deterministic via the fixed seed
+#: checkpoint write attempt), deterministic via the fixed seed.
+#: Corruption rates sum to 0.45 so the per-round corrupted fraction
+#: stays below the robust estimators' breakdown point for this seed.
 CHAOS = {
     "seed": 7,
     "dropout_rate": 0.25,
     "straggler_rate": 0.25,
     "straggler_inflation": 2.0,
     "ckpt_io_error_rate": 0.3,
+    "corrupt_nan_rate": 0.15,
+    "corrupt_scale_rate": 0.15,
+    "corrupt_sign_flip_rate": 0.15,
+    # far above any benign norm spread: every scale-corrupted client is
+    # a norm outlier, making the quarantine counter schedule-exact
+    "corrupt_scale_factor": 100.0,
 }
+
+#: the defense under test: finite screen + median-of-norms quarantine
+ROBUST = {"screen_nonfinite": True, "norm_multiplier": 4.0,
+          "aggregator": "mean"}
+
+
+def expected_corruption(rounds: int, k_padded: int, n_real: int) -> dict:
+    """Replay the seeded schedule host-side: per-class totals over LIVE
+    clients (real slot, not chaos-dropped) — what the in-program
+    counters and the finite-screen quarantine must equal exactly."""
+    import numpy as np
+
+    from msrflute_tpu.resilience.chaos import (CORRUPT_NAN, CORRUPT_SCALE,
+                                               CORRUPT_SIGN_FLIP,
+                                               ChaosSchedule)
+
+    sched = ChaosSchedule(**{k: v for k, v in CHAOS.items()})
+    out = {"nan_injected": 0, "scaled": 0, "sign_flipped": 0}
+    shape_only = np.zeros((k_padded, 1, 1), np.float32)
+    for r in range(rounds):
+        drop, _ = sched.client_faults(r, shape_only)
+        mode = sched.corrupt_modes(r, k_padded)
+        live = (np.arange(k_padded) < n_real) & (drop == 0)
+        out["nan_injected"] += int(((mode == CORRUPT_NAN) & live).sum())
+        out["scaled"] += int(((mode == CORRUPT_SCALE) & live).sum())
+        out["sign_flipped"] += int(
+            ((mode == CORRUPT_SIGN_FLIP) & live).sum())
+    return out
 
 
 def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
     """Run the drill; return the bench-style record (chaos block + fault
     counters + final round).  Raises AssertionError if any fault class
-    never fired — the smoke's whole point."""
+    never fired or the quarantine counters diverge from the seeded
+    schedule — the smoke's whole point."""
     from msrflute_tpu.utils.backend import force_cpu_backend
     force_cpu_backend()
 
@@ -50,6 +98,8 @@ def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
     from msrflute_tpu.data import ArraysDataset
     from msrflute_tpu.engine import OptimizationServer
     from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.parallel.mesh import pad_to_mesh
 
     cfg = FLUTEConfig.from_dict({
         "model_config": {"model_type": "LR", "num_classes": 4,
@@ -61,6 +111,7 @@ def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
             "optimizer_config": {"type": "sgd", "lr": 1.0},
             "val_freq": 10_000, "initial_val": False,
             "chaos": dict(CHAOS),
+            "robust": dict(ROBUST),
             # zero backoff: the injected faults are synthetic; sleeping
             # between retries would only burn the tier-1 budget
             "checkpoint_retry": {"retries": 3, "backoff_base_s": 0.0,
@@ -98,25 +149,53 @@ def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
         for ev in trace["traceEvents"]:
             if ev.get("ph") == "i":
                 trace_events[ev["name"]] = trace_events.get(ev["name"], 0) + 1
+        quarantine = {k: float(v)
+                      for k, v in server.shield.counters.items()}
         record = {
             "tool": "chaos_smoke",
             "rounds": int(state.round),
             "chaos": server.chaos.describe(),
+            "robust": server.shield.describe(),
             "fault_counters": counters,
+            "quarantine_counters": quarantine,
             "checkpoint_recovery_events": len(server.ckpt.recovery_events),
             "trace_fault_events": {
                 k: v for k, v in sorted(trace_events.items())
-                if k in ("chaos_faults", "ckpt_io_fault")},
+                if k in ("chaos_faults", "chaos_corruption",
+                         "ckpt_io_fault", "quarantine")},
         }
     assert state.round == rounds, f"run stopped early at {state.round}"
-    for key in ("dropped", "straggled", "steps_lost", "ckpt_io_faults"):
+    for key in ("dropped", "straggled", "steps_lost", "ckpt_io_faults",
+                "nan_injected", "scaled", "sign_flipped"):
         assert counters[key] > 0, (
             f"fault class {key!r} never fired — the injection path is "
             f"dead ({counters})")
-    for name in ("chaos_faults", "ckpt_io_fault"):
+    for name in ("chaos_faults", "chaos_corruption", "ckpt_io_fault",
+                 "quarantine"):
         assert record["trace_fault_events"].get(name, 0) > 0, (
             f"fault event {name!r} fired but never reached the trace — "
             f"the telemetry event path is dead ({trace_events})")
+    # ---- determinism pin: counters == the seeded injection schedule,
+    # replayed host-side from (seed, stream, round) alone ----
+    k_padded = pad_to_mesh(
+        int(cfg.server_config["num_clients_per_iteration"]), make_mesh())
+    expect = expected_corruption(
+        rounds, k_padded,
+        int(cfg.server_config["num_clients_per_iteration"]))
+    for key in ("nan_injected", "scaled", "sign_flipped"):
+        assert counters[key] == expect[key], (
+            f"corruption counter {key!r}={counters[key]} diverged from "
+            f"the seeded schedule ({expect[key]}) — determinism broken")
+    assert quarantine["quarantined_nonfinite"] == expect["nan_injected"], (
+        "finite-screen quarantine "
+        f"({quarantine['quarantined_nonfinite']}) != scheduled NaN "
+        f"injections ({expect['nan_injected']})")
+    assert quarantine["quarantined_norm_outlier"] == expect["scaled"], (
+        "norm-outlier quarantine "
+        f"({quarantine['quarantined_norm_outlier']}) != scheduled scale "
+        f"corruptions ({expect['scaled']}) — with corrupt_scale_factor "
+        "100x the screen must catch exactly the scheduled attackers")
+    record["expected_from_schedule"] = expect
     return record
 
 
