@@ -628,6 +628,19 @@ def _server_overhead_extras(server) -> dict:
     if tail:
         out["host_tail_secs_p50"] = round(
             float(np.percentile(tail, 50)), 5)
+    # dispatch-cost observability (ISSUE 6 satellite): whether the run
+    # staged its inputs as one packed buffer per dtype group, and what
+    # the last faithful dispatch actually paid — the bench-side mirror
+    # of the tier-1 transfer-count guard (tests/test_input_staging.py)
+    engine = getattr(server, "engine", None)
+    if engine is not None:
+        out["dispatch"] = {
+            "input_staging": bool(getattr(engine, "input_staging", False)),
+            "puts_per_dispatch": int(getattr(engine,
+                                             "last_dispatch_puts", 0)),
+            "staged_kb": round(
+                getattr(engine, "last_staged_bytes", 0) / 1024.0, 2),
+        }
     chaos = getattr(server, "chaos", None)
     if chaos is not None:
         out["chaos"] = dict(chaos.describe(),
@@ -985,6 +998,91 @@ def bench_pipeline_ab(on_tpu: bool) -> dict:
     return out
 
 
+def bench_fused_carry_ab(on_tpu: bool) -> dict:
+    """Pipeline A/B for a FORMERLY-SERIAL strategy (ISSUE 6 acceptance):
+    SCAFFOLD — whose control-variate flow forced the serial host
+    fallback since PR 1 — run with device-resident carry
+    (``fused_carry: true``) serial (``pipeline_depth: 0``) vs pipelined
+    with a depth-2 ring, under flutescope telemetry.  The pipelined
+    arm's trace feeds ``tools/scope``'s overlap summary, so the
+    host-tail overlap is recorded evidence (``overlap.efficiency_pct``
+    > 0 when the loop actually pipelined) together with the per-depth
+    rounds-in-flight breakdown.  Params are bit-identical across arms
+    by the pinned carry contract (tests/test_universal_overlap.py)."""
+    import tempfile
+
+    import jax
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.telemetry.scope_cli import summarize
+    from msrflute_tpu.utils.strict import strict_transfers_enabled
+
+    warm, rounds = (5, 40) if on_tpu else (3, 30)
+    out = {"rounds_per_arm": rounds, "strategy": "scaffold",
+           "protocol": "cnn_femnist" if on_tpu else "lr_mnist",
+           "strict_transfers": strict_transfers_enabled()}
+
+    def _cfg(depth):
+        if on_tpu:
+            model = {"model_type": "CNN", "num_classes": 62}
+            bs, lr = 20, 0.1
+        else:
+            model = {"model_type": "LR", "num_classes": 10,
+                     "input_dim": 784}
+            bs, lr = 10, 0.03
+        return FLUTEConfig.from_dict({
+            "model_config": model,
+            "strategy": "scaffold",
+            "server_config": {
+                "max_iteration": 0, "num_clients_per_iteration": 10,
+                "initial_lr_client": lr, "pipeline_depth": depth,
+                "fused_carry": True, "rounds_per_step": 1,
+                "telemetry": {"enable": True},
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "val_freq": 10_000, "initial_val": False,
+                "data_config": {"val": {"batch_size": 128}},
+            },
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": lr},
+                "data_config": {"train": {"batch_size": bs}},
+            },
+        })
+
+    for depth in (0, 2):
+        cfg = _cfg(depth)
+        if on_tpu:
+            data = _image_dataset(64, 240, (28, 28, 1), 62,
+                                  np.random.default_rng(0))
+        else:
+            data = _image_dataset(16, 60, (784,), 10,
+                                  np.random.default_rng(0))
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            server = OptimizationServer(task, cfg, data, model_dir=tmp,
+                                        mesh=make_mesh(), seed=0)
+            cfg.server_config.max_iteration = warm
+            server.train()
+            cfg.server_config.max_iteration = warm + rounds
+            tic = time.time()
+            server.train()
+            jax.block_until_ready(server.state.params)
+            secs = (time.time() - tic) / rounds
+            key = "pipelined" if depth else "serial"
+            out[f"{key}_secs_per_round"] = round(secs, 4)
+            if depth:
+                out["pipelined_chunks"] = server.pipelined_chunks
+                out.update(_server_overhead_extras(server))
+                # materialized by server.train()'s final flush; the
+                # overlap block is the acceptance evidence
+                scope = summarize(tmp)
+                out["scope_overlap"] = scope.get("overlap")
+    out["speedup"] = round(out["serial_secs_per_round"]
+                           / max(out["pipelined_secs_per_round"], 1e-9), 3)
+    return out
+
+
 def _config_block_ab(on_tpu: bool, key: str, arms: dict) -> dict:
     """Shared off-vs-on overhead harness: run the SAME faithful-mode
     protocol once per arm with ``server_config[key]`` set to that arm's
@@ -1324,6 +1422,21 @@ def main() -> None:
                 extras["faithful_pipeline_ab"] = bench_pipeline_ab(on_tpu)
         except Exception as exc:
             extras["faithful_pipeline_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # formerly-serial-strategy pipeline A/B (universal overlap): the
+    # evidence that fused_carry actually lifted the serial fallback —
+    # default-on for CPU runs, env-gated on TPU like the pipeline A/B
+    if (not on_tpu or os.environ.get("BENCH_FUSED_AB")) and \
+            (keep is None or "fused_carry_pipeline_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("fused_carry_pipeline_ab"):
+                extras["fused_carry_pipeline_ab"] = \
+                    bench_fused_carry_ab(on_tpu)
+        except Exception as exc:
+            extras["fused_carry_pipeline_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

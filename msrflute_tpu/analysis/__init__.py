@@ -1,6 +1,6 @@
 """fluteguard — TPU-safety static analysis for msrflute_tpu.
 
-Five checkers, one CLI::
+Six checkers, one CLI::
 
     python -m msrflute_tpu.analysis msrflute_tpu/     # or: tools/flint
 
@@ -13,6 +13,9 @@ Five checkers, one CLI::
   function bodies.
 - **pallas-shape**     TPU tile alignment of kernel block shapes and
   tracer-dependent Python loop bounds.
+- **put-loop**         per-leaf ``jax.device_put`` loops in hot-path
+  modules; since PR 6 the dispatch inputs cross as one staged buffer
+  per dtype group (``server_config.input_staging``).
 - **schema-drift**     ``schema.py`` vs ``config.py`` vs docs
   cross-consistency.
 
@@ -31,5 +34,5 @@ from .core import (Finding, analyze, default_baseline_path,  # noqa: F401
                    filter_baseline, load_baseline, write_baseline)
 
 RULES = ("host-sync", "donation-aliasing", "jit-purity", "pallas-shape",
-         "schema-drift", "stale-suppression", "bare-suppression",
-         "parse-error")
+         "put-loop", "schema-drift", "stale-suppression",
+         "bare-suppression", "parse-error")

@@ -295,7 +295,7 @@ def analyze(paths: List[str], root: Optional[str] = None,
     """Run every checker over ``paths``; returns suppression-filtered
     findings (baseline NOT applied — that is the caller's policy)."""
     from . import donation, host_sync, jit_purity, pallas_shape, \
-        schema_drift
+        put_loop, schema_drift
 
     root = os.path.abspath(root or os.getcwd())
     per_file_checkers = [
@@ -303,6 +303,7 @@ def analyze(paths: List[str], root: Optional[str] = None,
         (donation.RULE, donation.check),
         (jit_purity.RULE, jit_purity.check),
         (pallas_shape.RULE, pallas_shape.check),
+        (put_loop.RULE, put_loop.check),
     ]
 
     findings: List[Finding] = []

@@ -64,6 +64,10 @@ _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
 DOCUMENTED_KNOBS = (
     "pipeline_depth", "rounds_per_step", "checkpoint_async",
     "checkpoint_backend", "compilation_cache_dir", "step_bucketing",
+    # universal overlap (PR 6): an operator who cannot find the carry /
+    # staging knobs will keep paying the serial fallback and the
+    # per-leaf dispatch tax without knowing the lever exists
+    "fused_carry", "input_staging",
     # resilience knobs: an operator who cannot find the preemption /
     # fault-injection drill in the runbook will learn about it from a
     # lost run instead

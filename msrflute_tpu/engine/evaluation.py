@@ -137,6 +137,7 @@ def per_user_accuracy(per_user_fn: Callable, params: Any,
     """Per-user accuracy vector (NaN where a user had no eval samples)."""
     spec = P(CLIENTS_AXIS) if partition_mode == "shard_map" else P()
     sharding = NamedSharding(mesh, spec)
+    # flint: disable=put-loop eval-boundary staging, not the per-round dispatch path
     staged = {k: jax.device_put(v, sharding) for k, v in batches.items()}
     c, t = jax.device_get(per_user_fn(params, staged))
     c, t = np.asarray(c, np.float64), np.asarray(t, np.float64)
@@ -161,6 +162,7 @@ def evaluate(task: BaseTask, eval_fn: Callable, params: Any,
     """
     spec = P(CLIENTS_AXIS) if partition_mode == "shard_map" else P()
     sharding = NamedSharding(mesh, spec)
+    # flint: disable=put-loop eval-boundary staging, not the per-round dispatch path
     staged = {k: jax.device_put(v, sharding) for k, v in batches.items()}
     if telemetry is not None:
         with telemetry.span("eval_device"):

@@ -106,12 +106,50 @@ class PersonalizationStore:
 
 
 class PersonalizationServer(OptimizationServer):
-    """OptimizationServer + per-user personalization passes."""
+    """OptimizationServer + per-user personalization passes.
+
+    Two modes: the host path (default) runs a separate jitted personal
+    pass per round inside the ``_sample`` hook and keeps per-user state
+    in a host-side :class:`PersonalizationStore`; with
+    ``server_config.fused_carry: true`` the per-user local models and
+    alphas instead ride ``strategy_state`` as device-resident carry
+    (``strategies/personalized.py``) — the round pipelines like FedAvg,
+    durability rides the model checkpoint, and the personalized eval
+    reads the tables back with one explicit fetch at eval boundaries.
+    """
+
+    #: under fused_carry the ``_sample`` hook degrades to the base
+    #: sampler (the personal pass moved into the round program), so the
+    #: server's host-orchestrated predicate must not count it
+    fused_carry_sample = True
+
+    def _select_strategy(self, config) -> type:
+        if self._fused_carry:
+            from ..strategies.personalized import PersonalizedFedAvg
+            strat = (config.strategy or "fedavg").lower()
+            if strat not in ("fedavg", "fedprox"):
+                raise ValueError(
+                    f"fused_carry personalization composes only with "
+                    f"strategy: fedavg/fedprox (got {strat!r}) — the "
+                    "carry tables replace the host store, and other "
+                    "strategies keep their own state; drop fused_carry")
+            return PersonalizedFedAvg
+        return super()._select_strategy(config)
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         cc = self.config.client_config
         self.alpha0 = float(cc.get("convex_model_interp", 0.75))
+        if self._fused_carry:
+            # device-carry mode: per-user state lives in strategy_state
+            # (checkpointed with the model), the personal pass runs
+            # inside the fused round program, and there is no host store
+            self.store = None
+            self._personal_fn = None
+            self._personal_eval_fn = None
+            self._interp_space = self.config.server_config.get(
+                "personalization_interp", "probs")
+            return
         self._store_path = os.path.join(self.ckpt.model_dir,
                                         "personalization")
         self.store = PersonalizationStore(self.alpha0, self._store_path)
@@ -160,8 +198,11 @@ class PersonalizationServer(OptimizationServer):
         if round_no % val_freq == 0 and self.val_dataset is not None:
             self.personalized_accuracy(self.val_dataset)
         # persist ONLY the users updated this round (reference writes
-        # <user>_model.tar per processed client, core/client.py:408-443)
-        self.store.save()
+        # <user>_model.tar per processed client, core/client.py:408-443);
+        # fused mode has no host store — durability rides the model
+        # checkpoint, whose strategy_state IS the personalization state
+        if self.store is not None:
+            self.store.save()
 
     # -- jitted per-user local pass ------------------------------------
     def _build_personal_fn(self):
@@ -215,12 +256,17 @@ class PersonalizationServer(OptimizationServer):
     # -- hook into the round loop --------------------------------------
     def train(self):
         state = super().train()
-        self.store.save()
+        if self.store is not None:
+            self.store.save()
         return state
 
     def _sample(self):
         sampled = super()._sample()
-        self._run_personal_pass(sampled)
+        if self.store is not None:
+            # host path only: fused_carry runs the personal pass inside
+            # the round program (strategies/personalized.py), so sampling
+            # degrades to the base sampler and the pipeline stays eligible
+            self._run_personal_pass(sampled)
         return sampled
 
     def _stage_on_clients_axis(self, host_params_list, alphas, batch):
@@ -342,12 +388,55 @@ class PersonalizationServer(OptimizationServer):
         configured ``desired_max_samples`` cap when present."""
         if not hasattr(self.task, "apply"):
             return None
-        if not self.store.alpha:
-            # nothing personalized yet (e.g. initial_val before round 1):
-            # the whole program would reduce to 4 redundant global
-            # forwards per user — skip; the standard global eval already
-            # covers this state
-            return None
+        if self.store is None:
+            # fused_carry: ONE explicit fetch of the carry tables at this
+            # eval boundary (the sanctioned crossing — eval boundaries
+            # already fetch; the per-round loop still pays exactly one
+            # packed transfer).  Rows are unraveled host-side in
+            # tree-flatten order, the exact inverse of the strategy's
+            # ravel_pytree rows — no device round trip per user.  The
+            # cheap ``seen`` gate crosses FIRST: when nothing is
+            # personalized yet the early return must not have paid for
+            # the [N, n_params] local table (or the model params).
+            ss = self.state.strategy_state
+            # flint: disable=host-sync deliberate split — the [N] seen gate crosses alone so the early return never pays for the [N, n_params] local table
+            seen_tab = np.asarray(jax.device_get(ss["seen"]))
+            if not bool(np.any(seen_tab > 0)):
+                # nothing personalized yet (e.g. initial_val before
+                # round 1) — the standard global eval covers this state
+                return None
+            gp_host = jax.device_get(self.state.params)
+            local_tab, alpha_tab = jax.device_get(
+                (ss["local"], ss["alpha"]))
+            leaves, treedef = jax.tree.flatten(gp_host)
+            spans = []
+            off = 0
+            for leaf in leaves:
+                spans.append((off, int(np.prod(leaf.shape)), leaf.shape))
+                off += spans[-1][1]
+
+            def _unravel_np(vec):
+                return jax.tree.unflatten(treedef, [
+                    np.asarray(vec[o:o + n]).reshape(shp)
+                    for o, n, shp in spans])
+
+            def get_lp(u):
+                return (_unravel_np(local_tab[u]) if u < len(seen_tab)
+                        and seen_tab[u] > 0 else gp_host)
+
+            def get_alpha(u):
+                return (float(alpha_tab[u]) if u < len(seen_tab)
+                        and seen_tab[u] > 0 else self.alpha0)
+        else:
+            if not self.store.alpha:
+                # nothing personalized yet (e.g. initial_val before
+                # round 1): the whole program would reduce to 4 redundant
+                # global forwards per user — skip; the standard global
+                # eval already covers this state
+                return None
+            gp_host = jax.device_get(self.state.params)
+            get_lp = lambda u: self.store.params.get(u, gp_host)
+            get_alpha = lambda u: self.store.alpha.get(u, self.alpha0)
         uids = list(range(len(dataset)))
         if not uids:
             return None
@@ -359,15 +448,14 @@ class PersonalizationServer(OptimizationServer):
         S = steps_for(int(max(dataset.num_samples)), bs,
                       self.desired_max_samples)
         chunk_k = self.mesh.shape[CLIENTS_AXIS]
-        gp_host = jax.device_get(self.state.params)
         correct = total = loss_sum = 0.0
         for i in range(0, len(uids), chunk_k):
             part = uids[i:i + chunk_k]
             batch = pack_round_batches(
                 dataset, part, bs, S, shuffle=False, pad_clients_to=chunk_k,
                 desired_max_samples=self.desired_max_samples)
-            lps = [self.store.params.get(u, gp_host) for u in part]
-            alphas = [self.store.alpha.get(u, self.alpha0) for u in part]
+            lps = [get_lp(u) for u in part]
+            alphas = [get_alpha(u) for u in part]
             while len(lps) < chunk_k:  # mesh-padding lanes (client_mask 0)
                 lps.append(gp_host)
                 alphas.append(self.alpha0)

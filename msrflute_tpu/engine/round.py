@@ -48,7 +48,7 @@ from ..robust import make_shield
 from ..strategies.base import BaseStrategy
 from ..telemetry import devbus_config_enabled
 from ..telemetry.devbus import DeviceMetricBus
-from ..utils.flatpack import FlatPacker
+from ..utils.flatpack import AxisPacker, FlatPacker, ScalarStager
 from .client_update import ClientHParams, build_client_update, _clip_by_global_norm
 
 
@@ -152,6 +152,88 @@ class RoundEngine:
                 "per-client cosines need every payload against the final "
                 "aggregate, which chunked accumulation never materializes — "
                 "disable one of them")
+
+        # device-resident carry state (universal overlap): the strategy
+        # keeps its cross-round per-client tables (SCAFFOLD controls, EF
+        # residuals, personalization heads/alphas) INSIDE strategy_state,
+        # gathers its rows per client in-program and scatters the update
+        # back via apply_carry — the round-k -> k+1 data dependency lives
+        # on device, so these strategies pipeline like FedAvg.  The
+        # server flips the flag (enable_device_carry) before building the
+        # engine when server_config.fused_carry is set.
+        self.device_carry = bool(getattr(strategy, "device_carry", False))
+        if self.device_carry and self.clients_per_chunk:
+            raise ValueError(
+                "fused_carry is incompatible with clients_per_chunk: the "
+                "carry scatter needs every client's update row, which "
+                "chunked accumulation never materializes — disable one")
+
+        # fused RL (server_config.wantRL + fused_carry): the DQN
+        # aggregation-weight tuner lives in strategy_state (rl/fused.py)
+        # and re-weights the gathered payload stack in-program; the
+        # reward is the round-over-round train-loss delta (delayed one
+        # round) instead of the host path's val-accuracy comparison —
+        # the documented tradeoff that buys full overlap.
+        self.rl_fused = bool(sc.get("wantRL", False) and
+                             sc.get("fused_carry", False))
+        self._rl = None
+        if self.rl_fused:
+            if not strategy.supports_rl:
+                raise ValueError(
+                    f"{type(strategy).__name__} does not support wantRL")
+            if self.device_carry:
+                raise ValueError(
+                    "fused RL does not compose with a device-carry "
+                    "strategy (scaffold/ef_quant/personalization): the "
+                    "RL re-weighting assumes the plain single-payload "
+                    "flow — drop wantRL or use fedavg/dga")
+            if strategy.stateful or \
+                    getattr(strategy, "adaptive_clip", None) is not None:
+                raise ValueError(
+                    "fused RL requires a stateless strategy combine "
+                    "(no adaptive_clipping / strategy state): the RL "
+                    "weights replace the combine entirely")
+            if getattr(strategy, "wants_cohort", False) or \
+                    strategy.unit_weight_parts:
+                raise ValueError(
+                    "fused RL does not compose with masked multi-part "
+                    "payloads (secure_agg/fedlabels): re-weighting would "
+                    "break mask cancellation")
+            if self.clients_per_chunk:
+                raise ValueError(
+                    "fused RL is incompatible with clients_per_chunk: "
+                    "re-weighting needs the full payload stack")
+            if float(getattr(strategy, "stale_prob", 0.0) or 0.0) > 0.0:
+                raise ValueError("fused RL does not support stale_prob")
+            from ..config import RLConfig
+            from ..rl.fused import FusedRL
+            rl_cfg = sc.RL if getattr(sc, "RL", None) is not None \
+                else RLConfig.from_dict({})
+            if bool(rl_cfg.get("wantLSTM", False)):
+                raise ValueError(
+                    "fused RL does not support wantLSTM — the state-"
+                    "window recurrence is host-side; drop fused_carry "
+                    "for LSTM RL runs")
+            ncpi = sc.get("num_clients_per_iteration", 10)
+            if not isinstance(ncpi, int):
+                raise ValueError(
+                    "wantRL requires a fixed num_clients_per_iteration")
+            from ..parallel.mesh import pad_to_mesh
+            self._rl = FusedRL(rl_cfg, pad_to_mesh(int(ncpi), self.mesh))
+
+        # single-buffer input staging (server_config.input_staging,
+        # default on): per-round host inputs — masks, ids, chaos
+        # vectors, lr/round scalars, and the feature (or index) grids —
+        # cross the host boundary as ONE buffer per dtype group
+        # (utils/flatpack.py AxisPacker/ScalarStager) instead of ~8-10
+        # per-leaf device_puts per dispatch (tools/dispatch_cost_probe).
+        self.input_staging = bool(sc.get("input_staging", True))
+        self._staged_cache: Dict[Any, Callable] = {}
+        #: dispatch-cost observability (bench extras + the tier-1
+        #: regression guard): host->device put calls and bytes of the
+        #: most recent dispatch
+        self.last_dispatch_puts = 0
+        self.last_staged_bytes = 0
 
         # deterministic chaos client faults (server_config.chaos): when the
         # schedule injects dropout/straggling, the round program takes two
@@ -276,10 +358,18 @@ class RoundEngine:
             params = jax.device_put(params, self._replicated)
             opt_state = jax.jit(self.server_tx.init,
                                 out_shardings=self._replicated)(params)
+        strategy_state = self.strategy.init_state(params)
+        if self.rl_fused:
+            # the DQN tuner's carry (net params, optimizer state, replay
+            # ring, epsilon, delayed-reward anchors) rides strategy_state
+            # so it is donated, scanned, and checkpointed exactly like
+            # any strategy state
+            strategy_state = {"base": strategy_state,
+                              "rl": self._rl.init_state(rng)}
         return ServerState(
             params=params,
             opt_state=opt_state,
-            strategy_state=self.strategy.init_state(params),
+            strategy_state=strategy_state,
             round=0,
         )
 
@@ -293,9 +383,11 @@ class RoundEngine:
         the reference re-ships client data from host per round
         (``core/client.py:101-124``); on a remote-attached chip that
         transfer dominates small-model rounds."""
+        # flint: disable=put-loop one-time pool upload at attach, not per-round dispatch
         self._pool = {k: jax.device_put(np.asarray(v), self._replicated)
                       for k, v in pool_arrays.items()}
         self._multi_cache = {}
+        self._staged_cache = {}
         self._stats_packers = {}
         self._round_step = self._build_round_step()
 
@@ -317,6 +409,11 @@ class RoundEngine:
         chaos_corruption = self.chaos_corruption
         corrupt_scale = self._corrupt_scale
         corrupt_flip_scale = self._corrupt_flip_scale
+        # universal-overlap statics: both compile-time branches — a
+        # config without fused_carry traces the exact legacy program
+        device_carry = self.device_carry
+        rl_fused = self.rl_fused
+        fused_rl = self._rl
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
@@ -361,11 +458,26 @@ class RoundEngine:
                     cohort_kw = dict(cohort_ids=cohort_ids,
                                      cohort_mask=cohort_mask,
                                      self_id=cid_c, self_mask=cm_c)
-                parts, tl, ns, stats = strategy.client_step(
-                    client_update, params, arr_c, mask_c, client_lr, rng_c,
-                    round_idx=round_idx, leakage_threshold=leakage_threshold,
-                    quant_threshold=quant_threshold,
-                    strategy_state=strategy_state, **cohort_kw)
+                carry_row = None
+                if device_carry:
+                    # carry strategies gather their own table rows from
+                    # strategy_state by client id and return the
+                    # per-client carry update row alongside the payload
+                    parts, tl, ns, stats, carry_row = \
+                        strategy.client_step_carry(
+                            client_update, params, arr_c, mask_c,
+                            client_lr, rng_c, client_id=cid_c,
+                            live_mask=cm_c, round_idx=round_idx,
+                            leakage_threshold=leakage_threshold,
+                            quant_threshold=quant_threshold,
+                            strategy_state=strategy_state)
+                else:
+                    parts, tl, ns, stats = strategy.client_step(
+                        client_update, params, arr_c, mask_c, client_lr,
+                        rng_c, round_idx=round_idx,
+                        leakage_threshold=leakage_threshold,
+                        quant_threshold=quant_threshold,
+                        strategy_state=strategy_state, **cohort_kw)
                 if chaos_corruption:
                     # adversarial chaos (resilience/chaos.py corrupt
                     # modes, already gated on the live client_mask):
@@ -395,7 +507,9 @@ class RoundEngine:
                     stale = coin.astype(jnp.float32) * cm_c
                 else:
                     stale = jnp.zeros(())
-                return parts, tl * cm_c, ns * cm_c, stats, stale
+                # carry_row is None (a leafless pytree — vmap passes it
+                # through) unless the strategy runs in device-carry mode
+                return parts, tl * cm_c, ns * cm_c, stats, stale, carry_row
 
             def process_chunk(arr_k, sm_k, cm_k, cid_k, corrupt_k=None):
                 """One chunk of clients -> (summed locals, per-client
@@ -405,8 +519,8 @@ class RoundEngine:
                     arr_k = gather_pool(arr_k, sm_k)
                 vmap_args = (arr_k, sm_k, cm_k, cid_k) + \
                     ((corrupt_k,) if chaos_corruption else ())
-                parts, tls, nss, stats, stale = jax.vmap(per_client)(
-                    *vmap_args)
+                parts, tls, nss, stats, stale, carry_rows = \
+                    jax.vmap(per_client)(*vmap_args)
                 # per-client privacy-attack metrics stay per-client (the
                 # server needs the distribution for the adaptive leakage
                 # threshold, core/server.py:397-409)
@@ -490,7 +604,20 @@ class RoundEngine:
                     # stats buffer — zero new device_gets
                     local["shield_nonfinite"] = shield_counts[0]
                     local["shield_norm_outlier"] = shield_counts[1]
-                return local, privacy_per_client, parts, cm_k
+                extras = {}
+                if device_carry:
+                    extras["carry"] = carry_rows
+                if rl_fused:
+                    # the RL tuner needs the full per-client payload stack
+                    # (to re-weight) and the reference feature layout
+                    # (weight, magnitude, mean, variance per client)
+                    extras["rl"] = {
+                        "stack": parts["default"][0],
+                        "w": parts["default"][1],
+                        "mag": stats["mag"], "mean": stats["mean"],
+                        "var": stats["var_corrected"],
+                    }
+                return local, privacy_per_client, parts, cm_k, extras
 
             k_local = sample_mask.shape[0]
             if clients_per_chunk and clients_per_chunk < k_local:
@@ -510,7 +637,7 @@ class RoundEngine:
                                    else ()))
 
                 def scan_body(acc, xs_c):
-                    local_c, priv_c, _, _ = process_chunk(*xs_c)
+                    local_c, priv_c, _, _, _ = process_chunk(*xs_c)
                     return jax.tree.map(jnp.add, acc, local_c), priv_c
 
                 zero_local = jax.tree.map(
@@ -523,8 +650,10 @@ class RoundEngine:
                     lambda y: y.reshape((-1,) + y.shape[2:]), priv_chunks)
                 parts = None  # never materialized across all K — the point
                 cm_eff = None
+                extras = {}
             else:
-                local, privacy_per_client, parts, cm_eff = process_chunk(
+                (local, privacy_per_client, parts, cm_eff,
+                 extras) = process_chunk(
                     arrays, sample_mask, client_mask, client_ids,
                     corrupt_mode if chaos_corruption else None)
             if self.partition_mode == "shard_map":
@@ -554,6 +683,7 @@ class RoundEngine:
                 privacy_per_client["norm"] = pg_norm
                 privacy_per_client["cosine"] = dot / jnp.maximum(
                     pg_norm * gnorm, 1e-12)
+            out = (total, privacy_per_client)
             if robust_stack:
                 # the Byzantine-robust combine (coordinate-wise trimmed
                 # mean / median, strategies/robust.py) needs the full
@@ -563,8 +693,17 @@ class RoundEngine:
                 stack_tree = jax.tree.map(gather_axis,
                                           parts["default"][0])
                 stack_keep = gather_axis(cm_eff)
-                return total, privacy_per_client, stack_tree, stack_keep
-            return total, privacy_per_client
+                out += (stack_tree, stack_keep)
+            if device_carry:
+                # replicated full-cohort carry rows: every shard scatters
+                # the identical update, so strategy_state stays replicated
+                out += (jax.tree.map(gather_axis, extras["carry"]),)
+            if rl_fused:
+                # full per-client payload stack + feature vectors for the
+                # in-program re-weighting (reference keeps
+                # client_parameters_stack for this, dga.py:317-330)
+                out += (jax.tree.map(gather_axis, extras["rl"]),)
+            return out
 
         def shard_entry(params, strategy_state, arrays, sample_mask,
                         client_mask, client_ids, client_lr, round_idx,
@@ -585,14 +724,17 @@ class RoundEngine:
                               pool=pool_arg)
 
         if self.partition_mode == "shard_map":
+            out_specs = (rspec, cspec) + \
+                ((rspec, rspec) if robust_stack else ()) + \
+                ((rspec,) if device_carry else ()) + \
+                ((rspec,) if rl_fused else ())
             sharded_collect = shard_map(
                 shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec, rspec, rspec) +
                          ((cspec,) if chaos_corruption else ()) +
                          ((rspec,) if pool_mode else ()),
-                out_specs=((rspec, cspec, rspec, rspec) if robust_stack
-                           else (rspec, cspec)), check_vma=False)
+                out_specs=out_specs, check_vma=False)
         else:
             # GSPMD mode: plain jit — client data stays sharded on the
             # 'clients' axis, params sharded per infer_model_sharding on the
@@ -665,17 +807,24 @@ class RoundEngine:
                 client_ids, client_lr, round_idx, leakage_threshold,
                 quant_threshold, rng, client_ids, client_mask,
                 *corrupt_args, *pool_args)
+            collected, privacy_per_client = collect_out[0], collect_out[1]
+            pos = 2
             if robust_stack:
-                (collected, privacy_per_client,
-                 stack_tree, stack_keep) = collect_out
-            else:
-                collected, privacy_per_client = collect_out
+                stack_tree, stack_keep = collect_out[pos:pos + 2]
+                pos += 2
+            if device_carry:
+                carry_full = collect_out[pos]
+                pos += 1
+            if rl_fused:
+                rl_pc = collect_out[pos]
+                pos += 1
             part_sums = collected["parts"]
             deferred = None
             if stale_prob > 0.0:
                 default = part_sums["default"]
                 deferred = {"grad_sum": default["grad_sum_def"],
                             "weight_sum": default["weight_sum_def"]}
+            rl_stats = {}
             if robust_stack:
                 # Byzantine-robust combine over the screened stack
                 # (strategies/robust.py); strategy state passes through
@@ -683,12 +832,33 @@ class RoundEngine:
                 agg = strategy.combine_stack(stack_tree, stack_keep,
                                              jax.random.fold_in(rng, 17))
                 new_strategy_state = strategy_state
+            elif rl_fused:
+                # fused RL replaces the combine: the DQN tuner re-weights
+                # the gathered payload stack in-program; its whole carry
+                # (net, optimizer, replay ring, epsilon, delayed reward)
+                # rides strategy_state["rl"] (rl/fused.py)
+                cur_loss = collected["train_loss_sum"] / jnp.maximum(
+                    collected["client_count"], 1.0)
+                agg, new_rl_state, rl_stats = fused_rl.combine(
+                    strategy_state["rl"],
+                    {k: rl_pc[k] for k in ("w", "mag", "mean", "var")},
+                    rl_pc["stack"], cur_loss, jax.random.fold_in(rng, 29))
+                new_strategy_state = {"base": strategy_state["base"],
+                                      "rl": new_rl_state}
             else:
                 agg, new_strategy_state = strategy.combine_parts(
                     part_sums, deferred, strategy_state,
                     jax.random.fold_in(rng, 17),
                     num_clients=collected["client_count"],
                     global_params=bcast)
+            if device_carry:
+                # scatter the round's per-client carry rows (SCAFFOLD
+                # controls / EF residuals / personalization heads) back
+                # into the donated strategy_state tables — the round-k ->
+                # k+1 dependency the pipeline needed off the host
+                new_strategy_state = strategy.apply_carry(
+                    new_strategy_state, client_ids, carry_full,
+                    rng=jax.random.fold_in(rng, 31))
             if self.server_max_grad_norm is not None:
                 agg = _clip_by_global_norm(agg, float(self.server_max_grad_norm))
             if strategy.owns_server_update:
@@ -719,6 +889,7 @@ class RoundEngine:
                 "agg_grad_norm": optax.global_norm(agg),
             }
             round_stats.update(chaos_stats)
+            round_stats.update(rl_stats)
             if shield is not None:
                 # per-cause quarantine counters out through the same
                 # packed single transfer as every other stat
@@ -766,20 +937,11 @@ class RoundEngine:
         return jax.jit(round_step, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
-    def _multi_round_fn(self, num_rounds: int) -> Callable:
-        """Jitted ``lax.scan`` over ``num_rounds`` federated rounds.
-
-        TPU-first perf feature with no reference equivalent: FLUTE pays a
-        full server<->worker protocol exchange per round
-        (``core/federated.py:281-424``); even our single-round program pays
-        one host dispatch per round, which dominates when the controller is
-        far from the chips.  Scanning R rounds inside one program amortizes
-        dispatch/transfer to once per R rounds; client sampling stays
-        host-side (it is data-independent lookahead), eval boundaries cap R.
-        """
-        cached = self._multi_cache.get(num_rounds)
-        if cached is not None:
-            return cached
+    def _multi_core(self, num_rounds: int) -> Callable:
+        """The un-jitted ``lax.scan``-over-rounds program body — shared by
+        the legacy per-leaf dispatch (``_multi_round_fn`` jits it
+        directly) and the staged single-buffer dispatch (which wraps it
+        in the unpacking jit)."""
         core = self._round_step_core
         chaos_faults = self.chaos_client_faults
         chaos_corruption = self.chaos_corruption
@@ -811,7 +973,23 @@ class RoundEngine:
                 body, (params, opt_state, strategy_state), xs)
             return p, o, s, stats
 
-        fn = jax.jit(multi, donate_argnums=(0, 1, 2))
+        return multi
+
+    def _multi_round_fn(self, num_rounds: int) -> Callable:
+        """Jitted ``lax.scan`` over ``num_rounds`` federated rounds.
+
+        TPU-first perf feature with no reference equivalent: FLUTE pays a
+        full server<->worker protocol exchange per round
+        (``core/federated.py:281-424``); even our single-round program pays
+        one host dispatch per round, which dominates when the controller is
+        far from the chips.  Scanning R rounds inside one program amortizes
+        dispatch/transfer to once per R rounds; client sampling stays
+        host-side (it is data-independent lookahead), eval boundaries cap R.
+        """
+        cached = self._multi_cache.get(num_rounds)
+        if cached is not None:
+            return cached
+        fn = jax.jit(self._multi_core(num_rounds), donate_argnums=(0, 1, 2))
         self._multi_cache[num_rounds] = fn
         return fn
 
@@ -875,6 +1053,7 @@ class RoundEngine:
                 with_offsets=grad_offsets is not None))
         args = [
             state.params, state.strategy_state,
+            # flint: disable=put-loop host-orchestrated legacy round path; fused_carry is the staged overlap path
             {k: jax.device_put(v, self._client_sharding)
              for k, v in batch.arrays.items()},
             jax.device_put(batch.sample_mask, self._client_sharding),
@@ -924,14 +1103,14 @@ class RoundEngine:
                            state.round + 1)
 
     # ------------------------------------------------------------------
-    def _stage_chaos(self, chaos_vecs: Optional[list], sharding,
-                     stacked: bool) -> tuple:
-        """Device-stage the chaos fault vectors as trailing program
-        operands: per round a tuple of ``(drop [K], keep_steps [K])``
-        when client faults compiled in, followed by ``(corrupt_mode
-        [K],)`` when corruption compiled in — or nothing when the engine
-        compiled without either.  Mismatches are programming errors and
-        raise."""
+    def _chaos_host(self, chaos_vecs: Optional[list],
+                    stacked: bool) -> tuple:
+        """Validate + assemble the chaos fault vectors as HOST numpy
+        arrays, one per trailing program operand: per round ``(drop [K],
+        keep_steps [K])`` when client faults compiled in, followed by
+        ``(corrupt_mode [K],)`` when corruption compiled in — or nothing
+        when the engine compiled without either.  Mismatches are
+        programming errors and raise."""
         dtypes = ([np.float32, np.float32] if self.chaos_client_faults
                   else []) + \
                  ([np.int32] if self.chaos_corruption else [])
@@ -955,9 +1134,138 @@ class RoundEngine:
         out = []
         for i, dt in enumerate(dtypes):
             vals = [np.asarray(entry[i], dt) for entry in chaos_vecs]
-            arr = np.stack(vals) if stacked else vals[0]
-            out.append(jax.device_put(arr, sharding))
+            out.append(np.stack(vals) if stacked else vals[0])
         return tuple(out)
+
+    def _stage_chaos(self, chaos_vecs: Optional[list], sharding,
+                     stacked: bool) -> tuple:
+        """Legacy (``input_staging: false``) per-leaf device staging of
+        the chaos operands."""
+        # flint: disable=put-loop legacy non-staged dispatch path, kept for the staging A/B (tools/dispatch_cost_probe.py)
+        return tuple(jax.device_put(arr, sharding)
+                     for arr in self._chaos_host(chaos_vecs, stacked))
+
+    # ------------------------------------------------------------------
+    # single-buffer input staging (server_config.input_staging, default
+    # on): the dispatch half of the flatpack idea.  Everything the host
+    # assembles per round — the feature (or index) grids, sample/client
+    # masks, client ids, chaos fault vectors, and the lr/round/threshold
+    # scalars — crosses the host boundary as ONE buffer per dtype group
+    # (clients-axis operands via AxisPacker, replicated scalars via
+    # ScalarStager); the inverse runs INSIDE the jitted program as static
+    # slices/reshapes XLA fuses away, so the math is bit-identical to the
+    # legacy per-leaf path (tests/test_input_staging.py pins both the
+    # equivalence and the transfer count).
+    # ------------------------------------------------------------------
+    def _build_staged_fn(self, R: int, ax_packer: AxisPacker,
+                         stager: ScalarStager) -> Callable:
+        stacked = R > 1
+        core = self._multi_core(R) if stacked else self._round_step_core
+
+        def staged(params, opt_state, strategy_state, ax_bufs, sc_bufs,
+                   rng, *pool_args):
+            ax = ax_packer.unpack(ax_bufs)
+            sc = stager.unpack(sc_bufs)
+            chaos = ax.get("chaos", ())
+            if not stacked:
+                return core(params, opt_state, strategy_state,
+                            ax["arrays"], ax["sample_mask"],
+                            ax["client_mask"], ax["client_ids"],
+                            sc["client_lr"], sc["server_lr"],
+                            sc["round_idx"], sc["leakage"], sc["quant"],
+                            rng, *chaos, *pool_args)
+            # splitting inside the trace produces the same keys the
+            # legacy path computed eagerly — split is a pure function
+            rngs = jax.random.split(rng, R)
+            return core(params, opt_state, strategy_state, ax["arrays"],
+                        ax["sample_mask"], ax["client_mask"],
+                        ax["client_ids"], sc["client_lr"], sc["server_lr"],
+                        sc["round_idx"], sc["leakage"], sc["quant"], rngs,
+                        *chaos, *pool_args)
+
+        return jax.jit(staged, donate_argnums=(0, 1, 2))
+
+    def _dispatch_staged(self, state: ServerState, batches: list,
+                         client_lrs: list, server_lrs: list,
+                         rng: jax.Array,
+                         leakage_threshold: Optional[float],
+                         quant_thresholds: Optional[list],
+                         chaos_vecs: Optional[list]
+                         ) -> Tuple[ServerState, PackedStats]:
+        """Staged dispatch of ``len(batches)`` rounds: assemble host-side,
+        pack per dtype group, one ``device_put`` for the clients-axis
+        groups and one for the scalar groups, run the unpacking jit."""
+        R = len(batches)
+        stacked = R > 1
+
+        def stack(pick):
+            vals = [pick(b) for b in batches]
+            return vals[0] if R == 1 else np.stack(vals)
+
+        arrays_host, pool_args = self._host_arrays(batches)
+        axis_tree = {
+            "arrays": arrays_host,
+            "sample_mask": stack(lambda b: b.sample_mask),
+            "client_mask": stack(lambda b: b.client_mask),
+            "client_ids": stack(lambda b: b.client_ids),
+        }
+        chaos_host = self._chaos_host(chaos_vecs, stacked)
+        if chaos_host:
+            axis_tree["chaos"] = tuple(chaos_host)
+        lr_dt, rd_dt = np.float32, np.int32
+        if stacked:
+            sc_tree = {
+                "client_lr": np.asarray(client_lrs, lr_dt),
+                "server_lr": np.asarray(server_lrs, lr_dt),
+                "round_idx": np.arange(state.round, state.round + R,
+                                       dtype=rd_dt),
+                "leakage": lr_dt(leakage_threshold
+                                 if leakage_threshold is not None
+                                 else np.inf),
+                "quant": np.asarray(quant_thresholds
+                                    if quant_thresholds is not None
+                                    else [-1.0] * R, lr_dt),
+            }
+        else:
+            sc_tree = {
+                "client_lr": lr_dt(client_lrs[0]),
+                "server_lr": lr_dt(server_lrs[0]),
+                "round_idx": rd_dt(state.round),
+                "leakage": lr_dt(leakage_threshold
+                                 if leakage_threshold is not None
+                                 else np.inf),
+                "quant": lr_dt(quant_thresholds[0]
+                               if quant_thresholds is not None else -1.0),
+            }
+        ax_packer = AxisPacker(axis_tree, lead_ndim=2 if stacked else 1)
+        stager = ScalarStager(sc_tree)
+        key = (R, ax_packer.signature, stager.signature)
+        fn = self._staged_cache.get(key)
+        if fn is None:
+            fn = self._build_staged_fn(R, ax_packer, stager)
+            self._staged_cache[key] = fn
+        ax_bufs = ax_packer.pack_np(axis_tree)
+        sc_bufs = stager.pack_np(sc_tree)
+        ax_sharding = (NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
+                       if stacked else self._client_sharding)
+        # ONE staging transfer per dtype group: each put runs on the
+        # whole per-dtype dict, so the transfer count equals the group
+        # count — the dispatch-cost contract the tier-1 guard pins
+        ax_dev = jax.device_put(ax_bufs, ax_sharding)
+        sc_dev = jax.device_put(sc_bufs, self._replicated)
+        self.last_dispatch_puts = len(ax_bufs) + len(sc_bufs)
+        self.last_staged_bytes = int(
+            sum(b.nbytes for b in ax_bufs.values()) +
+            sum(b.nbytes for b in sc_bufs.values()))
+        params, opt_state, strategy_state, vecs = fn(
+            state.params, state.opt_state, state.strategy_state, ax_dev,
+            sc_dev, rng, *pool_args)
+        new_state = ServerState(params, opt_state, strategy_state,
+                                state.round + R)
+        packer = self._stats_packers[
+            ("single", batches[0].sample_mask.shape[0])]
+        return new_state, PackedStats(vecs, packer, rounds=R,
+                                      stacked=stacked)
 
     # ------------------------------------------------------------------
     def run_round(self, state: ServerState, batch: RoundBatch,
@@ -972,12 +1280,26 @@ class RoundEngine:
         Dispatch is async; the returned :class:`PackedStats` is a lazy
         handle — nothing crosses the host boundary until ``.fetch()``.
         """
+        if self.input_staging:
+            return self._dispatch_staged(
+                state, [batch], [client_lr], [server_lr], rng,
+                leakage_threshold,
+                [quant_threshold] if quant_threshold is not None else None,
+                chaos_vecs)
         chaos_args = self._stage_chaos(chaos_vecs, self._client_sharding,
                                        stacked=False)
         arrays, pool_args = self._stage_arrays([batch], self._client_sharding)
         sample_mask = jax.device_put(batch.sample_mask, self._client_sharding)
         client_mask = jax.device_put(batch.client_mask, self._client_sharding)
         client_ids = jax.device_put(batch.client_ids, self._client_sharding)
+        # legacy-dispatch observability: one put per chaos operand +
+        # per array key + the three grids, plus the five jnp.asarray
+        # scalar transfers below (what staged mode collapses per dtype)
+        self.last_dispatch_puts = len(chaos_args) + len(arrays) + 3 + 5
+        self.last_staged_bytes = int(
+            sum(int(a.nbytes) for a in chaos_args) +
+            sum(int(a.nbytes) for a in arrays.values()) +
+            sample_mask.nbytes + client_mask.nbytes + client_ids.nbytes)
 
         params, opt_state, strategy_state, vecs = self._round_step(
             state.params, state.opt_state, state.strategy_state,
@@ -996,12 +1318,13 @@ class RoundEngine:
         return new_state, PackedStats(vecs, packer, rounds=1, stacked=False)
 
     # ------------------------------------------------------------------
-    def _stage_arrays(self, batches: list, sharding):
-        """Device-stage the data inputs of one round (``[batch]``) or a
-        fused chunk (stacked on a leading round axis).
+    def _host_arrays(self, batches: list) -> Tuple[Dict[str, np.ndarray],
+                                                   tuple]:
+        """Assemble the data inputs of one round (``[batch]``) or a fused
+        chunk (stacked on a leading round axis) as HOST numpy arrays.
 
-        Host-packed ``RoundBatch``es stage their gathered feature arrays;
-        ``IndexRoundBatch``es stage only the int32 index grid and ride the
+        Host-packed ``RoundBatch``es carry their gathered feature arrays;
+        ``IndexRoundBatch``es carry only the int32 index grid and ride the
         resident pool (``attach_pool``) as a trailing program operand.
         """
         from ..data.batching import IndexRoundBatch
@@ -1017,10 +1340,17 @@ class RoundEngine:
             return vals[0] if len(vals) == 1 else np.stack(vals)
 
         if is_idx:
-            idx = stack(lambda b: b.indices)
-            return {"__idx__": jax.device_put(idx, sharding)}, (self._pool,)
-        return {k: jax.device_put(stack(lambda b: b.arrays[k]), sharding)
+            return {"__idx__": stack(lambda b: b.indices)}, (self._pool,)
+        return {k: stack(lambda b: b.arrays[k])
                 for k in batches[0].arrays}, ()
+
+    def _stage_arrays(self, batches: list, sharding):
+        """Legacy (``input_staging: false``) per-leaf device staging of
+        the round's data inputs."""
+        host, pool_args = self._host_arrays(batches)
+        # flint: disable=put-loop legacy non-staged dispatch path, kept for the staging A/B (tools/dispatch_cost_probe.py)
+        return {k: jax.device_put(v, sharding)
+                for k, v in host.items()}, pool_args
 
     # ------------------------------------------------------------------
     def dispatch_rounds(self, state: ServerState, batches: list,
@@ -1037,6 +1367,10 @@ class RoundEngine:
         the server's software-pipelined loop — the host is free to consume
         the previous chunk's results while this one executes."""
         R = len(batches)
+        if self.input_staging:
+            return self._dispatch_staged(
+                state, batches, client_lrs, server_lrs, rng,
+                leakage_threshold, quant_thresholds, chaos_vecs)
         if R == 1:
             return self.run_round(
                 state, batches[0], client_lrs[0], server_lrs[0], rng,
@@ -1054,6 +1388,11 @@ class RoundEngine:
             np.stack([b.client_mask for b in batches]), stacked_sharding)
         client_ids = jax.device_put(
             np.stack([b.client_ids for b in batches]), stacked_sharding)
+        self.last_dispatch_puts = len(chaos_args) + len(arrays) + 3 + 5
+        self.last_staged_bytes = int(
+            sum(int(a.nbytes) for a in chaos_args) +
+            sum(int(a.nbytes) for a in arrays.values()) +
+            sample_mask.nbytes + client_mask.nbytes + client_ids.nbytes)
         rngs = jax.random.split(rng, R)
 
         fn = self._multi_round_fn(R)

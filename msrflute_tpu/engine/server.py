@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -66,7 +67,11 @@ class OptimizationServer:
 
         sc = config.server_config
         dp = config.dp_config
-        strategy_cls = select_strategy(config.strategy)
+        #: universal overlap (PR 6): device-resident strategy carry state
+        #: — consulted by strategy selection, the host-orchestrated
+        #: predicate, and the RL construction below
+        self._fused_carry = bool(sc.get("fused_carry", False))
+        strategy_cls = self._select_strategy(config)
         if sc.get("robust"):
             # fluteshield (server_config.robust): a stack aggregator
             # (trimmed_mean / median) swaps in the stack-combining
@@ -78,6 +83,14 @@ class OptimizationServer:
             self.strategy = select_robust_strategy(config, dp, strategy_cls)
         else:
             self.strategy = strategy_cls(config, dp)
+        # universal overlap (server_config.fused_carry): strategies whose
+        # cross-round state moved into device-resident carry tables
+        # (SCAFFOLD controls, EF residuals, personalization heads/alphas)
+        # size those tables to the client pool; a no-op for strategies
+        # without carry state
+        fused_carry = self._fused_carry
+        if fused_carry:
+            self.strategy.carry_clients = len(train_dataset)
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
         #: fluteshield screening policy (None = firewall path); the ONE
         #: live Shield belongs to the engine — the server reads its
@@ -86,12 +99,22 @@ class OptimizationServer:
         # Host-orchestrated round paths (RL, SCAFFOLD/EF host rounds,
         # personalization's overridden sampling) build their payloads
         # outside the fused round program — the ONE predicate both the
-        # fluteshield and the chaos guards below key off.
+        # fluteshield and the chaos guards below key off.  fused_carry
+        # lifts these strategy by strategy: a carry-mode SCAFFOLD/EF run
+        # clears its host_rounds/ef_rounds flag at construction, fused RL
+        # rides the round program (rl/fused.py), and a server subclass
+        # whose ``_sample`` hook degrades to the base sampler under
+        # fused_carry declares it with ``fused_carry_sample``
+        # (personalization).
+        self._sample_hooked = (
+            type(self)._sample is not OptimizationServer._sample and
+            not (fused_carry and
+                 getattr(type(self), "fused_carry_sample", False)))
         host_orchestrated = (
-            sc.get("wantRL", False) or
+            (sc.get("wantRL", False) and not fused_carry) or
             getattr(self.strategy, "host_rounds", False) or
             getattr(self.strategy, "ef_rounds", False) or
-            type(self)._sample is not OptimizationServer._sample)
+            self._sample_hooked)
         if self.shield is not None:
             if host_orchestrated:
                 raise ValueError(
@@ -130,26 +153,29 @@ class OptimizationServer:
         # pipeline_depth (schema knob, default 1): with depth >= 1 the
         # host drains round k's tail (stats decode, metric logging,
         # privacy processing, checkpoint submit) AFTER dispatching round
-        # k+1, so the TPU never idles behind host bookkeeping.  Depth 0
-        # restores the serial loop.  Host-orchestrated paths (RL,
-        # SCAFFOLD, EF, server replay, personalization's per-round
-        # personal pass) and the adaptive leakage threshold feed host
-        # results back into the NEXT dispatch, so they force serial —
-        # computed here, up front, because the checkpoint-async default
-        # below depends on it.
-        self.pipeline_depth = min(int(sc.get("pipeline_depth", 1) or 0), 1)
+        # k+1, so the TPU never idles behind host bookkeeping.  Depth N
+        # keeps a ring of up to N dispatched-but-undrained chunks in
+        # flight (schema-validated against MAX_PIPELINE_DEPTH — the old
+        # silent min(depth, 1) clamp is gone).  Depth 0 restores the
+        # serial loop.  Paths that feed host results back into the NEXT
+        # dispatch (host-orchestrated RL/SCAFFOLD/EF — i.e. without
+        # fused_carry — server replay, the adaptive leakage threshold,
+        # a live ``_sample`` hook) force serial — computed here, up
+        # front, because the checkpoint-async default below depends on
+        # it.
+        self.pipeline_depth = max(int(sc.get("pipeline_depth", 1) or 0), 0)
         pm_cfg = config.privacy_metrics_config
         wants_adaptive = bool(
             pm_cfg is not None and pm_cfg.get("apply_metrics", False)
             and pm_cfg.get("adaptive_leakage_threshold"))
         self._pipeline_capable = (
-            not sc.get("wantRL", False) and
+            not (sc.get("wantRL", False) and not fused_carry) and
             not getattr(self.strategy, "host_rounds", False) and
             not getattr(self.strategy, "ef_rounds", False) and
             not (sc.server_replay_config is not None and
                  server_train_dataset is not None) and
             not wants_adaptive and
-            type(self)._sample is OptimizationServer._sample)
+            not self._sample_hooked)
         # pipelined loops route the per-round `latest` save through the
         # async writer by default so serialization never blocks the next
         # dispatch; an explicit `checkpoint_async:` in the config wins.
@@ -203,8 +229,12 @@ class OptimizationServer:
         self.best_val: Dict[str, Metric] = {}
 
         # RL meta-aggregation (reference server_config.wantRL + extensions/RL)
+        # — the HOST path (double-aggregate + val A/B + reward, three host
+        # round trips).  Under fused_carry the tuner instead rides the
+        # round program as device-resident carry (rl/fused.py): the engine
+        # owns it and no host RLAggregator is built.
         self.rl = None
-        if sc.get("wantRL", False):
+        if sc.get("wantRL", False) and not fused_carry:
             from ..rl import RLAggregator
             from ..config import RLConfig
             rl_cfg = sc.RL if sc.RL is not None else RLConfig.from_dict({})
@@ -479,6 +509,14 @@ class OptimizationServer:
                        f"{self.ef_store.n_params} ({gb:.2f} GiB HBM)")
 
     # ------------------------------------------------------------------
+    def _select_strategy(self, config) -> type:
+        """The strategy class this server will construct.  Subclasses
+        whose behavior moved into a device-carry strategy under
+        ``fused_carry`` override this (PersonalizationServer swaps in
+        PersonalizedFedAvg); the base server keeps the registry lookup."""
+        return select_strategy(config.strategy)
+
+    # ------------------------------------------------------------------
     def _tspan(self, name: str, **args):
         """One flutescope span — the shared no-op context when telemetry
         is off (the off path costs one attribute read + None check)."""
@@ -665,7 +703,11 @@ class OptimizationServer:
         pipelined = self.pipeline_depth > 0 and self._pipeline_ok()
         if pipelined:
             prefetch_ok = False
-        pending = None  # the dispatched-but-undrained chunk (depth-1 slot)
+        # the ring of dispatched-but-undrained chunks, oldest first: up to
+        # ``pipeline_depth`` stay in flight; each dispatch drains the
+        # oldest once the ring is full, so with depth N the host tail of
+        # chunk k overlaps the device execution of chunks k+1..k+N
+        pending: deque = deque()
         self._last_fence = 0.0
 
         round_no = self.state.round
@@ -748,13 +790,15 @@ class OptimizationServer:
                     log_metric("Quantization Thresh.", self.quant_thresh,
                                step=round_no + j)
 
-            if pending is not None:
-                # submit the pending chunk's `latest` checkpoint BEFORE
+            for ch in pending:
+                # submit each pending chunk's `latest` checkpoint BEFORE
                 # this dispatch donates its state buffers: the async
                 # writer enqueues device-side copies that execute in
-                # stream order, ahead of the donating program
-                self.ckpt.save_latest(pending["state"])
-                pending["latest_saved"] = True
+                # stream order, ahead of the donating program (only the
+                # newest ring entry can still be unsaved)
+                if not ch["latest_saved"]:
+                    self.ckpt.save_latest(ch["state"])
+                    ch["latest_saved"] = True
             chaos_vecs = None
             if self.engine.chaos_client_faults or \
                     self.engine.chaos_corruption:
@@ -823,38 +867,43 @@ class OptimizationServer:
             self._chunks_run += 1
             round_no += R
 
-            if pending is not None:
-                # drain the PREVIOUS chunk's host tail while the device
-                # executes the chunk just dispatched — the pipeline
-                self._drain_chunk(pending, val_freq, rec_freq)
+            while len(pending) >= self.pipeline_depth and pending:
+                # ring full: drain the OLDEST chunk's host tail while the
+                # device executes the newer ones (incl. the chunk just
+                # dispatched) — the pipeline.  Depth 1 reproduces the
+                # original one-deep behavior exactly.
+                self._drain_chunk(pending.popleft(), val_freq, rec_freq)
                 self.pipelined_chunks += 1
-                pending = None
             # the tail at an eval/housekeeping boundary can change LRs,
             # params (fall-back), and sampling-relevant state for the
-            # NEXT round, so the pipeline must drain before dispatching
+            # NEXT round, so the whole ring must drain before dispatching
             # past it; the final chunk always drains here too
             boundary = (round_no >= max_iteration or
                         round_no % val_freq == 0 or
                         (round_no % rec_freq == 0 and
                          self.test_dataset is not None))
             if pipelined and not boundary:
-                pending = chunk
+                pending.append(chunk)
             else:
+                while pending:
+                    self._drain_chunk(pending.popleft(), val_freq,
+                                      rec_freq)
+                    self.pipelined_chunks += 1
                 self._drain_chunk(chunk, val_freq, rec_freq)
-        if pending is not None:
-            # preemption landed with a chunk in flight: the device work
-            # is already done, so drain it normally — its housekeeping
-            # writes the per-round `latest` checkpoint, making those
-            # rounds part of the resume anchor instead of lost work.
-            # (Nothing speculative beyond this slot is ever dispatched.)
-            # The drain window is a first-class span: checkpoint stalls
-            # inside a preemption grace period are exactly what a trace
-            # reader needs to see.
-            with self._tspan("preempt_drain", round0=pending["round0"],
-                             rounds=pending["R"]):
-                self._drain_chunk(pending, val_freq, rec_freq)
+        while pending:
+            # preemption landed with chunks in flight: the device work is
+            # already done, so drain the ring in dispatch order — each
+            # chunk's housekeeping writes the per-round `latest`
+            # checkpoint, making those rounds part of the resume anchor
+            # instead of lost work.  (Nothing speculative beyond the ring
+            # is ever dispatched.)  The drain window is a first-class
+            # span: checkpoint stalls inside a preemption grace period
+            # are exactly what a trace reader needs to see.
+            ch = pending.popleft()
+            with self._tspan("preempt_drain", round0=ch["round0"],
+                             rounds=ch["R"]):
+                self._drain_chunk(ch, val_freq, rec_freq)
             self.pipelined_chunks += 1
-            pending = None
         self.ckpt.wait()  # async checkpoint saves must be durable on return
         if self.preemption.requested and round_no < max_iteration:
             # resumable exit: every completed round is checkpointed and
@@ -1591,6 +1640,7 @@ class OptimizationServer:
             spec = (P(CLIENTS_AXIS) if self.engine.partition_mode ==
                     "shard_map" else P())
             sharding = NamedSharding(self.mesh, spec)
+            # flint: disable=put-loop eval batches staged once and cached across evals
             batches = {k: jax.device_put(v, sharding)
                        for k, v in batches.items()}
             self._eval_batches_cache[split] = batches

@@ -1,0 +1,195 @@
+"""Fused RL — the DQN aggregation-weight tuner as device-resident carry.
+
+The host RL path (``rl/rl.py`` + ``engine/server.py::_run_rl_round``)
+aggregates twice per round, validates both candidates, and rewards the
+policy from the val-accuracy comparison — three host round trips that
+force the serial loop.  This module is the overlap-capable variant
+(``server_config.wantRL + fused_carry``): the whole tuner — Q-network
+params, optimizer state, replay ring, epsilon schedule, and the delayed
+experience — rides ``strategy_state`` as donated device buffers, and one
+traced :meth:`combine` call per round
+
+- finalizes LAST round's experience with its delayed reward (the
+  round-over-round TRAIN-loss delta, discretized exactly like the host
+  reward: +1 improved / 0.1 within 1e-3 / -1 regressed),
+- pushes it into the on-device replay ring and takes one DQN step over a
+  uniformly sampled minibatch,
+- picks this round's action epsilon-greedily (annealed in-program) and
+  re-weights the gathered client payload stack with ``exp(action)``
+  (the reference ``weights_from_action`` map, NaN/Inf -> 0).
+
+Documented tradeoffs vs the host path: the reward signal is the train
+loss (one round delayed) instead of a val-accuracy A/B, the RL weights
+are always applied (no keep-better arbitration — the policy must learn
+to be no worse than the strategy weights), and ``wantLSTM``'s state
+window stays host-only.  What it buys: zero host syncs, so RL runs fully
+pipelined with bit-identical serial-vs-pipelined trajectories.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..optim import make_optimizer
+
+
+class FusedRL:
+    """In-program DQN weight tuner over a fixed ``K``-client cohort."""
+
+    #: per-client feature count (weight, magnitude, mean, variance —
+    #: the reference state layout, ``dga.py:305``)
+    N_FEATS = 4
+
+    def __init__(self, rl_config, cohort_k: int):
+        self.cfg = rl_config
+        self.k = int(cohort_k)
+        self.in_dim = self.N_FEATS * self.k
+        self.eps0 = float(rl_config.get("initial_epsilon", 0.5))
+        self.final_eps = float(rl_config.get("final_epsilon", 1e-4))
+        self.eps_gamma = float(rl_config.get("epsilon_gamma", 0.9))
+        self.minibatch = int(rl_config.get("minibatch_size", 16))
+        self.max_memory = int(rl_config.get("max_replay_memory_size", 1000))
+        params_spec = rl_config.get("network_params") or \
+            [self.in_dim, 128, 128, self.k]
+        if isinstance(params_spec, str):
+            params_spec = [int(x) for x in params_spec.split(",")]
+        self.sizes = tuple(int(x) for x in params_spec[1:])
+        if self.sizes[-1] != self.k:
+            raise ValueError(
+                f"fused RL network_params output size {self.sizes[-1]} != "
+                f"padded cohort size {self.k}")
+        import flax.linen as nn
+
+        class _Net(nn.Module):
+            sizes: tuple
+
+            @nn.compact
+            def __call__(self, x):
+                for h in self.sizes[:-1]:
+                    x = nn.relu(nn.Dense(h)(x))
+                return nn.Dense(self.sizes[-1])(x)
+
+        self.net = _Net(sizes=self.sizes)
+        self.tx = make_optimizer(rl_config.optimizer_config)
+
+    # ------------------------------------------------------------------
+    def init_state(self, rng: jax.Array) -> Dict[str, Any]:
+        params = self.net.init(jax.random.fold_in(rng, 0xF),
+                               jnp.zeros((self.in_dim,)))["params"]
+        m = self.max_memory
+        return {
+            "net": params,
+            "opt": self.tx.init(params),
+            "replay_s": jnp.zeros((m, self.in_dim), jnp.float32),
+            "replay_a": jnp.zeros((m, self.k), jnp.float32),
+            "replay_r": jnp.zeros((m,), jnp.float32),
+            "count": jnp.zeros((), jnp.int32),
+            "ptr": jnp.zeros((), jnp.int32),
+            "eps": jnp.asarray(self.eps0, jnp.float32),
+            # delayed experience: last round's (state, action, loss)
+            "prev_s": jnp.zeros((self.in_dim,), jnp.float32),
+            "prev_a": jnp.zeros((self.k,), jnp.float32),
+            "prev_loss": jnp.zeros((), jnp.float32),
+            "have_prev": jnp.zeros((), jnp.float32),
+        }
+
+    # ------------------------------------------------------------------
+    def combine(self, state: Dict[str, Any], per_client: Dict[str, Any],
+                stack_tree: Any, cur_loss: jnp.ndarray, rng: jax.Array
+                ) -> Tuple[Any, Dict[str, Any], Dict[str, jnp.ndarray]]:
+        """One traced RL round: delayed reward -> replay push -> DQN step
+        -> epsilon-greedy action -> re-weighted aggregate.
+
+        ``per_client``: ``{"w","mag","mean","var"}`` each ``[K]``;
+        ``stack_tree``: the full per-client payload stack (each leaf
+        ``[K, ...]``); ``cur_loss``: this round's mean train loss.
+        Returns ``(aggregate, new_state, rl_stats)``.
+        """
+        w = per_client["w"]
+        k_act = int(w.shape[0])
+        if k_act > self.k:
+            raise ValueError(
+                f"fused RL cohort {k_act} exceeds the configured "
+                f"num_clients_per_iteration grid ({self.k})")
+        pad = self.k - k_act  # dataset smaller than ncpi: zero-pad feats
+        state_vec = jnp.concatenate([
+            jnp.pad(per_client[f], (0, pad))
+            for f in ("w", "mag", "mean", "var")
+        ]).astype(jnp.float32)
+        state_vec = jnp.nan_to_num(state_vec, nan=0.0, posinf=0.0,
+                                   neginf=0.0)
+
+        # -- delayed reward for LAST round's action (discretized like the
+        # host compute_reward, over train-loss improvement) --------------
+        delta = state["prev_loss"] - cur_loss
+        reward = jnp.where(jnp.abs(delta) < 1e-3, 0.1,
+                           jnp.where(delta > 0, 1.0, -1.0))
+        reward = reward * state["have_prev"]
+        # push (prev_s, prev_a, reward) into the ring only when it exists;
+        # a dropped write targets index max_memory (out of bounds -> drop)
+        slot = jnp.where(state["have_prev"] > 0, state["ptr"],
+                         self.max_memory)
+        replay_s = state["replay_s"].at[slot].set(state["prev_s"],
+                                                  mode="drop")
+        replay_a = state["replay_a"].at[slot].set(state["prev_a"],
+                                                  mode="drop")
+        replay_r = state["replay_r"].at[slot].set(reward, mode="drop")
+        pushed = (state["have_prev"] > 0).astype(jnp.int32)
+        count = jnp.minimum(state["count"] + pushed, self.max_memory)
+        ptr = (state["ptr"] + pushed) % self.max_memory
+
+        # -- one DQN step over a uniform minibatch (no-op until the ring
+        # holds at least one experience) ---------------------------------
+        idx = jax.random.randint(jax.random.fold_in(rng, 1),
+                                 (self.minibatch,), 0,
+                                 jnp.maximum(count, 1))
+        bs, ba, br = replay_s[idx], replay_a[idx], replay_r[idx]
+
+        def loss_fn(p):
+            q = jnp.sum(self.net.apply({"params": p}, bs) * ba, axis=-1)
+            return jnp.mean((q - br) ** 2)
+
+        qloss, grads = jax.value_and_grad(loss_fn)(state["net"])
+        updates, new_opt = self.tx.update(grads, state["opt"], state["net"])
+        stepped = optax.apply_updates(state["net"], updates)
+        new_net = jax.tree.map(lambda new, old: jnp.where(count > 0,
+                                                          new, old),
+                               stepped, state["net"])
+        new_opt = jax.tree.map(lambda new, old: jnp.where(count > 0,
+                                                          new, old),
+                               new_opt, state["opt"])
+        qloss = qloss * (count > 0).astype(jnp.float32)
+
+        # -- epsilon-greedy action for THIS round ------------------------
+        explore = jax.random.uniform(jax.random.fold_in(rng, 2)) <= \
+            state["eps"]
+        rand_action = jax.random.uniform(jax.random.fold_in(rng, 3),
+                                         (self.k,))
+        net_action = self.net.apply({"params": new_net}, state_vec)
+        action = jnp.where(explore, rand_action, net_action)
+        action_k = action[:k_act]
+        # reference weights_from_action: exp(action), NaN/Inf -> 0; gate
+        # on the strategy weight so padding/dropped clients stay out
+        rl_w = jnp.nan_to_num(jnp.exp(action_k), nan=0.0, posinf=0.0,
+                              neginf=0.0) * (w > 0).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(rl_w), 1e-12)
+        agg = jax.tree.map(
+            lambda g: jnp.tensordot(rl_w.astype(g.dtype), g,
+                                    axes=[[0], [0]]) / denom.astype(g.dtype),
+            stack_tree)
+
+        new_eps = jnp.where(state["eps"] * self.eps_gamma > self.final_eps,
+                            state["eps"] * self.eps_gamma, state["eps"])
+        new_state = dict(
+            state, net=new_net, opt=new_opt, replay_s=replay_s,
+            replay_a=replay_a, replay_r=replay_r, count=count, ptr=ptr,
+            eps=new_eps, prev_s=state_vec, prev_a=action,
+            prev_loss=cur_loss, have_prev=jnp.ones((), jnp.float32))
+        rl_stats = {"rl_reward": reward, "rl_qloss": qloss,
+                    "rl_epsilon": state["eps"],
+                    "rl_explored": explore.astype(jnp.float32)}
+        return agg, new_state, rl_stats

@@ -182,6 +182,14 @@ WATCHDOG_FIELD_SPECS = {
 #: watchdog detector actions (telemetry/watchdog.py ACTIONS)
 ALLOWED_WATCHDOG_ACTIONS = ["off", "log", "mark", "abort"]
 
+#: documented upper bound on ``server_config.pipeline_depth`` (the ring
+#: of in-flight dispatched-but-undrained round chunks): each slot holds
+#: a full set of staged round inputs + a packed-stats output buffer in
+#: HBM, and past the point where the host tail is fully hidden extra
+#: depth only adds memory and preemption-drain latency.  Validation
+#: REFUSES larger values (the PR-1 silent clamp is gone).
+MAX_PIPELINE_DEPTH = 8
+
 CHAOS_FIELD_SPECS = {
     "enable": ("bool", None, None),
     "seed": ("int", 0, None),
@@ -237,6 +245,18 @@ SERVER_KEYS = {
     # which widens the crash window: after a hard crash status_log.json
     # may be one round ahead of latest_model — see docs/RUNBOOK.md).
     "pipeline_depth",
+    # fused_carry: universal overlap (PR 6) — move cross-round strategy
+    # state (SCAFFOLD controls, EF residuals, personalization
+    # heads/alphas, the RL weight tuner) into device-resident carry
+    # operands of the fused round program so those strategies run
+    # pipelined instead of host-orchestrated serial; see
+    # docs/config_extensions.md for the per-strategy tradeoffs
+    "fused_carry",
+    # input_staging: single-buffer host->device dispatch staging (one
+    # packed transfer per dtype group instead of ~8-10 per-leaf
+    # device_puts per round) — default on; set false to A/B the legacy
+    # per-leaf path (tools/dispatch_cost_probe.py)
+    "input_staging",
     "rounds_per_step", "clients_per_chunk", "checkpoint_backend",
     "checkpoint_async", "compilation_cache_dir", "secure_agg", "fedbuff",
     "dump_norm_stats", "scaffold_device_controls", "scaffold_flush_freq",
@@ -310,6 +330,8 @@ SERVER_FIELD_SPECS = {
     "scaffold_device_controls": ("bool", None, None),
     "dump_norm_stats": ("bool", None, None),
     "pipeline_depth": ("int", 0, None),
+    "fused_carry": ("bool", None, None),
+    "input_staging": ("bool", None, None),
     "rounds_per_step": ("int", 1, None),
     "clients_per_chunk": ("int", 1, None),
     "model_backup_freq": ("int", 1, None),
@@ -682,6 +704,20 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                     _check_enum(errors, wd,
                                 "server_config.telemetry.watchdog", key,
                                 ALLOWED_WATCHDOG_ACTIONS)
+        # pipeline_depth keeps a bespoke upper bound the inclusive range
+        # table cannot document: the donated ring costs HBM per slot and
+        # the old engine-side min(depth, 1) clamp silently ignored the
+        # config — refusal with the bound beats clamping
+        pd = sc.get("pipeline_depth")
+        if isinstance(pd, int) and not isinstance(pd, bool) and \
+                pd > MAX_PIPELINE_DEPTH:
+            errors.append(
+                f"server_config.pipeline_depth: {pd} exceeds the "
+                f"supported maximum {MAX_PIPELINE_DEPTH} — each depth "
+                "slot keeps a full round chunk's staged inputs and "
+                "packed stats resident in device memory, and depth past "
+                "the host-tail/device-round ratio buys nothing; lower "
+                "it (see docs/RUNBOOK.md pipeline tuning)")
         ncpi = sc.get("num_clients_per_iteration")
         if ncpi is not None and not isinstance(ncpi, int):
             if not (isinstance(ncpi, str) and ":" in ncpi):

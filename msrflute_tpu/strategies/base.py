@@ -77,6 +77,18 @@ class BaseStrategy:
     #: ``self_id``/``self_mask`` — what a secure-aggregation client needs
     #: to derive its pairwise masks
     wants_cohort: bool = False
+    #: device-resident carry state (universal overlap, PR 6): the
+    #: strategy's cross-round per-client tables (SCAFFOLD controls, EF
+    #: residuals, personalization heads/alphas) live INSIDE
+    #: ``strategy_state`` as donated device buffers.  The engine then
+    #: calls :meth:`client_step_carry` (which gathers this client's table
+    #: row in-program) and :meth:`apply_carry` (which scatters the
+    #: round's updated rows back), so the round-k -> k+1 data dependency
+    #: never touches the host and the server's serial fallback is lifted.
+    device_carry: bool = False
+    #: total client-pool size for the carry tables; the server sets this
+    #: (``len(train_dataset)``) before ``init_state`` builds the tables
+    carry_clients: int = 0
 
     def __init__(self, config, dp_config=None):
         self.config = config
@@ -191,6 +203,29 @@ class BaseStrategy:
         record per-client diagnostics for the same-trace caller (e.g. the
         pre-clip update norm for adaptive clipping)."""
         return pseudo_grad, weight
+
+    # ---- traced, per-client carry (device_carry strategies) ----------
+    def client_step_carry(self, client_update, global_params, arrays,
+                          sample_mask, client_lr, rng, *, client_id,
+                          live_mask, round_idx=None, leakage_threshold=None,
+                          quant_threshold=None, strategy_state=None):
+        """Carry-mode client step: like :meth:`client_step` but the
+        strategy gathers its own per-client table row from
+        ``strategy_state`` by ``client_id`` and additionally returns a
+        ``carry`` pytree (``{"row": ..., "keep": 0/1, ...}``) that
+        :meth:`apply_carry` scatters back after aggregation.
+        ``live_mask`` is this client's 0/1 presence (mesh padding + chaos
+        dropout already folded in)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement device-carry mode")
+
+    def apply_carry(self, state: Any, client_ids, carry: Any,
+                    rng: Optional[jax.Array] = None) -> Any:
+        """Scatter the round's per-client carry rows into the state's
+        tables (traced, replicated; runs once per round after combine).
+        Rows whose ``keep`` gate is 0 must leave the table untouched."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement device-carry mode")
 
     # ---- traced, pre-dispatch (replicated) ---------------------------
     def broadcast_params(self, params: Any, state: Any) -> Any:

@@ -233,6 +233,7 @@ class DeviceResidualTable:
             rows = store.rows(np.asarray(chunk, np.int64))
             self.table = self._scatter(
                 self.table, jnp.asarray(chunk, jnp.int32),
+                # flint: disable=put-loop one-time table warm-up at construction
                 jax.device_put(rows, self._rep))
         self._dirty = set()
 
@@ -300,6 +301,23 @@ class EFQuant(FedAvg):
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
+        # fused carry mode (server_config.fused_carry): the [N, n_params]
+        # residual table rides strategy_state as a donated device buffer;
+        # the EF correct/quantize/remember cycle happens inside the vmap'd
+        # client body and the round pipelines like FedAvg (PR 6).
+        sc = getattr(config, "server_config", None)
+        self.fused = bool(sc is not None and sc.get("fused_carry", False))
+        if self.fused:
+            self.ef_rounds = False
+            self.device_carry = True
+            if dp_config is not None and dp_config.get("adaptive_clipping"):
+                raise ValueError(
+                    "strategy: ef_quant with fused_carry does not compose "
+                    "with dp_config.adaptive_clipping — the carry state "
+                    "holds only the EF residual table, so the quantile-"
+                    "tracking clip state would silently freeze at "
+                    "max_grad; drop fused_carry (host EF round) or "
+                    "adaptive_clipping")
         cc = config.client_config
         self.quant_bits = int(cc.get("quant_bits", 4))
         self.quant_thresh = float(cc.get("quant_thresh", 0.0))
@@ -313,6 +331,61 @@ class EFQuant(FedAvg):
             raise ValueError(
                 f"ef_quant quant_thresh is an |.|-quantile in [0, 1), "
                 f"got {self.quant_thresh}")
+
+    # ---- fused carry mode (server_config.fused_carry) ----------------
+    def init_state(self, params_like):
+        if not self.fused:
+            return super().init_state(params_like)
+        if not self.carry_clients:
+            raise ValueError(
+                "fused_carry ef_quant needs carry_clients (the total "
+                "client-pool size) set before init_state — the server "
+                "does this from len(train_dataset)")
+        n_params = sum(int(np.prod(leaf.shape))
+                       for leaf in jax.tree.leaves(params_like))
+        return {"res": jnp.zeros((int(self.carry_clients), n_params),
+                                 jnp.float32)}
+
+    def client_step_carry(self, client_update, global_params, arrays,
+                          sample_mask, client_lr, rng, *, client_id,
+                          live_mask, round_idx=None, leakage_threshold=None,
+                          quant_threshold=None, strategy_state=None):
+        from jax.flatten_util import ravel_pytree
+        from ..ops.quantization import quantize_array
+        # the payload post local-DP transform — exactly what the host EF
+        # round compresses (DP before EF, so the residual never absorbs
+        # the noise-free signal)
+        parts, tl, ns, stats = super().client_step(
+            client_update, global_params, arrays, sample_mask, client_lr,
+            rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
+            quant_threshold=None, strategy_state=None)
+        pg, w = parts["default"]
+        pg_flat, unravel = ravel_pytree(pg)
+        n_rows = strategy_state["res"].shape[0]
+        valid = (client_id >= 0).astype(jnp.float32)
+        res = strategy_state["res"][jnp.clip(client_id, 0, n_rows - 1)] \
+            * valid
+        corrected = pg_flat + res
+        # per-round annealed threshold rides the quant_threshold operand
+        # (the server's quant_anneal schedule, same metric log); -1 means
+        # "not configured" -> the strategy's static default
+        thresh = jnp.where(quant_threshold >= 0, quant_threshold,
+                           self.quant_thresh) if quant_threshold is not None \
+            else self.quant_thresh
+        q = quantize_array(corrected, n_bins=2 ** self.quant_bits,
+                           quant_threshold=thresh, approx=self.quant_approx)
+        new_res = corrected - q
+        parts = dict(parts)
+        parts["default"] = (unravel(q), w)
+        keep = valid * live_mask * (w > 0).astype(jnp.float32)
+        carry = {"row": jnp.where(keep > 0, new_res, res), "keep": keep}
+        return parts, tl, ns, stats, carry
+
+    def apply_carry(self, state, client_ids, carry, rng=None):
+        rows, keep = carry["row"], carry["keep"]
+        n_rows = state["res"].shape[0]
+        idx = jnp.where(keep > 0, client_ids, n_rows)
+        return {"res": state["res"].at[idx].set(rows, mode="drop")}
 
     def next_threshold(self) -> float:
         """Anneal the sparsification threshold per round — the same

@@ -1,0 +1,132 @@
+"""Fused personalization — per-user local models + alphas as carry state.
+
+The host personalization path (``engine/personalization.py``) runs a
+separate jitted personal pass inside an overridden ``_sample()`` hook,
+which reads the live global params per round and therefore forces the
+server's serial fallback.  With ``server_config.fused_carry: true`` the
+PersonalizationServer swaps in this strategy instead: the per-user local
+models (flat, ravel-pytree order), interpolation ``alpha``s, and a
+``seen`` gate live in ``strategy_state`` as donated ``[N, ...]`` device
+buffers, and each sampled client's local pass + alpha SGD step runs
+inside the SAME vmap'd client body as the global pass — the round
+pipelines like FedAvg (universal overlap, PR 6).
+
+Cold-start semantics match ``personalization_init: global`` (the
+default): a user's first participation clones the round's live global
+params in-program (``seen == 0`` selects the broadcast params over the
+table row).  ``random``/``initial`` init would need per-user host state
+and stay on the host path.  The personalized convex-interpolation eval
+reads the tables back with one explicit fetch at eval boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fedavg import FedAvg
+
+
+class PersonalizedFedAvg(FedAvg):
+    """FedAvg aggregation + in-program per-user personalization carry."""
+
+    device_carry = True
+    supports_staleness = False
+    supports_rl = False
+
+    def __init__(self, config, dp_config=None):
+        super().__init__(config, dp_config)
+        if dp_config is not None and dp_config.get("enable_local_dp", False):
+            raise ValueError(
+                "fused_carry personalization does not compose with "
+                "dp_config.enable_local_dp — the alpha update reads the "
+                "raw global pseudo-gradient; use the host personalization "
+                "path (drop fused_carry) for DP runs")
+        cc = config.client_config
+        self.alpha0 = float(cc.get("convex_model_interp", 0.75))
+        sc = config.server_config
+        init_kind = sc.get("personalization_init", "global")
+        if init_kind != "global":
+            raise ValueError(
+                f"fused_carry personalization supports only "
+                f"personalization_init: global (got {init_kind!r}) — "
+                "random/initial init needs per-user host state; drop "
+                "fused_carry for those modes")
+
+    # ------------------------------------------------------------------
+    def init_state(self, params_like: Any) -> Any:
+        if not self.carry_clients:
+            raise ValueError(
+                "fused_carry personalization needs carry_clients (the "
+                "total client-pool size) set before init_state — the "
+                "server does this from len(train_dataset)")
+        n_params = sum(int(np.prod(leaf.shape))
+                       for leaf in jax.tree.leaves(params_like))
+        n = int(self.carry_clients)
+        return {
+            "local": jnp.zeros((n, n_params), jnp.float32),
+            "alpha": jnp.full((n,), self.alpha0, jnp.float32),
+            # 0 until first participation: cold-start clones the live
+            # global params in-program (personalization_init: global)
+            "seen": jnp.zeros((n,), jnp.float32),
+        }
+
+    # ------------------------------------------------------------------
+    def client_step_carry(self, client_update, global_params, arrays,
+                          sample_mask, client_lr, rng, *, client_id,
+                          live_mask, round_idx=None, leakage_threshold=None,
+                          quant_threshold=None, strategy_state=None):
+        from jax.flatten_util import ravel_pytree
+        parts, tl, ns, stats = super().client_step(
+            client_update, global_params, arrays, sample_mask, client_lr,
+            rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
+            quant_threshold=quant_threshold, strategy_state=None)
+        pg_g = parts["default"][0]  # identity transform (no DP): the raw
+        # global-pass pseudo-gradient the alpha update needs
+
+        flat_g, unravel = ravel_pytree(global_params)
+        n_rows = strategy_state["local"].shape[0]
+        idx = jnp.clip(client_id, 0, n_rows - 1)
+        valid = (client_id >= 0).astype(jnp.float32)
+        seen = strategy_state["seen"][idx] * valid
+        lp_flat = jnp.where(seen > 0, strategy_state["local"][idx], flat_g)
+        lp = unravel(lp_flat)
+        alpha = jnp.where(seen > 0, strategy_state["alpha"][idx],
+                          self.alpha0)
+
+        # local-model pass on the same data (engine/personalization.py
+        # per_user, fused into the round program)
+        pg_p, _, _, _ = client_update(
+            lp, arrays, sample_mask, client_lr,
+            jax.random.fold_in(rng, 104729))
+        new_lp = jax.tree.map(lambda w_, g: w_ - g, lp, pg_p)
+        # alpha SGD step on the interpolation objective (reference
+        # utils/utils.py:607-617, post-training params on both sides)
+        dots = jax.tree.map(
+            lambda wg, wp, gg, gp: jnp.sum(
+                ((wg - gg) - (wp - gp)) *
+                (alpha * gg + (1.0 - alpha) * gp)),
+            global_params, lp, pg_g, pg_p)
+        grad_alpha = sum(jax.tree.leaves(dots)) + 0.02 * alpha
+        new_alpha = jnp.clip(alpha - client_lr * grad_alpha, 1e-4, 0.9999)
+        new_alpha = jnp.where(jnp.isfinite(new_alpha), new_alpha,
+                              jnp.asarray(self.alpha0))
+
+        keep = valid * live_mask
+        carry = {"row": ravel_pytree(new_lp)[0], "alpha": new_alpha,
+                 "keep": keep}
+        return parts, tl, ns, stats, carry
+
+    def apply_carry(self, state, client_ids, carry, rng=None):
+        keep_b = carry["keep"] > 0
+        n_rows = state["local"].shape[0]
+        idx = jnp.where(keep_b, client_ids, n_rows)
+        return {
+            "local": state["local"].at[idx].set(carry["row"], mode="drop"),
+            "alpha": state["alpha"].at[idx].set(carry["alpha"],
+                                                mode="drop"),
+            "seen": state["seen"].at[idx].set(1.0, mode="drop"),
+        }

@@ -248,6 +248,7 @@ class DeviceControlTable:
             rows = np.stack([store.ci(cid) for cid in chunk])
             self.table = self._scatter(
                 self.table, jnp.asarray(chunk, jnp.int32),
+                # flint: disable=put-loop one-time table warm-up at construction
                 jax.device_put(rows, self._rep))
         self.c = jax.device_put(store.c.copy(), self._rep)
         self._dirty = set()
@@ -337,7 +338,15 @@ class DeviceControlTable:
 class Scaffold(FedAvg):
     """Aggregation weights are FedAvg's sample counts; the control-variate
     flow is orchestrated by the server's scaffold round
-    (``engine/server.py::_run_scaffold_round``), flagged by ``host_rounds``.
+    (``engine/server.py::_run_scaffold_round``), flagged by ``host_rounds``
+    — OR, with ``server_config.fused_carry: true``, runs entirely inside
+    the fused round program: the ``[N, n_params]`` control table and the
+    server control ``c`` ride ``strategy_state`` as donated device
+    buffers, the per-client offset gather and the option-II scatter are
+    traced ops (``client_step_carry`` / ``apply_carry``), and the round
+    pipelines like FedAvg (universal overlap, PR 6).  In carry mode
+    durability rides the model checkpoint (strategy_state is
+    checkpointed), replacing the host ControlStore files.
     Payload transforms that would corrupt the control update (local DP,
     adaptive clipping, quantization) and non-SGD client optimizers are
     rejected at construction — see ``__init__``."""
@@ -351,6 +360,16 @@ class Scaffold(FedAvg):
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
+        sc = getattr(config, "server_config", None)
+        self.fused = bool(sc is not None and sc.get("fused_carry", False))
+        if self.fused:
+            # instance attrs shadow the class flags: the engine sees a
+            # carry strategy, the server sees no host rounds to run
+            self.host_rounds = False
+            self.device_carry = True
+        cc = getattr(config, "client_config", None)
+        self._epochs = int(cc.get("num_epochs", 1) or 1) if cc is not None \
+            else 1
         # The option-II control update reads the PAYLOAD pseudo-gradient as
         # "sum of corrected SGD steps x lr": anything that breaks that
         # identity would bake garbage into the controls and re-inject it
@@ -403,6 +422,79 @@ class Scaffold(FedAvg):
                     "quantization — the control update would absorb the "
                     "quantization error; drop quant_thresh or use "
                     "fedavg/dga")
+
+    # ---- fused carry mode (server_config.fused_carry) ----------------
+    def init_state(self, params_like):
+        if not self.fused:
+            return super().init_state(params_like)
+        import jax
+        import jax.numpy as jnp
+        if not self.carry_clients:
+            raise ValueError(
+                "fused_carry scaffold needs carry_clients (the total "
+                "client-pool size) set before init_state — the server "
+                "does this from len(train_dataset)")
+        n_params = sum(int(np.prod(leaf.shape))
+                       for leaf in jax.tree.leaves(params_like))
+        return {
+            "c": jnp.zeros((n_params,), jnp.float32),
+            # per-client controls; scatters to dropped rows target index
+            # n_rows (out of bounds -> mode="drop"), like the device table
+            "ci": jnp.zeros((int(self.carry_clients), n_params),
+                            jnp.float32),
+        }
+
+    def client_step_carry(self, client_update, global_params, arrays,
+                          sample_mask, client_lr, rng, *, client_id,
+                          live_mask, round_idx=None, leakage_threshold=None,
+                          quant_threshold=None, strategy_state=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+        _, unravel = ravel_pytree(global_params)
+        n_rows = strategy_state["ci"].shape[0]
+        valid = (client_id >= 0).astype(jnp.float32)
+        ci = strategy_state["ci"][jnp.clip(client_id, 0, n_rows - 1)] * valid
+        # the paper's drift correction c - c_i, zero for padding lanes so
+        # their masked updates stay exact no-ops
+        offset_flat = (strategy_state["c"] - ci) * valid
+        parts, tl, ns, stats = super().client_step(
+            client_update, global_params, arrays, sample_mask, client_lr,
+            rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
+            quant_threshold=quant_threshold, strategy_state=None,
+            grad_offset=unravel(offset_flat))
+        pg, w = parts["default"]
+        pg_flat = ravel_pytree(pg)[0]
+        # real local steps K_i: sample-mask rows with >= 1 real sample,
+        # per epoch — matches the host path's steps computation AND
+        # respects in-program straggler truncation (chaos keeps working)
+        steps = jnp.sum((jnp.sum(sample_mask, axis=-1) > 0)
+                        .astype(jnp.float32)) * float(self._epochs)
+        k_i = jnp.maximum(steps, 1.0)
+        ci_new = ci - strategy_state["c"] + pg_flat / (k_i * client_lr)
+        # participation gate (id >= 0, live, weight > 0): privacy-dropped
+        # and chaos-dropped clients must not leak into the controls
+        keep = valid * live_mask * (w > 0).astype(jnp.float32)
+        carry = {"row": jnp.where(keep > 0, ci_new, ci), "keep": keep}
+        return parts, tl, ns, stats, carry
+
+    def apply_carry(self, state, client_ids, carry, rng=None):
+        import jax.numpy as jnp
+        rows, keep = carry["row"], carry["keep"]
+        n_rows = state["ci"].shape[0]
+        ci_old = state["ci"][jnp.clip(client_ids, 0, n_rows - 1)]
+        keep_b = keep > 0
+        delta = jnp.where(keep_b[:, None], rows - ci_old, 0.0)
+        new_c = state["c"] + delta.sum(axis=0) / max(
+            float(self.carry_clients), 1.0)
+        idx = jnp.where(keep_b, client_ids, n_rows)
+        new_ci = state["ci"].at[idx].set(rows, mode="drop")
+        bus = getattr(self, "devbus", None)
+        if bus is not None and bus.enabled:
+            # ‖c‖ rides the packed-stats single transfer (the host path
+            # bundled it into its own fetch; carry mode has no host fetch)
+            bus.publish("scaffold_c_norm", jnp.linalg.norm(new_c))
+        return {"c": new_c, "ci": new_ci}
 
     def update_controls(self, store: ControlStore, client_ids,
                         steps_per_client, pgs_flat: np.ndarray,

@@ -11,7 +11,11 @@ for —
   went;
 - **overlap efficiency**: the fraction of host-tail time that ran while
   a device round was in flight (pipeline health: ~100% means the host
-  tail is fully hidden; ~0% means the pipeline isn't pipelining);
+  tail is fully hidden; ~0% means the pipeline isn't pipelining), plus
+  a per-depth breakdown (``by_depth``): how much of that overlapped
+  tail ran while exactly 1, 2, ... N device rounds were in flight —
+  the depth-N ring's (``server_config.pipeline_depth``) evidence that
+  extra depth is (or is not) buying additional overlap;
 - **fault/event table**: chaos faults, checkpoint recovery/IO faults,
   preemption, watchdog findings — counts per kind;
 - **round span + counters/metrics inventory** so a reader knows what
@@ -97,6 +101,26 @@ def _interval_overlap(a: List[Tuple[float, float]],
     return covered
 
 
+def _depth_segments(ivs: List[Tuple[float, float]]
+                    ) -> Dict[int, List[Tuple[float, float]]]:
+    """Timeline regions keyed by how many ``ivs`` cover them (>= 1) —
+    the rounds-in-flight depth profile of the device windows."""
+    events: List[Tuple[float, int]] = []
+    for lo, hi in ivs:
+        events.append((lo, 1))
+        events.append((hi, -1))
+    events.sort()
+    segs: Dict[int, List[Tuple[float, float]]] = {}
+    depth = 0
+    prev: Optional[float] = None
+    for t, d in events:
+        if prev is not None and depth > 0 and t > prev:
+            segs.setdefault(depth, []).append((prev, t))
+        depth += d
+        prev = t
+    return segs
+
+
 def summarize(run_dir: str) -> Dict[str, Any]:
     """The scope summary for one run directory (see module docstring)."""
     tdir = run_dir
@@ -161,6 +185,16 @@ def summarize(run_dir: str) -> Dict[str, Any]:
             "efficiency_pct": round(100.0 * overlapped / tail_total, 1)
             if tail_total > 0 else 0.0,
         }
+        segs = _depth_segments(device_iv)
+        if segs:
+            # host-tail seconds that ran while exactly d device rounds
+            # were in flight: the depth-N pipeline ring's per-depth
+            # evidence (a depth-3 config whose by_depth has no "2"/"3"
+            # mass is not actually going deeper than 1)
+            out["overlap"]["by_depth"] = {
+                str(d): round(_interval_overlap(host_tail_iv, iv) / 1e6, 6)
+                for d, iv in sorted(segs.items())}
+            out["overlap"]["max_rounds_in_flight"] = max(segs)
         if counters:
             out["counters"] = {k: dict(v) for k, v in sorted(
                 counters.items())}

@@ -113,3 +113,142 @@ class FlatPacker:
 
 def build_packer(template: Any) -> FlatPacker:
     return FlatPacker(template)
+
+
+# ----------------------------------------------------------------------
+# host->device input staging (the flatpack idea mirrored onto the
+# dispatch path): the faithful round used to device_put ~8-10 small host
+# arrays per dispatch (masks, ids, lrs, chaos vectors, feature grids) —
+# `tools/dispatch_cost_probe.py` measured the per-buffer RPC cost that
+# makes that expensive on a remote-attached chip.  These packers collapse
+# the staging to ONE host buffer (and one `jax.device_put`) per dtype
+# group; the unpack runs INSIDE the jitted round program as static
+# slices/reshapes that XLA fuses away, so the math is bit-identical.
+# ----------------------------------------------------------------------
+
+def canonical_np(x) -> np.ndarray:
+    """Host-side dtype canonicalization matching what ``jax.device_put``
+    does under the default x64-disabled config (int64 -> int32,
+    float64 -> float32) — packing must group by the dtype the device
+    array will actually have, or the slot table mislabels groups."""
+    arr = np.asarray(x)
+    if arr.dtype == np.int64:
+        return arr.astype(np.int32)
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)
+    if arr.dtype == np.uint64:
+        return arr.astype(np.uint32)
+    return arr
+
+
+class AxisPacker:
+    """Pack a fixed-structure tree of host arrays that SHARE their leading
+    axes (e.g. every per-round operand is ``[K, ...]`` or ``[R, K, ...]``)
+    into one ``[*lead, total]`` buffer per dtype.
+
+    Keeping the shared axes intact (instead of raveling to 1-D like
+    :class:`FlatPacker`) is what lets the staged buffer carry a clients-
+    axis sharding: the round program's inputs stay sharded over the mesh
+    while still crossing the host boundary as one transfer per dtype.
+    """
+
+    def __init__(self, template: Any, lead_ndim: int):
+        self.lead_ndim = int(lead_ndim)
+        leaves, treedef = jax.tree.flatten(template)
+        self.treedef = treedef
+        self.lead_shape = None
+        #: per-leaf (dtype_str, offset, trailing_size, trailing_shape)
+        self._slots: List[Tuple[str, int, int, Tuple[int, ...]]] = []
+        sizes: Dict[str, int] = {}
+        for leaf in leaves:
+            arr = canonical_np(leaf)
+            if arr.ndim < self.lead_ndim:
+                raise ValueError(
+                    f"AxisPacker leaf has {arr.ndim} dims, needs the "
+                    f"{self.lead_ndim} shared leading axes")
+            lead = tuple(arr.shape[:self.lead_ndim])
+            if self.lead_shape is None:
+                self.lead_shape = lead
+            elif lead != self.lead_shape:
+                raise ValueError(
+                    f"AxisPacker leaves disagree on leading axes: "
+                    f"{lead} != {self.lead_shape}")
+            trailing = tuple(arr.shape[self.lead_ndim:])
+            size = int(np.prod(trailing)) if trailing else 1
+            dt = str(arr.dtype)
+            off = sizes.get(dt, 0)
+            self._slots.append((dt, off, size, trailing))
+            sizes[dt] = off + size
+        self.sizes = sizes
+
+    @property
+    def signature(self) -> Tuple:
+        """Cache key for jitted unpackers: the full slot table."""
+        return (self.lead_ndim, self.lead_shape, tuple(self._slots),
+                self.treedef)
+
+    def pack_np(self, tree: Any) -> Dict[str, np.ndarray]:
+        """One ``[*lead, total]`` numpy buffer per dtype (host-side —
+        the single memcpy that replaces N per-leaf transfers)."""
+        leaves, treedef = jax.tree.flatten(tree)
+        if treedef != self.treedef or len(leaves) != len(self._slots):
+            raise ValueError(
+                f"tree structure {treedef} != packer template "
+                f"{self.treedef}")
+        groups: Dict[str, list] = {}
+        for leaf, (dt, _, size, trailing) in zip(leaves, self._slots):
+            arr = canonical_np(leaf)
+            if tuple(arr.shape[self.lead_ndim:]) != trailing or \
+                    tuple(arr.shape[:self.lead_ndim]) != self.lead_shape:
+                raise ValueError(
+                    f"leaf shape {arr.shape} != packer template "
+                    f"{self.lead_shape}+{trailing}")
+            if str(arr.dtype) != dt:
+                raise ValueError(
+                    f"leaf dtype {arr.dtype} != packer template dtype {dt}")
+            groups.setdefault(dt, []).append(
+                arr.reshape(self.lead_shape + (size,)))
+        return {dt: (np.concatenate(parts, axis=-1) if len(parts) > 1
+                     else parts[0])
+                for dt, parts in groups.items()}
+
+    def unpack(self, vecs: Dict[str, jnp.ndarray]) -> Any:
+        """Traced inverse of :meth:`pack_np` — static last-axis slices +
+        reshapes, fused away by XLA inside the round program."""
+        leaves = []
+        for dt, off, size, trailing in self._slots:
+            part = vecs[dt][..., off:off + size]
+            leaves.append(jnp.reshape(part, self.lead_shape + trailing))
+        return jax.tree.unflatten(self.treedef, leaves)
+
+
+class ScalarStager:
+    """FlatPacker + host-side pack for the replicated scalar operands
+    (lrs, round indices, thresholds): one tiny 1-D buffer per dtype."""
+
+    def __init__(self, template: Any):
+        self.packer = FlatPacker(jax.tree.map(canonical_np, template))
+
+    @property
+    def signature(self) -> Tuple:
+        return (tuple(self.packer._slots), self.packer.treedef)
+
+    def pack_np(self, tree: Any) -> Dict[str, np.ndarray]:
+        leaves, treedef = jax.tree.flatten(jax.tree.map(canonical_np, tree))
+        if treedef != self.packer.treedef:
+            raise ValueError(
+                f"tree structure {treedef} != stager template "
+                f"{self.packer.treedef}")
+        groups: Dict[str, list] = {}
+        for leaf, (dt, _, _, shape) in zip(leaves, self.packer._slots):
+            arr = np.asarray(leaf)
+            if str(arr.dtype) != dt or tuple(arr.shape) != shape:
+                raise ValueError(
+                    f"leaf {arr.dtype}{tuple(arr.shape)} != template "
+                    f"{dt}{shape}")
+            groups.setdefault(dt, []).append(arr.ravel())
+        return {dt: (np.concatenate(parts) if len(parts) > 1 else parts[0])
+                for dt, parts in groups.items()}
+
+    def unpack(self, vecs: Dict[str, jnp.ndarray]) -> Any:
+        return self.packer.unpack(vecs)

@@ -688,3 +688,111 @@ def test_schema_drift_flags_undocumented_robust_knob(tmp_path):
     found = check_project(str(tmp_path), documented_knobs=("robust",))
     assert [f.rule for f in found] == ["schema-drift"]
     assert "robust" in found[0].message
+
+
+# ======================================================================
+# PR 6 corpus: put-loop (single-buffer input staging discipline)
+# ======================================================================
+def test_put_loop_flags_for_loop_and_dict_comprehension(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def stage_each(host, sharding):
+            out = []
+            for leaf in host:
+                out.append(jax.device_put(leaf, sharding))
+            return out
+
+        def stage_dict(host, sharding):
+            return {k: jax.device_put(v, sharding)
+                    for k, v in host.items()}
+        """, rules=["put-loop"])
+    assert rules_of(found) == ["put-loop", "put-loop"]
+    assert "per iteration" in found[0].message
+    assert "AxisPacker" in found[0].hint
+
+
+def test_put_loop_flags_generator_expression(tmp_path):
+    found = run_on(tmp_path, "strategies/mod.py", """\
+        import jax
+
+        def stage_tuple(vecs, sharding):
+            return tuple(jax.device_put(v, sharding) for v in vecs)
+        """, rules=["put-loop"])
+    assert rules_of(found) == ["put-loop"]
+
+
+def test_put_loop_single_whole_tree_put_is_fine(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def stage_packed(bufs_by_dtype, sharding):
+            # ONE call on the whole per-dtype dict: one transfer per
+            # dtype group, the staged-dispatch contract
+            return jax.device_put(bufs_by_dtype, sharding)
+
+        def loop_without_puts(items):
+            total = 0
+            for x in items:
+                total += x
+            return total
+        """, rules=["put-loop"])
+    assert found == []
+
+
+def test_put_loop_cold_paths_and_closures_are_fine(tmp_path):
+    # cold path (tools/): rule does not apply outside hot-path modules;
+    # a staging closure DEFINED in a loop is called elsewhere — the
+    # function boundary resets the loop context
+    found = run_on(tmp_path, "tools/mod.py", """\
+        import jax
+
+        def probe(host):
+            return [jax.device_put(h) for h in host]
+        """, rules=["put-loop"])
+    assert found == []
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def build(shardings):
+            stagers = []
+            for s in shardings:
+                def stage(v, s=s):
+                    return jax.device_put(v, s)
+                stagers.append(stage)
+            return stagers
+        """, rules=["put-loop"])
+    assert found == []
+
+
+def test_put_loop_suppression_with_reason(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def attach(pool, sharding):
+            # flint: disable=put-loop one-time pool upload, not per-round
+            return {k: jax.device_put(v, sharding)
+                    for k, v in pool.items()}
+        """, rules=["put-loop"])
+    assert found == []
+
+
+def test_schema_drift_flags_undocumented_overlap_knobs(tmp_path):
+    """An operator who cannot find fused_carry / input_staging in the
+    runbook keeps paying the serial fallback and the per-leaf dispatch
+    tax without knowing the lever exists."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'fused_carry', 'input_staging'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.fused_carry` moves strategy state on device")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("fused_carry",
+                                            "input_staging"))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "input_staging" in found[0].message

@@ -44,7 +44,12 @@ def test_scope_fixture_checks_the_interesting_numbers():
     golden = _golden()
     assert golden["overlap"] == {"host_tail_s": 0.0035,
                                  "overlapped_s": 0.002,
-                                 "efficiency_pct": 57.1}
+                                 "efficiency_pct": 57.1,
+                                 # one round in flight while round 0's
+                                 # tail drained: the whole overlapped
+                                 # span sits at depth 1
+                                 "by_depth": {"1": 0.002},
+                                 "max_rounds_in_flight": 1}
     assert golden["events"] == {"chaos_faults": 1, "ckpt_io_fault": 1,
                                 "preemption": 1}
     assert golden["rounds"] == {"count": 2, "first": 0, "last": 1}
@@ -72,3 +77,24 @@ def test_scope_salvages_truncated_trace(tmp_path):
     from msrflute_tpu.telemetry.scope_cli import summarize
     out = summarize(str(tmp_path))
     assert out["phase_secs"]["pack"]["count"] == 1
+
+
+def test_scope_by_depth_splits_overlap_at_ring_depth(tmp_path):
+    """Depth-N ring evidence (PR 6): host-tail time overlapped by TWO
+    concurrently-in-flight device windows lands under by_depth["2"]."""
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    us = 1e6  # all spans in whole seconds for easy arithmetic
+    (tdir / "trace.json").write_text(json.dumps({"traceEvents": [
+        {"name": "host_tail", "ph": "X", "ts": 0.0, "dur": 10 * us,
+         "pid": 1, "tid": 1, "args": {}},
+        {"name": "round_device", "ph": "X", "ts": 0.0, "dur": 6 * us,
+         "pid": 1, "tid": 9001, "args": {"round0": 0, "rounds": 1}},
+        {"name": "round_device", "ph": "X", "ts": 4 * us, "dur": 6 * us,
+         "pid": 1, "tid": 9002, "args": {"round0": 1, "rounds": 1}},
+    ]}))
+    from msrflute_tpu.telemetry.scope_cli import summarize
+    overlap = summarize(str(tmp_path))["overlap"]
+    assert overlap["overlapped_s"] == 10.0
+    assert overlap["by_depth"] == {"1": 8.0, "2": 2.0}
+    assert overlap["max_rounds_in_flight"] == 2

@@ -1,0 +1,218 @@
+"""Universal overlap (PR 6): device-resident strategy carry + depth-N ring.
+
+The tentpole contract: the formerly host-orchestrated strategies —
+SCAFFOLD, EF/quantization, personalization, RL — run PIPELINED under
+``server_config.fused_carry`` with final params bit-identical to their
+serial runs, at pipeline depth 1, 2, and 3 (the ring of donated buffer
+sets replacing PR 1's hard ``min(depth, 1)`` clamp), composed with the
+deterministic chaos streams and the preemption drain/resume contract,
+clean under ``MSRFLUTE_STRICT_TRANSFERS=1``.
+"""
+
+import json
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from conftest import make_synthetic_classification
+from msrflute_tpu import schema
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.engine import OptimizationServer
+from msrflute_tpu.engine.server import select_server
+from msrflute_tpu.models import make_task
+
+
+def _cfg(strategy, depth, *, fused=True, rounds=6, chaos=None,
+         server_over=None):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": 4,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "fused_carry": fused, "rounds_per_step": 1,
+        "val_freq": 100, "initial_val": False,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "data_config": {"val": {"batch_size": 8}},
+    }
+    if strategy == "rl":
+        strategy = "fedavg"
+        sc["wantRL"] = True
+        sc["RL"] = {"minibatch_size": 4, "max_replay_memory_size": 16,
+                    "optimizer_config": {"type": "adam", "lr": 1e-3}}
+    if strategy == "personalization":
+        strategy = "fedavg"
+        sc["type"] = "personalization"
+    if chaos is not None:
+        sc["chaos"] = chaos
+    if server_over:
+        sc.update(server_over)
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": strategy,
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _run(cfg, model_dir=None, val=False, seed=7):
+    ds = make_synthetic_classification()
+    task = make_task(cfg.model_config)
+    cls = select_server(cfg.server_config.get("type"))
+    if model_dir is None:
+        with tempfile.TemporaryDirectory() as tmp:
+            server = cls(task, cfg, ds, model_dir=tmp, seed=seed,
+                         val_dataset=ds if val else None)
+            state = server.train()
+            flat = np.asarray(
+                ravel_pytree(jax.device_get(state.params))[0])
+        return flat, server, state
+    server = cls(task, cfg, ds, model_dir=model_dir, seed=seed,
+                 val_dataset=ds if val else None)
+    state = server.train()
+    flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return flat, server, state
+
+
+STRATEGIES = ["scaffold", "ef_quant", "rl", "personalization"]
+
+_serial_cache = {}
+
+
+def _serial_flat(strategy):
+    if strategy not in _serial_cache:
+        _serial_cache[strategy] = _run(_cfg(strategy, 0))[0]
+    return _serial_cache[strategy]
+
+
+# ======================================================================
+# the clamp is gone: schema-validated depth, refusal past the bound
+# ======================================================================
+def test_pipeline_depth_past_maximum_is_refused_not_clamped():
+    raw = {
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {"max_iteration": 1,
+                          "pipeline_depth": schema.MAX_PIPELINE_DEPTH + 1,
+                          "optimizer_config": {"type": "sgd", "lr": 1.0},
+                          "data_config": {}},
+        "client_config": {"optimizer_config": {"type": "sgd", "lr": 0.1},
+                          "data_config": {"train": {}}},
+    }
+    with pytest.raises(ValueError, match="pipeline_depth.*maximum"):
+        FLUTEConfig.from_dict(raw)
+
+
+def test_pipeline_depth_is_honored_not_silently_clamped():
+    flat, server, _ = _run(_cfg("scaffold", 3, rounds=2))
+    assert server.pipeline_depth == 3
+    assert np.all(np.isfinite(flat))
+
+
+# ======================================================================
+# the tentpole: every formerly-serial strategy pipelines bit-identically
+# ======================================================================
+@pytest.mark.parametrize("strategy", STRATEGIES)
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_fused_carry_pipelined_matches_serial_bit_exact(strategy, depth):
+    serial = _serial_flat(strategy)
+    flat, server, _ = _run(_cfg(strategy, depth))
+    # the guard actually lifted: the run really pipelined
+    assert server._pipeline_ok()
+    assert server.pipelined_chunks > 0
+    np.testing.assert_array_equal(serial, flat)
+
+
+def test_fused_scaffold_matches_host_scaffold_bit_exact():
+    """The carry math IS the host control-variate math: same controls,
+    same option-II update, moved on device."""
+    fused = _serial_flat("scaffold")
+    host, server, _ = _run(_cfg("scaffold", 0, fused=False))
+    assert server.scaffold_store is not None  # host path really ran
+    np.testing.assert_array_equal(fused, host)
+
+
+def test_fused_rl_tuner_state_lives_in_strategy_state():
+    _, server, state = _run(_cfg("rl", 2))
+    assert server.rl is None  # no host RLAggregator constructed
+    rl_state = state.strategy_state["rl"]
+    # epsilon annealed in-program across the pipelined rounds
+    assert float(jax.device_get(rl_state["eps"])) < 0.5
+    assert int(jax.device_get(rl_state["count"])) > 0
+
+
+# ======================================================================
+# composition: chaos streams + preemption drain/resume at depth > 1
+# ======================================================================
+_CHAOS = {"enable": True, "seed": 3, "dropout_rate": 0.25,
+          "straggler_rate": 0.25}
+
+
+def test_fused_carry_chaos_pipelined_matches_serial(tmp_path):
+    # pre-PR these configs RAISED (chaos requires the fused path, which
+    # scaffold forfeited); now they compose and stay bit-identical
+    serial = _run(_cfg("scaffold", 0, chaos=_CHAOS))[0]
+    for depth in (1, 3):
+        flat, server, _ = _run(_cfg("scaffold", depth, chaos=_CHAOS))
+        assert server.pipelined_chunks > 0
+        np.testing.assert_array_equal(serial, flat)
+
+
+def test_preempt_drain_resume_depth3_with_chaos(tmp_path):
+    chaos = dict(_CHAOS, preempt_at_round=3)
+    ref = _run(_cfg("scaffold", 3, rounds=7, chaos=_CHAOS),
+               model_dir=str(tmp_path / "ref"))[0]
+
+    run_dir = str(tmp_path / "run")
+    _, pre, pre_state = _run(_cfg("scaffold", 3, rounds=7, chaos=chaos),
+                             model_dir=run_dir)
+    assert pre.preempted
+    # the in-flight ring drained: every dispatched round was kept
+    assert 3 <= pre_state.round < 7
+    status = json.load(open(os.path.join(run_dir, "status_log.json")))
+    assert status["i"] == pre_state.round
+
+    res_cfg = _cfg("scaffold", 3, rounds=7, chaos=chaos,
+                   server_over={"resume_from_checkpoint": True})
+    flat, res, res_state = _run(res_cfg, model_dir=run_dir)
+    assert res_state.round == 7
+    assert not res.preempted
+    np.testing.assert_array_equal(ref, flat)
+
+
+# ======================================================================
+# strict transfers: the lifted strategies keep the one-packed-fetch
+# contract
+# ======================================================================
+@pytest.mark.parametrize("strategy", ["scaffold", "personalization"])
+def test_fused_carry_clean_under_strict_transfers(strategy, monkeypatch):
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    serial = _serial_flat(strategy)
+    flat, server, _ = _run(_cfg(strategy, 2))
+    assert server.pipelined_chunks > 0
+    np.testing.assert_array_equal(serial, flat)
+
+
+# ======================================================================
+# fused personalization: the carry tables ARE the per-user state
+# ======================================================================
+def test_fused_personalization_eval_reads_carry_tables(tmp_path):
+    cfg = _cfg("personalization", 2)
+    flat, server, state = _run(cfg, model_dir=str(tmp_path), val=True)
+    assert server.store is None  # no host store in fused mode
+    seen = np.asarray(jax.device_get(state.strategy_state["seen"]))
+    assert np.sum(seen > 0) >= 4  # sampled users marked in-program
+    alphas = np.asarray(jax.device_get(state.strategy_state["alpha"]))
+    assert np.all((alphas >= 1e-4) & (alphas <= 0.9999))
+    ds = make_synthetic_classification()
+    res = server.personalized_eval(ds)
+    assert res is not None
+    acc, loss = res
+    assert 0.0 <= acc <= 1.0 and np.isfinite(loss)
+    # repeat call is deterministic (one fetch + one compiled program)
+    assert server.personalized_eval(ds) == res

@@ -99,6 +99,56 @@ def main() -> int:
     res["cases"]["tree16_donated_threaded"] = round(
         1e3 * (time.perf_counter() - tic) / iters, 4)
 
+    # input-staging A/B (PR 6, server_config.input_staging): the faithful
+    # round's REAL per-dispatch operand mix — [K,S,B,D] feature grid,
+    # [K,S,B] sample mask, [K] client mask/ids, [K] chaos drop/
+    # keep_steps/corrupt vectors, and the lr/round/threshold scalars —
+    # staged per-leaf (the pre-PR shape the ~88 ms suspect came from) vs
+    # packed one-buffer-per-dtype through the engine's own packers
+    # (utils/flatpack.py AxisPacker/ScalarStager).  This is the number
+    # that makes the staging win reproducible on the chip.
+    import numpy as _np
+    from msrflute_tpu.utils.flatpack import AxisPacker, ScalarStager
+    rng = _np.random.default_rng(0)
+    K, S, B, D = 10, 4, 20, 64
+    axis_tree = {
+        "grid": rng.normal(size=(K, S, B, D)).astype(_np.float32),
+        "sample_mask": _np.ones((K, S, B), _np.float32),
+        "client_mask": _np.ones((K,), _np.float32),
+        "client_ids": _np.arange(K, dtype=_np.int32),
+        "drop": _np.zeros((K,), _np.float32),
+        "keep_steps": _np.full((K,), float(S), _np.float32),
+        "corrupt": _np.zeros((K,), _np.int32),
+    }
+    sc_tree = {"client_lr": _np.float32(0.1),
+               "server_lr": _np.float32(1.0),
+               "round_idx": _np.int32(0),
+               "leakage": _np.float32(_np.inf),
+               "quant": _np.float32(-1.0)}
+    iters = 30
+    # legacy: one device_put per leaf (12 transfers)
+    tic = time.perf_counter()
+    for _ in range(iters):
+        # flint would flag this shape in product code — it IS the probe
+        staged = [jax.device_put(v) for v in axis_tree.values()]
+        staged += [jax.device_put(v) for v in sc_tree.values()]
+        _sync(staged)
+    res["cases"]["dispatch_mix_per_leaf"] = round(
+        1e3 * (time.perf_counter() - tic) / iters, 4)
+    # staged: pack host-side, one put per dtype group (4 transfers)
+    ax_packer = AxisPacker(axis_tree, lead_ndim=1)
+    stager = ScalarStager(sc_tree)
+    tic = time.perf_counter()
+    for _ in range(iters):
+        ax = jax.device_put(ax_packer.pack_np(axis_tree))
+        sc = jax.device_put(stager.pack_np(sc_tree))
+        _sync((ax, sc))
+    res["cases"]["dispatch_mix_staged"] = round(
+        1e3 * (time.perf_counter() - tic) / iters, 4)
+    res["staging_speedup"] = round(
+        res["cases"]["dispatch_mix_per_leaf"]
+        / max(res["cases"]["dispatch_mix_staged"], 1e-9), 2)
+
     print(json.dumps(res))
     return 0
 
