@@ -61,7 +61,10 @@ BASELINES_SECS_PER_ROUND["cnn_femnist_bf16"] = \
 HEADLINE = "cnn_femnist"
 # TPU v5e peak: 197 TFLOP/s bf16 (394 int8).  We report model FLOPs utilisation
 # against the bf16 peak even for f32 programs — a deliberately conservative
-# denominator, stated here so the number is interpretable.
+# denominator, stated here so the number is interpretable.  Source of
+# truth is utils.compat.TPU_PEAK_FLOPS["v5e"] — mirrored as a literal
+# because this module must not import anything jax-adjacent before
+# backend selection; the mirror is pinned by tests/test_xla_truth.py.
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
@@ -493,22 +496,24 @@ def _one_client_batch(dataset, batch_size, max_steps):
 
 
 def grad_step_cost(task, params, batch):
-    """XLA cost analysis (flops/bytes) of one client fwd+bwd step, or None
-    (shared by the MFU estimate and ``tools/profile_round.py``)."""
+    """XLA cost + memory analysis of one client fwd+bwd step, or None.
+
+    Routed through the ONE compiled-analysis helper
+    (``msrflute_tpu.telemetry.xla.aot_cost`` — the same code behind the
+    live device-truth layer and ``tools/profile_round.py``), so the MFU
+    numerator can never drift between bench, profiler and telemetry.
+    Keys are the normalized ``flops`` / ``bytes_accessed`` /
+    ``hbm_bytes`` spellings."""
     import jax
+
+    from msrflute_tpu.telemetry.xla import aot_cost
 
     def step(p, b):
         def loss(pp):
             return task.loss(pp, b, jax.random.PRNGKey(0), True)[0]
         return jax.grad(loss)(p)
 
-    try:
-        cost = jax.jit(step).lower(params, batch).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return dict(cost)
-    except Exception:
-        return None
+    return aot_cost(step, params, batch)
 
 
 def make_val_ds(dataset, eval_users):
@@ -580,18 +585,39 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
                      mesh, server.engine.partition_mode)
         secs_eval = sw.secs
 
+        # device-truth numbers on EVERY protocol (the ISSUE 7 bench
+        # contract): compiled grad-step cost through the shared helper,
+        # MFU vs this chip's peak (CPU runs use the documented nominal
+        # fallback — comparable across CPU runs, never against a TPU),
+        # HBM footprint, and the engine's always-on recompile counter.
+        from msrflute_tpu.telemetry.xla import mfu as mfu_of
+        from msrflute_tpu.utils.compat import chip_peak_flops
+        one_batch = _one_client_batch(dataset, int(
+            cfg.client_config.data_config.train["batch_size"]),
+            server.max_steps)
+        cost = grad_step_cost(task, server.state.params, one_batch)
         mfu = None
-        if want_mfu:
-            one_batch = _one_client_batch(dataset, int(
-                cfg.client_config.data_config.train["batch_size"]),
-                server.max_steps)
-            cost = grad_step_cost(task, server.state.params, one_batch)
-            if cost is not None:
-                steps = server.max_steps
-                clients = int(cfg.server_config.num_clients_per_iteration)
-                flops_per_round = float(cost["flops"]) * steps * clients
-                mfu = flops_per_round / float(np.median(per_chunk)) \
-                    / V5E_BF16_PEAK_FLOPS
+        flops_per_round = None
+        if cost is not None and cost.get("flops"):
+            steps = server.max_steps
+            clients = int(cfg.server_config.num_clients_per_iteration)
+            flops_per_round = float(cost["flops"]) * steps * clients
+            if want_mfu:
+                # the historical headline column: pinned to the v5e
+                # bf16 peak whatever chip ran, for artifact continuity
+                mfu = mfu_of(flops_per_round, float(np.median(per_chunk)),
+                             peak_flops=V5E_BF16_PEAK_FLOPS)
+        chip_kind, chip_peak = chip_peak_flops()
+        device_truth = {
+            "chip": chip_kind,
+            "mfu": (round(mfu_of(flops_per_round,
+                                 float(np.median(per_chunk)),
+                                 peak_flops=chip_peak) or 0.0, 6)
+                    if flops_per_round else None),
+            "hbm_peak_bytes": (cost or {}).get("hbm_bytes"),
+            "recompiles": int(server.engine.recompile_count),
+            "compiled_programs": len(server.engine.compile_log),
+        }
 
     secs_train = float(np.median(per_chunk))
     secs_per_round = secs_train + secs_eval / eval_every
@@ -606,6 +632,7 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
     }
     if mfu is not None:
         out["mfu_vs_bf16_peak"] = round(mfu, 5)
+    out["device_truth"] = device_truth
     out.update(_server_overhead_extras(server))
     return out
 

@@ -46,7 +46,8 @@ from ..resilience.chaos import (CORRUPT_NAN, CORRUPT_SCALE,
                                 CORRUPT_SIGN_FLIP)
 from ..robust import make_shield
 from ..strategies.base import BaseStrategy
-from ..telemetry import devbus_config_enabled
+from ..telemetry import devbus_config_enabled, xla_config_enabled
+from ..telemetry import xla as xla_telemetry
 from ..telemetry.devbus import DeviceMetricBus
 from ..utils.flatpack import AxisPacker, FlatPacker, ScalarStager
 from .client_update import ClientHParams, build_client_update, _clip_by_global_norm
@@ -327,6 +328,22 @@ class RoundEngine:
             devbus_config_enabled(sc.get("telemetry")))
         strategy.devbus = self.devbus
 
+        # flutescope device-truth (server_config.telemetry.xla): wrap
+        # each jitted entry point in an AOT-cached _InstrumentedFn so
+        # every compile is observed with its cost/memory analysis and
+        # the recompile sentinel sees signature churn (telemetry/
+        # xla.py).  None when telemetry/xla is off — the zero-cost
+        # contract: no introspection objects, the plain jit callables,
+        # identical dispatch path.
+        self.xla = (xla_telemetry.XlaIntrospector()
+                    if xla_config_enabled(sc.get("telemetry")) else None)
+        #: entry-point names in compile order — ALWAYS on (a list append
+        #: per compiled program variant, read from the jit caches; no
+        #: introspection objects).  `recompile_count` derives from it,
+        #: so bench.py can report recompiles without telemetry enabled.
+        self.compile_log: list = []
+        self._compile_seen: Dict[Any, int] = {}
+
         self._client_sharding = NamedSharding(self.mesh, P(CLIENTS_AXIS))
         self._replicated = NamedSharding(self.mesh, P())
         #: device-resident sample pool (build_sample_pool); when set, round
@@ -343,6 +360,44 @@ class RoundEngine:
         #: packed stats buffers, recorded when the round program traces
         self._stats_packers: Dict[Any, FlatPacker] = {}
         self._round_step = self._build_round_step()
+
+    # ------------------------------------------------------------------
+    def _instrument(self, name: str, jitted: Callable,
+                    rounds: int = 1) -> Callable:
+        """Route one jitted entry point through the device-truth layer
+        (cost/memory capture + recompile sentinel) when it is on; the
+        plain jit callable otherwise."""
+        if self.xla is None:
+            return jitted
+        return self.xla.wrap(name, jitted, rounds=rounds)
+
+    def _note_compiles(self, name: str, fn: Callable) -> None:
+        """Append one ``compile_log`` entry per NEW compiled variant of
+        ``fn`` since the last note — read from the wrapper's AOT cache
+        or the pjit dispatch cache, so the count is the truth of what
+        XLA compiled, not a guess from our own cache keys."""
+        if hasattr(fn, "cache_len"):          # _InstrumentedFn
+            n = int(fn.cache_len)
+        elif hasattr(fn, "_cache_size"):      # pjit function
+            try:
+                n = int(fn._cache_size())
+            except Exception:
+                return
+        else:
+            return
+        key = (name, id(fn))
+        prev = self._compile_seen.get(key, 0)
+        for _ in range(n - prev):
+            self.compile_log.append(name)
+        self._compile_seen[key] = max(prev, n)
+
+    @property
+    def recompile_count(self) -> int:
+        """Compiled program variants beyond the first per entry point —
+        the always-on recompile counter (the sentinel's event stream,
+        with operand diffs, additionally exists when telemetry/xla is
+        on)."""
+        return len(self.compile_log) - len(set(self.compile_log))
 
     # ------------------------------------------------------------------
     def init_state(self, rng: jax.Array, params: Any = None) -> ServerState:
@@ -934,7 +989,8 @@ class RoundEngine:
                     packer.pack(round_stats))
 
         self._round_step_core = round_step
-        return jax.jit(round_step, donate_argnums=(0, 1, 2))
+        return self._instrument(
+            "round_step", jax.jit(round_step, donate_argnums=(0, 1, 2)))
 
     # ------------------------------------------------------------------
     def _multi_core(self, num_rounds: int) -> Callable:
@@ -989,7 +1045,10 @@ class RoundEngine:
         cached = self._multi_cache.get(num_rounds)
         if cached is not None:
             return cached
-        fn = jax.jit(self._multi_core(num_rounds), donate_argnums=(0, 1, 2))
+        fn = self._instrument(
+            f"multi_round_r{num_rounds}",
+            jax.jit(self._multi_core(num_rounds), donate_argnums=(0, 1, 2)),
+            rounds=num_rounds)
         self._multi_cache[num_rounds] = fn
         return fn
 
@@ -1049,8 +1108,9 @@ class RoundEngine:
         key = "_payload_step_off" if grad_offsets is not None \
             else "_payload_step"
         if not hasattr(self, key):
-            setattr(self, key, self._build_payload_step(
-                with_offsets=grad_offsets is not None))
+            setattr(self, key, self._instrument(
+                key.lstrip("_"), self._build_payload_step(
+                    with_offsets=grad_offsets is not None)))
         args = [
             state.params, state.strategy_state,
             # flint: disable=put-loop host-orchestrated legacy round path; fused_carry is the staged overlap path
@@ -1071,7 +1131,10 @@ class RoundEngine:
             if not isinstance(grad_offsets, jax.Array):
                 grad_offsets = np.asarray(grad_offsets, np.float32)
             args.append(jax.device_put(grad_offsets, self._client_sharding))
-        return getattr(self, key)(*args)
+        fn = getattr(self, key)
+        out = fn(*args)
+        self._note_compiles(key.lstrip("_"), fn)
+        return out
 
     def apply_custom_weights(self, state: ServerState, pgs, weights,
                              server_lr: float) -> ServerState:
@@ -1093,12 +1156,14 @@ class RoundEngine:
                 updates, new_opt = server_tx.update(agg, opt_state, params)
                 return optax.apply_updates(params, updates), new_opt
 
-            self._custom_agg = jax.jit(agg_fn)
+            self._custom_agg = self._instrument("custom_agg",
+                                                jax.jit(agg_fn))
         params, opt_state = self._custom_agg(
             state.params, state.opt_state, pgs,
             jax.device_put(jnp.asarray(weights, jnp.float32),
                            self._client_sharding),
             jnp.asarray(server_lr, jnp.float32))
+        self._note_compiles("custom_agg", self._custom_agg)
         return ServerState(params, opt_state, state.strategy_state,
                            state.round + 1)
 
@@ -1242,7 +1307,10 @@ class RoundEngine:
         key = (R, ax_packer.signature, stager.signature)
         fn = self._staged_cache.get(key)
         if fn is None:
-            fn = self._build_staged_fn(R, ax_packer, stager)
+            fn = self._instrument(f"staged_r{R}",
+                                  self._build_staged_fn(R, ax_packer,
+                                                        stager),
+                                  rounds=R)
             self._staged_cache[key] = fn
         ax_bufs = ax_packer.pack_np(axis_tree)
         sc_bufs = stager.pack_np(sc_tree)
@@ -1260,6 +1328,7 @@ class RoundEngine:
         params, opt_state, strategy_state, vecs = fn(
             state.params, state.opt_state, state.strategy_state, ax_dev,
             sc_dev, rng, *pool_args)
+        self._note_compiles(f"staged_r{R}", fn)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
         packer = self._stats_packers[
@@ -1312,6 +1381,7 @@ class RoundEngine:
             jnp.asarray(quant_threshold if quant_threshold is not None
                         else -1.0, jnp.float32), rng, *chaos_args,
             *pool_args)
+        self._note_compiles("round_step", self._round_step)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
         packer = self._stats_packers[("single", batch.sample_mask.shape[0])]
@@ -1407,6 +1477,7 @@ class RoundEngine:
             jnp.asarray(quant_thresholds if quant_thresholds is not None
                         else [-1.0] * R, jnp.float32), rngs, *chaos_args,
             *pool_args)
+        self._note_compiles(f"multi_round_r{R}", fn)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
         # the scan stacks the core program's packed per-round vecs into

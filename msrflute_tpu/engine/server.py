@@ -205,6 +205,13 @@ class OptimizationServer:
         # packed stats, wall clocks), so strict transfer mode and the
         # one-fetch-per-round guard hold unchanged.
         self.scope = make_telemetry(sc.get("telemetry"), model_dir)
+        #: (device_kind, peak_flops) of the mesh's chip — the live-MFU
+        #: denominator, resolved once (utils/compat.py chip table, CPU
+        #: nominal fallback); None when the device-truth layer is off
+        self._chip = None
+        if self.engine.xla is not None:
+            from ..utils.compat import chip_peak_flops
+            self._chip = chip_peak_flops(next(iter(self.mesh.devices.flat)))
         if self.scope is not None:
             self.ckpt.telemetry = self.scope
             self.scope.watchdog.on_mark = self._watchdog_mark
@@ -337,6 +344,12 @@ class OptimizationServer:
 
         self._eval_fn = build_eval_fn(task, self.mesh,
                                       self.engine.partition_mode)
+        if self.engine.xla is not None:
+            # device-truth capture for the eval program too: its
+            # FLOPs/HBM row joins the scorecard's entry-point table and
+            # an eval-grid shape churn trips the same recompile sentinel
+            self._eval_fn = self.engine.xla.wrap("eval_step",
+                                                 self._eval_fn)
         self._eval_batches_cache: Dict[str, Any] = {}
         self._per_user_fns: Dict[str, Any] = {}
         self._np_rng = np.random.default_rng(seed)
@@ -350,7 +363,11 @@ class OptimizationServer:
         self._rng_uses = 0
         self.run_stats: Dict[str, list] = {
             "secsPerRound": [], "secsPerRoundHousekeeping": [],
-            "secsPerRoundHostTail": [], "hostToDeviceBytesPerRound": []}
+            "secsPerRoundHostTail": [], "hostToDeviceBytesPerRound": [],
+            # live MFU (device-truth layer: compiled FLOPs / round
+            # wall-clock / chip peak) — populated only when
+            # telemetry.xla captured the round program's cost
+            "mfuPerRound": []}
         #: chunks whose host tail overlapped the next chunk's device
         #: execution (observability + the equivalence tests' proof that
         #: the pipelined run actually pipelined)
@@ -609,7 +626,15 @@ class OptimizationServer:
                 # materialize trace.json whatever path exited the loop
                 self.scope.profiler.finish()
                 try:
+                    # compile/recompile events buffered after the last
+                    # drain (e.g. an eval compile) land in the streams,
+                    # THEN the trace flushes, THEN the scorecard is
+                    # built (its overlap numbers read the flushed
+                    # trace).  An aborted run keeps its scorecard too —
+                    # that is the run `tools/scope diff` most needs.
+                    self._drain_xla_events()
                     self.scope.flush()
+                    self.scope.write_scorecard(self.build_scorecard())
                 except Exception:
                     pass
             self.preemption.uninstall()
@@ -854,6 +879,16 @@ class OptimizationServer:
                             if isinstance(self.state.strategy_state, dict)
                             and "dp_clip" in self.state.strategy_state
                             else None),
+                # device-truth snapshot: which compiled entry point this
+                # chunk dispatched through and what it costs (compile-
+                # time facts; the drain pairs them with the measured
+                # wall clock for the live MFU).  Snapshotted NOW — by
+                # drain time, a newer pipelined dispatch may have
+                # overwritten last_dispatch.
+                "xla_dispatch": (dict(self.engine.xla.last_dispatch)
+                                 if self.engine.xla is not None and
+                                 self.engine.xla.last_dispatch is not None
+                                 else None),
             }
             # dispatch is async: pack the next chunk NOW, while the device
             # executes this one (reading the stats below is what blocks)
@@ -979,6 +1014,8 @@ class OptimizationServer:
         self.run_stats["secsPerRoundHostTail"].append(
             (time.time() - toc) / R)
         if self.scope is not None:
+            self._drain_device_truth(chunk, round0, R)
+        if self.scope is not None:
             # watchdogs run over values this tail ALREADY holds: the
             # fetched per-round losses, the wall clock, the checkpoint
             # escalator's consecutive-failure count.  A configured
@@ -1001,7 +1038,10 @@ class OptimizationServer:
                     train_loss=float(stats["train_loss_sum"][j]) / n,
                     round_secs=secs,
                     ckpt_failures=self.ckpt.escalator.consecutive,
-                    quarantine_frac=quarantine_frac)
+                    quarantine_frac=quarantine_frac,
+                    # always-on engine counter (compiled variants beyond
+                    # the first per entry point) — feeds recompile_storm
+                    recompiles=self.engine.recompile_count)
 
     def _drain_host_tail(self, chunk: Dict[str, Any], stats,
                          val_freq: int, rec_freq: int) -> None:
@@ -1101,6 +1141,100 @@ class OptimizationServer:
         self._round_housekeeping(round0 + R, val_freq, rec_freq,
                                  skip_latest=chunk["latest_saved"],
                                  rng_snapshot=chunk.get("rng_snapshot"))
+
+    # ------------------------------------------------------------------
+    # flutescope device-truth (telemetry/xla.py): the host-tail half.
+    # Compile-time facts (FLOPs, HBM bytes, recompile findings) pair
+    # with the wall clocks the loop ALREADY measures — no device access,
+    # no new transfers, clean under strict mode by construction.
+    # ------------------------------------------------------------------
+    def _drain_xla_events(self) -> None:
+        """Emit the introspector's buffered compile/recompile events as
+        structured records (metrics stream + trace instants)."""
+        reg = self.engine.xla
+        if reg is None or self.scope is None:
+            return
+        for ev in reg.drain_events():
+            self.scope.event(ev.pop("kind"), **ev)
+
+    def _drain_device_truth(self, chunk: Dict[str, Any], round0: int,
+                            R: int) -> None:
+        """Per-chunk device-truth tail: drain compile events, then the
+        live MFU — the chunk's compiled FLOPs (snapshotted at dispatch)
+        over the measured per-round wall clock and the chip's peak —
+        and the program's HBM footprint, published through the host-side
+        bus (metric lines + trace counters; zero device reads)."""
+        self._drain_xla_events()
+        disp = chunk.get("xla_dispatch")
+        if not disp or not disp.get("flops") or self._chip is None:
+            return
+        from ..telemetry.xla import mfu as _mfu
+        flops_per_round = float(disp["flops"]) / max(
+            int(disp.get("rounds") or R), 1)
+        secs = self.run_stats["secsPerRound"][-1]
+        value = _mfu(flops_per_round, secs, peak_flops=self._chip[1])
+        if value is not None:
+            self.run_stats["mfuPerRound"].append(value)
+            self.scope.devbus_host("mfu", value, step=round0 + R - 1)
+        hbm = disp.get("hbm_bytes")
+        if hbm:
+            self.scope.devbus_host("hbm_program_gb", hbm / 2 ** 30,
+                                   step=round0 + R - 1)
+
+    def build_scorecard(self) -> Dict[str, Any]:
+        """The run's compact regression surface
+        (``telemetry/scorecard.json``): the metrics ``tools/scope diff``
+        thresholds and the endurance harness gates on.  Every value is
+        something the run already measured — wall clocks, the overlap
+        geometry from the flushed trace, the device-truth layer's
+        compile-time numbers, watchdog findings."""
+        rs = self.run_stats
+
+        def p50(values):
+            return (round(float(np.percentile(values, 50)), 6)
+                    if values else None)
+
+        card: Dict[str, Any] = {
+            "rounds": int(self.state.round),
+            "pipeline_depth": int(self.pipeline_depth),
+            "pipelined_chunks": int(self.pipelined_chunks),
+            "round_secs_p50": p50(rs["secsPerRound"]),
+            "host_tail_secs_p50": p50(rs["secsPerRoundHostTail"]),
+            "staged_bytes_per_round_p50": p50(
+                rs["hostToDeviceBytesPerRound"]),
+            "mfu_p50": p50(rs["mfuPerRound"]),
+            "puts_per_dispatch": int(self.engine.last_dispatch_puts),
+            "compiles": len(self.engine.compile_log),
+            "recompiles": int(self.engine.recompile_count),
+        }
+        fires: Dict[str, int] = {}
+        if self.scope is not None:
+            for finding in self.scope.watchdog.findings:
+                kind = str(finding.get("kind", "?"))
+                fires[kind] = fires.get(kind, 0) + 1
+        card["watchdog_fires"] = fires
+        reg = self.engine.xla
+        if reg is not None:
+            card["entry_points"] = reg.summary()
+            card["hbm_peak_bytes"] = reg.hbm_peak_bytes()
+            if self._chip is not None:
+                card["chip"] = {"kind": self._chip[0],
+                                "peak_flops": self._chip[1]}
+        # overlap geometry from the flushed trace — via the ONE reader
+        # (scope_cli.summarize), so the scorecard and `tools/scope`
+        # can never disagree about the efficiency number
+        try:
+            from ..telemetry.scope_cli import summarize
+            overlap = summarize(self.ckpt.model_dir).get("overlap") or {}
+            card["overlap_efficiency_pct"] = overlap.get("efficiency_pct")
+            if "by_depth" in overlap:
+                card["host_tail_by_depth_s"] = overlap["by_depth"]
+            if "max_rounds_in_flight" in overlap:
+                card["max_rounds_in_flight"] = \
+                    overlap["max_rounds_in_flight"]
+        except Exception:
+            card["overlap_efficiency_pct"] = None
+        return card
 
     # ------------------------------------------------------------------
     def _record_staged_bytes(self, batches: list, rounds: int) -> None:
@@ -1346,6 +1480,7 @@ class OptimizationServer:
         metrics = evaluate(self.task, self._eval_fn, self.state.params,
                            self._packed_eval_batches("val"), self.mesh,
                            self.engine.partition_mode)
+        self.engine._note_compiles("eval_step", self._eval_fn)
         if "acc" in metrics:
             return float(metrics["acc"].value)
         return -float(metrics["loss"].value)
@@ -1655,6 +1790,10 @@ class OptimizationServer:
                                self._packed_eval_batches(split), self.mesh,
                                self.engine.partition_mode,
                                telemetry=self.scope)
+        # eval compiles join the always-on compile log (and so the
+        # recompile counter the storm watchdog + scorecard gate on) —
+        # an eval-grid shape churn must not hide from the sentinel
+        self.engine._note_compiles("eval_step", self._eval_fn)
         for name, metric in metrics.items():
             log_metric(f"{split.capitalize()} {name}", metric.value, step=round_no)
         if self._split_cfg(split).get("wantLogits", False):

@@ -154,18 +154,26 @@ CHECKPOINT_RETRY_KEYS = {
 
 TELEMETRY_KEYS = {
     "enable", "trace", "devbus", "profile_rounds", "watchdog",
+    "xla", "scorecard",
 }
 
 WATCHDOG_KEYS = {
     "nan_loss", "round_time_action", "round_time_factor",
     "round_time_window", "ckpt_failure_action", "ckpt_failure_streak",
     "quarantine_rate_action", "quarantine_rate_threshold",
+    "recompile_storm_action", "recompile_storm_threshold",
+    "recompile_storm_warmup_rounds",
 }
 
 TELEMETRY_FIELD_SPECS = {
     "enable": ("bool", None, None),
     "trace": ("bool", None, None),
     "devbus": ("bool", None, None),
+    # device-truth layer (telemetry/xla.py): compiled cost/memory
+    # capture + recompile sentinel + live MFU
+    "xla": ("bool", None, None),
+    # compact per-run regression surface (telemetry/scorecard.json)
+    "scorecard": ("bool", None, None),
     # profile_rounds keeps a bespoke check in validate(): int | "lo:hi"
     # | [lo, hi] is a union type the scalar spec table cannot express
 }
@@ -177,6 +185,10 @@ WATCHDOG_FIELD_SPECS = {
     "ckpt_failure_streak": ("int", 1, None),
     # fluteshield: fraction of the live cohort quarantined in one round
     "quarantine_rate_threshold": ("num", 0.0, 1.0),
+    # recompile sentinel storm: fire after this many recompile events
+    # past the warmup rounds (a steady-state loop recompiles ZERO times)
+    "recompile_storm_threshold": ("int", 1, None),
+    "recompile_storm_warmup_rounds": ("int", 0, None),
 }
 
 #: watchdog detector actions (telemetry/watchdog.py ACTIONS)
@@ -700,7 +712,8 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                               WATCHDOG_FIELD_SPECS)
                 for key in ("nan_loss", "round_time_action",
                             "ckpt_failure_action",
-                            "quarantine_rate_action"):
+                            "quarantine_rate_action",
+                            "recompile_storm_action"):
                     _check_enum(errors, wd,
                                 "server_config.telemetry.watchdog", key,
                                 ALLOWED_WATCHDOG_ACTIONS)

@@ -37,11 +37,14 @@ __all__ = [
     "DeviceMetricBus", "NULL_SPAN", "SpanToken",
     "Stopwatch", "Telemetry", "Tracer", "Watchdog", "WatchdogAbort",
     "devbus_config_enabled", "emit_event", "make_telemetry",
-    "scalar_time", "telemetry_config_enabled",
+    "scalar_time", "telemetry_config_enabled", "xla_config_enabled",
 ]
 
 #: subdirectory of the model dir holding trace.json/events.jsonl/profiles
 TELEMETRY_DIRNAME = "telemetry"
+
+#: the compact per-run regression surface (tools/scope diff reads it)
+SCORECARD_FILENAME = "scorecard.json"
 
 
 def telemetry_config_enabled(raw: Optional[Dict[str, Any]]) -> bool:
@@ -56,6 +59,16 @@ def devbus_config_enabled(raw: Optional[Dict[str, Any]]) -> bool:
     program byte-identical to a telemetry-free build)."""
     return telemetry_config_enabled(raw) and \
         bool(dict(raw).get("devbus", True))
+
+
+def xla_config_enabled(raw: Optional[Dict[str, Any]]) -> bool:
+    """Whether the device-truth layer (``telemetry/xla.py``: compiled
+    cost/memory capture + recompile sentinel) is on — the engine reads
+    this at build time and constructs an :class:`~.xla.XlaIntrospector`
+    only then (telemetry off => zero xla-introspection objects, the
+    zero-cost contract)."""
+    return telemetry_config_enabled(raw) and \
+        bool(dict(raw).get("xla", True))
 
 
 class Telemetry:
@@ -135,6 +148,24 @@ class Telemetry:
                 metrics.log_metric(f"devbus/{name}", value, step=round0 + j)
                 if self.tracer is not None:
                     self.tracer.counter(f"devbus/{name}", value)
+
+    # -- scorecard ------------------------------------------------------
+    def write_scorecard(self, card: Dict[str, Any]) -> Optional[str]:
+        """Persist the run's compact regression surface
+        (``telemetry/scorecard.json``) — the machine-readable summary
+        ``tools/scope diff`` gates on.  Atomic (tmp + replace) so a
+        concurrent reader never sees a torn card; returns the path, or
+        None when the block disables it (``scorecard: false``)."""
+        if not self.raw.get("scorecard", True):
+            return None
+        import json
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, SCORECARD_FILENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(card, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
 
     # -- lifecycle ------------------------------------------------------
     def flush(self) -> None:

@@ -1,9 +1,22 @@
-"""``tools/scope`` — summarize one run directory's flutescope output.
+"""``tools/scope`` — summarize, diff and trend flutescope output.
 
-Input: a model dir (or its ``telemetry/`` subdir) holding any of
-``telemetry/trace.json``, ``telemetry/events.jsonl``, ``metrics.jsonl``.
-Output: ONE JSON object answering the questions a round trace exists
-for —
+Three commands (the bare form stays ``tools/scope <run_dir>``):
+
+- ``tools/scope <run_dir>`` / ``tools/scope summarize <run_dir>`` —
+  ONE JSON object summarizing a run's telemetry (below);
+- ``tools/scope diff A B [--gate] [--pct N]`` — compare two runs'
+  ``scorecard.json`` regression surfaces (A = baseline, B = candidate)
+  with per-metric thresholds; ``--gate`` exits **3** when B regresses,
+  naming the offending metric — the CI / endurance-harness tripwire;
+- ``tools/scope trend BENCH_*.json... [--gate] [--pct N]`` — walk a
+  series of committed bench artifacts and flag a headline / per-protocol
+  round-time regression between the last two measured entries (same
+  exit-code contract).
+
+Summarize input: a model dir (or its ``telemetry/`` subdir) holding any
+of ``telemetry/trace.json``, ``telemetry/events.jsonl``,
+``metrics.jsonl``.  Output: ONE JSON object answering the questions a
+round trace exists for —
 
 - **phase-time breakdown**: total/count/p50 per span name (pack,
   dispatch, stats_fetch, host_tail, housekeeping, ckpt_submit,
@@ -233,10 +246,226 @@ def summarize(run_dir: str) -> Dict[str, Any]:
             events[name] = max(events.get(name, 0), count)
     if events:
         out["events"] = dict(sorted(events.items()))
+
+    # ---- device-truth scorecard (telemetry/scorecard.json): surfaced
+    # verbatim so one `tools/scope <dir>` answers the MFU/HBM/recompile
+    # questions without a second command ------------------------------
+    card_path = os.path.join(tdir, "scorecard.json")
+    if os.path.exists(card_path):
+        try:
+            with open(card_path, "r", encoding="utf-8") as fh:
+                out["scorecard"] = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            out["scorecard"] = "unreadable"
     return out
 
 
+# ======================================================================
+# scorecard diff — the cross-run regression gate
+# ======================================================================
+#: per-metric regression rules: (direction, default threshold).
+#: ``higher_frac``: B worse when > A x (1 + frac); ``lower_frac``: B
+#: worse when < A x (1 - frac); ``higher_abs`` / ``lower_abs``:
+#: absolute-delta rules (counts, percentage points).  Thresholds scale
+#: with ``--pct`` except the count rules (any increase in recompiles /
+#: puts-per-dispatch is a real finding — those counters are flat in a
+#: healthy steady state by construction).
+DIFF_RULES: Dict[str, Tuple[str, float]] = {
+    "round_secs_p50": ("higher_frac", 0.15),
+    "host_tail_secs_p50": ("higher_frac", 0.30),
+    "staged_bytes_per_round_p50": ("higher_frac", 0.10),
+    "hbm_peak_bytes": ("higher_frac", 0.10),
+    "mfu_p50": ("lower_frac", 0.15),
+    "overlap_efficiency_pct": ("lower_abs", 10.0),
+    "recompiles": ("higher_abs", 0.0),
+    "puts_per_dispatch": ("higher_abs", 0.0),
+}
+
+#: metrics whose thresholds scale with --pct (the wall-clock-ish ones)
+_PCT_SCALED = {"round_secs_p50", "host_tail_secs_p50",
+               "staged_bytes_per_round_p50", "hbm_peak_bytes", "mfu_p50"}
+
+
+def load_scorecard(path: str) -> Dict[str, Any]:
+    """A scorecard from a file path, a run dir, or its telemetry dir."""
+    if os.path.isdir(path):
+        for cand in (os.path.join(path, "telemetry", "scorecard.json"),
+                     os.path.join(path, "scorecard.json")):
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"no scorecard.json under {path!r} — was the run's "
+                "telemetry block enabled (server_config.telemetry)?")
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def diff_scorecards(a: Dict[str, Any], b: Dict[str, Any],
+                    pct: Optional[float] = None) -> Dict[str, Any]:
+    """Compare baseline ``a`` against candidate ``b``: per-metric deltas
+    plus the thresholded ``regressions`` list (each naming the metric,
+    both values and the limit it broke).  ``pct`` overrides the
+    wall-clock-class thresholds (as a percentage, e.g. 15)."""
+    metrics: Dict[str, Any] = {}
+    regressions: List[Dict[str, Any]] = []
+    for name, (direction, default_thresh) in DIFF_RULES.items():
+        va, vb = a.get(name), b.get(name)
+        row: Dict[str, Any] = {"a": va, "b": vb}
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            row["delta"] = round(float(vb) - float(va), 6)
+            if va:
+                row["delta_pct"] = round(100.0 * (vb - va) / abs(va), 2)
+            thresh = default_thresh
+            if pct is not None and name in _PCT_SCALED:
+                thresh = float(pct) / 100.0
+            regressed, limit = False, None
+            if direction == "higher_frac" and va > 0:
+                limit = va * (1.0 + thresh)
+                regressed = vb > limit
+            elif direction == "lower_frac" and va > 0:
+                limit = va * (1.0 - thresh)
+                regressed = vb < limit
+            elif direction == "higher_abs":
+                limit = va + thresh
+                regressed = vb > limit
+            elif direction == "lower_abs":
+                limit = va - thresh
+                regressed = vb < limit
+            if regressed:
+                regressions.append({
+                    "metric": name, "a": va, "b": vb,
+                    "limit": round(float(limit), 6),
+                    "rule": direction, "threshold": thresh})
+        metrics[name] = row
+    return {"metrics": metrics, "regressions": regressions,
+            "ok": not regressions}
+
+
+def _diff_main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="scope diff",
+        description="compare two runs' scorecard.json regression "
+                    "surfaces (A = baseline, B = candidate)")
+    ap.add_argument("a", help="baseline: scorecard.json or run dir")
+    ap.add_argument("b", help="candidate: scorecard.json or run dir")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 3 when the candidate regresses")
+    ap.add_argument("--pct", type=float, default=None,
+                    help="override the wall-clock-class thresholds (%%)")
+    ap.add_argument("--indent", type=int, default=None)
+    args = ap.parse_args(argv)
+    try:
+        card_a, card_b = load_scorecard(args.a), load_scorecard(args.b)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"scope diff: {exc}", file=sys.stderr)
+        return 2
+    out = diff_scorecards(card_a, card_b, pct=args.pct)
+    out["a"], out["b"] = args.a, args.b
+    print(json.dumps(out, indent=args.indent, sort_keys=True))
+    if out["regressions"]:
+        names = ", ".join(r["metric"] for r in out["regressions"])
+        print(f"scope diff: REGRESSION in {names}", file=sys.stderr)
+        if args.gate:
+            return 3
+    return 0
+
+
+# ======================================================================
+# bench-artifact trend — the committed-trajectory gate
+# ======================================================================
+def _bench_entry(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if "parsed" in data and "metric" not in data:
+        # driver-round record (BENCH_rNN.json): bench.py's line sits
+        # under "parsed" — null when the driver truncated the capture,
+        # which trend treats as an unmeasured entry and skips over
+        data = data.get("parsed") or {}
+    protocols = {}
+    for name, block in (data.get("extras") or {}).items():
+        if isinstance(block, dict) and "secs_per_round" in block:
+            row = {"secs_per_round": block.get("secs_per_round")}
+            for key in ("mfu_vs_bf16_peak", "device_truth"):
+                if key in block:
+                    row[key] = block[key]
+            protocols[name] = row
+    return {"file": os.path.basename(path),
+            "metric": data.get("metric"), "value": data.get("value"),
+            "backend": (data.get("extras") or {}).get("backend"),
+            "protocols": protocols}
+
+
+def trend_bench(paths: List[str],
+                pct: Optional[float] = None) -> Dict[str, Any]:
+    """Series view over committed bench artifacts (given order — pass
+    them sorted; BENCH_* stamps sort chronologically) + regressions
+    between the last two entries that actually measured: the headline
+    ``value`` and each shared protocol's ``secs_per_round``, both gated
+    at ``pct`` (default 15%) slower-than-previous."""
+    thresh = (float(pct) if pct is not None else 15.0) / 100.0
+    series = [_bench_entry(p) for p in paths]
+    measured = [e for e in series if isinstance(e.get("value"),
+                                                (int, float))]
+    regressions: List[Dict[str, Any]] = []
+    if len(measured) >= 2:
+        prev, last = measured[-2], measured[-1]
+        if last["value"] > prev["value"] * (1.0 + thresh):
+            regressions.append({
+                "metric": "value", "a": prev["value"], "b": last["value"],
+                "a_file": prev["file"], "b_file": last["file"],
+                "limit": round(prev["value"] * (1.0 + thresh), 6),
+                "threshold": thresh})
+        for name in sorted(set(prev["protocols"]) & set(last["protocols"])):
+            sa = prev["protocols"][name].get("secs_per_round")
+            sb = last["protocols"][name].get("secs_per_round")
+            if isinstance(sa, (int, float)) and \
+                    isinstance(sb, (int, float)) and sa > 0 and \
+                    sb > sa * (1.0 + thresh):
+                regressions.append({
+                    "metric": f"{name}.secs_per_round", "a": sa, "b": sb,
+                    "a_file": prev["file"], "b_file": last["file"],
+                    "limit": round(sa * (1.0 + thresh), 6),
+                    "threshold": thresh})
+    return {"series": series, "regressions": regressions,
+            "ok": not regressions}
+
+
+def _trend_main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="scope trend",
+        description="trend committed bench artifacts; gate on a "
+                    "round-time regression between the last two")
+    ap.add_argument("files", nargs="+", help="BENCH_*.json, oldest first")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 3 when the newest artifact regresses")
+    ap.add_argument("--pct", type=float, default=None,
+                    help="slower-than-previous threshold (%%, default 15)")
+    ap.add_argument("--indent", type=int, default=None)
+    args = ap.parse_args(argv)
+    try:
+        out = trend_bench(args.files, pct=args.pct)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"scope trend: {exc}", file=sys.stderr)
+        return 2
+    print(json.dumps(out, indent=args.indent, sort_keys=True))
+    if out["regressions"]:
+        names = ", ".join(r["metric"] for r in out["regressions"])
+        print(f"scope trend: REGRESSION in {names}", file=sys.stderr)
+        if args.gate:
+            return 3
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "diff":
+        return _diff_main(argv[1:])
+    if argv and argv[0] == "trend":
+        return _trend_main(argv[1:])
+    if argv and argv[0] == "summarize":
+        argv = argv[1:]
     ap = argparse.ArgumentParser(
         description="summarize a run directory's flutescope telemetry")
     ap.add_argument("run_dir", help="model dir (or its telemetry/ subdir)")

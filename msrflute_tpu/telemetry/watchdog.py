@@ -18,7 +18,18 @@ escalator's consecutive-failure count; never a device read):
   quarantined means the GLOBAL model is what's diverging (every honest
   client returns garbage) — the distinction between "screen and carry
   on" and "stop the run".  Fed only when ``server_config.robust``
-  screening is on (the fraction rides the packed round stats).
+  screening is on (the fraction rides the packed round stats);
+- **recompile_storm** — the device-truth layer's sentinel counter
+  (telemetry/xla.py ``recompile`` events: a SECOND compile of an entry
+  point that was already warm) reaches
+  ``recompile_storm_threshold`` after
+  ``recompile_storm_warmup_rounds``.  A steady-state round loop
+  compiles each entry point exactly once; every recompile stalls the
+  pipeline for a full XLA compile and silently forfeits the overlap
+  win, so a storm of them is a "your shapes are churning" finding, not
+  noise.  Recompiles that land during the warmup rounds (legitimate
+  geometry discovery: step/length buckets, eval-boundary chunk sizes)
+  set the baseline and never count toward the storm.
 
 Each detector has a configurable action (``server_config.telemetry.
 watchdog``): ``off`` | ``log`` (event only) | ``mark`` (event + durable
@@ -44,6 +55,9 @@ _DEFAULTS = {
     "ckpt_failure_streak": 3,
     "quarantine_rate_action": "mark",
     "quarantine_rate_threshold": 0.5,
+    "recompile_storm_action": "log",
+    "recompile_storm_threshold": 3,
+    "recompile_storm_warmup_rounds": 2,
 }
 
 
@@ -65,7 +79,7 @@ class Watchdog:
         cfg = dict(_DEFAULTS)
         cfg.update({k: raw[k] for k in _DEFAULTS if k in raw})
         for key in ("nan_loss", "round_time_action", "ckpt_failure_action",
-                    "quarantine_rate_action"):
+                    "quarantine_rate_action", "recompile_storm_action"):
             if cfg[key] not in ACTIONS:
                 raise ValueError(
                     f"telemetry.watchdog.{key}: {cfg[key]!r} not in "
@@ -76,6 +90,10 @@ class Watchdog:
         window = max(int(cfg["round_time_window"]), 4)
         self._times: deque = deque(maxlen=window)
         self._last_ckpt_streak = 0
+        # recompile sentinel state: recompiles observed during the
+        # warmup rounds set the baseline; only growth past it counts
+        self._recompile_baseline: Optional[int] = None
+        self._last_storm_count = 0
         #: findings fired this run (observability + tests)
         self.findings: list = []
 
@@ -84,9 +102,16 @@ class Watchdog:
                       train_loss: Optional[float] = None,
                       round_secs: Optional[float] = None,
                       ckpt_failures: int = 0,
-                      quarantine_frac: Optional[float] = None) -> None:
+                      quarantine_frac: Optional[float] = None,
+                      recompiles: Optional[int] = None) -> None:
         """Feed one completed round's host-side observations; applies
-        every enabled detector and its configured action."""
+        every enabled detector and its configured action.
+
+        ``recompiles`` is the CUMULATIVE recompile-event count from the
+        device-truth layer (``RoundEngine.recompile_count`` /
+        ``XlaIntrospector.recompiles``) — already "compiles beyond the
+        first per entry point", so warm-up first compiles never feed the
+        storm detector."""
         if train_loss is not None and self.cfg["nan_loss"] != "off" and \
                 not math.isfinite(float(train_loss)):
             self._fire("nan_loss", self.cfg["nan_loss"],
@@ -114,6 +139,25 @@ class Watchdog:
                                trailing_median_secs=round(float(med), 4),
                                factor=factor)
             self._times.append(float(round_secs))
+        if recompiles is not None and \
+                self.cfg["recompile_storm_action"] != "off":
+            warmup = int(self.cfg["recompile_storm_warmup_rounds"])
+            if round_no < warmup or self._recompile_baseline is None:
+                # warmup rounds (and the first post-warmup observation)
+                # anchor the baseline: geometry discovery retraces are
+                # expected and must not arm the storm
+                self._recompile_baseline = int(recompiles)
+            storm = int(recompiles) - self._recompile_baseline
+            threshold = int(self.cfg["recompile_storm_threshold"])
+            if round_no >= warmup and storm >= threshold and \
+                    storm > self._last_storm_count:
+                # fire on each NEW recompile past the threshold (the
+                # ckpt-streak pattern), not once per round forever
+                self._fire("recompile_storm",
+                           self.cfg["recompile_storm_action"],
+                           round=round_no, recompiles_after_warmup=storm,
+                           threshold=threshold)
+            self._last_storm_count = storm
         streak = int(self.cfg["ckpt_failure_streak"])
         if self.cfg["ckpt_failure_action"] != "off" and streak > 0 and \
                 ckpt_failures >= streak and \

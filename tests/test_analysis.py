@@ -635,6 +635,37 @@ def test_schema_drift_covers_telemetry_and_watchdog_specs(tmp_path):
     assert any("ghost_streak" in m and "WATCHDOG_KEYS" in m for m in msgs)
 
 
+def test_schema_drift_covers_device_truth_keys(tmp_path):
+    """ISSUE 7 corpus: the device-truth knobs (``telemetry.xla`` /
+    ``scorecard``, the ``recompile_storm_*`` watchdog keys) are
+    drift-checked like every other block — a spec row whose key the
+    unknown-key pass doesn't know is dead config and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'telemetry'}\n"
+        # 'xla' missing from TELEMETRY_KEYS, recompile_storm_threshold
+        # missing from WATCHDOG_KEYS: both spec rows are unreachable
+        "TELEMETRY_KEYS = {'enable', 'scorecard'}\n"
+        "WATCHDOG_KEYS = {'recompile_storm_action'}\n"
+        "TELEMETRY_FIELD_SPECS = {'scorecard': ('bool', None, None),"
+        " 'xla': ('bool', None, None)}\n"
+        "WATCHDOG_FIELD_SPECS = "
+        "{'recompile_storm_threshold': ('int', 1, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.telemetry` holds the device-truth knobs.")
+    found = check_project(str(tmp_path), documented_knobs=("telemetry",))
+    msgs = sorted(f.message for f in found)
+    assert [f.rule for f in found] == ["schema-drift", "schema-drift"]
+    assert any("xla" in m and "TELEMETRY_KEYS" in m for m in msgs)
+    assert any("recompile_storm_threshold" in m and "WATCHDOG_KEYS" in m
+               for m in msgs)
+
+
 def test_schema_drift_flags_undocumented_telemetry_knob(tmp_path):
     pkg = tmp_path / "msrflute_tpu"
     pkg.mkdir(parents=True)

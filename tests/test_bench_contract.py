@@ -107,6 +107,36 @@ def test_bench_telemetry_mode_recorded_when_instrumented():
     assert out["extras"]["telemetry"].get("enabled") is True
 
 
+def test_bench_records_device_truth_for_every_measured_protocol():
+    """ISSUE 7 bench contract: every protocol line carries the
+    `device_truth` block — chip kind, MFU vs THIS chip's peak (CPU runs
+    use the documented nominal fallback), `hbm_peak_bytes` from the
+    compiled program's memory analysis, and the engine's always-on
+    `recompiles` counter — so the trajectory files gate on device-truth
+    numbers, not just wall clocks."""
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        env=_env(BENCH_PROTOCOLS="lr_mnist", BENCH_DEADLINE_SECS="300"),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = _json_line(proc.stdout)
+    measured = {k: v for k, v in out["extras"].items()
+                if isinstance(v, dict) and "secs_per_round" in v}
+    assert measured, out["extras"]
+    for name, line in measured.items():
+        truth = line.get("device_truth")
+        assert truth is not None, (name, line)
+        assert set(truth) >= {"chip", "mfu", "hbm_peak_bytes",
+                              "recompiles", "compiled_programs"}, truth
+        # a steady-state bench protocol never recompiles (the sentinel's
+        # no-churn invariant holds on the bench path too)
+        assert truth["recompiles"] == 0, (name, truth)
+        # CPU contract: the nominal-peak fallback still yields a number
+        assert truth["chip"], truth
+        if truth["mfu"] is not None:
+            assert 0.0 < truth["mfu"] <= 1.5, truth
+
+
 def test_sigterm_mid_run_flushes_partial_json():
     """SIGTERM while protocols are running -> partial results + flush_note
     on stdout, clean exit."""

@@ -79,7 +79,9 @@ def _run(cfg, model_dir, seed=0):
 def test_telemetry_off_constructs_no_telemetry_state(tmp_path,
                                                      monkeypatch):
     """With no telemetry block the round loop must never touch the
-    subsystem: Tracer/Watchdog construction would blow up here."""
+    subsystem: Tracer/Watchdog/XlaIntrospector construction would blow
+    up here (the device-truth layer included — telemetry off means NO
+    xla-introspection objects, the plain jit dispatch path)."""
     import msrflute_tpu.telemetry as tel
 
     def bomb(*a, **k):
@@ -87,10 +89,14 @@ def test_telemetry_off_constructs_no_telemetry_state(tmp_path,
 
     monkeypatch.setattr(tel, "Telemetry", bomb)
     monkeypatch.setattr(tel.spans, "Tracer", bomb)
+    monkeypatch.setattr(tel.xla, "XlaIntrospector", bomb)
     server, state = _run(_cfg(pipeline_depth=1), tmp_path)
     assert state.round == 6
     assert server.scope is None
     assert not server.engine.devbus.enabled
+    assert server.engine.xla is None
+    # no scorecard either — nothing to regress-gate without telemetry
+    assert not os.path.exists(tmp_path / "telemetry" / "scorecard.json")
     assert not os.path.isdir(tmp_path / "telemetry")
     # the round program carries no devbus outputs: the stats slot table
     # has no devbus_* entries
@@ -173,6 +179,14 @@ def test_telemetry_on_zero_implicit_syncs_and_bit_identical(tmp_path,
     stats = packer.unpack_np({dt: np.zeros(n, dtype=dt)
                               for dt, n in packer.sizes.items()})
     assert "devbus_update_ratio" in stats
+    # the device-truth layer ran THROUGH the interception harness: AOT
+    # capture recorded the round program's cost with zero implicit
+    # syncs, zero recompiles, and a scorecard on disk — telemetry-on is
+    # transfer-neutral INCLUDING the xla layer
+    assert server.engine.xla is not None
+    assert server.engine.xla.entries and server.engine.xla.recompiles == 0
+    assert os.path.exists(
+        tmp_path / f"tel{depth}" / "telemetry" / "scorecard.json")
 
 
 def test_telemetry_on_keeps_one_packed_fetch_per_round(tmp_path,

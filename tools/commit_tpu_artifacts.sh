@@ -7,7 +7,19 @@ cd /root/repo
 # One add per pathspec: a single missing file must not abort the whole
 # batch (git add fails the entire call on any unmatched pathspec, which
 # is exactly what stranded the first headline artifact).
-for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
+# Canonical trajectory files stay tracked at repo root.
+for f in BENCH_TPU_*.json FULLRUN_TPU_*.json \
+  PROFILE_BERT_TPU.json PROFILE_BERT_GATHERED_TPU.json \
+  PARITY_LONGRUN.json \
+  PROFILE_EVAL_LR_TPU.json PROFILE_EVAL_CNN_TPU.json \
+  FLASH_AUTO_VALIDATION.json DISPATCH_COST_TPU.json; do
+  [ -e "$f" ] && git add -f "$f"
+done
+# Raw per-job captures (stdout json / stderr / logs) are repo-root
+# strays by the ISSUE 7 hygiene rule: route them into artifacts/
+# before committing so the root stays .gitignore-clean.
+mkdir -p artifacts
+for f in bench_tpu_*.json bench_tpu_*.err \
   bench_longctx.json bench_longctx.err \
   tpu_flash_validation.log tpu_pallas_tests.log \
   profile_cnn.json profile_cnn.err \
@@ -16,14 +28,10 @@ for f in BENCH_TPU_*.json bench_tpu_*.json bench_tpu_*.err \
   digits_tpu.json digits_tpu.err \
   flash_crossover.json flash_crossover.err \
   tpu_secagg_ef_tests.log \
-  FULLRUN_TPU_*.json fullrun_tpu.log \
-  PROFILE_BERT_TPU.json PROFILE_BERT_GATHERED_TPU.json profile_bert_tpu.log \
-  PARITY_LONGRUN.json parity_longrun.log \
-  PROFILE_EVAL_LR_TPU.json PROFILE_EVAL_CNN_TPU.json profile_eval_tpu.log \
-  FLASH_AUTO_VALIDATION.json flash_auto_validation.err \
-  DISPATCH_COST_TPU.json dispatch_cost.err \
+  fullrun_tpu.log profile_bert_tpu.log parity_longrun.log \
+  profile_eval_tpu.log flash_auto_validation.err dispatch_cost.err \
   tpu_pallas_attention.log tpu_quant_kernel_probe.log; do
-  [ -e "$f" ] && git add -f "$f"
+  [ -e "$f" ] && mv -f "$f" "artifacts/$f" && git add -f "artifacts/$f"
 done
 git diff --cached --quiet && exit 0
 git commit -m "Add raw on-chip measurement artifacts (TPU queue checkpoint)

@@ -153,21 +153,39 @@ def main(argv=None) -> None:
         except Exception as exc:  # decomposition must not kill the tool
             out["flops_by_op_error"] = f"{type(exc).__name__}: {exc}"
 
-        # ---- XLA's own cost analysis of one client grad step ----
+        # ---- XLA's own cost + memory analysis of one client grad step
+        # (the shared telemetry/xla.py helper — the same numbers the
+        # live device-truth layer records, so this report can never
+        # disagree with a scorecard) ----
         cost = bench.grad_step_cost(task, server.state.params, one)
         if cost is not None:
+            from msrflute_tpu.telemetry.xla import mfu as mfu_of
+            from msrflute_tpu.utils.compat import chip_peak_flops
             flops = float(cost.get("flops", 0.0))
             out["client_step_flops"] = flops
-            out["client_step_bytes"] = float(
-                cost.get("bytes accessed", 0.0))
+            out["client_step_bytes"] = float(cost.get("bytes_accessed",
+                                                      0.0))
+            if "hbm_bytes" in cost:
+                out["client_step_hbm_bytes"] = cost["hbm_bytes"]
             out["round_model_flops"] = flops * server.max_steps * len(sampled)
+            chip_kind, chip_peak = chip_peak_flops()
+            value = mfu_of(out["round_model_flops"],
+                           float(np.median(per_round)),
+                           peak_flops=chip_peak)
+            if value is not None:
+                out["mfu_vs_chip_peak"] = {"chip": chip_kind,
+                                           "mfu": round(value, 6)}
             if on_tpu:
                 out["mfu_vs_bf16_peak"] = round(
-                    out["round_model_flops"] / max(np.median(per_round),
-                                                   1e-9)
-                    / bench.V5E_BF16_PEAK_FLOPS, 5)
+                    mfu_of(out["round_model_flops"],
+                           float(np.median(per_round)),
+                           peak_flops=bench.V5E_BF16_PEAK_FLOPS) or 0.0, 5)
         else:
-            out["cost_analysis_error"] = "cost analysis unavailable"
+            # structured (not silently swallowed): name the helper that
+            # declined so an operator knows WHICH layer has no analysis
+            out["cost_analysis_error"] = (
+                "telemetry.xla.aot_cost returned None — XLA cost "
+                "analysis unavailable on this jax/backend")
 
         # ---- eval cost breakdown: bench.py's secs_eval is an absolute
         # (~0.07 s even for tiny protocols) larger than a train round;

@@ -71,6 +71,17 @@ def main() -> None:
             "other_share": res["other_share"],
             "approximate": res["approximate"],
         }
+        # XLA's own compiled-program numbers next to the jaxpr walk —
+        # through the ONE shared helper (telemetry/xla.aot_cost, same
+        # path as bench.grad_step_cost and the live device-truth layer),
+        # so the two FLOP accountings can be compared without wondering
+        # whether they were measured differently
+        from msrflute_tpu.telemetry.xla import aot_cost
+        cost = aot_cost(grad_step, params)
+        if cost is not None:
+            report[name]["xla_flops"] = cost.get("flops")
+            report[name]["xla_bytes_accessed"] = cost.get("bytes_accessed")
+            report[name]["xla_hbm_bytes"] = cost.get("hbm_bytes")
         print(f"{name}: mxu={res['mxu_share']:.3f} "
               f"(conv={res['conv_share']:.3f} dot={res['dot_share']:.3f})")
 
