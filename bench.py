@@ -668,6 +668,26 @@ def _server_overhead_extras(server) -> dict:
             "staged_kb": round(
                 getattr(engine, "last_staged_bytes", 0) / 1024.0, 2),
         }
+    # padding efficiency (cohort shape-bucketing's meter): run-total
+    # real samples / padded grid slots — recorded on EVERY protocol so
+    # the monolithic baseline and a bucketed run are directly
+    # comparable, and `tools/scope trend` can gate a drop between
+    # committed artifacts
+    pad_eff = getattr(server, "padding_efficiency", None)
+    if pad_eff is not None:
+        out["padding_efficiency"] = round(float(pad_eff), 4)
+    cb = getattr(server, "cohort_bucketing", None)
+    if cb is not None:
+        # contract marker (the chaos/telemetry/robust discipline): a
+        # bucketed run can never be silently compared against a
+        # monolithic baseline
+        out["cohort_bucketing"] = {
+            "enabled": True,
+            "boundaries": list(cb["boundaries"]),
+            "max_buckets": int(cb["max_buckets"]),
+            "bucket_grid_variants":
+                len(getattr(server.engine, "bucket_shapes_seen", ())),
+        }
     chaos = getattr(server, "chaos", None)
     if chaos is not None:
         out["chaos"] = dict(chaos.describe(),
@@ -1110,14 +1130,21 @@ def bench_fused_carry_ab(on_tpu: bool) -> dict:
     return out
 
 
-def _config_block_ab(on_tpu: bool, key: str, arms: dict) -> dict:
+def _config_block_ab(on_tpu: bool, key: str, arms: dict,
+                     data_fn=None, protocol=None, per_arm=None) -> dict:
     """Shared off-vs-on overhead harness: run the SAME faithful-mode
     protocol once per arm with ``server_config[key]`` set to that arm's
     block (``None`` = block absent), many rounds inside one ``train()``
-    call, and record steady-state ``{key}_{arm}_secs_per_round``.  Both
-    subsystem A/Bs (telemetry, robust) ride this so their warm-up and
-    measurement protocols can never drift apart; ratio keys are the
-    caller's job (arm sets differ)."""
+    call, and record steady-state ``{key}_{arm}_secs_per_round``.  The
+    subsystem A/Bs (telemetry, robust, cohort_bucketing) ride this so
+    their warm-up and measurement protocols can never drift apart; ratio
+    keys are the caller's job (arm sets differ).
+
+    ``data_fn()`` overrides the default homogeneous dataset (the
+    cohort-bucketing A/B needs heterogeneous client sizes — the whole
+    point of the optimization); ``protocol`` labels it; ``per_arm(server,
+    arm)`` returns extra per-arm fields recorded under ``{key}_{arm}_*``.
+    """
     import tempfile
 
     import jax
@@ -1128,18 +1155,21 @@ def _config_block_ab(on_tpu: bool, key: str, arms: dict) -> dict:
 
     warm, rounds = (5, 40) if on_tpu else (3, 30)
     out = {"rounds_per_arm": rounds,
-           "protocol": "cnn_femnist" if on_tpu else "lr_mnist"}
+           "protocol": protocol or
+           ("cnn_femnist" if on_tpu else "lr_mnist")}
     for arm, block in arms.items():
         if on_tpu:
             cfg = _flute_config({"model_type": "CNN", "num_classes": 62},
                                 20, 0.1, fuse=1)
-            data = _image_dataset(64, 240, (28, 28, 1), 62,
-                                  np.random.default_rng(0))
+            data = (data_fn() if data_fn is not None else
+                    _image_dataset(64, 240, (28, 28, 1), 62,
+                                   np.random.default_rng(0)))
         else:
             cfg = _flute_config({"model_type": "LR", "num_classes": 10,
                                  "input_dim": 784}, 10, 0.03, fuse=1)
-            data = _image_dataset(16, 60, (784,), 10,
-                                  np.random.default_rng(0))
+            data = (data_fn() if data_fn is not None else
+                    _image_dataset(16, 60, (784,), 10,
+                                   np.random.default_rng(0)))
         if block is not None:
             cfg.server_config[key] = dict(block)
         task = make_task(cfg.model_config)
@@ -1152,6 +1182,9 @@ def _config_block_ab(on_tpu: bool, key: str, arms: dict) -> dict:
             with Stopwatch() as sw:
                 server.train()
                 jax.block_until_ready(server.state.params)
+            if per_arm is not None:
+                for name, value in per_arm(server, arm).items():
+                    out[f"{key}_{arm}_{name}"] = value
         out[f"{key}_{arm}_secs_per_round"] = round(sw.secs / rounds, 5)
     return out
 
@@ -1195,6 +1228,102 @@ def bench_robust_ab(on_tpu: bool) -> dict:
     for arm in ("screened_mean", "trimmed_mean"):
         out[f"{arm}_overhead_ratio"] = round(
             out[f"robust_{arm}_secs_per_round"] / max(off, 1e-9), 3)
+    return out
+
+
+def _hetero_image_dataset(pool, shape, classes, rng, min_samples=4,
+                          max_samples=256, small_frac=0.75):
+    """Heterogeneous federated pool: ``small_frac`` of users hold a
+    handful of samples (uniform near ``min_samples``) and the rest a
+    log-uniform tail up to ``max_samples`` — the real-federated shape
+    (most phones have little data, a few have lots) that the monolithic
+    [K, S, B] grid pads worst: every client pays the biggest client's
+    step count.  What cohort bucketing exists for."""
+    from msrflute_tpu.data import ArraysDataset
+    users, per_user = [], []
+    n_small = int(pool * small_frac)
+    lo_tail = min(10 * min_samples, max_samples)
+    counts = np.concatenate([
+        rng.integers(min_samples, lo_tail + 1, size=n_small),
+        np.exp(rng.uniform(np.log(lo_tail), np.log(max_samples),
+                           size=pool - n_small)).astype(int)])
+    counts = np.clip(counts, min_samples, max_samples)
+    counts[-1] = max_samples  # pin the worst case so S_max is stable
+    for u in range(pool):
+        n = int(counts[u])
+        x = rng.integers(0, 256, size=(n,) + shape, dtype=np.uint8)
+        y = rng.integers(0, classes, size=(n,)).astype(np.int32)
+        users.append(f"u{u:04d}")
+        per_user.append({"x": x, "y": y})
+    return ArraysDataset(users, per_user)
+
+
+def bench_cohort_bucketing_ab(on_tpu: bool) -> dict:
+    """Monolithic vs bucketed A/B on a HETEROGENEOUS cohort (ISSUE 8
+    acceptance): same protocol, same log-uniform client-size spread,
+    ``cohort_bucketing`` off vs on.  Records per-arm wall-clock,
+    padding efficiency (real samples / padded grid slots), the padded
+    grid slots per round (the masked-FLOPs proxy — grid slots x the
+    per-step cost IS the round's compute), compiled bucket-grid
+    variants, and the engine's always-on recompile counter — so the
+    win is measured against the ``<= max_buckets`` compiled-program
+    budget, not asserted."""
+    def data_fn():
+        # strongly heterogeneous (log-uniform over two orders of
+        # magnitude) — the real-federated shape: most clients tiny, a
+        # few huge, so the monolithic grid pads nearly everyone to the
+        # biggest client's step count
+        if on_tpu:
+            return _hetero_image_dataset(64, (28, 28, 1), 62,
+                                         np.random.default_rng(7),
+                                         min_samples=20, max_samples=4800)
+        return _hetero_image_dataset(48, (784,), 10,
+                                     np.random.default_rng(7),
+                                     min_samples=4, max_samples=1200)
+
+    def per_arm(server, arm):
+        pad = getattr(server, "padding_efficiency", None)
+        extra = {
+            "padding_efficiency": round(float(pad), 4)
+            if pad is not None else None,
+            "recompiles": int(server.engine.recompile_count),
+            "compiled_programs": len(server.engine.compile_log),
+            "bucket_grid_variants":
+                len(server.engine.bucket_shapes_seen),
+        }
+        # masked-FLOPs proxy: padded grid slots per round — slots x the
+        # (identical per arm) per-step cost IS the round's compute;
+        # monolithic pays K * S_max * B whatever the cohort needed
+        rounds = max(int(server.state.round), 1)
+        extra["grid_slots_per_round"] = int(server._pad_slots / rounds)
+        # communication side: staged host->device kb per round (in pool
+        # mode these are int32 index bytes, not feature bytes)
+        staged = server.run_stats.get("hostToDeviceBytesPerRound") or []
+        if staged:
+            extra["staged_kb_per_round"] = round(
+                float(np.mean(staged)) / 1024.0, 2)
+        return extra
+
+    max_buckets = 4
+    out = _config_block_ab(
+        on_tpu, "cohort_bucketing",
+        {"off": None, "on": {"enable": True, "max_buckets": max_buckets,
+                             "slack": 1.25}},
+        data_fn=data_fn,
+        protocol=("cnn_femnist_hetero" if on_tpu else "lr_mnist_hetero"),
+        per_arm=per_arm)
+    out["max_buckets"] = max_buckets
+    off = out["cohort_bucketing_off_secs_per_round"]
+    out["speedup"] = round(
+        off / max(out["cohort_bucketing_on_secs_per_round"], 1e-9), 3)
+    pe_off = out.get("cohort_bucketing_off_padding_efficiency")
+    pe_on = out.get("cohort_bucketing_on_padding_efficiency")
+    if pe_off and pe_on:
+        out["padding_efficiency_gain"] = round(pe_on / pe_off, 3)
+        # FLOPs ratio == slots ratio at fixed per-step cost: padding
+        # efficiency is real/slots with identical real work per arm
+        out["flops_ratio_bucketed_vs_monolithic"] = round(
+            pe_off / pe_on, 3)
     return out
 
 
@@ -1491,6 +1620,21 @@ def main() -> None:
                 extras["robust_overhead_ab"] = bench_robust_ab(on_tpu)
         except Exception as exc:
             extras["robust_overhead_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # cohort shape-bucketing A/B on a heterogeneous cohort: default-on
+    # for CPU runs (the padding-efficiency acceptance evidence),
+    # env-gated on TPU like the others
+    if (not on_tpu or os.environ.get("BENCH_BUCKETING_AB")) and \
+            (keep is None or "cohort_bucketing_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("cohort_bucketing_ab"):
+                extras["cohort_bucketing_ab"] = \
+                    bench_cohort_bucketing_ab(on_tpu)
+        except Exception as exc:
+            extras["cohort_bucketing_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

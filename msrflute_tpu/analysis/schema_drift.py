@@ -52,6 +52,8 @@ _SPEC_MAP = {
     "WATCHDOG_FIELD_SPECS": "WATCHDOG_KEYS",
     # fluteshield screened aggregation (PR 5)
     "ROBUST_FIELD_SPECS": "ROBUST_KEYS",
+    # cohort shape-bucketing (PR 8)
+    "COHORT_BUCKETING_FIELD_SPECS": "COHORT_BUCKETING_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -78,6 +80,10 @@ DOCUMENTED_KNOBS = (
     # fluteshield: an operator who cannot find the screened-aggregation
     # drill will learn about poisoned cohorts from a diverged model
     "robust",
+    # cohort shape-bucketing: an operator who cannot find the bucket
+    # tuning drill will keep paying masked FLOPs padding every client
+    # to the slowest one
+    "cohort_bucketing",
 )
 
 _DOC_MENTION_RE = re.compile(

@@ -412,6 +412,13 @@ class ServerConfig(Config):
     # schema.ROBUST_KEYS; absent (the default) is the firewall path:
     # the exact pre-fluteshield round program
     robust: Optional[Dict[str, Any]] = None
+    # cohort shape-bucketing (engine/round.py + data/batching.py):
+    # partition each round's cohort into power-of-two step buckets and
+    # dispatch one compact grid per bucket instead of padding every
+    # client to the slowest one — free-form dict validated by
+    # schema.COHORT_BUCKETING_KEYS; absent (the default) keeps the
+    # monolithic [K, S, B] round program
+    cohort_bucketing: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -433,7 +440,8 @@ class ServerConfig(Config):
             "do_profiling", "wantRL", "aggregate_median", "softmax_beta",
             "initial_lr", "weight_train_loss", "stale_prob",
             "num_skip_decoding", "nbest_task_scheduler", "chaos",
-            "checkpoint_retry", "telemetry", "robust"]))
+            "checkpoint_retry", "telemetry", "robust",
+            "cohort_bucketing"]))
         out.data_config = data
         out.optimizer_config = opt
         out.annealing_config = ann

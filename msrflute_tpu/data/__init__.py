@@ -1,7 +1,8 @@
 from .user_blob import load_user_blob, UserBlob  # noqa: F401
 from .dataset import BaseDataset, ArraysDataset  # noqa: F401
 from .batching import (  # noqa: F401
-    IndexRoundBatch, RoundBatch, build_sample_pool, pack_eval_batches,
-    pack_round_batches, pack_round_indices, steps_for,
+    IndexRoundBatch, RoundBatch, assign_step_buckets, bucket_boundaries,
+    build_sample_pool, ceil_div, pack_eval_batches, pack_round_batches,
+    pack_round_indices, padding_efficiency, pow2_ceil, steps_for,
 )
 from .samplers import BatchSampler, DynamicBatchSampler  # noqa: F401

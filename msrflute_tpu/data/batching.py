@@ -47,6 +47,16 @@ class RoundBatch:
         return self.sample_mask.shape
 
 
+def ceil_div(n: int, d: int) -> int:
+    """Integer ceiling division — the ONE spelling of the idiom that
+    :func:`steps_for` and :func:`_sample_cap` both used to hand-roll
+    (``math.ceil(a / b)`` truncates for large ints via the float detour;
+    ``-(-a // b)`` is exact but write-only).  Property-tested at the
+    ``desired_max_samples`` mid-batch boundary in
+    ``tests/test_cohort_bucketing.py``."""
+    return -(-int(n) // int(d))
+
+
 def steps_for(max_samples: int, batch_size: int,
               desired_max_samples: Optional[int] = None) -> int:
     """Static local-step count S for a round program.
@@ -57,7 +67,7 @@ def steps_for(max_samples: int, batch_size: int,
     """
     cap = max_samples if desired_max_samples is None else min(
         max_samples, desired_max_samples)
-    return max(1, math.ceil(cap / batch_size))
+    return max(1, ceil_div(cap, batch_size))
 
 
 def _sample_cap(S: int, B: int, desired_max_samples: Optional[int]) -> int:
@@ -71,7 +81,7 @@ def _sample_cap(S: int, B: int, desired_max_samples: Optional[int]) -> int:
     would wrongly engage at all)."""
     if desired_max_samples is None:
         return S * B
-    return min(S * B, -(-int(desired_max_samples) // B) * B)
+    return min(S * B, ceil_div(desired_max_samples, B) * B)
 
 
 def _pad_feat(sample_count: int, shape: tuple, dtype) -> np.ndarray:
@@ -87,6 +97,7 @@ def pack_round_batches(
     shuffle: bool = True,
     pad_clients_to: Optional[int] = None,
     desired_max_samples: Optional[int] = None,
+    orders: Optional[Dict[int, np.ndarray]] = None,
 ) -> RoundBatch:
     """Assemble ``[K, S, B, ...]`` arrays for the sampled clients.
 
@@ -95,6 +106,13 @@ def pack_round_batches(
     zero-pad to the static grid.  K is padded to ``pad_clients_to`` (mesh
     divisibility) with zero-weight clients — the masked equivalent of
     FLUTE's idle-node dummy syncs (``core/federated.py:251-262``).
+
+    ``orders`` (client id -> sample permutation) overrides the in-place
+    shuffle draw: cohort bucketing pre-draws every sampled client's
+    permutation in COHORT order before packing per-bucket grids, so the
+    rng trail — and hence every client's sample order — is identical to
+    what the monolithic pack would have drawn (the cross-mode
+    bit-identity anchor, ``tests/test_cohort_bucketing.py``).
     """
     rng = rng or np.random.default_rng(0)
     K = len(client_indices)
@@ -102,8 +120,11 @@ def pack_round_batches(
     S, B = max_steps, batch_size
     spec = dataset.element_spec
 
-    arrays = {k: np.zeros((K_pad, S, B) + shape,
-                          dtype=dataset.user_arrays(client_indices[0])[k].dtype)
+    # an EMPTY client list still packs a valid all-padding grid (a
+    # bucketed round dispatches every bucket at its static capacity,
+    # occupied or not) — dtypes come from user 0
+    ref = dataset.user_arrays(client_indices[0] if K else 0)
+    arrays = {k: np.zeros((K_pad, S, B) + shape, dtype=ref[k].dtype)
               for k, shape in spec.items()}
     sample_mask = np.zeros((K_pad, S, B), dtype=np.float32)
     num_samples = np.zeros((K_pad,), dtype=np.float32)
@@ -115,7 +136,10 @@ def pack_round_batches(
     for j, ci in enumerate(client_indices):
         user = dataset.user_arrays(ci)
         n = len(next(iter(user.values())))
-        order = rng.permutation(n) if shuffle else np.arange(n)
+        if orders is not None:
+            order = orders[ci]
+        else:
+            order = rng.permutation(n) if shuffle else np.arange(n)
         take = order[:cap]
         users.append(user)
         takes.append(take)
@@ -130,6 +154,8 @@ def pack_round_batches(
     # numpy fallback is identical, just single-threaded
     from ..native import gather_rows
     for k, shape in spec.items():
+        if not users:
+            break
         dst = arrays[k].reshape((K_pad, S * B) + shape)
         srcs = [np.asarray(u[k]) for u in users]
         if not gather_rows(dst, srcs, takes):
@@ -204,12 +230,14 @@ def pack_round_indices(
     shuffle: bool = True,
     pad_clients_to: Optional[int] = None,
     desired_max_samples: Optional[int] = None,
+    orders: Optional[Dict[int, np.ndarray]] = None,
 ) -> IndexRoundBatch:
     """:func:`pack_round_batches` with the row gather deferred to the
     device: identical sampling/shuffle/cap/mask semantics (same rng
     consumption, so a pool-mode round is bit-comparable to a host-packed
     one), but the output is ``[K, S, B]`` int32 indices into the
     :func:`build_sample_pool` flat pool instead of gathered feature rows.
+    ``orders`` as in :func:`pack_round_batches`.
     """
     rng = rng or np.random.default_rng(0)
     K = len(client_indices)
@@ -225,7 +253,10 @@ def pack_round_indices(
     cap = _sample_cap(S, B, desired_max_samples)
     for j, ci in enumerate(client_indices):
         n = int(dataset.num_samples[ci])
-        order = rng.permutation(n) if shuffle else np.arange(n)
+        if orders is not None:
+            order = orders[ci]
+        else:
+            order = rng.permutation(n) if shuffle else np.arange(n)
         take = order[:cap]
         t = len(take)
         indices[j].reshape(-1)[:t] = offsets[ci] + take
@@ -280,6 +311,160 @@ def pack_eval_batches(
     batched["sample_mask"] = mask.reshape(T, B)
     batched["user_idx"] = user_idx.reshape(T, B)
     return batched
+
+
+# ----------------------------------------------------------------------
+# cohort shape-bucketing (server_config.cohort_bucketing): the step-count
+# analogue of seq_length_bucket.  One monolithic [K, S, B, ...] grid pads
+# every client to the slowest one's step count; partitioning the cohort
+# into a small set of power-of-two step buckets builds one COMPACT grid
+# per bucket instead, so small clients stop burning masked FLOPs on a
+# big client's steps.  Everything here is host-side numpy over counts —
+# the device half (per-bucket collect + on-device combine) lives in
+# engine/round.py.
+# ----------------------------------------------------------------------
+def pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (min 1) — the shape quantizer that
+    keeps the compiled-variant set logarithmic, same discipline as
+    :func:`seq_length_bucket`'s length buckets."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def bucket_boundaries(needs: Sequence[int], max_buckets: int,
+                      max_steps: int) -> list:
+    """Derive the step-bucket boundary set from the POPULATION's
+    per-client step needs: the distinct power-of-two ceilings (capped at
+    ``max_steps``), greedily merged down to ``max_buckets`` by the
+    smallest added padded-step cost.
+
+    The result is strictly increasing and always ends at
+    ``pow2_ceil(max need)`` (clamped to ``max_steps``), so every client
+    fits some bucket — a client's grid S must be >= its need or its
+    data would silently truncate.  Deterministic in the needs multiset.
+    """
+    if max_buckets < 1:
+        raise ValueError("cohort_bucketing.max_buckets must be >= 1")
+    pops: dict = {}
+    for need in needs:
+        s = min(pow2_ceil(max(int(need), 1)), int(max_steps))
+        pops[s] = pops.get(s, 0) + 1
+    bounds = sorted(pops)
+    # greedy merge: absorbing bucket b into the next-larger one costs its
+    # population x the extra padded steps; drop the cheapest until bounded
+    while len(bounds) > max_buckets:
+        costs = [(pops[bounds[i]] * (bounds[i + 1] - bounds[i]), i)
+                 for i in range(len(bounds) - 1)]
+        _, i = min(costs)
+        pops[bounds[i + 1]] += pops.pop(bounds[i])
+        del bounds[i]
+    return bounds
+
+
+def assign_step_buckets(needs: Sequence[int],
+                        boundaries: Sequence[int],
+                        capacities: Optional[Sequence[int]] = None
+                        ) -> "Dict[int, list]":
+    """Deterministic bucket assignment for one round's cohort.
+
+    ``needs[j]``: sampled client j's step need (``steps_for``);
+    ``boundaries``: strictly increasing bucket S values whose last entry
+    covers every need.  Each client goes to the SMALLEST bucket whose S
+    covers it — a pure function of (needs, boundaries, capacities),
+    independent of rng or host loop arrangement, so serial/pipelined/
+    resumed runs bucket identically.
+
+    Without ``capacities``: returns only occupied buckets.  With
+    ``capacities`` (one per boundary): every bucket appears (possibly
+    empty — the STATIC-shape contract: every bucket grid dispatches
+    every round at its fixed capacity, so the compiled shape set is
+    closed by construction), and a bucket at capacity spills its
+    overflow UP to the next larger bucket — a larger S is always
+    mathematically correct (masked padding steps are no-ops), it only
+    wastes steps.  The TOP bucket ignores its capacity; the caller
+    enlarges its grid for the (rare, sentinel-visible) overflow round.
+
+    Returns ``{S: [cohort positions]}``, positions in cohort order,
+    keys ascending.
+    """
+    bounds = list(boundaries)
+    if any(b <= a for a, b in zip(bounds, bounds[1:])):
+        raise ValueError(
+            f"bucket boundaries must be strictly increasing, got {bounds}")
+    out: Dict[int, list] = ({s: [] for s in bounds}
+                            if capacities is not None else {})
+    for j, need in enumerate(needs):
+        need = max(int(need), 1)
+        placed = False
+        for i, s in enumerate(bounds):
+            if need > s:
+                continue
+            if capacities is not None and i < len(bounds) - 1 and \
+                    len(out[s]) >= int(capacities[i]):
+                continue  # bucket full: spill up to the next larger S
+            out.setdefault(s, []).append(j)
+            placed = True
+            break
+        if not placed:
+            raise ValueError(
+                f"client step need {need} exceeds the largest bucket "
+                f"boundary {bounds[-1]} — boundaries must cover max_steps")
+    return {s: out[s] for s in sorted(out)}
+
+
+def bucket_capacities(needs: Sequence[int], boundaries: Sequence[int],
+                      cohort_size: int, quantum: int = 1,
+                      slack: float = 1.5) -> list:
+    """Static per-bucket client capacities from the POPULATION mix.
+
+    For each boundary: the expected bucket occupancy of a
+    ``cohort_size`` sample (population fraction x cohort) with
+    ``slack`` headroom for sampling variance, clamped to the cohort
+    size and the bucket's population (without-replacement sampling can
+    never exceed either), rounded up to ``quantum`` (mesh
+    divisibility).  Computed ONCE at server init — capacities are what
+    make every bucket grid's ``[K_b, S_b, B]`` shape static across
+    rounds, so the run compiles exactly one collect program per bucket
+    and zero post-warmup recompiles (overflow spills up; top-bucket
+    overflow is the one sentinel-visible exception — ITS enlarged grid
+    is pow2-quantized so even pathological overflow stays logarithmic
+    in compiled variants)."""
+    bounds = list(boundaries)
+    counts = {s: 0 for s in bounds}
+    for need in needs:
+        need = max(int(need), 1)
+        for s in bounds:
+            if need <= s:
+                counts[s] += 1
+                break
+    total = max(sum(counts.values()), 1)
+    caps = []
+    for s in bounds:
+        pop_b = counts[s]
+        want = ceil_div(int(math.ceil(slack * cohort_size * pop_b)), total) \
+            if pop_b else 1
+        cap = max(min(want, int(cohort_size), max(pop_b, 1)), 1)
+        caps.append(ceil_div(cap, quantum) * quantum)
+    return caps
+
+
+def grid_slots(batches: Sequence) -> int:
+    """Total padded sample slots of a chunk's grids (``K*S*B`` summed) —
+    the denominator of the padding-efficiency meter."""
+    total = 0
+    for b in batches:
+        k, s, bs = b.sample_mask.shape
+        total += int(k) * int(s) * int(bs)
+    return total
+
+
+def padding_efficiency(batches: Sequence) -> float:
+    """Real samples / padded grid slots of a chunk (1.0 = zero waste).
+    The scorecard/bench meter the cohort-bucketing win is gated on —
+    counts REAL (capped) samples from ``num_samples``, same convention
+    as the aggregation weights."""
+    slots = grid_slots(batches)
+    real = sum(float(np.sum(b.num_samples)) for b in batches)
+    return real / slots if slots else 0.0
 
 
 def seq_length_bucket(batches: Sequence[RoundBatch],

@@ -84,6 +84,47 @@ class PackedStats:
 
 
 @dataclass
+class BucketedStats:
+    """Lazy handle to a bucketed chunk's per-round packed stats.
+
+    Cohort bucketing dispatches each round as N collect programs plus a
+    finalize whose packed stats ride the same one-buffer-per-dtype
+    contract as :class:`PackedStats` — but rounds of one chunk may have
+    different cohort-vector lengths (per-client privacy stats are laid
+    out as the concatenation of that round's buckets), so the chunk's
+    stats cannot ride one stacked buffer.  ``fetch`` pulls every round's
+    buffers in ONE ``device_get`` call (still one packed buffer per
+    dtype group per round — the invariant), then stacks host-side:
+    scalars to ``[R]``, per-client vectors zero-padded to the chunk max
+    (their mask is the batches' client_mask, padded identically by the
+    server)."""
+
+    rounds_stats: list  #: one PackedStats per round, dispatch order
+
+    @property
+    def rounds(self) -> int:
+        return len(self.rounds_stats)
+
+    def fetch(self) -> Dict[str, np.ndarray]:
+        host = jax.device_get([ps.vecs for ps in self.rounds_stats])
+        decoded = [ps.packer.unpack_np(h)
+                   for ps, h in zip(self.rounds_stats, host)]
+        out: Dict[str, np.ndarray] = {}
+        for key in decoded[0]:
+            vals = [np.asarray(d[key]) for d in decoded]
+            if vals[0].ndim == 0:
+                out[key] = np.asarray(vals)
+                continue
+            width = max(v.shape[0] for v in vals)
+            out[key] = np.stack([
+                v if v.shape[0] == width else np.concatenate(
+                    [v, np.zeros((width - v.shape[0],) + v.shape[1:],
+                                 v.dtype)])
+                for v in vals])
+        return out
+
+
+@dataclass
 class ServerState:
     """Replicated server-side state threaded through rounds
     (the analogue of the reference's global model + ModelUpdater optimizer +
@@ -316,6 +357,67 @@ class RoundEngine:
                     "(strategies/robust.py); the server wires this — "
                     "constructing RoundEngine directly, pass it yourself")
 
+        # cohort shape-bucketing (server_config.cohort_bucketing): the
+        # round's sampled clients partition into a small set of
+        # power-of-two step buckets; each bucket dispatches a COMPACT
+        # [K_b, S_b, B, ...] collect program (the same per-client math
+        # as the fused round — masked padding steps are no-op-pinned,
+        # so per-client updates are bit-identical), and a finalize
+        # program combines the per-bucket partials into the weighted
+        # aggregate ON DEVICE in deterministic bucket order.  One packed
+        # stats fetch per round and zero implicit host syncs, unchanged.
+        _cb_raw = sc.get("cohort_bucketing") or {}
+        self.cohort_bucketing = bool(_cb_raw and _cb_raw.get("enable", True))
+        # an EXPLICIT max_buckets: 0 must reach the < 1 refusal below,
+        # not silently coerce to the default (bench injects blocks past
+        # schema validation)
+        _mb = _cb_raw.get("max_buckets")
+        self.bucket_max = 4 if _mb is None else int(_mb)
+        if self.cohort_bucketing:
+            if self.bucket_max < 1:
+                raise ValueError("cohort_bucketing.max_buckets must be >= 1")
+            if self.clients_per_chunk:
+                raise ValueError(
+                    "cohort_bucketing is incompatible with "
+                    "clients_per_chunk: the chunk scan assumes one grid "
+                    "shape per round — pick one HBM/FLOP bounding scheme")
+            if self.dump_norm_stats:
+                raise ValueError(
+                    "cohort_bucketing is incompatible with "
+                    "dump_norm_stats: per-client cosines need every "
+                    "payload against the final aggregate inside ONE "
+                    "program — disable one of them")
+            if self.rl_fused:
+                raise ValueError(
+                    "cohort_bucketing does not compose with fused RL: "
+                    "the DQN re-weighting assumes the single-grid payload "
+                    "stack — drop wantRL or cohort_bucketing")
+            if getattr(strategy, "wants_cohort", False):
+                raise ValueError(
+                    f"cohort_bucketing does not compose with "
+                    f"{type(strategy).__name__}: pairwise-mask cohorts "
+                    "(secure aggregation) need every pairmate in one "
+                    "grid for mask cancellation — drop cohort_bucketing")
+            if not self.input_staging:
+                raise ValueError(
+                    "cohort_bucketing requires input_staging (the "
+                    "legacy per-leaf dispatch path is kept only for the "
+                    "staging A/B) — drop `input_staging: false`")
+            if self.shield is not None and \
+                    float(getattr(strategy, "stale_prob", 0.0) or 0.0) > 0:
+                raise ValueError(
+                    "cohort_bucketing + robust screening does not "
+                    "support stale_prob > 0")
+        #: staged per-bucket collect programs, keyed by grid geometry +
+        #: packer signatures — one compiled variant per distinct
+        #: (K_b, S_b) shape, which the recompile sentinel watches
+        self._bucket_collect_cache: Dict[Any, Callable] = {}
+        self._bucket_collect_core = None
+        self._bucket_finalize = None
+        #: distinct (K_b, S_b) collect grids this run compiled — the
+        #: scorecard/bench closure metric gated against max_buckets
+        self.bucket_shapes_seen: set = set()
+
         # flutescope device-metric bus (server_config.telemetry.devbus):
         # engine/strategy code publishes per-round device scalars at
         # TRACE time; round_step drains them into round_stats just
@@ -444,6 +546,9 @@ class RoundEngine:
         self._multi_cache = {}
         self._staged_cache = {}
         self._stats_packers = {}
+        self._bucket_collect_cache = {}
+        self._bucket_collect_core = None
+        self._bucket_finalize = None
         self._round_step = self._build_round_step()
 
     # ------------------------------------------------------------------
@@ -1486,6 +1591,594 @@ class RoundEngine:
         packer = self._stats_packers[
             ("single", batches[0].sample_mask.shape[0])]
         return new_state, PackedStats(vecs, packer, rounds=R, stacked=True)
+
+    # ------------------------------------------------------------------
+    # cohort shape-bucketing (server_config.cohort_bucketing): one
+    # COMPACT [K_b, S_b, B, ...] collect program per step bucket + one
+    # finalize program per round that combines the per-bucket partials
+    # into the weighted aggregate ON DEVICE.  The per-client math is the
+    # fused round's exactly (client rng streams fold on client id, and
+    # masked padding steps are no-op-pinned), so per-client updates are
+    # bit-identical to the monolithic grid; only the summation
+    # association differs, in a DETERMINISTIC left-to-right bucket
+    # order.  Compiled-program economics: one collect variant per
+    # distinct (K_b, S_b) grid — S_b values come from the config-bounded
+    # boundary set and K_b is pow2-quantized by the server — plus one
+    # finalize variant per bucket-shape signature; the PR 7 recompile
+    # sentinel watches that this set stays closed after warmup.
+    # ------------------------------------------------------------------
+    def _get_bucket_collect_core(self) -> Callable:
+        """The un-jitted one-bucket collect body (shared by every staged
+        per-shape variant): chaos fold + vmap'd client math + either the
+        psum'd partial sums (default) or the gathered per-client stack
+        (shield mode, where screening must see the WHOLE cohort and so
+        defers to the finalize program)."""
+        if self._bucket_collect_core is not None:
+            return self._bucket_collect_core
+        strategy = self.strategy
+        client_update = self.client_update
+        stale_prob = self.stale_prob
+        mesh = self.mesh
+        cspec = P(CLIENTS_AXIS)
+        rspec = P()
+        pool_mode = self._pool is not None
+        shield = self.shield
+        defer_screen = shield is not None
+        chaos_faults = self.chaos_client_faults
+        chaos_corruption = self.chaos_corruption
+        corrupt_scale = self._corrupt_scale
+        corrupt_flip_scale = self._corrupt_flip_scale
+        device_carry = self.device_carry
+
+        def shard_body(params, strategy_state, arrays, sample_mask,
+                       client_mask, client_ids, client_lr, round_idx,
+                       leakage_threshold, quant_threshold, rng,
+                       corrupt_mode=None, pool=None):
+            if self.partition_mode == "shard_map":
+                def gather_axis(x):
+                    return jax.lax.all_gather(x, CLIENTS_AXIS, axis=0,
+                                              tiled=True)
+            else:
+                def gather_axis(x):
+                    return x
+
+            def gather_pool(arrays, sample_mask):
+                # device-resident mode: identical to the round program's
+                # in-program row gather (padding slots zeroed via mask)
+                idx = arrays["__idx__"]
+                m = sample_mask
+                return {
+                    k: pool[k][idx]
+                    * m.reshape(m.shape + (1,) * (pool[k].ndim - 1)
+                                ).astype(pool[k].dtype)
+                    for k in pool}
+
+            def per_client(arr_c, mask_c, cm_c, cid_c, corrupt_c=None):
+                # SAME per-client stream discipline as the fused round:
+                # fold_in on the CLIENT ID, so a client's rng (and hence
+                # its whole local update) is independent of which grid
+                # slot or bucket it landed in — the bit-identity anchor
+                rng_c = jax.random.fold_in(rng, cid_c)
+                carry_row = None
+                if device_carry:
+                    parts, tl, ns, stats, carry_row = \
+                        strategy.client_step_carry(
+                            client_update, params, arr_c, mask_c,
+                            client_lr, rng_c, client_id=cid_c,
+                            live_mask=cm_c, round_idx=round_idx,
+                            leakage_threshold=leakage_threshold,
+                            quant_threshold=quant_threshold,
+                            strategy_state=strategy_state)
+                else:
+                    parts, tl, ns, stats = strategy.client_step(
+                        client_update, params, arr_c, mask_c, client_lr,
+                        rng_c, round_idx=round_idx,
+                        leakage_threshold=leakage_threshold,
+                        quant_threshold=quant_threshold,
+                        strategy_state=strategy_state)
+                if chaos_corruption:
+                    pg0, w0 = parts["default"]
+                    mult = jnp.where(
+                        corrupt_c == CORRUPT_SCALE, corrupt_scale,
+                        jnp.where(corrupt_c == CORRUPT_SIGN_FLIP,
+                                  -corrupt_flip_scale, 1.0))
+                    bad = corrupt_c == CORRUPT_NAN
+                    pg0 = jax.tree.map(
+                        lambda g: (jnp.where(
+                            bad, jnp.asarray(jnp.nan, g.dtype),
+                            g * mult.astype(g.dtype))
+                            if jnp.issubdtype(g.dtype, jnp.floating)
+                            else g), pg0)
+                    parts = dict(parts)
+                    parts["default"] = (pg0, w0)
+                parts = {name: (tree, w * cm_c)
+                         for name, (tree, w) in parts.items()}
+                if stale_prob > 0.0:
+                    coin = jax.random.bernoulli(
+                        jax.random.fold_in(rng_c, 3), stale_prob)
+                    stale = coin.astype(jnp.float32) * cm_c
+                else:
+                    stale = jnp.zeros(())
+                return parts, tl * cm_c, ns * cm_c, stats, stale, carry_row
+
+            if pool is not None:
+                arrays = gather_pool(arrays, sample_mask)
+            vmap_args = (arrays, sample_mask, client_mask, client_ids) + \
+                ((corrupt_mode,) if chaos_corruption else ())
+            parts, tls, nss, stats, stale, carry_rows = \
+                jax.vmap(per_client)(*vmap_args)
+            privacy_per_client = {k: v for k, v in stats.items()
+                                  if k.startswith("privacy_")}
+            stats = {k: v for k, v in stats.items()
+                     if not k.startswith("privacy_")}
+
+            if defer_screen:
+                # shield mode: screening needs the FULL cohort's norms,
+                # which spans buckets — ship the per-client stack (the
+                # same K x model HBM cost the robust_stack path already
+                # pays) replicated to the finalize program; nothing
+                # crosses to the host
+                pc = {
+                    "stack": jax.tree.map(gather_axis,
+                                          parts["default"][0]),
+                    "w": gather_axis(parts["default"][1]),
+                    "tl": gather_axis(tls),
+                    "ns": gather_axis(nss),
+                    "stats": {k: gather_axis(v) for k, v in stats.items()},
+                    "cm": gather_axis(client_mask),
+                }
+                return pc, privacy_per_client
+
+            cm_k = client_mask
+            local = {"parts": {}}
+            for name, (trees, ws) in parts.items():
+                w_now = ws * (1.0 - stale)
+                w_def = ws * stale
+                wsum = lambda w, t: jax.tree.map(
+                    lambda g: jnp.tensordot(w, g, axes=[[0], [0]]), t)
+                if name in strategy.unit_weight_parts:
+                    gsum = jax.tree.map(
+                        lambda g: jnp.tensordot(
+                            cm_k.astype(g.dtype), g, axes=[[0], [0]]),
+                        trees)
+                    local["parts"][name] = {
+                        "grad_sum": gsum,
+                        "weight_sum": jnp.sum(w_now),
+                        "grad_sum_def": jax.tree.map(
+                            jnp.zeros_like, gsum),
+                        "weight_sum_def": jnp.sum(w_def),
+                        "weight_sum_raw": jnp.sum(ws),
+                    }
+                    continue
+                local["parts"][name] = {
+                    "grad_sum": wsum(w_now, trees),
+                    "weight_sum": jnp.sum(w_now),
+                    "grad_sum_def": wsum(w_def, trees),
+                    "weight_sum_def": jnp.sum(w_def),
+                    "weight_sum_raw": jnp.sum(ws),
+                }
+            local.update({
+                "train_loss_sum": jnp.sum(tls),
+                "num_samples_sum": jnp.sum(nss),
+                "client_count": jnp.sum(cm_k),
+                "stats_mean_sum": jnp.sum(stats["mean"] * cm_k),
+                "stats_mag_sum": jnp.sum(stats["mag"] * cm_k),
+                "stats_var_sum": jnp.sum(stats["var_corrected"] * cm_k),
+                "stats_norm_sum": jnp.sum(stats["norm"] * cm_k),
+            })
+            if self.partition_mode == "shard_map":
+                local = jax.lax.psum(local, CLIENTS_AXIS)
+            out = (local, privacy_per_client)
+            if device_carry:
+                out += (jax.tree.map(gather_axis, carry_rows),)
+            return out
+
+        def shard_entry(params, strategy_state, arrays, sample_mask,
+                        client_mask, client_ids, client_lr, round_idx,
+                        leakage_threshold, quant_threshold, rng, *rest):
+            rest = list(rest)
+            corrupt = rest.pop(0) if chaos_corruption else None
+            pool_arg = rest.pop(0) if pool_mode else None
+            return shard_body(params, strategy_state, arrays, sample_mask,
+                              client_mask, client_ids, client_lr,
+                              round_idx, leakage_threshold,
+                              quant_threshold, rng, corrupt_mode=corrupt,
+                              pool=pool_arg)
+
+        if self.partition_mode == "shard_map":
+            out_specs = ((rspec, cspec) if defer_screen else
+                         (rspec, cspec) +
+                         ((rspec,) if device_carry else ()))
+            sharded = shard_map(
+                shard_entry, mesh=mesh,
+                in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
+                          rspec, rspec, rspec, rspec) +
+                         ((cspec,) if chaos_corruption else ()) +
+                         ((rspec,) if pool_mode else ()),
+                out_specs=out_specs, check_vma=False)
+        else:
+            sharded = shard_entry
+
+        def collect_core(params, strategy_state, arrays, sample_mask,
+                         client_mask, client_ids, client_lr, round_idx,
+                         leakage_threshold, quant_threshold, rng,
+                         *extra_args):
+            # chaos fold: identical semantics to the fused round —
+            # dropout multiplies into client_mask, straggling truncates
+            # the step grid, corruption modes gate on the live mask;
+            # the per-bucket counters sum additively in finalize
+            chaos_stats = {}
+            n_used = 0
+            if chaos_faults:
+                chaos_drop, chaos_keep = extra_args[0], extra_args[1]
+                n_used = 2
+                step_live = (jnp.sum(sample_mask, axis=-1) > 0)
+                real_steps = jnp.sum(step_live, axis=-1)
+                keep_f = (jnp.arange(sample_mask.shape[-2])[None, :]
+                          < chaos_keep[:, None]).astype(jnp.float32)
+                live_cm = client_mask * (1.0 - chaos_drop)
+                chaos_stats = {
+                    "chaos_dropped": jnp.sum(client_mask * chaos_drop),
+                    "chaos_straggled": jnp.sum(
+                        live_cm * (chaos_keep < real_steps)),
+                    "chaos_steps_lost": jnp.sum(
+                        step_live.astype(jnp.float32) * (1.0 - keep_f)
+                        * live_cm[:, None]),
+                }
+                sample_mask = sample_mask * keep_f[..., None].astype(
+                    sample_mask.dtype)
+                client_mask = live_cm
+            corrupt_args = ()
+            if chaos_corruption:
+                corrupt_mode = extra_args[n_used]
+                n_used += 1
+                corrupt_mode = jnp.where(client_mask > 0, corrupt_mode, 0)
+                f32 = jnp.float32
+                chaos_stats.update({
+                    "chaos_nan_injected": jnp.sum(
+                        (corrupt_mode == CORRUPT_NAN).astype(f32)),
+                    "chaos_scaled": jnp.sum(
+                        (corrupt_mode == CORRUPT_SCALE).astype(f32)),
+                    "chaos_sign_flipped": jnp.sum(
+                        (corrupt_mode == CORRUPT_SIGN_FLIP).astype(f32)),
+                })
+                corrupt_args = (corrupt_mode,)
+            pool_args = extra_args[n_used:]
+            bcast = strategy.broadcast_params(params, strategy_state)
+            out = sharded(bcast, strategy_state, arrays, sample_mask,
+                          client_mask, client_ids, client_lr, round_idx,
+                          leakage_threshold, quant_threshold, rng,
+                          *corrupt_args, *pool_args)
+            if defer_screen:
+                result = {"pc": out[0], "privacy": out[1]}
+            else:
+                result = {"local": out[0], "privacy": out[1]}
+                if device_carry:
+                    result["carry"] = out[2]
+            result["chaos"] = chaos_stats
+            result["ids"] = client_ids
+            # trace-time hygiene: a strategy publish during a COLLECT
+            # trace would otherwise be drained by the finalize trace as
+            # a leaked tracer; bucket collects drop such publishes (the
+            # engine's own update_ratio publish lives in finalize)
+            self.devbus.drain()
+            return result
+
+        self._bucket_collect_core = collect_core
+        return collect_core
+
+    def _bucket_collect_fn(self, K: int, S: int, ax_packer: AxisPacker,
+                           stager: ScalarStager) -> Callable:
+        """The staged, jitted collect program for one (K_b, S_b) grid —
+        cached per geometry + packer signature.  Entry-point name keys
+        on S only: the S set is config-bounded, so a NEW compiled
+        variant under one name is exactly the K-quantization churn the
+        recompile sentinel should see."""
+        key = (K, S, ax_packer.signature, stager.signature)
+        fn = self._bucket_collect_cache.get(key)
+        if fn is not None:
+            return fn
+        core = self._get_bucket_collect_core()
+
+        def staged(params, strategy_state, ax_bufs, sc_bufs, rng,
+                   *pool_args):
+            ax = ax_packer.unpack(ax_bufs)
+            sc = stager.unpack(sc_bufs)
+            chaos = ax.get("chaos", ())
+            return core(params, strategy_state, ax["arrays"],
+                        ax["sample_mask"], ax["client_mask"],
+                        ax["client_ids"], sc["client_lr"],
+                        sc["round_idx"], sc["leakage"], sc["quant"],
+                        rng, *chaos, *pool_args)
+
+        fn = self._instrument(f"bucket_collect_s{S}", jax.jit(staged))
+        self._bucket_collect_cache[key] = fn
+        self.bucket_shapes_seen.add((K, S))
+        return fn
+
+    def _get_bucket_finalize(self) -> Callable:
+        """The jitted finalize program: per-bucket partials -> screened/
+        combined aggregate -> server step -> ONE packed stats buffer per
+        dtype group.  Shapes vary with the round's bucket signature; the
+        jit cache (and the sentinel, when on) tracks the variants."""
+        if self._bucket_finalize is not None:
+            return self._bucket_finalize
+        strategy = self.strategy
+        shield = self.shield
+        robust_stack = shield is not None and shield.wants_stack
+        device_carry = self.device_carry
+        stale_prob = self.stale_prob
+        server_tx = self.server_tx
+
+        def finalize(params, opt_state, strategy_state, outs, server_lr,
+                     rng):
+            bcast = strategy.broadcast_params(params, strategy_state)
+            shield_counts = None
+            if shield is None:
+                # deterministic on-device aggregation order: partial
+                # sums fold left-to-right in ascending-bucket order
+                total = outs[0]["local"]
+                for o in outs[1:]:
+                    total = jax.tree.map(jnp.add, total, o["local"])
+                part_sums = total["parts"]
+                deferred = None
+                if stale_prob > 0.0:
+                    default = part_sums["default"]
+                    deferred = {"grad_sum": default["grad_sum_def"],
+                                "weight_sum": default["weight_sum_def"]}
+                agg, new_strategy_state = strategy.combine_parts(
+                    part_sums, deferred, strategy_state,
+                    jax.random.fold_in(rng, 17),
+                    num_clients=total["client_count"],
+                    global_params=bcast)
+                collected = total
+            else:
+                # shield mode: assemble the cohort stack (ascending-
+                # bucket concatenation), screen against the WHOLE
+                # cohort's median norm, zero quarantined clients via
+                # jnp.where, then sum/combine — the fused round's
+                # screening semantics over the multi-grid cohort
+                def cat(*xs):
+                    return jnp.concatenate(xs, axis=0)
+                stack = jax.tree.map(cat, *[o["pc"]["stack"]
+                                            for o in outs])
+                w = cat(*[o["pc"]["w"] for o in outs])
+                tls = cat(*[o["pc"]["tl"] for o in outs])
+                nss = cat(*[o["pc"]["ns"] for o in outs])
+                cm = cat(*[o["pc"]["cm"] for o in outs])
+                stats = jax.tree.map(cat, *[o["pc"]["stats"]
+                                            for o in outs])
+                keep, q_nonfinite, q_norm = shield.screen(
+                    stack, tls, w, cm, lambda x: x)
+                keep_b = keep > 0
+                stack = jax.tree.map(
+                    lambda g: jnp.where(
+                        keep_b.reshape((-1,) + (1,) * (g.ndim - 1)),
+                        g, jnp.zeros_like(g)), stack)
+                w = jnp.where(keep_b, w, 0.0)
+                tls = jnp.where(keep_b, tls, 0.0)
+                nss = jnp.where(keep_b, nss, 0.0)
+                stats = {k: jnp.where(keep_b, v, 0.0)
+                         for k, v in stats.items()}
+                cm = cm * keep
+                gsum = jax.tree.map(
+                    lambda g: jnp.tensordot(w, g, axes=[[0], [0]]),
+                    stack)
+                part_sums = {"default": {
+                    "grad_sum": gsum,
+                    "weight_sum": jnp.sum(w),
+                    "grad_sum_def": jax.tree.map(jnp.zeros_like, gsum),
+                    "weight_sum_def": jnp.zeros(()),
+                    "weight_sum_raw": jnp.sum(w),
+                }}
+                collected = {
+                    "train_loss_sum": jnp.sum(tls),
+                    "num_samples_sum": jnp.sum(nss),
+                    "client_count": jnp.sum(cm),
+                    "stats_mean_sum": jnp.sum(stats["mean"] * cm),
+                    "stats_mag_sum": jnp.sum(stats["mag"] * cm),
+                    "stats_var_sum": jnp.sum(
+                        stats["var_corrected"] * cm),
+                    "stats_norm_sum": jnp.sum(stats["norm"] * cm),
+                }
+                if robust_stack:
+                    agg = strategy.combine_stack(
+                        stack, cm, jax.random.fold_in(rng, 17))
+                    new_strategy_state = strategy_state
+                else:
+                    agg, new_strategy_state = strategy.combine_parts(
+                        part_sums, None, strategy_state,
+                        jax.random.fold_in(rng, 17),
+                        num_clients=collected["client_count"],
+                        global_params=bcast)
+                shield_counts = (jnp.sum(q_nonfinite), jnp.sum(q_norm))
+            if device_carry:
+                # per-bucket scatters commute (a client id appears in
+                # exactly one bucket), so sequential application equals
+                # the monolithic single scatter
+                for b, o in enumerate(outs):
+                    new_strategy_state = strategy.apply_carry(
+                        new_strategy_state, o["ids"], o["carry"],
+                        rng=jax.random.fold_in(
+                            jax.random.fold_in(rng, 31), b))
+            if self.server_max_grad_norm is not None:
+                agg = _clip_by_global_norm(
+                    agg, float(self.server_max_grad_norm))
+            if strategy.owns_server_update:
+                new_params, new_strategy_state = \
+                    strategy.apply_server_update(params, agg,
+                                                 new_strategy_state,
+                                                 server_lr)
+                new_opt_state = opt_state
+            else:
+                opt_state.hyperparams["learning_rate"] = server_lr
+                updates, new_opt_state = server_tx.update(
+                    agg, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+            default_part = part_sums.get("default") or \
+                next(iter(part_sums.values()))
+            round_stats = {
+                "train_loss_sum": collected["train_loss_sum"],
+                "num_samples_sum": collected["num_samples_sum"],
+                "client_count": collected["client_count"],
+                "weight_sum": default_part["weight_sum"],
+                "weight_sum_raw": default_part["weight_sum_raw"],
+                "grad_mean": collected["stats_mean_sum"]
+                / jnp.maximum(collected["client_count"], 1.0),
+                "grad_mag": collected["stats_mag_sum"]
+                / jnp.maximum(collected["client_count"], 1.0),
+                "grad_var": collected["stats_var_sum"]
+                / jnp.maximum(collected["client_count"], 1.0),
+                "grad_norm": collected["stats_norm_sum"]
+                / jnp.maximum(collected["client_count"], 1.0),
+                "agg_grad_norm": optax.global_norm(agg),
+            }
+            chaos_tot = outs[0]["chaos"]
+            for o in outs[1:]:
+                chaos_tot = jax.tree.map(jnp.add, chaos_tot, o["chaos"])
+            round_stats.update(chaos_tot)
+            if shield_counts is not None:
+                round_stats["shield_nonfinite"] = shield_counts[0]
+                round_stats["shield_norm_outlier"] = shield_counts[1]
+            privacy = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0),
+                *[o["privacy"] for o in outs])
+            for k, v in privacy.items():
+                round_stats[k] = v
+            if self.devbus.enabled:
+                applied = jax.tree.map(lambda a, b: a - b,
+                                       new_params, params)
+                self.devbus.publish(
+                    "update_ratio",
+                    optax.global_norm(applied)
+                    / (optax.global_norm(new_params) + 1e-12))
+                round_stats.update(self.devbus.drain())
+            packer = FlatPacker(round_stats)
+            k_tot = sum(int(o["ids"].shape[0]) for o in outs)
+            # flint: disable=jit-purity trace-time slot-table recording is the flatpack contract (one write per compile, host-side reads only)
+            self._stats_packers[("bucketed", k_tot)] = packer
+            return (new_params, new_opt_state, new_strategy_state,
+                    packer.pack(round_stats))
+
+        # donate only the server state (params/opt/strategy) — the
+        # per-bucket partials (arg 3) mostly feed reductions XLA cannot
+        # alias in place, and an unusable donation warns per compile
+        self._bucket_finalize = self._instrument(
+            "bucket_finalize",
+            jax.jit(finalize, donate_argnums=(0, 1, 2)))
+        return self._bucket_finalize
+
+    def dispatch_bucketed_rounds(self, state: ServerState,
+                                 rounds_buckets: list,
+                                 client_lrs: list, server_lrs: list,
+                                 rng: jax.Array,
+                                 leakage_threshold: Optional[float] = None,
+                                 quant_thresholds: Optional[list] = None,
+                                 chaos_vecs: Optional[list] = None
+                                 ) -> Tuple[ServerState, BucketedStats]:
+        """Dispatch ``len(rounds_buckets)`` bucketed rounds WITHOUT
+        blocking.  ``rounds_buckets[r]`` is round r's list of per-bucket
+        :class:`~msrflute_tpu.data.batching.RoundBatch` grids (ascending
+        bucket order); ``chaos_vecs[r][b]`` the matching per-bucket
+        fault-vector entries.  Per round: one staged collect dispatch
+        per occupied bucket, then one finalize dispatch producing the
+        round's single packed-stats handle — everything device-side, so
+        the pipeline ring and strict-transfer contracts hold unchanged."""
+        R = len(rounds_buckets)
+        # same stream derivation as the monolithic dispatch (split is a
+        # pure function), so a bucketed round sees the exact round rng
+        # the monolithic program would have — per-client bit-identity
+        rngs = [rng] if R == 1 else list(jax.random.split(rng, R))
+        finalize = self._get_bucket_finalize()
+        per_round: list = []
+        cur = state
+        puts = staged_bytes = 0
+        lr_dt, rd_dt = np.float32, np.int32
+        for r, buckets in enumerate(rounds_buckets):
+            outs = []
+            round_flops = 0.0
+            round_hbm = 0
+            for b, batch in enumerate(buckets):
+                arrays_host, pool_args = self._host_arrays([batch])
+                axis_tree = {
+                    "arrays": arrays_host,
+                    "sample_mask": batch.sample_mask,
+                    "client_mask": batch.client_mask,
+                    "client_ids": batch.client_ids,
+                }
+                entry = (chaos_vecs[r][b] if chaos_vecs is not None
+                         else None)
+                chaos_host = self._chaos_host(
+                    [entry] if entry is not None else None,
+                    stacked=False)
+                if chaos_host:
+                    axis_tree["chaos"] = tuple(chaos_host)
+                sc_tree = {
+                    "client_lr": lr_dt(client_lrs[r]),
+                    "round_idx": rd_dt(cur.round),
+                    "leakage": lr_dt(leakage_threshold
+                                     if leakage_threshold is not None
+                                     else np.inf),
+                    "quant": lr_dt(quant_thresholds[r]
+                                   if quant_thresholds is not None
+                                   else -1.0),
+                }
+                ax_packer = AxisPacker(axis_tree, lead_ndim=1)
+                stager = ScalarStager(sc_tree)
+                K, S = (int(batch.sample_mask.shape[0]),
+                        int(batch.sample_mask.shape[1]))
+                fn = self._bucket_collect_fn(K, S, ax_packer, stager)
+                ax_bufs = ax_packer.pack_np(axis_tree)
+                sc_bufs = stager.pack_np(sc_tree)
+                # flint: disable=put-loop one staged put per dtype group per BUCKET PROGRAM (each loop iteration dispatches its own compiled grid; the leaves are already flatpacked)
+                ax_dev = jax.device_put(ax_bufs, self._client_sharding)
+                # flint: disable=put-loop same — the scalar group's single staged buffer for this bucket's dispatch
+                sc_dev = jax.device_put(sc_bufs, self._replicated)
+                puts += len(ax_bufs) + len(sc_bufs)
+                staged_bytes += int(
+                    sum(bf.nbytes for bf in ax_bufs.values()) +
+                    sum(bf.nbytes for bf in sc_bufs.values()))
+                out = fn(cur.params, cur.strategy_state, ax_dev, sc_dev,
+                         rngs[r], *pool_args)
+                self._note_compiles(f"bucket_collect_s{S}", fn)
+                if self.xla is not None and \
+                        self.xla.last_dispatch is not None:
+                    round_flops += float(
+                        self.xla.last_dispatch.get("flops") or 0.0)
+                    round_hbm = max(round_hbm, int(
+                        self.xla.last_dispatch.get("hbm_bytes") or 0))
+                outs.append(out)
+            params, opt_state, strategy_state, vecs = finalize(
+                cur.params, cur.opt_state, cur.strategy_state,
+                tuple(outs), jnp.asarray(server_lrs[r], jnp.float32),
+                rngs[r])
+            self._note_compiles("bucket_finalize", finalize)
+            if self.xla is not None and \
+                    self.xla.last_dispatch is not None:
+                round_flops += float(
+                    self.xla.last_dispatch.get("flops") or 0.0)
+                round_hbm = max(round_hbm, int(
+                    self.xla.last_dispatch.get("hbm_bytes") or 0))
+                # the live-MFU snapshot must describe the WHOLE bucketed
+                # round (collects + finalize), not just whichever
+                # program dispatched last
+                self.xla.last_dispatch = {
+                    "entry": "bucketed_round", "rounds": 1,
+                    "flops": round_flops or None,
+                    "bytes_accessed": None,
+                    "hbm_bytes": round_hbm or None,
+                }
+            cur = ServerState(params, opt_state, strategy_state,
+                              cur.round + 1)
+            k_tot = sum(int(batch.sample_mask.shape[0])
+                        for batch in buckets)
+            packer = self._stats_packers[("bucketed", k_tot)]
+            per_round.append(PackedStats(vecs, packer, rounds=1,
+                                         stacked=False))
+        from ..data.batching import ceil_div
+        self.last_dispatch_puts = ceil_div(puts, R)
+        self.last_staged_bytes = int(staged_bytes // R)
+        return cur, BucketedStats(per_round)
 
     def run_rounds(self, state: ServerState, batches: list,
                    client_lrs: list, server_lrs: list,

@@ -286,6 +286,86 @@ class OptimizationServer:
         self.length_bucketing = bool(
             cc.data_config.train.get("length_bucketing", True))
         self._length_bucket_stats = None
+        # cohort shape-bucketing (server_config.cohort_bucketing): stop
+        # padding every client to the slowest one.  The round's sampled
+        # clients partition into a small config-bounded set of
+        # power-of-two step buckets; each bucket packs its own compact
+        # [K_b, S_b, B, ...] grid and the engine dispatches one collect
+        # program per bucket + one on-device finalize per round
+        # (engine/round.py).  Boundaries derive from the POPULATION's
+        # step-need histogram once at init (greedy-merged to
+        # max_buckets), or come from an explicit `boundaries:` list —
+        # either way the S set is static, so compiled grid variants stay
+        # bounded and the PR 7 recompile sentinel guards closure.
+        self.cohort_bucketing = None
+        self._step_needs = None
+        _cb = sc.get("cohort_bucketing") or {}
+        if _cb and _cb.get("enable", True):
+            if host_orchestrated:
+                raise ValueError(
+                    "server_config.cohort_bucketing requires the fused "
+                    "round path — wantRL (host), strategy: scaffold / "
+                    "ef_quant (host rounds), and personalization's "
+                    "overridden sampling orchestrate rounds host-side "
+                    "and would silently run unbucketed; drop the block "
+                    "or lift the strategy with fused_carry")
+            from ..data.batching import bucket_boundaries
+            needs = np.array(
+                [steps_for(int(n), self.batch_size,
+                           self.desired_max_samples)
+                 for n in train_dataset.num_samples], dtype=np.int64)
+            max_need = int(needs.max()) if needs.size else 1
+            _mb = _cb.get("max_buckets")
+            max_buckets = 4 if _mb is None else int(_mb)
+            user_bounds = _cb.get("boundaries")
+            if user_bounds:
+                bounds = [int(b) for b in user_bounds]
+                if any(b < 1 for b in bounds) or \
+                        any(y <= x for x, y in zip(bounds, bounds[1:])):
+                    raise ValueError(
+                        "cohort_bucketing.boundaries must be strictly "
+                        f"increasing positive ints, got {bounds}")
+                # coverage: the TOP bucket must fit the biggest client's
+                # step need or its data would silently truncate; user
+                # boundaries above that only waste padded steps
+                covering = [b for b in bounds if b >= max_need]
+                top = min(covering[0] if covering else max_need,
+                          self.max_steps)
+                top = max(top, max_need)
+                bounds = [b for b in bounds if b < top] + [top]
+            else:
+                bounds = bucket_boundaries(needs.tolist(), max_buckets,
+                                           self.max_steps)
+            if len(bounds) > max_buckets:
+                raise ValueError(
+                    f"cohort_bucketing: {len(bounds)} boundaries exceed "
+                    f"max_buckets={max_buckets} — raise max_buckets or "
+                    "shorten the boundaries list")
+            # static per-bucket capacities: every bucket grid dispatches
+            # every round at its fixed K_b (occupied or not), so the
+            # compiled shape set is exactly one collect program per
+            # bucket + one finalize — closed by construction; overflow
+            # spills up, top-bucket overflow (rare) enlarges that grid
+            # and is exactly what the recompile sentinel exists to see
+            from ..data.batching import bucket_capacities
+            ncpi = sc.get("num_clients_per_iteration", 10)
+            if isinstance(ncpi, str) and ":" in ncpi:
+                cohort_hi = int(ncpi.split(":")[1])
+            else:
+                cohort_hi = int(ncpi)
+            cohort_hi = min(cohort_hi, len(train_dataset))
+            caps = bucket_capacities(
+                needs.tolist(), bounds, cohort_hi,
+                quantum=self.mesh.shape[CLIENTS_AXIS],
+                slack=float(_cb.get("slack", 1.5) or 1.5))
+            self.cohort_bucketing = {"boundaries": bounds,
+                                     "capacities": caps,
+                                     "max_buckets": max_buckets}
+            self._step_needs = needs
+            print_rank(
+                f"cohort bucketing on: step buckets {bounds} with "
+                f"client capacities {caps} (population max need "
+                f"{max_need}, monolithic S {self.max_steps})")
 
         # device-resident dataset (data_config.train.device_resident): the
         # whole sample pool lives in HBM; rounds ship [K,S,B] int32 indices
@@ -367,7 +447,15 @@ class OptimizationServer:
             # live MFU (device-truth layer: compiled FLOPs / round
             # wall-clock / chip peak) — populated only when
             # telemetry.xla captured the round program's cost
-            "mfuPerRound": []}
+            "mfuPerRound": [],
+            # real samples / padded grid slots per packed chunk — the
+            # cohort-bucketing win, measured on EVERY run (monolithic
+            # too, so the bench A/B and scope diff can compare)
+            "paddingEfficiency": []}
+        #: run-total padding-efficiency accumulators (slots-weighted —
+        #: see _record_padding_efficiency)
+        self._pad_real = 0.0
+        self._pad_slots = 0
         #: chunks whose host tail overlapped the next chunk's device
         #: execution (observability + the equivalence tests' proof that
         #: the pipelined run actually pipelined)
@@ -692,23 +780,35 @@ class OptimizationServer:
             # sample the whole chunk first so every round pads to a common
             # client count (ranged num_clients_per_iteration draws differ)
             chunk_samples = [self._sample() for _ in range(R)]
+            if self.cohort_bucketing is not None:
+                # nested layout: batches[r] is round r's list of
+                # per-bucket grids (ascending bucket order)
+                batches = [self._pack_bucketed_round(sampled)
+                           for sampled in chunk_samples]
+                flat = [b for row in batches for b in row]
+                self._maybe_length_bucket(flat)
+                self._record_padding_efficiency(flat)
+                return batches
             pad_to = pad_to_mesh(max(len(s) for s in chunk_samples),
                                  self.mesh)
             steps = self._chunk_steps(chunk_samples)
             if self._pool_offsets is not None:
                 from ..data.batching import pack_round_indices
-                return [pack_round_indices(
+                batches = [pack_round_indices(
                     self.train_dataset, self._pool_offsets, sampled,
                     self.batch_size, steps, rng=self._np_rng,
                     pad_clients_to=pad_to,
                     desired_max_samples=self.desired_max_samples)
                     for sampled in chunk_samples]
+                self._record_padding_efficiency(batches)
+                return batches
             batches = [pack_round_batches(
                 self.train_dataset, sampled, self.batch_size, steps,
                 rng=self._np_rng, pad_clients_to=pad_to,
                 desired_max_samples=self.desired_max_samples)
                 for sampled in chunk_samples]
             self._maybe_length_bucket(batches)
+            self._record_padding_efficiency(batches)
             return batches
 
         # prefetch: with fused chunks, the NEXT chunk's host-side sampling
@@ -835,6 +935,27 @@ class OptimizationServer:
                 # engine compiled in.
                 chaos_vecs = []
                 for j in range(R):
+                    if self.cohort_bucketing is not None:
+                        # nested per-bucket entries: each bucket grid
+                        # draws its own salted sub-stream, so the
+                        # schedule stays a pure function of (seed,
+                        # round, bucket, slot) — serial == pipelined ==
+                        # resumed, whatever the bucket layout
+                        per_bucket = []
+                        for bi, batch in enumerate(batches[j]):
+                            entry = ()
+                            if self.engine.chaos_client_faults:
+                                entry += self.chaos.client_faults(
+                                    round_no + j, batch.sample_mask,
+                                    salt=bi + 1)
+                            if self.engine.chaos_corruption:
+                                entry += (self.chaos.corrupt_modes(
+                                    round_no + j,
+                                    batch.sample_mask.shape[0],
+                                    salt=bi + 1),)
+                            per_bucket.append(entry)
+                        chaos_vecs.append(per_bucket)
+                        continue
                     entry = ()
                     if self.engine.chaos_client_faults:
                         entry += self.chaos.client_faults(
@@ -852,12 +973,21 @@ class OptimizationServer:
                                             round0=round_no, rounds=R)
                            if self.scope is not None else None)
             with self._tspan("dispatch", round0=round_no, rounds=R):
-                self.state, packed = self.engine.dispatch_rounds(
-                    self.state, batches, [client_lr] * R, server_lrs,
-                    chunk_rng,
-                    leakage_threshold=self.max_allowed_leakage,
-                    quant_thresholds=quant_thresholds,
-                    chaos_vecs=chaos_vecs)
+                if self.cohort_bucketing is not None:
+                    self.state, packed = \
+                        self.engine.dispatch_bucketed_rounds(
+                            self.state, batches, [client_lr] * R,
+                            server_lrs, chunk_rng,
+                            leakage_threshold=self.max_allowed_leakage,
+                            quant_thresholds=quant_thresholds,
+                            chaos_vecs=chaos_vecs)
+                else:
+                    self.state, packed = self.engine.dispatch_rounds(
+                        self.state, batches, [client_lr] * R, server_lrs,
+                        chunk_rng,
+                        leakage_threshold=self.max_allowed_leakage,
+                        quant_thresholds=quant_thresholds,
+                        chaos_vecs=chaos_vecs)
             chunk = {
                 "span": device_span,
                 "round0": round_no, "R": R, "state": self.state,
@@ -1125,7 +1255,7 @@ class OptimizationServer:
                                nonfinite=nonfinite, norm_outlier=outlier)
         self._process_privacy_stats(
             stats, round0,
-            client_mask=np.stack([b.client_mask for b in chunk["batches"]]))
+            client_mask=self._chunk_client_masks(chunk["batches"]))
         if chunk["dp_clip"] is not None:
             # adaptive DP clipping observability (arXiv:1905.03871); the
             # post-chunk value is the clip the NEXT round applies, so it
@@ -1202,6 +1332,12 @@ class OptimizationServer:
             "host_tail_secs_p50": p50(rs["secsPerRoundHostTail"]),
             "staged_bytes_per_round_p50": p50(
                 rs["hostToDeviceBytesPerRound"]),
+            # run-total real samples / padded grid slots (slots- i.e.
+            # FLOPs-weighted, NOT a per-chunk mean — cheap chunks must
+            # not mask waste on expensive ones)
+            "padding_efficiency": (
+                round(self.padding_efficiency, 6)
+                if self.padding_efficiency is not None else None),
             "mfu_p50": p50(rs["mfuPerRound"]),
             "puts_per_dispatch": int(self.engine.last_dispatch_puts),
             "compiles": len(self.engine.compile_log),
@@ -1213,6 +1349,16 @@ class OptimizationServer:
                 kind = str(finding.get("kind", "?"))
                 fires[kind] = fires.get(kind, 0) + 1
         card["watchdog_fires"] = fires
+        if self.cohort_bucketing is not None:
+            card["cohort_bucketing"] = {
+                "boundaries": list(self.cohort_bucketing["boundaries"]),
+                "max_buckets": int(self.cohort_bucketing["max_buckets"]),
+                # compiled-grid closure: distinct (K_b, S_b) collect
+                # shapes this run compiled (gated <= max_buckets in the
+                # bench A/B; churn past warmup trips the sentinel)
+                "bucket_grid_variants":
+                    len(self.engine.bucket_shapes_seen),
+            }
         reg = self.engine.xla
         if reg is not None:
             card["entry_points"] = reg.summary()
@@ -1244,12 +1390,15 @@ class OptimizationServer:
         ``communicationCosts`` timing (``core/server.py:317,353``);
         reported by ``_log_timing``.  Called from the fused path AND the
         host-orchestrated (RL/SCAFFOLD) rounds, which also ship a packed
-        batch."""
+        batch.  Bucketed chunks pass the nested per-round bucket lists;
+        the bytes sum over every grid either way."""
+        flat = [b for entry in batches
+                for b in (entry if isinstance(entry, list) else [entry])]
         chunk_bytes = sum(
             sum(a.nbytes for a in
                 (getattr(b, "arrays", None) or
                  {"__idx__": b.indices}).values())
-            + b.sample_mask.nbytes for b in batches)
+            + b.sample_mask.nbytes for b in flat)
         self.run_stats["hostToDeviceBytesPerRound"].append(
             chunk_bytes / max(rounds, 1))
 
@@ -1270,6 +1419,76 @@ class OptimizationServer:
                 f"pad-eff {stats['tokens_real'] / max(stats['tokens_grid_after'], 1):.3f}"
                 f" (was {stats['tokens_real'] / max(stats['tokens_grid_before'], 1):.3f})",
                 loglevel=logging.DEBUG)
+
+    # ------------------------------------------------------------------
+    def _pack_bucketed_round(self, sampled: list) -> list:
+        """One round's cohort as per-bucket compact grids
+        (``server_config.cohort_bucketing``): deterministic assignment
+        of each sampled client to the smallest step bucket covering its
+        need, one ``[K_b, S_b, B, ...]`` grid per occupied bucket with
+        ``K_b`` pow2-quantized (then mesh-padded) so the compiled grid
+        variant set stays small and closed."""
+        from ..data.batching import assign_step_buckets
+        needs = [int(self._step_needs[i]) for i in sampled]
+        caps = self.cohort_bucketing["capacities"]
+        bounds = self.cohort_bucketing["boundaries"]
+        assignment = assign_step_buckets(needs, bounds, capacities=caps)
+        # pre-draw every sampled client's shuffle permutation in COHORT
+        # order — the exact rng calls the monolithic pack would make —
+        # so bucketing changes only grid SHAPES, never which samples a
+        # client trains on or any later round's sampling stream
+        orders = {int(ci): self._np_rng.permutation(
+                      int(self.train_dataset.num_samples[ci]))
+                  for ci in sampled}
+        out = []
+        for (s_b, positions), cap in zip(assignment.items(), caps):
+            ids = [sampled[p] for p in positions]
+            cap = int(cap)
+            # TOP-bucket overflow (sampling variance beyond the slack)
+            # splits into EXTRA GRIDS OF THE SAME COMPILED SHAPE — the
+            # collect-variant set stays exactly one program per bucket,
+            # deterministically; only the finalize (one more partial in
+            # its signature) retraces, once per new grid count
+            groups = ([ids] if len(ids) <= cap else
+                      [ids[i:i + cap] for i in range(0, len(ids), cap)])
+            for g in groups:
+                if self._pool_offsets is not None:
+                    from ..data.batching import pack_round_indices
+                    out.append(pack_round_indices(
+                        self.train_dataset, self._pool_offsets, g,
+                        self.batch_size, s_b, rng=self._np_rng,
+                        pad_clients_to=cap, orders=orders,
+                        desired_max_samples=self.desired_max_samples))
+                else:
+                    out.append(pack_round_batches(
+                        self.train_dataset, g, self.batch_size, s_b,
+                        rng=self._np_rng, pad_clients_to=cap,
+                        orders=orders,
+                        desired_max_samples=self.desired_max_samples))
+        return out
+
+    def _record_padding_efficiency(self, batches_flat: list) -> None:
+        """Real samples / padded grid slots of one packed chunk — the
+        meter the cohort-bucketing win is gated on (scorecard +
+        ``tools/scope diff`` + bench A/B).  The per-chunk ratio joins
+        ``run_stats`` for observability; the GATED number is the
+        run-total ratio (:attr:`padding_efficiency`) — slots-weighted,
+        i.e. FLOPs-weighted, so cheap small-cohort chunks cannot mask
+        waste on the expensive ones."""
+        from ..data.batching import grid_slots, padding_efficiency
+        self.run_stats["paddingEfficiency"].append(
+            padding_efficiency(batches_flat))
+        self._pad_slots += grid_slots(batches_flat)
+        self._pad_real += float(sum(np.sum(b.num_samples)
+                                    for b in batches_flat))
+
+    @property
+    def padding_efficiency(self) -> Optional[float]:
+        """Run-total real samples / padded grid slots (1.0 = zero
+        padding waste); None before any chunk packed."""
+        if not self._pad_slots:
+            return None
+        return self._pad_real / self._pad_slots
 
     # ------------------------------------------------------------------
     def _chunk_steps(self, chunk_samples: list) -> int:
@@ -1500,6 +1719,7 @@ class OptimizationServer:
             desired_max_samples=self.desired_max_samples)
         self._maybe_length_bucket([batch])
         self._record_staged_bytes([batch], 1)
+        self._record_padding_efficiency([batch])
         rng = self._next_rng()
         return client_lr, server_lr, batch, rng
 
@@ -1714,6 +1934,27 @@ class OptimizationServer:
         log_metric("RL Running Loss", self.rl.running_loss, step=round_no)
 
     # ------------------------------------------------------------------
+    def _chunk_client_masks(self, batches) -> np.ndarray:
+        """``[R, K]`` live-client mask of one chunk for the privacy-stat
+        distribution.  Bucketed chunks concatenate each round's bucket
+        masks in ascending-bucket order — the SAME layout the finalize
+        program concatenates its per-client vectors in — then zero-pad
+        rounds to the chunk max exactly like
+        :meth:`~msrflute_tpu.engine.round.BucketedStats.fetch`."""
+        rows = []
+        for entry in batches:
+            if isinstance(entry, list):
+                rows.append(np.concatenate(
+                    [b.client_mask for b in entry]))
+            else:
+                rows.append(np.asarray(entry.client_mask))
+        width = max(r.shape[0] for r in rows)
+        return np.stack([
+            r if r.shape[0] == width
+            else np.concatenate([r, np.zeros(width - r.shape[0],
+                                             r.dtype)])
+            for r in rows])
+
     def _process_privacy_stats(self, stats, round_no: int,
                                client_mask=None) -> None:
         """Log attack metrics + adapt the leakage threshold (reference

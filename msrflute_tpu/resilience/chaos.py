@@ -134,12 +134,26 @@ class ChaosSchedule:
                 self.corrupt_scale_rate > 0.0 or
                 self.corrupt_sign_flip_rate > 0.0)
 
-    def _round_rng(self, round_no: int) -> np.random.Generator:
+    @staticmethod
+    def _entropy(seed: int, stream: int, round_no: int,
+                 salt: int) -> list:
+        """SeedSequence entropy for one (round, salt) draw.  ``salt == 0``
+        keeps the historical 3-word key, so existing seeds reproduce their
+        exact schedules; non-zero salts (cohort-bucketing's per-bucket
+        grids) get their own independent stream per bucket."""
+        key = [seed, stream, int(round_no)]
+        if salt:
+            key.append(int(salt))
+        return key
+
+    def _round_rng(self, round_no: int,
+                   salt: int = 0) -> np.random.Generator:
         return np.random.default_rng(np.random.SeedSequence(
-            [self.seed, _CLIENT_STREAM, int(round_no)]))
+            self._entropy(self.seed, _CLIENT_STREAM, round_no, salt)))
 
     def client_faults(self, round_no: int,
-                      sample_mask: np.ndarray
+                      sample_mask: np.ndarray,
+                      salt: int = 0
                       ) -> Tuple[np.ndarray, np.ndarray]:
         """Per-round fault vectors for one packed round batch.
 
@@ -150,9 +164,10 @@ class ChaosSchedule:
         (``ceil(real_steps / straggler_inflation)``, min 1) and
         :data:`NO_BOUND` for everyone else.  Decisions are keyed on
         (seed, round, client SLOT), so the schedule is identical however
-        the host loop is arranged (serial, pipelined, resumed)."""
+        the host loop is arranged (serial, pipelined, resumed).
+        ``salt`` keys an independent sub-stream per bucketed grid."""
         k = int(sample_mask.shape[0])
-        rng = self._round_rng(round_no)
+        rng = self._round_rng(round_no, salt)
         # one per-round stream, fixed draw order (drop then straggle):
         # the determinism guarantee is per (seed, chaos config)
         drop = (rng.random(k) < self.dropout_rate).astype(np.float32)
@@ -165,7 +180,8 @@ class ChaosSchedule:
         return drop, keep
 
     # ------------------------------------------------------------------
-    def corrupt_modes(self, round_no: int, k: int) -> np.ndarray:
+    def corrupt_modes(self, round_no: int, k: int,
+                      salt: int = 0) -> np.ndarray:
         """Per-round adversarial corruption assignment for one packed
         round batch: ``[K] int32`` of :data:`CORRUPT_NONE` /
         :data:`CORRUPT_NAN` / :data:`CORRUPT_SCALE` /
@@ -180,9 +196,11 @@ class ChaosSchedule:
         most one corruption per round.  Padding/dropped slots draw too
         (slot-keyed determinism) — the round program gates corruption on
         the live ``client_mask`` so their draws are inert.
+        ``salt`` keys an independent sub-stream per bucketed grid
+        (``salt == 0`` reproduces the historical key).
         """
         rng = np.random.default_rng(np.random.SeedSequence(
-            [self.seed, _CORRUPT_STREAM, int(round_no)]))
+            self._entropy(self.seed, _CORRUPT_STREAM, round_no, salt)))
         u = rng.random(int(k))
         mode = np.full(int(k), CORRUPT_NONE, np.int32)
         hi = self.corrupt_nan_rate + self.corrupt_scale_rate + \

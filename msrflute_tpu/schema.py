@@ -132,6 +132,25 @@ ROBUST_KEYS = {
     "trim_fraction",
 }
 
+COHORT_BUCKETING_KEYS = {
+    "enable", "max_buckets", "boundaries", "slack",
+}
+
+COHORT_BUCKETING_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    # distinct compiled bucket grids the run may hold (1 == monolithic
+    # shape discipline); the recompile sentinel + bench A/B gate closure
+    "max_buckets": ("int", 1, None),
+    # per-bucket capacity headroom over the expected cohort mix: lower
+    # = tighter grids (better padding efficiency) but more spill-up and
+    # occasional extra top-bucket grids; < 1 would under-provision the
+    # EXPECTED occupancy and spill every round
+    "slack": ("num", 1.0, None),
+    # `boundaries` (explicit step-bucket S values) keeps a bespoke check
+    # in validate(): a strictly-increasing positive-int LIST is a shape
+    # the scalar spec table cannot express
+}
+
 #: robust aggregator vocabulary (mirrors robust.shield.AGGREGATORS)
 ALLOWED_ROBUST_AGGREGATORS = ["mean", "trimmed_mean", "median"]
 
@@ -289,6 +308,13 @@ SERVER_KEYS = {
     # median) — default off; disabled is bit-identical to pre-fluteshield
     # behavior (docs/config_extensions.md)
     "robust",
+    # cohort shape-bucketing: partition each round's cohort into a
+    # config-bounded set of power-of-two step buckets and dispatch one
+    # compact [K_b, S_b, B] grid per bucket + an on-device finalize,
+    # instead of padding every client to the slowest one — default off;
+    # per-client updates stay bit-identical to the monolithic grid
+    # (docs/config_extensions.md, RUNBOOK "Tuning cohort buckets")
+    "cohort_bucketing",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
@@ -666,6 +692,46 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                     f"{strategy!r} — screened aggregation plugs into the "
                     "fedavg/fedprox combine only; payloads would "
                     "aggregate UNSCREENED")
+        cb = sc.get("cohort_bucketing")
+        if cb is not None and not isinstance(cb, dict):
+            errors.append(
+                "server_config.cohort_bucketing: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(cb).__name__}")
+        if isinstance(cb, dict):
+            _check_unknown(unknown, cb, "server_config.cohort_bucketing",
+                           COHORT_BUCKETING_KEYS)
+            _check_fields(errors, cb, "server_config.cohort_bucketing",
+                          COHORT_BUCKETING_FIELD_SPECS)
+            bounds = cb.get("boundaries")
+            if bounds is not None:
+                # bespoke: a strictly-increasing positive-int list — a
+                # non-increasing list would assign clients to a bucket
+                # too small for their data (silent truncation), which
+                # the server also refuses; validation must not bless it
+                if not isinstance(bounds, (list, tuple)) or not bounds:
+                    errors.append(
+                        "server_config.cohort_bucketing.boundaries: "
+                        "must be a non-empty list of step counts")
+                elif any(isinstance(b, bool) or not isinstance(b, int)
+                         or b < 1 for b in bounds):
+                    errors.append(
+                        "server_config.cohort_bucketing.boundaries: "
+                        "every boundary must be a positive integer, "
+                        f"got {list(bounds)!r}")
+                elif any(y <= x for x, y in zip(bounds, bounds[1:])):
+                    errors.append(
+                        "server_config.cohort_bucketing.boundaries: "
+                        f"must be strictly increasing, got "
+                        f"{list(bounds)!r}")
+                mb = cb.get("max_buckets")
+                if isinstance(mb, int) and not isinstance(mb, bool) and \
+                        isinstance(bounds, (list, tuple)) and \
+                        len(bounds) > mb:
+                    errors.append(
+                        "server_config.cohort_bucketing: "
+                        f"{len(bounds)} boundaries exceed "
+                        f"max_buckets={mb}")
         ckpt_retry = sc.get("checkpoint_retry")
         if isinstance(ckpt_retry, dict):
             _check_unknown(unknown, ckpt_retry,

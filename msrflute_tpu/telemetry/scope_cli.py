@@ -276,6 +276,10 @@ DIFF_RULES: Dict[str, Tuple[str, float]] = {
     "staged_bytes_per_round_p50": ("higher_frac", 0.10),
     "hbm_peak_bytes": ("higher_frac", 0.10),
     "mfu_p50": ("lower_frac", 0.15),
+    # real samples / padded grid slots (cohort shape-bucketing's win):
+    # a DROP means the grids grew back toward the monolithic worst case
+    # — e.g. a bucket-boundary change silently re-padding small clients
+    "padding_efficiency": ("lower_frac", 0.10),
     "overlap_efficiency_pct": ("lower_abs", 10.0),
     "recompiles": ("higher_abs", 0.0),
     "puts_per_dispatch": ("higher_abs", 0.0),
@@ -283,7 +287,8 @@ DIFF_RULES: Dict[str, Tuple[str, float]] = {
 
 #: metrics whose thresholds scale with --pct (the wall-clock-ish ones)
 _PCT_SCALED = {"round_secs_p50", "host_tail_secs_p50",
-               "staged_bytes_per_round_p50", "hbm_peak_bytes", "mfu_p50"}
+               "staged_bytes_per_round_p50", "hbm_peak_bytes", "mfu_p50",
+               "padding_efficiency"}
 
 
 def load_scorecard(path: str) -> Dict[str, Any]:
@@ -387,7 +392,8 @@ def _bench_entry(path: str) -> Dict[str, Any]:
     for name, block in (data.get("extras") or {}).items():
         if isinstance(block, dict) and "secs_per_round" in block:
             row = {"secs_per_round": block.get("secs_per_round")}
-            for key in ("mfu_vs_bf16_peak", "device_truth"):
+            for key in ("mfu_vs_bf16_peak", "device_truth",
+                        "padding_efficiency"):
                 if key in block:
                     row[key] = block[key]
             protocols[name] = row
@@ -427,6 +433,20 @@ def trend_bench(paths: List[str],
                     "metric": f"{name}.secs_per_round", "a": sa, "b": sb,
                     "a_file": prev["file"], "b_file": last["file"],
                     "limit": round(sa * (1.0 + thresh), 6),
+                    "threshold": thresh})
+            # padding efficiency is gated in the OTHER direction: a drop
+            # means the round grids grew back toward the monolithic
+            # pad-to-slowest worst case (cohort-bucketing regression)
+            pa = prev["protocols"][name].get("padding_efficiency")
+            pb = last["protocols"][name].get("padding_efficiency")
+            if isinstance(pa, (int, float)) and \
+                    isinstance(pb, (int, float)) and pa > 0 and \
+                    pb < pa * (1.0 - thresh):
+                regressions.append({
+                    "metric": f"{name}.padding_efficiency",
+                    "a": pa, "b": pb,
+                    "a_file": prev["file"], "b_file": last["file"],
+                    "limit": round(pa * (1.0 - thresh), 6),
                     "threshold": thresh})
     return {"series": series, "regressions": regressions,
             "ok": not regressions}

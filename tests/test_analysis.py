@@ -721,6 +721,51 @@ def test_schema_drift_flags_undocumented_robust_knob(tmp_path):
     assert "robust" in found[0].message
 
 
+def test_schema_drift_covers_cohort_bucketing_specs(tmp_path):
+    """PR 8 corpus: the cohort_bucketing block's field specs are
+    drift-checked like the chaos/telemetry/robust sections — a
+    COHORT_BUCKETING_FIELD_SPECS rule for a key the unknown-key pass
+    doesn't know is dead and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'cohort_bucketing'}\n"
+        "COHORT_BUCKETING_KEYS = {'enable', 'max_buckets'}\n"
+        "COHORT_BUCKETING_FIELD_SPECS = "
+        "{'max_buckets': ('int', 1, None),"
+        " 'phantom_buckets': ('int', 1, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.cohort_bucketing` buckets the cohort.")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("cohort_bucketing",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "phantom_buckets" in found[0].message
+    assert "COHORT_BUCKETING_KEYS" in found[0].message
+
+
+def test_schema_drift_flags_undocumented_cohort_bucketing_knob(tmp_path):
+    """An operator who cannot find the bucket-tuning drill in the
+    runbook keeps paying masked FLOPs padding every client to the
+    slowest one."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'cohort_bucketing'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text("no bucketing documented here")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("cohort_bucketing",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "cohort_bucketing" in found[0].message
+
+
 # ======================================================================
 # PR 6 corpus: put-loop (single-buffer input staging discipline)
 # ======================================================================
