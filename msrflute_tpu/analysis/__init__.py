@@ -1,16 +1,23 @@
 """fluteguard — TPU-safety static analysis for msrflute_tpu.
 
-Six checkers, one CLI::
+Eleven checkers on one interprocedural engine, one CLI::
 
     python -m msrflute_tpu.analysis msrflute_tpu/     # or: tools/flint
 
+Since flint v2 the checkers share a project-wide call graph with
+per-function def-use summaries (``core.py``: :class:`~.core.Project`,
+mtime-keyed summary caching), so rules reason ACROSS modules — a traced
+body's helper in another file, a round path's fetch three calls deep.
+
 - **host-sync**        implicit device->host syncs in hot-path modules
   (``engine/``, ``ops/``, ``strategies/``); the flatpack packed-stats
-  fetch is the single sanctioned per-round transfer.
+  fetch is the single sanctioned per-round transfer.  Taint seeding
+  follows jitted bindings across modules.
 - **donation-aliasing** reads of a buffer after ``donate_argnums``
   handed it to a dispatch.
 - **jit-purity**       side effects / host-state reads inside traced
-  function bodies.
+  function bodies (project-wide reachability: a helper imported into a
+  traced body is checked in its own module).
 - **pallas-shape**     TPU tile alignment of kernel block shapes and
   tracer-dependent Python loop bounds.
 - **put-loop**         per-leaf ``jax.device_put`` loops in hot-path
@@ -18,21 +25,37 @@ Six checkers, one CLI::
   per dtype group (``server_config.input_staging``).
 - **schema-drift**     ``schema.py`` vs ``config.py`` vs docs
   cross-consistency.
+- **shard-ready**      cohort-axis host logic that would break under a
+  mesh-sharded client axis (ROADMAP item 1 de-risking): host
+  iteration/indexing over the leading client dim of device values,
+  ``.shape[0]``-conditioned branches inside traced bodies.
+- **recompile-hazard** the static counterpart of the PR 7 runtime
+  recompile sentinel: data-derived values in static-arg positions,
+  traced closures over mutable self-state, data-dependent operand
+  shapes at jitted call sites.
+- **transfer-budget**  the one-fetch-per-round invariant, proven on the
+  call graph: explicit ``device_get`` sites reachable from each round
+  root, flagged when a round-path function splits its fetch or fetches
+  in a loop.
+- **guard-matrix**     the host_orchestrated/robust/bucketing/secagg/
+  fused-carry refusal matrix cross-checked against ``schema.py``
+  bespoke checks and ``docs/config_extensions.md``.
+- **event-schema**     telemetry event names and devbus publishers
+  emitted by the code vs ``docs/observability.md``'s catalogue.
 
 Static findings pair with a runtime strict mode: under
 ``MSRFLUTE_STRICT_TRANSFERS=1`` the server round loop runs inside a
 ``jax.transfer_guard_device_to_host("disallow")`` scope
-(``utils/strict.py``), so any implicit sync the linter's same-module
-view cannot see raises at the offending line in e2e tests.
+(``utils/strict.py``), so any implicit sync the linter's static view
+cannot see raises at the offending line in e2e tests.
 
-Suppression: ``# flint: disable=RULE reason`` (linted for staleness).
-Baseline: ``analysis/baseline.json`` (shipped empty; the tier-1 gate
-``tests/test_flint_clean.py`` fails on any non-baselined finding).
+Suppression: ``# flint: disable=RULE reason`` (linted for staleness;
+unknown rule names are errors, with rename hints from
+``core.RULE_RENAMES``).  Baseline: ``analysis/baseline.json`` (shipped
+empty; the tier-1 gate ``tests/test_flint_clean.py`` fails on any
+non-baselined finding).
 """
 
-from .core import (Finding, analyze, default_baseline_path,  # noqa: F401
-                   filter_baseline, load_baseline, write_baseline)
-
-RULES = ("host-sync", "donation-aliasing", "jit-purity", "pallas-shape",
-         "put-loop", "schema-drift", "stale-suppression",
-         "bare-suppression", "parse-error")
+from .core import (RULE_RENAMES, RULES, Finding, analyze,  # noqa: F401
+                   default_baseline_path, filter_baseline, load_baseline,
+                   write_baseline)

@@ -1,6 +1,20 @@
 """fluteguard CLI: ``python -m msrflute_tpu.analysis [paths]``.
 
 Exit codes: 0 clean (after baseline), 1 findings, 2 usage error.
+
+Incremental mode (``--changed``) analyzes only the files git reports as
+modified (staged, unstaged and untracked vs HEAD, or vs ``--changed
+BASE``) while the interprocedural call graph still spans the whole
+package — unchanged files contribute their summaries from the on-disk
+cache (``.flint_cache.json``, mtime-keyed) without being re-parsed.
+Project-level checkers (schema-drift, guard-matrix, event-schema,
+transfer-budget) run only when one of their inputs changed (any doc,
+schema/config, or a hot-path module).
+
+Machine output: ``--format json`` (one object per finding with a
+stable ``id``) or ``--format sarif`` (SARIF 2.1.0 for editor/CI
+ingestion; the finding id rides ``partialFingerprints``).  IDs hash the
+line-free baseline key, so they survive unrelated edits.
 """
 
 from __future__ import annotations
@@ -8,11 +22,91 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+from typing import List, Optional
 
 from . import RULES
-from .core import (analyze, default_baseline_path, filter_baseline,
-                   load_baseline, write_baseline)
+from .core import (Finding, analyze, default_baseline_path,
+                   default_cache_path, filter_baseline, load_baseline,
+                   load_summary_cache, save_summary_cache,
+                   write_baseline)
+
+#: file classes whose change triggers the project-level checkers in
+#: --changed mode (their inputs: docs, schema/config, hot-path modules)
+_PROJECT_TRIGGER_PARTS = ("docs/", "README.md", "schema.py", "config.py",
+                          "engine/", "strategies/", "ops/", "telemetry/",
+                          "robust/", "resilience/", "analysis/")
+
+
+def _git_changed_files(root: str, base: Optional[str]
+                       ) -> "tuple[str, List[str]]":
+    """``(toplevel, changed)``: the repo toplevel plus changed +
+    untracked files vs HEAD (or the MERGE BASE with ``base``), as
+    ABSOLUTE paths.  git prints paths relative to the repo TOPLEVEL
+    (not the cwd/--root), so they are resolved against ``rev-parse
+    --show-toplevel`` — running from a subdirectory must not silently
+    lint nothing.  An explicit base compares against ``git merge-base
+    base HEAD`` (the documented 'what did THIS branch change'
+    semantics), not base's tip — otherwise commits that landed on base
+    after the branch point would all read as changed here."""
+    def run(*cmd: str) -> str:
+        proc = subprocess.run(["git", "-C", root, *cmd],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr.strip() or
+                               f"git {' '.join(cmd)} failed")
+        return proc.stdout
+    toplevel = run("rev-parse", "--show-toplevel").strip()
+    diff_base = "HEAD" if base is None \
+        else run("merge-base", base, "HEAD").strip()
+    out: List[str] = []
+    for text in (run("diff", "--name-only", diff_base),
+                 run("ls-files", "--others", "--exclude-standard",
+                     "--full-name")):
+        out.extend(os.path.join(toplevel, line.strip())
+                   for line in text.splitlines() if line.strip())
+    return toplevel, sorted(set(out))
+
+
+def _to_json(findings: List[Finding]) -> str:
+    return json.dumps(
+        [{"id": f.id, "rule": f.rule, "path": f.path, "line": f.line,
+          "message": f.message, "hint": f.hint} for f in findings],
+        indent=2)
+
+
+def _to_sarif(findings: List[Finding]) -> str:
+    rules = sorted({f.rule for f in findings} | set(RULES))
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message +
+                        (f"\nhint: {f.hint}" if f.hint else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                }}],
+            "partialFingerprints": {"flintFindingId/v1": f.id},
+        })
+    sarif = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fluteguard",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(sarif, indent=2)
 
 
 def main(argv=None) -> int:
@@ -20,7 +114,9 @@ def main(argv=None) -> int:
         prog="flint",
         description="fluteguard — TPU-safety static analysis "
                     "(host-sync, donation-aliasing, jit-purity, "
-                    "pallas-shape, schema-drift)")
+                    "pallas-shape, put-loop, schema-drift, shard-ready, "
+                    "recompile-hazard, transfer-budget, guard-matrix, "
+                    "event-schema)")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/dirs to analyze (default: the "
                              "msrflute_tpu package)")
@@ -37,14 +133,28 @@ def main(argv=None) -> int:
                              "file and exit 0")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule subset to run")
+    parser.add_argument("--changed", nargs="?", const="HEAD",
+                        default=None, metavar="BASE",
+                        help="incremental mode: analyze only files git "
+                             "reports changed vs BASE (default HEAD) + "
+                             "untracked, sharing cached summaries for "
+                             "the rest of the package")
+    parser.add_argument("--cache", default=None,
+                        help="summary-cache path (default: "
+                             "<root>/.flint_cache.json; used by "
+                             "--changed)")
+    parser.add_argument("--format", default=None, dest="fmt",
+                        choices=("text", "json", "sarif"),
+                        help="output format (default text)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable output")
+                        help="alias for --format json")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         print("\n".join(RULES))
         return 0
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     root = os.path.abspath(args.root or os.getcwd())
     paths = args.paths or [os.path.dirname(os.path.dirname(
@@ -57,7 +167,45 @@ def main(argv=None) -> int:
             print(f"unknown rules: {sorted(unknown)}", file=sys.stderr)
             return 2
 
-    findings = analyze(paths, root=root, rules=rules)
+    if args.changed is not None:
+        try:
+            toplevel, changed = _git_changed_files(
+                root, None if args.changed == "HEAD" else args.changed)
+        except (OSError, RuntimeError) as exc:
+            print(f"flint --changed: {exc}", file=sys.stderr)
+            return 2
+        norm_paths = [os.path.abspath(p) for p in paths]
+
+        def in_scope(p: str) -> bool:
+            for np in norm_paths:
+                if os.path.isdir(np):
+                    if os.path.commonpath([p, np]) == np:
+                        return True
+                elif p == np:
+                    return True
+            return False
+
+        changed_py = [p for p in changed
+                      if p.endswith(".py") and os.path.exists(p) and
+                      in_scope(p)]
+        rel_changed = [os.path.relpath(c, root).replace(os.sep, "/")
+                       for c in changed]
+        with_project = any(part in c for c in rel_changed
+                           for part in _PROJECT_TRIGGER_PARTS)
+        # the cache lives at the repo TOPLEVEL (where .gitignore covers
+        # it) but is ROOT-scoped: entries carry root-relative paths, so
+        # a cache warmed under a different --root/cwd is discarded
+        cache_path = args.cache or default_cache_path(toplevel)
+        cache = load_summary_cache(cache_path, root=root)
+        findings = analyze(changed_py, root=root, rules=rules,
+                           project_paths=paths, cache=cache,
+                           with_project_checkers=with_project)
+        try:
+            save_summary_cache(cache_path, cache, root=root)
+        except OSError:
+            pass  # a read-only checkout still lints, just cold
+    else:
+        findings = analyze(paths, root=root, rules=rules)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     baseline_path = args.baseline or default_baseline_path()
@@ -68,8 +216,10 @@ def main(argv=None) -> int:
     if not args.no_baseline:
         findings = filter_baseline(findings, load_baseline(baseline_path))
 
-    if args.as_json:
-        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    if fmt == "json":
+        print(_to_json(findings))
+    elif fmt == "sarif":
+        print(_to_sarif(findings))
     else:
         for f in findings:
             print(f.render())

@@ -24,11 +24,12 @@ Machinery:
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 #: modules whose per-round cost rides the TPU queue — the host-sync rule
 #: only applies here (cold paths may sync freely).  telemetry/ is in the
@@ -36,6 +37,50 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 #: publisher spelled `.item()`/`float(...)` would silently turn the
 #: packed-stats ride-along into per-scalar transfers.
 HOT_PATH_PARTS = ("engine", "ops", "strategies", "telemetry", "robust")
+
+#: every rule id the suite can emit.  Lives here (not __init__) so the
+#: suppression linter can judge pragma validity without an import cycle.
+RULES = ("host-sync", "donation-aliasing", "jit-purity", "pallas-shape",
+         "put-loop", "schema-drift", "shard-ready", "recompile-hazard",
+         "transfer-budget", "guard-matrix", "event-schema",
+         "stale-suppression", "bare-suppression", "unknown-suppression",
+         "parse-error")
+
+#: rule-rename migration map: old pragma spelling -> current rule id.  A
+#: pragma naming a rule that no longer exists is an ERROR
+#: (``unknown-suppression``), never silently inert; when the old name is
+#: here the finding's hint names the replacement.  Seeded with the
+#: underscore spellings (the one misspelling every rule accumulates).
+RULE_RENAMES = {
+    "host_sync": "host-sync",
+    "donation_aliasing": "donation-aliasing",
+    "jit_purity": "jit-purity",
+    "pallas_shape": "pallas-shape",
+    "put_loop": "put-loop",
+    "schema_drift": "schema-drift",
+    "shard_ready": "shard-ready",
+    "recompile_hazard": "recompile-hazard",
+    "transfer_budget": "transfer-budget",
+    "guard_matrix": "guard-matrix",
+    "event_schema": "event-schema",
+}
+
+#: factories whose RESULT is a compiled callable — shared by host-sync
+#: (taint seeding), the summary extractor (cross-module jitted-binding
+#: tracking) and recompile-hazard (static_argnums hazards)
+JIT_FACTORIES = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+                 "jax.experimental.shard_map.shard_map", "pl.pallas_call",
+                 "pallas_call"}
+
+#: calls whose named function arguments become TRACED bodies — shared by
+#: jit-purity (root discovery) and the summary extractor
+TRACE_ENTRY = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+               "jax.experimental.shard_map.shard_map", "jax.vmap", "vmap",
+               "jax.lax.scan", "lax.scan", "jax.lax.while_loop",
+               "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
+               "jax.lax.cond", "lax.cond", "jax.checkpoint", "jax.remat",
+               "pl.pallas_call", "pallas_call", "jax.grad",
+               "jax.value_and_grad"}
 
 _PRAGMA_RE = re.compile(
     r"#\s*flint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s+(\S.*))?")
@@ -57,6 +102,16 @@ class Finding:
         # in the file
         return f"{self.rule}::{self.path}::{self.message}"
 
+    @property
+    def id(self) -> str:
+        """Stable finding id for machine consumers (``--format json`` /
+        SARIF ``partialFingerprints``): the rule plus a hash of the
+        line-free baseline key, so the id survives unrelated edits in
+        the same file exactly like the baseline does."""
+        digest = hashlib.sha1(
+            self.baseline_key.encode("utf-8")).hexdigest()[:12]
+        return f"{self.rule}-{digest}"
+
     def render(self) -> str:
         out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
         if self.hint:
@@ -74,6 +129,11 @@ class Suppression:
     reason: str
     applies_to: int      #: line the pragma suppresses (itself, or next)
     used: bool = False
+    #: hygiene findings (stale/bare/unknown) are only judged for pragmas
+    #: in files the caller actually asked to analyze — a project-wide
+    #: summary pass may parse pragmas in files outside the request
+    #: purely so cross-file checkers' findings can be suppressed there
+    in_scope: bool = True
 
 
 @dataclass
@@ -102,6 +162,8 @@ def parse_suppressions(info: ModuleInfo) -> List[Suppression]:
     import tokenize
 
     out: List[Suppression] = []
+    if "flint:" not in info.src:
+        return out  # fast path: tokenizing is ~10x a parse
     try:
         tokens = list(tokenize.generate_tokens(
             io.StringIO(info.src).readline))
@@ -149,6 +211,24 @@ def apply_suppressions(findings: List[Finding],
         kept.append(f)
 
     for sup in suppressions:
+        if not sup.in_scope:
+            continue
+        # pragma validity is judged regardless of any --rules subset: a
+        # pragma naming a rule that no longer exists must be an ERROR,
+        # not silently inert (the rule-rename failure mode)
+        unknown = [r for r in sup.rules if r not in RULES]
+        for r in unknown:
+            renamed = RULE_RENAMES.get(r)
+            kept.append(Finding(
+                "unknown-suppression", sup.path, sup.line,
+                f"suppression names unknown rule `{r}`"
+                + (f" (renamed to `{renamed}`)" if renamed else ""),
+                hint=(f"update the pragma to `disable={renamed}`"
+                      if renamed else
+                      "no such rule — fix the spelling or delete the "
+                      "pragma (tools/flint --list-rules)")))
+        if unknown and not (set(sup.rules) & set(RULES)):
+            continue  # nothing valid left to judge for staleness
         if active_rules is not None and \
                 not set(sup.rules) & active_rules:
             continue
@@ -257,6 +337,717 @@ def module_int_constants(tree: ast.Module) -> Dict[str, int]:
 
 
 # ----------------------------------------------------------------------
+# interprocedural engine (flint v2)
+#
+# One pass per file extracts a JSON-serializable :class:`ModuleSummary`
+# (functions + their call sites / fetch sites / self-state reads &
+# writes, imports, jitted bindings, traced roots, class markers, event
+# emissions).  :class:`Project` stitches the summaries into a project-
+# wide call graph with cross-module resolution, and exposes the two
+# reachability queries the checkers need: trace-context closure
+# (jit-purity, shard-ready, recompile-hazard) and round-path closure
+# (transfer-budget).  Summaries are cached per file keyed by
+# (mtime_ns, size) — in memory for repeated in-process runs (the tier-1
+# gate + test suite), and optionally on disk for ``tools/flint
+# --changed`` so an incremental run re-parses only the edited files.
+# ----------------------------------------------------------------------
+@dataclass
+class FunctionSummary:
+    """Def-use facts for one function/method, enough for every project
+    checker to reason about it WITHOUT re-parsing its file."""
+
+    module: str                 #: rel path of the defining file
+    qual: str                   #: dotted qualname ("Cls.meth", "f.inner")
+    name: str                   #: bare name
+    cls: Optional[str]          #: immediately enclosing class, if any
+    line: int
+    #: every call site: (dotted name as written, line)
+    calls: List[Tuple[str, int]] = field(default_factory=list)
+    #: explicit fetches: (line, arg source, lexically-inside-loop)
+    device_gets: List[Tuple[int, str, bool]] = field(default_factory=list)
+    #: ``self.X`` attribute loads / stores (recompile-hazard's
+    #: mutable-capture cross-check)
+    self_reads: List[str] = field(default_factory=list)
+    self_writes: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"module": self.module, "qual": self.qual,
+                "name": self.name, "cls": self.cls, "line": self.line,
+                "calls": [list(c) for c in self.calls],
+                "device_gets": [list(d) for d in self.device_gets],
+                "self_reads": self.self_reads,
+                "self_writes": self.self_writes}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FunctionSummary":
+        return cls(d["module"], d["qual"], d["name"], d.get("cls"),
+                   d["line"],
+                   [tuple(c) for c in d.get("calls", [])],
+                   [tuple(g) for g in d.get("device_gets", [])],
+                   list(d.get("self_reads", [])),
+                   list(d.get("self_writes", [])))
+
+
+@dataclass
+class ModuleSummary:
+    """One file's interprocedural facts (see module comment)."""
+
+    path: str                               #: rel path
+    functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    #: local name -> (target rel path, attr or None for module imports);
+    #: only imports that resolve INSIDE the analyzed project are kept
+    imports: Dict[str, Tuple[str, Optional[str]]] = \
+        field(default_factory=dict)
+    #: bare name -> qual of the LAST def with that name (runtime
+    #: shadowing semantics, matching the old jit-purity index)
+    name_index: Dict[str, str] = field(default_factory=dict)
+    #: names / self-attrs bound to a jit-factory result
+    jit_names: List[str] = field(default_factory=list)
+    jit_attrs: List[str] = field(default_factory=list)
+    #: trace roots: (function ref as written, enclosing class or None)
+    traced_roots: List[Tuple[str, Optional[str]]] = \
+        field(default_factory=list)
+    #: jit factories declaring static args: binding name/attr ->
+    #: {"argnums": [...], "argnames": [...], "line": n}
+    static_jit: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    #: class -> list of base-class names (dotted, as written)
+    class_bases: Dict[str, List[str]] = field(default_factory=dict)
+    #: class -> {attr: constant} for simple class-level constants
+    #: (``host_rounds = True`` markers, guard-matrix's strategy scan)
+    class_markers: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    #: telemetry emissions: (event name, line, api); api one of
+    #: log_event / emit_event / event / kind-literal; a trailing ``*``
+    #: in the name marks an f-string prefix family (``watchdog_*``)
+    events: List[Tuple[str, int, str]] = field(default_factory=list)
+    #: devbus publishes: (metric name, line, publish|devbus_host)
+    devbus: List[Tuple[str, int, str]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "functions": {q: f.to_dict()
+                          for q, f in self.functions.items()},
+            "imports": {k: list(v) for k, v in self.imports.items()},
+            "name_index": self.name_index,
+            "jit_names": self.jit_names, "jit_attrs": self.jit_attrs,
+            "traced_roots": [list(t) for t in self.traced_roots],
+            "static_jit": self.static_jit,
+            "class_bases": self.class_bases,
+            "class_markers": self.class_markers,
+            "events": [list(e) for e in self.events],
+            "devbus": [list(d) for d in self.devbus],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModuleSummary":
+        out = cls(d["path"])
+        out.functions = {q: FunctionSummary.from_dict(f)
+                         for q, f in d.get("functions", {}).items()}
+        out.imports = {k: (v[0], v[1])
+                       for k, v in d.get("imports", {}).items()}
+        out.name_index = dict(d.get("name_index", {}))
+        out.jit_names = list(d.get("jit_names", []))
+        out.jit_attrs = list(d.get("jit_attrs", []))
+        out.traced_roots = [(t[0], t[1])
+                            for t in d.get("traced_roots", [])]
+        out.static_jit = dict(d.get("static_jit", {}))
+        out.class_bases = {k: list(v)
+                           for k, v in d.get("class_bases", {}).items()}
+        out.class_markers = {k: dict(v)
+                             for k, v in d.get("class_markers", {}).items()}
+        out.events = [(e[0], e[1], e[2]) for e in d.get("events", [])]
+        out.devbus = [(e[0], e[1], e[2]) for e in d.get("devbus", [])]
+        return out
+
+
+_EVENT_APIS = {"log_event": 0, "emit_event": 1}
+_DEVGET_NAMES = ("jax.device_get", "device_get")
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _module_rel_for(dotted: str, importer: str, level: int,
+                    known: Set[str]) -> Optional[str]:
+    """Map an import to a rel path inside the project file set.
+
+    ``known`` holds the project's rel paths.  Handles relative imports
+    (``from ..telemetry import metrics``) by walking up from the
+    importer's package, and absolute ones by trying the dotted path both
+    as-is and package-qualified (``msrflute_tpu.engine.round``)."""
+    candidates: List[str] = []
+    if level > 0:
+        base = importer.split("/")[:-1]           # importer's package dir
+        base = base[: len(base) - (level - 1)] if level > 1 else base
+        if len(importer.split("/")) - 1 >= level - 1:
+            candidates.append("/".join(base + dotted.split("."))
+                              if dotted else "/".join(base))
+    else:
+        candidates.append("/".join(dotted.split(".")))
+    out = []
+    for cand in candidates:
+        if not cand:
+            continue
+        if cand + ".py" in known:
+            return cand + ".py"
+        if cand + "/__init__.py" in known:
+            return cand + "/__init__.py"
+        out.append(cand)
+    return None
+
+
+class _SummaryVisitor(ast.NodeVisitor):
+    """One walk of a module AST building its :class:`ModuleSummary`."""
+
+    def __init__(self, info: ModuleInfo, summary: ModuleSummary):
+        self.info = info
+        self.s = summary
+        self.class_stack: List[str] = []
+        self.fn_stack: List[FunctionSummary] = []
+        self.loop_depth = 0
+
+    # -- context ----------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.s.class_bases[node.name] = [
+            n for n in (dotted_name(b) for b in node.bases) if n]
+        markers = {}
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) and \
+                    isinstance(stmt.value, ast.Constant):
+                markers[stmt.targets[0].id] = stmt.value.value
+        if markers:
+            self.s.class_markers[node.name] = markers
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _enter_fn(self, node) -> None:
+        prefix = ""
+        if self.fn_stack:
+            prefix = self.fn_stack[-1].qual + "."
+        elif self.class_stack:
+            prefix = ".".join(self.class_stack) + "."
+        qual = prefix + node.name
+        fn = FunctionSummary(self.info.path, qual, node.name,
+                             self.class_stack[-1] if self.class_stack
+                             else None, node.lineno)
+        self.s.functions[qual] = fn
+        self.s.name_index[node.name] = qual
+        for dec in node.decorator_list:
+            dec_call = dec.func if isinstance(dec, ast.Call) else dec
+            if dotted_name(dec_call) in TRACE_ENTRY:
+                self.s.traced_roots.append(
+                    (node.name, fn.cls))
+        self.fn_stack.append(fn)
+        outer_loop, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = outer_loop
+        self.fn_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._enter_fn(node)
+
+    # -- imports ----------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            target = _module_rel_for(alias.name, self.info.path, 0,
+                                     self._known())
+            if not target:
+                continue
+            if alias.asname:
+                self.s.imports[alias.asname] = (target, None)
+            elif "." not in alias.name:
+                self.s.imports[alias.name] = (target, None)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        target = _module_rel_for(node.module or "", self.info.path,
+                                 node.level or 0, self._known())
+        if target is None:
+            return
+        for alias in node.names:
+            # `from pkg import mod` where pkg/mod.py exists binds the
+            # MODULE, not an attr of pkg/__init__.py
+            dotted = alias.name if not node.module \
+                else node.module + "." + alias.name
+            sub = _module_rel_for(dotted, self.info.path,
+                                  node.level or 0, self._known())
+            if sub and sub != target:
+                self.s.imports[alias.asname or alias.name] = (sub, None)
+            else:
+                self.s.imports[alias.asname or alias.name] = \
+                    (target, alias.name)
+
+    def _known(self) -> Set[str]:
+        return getattr(self, "_known_paths", set())
+
+    # -- loops (lexical, for loop-fetch detection) -------------------
+    def _loop(self, node) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+    visit_ListComp = visit_SetComp = visit_DictComp = _loop
+    visit_GeneratorExp = _loop
+
+    # -- statements -------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        if isinstance(value, ast.Call) and \
+                call_name(value) in JIT_FACTORIES:
+            static = self._static_spec(value)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.s.jit_names.append(tgt.id)
+                    if static:
+                        self.s.static_jit[tgt.id] = static
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    self.s.jit_attrs.append(tgt.attr)
+                    if static:
+                        self.s.static_jit["self." + tgt.attr] = static
+        if self.fn_stack:
+            for tgt in node.targets:
+                self._record_self_write(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.fn_stack:
+            self._record_self_write(node.target)
+        self.generic_visit(node)
+
+    def _record_self_write(self, tgt: ast.AST) -> None:
+        """``self.X`` / ``self.X[...]`` / ``self.X.Y`` store targets
+        count as writes of attr ``X`` (mutation of its object)."""
+        if not isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            return
+        attr_node = tgt
+        while isinstance(attr_node, ast.Subscript):
+            attr_node = attr_node.value
+        if not isinstance(attr_node, ast.Attribute):
+            return
+        while isinstance(attr_node.value, (ast.Attribute, ast.Subscript)):
+            attr_node = attr_node.value
+            while isinstance(attr_node, ast.Subscript):
+                attr_node = attr_node.value
+            if not isinstance(attr_node, ast.Attribute):
+                return
+        if isinstance(attr_node.value, ast.Name) and \
+                attr_node.value.id == "self":
+            self.fn_stack[-1].self_writes.append(attr_node.attr)
+
+    @staticmethod
+    def _static_spec(call: ast.Call) -> Optional[Dict[str, Any]]:
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                nums = []
+                for elt in (kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]):
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, int):
+                        nums.append(elt.value)
+                return {"argnums": nums, "argnames": [],
+                        "line": call.lineno}
+            if kw.arg == "static_argnames":
+                names = []
+                for elt in (kw.value.elts if isinstance(
+                        kw.value, (ast.Tuple, ast.List)) else [kw.value]):
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        names.append(elt.value)
+                return {"argnums": [], "argnames": names,
+                        "line": call.lineno}
+        return None
+
+    # -- expressions ------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.fn_stack and isinstance(node.ctx, ast.Load) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            self.fn_stack[-1].self_reads.append(node.attr)
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        # telemetry event records built as dict literals ({"kind": ...})
+        # — the xla.py drain-queue pattern
+        for key, val in zip(node.keys, node.values):
+            if isinstance(key, ast.Constant) and key.value == "kind":
+                for arm in ([val.body, val.orelse]
+                            if isinstance(val, ast.IfExp) else [val]):
+                    if isinstance(arm, ast.Constant) and \
+                            isinstance(arm.value, str):
+                        self.s.events.append(
+                            (arm.value, node.lineno, "kind-literal"))
+        self.generic_visit(node)
+
+    def _event_name(self, arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.JoinedStr) and arg.values and \
+                isinstance(arg.values[0], ast.Constant):
+            return str(arg.values[0].value) + "*"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name is not None and self.fn_stack:
+            self.fn_stack[-1].calls.append((name, node.lineno))
+        if name in _DEVGET_NAMES and self.fn_stack:
+            arg_src = ast.unparse(node.args[0]) if node.args else ""
+            self.fn_stack[-1].device_gets.append(
+                (node.lineno, arg_src, self.loop_depth > 0))
+        # trace roots from named function args (incl. functools.partial)
+        if name in TRACE_ENTRY:
+            cls = self.class_stack[-1] if self.class_stack else None
+            for arg in node.args:
+                ref = dotted_name(arg)
+                if ref is None and isinstance(arg, ast.Call) and \
+                        call_name(arg) in ("functools.partial", "partial"):
+                    ref = arg.args and dotted_name(arg.args[0]) or None
+                if ref:
+                    self.s.traced_roots.append((ref, cls))
+        # telemetry emissions
+        tail = name.rsplit(".", 1)[-1] if name else None
+        if tail in _EVENT_APIS:
+            idx = _EVENT_APIS[tail]
+            if len(node.args) > idx:
+                ev = self._event_name(node.args[idx])
+                if ev:
+                    self.s.events.append((ev, node.lineno, tail))
+        elif name and name.endswith(".event") and node.args:
+            ev = self._event_name(node.args[0])
+            if ev:
+                self.s.events.append((ev, node.lineno, "event"))
+        elif name and name.endswith("on_event") and node.args:
+            ev = self._event_name(node.args[0])
+            if ev:
+                self.s.events.append((ev, node.lineno, "event"))
+        if name and node.args:
+            if name.endswith(".publish"):
+                ev = self._event_name(node.args[0])
+                if ev:
+                    self.s.devbus.append((ev, node.lineno, "publish"))
+            elif name.endswith("devbus_host"):
+                ev = self._event_name(node.args[0])
+                if ev:
+                    self.s.devbus.append((ev, node.lineno,
+                                          "devbus_host"))
+        self.generic_visit(node)
+
+
+def compute_module_summary(info: ModuleInfo,
+                           known_paths: Optional[Set[str]] = None
+                           ) -> ModuleSummary:
+    """Extract ``info``'s :class:`ModuleSummary` (one AST walk)."""
+    summary = ModuleSummary(info.path)
+    visitor = _SummaryVisitor(info, summary)
+    visitor._known_paths = known_paths or set()
+    visitor.visit(info.tree)
+    return summary
+
+
+#: in-process summary cache: abspath -> (mtime_ns, size, summary).
+#: Shared across analyze() calls so the tier-1 gate and the test suite
+#: never re-summarize an unchanged file twice in one process.
+_SUMMARY_CACHE: Dict[str, Tuple[int, int, ModuleSummary]] = {}
+
+
+def _file_stamp(abspath: str) -> Tuple[int, int]:
+    st = os.stat(abspath)
+    return (st.st_mtime_ns, st.st_size)
+
+
+class Project:
+    """The project-wide call graph + reachability queries."""
+
+    def __init__(self, root: str,
+                 modules: Dict[str, ModuleSummary]):
+        self.root = root
+        self.modules = modules
+        self._traced: Optional[Set[Tuple[str, str]]] = None
+
+    # -- resolution --------------------------------------------------
+    def resolve(self, module: str, ref: str,
+                cls: Optional[str] = None
+                ) -> Optional[Tuple[str, str]]:
+        """Resolve a call/ref string written in ``module`` (optionally
+        inside class ``cls``) to a ``(module, qual)`` function, or None
+        when it points outside the project / cannot be proven."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        if ref.startswith("self."):
+            attr = ref.split(".", 1)[1]
+            if "." in attr:
+                return None  # self.a.b: attribute-of-attribute dispatch
+            return self._resolve_method(module, cls, attr, set())
+        if "." not in ref:
+            qual = mod.name_index.get(ref)
+            if qual is not None:
+                return (module, qual)
+            imp = mod.imports.get(ref)
+            if imp is not None and imp[1] is not None:
+                target_mod = self.modules.get(imp[0])
+                if target_mod is not None:
+                    qual = target_mod.name_index.get(imp[1])
+                    if qual is not None:
+                        return (imp[0], qual)
+            return None
+        head, rest = ref.split(".", 1)
+        imp = mod.imports.get(head)
+        if imp is not None and imp[1] is None and "." not in rest:
+            target_mod = self.modules.get(imp[0])
+            if target_mod is not None:
+                qual = target_mod.name_index.get(rest)
+                if qual is not None:
+                    return (imp[0], qual)
+        return None
+
+    def _resolve_method(self, module: str, cls: Optional[str],
+                        attr: str, seen: Set[Tuple[str, str]]
+                        ) -> Optional[Tuple[str, str]]:
+        """``self.attr`` -> the method, walking same-named base classes
+        (resolved through imports) with a cycle guard."""
+        if cls is None or (module, cls) in seen:
+            return None
+        seen.add((module, cls))
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        qual = f"{cls}.{attr}"
+        if qual in mod.functions:
+            return (module, qual)
+        for base in mod.class_bases.get(cls, []):
+            base_name = base.rsplit(".", 1)[-1]
+            if base_name in mod.class_bases or \
+                    any(q.startswith(base_name + ".")
+                        for q in mod.functions):
+                found = self._resolve_method(module, base_name, attr,
+                                             seen)
+                if found:
+                    return found
+            imp = mod.imports.get(base.split(".")[0])
+            if imp is not None:
+                # both `from .base import BaseStrategy` (attr import)
+                # and `from . import base` + `base.BaseStrategy`
+                # (module import) resolve the base's METHODS in imp[0]
+                found = self._resolve_method(imp[0], base_name, attr,
+                                             seen)
+                if found:
+                    return found
+        return None
+
+    def function(self, key: Tuple[str, str]) -> Optional[FunctionSummary]:
+        mod = self.modules.get(key[0])
+        return mod.functions.get(key[1]) if mod else None
+
+    # -- jitted bindings ---------------------------------------------
+    def imported_jit_names(self, module: str) -> Set[str]:
+        """Local names of ``module`` that are module-level jit-factory
+        bindings in their DEFINING module — the cross-module half of
+        host-sync's taint seeding."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return set()
+        out: Set[str] = set()
+        for local, (target, attr) in mod.imports.items():
+            if attr is None:
+                continue
+            target_mod = self.modules.get(target)
+            if target_mod is not None and attr in target_mod.jit_names:
+                out.add(local)
+        return out
+
+    # -- trace-context closure ---------------------------------------
+    def traced_reachable(self) -> Set[Tuple[str, str]]:
+        """Every function that runs INSIDE a trace: named roots handed
+        to jit/vmap/scan/... (including ``self._fn = jax.jit(body)``
+        method bindings and decorator form), closed over the project
+        call graph.  Cycles are fine (seen-set)."""
+        if self._traced is not None:
+            return self._traced
+        frontier: List[Tuple[str, str]] = []
+        for path, mod in self.modules.items():
+            for ref, cls in mod.traced_roots:
+                resolved = self.resolve(path, ref, cls)
+                if resolved:
+                    frontier.append(resolved)
+        seen: Set[Tuple[str, str]] = set()
+        while frontier:
+            key = frontier.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            fn = self.function(key)
+            if fn is None:
+                continue
+            for ref, _line in fn.calls:
+                callee = self.resolve(key[0], ref, fn.cls)
+                if callee and callee not in seen:
+                    frontier.append(callee)
+        self._traced = seen
+        return seen
+
+    # -- round-path closure (transfer-budget) ------------------------
+    def reachable_from(self, roots: Iterable[Tuple[str, str]],
+                       stop: Optional[re.Pattern] = None
+                       ) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        """BFS closure over the host call graph from ``roots``; returns
+        ``{function: caller}`` back-edges (roots map to themselves).
+        ``stop`` prunes callees whose BARE NAME matches (cadence
+        boundaries: eval/checkpoint-class functions)."""
+        parents: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        frontier = []
+        for key in roots:
+            if key not in parents:
+                parents[key] = key
+                frontier.append(key)
+        while frontier:
+            key = frontier.pop()
+            fn = self.function(key)
+            if fn is None:
+                continue
+            for ref, _line in fn.calls:
+                callee = self.resolve(key[0], ref, fn.cls)
+                if callee is None or callee in parents:
+                    continue
+                callee_fn = self.function(callee)
+                if callee_fn is None:
+                    continue
+                if stop is not None and stop.search(callee_fn.name):
+                    continue
+                parents[callee] = key
+                frontier.append(callee)
+        return parents
+
+    def call_path(self, parents: Dict[Tuple[str, str], Tuple[str, str]],
+                  key: Tuple[str, str]) -> List[str]:
+        """Human-readable root -> ... -> key chain from a
+        :meth:`reachable_from` result."""
+        chain = [key]
+        while parents.get(chain[-1]) not in (None, chain[-1]):
+            chain.append(parents[chain[-1]])
+        return [f"{m}::{q}" for m, q in reversed(chain)]
+
+
+def build_project(root: str, project_files: List[str],
+                  infos: Optional[Dict[str, ModuleInfo]] = None,
+                  cache: Optional[Dict[str, Any]] = None) -> Project:
+    """Summarize ``project_files`` (abs paths) into a :class:`Project`.
+
+    ``infos`` carries already-parsed modules (the analyzed set) so no
+    file is parsed twice.  ``cache`` is an optional disk-cache dict (see
+    :func:`load_summary_cache`): entries whose (mtime_ns, size) stamp
+    still matches are reused WITHOUT re-reading the file — the
+    ``--changed`` incremental contract."""
+    known = {os.path.relpath(p, root).replace(os.sep, "/")
+             for p in project_files}
+    modules: Dict[str, ModuleSummary] = {}
+    for abspath in project_files:
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            stamp = _file_stamp(abspath)
+        except OSError:
+            continue
+        hit = _SUMMARY_CACHE.get(abspath)
+        if hit is not None and (hit[0], hit[1]) == stamp:
+            modules[rel] = hit[2]
+            continue
+        if cache is not None:
+            entry = cache.get(rel)
+            if entry is not None and \
+                    tuple(entry.get("stamp", ())) == stamp:
+                summary = ModuleSummary.from_dict(entry["summary"])
+                modules[rel] = summary
+                _SUMMARY_CACHE[abspath] = (stamp[0], stamp[1], summary)
+                continue
+        info = infos.get(rel) if infos else None
+        if info is None:
+            info = load_module(abspath, root)
+        if getattr(info, "parse_error", None) is not None:
+            continue
+        summary = compute_module_summary(info, known)
+        modules[rel] = summary
+        _SUMMARY_CACHE[abspath] = (stamp[0], stamp[1], summary)
+        if cache is not None:
+            cache[rel] = {"stamp": list(stamp),
+                          "summary": summary.to_dict()}
+    return Project(os.path.abspath(root), modules)
+
+
+# ----------------------------------------------------------------------
+# disk summary cache (tools/flint --changed)
+# ----------------------------------------------------------------------
+_CACHE_VERSION = 1
+
+
+def default_cache_path(root: str) -> str:
+    return os.path.join(root, ".flint_cache.json")
+
+
+def load_summary_cache(path: str,
+                       root: Optional[str] = None) -> Dict[str, Any]:
+    """Entries are keyed by ROOT-relative path and their summaries
+    carry root-relative module paths, so a cache warmed under a
+    different analysis root must be discarded wholesale — reusing it
+    would report findings at the wrong paths."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            raw = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if raw.get("version") != _CACHE_VERSION:
+        return {}
+    if root is not None and raw.get("root") not in (None,
+                                                   os.path.abspath(root)):
+        return {}
+    entries = raw.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def save_summary_cache(path: str, cache: Dict[str, Any],
+                       root: Optional[str] = None) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"version": _CACHE_VERSION,
+                   "root": os.path.abspath(root) if root else None,
+                   "entries": cache}, fh)
+    os.replace(tmp, path)
+
+
+def function_nodes(info: ModuleInfo) -> Dict[str, ast.AST]:
+    """AST def nodes of ``info`` keyed by the SAME qualnames the
+    summary extractor assigns — the bridge from a reachability answer
+    back to a body to walk.  Memoized on the info (three checkers ask
+    per file)."""
+    cached = getattr(info, "_fn_nodes", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, ast.AST] = {}
+
+    def walk(node: ast.AST, prefix: str, in_fn: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, prefix if in_fn else prefix + child.name + ".",
+                     in_fn)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                out[qual] = child
+                walk(child, qual + ".", True)
+            else:
+                walk(child, prefix, in_fn)
+
+    walk(info.tree, "", False)
+    info._fn_nodes = out  # type: ignore[attr-defined]
+    return out
+
+
+# ----------------------------------------------------------------------
 # runner
 # ----------------------------------------------------------------------
 def _iter_py_files(paths: List[str]) -> List[str]:
@@ -291,40 +1082,124 @@ def load_module(abspath: str, root: str) -> ModuleInfo:
 
 
 def analyze(paths: List[str], root: Optional[str] = None,
-            rules: Optional[Set[str]] = None) -> List[Finding]:
+            rules: Optional[Set[str]] = None,
+            project_paths: Optional[List[str]] = None,
+            cache: Optional[Dict[str, Any]] = None,
+            with_project_checkers: bool = True) -> List[Finding]:
     """Run every checker over ``paths``; returns suppression-filtered
-    findings (baseline NOT applied — that is the caller's policy)."""
-    from . import donation, host_sync, jit_purity, pallas_shape, \
-        put_loop, schema_drift
+    findings (baseline NOT applied — that is the caller's policy).
+
+    ``project_paths`` widens the CALL-GRAPH scope beyond the analyzed
+    set (``--changed`` analyzes the edited files against the whole
+    package's summaries); findings are only emitted for ``paths``.
+    ``cache`` is a disk-cache dict (:func:`load_summary_cache`) updated
+    in place.  ``with_project_checkers=False`` skips the project-level
+    checkers (schema-drift, guard-matrix, event-schema,
+    transfer-budget) — the incremental mode's call when none of their
+    inputs changed."""
+    from . import (donation, event_schema, guard_matrix, host_sync,
+                   jit_purity, pallas_shape, put_loop, recompile_hazard,
+                   schema_drift, shard_ready, transfer_budget)
 
     root = os.path.abspath(root or os.getcwd())
-    per_file_checkers = [
-        (host_sync.RULE, host_sync.check),
-        (donation.RULE, donation.check),
-        (jit_purity.RULE, jit_purity.check),
-        (pallas_shape.RULE, pallas_shape.check),
-        (put_loop.RULE, put_loop.check),
-    ]
+    files = _iter_py_files(paths)
+    proj_files = sorted(set(files) | set(
+        _iter_py_files(project_paths or [])))
 
+    # parse the analyzed set once; summaries for the rest come from the
+    # caches (or a fresh parse on a cold run)
+    infos: Dict[str, ModuleInfo] = {}
     findings: List[Finding] = []
     suppressions: List[Suppression] = []
-    for abspath in _iter_py_files(paths):
+    analyzed_rel: Set[str] = set()
+    for abspath in files:
         info = load_module(abspath, root)
+        analyzed_rel.add(info.path)
         if getattr(info, "parse_error", None) is not None:
             exc = info.parse_error  # type: ignore[attr-defined]
             findings.append(Finding("parse-error", info.path,
                                     exc.lineno or 1, str(exc.msg)))
             continue
+        infos[info.path] = info
         suppressions.extend(parse_suppressions(info))
+
+    project = build_project(root, proj_files, infos=infos, cache=cache)
+
+    # project-level findings can land in files OUTSIDE the analyzed set
+    # (a transfer-budget finding in an unchanged engine file whose
+    # round path a changed helper joined; an event-schema finding in a
+    # telemetry module a subset run never named) — their pragmas must
+    # still suppress, so parse the WHOLE package's pragmas too, out of
+    # hygiene scope
+    if with_project_checkers:
+        pragma_files = set(proj_files)
+        pkg_dir = os.path.join(root, "msrflute_tpu")
+        if os.path.isdir(pkg_dir):
+            pragma_files |= set(_iter_py_files([pkg_dir]))
+        for abspath in sorted(pragma_files):
+            rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+            if rel in analyzed_rel:
+                continue
+            info = load_module(abspath, root)
+            if getattr(info, "parse_error", None) is not None:
+                continue
+            for sup in parse_suppressions(info):
+                sup.in_scope = False
+                suppressions.append(sup)
+
+    per_file_checkers = [
+        (host_sync.RULE, lambda i: host_sync.check(i, project)),
+        (donation.RULE, donation.check),
+        (jit_purity.RULE, lambda i: jit_purity.check(i, project)),
+        (pallas_shape.RULE, pallas_shape.check),
+        (put_loop.RULE, put_loop.check),
+        (shard_ready.RULE, lambda i: shard_ready.check(i, project)),
+        (recompile_hazard.RULE,
+         lambda i: recompile_hazard.check(i, project)),
+    ]
+    for rel in sorted(infos):
+        info = infos[rel]
         for rule, check in per_file_checkers:
             if rules and rule not in rules:
                 continue
             findings.extend(check(info))
 
-    if rules is None or schema_drift.RULE in rules:
-        findings.extend(schema_drift.check_project(root))
-        # schema-drift findings live in .py/.md files that may carry
-        # inline pragmas too; only .py pragmas are parsed, which is fine
-        # because the actionable end of a drift is always the schema.
+    if with_project_checkers:
+        if rules is None or transfer_budget.RULE in rules:
+            findings.extend(transfer_budget.check_project(
+                project, emit_paths=analyzed_rel
+                if project_paths else None))
+        if rules is None or schema_drift.RULE in rules:
+            findings.extend(schema_drift.check_project(root))
+        if rules is None or guard_matrix.RULE in rules:
+            findings.extend(guard_matrix.check_project(
+                root, trees={rel: i.tree for rel, i in infos.items()}))
+        if rules is None or event_schema.RULE in rules:
+            findings.extend(event_schema.check_project(
+                root, modules=project.modules))
+        # project-checker findings live in .py/.md files that may carry
+        # inline pragmas; .md pragmas are not a thing, which is fine
+        # because the actionable end of a doc drift is the doc itself.
 
-    return apply_suppressions(findings, suppressions, active_rules=rules)
+    # staleness is judged only for rules that RAN AND APPLIED: a
+    # doc-vs-code checker that returned early (tree without its doc /
+    # schema inputs, or a --changed run that skipped project checkers)
+    # must not mark its pragmas stale
+    active = set(rules) if rules is not None else set(RULES)
+    project_rules = {transfer_budget.RULE, schema_drift.RULE,
+                     guard_matrix.RULE, event_schema.RULE}
+    if not with_project_checkers:
+        active -= project_rules
+    else:
+        pkg = os.path.join(root, "msrflute_tpu")
+        if not (os.path.exists(os.path.join(pkg, "schema.py")) and
+                os.path.exists(os.path.join(pkg, "config.py"))):
+            active.discard(schema_drift.RULE)
+        if not (os.path.exists(os.path.join(pkg, "engine", "server.py"))
+                and os.path.exists(os.path.join(pkg, "schema.py"))):
+            active.discard(guard_matrix.RULE)
+        if not os.path.exists(os.path.join(root, "docs",
+                                           "observability.md")):
+            active.discard(event_schema.RULE)
+    return apply_suppressions(findings, suppressions,
+                              active_rules=active)

@@ -1,0 +1,175 @@
+"""event-schema — telemetry event contracts, code vs docs.
+
+Structured events are the round loop's crash-forensics surface:
+``tools/scope`` tabulates them per name, the RUNBOOK drills grep for
+them, and operators alert on them.  An event the code emits but the
+docs never mention is invisible operationally; an event the docs
+advertise but nothing emits is an alert that can never fire.  Both
+directions drift silently — this rule makes them mechanical:
+
+- **emitted -> documented**: every literal event name reaching
+  ``log_event(...)`` / ``emit_event(scope, ...)`` / ``*.event(...)`` /
+  ``*.on_event(...)`` (f-string prefixes like ``f"watchdog_{kind}"``
+  count as the family ``watchdog_*``), plus ``{"kind": "..."}`` event
+  records built as dict literals (the xla.py drain-queue pattern), must
+  appear in ``docs/observability.md``;
+- **documented -> emitted**: every event token in the doc's
+  "Instant events" catalogue must be emitted somewhere (globs match
+  prefix families);
+- **devbus publishers**: every ``devbus.publish("name", ...)`` /
+  ``scope.devbus_host("name", ...)`` metric must appear in the doc
+  (as `` `name` `` or `` `devbus/name` ``), and every name in the
+  doc's "Built-in publishers" sentence must still be published.
+
+Emission sites come from the module summaries (one AST walk shared
+with the rest of flint v2); dynamic names (``ev.pop("kind")``) are
+skipped — those records were emitted under their literal names at the
+point the dict was BUILT, which the dict-literal scan covers.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import (Finding, ModuleSummary, _iter_py_files,
+                   build_project)
+
+RULE = "event-schema"
+
+#: paragraph anchors in docs/observability.md
+DOC_EVENT_ANCHOR = "Instant events"
+DOC_DEVBUS_ANCHOR = "Built-in publishers"
+
+_BACKTICK_RE = re.compile(r"`([A-Za-z0-9_*/]+)`")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\*?$")
+
+
+def _doc_anchor_tokens(doc_lines: List[str], anchor: str
+                       ) -> List[Tuple[int, str]]:
+    """Backticked event-shaped tokens in the paragraph starting at the
+    line containing ``anchor`` (to the next blank line)."""
+    out: List[Tuple[int, str]] = []
+    for i, line in enumerate(doc_lines):
+        if anchor not in line:
+            continue
+        for j in range(i, len(doc_lines)):
+            if j > i and not doc_lines[j].strip():
+                break
+            for m in _BACKTICK_RE.finditer(doc_lines[j]):
+                token = m.group(1)
+                if _NAME_RE.match(token):
+                    out.append((j + 1, token))
+        break
+    return out
+
+
+def _name_matches(name: str, token: str) -> bool:
+    """Glob-aware event-name match (either side may be a ``P*``
+    prefix family)."""
+    if name.endswith("*") and token.endswith("*"):
+        return name[:-1].startswith(token[:-1]) or \
+            token[:-1].startswith(name[:-1])
+    if token.endswith("*"):
+        return name.startswith(token[:-1])
+    if name.endswith("*"):
+        return token.startswith(name[:-1])
+    return name == token
+
+
+def _collect_modules(root: str) -> Dict[str, ModuleSummary]:
+    pkg = os.path.join(root, "msrflute_tpu")
+    files = _iter_py_files([pkg] if os.path.isdir(pkg) else [root])
+    return build_project(root, files).modules
+
+
+def check_project(root: str,
+                  modules: Optional[Dict[str, ModuleSummary]] = None
+                  ) -> List[Finding]:
+    doc_path = os.path.join(root, "docs", "observability.md")
+    if not os.path.exists(doc_path):
+        return []  # not a tree this checker applies to
+    rel_doc = os.path.relpath(doc_path, root).replace(os.sep, "/")
+    with open(doc_path, "r", encoding="utf-8") as fh:
+        doc_text = fh.read()
+    doc_lines = doc_text.splitlines()
+    doc_tokens = set(_BACKTICK_RE.findall(doc_text))
+
+    if modules is None:
+        modules = _collect_modules(root)
+    else:
+        # a subset run (`tools/flint engine/`) hands us only the
+        # analyzed files' summaries; judging "documented event is
+        # emitted nowhere" against a partial emission set would flood
+        # with false positives — rescan the whole package instead
+        pkg = os.path.join(root, "msrflute_tpu")
+        if os.path.isdir(pkg):
+            all_rel = {os.path.relpath(p, root).replace(os.sep, "/")
+                       for p in _iter_py_files([pkg])}
+            if not all_rel <= set(modules):
+                modules = _collect_modules(root)
+
+    findings: List[Finding] = []
+
+    # ---- emitted -> documented ---------------------------------------
+    emitted: List[Tuple[str, str, int]] = []   # (name, module, line)
+    published: List[Tuple[str, str, int]] = []
+    for path in sorted(modules):
+        mod = modules[path]
+        for name, line, _api in mod.events:
+            emitted.append((name, path, line))
+        for name, line, _api in mod.devbus:
+            published.append((name, path, line))
+    seen_names = set()
+    for name, path, line in emitted:
+        if name in seen_names:
+            continue
+        documented = any(_name_matches(name, tok) for tok in doc_tokens)
+        if not documented:
+            seen_names.add(name)
+            findings.append(Finding(
+                RULE, path, line,
+                f"telemetry event `{name}` is emitted but "
+                "docs/observability.md never mentions it",
+                hint="add it to the 'Instant events' catalogue — "
+                     "undocumented events are invisible to operators "
+                     "and tools/scope readers"))
+    seen_pub = set()
+    for name, path, line in published:
+        if name in seen_pub:
+            continue
+        core_name = name.rstrip("*")
+        if not (name in doc_tokens or f"devbus/{core_name}" in doc_tokens
+                or any(_name_matches(name, tok) for tok in doc_tokens)):
+            seen_pub.add(name)
+            findings.append(Finding(
+                RULE, path, line,
+                f"devbus metric `{name}` is published but "
+                "docs/observability.md never mentions it",
+                hint="add it to the 'Built-in publishers' list (the "
+                     "devbus section)"))
+
+    # ---- documented -> emitted ---------------------------------------
+    emitted_names = {name for name, _, _ in emitted}
+    for line_no, token in _doc_anchor_tokens(doc_lines,
+                                             DOC_EVENT_ANCHOR):
+        if not any(_name_matches(name, token) for name in emitted_names):
+            findings.append(Finding(
+                RULE, rel_doc, line_no,
+                f"documented event `{token}` is emitted nowhere",
+                hint="the emission was renamed or dropped — fix the "
+                     "doc or restore the event (an advertised event "
+                     "that can never fire breaks alerting)"))
+    published_names = {name for name, _, _ in published}
+    for line_no, token in _doc_anchor_tokens(doc_lines,
+                                             DOC_DEVBUS_ANCHOR):
+        if not any(_name_matches(name, token)
+                   for name in published_names):
+            findings.append(Finding(
+                RULE, rel_doc, line_no,
+                f"documented devbus publisher `{token}` publishes "
+                "nowhere",
+                hint="the publisher was renamed or dropped — fix the "
+                     "doc or restore the publish call"))
+    return findings

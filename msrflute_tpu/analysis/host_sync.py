@@ -30,9 +30,12 @@ Device taint is tracked per function scope, seeded by:
   calls taint every target.
 
 ``jax.device_get(...)`` results are host values and CLEAR taint, as
-does rebinding a name to an untainted value.  The tracker is
-intentionally same-module only: cross-module flows are the runtime
-strict mode's job (``MSRFLUTE_STRICT_TRANSFERS=1``, docs/RUNBOOK.md).
+does rebinding a name to an untainted value.  Since flint v2 the taint
+seeding is interprocedural: a name IMPORTED from another project module
+where it is bound to a jit-factory result taints its call results here
+too (``Project.imported_jit_names``).  VALUE flows across modules are
+still the runtime strict mode's job (``MSRFLUTE_STRICT_TRANSFERS=1``,
+docs/RUNBOOK.md).
 """
 
 from __future__ import annotations
@@ -40,7 +43,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set
 
-from .core import Finding, ModuleInfo, call_name, dotted_name
+from .core import (JIT_FACTORIES, Finding, ModuleInfo, Project,
+                   call_name, dotted_name)
 
 RULE = "host-sync"
 
@@ -48,9 +52,7 @@ RULE = "host-sync"
 _DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.", "jax.lax.",
                     "jax.nn.", "optax.")
 #: factories whose RESULT is a compiled callable (module-level tracking)
-_JIT_FACTORIES = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
-                  "jax.experimental.shard_map.shard_map", "pl.pallas_call",
-                  "pallas_call"}
+_JIT_FACTORIES = JIT_FACTORIES
 _LOG_SINKS = {"print", "print_rank", "log_metric"}
 
 
@@ -237,10 +239,21 @@ class _ScopeTaint(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def check(info: ModuleInfo) -> List[Finding]:
+def check(info: ModuleInfo,
+          project: Optional[Project] = None) -> List[Finding]:
     if not info.is_hot_path:
         return []
-    jit_names, jit_attrs = _collect_jitted_bindings(info.tree)
+    summary = project.modules.get(info.path) if project else None
+    if summary is not None:
+        # flint v2: the module summary already extracted the bindings,
+        # and imported compiled callables (module-level
+        # ``step = jax.jit(...)`` in another project file) seed taint
+        # exactly like locally-built ones
+        jit_names = set(summary.jit_names) | \
+            project.imported_jit_names(info.path)
+        jit_attrs = set(summary.jit_attrs)
+    else:
+        jit_names, jit_attrs = _collect_jitted_bindings(info.tree)
     findings: List[Finding] = []
     for node in ast.walk(info.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -7,8 +7,9 @@ Python side effects do not re-execute per call, and host-state reads
 compiled program.  Both are classic silent-wrongness bugs: the program
 "works" and the effect/entropy is simply absent from round 2 onward.
 
-Flagged inside traced bodies (and same-module functions they call,
-transitively):
+Flagged inside traced bodies (and any function they call, resolved
+through the PROJECT call graph since flint v2 — a helper imported from
+another module is traced context too, reported in its own file):
 
 - wall-clock reads: ``time.time/perf_counter/monotonic``,
   ``datetime.now``;
@@ -20,11 +21,14 @@ transitively):
 - mutation of enclosing object state: assignment/augassign to a
   ``self.*`` target, ``global`` / ``nonlocal`` declarations.
 
-Traced roots are resolved same-module only: named function arguments
-to the trace entry points, including decorator form (``@jax.jit``) and
-``functools.partial(fn, ...)`` wrapping.  A *deliberate* trace-time
-effect (e.g. recording a slot table the host decodes with) takes an
-inline ``# flint: disable=jit-purity <reason>``.
+Traced roots come from the module summaries: named function arguments
+to the trace entry points, including decorator form (``@jax.jit``),
+``functools.partial(fn, ...)`` wrapping, and method bindings
+(``self._step = jax.jit(self._body)``); closure follows
+``Project.traced_reachable()`` (cross-module chains, cycles, method
+dispatch).  A *deliberate* trace-time effect (e.g. recording a slot
+table the host decodes with) takes an inline
+``# flint: disable=jit-purity <reason>``.
 """
 
 from __future__ import annotations
@@ -32,17 +36,10 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set
 
-from .core import Finding, ModuleInfo, call_name, dotted_name
+from .core import (Finding, ModuleInfo, Project, build_project,
+                   call_name, dotted_name, function_nodes)
 
 RULE = "jit-purity"
-
-_TRACE_ENTRY = {"jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
-                "jax.experimental.shard_map.shard_map", "jax.vmap", "vmap",
-                "jax.lax.scan", "lax.scan", "jax.lax.while_loop",
-                "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
-                "jax.lax.cond", "lax.cond", "jax.checkpoint", "jax.remat",
-                "pl.pallas_call", "pallas_call", "jax.grad",
-                "jax.value_and_grad"}
 
 _IMPURE_CALLS = {
     "time.time": "wall-clock read bakes ONE trace-time value into the "
@@ -72,70 +69,22 @@ _IMPURE_PREFIXES = {
 
 def _named_function_args(call: ast.Call) -> List[str]:
     """Function names passed (positionally or via partial) to a trace
-    entry point."""
+    entry point (shared with pallas-shape's kernel discovery)."""
+    from .core import dotted_name as _dn
     out: List[str] = []
     for arg in call.args:
-        name = dotted_name(arg)
+        name = _dn(arg)
         if name is not None:
             out.append(name)
         elif isinstance(arg, ast.Call) and call_name(arg) in (
                 "functools.partial", "partial"):
-            inner = arg.args and dotted_name(arg.args[0])
+            inner = arg.args and _dn(arg.args[0])
             if inner:
                 out.append(inner)
     return out
 
 
-def _collect_traced_roots(tree: ast.Module) -> Set[str]:
-    """Function names that reach a trace entry point in this module."""
-    roots: Set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and call_name(node) in _TRACE_ENTRY:
-            roots.update(_named_function_args(node))
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in node.decorator_list:
-                dec_call = dec.func if isinstance(dec, ast.Call) else dec
-                if dotted_name(dec_call) in _TRACE_ENTRY:
-                    roots.add(node.name)
-    return roots
-
-
-def _function_index(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
-    """Every (possibly nested) def in the module by bare name — last
-    definition wins, which matches runtime shadowing."""
-    index: Dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            index[node.name] = node
-    return index
-
-
-def _called_names(fn: ast.FunctionDef) -> Set[str]:
-    out: Set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            name = call_name(node)
-            if name and "." not in name:
-                out.add(name)
-    return out
-
-
-def _expand_reachable(roots: Set[str],
-                      index: Dict[str, ast.FunctionDef]) -> Set[str]:
-    seen: Set[str] = set()
-    frontier = [r for r in roots if r in index]
-    while frontier:
-        name = frontier.pop()
-        if name in seen:
-            continue
-        seen.add(name)
-        for callee in _called_names(index[name]):
-            if callee in index and callee not in seen:
-                frontier.append(callee)
-    return seen
-
-
-def _own_body_nodes(fn: ast.FunctionDef) -> List[ast.AST]:
+def _own_body_nodes(fn: ast.AST) -> List[ast.AST]:
     """All nodes of ``fn`` excluding nested function subtrees — nested
     defs are analyzed on their own when they are traced/reached, so
     walking them here would double-report."""
@@ -150,7 +99,7 @@ def _own_body_nodes(fn: ast.FunctionDef) -> List[ast.AST]:
     return out
 
 
-def _check_body(fn: ast.FunctionDef, info: ModuleInfo,
+def _check_body(fn: ast.AST, info: ModuleInfo,
                 findings: List[Finding]) -> None:
     for node in _own_body_nodes(fn):
         if isinstance(node, ast.Call):
@@ -199,12 +148,25 @@ def _check_body(fn: ast.FunctionDef, info: ModuleInfo,
                 hint="return the value instead of mutating outer state"))
 
 
-def check(info: ModuleInfo) -> List[Finding]:
-    roots = _collect_traced_roots(info.tree)
-    if not roots:
+def check(info: ModuleInfo,
+          project: Optional[Project] = None) -> List[Finding]:
+    if project is None:
+        # standalone use (unit tests, direct checker calls): a
+        # single-module project reproduces the pre-v2 behavior.  The
+        # project root is recovered so the summary's rel path matches
+        # ``info.path`` exactly.
+        root = info.abspath[: -len(info.path)] if \
+            info.abspath.replace("\\", "/").endswith(info.path) else "."
+        project = build_project(root or ".", [info.abspath],
+                                infos={info.path: info})
+    reached = project.traced_reachable()
+    mine = sorted(q for (m, q) in reached if m == info.path)
+    if not mine:
         return []
-    index = _function_index(info.tree)
+    nodes = function_nodes(info)
     findings: List[Finding] = []
-    for name in sorted(_expand_reachable(roots, index)):
-        _check_body(index[name], info, findings)
+    for qual in mine:
+        fn = nodes.get(qual)
+        if fn is not None:
+            _check_body(fn, info, findings)
     return findings

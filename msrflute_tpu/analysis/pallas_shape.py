@@ -104,7 +104,7 @@ def _reads_ref_value(node: ast.AST, refs: Set[str]) -> bool:
 
 
 def check(info: ModuleInfo) -> List[Finding]:
-    if not _imports_pallas(info.tree):
+    if "pallas" not in info.src or not _imports_pallas(info.tree):
         return []
     consts = module_int_constants(info.tree)
     findings: List[Finding] = []

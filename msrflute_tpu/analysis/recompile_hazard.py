@@ -1,0 +1,238 @@
+"""recompile-hazard — static counterpart of the PR 7 runtime sentinel.
+
+The device-truth layer (``telemetry/xla.py``) catches recompiles when
+they HAPPEN: every post-warmup compile is an event with the leaf-level
+shape diff.  This rule catches the three code shapes that cause them,
+before a chip ever runs:
+
+- **static-arg hazard** — a ``jax.jit(..., static_argnums=/argnames=)``
+  binding whose call site passes a DATA-DERIVED value (``len(...)``,
+  ``.shape[...]``, arithmetic on them, or an enclosing loop variable)
+  in a static position: the static-arg value set is unbounded, so XLA
+  compiles one program per distinct value;
+- **mutable-capture hazard** — a traced body reads ``self.X`` while a
+  host-side method of the same class MUTATES ``self.X``: the traced
+  read is baked at trace time, so the mutation either silently never
+  reaches the compiled program or (for shape-bearing state) forces a
+  retrace per mutation;
+- **shape-derived operand hazard** — an array built with a
+  data-dependent length (``np.zeros((len(xs), ...))``,
+  ``np.empty(n, ...)`` with ``n`` shape-derived) passed DIRECTLY to a
+  jitted call: every distinct length is a new compiled program.  Round
+  operands must come from the closed bucket set (pad to a static
+  capacity), which is exactly what the cohort-bucketing machinery
+  exists for.
+
+Scope: hot-path modules.  Traced-body facts and jitted bindings come
+from the project summaries, so ``self._fn = jax.jit(...)`` method
+dispatch and cross-module imports are covered.  A deliberately small
+static-arg domain (a config-time constant, a bool flag) takes an
+inline ``# flint: disable=recompile-hazard <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (Finding, ModuleInfo, Project, call_name,
+                   dotted_name, function_nodes)
+
+RULE = "recompile-hazard"
+
+_ARRAY_CTORS = {"np.zeros", "np.empty", "np.full", "np.ones",
+                "numpy.zeros", "numpy.empty", "numpy.full", "numpy.ones",
+                "jnp.zeros", "jnp.empty", "jnp.full", "jnp.ones"}
+
+#: self attrs whose mutation is bookkeeping, not program state — the
+#: always-on compile log class of counters
+_CAPTURE_EXEMPT_PREFIXES = ("_",)
+
+
+def _is_data_derived(node: ast.AST, derived: Set[str],
+                     loop_vars: Set[str]) -> bool:
+    """Whether an expression's value varies with data: contains a
+    ``len()`` call, a ``.shape`` read, a name locally bound from one,
+    or an enclosing loop variable."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and call_name(sub) == "len":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+        if isinstance(sub, ast.Name) and (sub.id in derived or
+                                          sub.id in loop_vars):
+            return True
+    return False
+
+
+class _HazardWalk(ast.NodeVisitor):
+    """One function scope: track shape-derived names + loop vars, flag
+    hazardous jitted call sites."""
+
+    def __init__(self, info: ModuleInfo, static_jit: Dict[str, Dict],
+                 jit_callables: Set[str], findings: List[Finding]):
+        self.info = info
+        self.static_jit = static_jit
+        self.jit_callables = jit_callables
+        self.findings = findings
+        self.derived: Set[str] = set()
+        self.loop_vars: Set[str] = set()
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested scopes walk on their own
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        if _is_data_derived(node.value, self.derived, self.loop_vars):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.derived.add(tgt.id)
+        else:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.derived.discard(tgt.id)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        var = node.target.id if isinstance(node.target, ast.Name) else None
+        if var:
+            self.loop_vars.add(var)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        if var:
+            self.loop_vars.discard(var)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if name is not None:
+            spec = self.static_jit.get(name)
+            if spec is not None:
+                self._check_static_args(node, name, spec)
+            if name in self.jit_callables or spec is not None:
+                self._check_operand_shapes(node, name)
+        self.generic_visit(node)
+
+    def _check_static_args(self, node: ast.Call, name: str,
+                           spec: Dict) -> None:
+        for pos in spec.get("argnums", []):
+            if pos < len(node.args) and _is_data_derived(
+                    node.args[pos], self.derived, self.loop_vars):
+                self.findings.append(Finding(
+                    RULE, self.info.path, node.lineno,
+                    f"data-derived value "
+                    f"`{ast.unparse(node.args[pos])}` in static arg "
+                    f"{pos} of `{name}` — one XLA compile per distinct "
+                    "value",
+                    hint="static args must range over a small closed "
+                         "set (config constants); pass data as a "
+                         "traced operand or pad to a static capacity"))
+        for kw in node.keywords:
+            if kw.arg in spec.get("argnames", []) and _is_data_derived(
+                    kw.value, self.derived, self.loop_vars):
+                self.findings.append(Finding(
+                    RULE, self.info.path, node.lineno,
+                    f"data-derived value `{ast.unparse(kw.value)}` in "
+                    f"static arg `{kw.arg}` of `{name}` — one XLA "
+                    "compile per distinct value",
+                    hint="static args must range over a small closed "
+                         "set (config constants); pass data as a "
+                         "traced operand or pad to a static capacity"))
+
+    def _check_operand_shapes(self, node: ast.Call, name: str) -> None:
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Call) and \
+                    call_name(arg) in _ARRAY_CTORS and arg.args and \
+                    _is_data_derived(arg.args[0], self.derived,
+                                     self.loop_vars):
+                self.findings.append(Finding(
+                    RULE, self.info.path, node.lineno,
+                    f"operand `{ast.unparse(arg)}` of jitted `{name}` "
+                    "has a data-dependent shape — every distinct "
+                    "length compiles a new program",
+                    hint="pad to a static capacity from the closed "
+                         "bucket set (data/batching.py) so the "
+                         "compiled-shape set stays closed"))
+
+
+def _mutable_capture(info: ModuleInfo, project: Project,
+                     findings: List[Finding]) -> None:
+    mod = project.modules.get(info.path)
+    if mod is None:
+        return
+    traced = {q for (m, q) in project.traced_reachable()
+              if m == info.path}
+    if not traced:
+        return
+    # class -> attrs mutated by HOST-side methods (not __init__, not
+    # traced, not private bookkeeping)
+    writes: Dict[str, Dict[str, str]] = {}
+    for qual, fn in mod.functions.items():
+        if fn.cls is None or qual in traced or fn.name == "__init__" or \
+                fn.name.startswith("_build"):
+            continue
+        for attr in fn.self_writes:
+            if attr.startswith(_CAPTURE_EXEMPT_PREFIXES):
+                continue
+            writes.setdefault(fn.cls, {}).setdefault(attr, fn.name)
+    for qual in sorted(traced):
+        fn = mod.functions.get(qual)
+        if fn is None or fn.cls is None:
+            continue
+        cls_writes = writes.get(fn.cls, {})
+        flagged: Set[str] = set()
+        for attr in fn.self_reads:
+            if attr in cls_writes and attr not in flagged and \
+                    attr not in fn.self_writes:
+                flagged.add(attr)
+                findings.append(Finding(
+                    RULE, info.path, fn.line,
+                    f"traced `{fn.name}` closes over `self.{attr}`, "
+                    f"which `{fn.cls}.{cls_writes[attr]}` mutates "
+                    "host-side — the traced read is baked at trace "
+                    "time",
+                    hint="thread the value through the call as an "
+                         "operand (data) or a rebuild-triggering "
+                         "config (static), never mutable self state"))
+
+
+def check(info: ModuleInfo,
+          project: Optional[Project] = None) -> List[Finding]:
+    if not info.is_hot_path:
+        return []
+    findings: List[Finding] = []
+    mod = project.modules.get(info.path) if project else None
+    static_jit = dict(mod.static_jit) if mod else {}
+    jit_callables: Set[str] = set(mod.jit_names) if mod else set()
+    jit_callables |= {"self." + a for a in (mod.jit_attrs if mod else [])}
+    if project is not None:
+        jit_callables |= project.imported_jit_names(info.path)
+        # an IMPORTED static-arg jit binding carries its spec across
+        # the module boundary — the unbounded-compile hazard must not
+        # go silent exactly when the call graph was built to see it
+        if mod is not None:
+            for local, (target, attr) in mod.imports.items():
+                if attr is None:
+                    continue
+                target_mod = project.modules.get(target)
+                if target_mod is not None and \
+                        attr in target_mod.static_jit and \
+                        local not in static_jit:
+                    static_jit[local] = target_mod.static_jit[attr]
+    # summaries key self-attr statics as "self.<attr>"; scope walks see
+    # the same spelling via dotted_name, so the dict lines up
+    traced_quals: Set[str] = set()
+    if project is not None:
+        traced_quals = {q for (m, q) in project.traced_reachable()
+                        if m == info.path}
+    nodes = function_nodes(info)
+    for qual, fn_node in sorted(nodes.items()):
+        if qual in traced_quals:
+            continue  # calls INSIDE a trace re-trace anyway
+        walker = _HazardWalk(info, static_jit, jit_callables, findings)
+        for stmt in fn_node.body:
+            walker.visit(stmt)
+    if project is not None:
+        _mutable_capture(info, project, findings)
+    return findings

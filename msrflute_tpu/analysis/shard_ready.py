@@ -1,0 +1,158 @@
+"""shard-ready — cohort-axis host logic that breaks under `shard_map`.
+
+ROADMAP item 1 shards the cohort (client) axis of the round program
+across a device mesh.  Everything that is *sharding-oblivious* — vmap
+over the leading axis, psum'd reductions, masked static-shape math —
+survives that move untouched.  What does NOT survive is host Python
+that reasons about the leading client dimension of a DEVICE value:
+
+- ``for c in device_value:`` — host iteration over the leading axis
+  materializes one element per step (a transfer each) and sees only the
+  LOCAL shard once the axis is sharded;
+- ``device_value[i]`` inside a host loop over ``range(...)`` — the same
+  per-client indexing spelled with an index variable;
+- ``if x.shape[0] ...`` / ``while x.shape[0] ...`` inside a TRACED body
+  — a cohort-geometry branch: under ``shard_map`` the traced leading
+  dim is the per-shard K, not the global cohort, so the branch silently
+  changes meaning (and each distinct K compiles its own side).
+
+Scope: ``engine/`` and ``strategies/`` modules — the code that owns the
+cohort axis.  Device taint reuses the host-sync tracker (jnp/jax.random
+results, jitted-binding results incl. cross-module imports); host
+values fetched through ``jax.device_get`` are clear, so the ubiquitous
+"loop over fetched numpy stats" pattern never flags.
+
+Traced-body detection comes from the project call graph
+(``Project.traced_reachable()``), so a branch helper called from a
+traced body in ANOTHER module is still judged traced.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import (Finding, ModuleInfo, Project, call_name,
+                   function_nodes)
+from .host_sync import _collect_jitted_bindings, _ScopeTaint
+
+RULE = "shard-ready"
+
+_SCOPE_PARTS = ("engine", "strategies")
+
+
+def _in_scope(info: ModuleInfo) -> bool:
+    parts = info.path.split("/")
+    return any(p in parts for p in _SCOPE_PARTS)
+
+
+class _ShardWalk(_ScopeTaint):
+    """Taint-aware walk flagging host iteration/indexing over device
+    values.  Inherits the host-sync taint rules but emits none of its
+    findings (they are host-sync's business)."""
+
+    def __init__(self, info: ModuleInfo, jit_names, jit_attrs,
+                 findings: List[Finding]):
+        super().__init__(info, jit_names, jit_attrs, [])
+        self.out = findings
+        self.range_vars: List[str] = []
+
+    # host-sync's flags are suppressed; only taint propagation remains
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        tainted_iter = self.is_tainted(node.iter)
+        if tainted_iter:
+            self.out.append(Finding(
+                RULE, self.info.path, node.lineno,
+                f"host iteration over device value "
+                f"`{ast.unparse(node.iter)}` walks the leading (client) "
+                "axis on the host",
+                hint="this pays a transfer per element today and sees "
+                     "only the local shard under a mesh-sharded client "
+                     "axis — vmap/scan over the axis on device, or "
+                     "jax.device_get the whole array first"))
+        self._bind(node.target, tainted_iter)
+        is_range = isinstance(node.iter, ast.Call) and \
+            call_name(node.iter) == "range"
+        var = node.target.id if isinstance(node.target, ast.Name) else None
+        if is_range and var:
+            self.range_vars.append(var)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        if is_range and var:
+            self.range_vars.pop()
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, ast.Load) and \
+                isinstance(node.slice, ast.Name) and \
+                node.slice.id in self.range_vars and \
+                self.is_tainted(node.value):
+            self.out.append(Finding(
+                RULE, self.info.path, node.lineno,
+                f"host per-client indexing "
+                f"`{ast.unparse(node)}` into a device value inside a "
+                "loop",
+                hint="a device gather (`x[ids]`) or vmap keeps the "
+                     "cohort axis on device; host indexing pays a "
+                     "transfer per client and breaks when the axis is "
+                     "sharded"))
+        self.generic_visit(node)
+
+
+def _check_traced_branches(info: ModuleInfo, traced_quals: Set[str],
+                           findings: List[Finding]) -> None:
+    """``.shape[0]``-conditioned if/while tests inside traced bodies."""
+    nodes = function_nodes(info)
+    for qual in sorted(traced_quals):
+        fn = nodes.get(qual)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Subscript) and \
+                        isinstance(sub.value, ast.Attribute) and \
+                        sub.value.attr == "shape" and \
+                        isinstance(sub.slice, ast.Constant) and \
+                        sub.slice.value == 0:
+                    findings.append(Finding(
+                        RULE, info.path, node.lineno,
+                        f"traced `{fn.name}` branches on "
+                        f"`{ast.unparse(sub)}` — under a mesh-sharded "
+                        "client axis the traced leading dim is the "
+                        "per-shard count, not the cohort",
+                        hint="make the behavior a data operand (mask / "
+                             "capacity scalar) instead of trace-time "
+                             "cohort geometry"))
+                    break
+
+
+def check(info: ModuleInfo,
+          project: Optional[Project] = None) -> List[Finding]:
+    if not _in_scope(info):
+        return []
+    findings: List[Finding] = []
+    summary = project.modules.get(info.path) if project else None
+    if summary is not None:
+        jit_names = set(summary.jit_names) | \
+            project.imported_jit_names(info.path)
+        jit_attrs = set(summary.jit_attrs)
+    else:
+        jit_names, jit_attrs = _collect_jitted_bindings(info.tree)
+    traced_quals: Set[str] = set()
+    if project is not None:
+        traced_quals = {q for (m, q) in project.traced_reachable()
+                        if m == info.path}
+    nodes = function_nodes(info)
+    for qual, fn_node in sorted(nodes.items()):
+        if qual in traced_quals:
+            continue  # traced bodies: geometry rules below, not taint
+        walker = _ShardWalk(info, jit_names, jit_attrs, findings)
+        for stmt in fn_node.body:
+            walker.visit(stmt)
+    _check_traced_branches(info, traced_quals, findings)
+    return findings

@@ -1,0 +1,114 @@
+"""transfer-budget — the one-fetch-per-round invariant, on the graph.
+
+A faithful round pays ONE explicit ``jax.device_get`` per dtype group
+(the flatpack packed-stats fetch) and nothing else crosses the
+device->host boundary per round.  host-sync polices the *implicit*
+syncs inside one module; this rule proves the *explicit* budget along
+the actual round paths:
+
+1. **round roots** — engine functions whose name matches
+   :data:`ROUND_ROOT_RE` (``_drain_chunk``, ``_run_scaffold_round``,
+   ``run_round``...): the entry points the per-round loop drives;
+2. the project call graph is closed from each root, pruning callees
+   whose name matches :data:`BOUNDARY_RE` — the eval/checkpoint-cadence
+   functions whose fetches are sanctioned at their own (non-per-round)
+   boundaries;
+3. every function on a round path is held to the budget:
+
+   - **split fetch** — more than one ``device_get`` site in one
+     round-path function: each extra site is a transfer that a single
+     bundled ``jax.device_get((a, b, c))`` would have amortized;
+   - **loop fetch** — a ``device_get`` lexically inside a loop on a
+     round path: one transfer PER ITERATION, the per-client fetch
+     pattern the flatpack discipline exists to kill.
+
+A deliberate second fetch (a value needed BEFORE the tail's bundle can
+form, e.g. the scaffold weights feeding the control update) takes an
+inline ``# flint: disable=transfer-budget <reason>`` naming the data
+dependency.
+
+Limitations (by design): value-flow through containers
+(``chunk["stats"].fetch()``) is unresolvable statically — the packed
+fetch that IS the budget lives behind exactly that pattern, which is
+fine: the rule bounds the *extra* fetches around it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Set, Tuple
+
+from .core import Finding, Project
+
+RULE = "transfer-budget"
+
+#: engine functions that anchor a per-round path
+ROUND_ROOT_RE = re.compile(r"(^|_)(run|drain)_?\w*?(round|chunk|tail)",
+                           re.I)
+#: callees NOT on the per-round cadence (their own budgets apply at
+#: their own boundaries): eval, checkpoint/persistence, prediction
+#: dumps, replay, setup/teardown
+BOUNDARY_RE = re.compile(
+    r"(eval|checkpoint|ckpt|scorecard|predict|dump|replay|fall_back|"
+    r"per_user|snapshot|save|load|close|finish|setup|init|flush)", re.I)
+
+#: round roots live in engine modules
+_ROOT_PARTS = ("engine",)
+#: budget applies to hot-path modules reached from a root
+_SCOPE_PARTS = ("engine", "strategies", "robust", "telemetry", "ops")
+
+
+def _has_part(path: str, parts: Tuple[str, ...]) -> bool:
+    segs = path.split("/")
+    return any(p in segs for p in parts)
+
+
+def check_project(project: Project,
+                  emit_paths: Optional[Set[str]] = None
+                  ) -> List[Finding]:
+    roots = []
+    for path, mod in project.modules.items():
+        if not _has_part(path, _ROOT_PARTS):
+            continue
+        for qual, fn in mod.functions.items():
+            if ROUND_ROOT_RE.search(fn.name) and \
+                    not BOUNDARY_RE.search(fn.name):
+                roots.append((path, qual))
+    if not roots:
+        return []
+    parents = project.reachable_from(sorted(roots), stop=BOUNDARY_RE)
+
+    findings: List[Finding] = []
+    for key in sorted(parents):
+        fn = project.function(key)
+        if fn is None or not _has_part(fn.module, _SCOPE_PARTS):
+            continue
+        if emit_paths is not None and fn.module not in emit_paths:
+            continue
+        chain = project.call_path(parents, key)
+        via = f" (round path: {' -> '.join(chain)})" if len(chain) > 1 \
+            else ""
+        loop_gets = [g for g in fn.device_gets if g[2]]
+        flat_gets = [g for g in fn.device_gets if not g[2]]
+        for line, arg, _ in loop_gets:
+            findings.append(Finding(
+                RULE, fn.module, line,
+                f"device_get of `{arg}` inside a loop in round-path "
+                f"function `{fn.qual}` — one transfer per iteration"
+                + via,
+                hint="hoist the fetch out of the loop: device_get the "
+                     "whole array/tree once and index on host (the "
+                     "flatpack single-transfer discipline)"))
+        if len(flat_gets) > 1:
+            for line, arg, _ in flat_gets[1:]:
+                findings.append(Finding(
+                    RULE, fn.module, line,
+                    f"round-path function `{fn.qual}` pays "
+                    f"{len(flat_gets)} explicit fetches — "
+                    f"`device_get({arg})` splits the round's transfer "
+                    "budget" + via,
+                    hint="bundle the values into the function's first "
+                         "fetch (`jax.device_get((a, b, ...))` is one "
+                         "transfer) or suppress with the data "
+                         "dependency that forces the ordering"))
+    return findings

@@ -396,8 +396,8 @@ class RoundEngine:
                 raise ValueError(
                     f"cohort_bucketing does not compose with "
                     f"{type(strategy).__name__}: pairwise-mask cohorts "
-                    "(secure aggregation) need every pairmate in one "
-                    "grid for mask cancellation — drop cohort_bucketing")
+                    "(secure_agg) need every pairmate in one grid for "
+                    "mask cancellation — drop cohort_bucketing")
             if not self.input_staging:
                 raise ValueError(
                     "cohort_bucketing requires input_staging (the "

@@ -1739,7 +1739,11 @@ class OptimizationServer:
         self.state = self.engine.apply_custom_weights(self.state, pgs, ws,
                                                       server_lr)
 
-        ws_np = np.asarray(jax.device_get(ws))
+        # ONE bundled fetch for everything that exists at collect time
+        # (weights + stats + losses); c_norm is PRODUCED by the control
+        # update below, so it cannot ride this bundle
+        ws_np, stats_np, tls_np = jax.device_get((ws, stats, tls))
+        ws_np = np.asarray(ws_np)
         epochs = int(self.config.client_config.get("num_epochs", 1) or 1)
         # real local steps per client: steps with >= 1 real sample, per epoch
         steps = (batch.sample_mask.sum(axis=2) > 0).sum(axis=1) * epochs
@@ -1753,8 +1757,14 @@ class OptimizationServer:
             c_norm = self.scaffold_device.update(
                 batch.client_ids, steps, pgs, ws, ws_np, client_lr,
                 total_clients=len(self.train_dataset))
+            # the device branch's `‖c‖` only exists after the update —
+            # a post-bundle scalar fetch is the price of keeping the
+            # [K, n_params] control math on device
+            # flint: disable=transfer-budget c_norm is produced by the control update, after the tail bundle
+            c_norm = jax.device_get(c_norm)
         else:
             # ---- host-side control update (exact per-client math) ----
+            # flint: disable=transfer-budget host-control branch only; bundling pgs would fetch [K, n_params] on the device branch too
             pgs_np = jax.device_get(pgs)
             k = len(batch.client_ids)
             # [K, n_params] in ravel_pytree order: tree.leaves order, each
@@ -1768,15 +1778,12 @@ class OptimizationServer:
                 weights=ws_np)
             c_norm = float(np.linalg.norm(self.scaffold_store.c))
 
-        # ONE fetch for the whole host tail (stats + losses + the device
-        # branch's control norm — device_get passes the host branch's
-        # python float through untouched); separate per-value pulls paid
-        # a transfer each.  The -1 sentinel stays in place until
-        # _round_housekeeping commits the marker AFTER the paired model
-        # checkpoint is durable — resume keeps the controls whenever a
-        # matching checkpoint exists and resets only on a crash inside
-        # the round window
-        stats_np, tls_np, c_norm = jax.device_get((stats, tls, c_norm))
+        # the tail below reads only the bundled fetch from collect time.
+        # The -1 sentinel stays in place until _round_housekeeping
+        # commits the marker AFTER the paired model checkpoint is
+        # durable — resume keeps the controls whenever a matching
+        # checkpoint exists and resets only on a crash inside the round
+        # window
         self._process_privacy_stats(stats_np, round_no,
                                     client_mask=batch.client_mask)
         tls_np = np.asarray(tls_np)
@@ -1787,8 +1794,9 @@ class OptimizationServer:
         log_metric("Control norm (server c)", float(c_norm),
                    step=round_no)  # latest-checkpoint save: housekeeping
         if self.scope is not None:
-            # host-side bus publish: c_norm came through the bundled
-            # single fetch above — a counter sample, not a new transfer
+            # host-side bus publish of the already-fetched c_norm (the
+            # device branch's post-update scalar fetch, or the host
+            # branch's python float) — a counter sample, no new transfer
             self.scope.devbus_host("scaffold_c_norm", float(c_norm),
                                    step=round_no)
 
@@ -1855,7 +1863,10 @@ class OptimizationServer:
         self.state = self.engine.apply_custom_weights(self.state, q_tree,
                                                       ws, server_lr)
 
-        ws_np = np.asarray(jax.device_get(ws))
+        # ONE bundled fetch for the EF tail (weights + stats + losses —
+        # the same single-transfer discipline as the scaffold round)
+        ws_np, stats_np, tls_np = jax.device_get((ws, stats, tls))
+        ws_np = np.asarray(ws_np)
         if self.ef_device is not None:
             # new_res and ws stay on device; the scatter gates on
             # participation (id >= 0, w > 0) in-program
@@ -1864,12 +1875,10 @@ class OptimizationServer:
             # dropped clients (w == 0) contributed nothing: their residual
             # must not absorb this round's uncompressed payload
             keep = (np.asarray(batch.client_ids) >= 0) & (ws_np > 0)
-            self.ef_store.update(batch.client_ids,
-                                 np.asarray(jax.device_get(new_res)), keep)
+            # flint: disable=transfer-budget host-store branch only; bundling new_res would fetch the [K, n_params] residual stack on the device branch too
+            new_res_np = np.asarray(jax.device_get(new_res))
+            self.ef_store.update(batch.client_ids, new_res_np, keep)
 
-        # one fetch for the EF tail's stats + losses (same single-
-        # transfer discipline as the scaffold round)
-        stats_np, tls_np = jax.device_get((stats, tls))
         self._process_privacy_stats(stats_np, round_no,
                                     client_mask=batch.client_mask)
         tls_np = np.asarray(tls_np)

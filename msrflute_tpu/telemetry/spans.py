@@ -157,6 +157,7 @@ class Tracer:
                 "name": name, "ph": "X", "ts": round(t0_us, 1),
                 "dur": round(max(dur_us, 0.0), 1),
                 "pid": self._pid, "tid": tid, "args": args})
+            # flint: disable=event-schema events.jsonl record-type tag, not a telemetry event name
             self._jsonl({"kind": "span", "name": name,
                          "ts": round(self._epoch_of(t0_us), 6),
                          "dur_s": round(dur_us / 1e6, 6), **args})
@@ -199,6 +200,7 @@ class Tracer:
             self._append_trace({
                 "name": name, "ph": "i", "s": "p", "ts": round(ts, 1),
                 "pid": self._pid, "tid": tid, "args": args})
+            # flint: disable=event-schema events.jsonl record-type tag, not a telemetry event name
             self._jsonl({"kind": "event", "name": name,
                          "ts": round(self._epoch_of(ts), 6), **args})
 
@@ -211,6 +213,7 @@ class Tracer:
                 "name": name, "ph": "C", "ts": round(ts, 1),
                 "pid": self._pid, "tid": 0,
                 "args": {"value": float(value)}})
+            # flint: disable=event-schema events.jsonl record-type tag, not a telemetry event name
             self._jsonl({"kind": "counter", "name": name,
                          "ts": round(self._epoch_of(ts), 6),
                          "value": float(value), **args})

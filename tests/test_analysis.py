@@ -10,6 +10,7 @@ path-aware (host-sync fires only under ``engine/``/``ops/``/
 import json
 import os
 import textwrap
+import time
 
 import pytest
 
@@ -872,3 +873,908 @@ def test_schema_drift_flags_undocumented_overlap_knobs(tmp_path):
                                             "input_staging"))
     assert [f.rule for f in found] == ["schema-drift"]
     assert "input_staging" in found[0].message
+
+
+# ======================================================================
+# flint v2: shared doc-vs-code fixture layout (schema-drift,
+# guard-matrix, event-schema all read the same project shape)
+# ======================================================================
+def write_tree(tmp_path, files):
+    """One fixture layout for every project-level checker: a dict of
+    repo-relative path -> content, dedented and written under
+    ``tmp_path``."""
+    for rel, content in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(content))
+    return str(tmp_path)
+
+
+#: a minimal consistent project: one guarded block (robust), one host
+#: marker consulted by the predicate, schema strategy check, docs that
+#: match, one emitted+documented event and devbus publisher
+_CONSISTENT = {
+    "msrflute_tpu/schema.py": """\
+        SERVER_KEYS = {'max_iteration', 'robust'}
+        ERR = ("server_config.robust is set but strategy is wrong — "
+               "it plugs into the fedavg combine only; payloads would "
+               "aggregate UNSCREENED")
+        FEDBUFF_ERR = ("server_config.fedbuff is set but strategy is "
+                       "not fedbuff")
+        """,
+    "msrflute_tpu/config.py": """\
+        class ServerConfig:
+            max_iteration: int = 0
+        """,
+    "msrflute_tpu/engine/server.py": """\
+        class Server:
+            def __init__(self, sc, strategy):
+                host_orchestrated = (
+                    sc.get("wantRL", False) or
+                    getattr(strategy, "host_rounds", False))
+                if sc.get("robust") and host_orchestrated:
+                    raise ValueError(
+                        "server_config.robust requires the fused round "
+                        "path — wantRL and scaffold orchestrate rounds "
+                        "host-side")
+        """,
+    "msrflute_tpu/strategies/scaffold.py": """\
+        class Scaffold:
+            host_rounds = True
+        """,
+    "msrflute_tpu/telemetry/metrics.py": """\
+        def log_event(kind, **fields):
+            pass
+
+        def boom():
+            log_event("chaos_faults", round=1)
+        """,
+    "msrflute_tpu/engine/round.py": """\
+        def combine(devbus, agg):
+            devbus.publish("update_ratio", agg)
+        """,
+    "msrflute_tpu/telemetry/watchdog.py": """\
+        class Watchdog:
+            def _fire(self, kind, action):
+                self.on_event(f"watchdog_{kind}", action=action)
+        """,
+    "docs/config_extensions.md": """\
+        # extensions
+
+        ### server_config.robust — screened aggregation
+
+        Requires `strategy: fedavg`.  Incompatible with `wantRL` and
+        `scaffold` (host-orchestrated rounds).
+        """,
+    "docs/observability.md": """\
+        # observability
+
+        Instant events: `chaos_faults`, `watchdog_*`.
+
+        Built-in publishers: `update_ratio`.
+        """,
+    "docs/RUNBOOK.md": "`server_config.robust` is documented here.\n",
+}
+
+
+def _consistent(tmp_path, **overrides):
+    files = dict(_CONSISTENT)
+    files.update(overrides)
+    return write_tree(tmp_path, files)
+
+
+# ======================================================================
+# shard-ready
+# ======================================================================
+def test_shard_ready_flags_iteration_over_device_value(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def walk_clients(xs):
+            dev = jnp.cumsum(xs)
+            total = 0.0
+            for row in dev:
+                total += 1.0
+            return total
+        """, rules=["shard-ready"])
+    assert rules_of(found) == ["shard-ready"]
+    assert "host iteration" in found[0].message
+
+
+def test_shard_ready_flags_indexed_client_loop(tmp_path):
+    found = run_on(tmp_path, "strategies/mod.py", """\
+        import jax.numpy as jnp
+
+        def per_client(xs, k):
+            dev = jnp.sort(xs)
+            out = []
+            for i in range(k):
+                out.append(dev[i])
+            return out
+        """, rules=["shard-ready"])
+    assert rules_of(found) == ["shard-ready"]
+    assert "per-client indexing" in found[0].message
+
+
+def test_shard_ready_flags_shape_branch_in_traced_body(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def body(x):
+            if x.shape[0] > 4:
+                return x * 2
+            return x
+
+        fn = jax.jit(body)
+        """, rules=["shard-ready"])
+    assert rules_of(found) == ["shard-ready"]
+    assert "shape[0]" in found[0].message
+
+
+def test_shard_ready_fetched_numpy_and_python_lists_are_fine(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        def tail(stats_dev, batches):
+            stats = jax.device_get(stats_dev)
+            for row in stats:          # host numpy: fine
+                print(row)
+            for b in batches:          # python list: fine
+                b.close()
+        """, rules=["shard-ready"])
+    assert found == []
+
+
+def test_shard_ready_vmap_width_and_cold_paths_are_fine(tmp_path):
+    # shape[0] as a vmap width / assignment inside a traced body is the
+    # sharding-OBLIVIOUS spelling — only branches flag
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def body(x):
+            k_local = x.shape[0]
+            return x.reshape(k_local, -1).sum(axis=1)
+
+        fn = jax.jit(body)
+        """, rules=["shard-ready"])
+    assert found == []
+    # outside engine/strategies the rule does not apply
+    found = run_on(tmp_path, "tools/mod.py", """\
+        import jax.numpy as jnp
+
+        def probe(xs):
+            dev = jnp.cumsum(xs)
+            return [x for x in dev]
+        """, rules=["shard-ready"])
+    assert found == []
+
+
+# ======================================================================
+# recompile-hazard
+# ======================================================================
+def test_recompile_hazard_flags_data_derived_static_arg(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        step = jax.jit(lambda s, n: s, static_argnums=(1,))
+
+        def round_step(s, xs):
+            n = len(xs)
+            return step(s, n)
+        """, rules=["recompile-hazard"])
+    assert rules_of(found) == ["recompile-hazard"]
+    assert "static arg" in found[0].message
+
+
+def test_recompile_hazard_flags_mutable_capture(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        class Eng:
+            def __init__(self):
+                self.thresholds = {}
+                self._fn = jax.jit(self._body)
+
+            def _body(self, x):
+                return x + self.thresholds["clip"]
+
+            def retune(self, v):
+                self.thresholds = {"clip": v}
+        """, rules=["recompile-hazard"])
+    assert rules_of(found) == ["recompile-hazard"]
+    assert "closes over `self.thresholds`" in found[0].message
+
+
+def test_recompile_hazard_flags_data_dependent_operand_shape(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda g: g)
+
+        def dispatch(clients):
+            return step(np.zeros((len(clients), 4)))
+        """, rules=["recompile-hazard"])
+    assert rules_of(found) == ["recompile-hazard"]
+    assert "data-dependent shape" in found[0].message
+
+
+def test_recompile_hazard_config_constants_are_fine(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        MAX_STEPS = 16
+
+        step = jax.jit(lambda s, n: s, static_argnums=(1,))
+
+        def round_step(s, cfg):
+            return step(s, MAX_STEPS)
+        """, rules=["recompile-hazard"])
+    assert found == []
+
+
+def test_recompile_hazard_frozen_self_state_is_fine(tmp_path):
+    # reads of self state NOBODY mutates after __init__ are the normal
+    # closure pattern (strategy/hparams captured at build)
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        import numpy as np
+
+        class Eng:
+            def __init__(self, hparams):
+                self.hparams = hparams
+                self._fn = jax.jit(self._body)
+
+            def _body(self, x):
+                return x * self.hparams.lr
+
+            def dispatch(self, x):
+                return self._fn(np.zeros((8, 4)) + x)
+        """, rules=["recompile-hazard"])
+    assert found == []
+
+
+# ======================================================================
+# transfer-budget
+# ======================================================================
+def test_transfer_budget_flags_split_fetch_on_round_path(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def _drain_chunk(chunk):
+            stats = jax.device_get(chunk.stats)
+            clip = jax.device_get(chunk.clip)
+            return stats, clip
+        """, rules=["transfer-budget"])
+    assert rules_of(found) == ["transfer-budget"]
+    assert "2 explicit fetches" in found[0].message
+
+
+def test_transfer_budget_flags_loop_fetch_via_call_graph(tmp_path):
+    # the loop fetch lives in a HELPER two calls down from the root —
+    # only the interprocedural closure sees it
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def _pick(items):
+            return [jax.device_get(x) for x in items]
+
+        def _decode(chunk):
+            return _pick(chunk.parts)
+
+        def _run_round(chunk):
+            return _decode(chunk)
+        """, rules=["transfer-budget"])
+    assert rules_of(found) == ["transfer-budget"]
+    assert "per iteration" in found[0].message
+    assert "_run_round" in found[0].message  # the path is named
+
+
+def test_transfer_budget_single_bundle_is_fine(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def _drain_chunk(chunk):
+            stats, tls, norm = jax.device_get(
+                (chunk.stats, chunk.tls, chunk.norm))
+            return stats, tls, norm
+        """, rules=["transfer-budget"])
+    assert found == []
+
+
+def test_transfer_budget_eval_boundary_functions_are_exempt(tmp_path):
+    # fetches in eval/checkpoint-cadence callees have their own budget;
+    # non-round functions are not judged at all
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def _maybe_eval(grids):
+            a = jax.device_get(grids.a)
+            b = jax.device_get(grids.b)
+            return a, b
+
+        def _run_round(chunk, grids):
+            _maybe_eval(grids)
+            return jax.device_get(chunk.stats)
+
+        def cold_tool(x, y):
+            return jax.device_get(x), jax.device_get(y)
+        """, rules=["transfer-budget"])
+    assert found == []
+
+
+def test_transfer_budget_suppression_with_reason(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def _run_round(chunk):
+            ws = jax.device_get(chunk.ws)
+            # flint: disable=transfer-budget ws feeds the control update that produces the tail bundle
+            tail = jax.device_get(chunk.stats)
+            return ws, tail
+        """, rules=["transfer-budget"])
+    assert found == []
+
+
+# ======================================================================
+# guard-matrix
+# ======================================================================
+def test_guard_matrix_consistent_tree_passes(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path)
+    assert check_project(root) == []
+
+
+def test_guard_matrix_flags_unconsulted_host_marker(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/strategies/newthing.py": """\
+            class NewThing:
+                buffered_rounds = True
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "buffered_rounds" in found[0].message
+    assert "host_orchestrated" in found[0].message
+
+
+def test_guard_matrix_flags_undocumented_refusal_token(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    # the runtime guard refuses clients_per_chunk; the docs section
+    # never mentions it
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+                    if sc.get("robust") and host_orchestrated:
+                        raise ValueError(
+                            "server_config.robust requires the fused "
+                            "round path — wantRL and scaffold")
+                    if sc.get("robust") and sc.get("clients_per_chunk"):
+                        raise ValueError(
+                            "server_config.robust is incompatible with "
+                            "clients_per_chunk")
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "clients_per_chunk" in found[0].message
+    assert found[0].path == "docs/config_extensions.md"
+
+
+def test_guard_matrix_flags_unenforced_doc_promise(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`,
+            `scaffold` and `adaptive_clipping`.
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "adaptive_clipping" in found[0].message
+    assert "no runtime guard" in found[0].message
+
+
+def test_guard_matrix_flags_missing_runtime_guard_and_schema(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+            """,
+        "msrflute_tpu/schema.py": """\
+            SERVER_KEYS = {'max_iteration', 'robust'}
+            """})
+    found = check_project(root)
+    msgs = " | ".join(f.message for f in found)
+    assert all(f.rule == "guard-matrix" for f in found)
+    assert "`robust` has no runtime refusal" in msgs
+    assert "no config-load-time strategy check" in msgs
+
+
+# ======================================================================
+# event-schema
+# ======================================================================
+def test_event_schema_consistent_tree_passes(tmp_path):
+    from msrflute_tpu.analysis.event_schema import check_project
+    root = _consistent(tmp_path)
+    assert check_project(root) == []
+
+
+def test_event_schema_flags_undocumented_event(tmp_path):
+    from msrflute_tpu.analysis.event_schema import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/telemetry/metrics.py": """\
+            def log_event(kind, **fields):
+                pass
+
+            def boom():
+                log_event("chaos_faults", round=1)
+                log_event("mystery_meltdown", round=2)
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["event-schema"]
+    assert "mystery_meltdown" in found[0].message
+
+
+def test_event_schema_flags_documented_event_never_emitted(tmp_path):
+    from msrflute_tpu.analysis.event_schema import check_project
+    root = _consistent(tmp_path, **{
+        "docs/observability.md": """\
+            # observability
+
+            Instant events: `chaos_faults`, `ghost_event`, `watchdog_*`.
+
+            Built-in publishers: `update_ratio`.
+            """,
+        "msrflute_tpu/telemetry/watchdog.py": """\
+            class Watchdog:
+                def _fire(self, kind, action):
+                    self.on_event(f"watchdog_{kind}", action=action)
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["event-schema"]
+    assert "ghost_event" in found[0].message
+    assert found[0].path == "docs/observability.md"
+
+
+def test_event_schema_prefix_families_match_globs(tmp_path):
+    from msrflute_tpu.analysis.event_schema import check_project
+    # f"watchdog_{kind}" emission satisfies the documented `watchdog_*`
+    # glob and vice versa
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/telemetry/watchdog.py": """\
+            class Watchdog:
+                def _fire(self, kind, action):
+                    self.on_event(f"watchdog_{kind}", action=action)
+            """})
+    assert check_project(root) == []
+
+
+def test_event_schema_flags_undocumented_devbus_publisher(tmp_path):
+    from msrflute_tpu.analysis.event_schema import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/engine/round.py": """\
+            def combine(devbus, agg):
+                devbus.publish("update_ratio", agg)
+                devbus.publish("secret_metric", agg)
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["event-schema"]
+    assert "secret_metric" in found[0].message
+
+
+def test_event_schema_kind_literal_dicts_are_emissions(tmp_path):
+    from msrflute_tpu.analysis.event_schema import check_project
+    # the xla.py drain-queue pattern: records built as {"kind": ...}
+    # dict literals count as emissions of those names
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/telemetry/xla.py": """\
+            def note_compile(first):
+                return {"kind": "recompile" if not first
+                        else "xla_compile"}
+            """,
+        "docs/observability.md": """\
+            # observability
+
+            Instant events: `chaos_faults`, `xla_compile`, `recompile`,
+            `watchdog_*`.
+
+            Built-in publishers: `update_ratio`.
+            """})
+    assert check_project(root) == []
+
+
+def test_schema_drift_shares_the_fixture_layout(tmp_path):
+    """The three doc-vs-code checkers consume ONE fixture shape: the
+    same write_tree() project drives schema-drift too."""
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/config.py": """\
+            class ServerConfig:
+                max_iteration: int = 0
+                phantom_knob: int = 0
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "phantom_knob" in found[0].message
+
+
+# ======================================================================
+# flint v2 engine: call graph, cycles, method dispatch, caching
+# ======================================================================
+def test_jit_purity_cross_module_chain(tmp_path):
+    """A traced root in module A reaches a helper in module B through
+    an import — the helper's impure call is flagged IN B."""
+    a = tmp_path / "pkg" / "a.py"
+    b = tmp_path / "pkg" / "b.py"
+    a.parent.mkdir(parents=True)
+    b.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def helper(x):
+            return x + np.random.rand()
+        """))
+    a.write_text(textwrap.dedent("""\
+        import jax
+        from .b import helper
+
+        def body(x):
+            return helper(x)
+
+        fn = jax.jit(body)
+        """))
+    found = analyze([str(a), str(b)], root=str(tmp_path),
+                    rules={"jit-purity"})
+    assert rules_of(found) == ["jit-purity"]
+    assert found[0].path == "pkg/b.py"
+    assert "np.random" in found[0].message
+
+
+def test_jit_purity_method_dispatch_via_self_binding(tmp_path):
+    """``self._fn = jax.jit(self._body)``: the method is a traced root
+    resolved through the class."""
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+        import time
+
+        class Eng:
+            def __init__(self):
+                self._fn = jax.jit(self._body)
+
+            def _body(self, x):
+                return x * time.time()
+        """, rules=["jit-purity"])
+    assert rules_of(found) == ["jit-purity"]
+    assert "time.time" in found[0].message
+
+
+def test_call_graph_cycles_terminate(tmp_path):
+    """Mutually recursive traced helpers close without hanging and each
+    impure site reports once."""
+    found = run_on(tmp_path, "mod.py", """\
+        import jax
+
+        def ping(x, n):
+            print("tracing ping")
+            return pong(x, n - 1) if n else x
+
+        def pong(x, n):
+            return ping(x, n - 1) if n else x
+
+        fn = jax.jit(ping)
+        """, rules=["jit-purity"])
+    assert rules_of(found) == ["jit-purity"]
+
+
+def test_host_sync_imported_jit_binding_taints(tmp_path):
+    """A module-level jitted callable IMPORTED from another project
+    module seeds device taint at its call sites (the flint v2
+    cross-module migration)."""
+    step_mod = tmp_path / "engine" / "steps.py"
+    user_mod = tmp_path / "engine" / "user.py"
+    step_mod.parent.mkdir(parents=True)
+    step_mod.write_text(textwrap.dedent("""\
+        import jax
+
+        round_step = jax.jit(lambda s: (s, s.sum()))
+        """))
+    user_mod.write_text(textwrap.dedent("""\
+        from .steps import round_step
+
+        def drain(s):
+            s, norm = round_step(s)
+            return float(norm)
+        """))
+    found = analyze([str(user_mod)], root=str(tmp_path),
+                    project_paths=[str(tmp_path / "engine")],
+                    rules={"host-sync"})
+    assert rules_of(found) == ["host-sync"]
+    assert "float(norm)" in found[0].message
+
+
+def test_summary_cache_recomputes_only_edited_file(tmp_path, monkeypatch):
+    """Disk-cache correctness: a second run recomputes NO summaries; an
+    edit recomputes exactly the edited file's; findings stay identical
+    to a cold run throughout."""
+    import msrflute_tpu.analysis.core as core
+
+    pkg = tmp_path / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "clean.py").write_text("def ok():\n    return 1\n")
+    (pkg / "dirty.py").write_text(textwrap.dedent("""\
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x).item()
+        """))
+
+    computed = []
+    real = core.compute_module_summary
+
+    def counting(info, known=None):
+        computed.append(info.path)
+        return real(info, known)
+
+    monkeypatch.setattr(core, "compute_module_summary", counting)
+
+    def run(cache):
+        monkeypatch.setattr(core, "_SUMMARY_CACHE", {})  # fresh process
+        return core.analyze([str(pkg)], root=str(tmp_path),
+                            cache=cache)
+
+    cache = {}
+    cold = run(cache)
+    assert sorted(computed) == ["engine/clean.py", "engine/dirty.py"]
+    assert rules_of(cold) == ["host-sync"]
+
+    computed.clear()
+    warm = run(cache)
+    assert computed == []            # every summary came from the cache
+    assert warm == cold
+
+    # edit one file: only ITS summary recomputes, findings match a
+    # fresh cold run
+    (pkg / "dirty.py").write_text(textwrap.dedent("""\
+        import jax.numpy as jnp
+
+        def f(x):
+            return float(jnp.sum(x))
+        """))
+    os.utime(pkg / "dirty.py", ns=(time.time_ns(), time.time_ns()))
+    computed.clear()
+    edited = run(cache)
+    assert computed == ["engine/dirty.py"]
+    assert rules_of(edited) == ["host-sync"]
+    assert "float" in edited[0].message
+
+    computed.clear()
+    fresh = run({})                   # cold reference run, no cache
+    assert sorted(computed) == ["engine/clean.py", "engine/dirty.py"]
+    assert [f.baseline_key for f in fresh] == \
+        [f.baseline_key for f in edited]
+
+
+def test_summary_cache_round_trips_through_json(tmp_path):
+    """The disk cache survives serialization: save, reload, reuse."""
+    from msrflute_tpu.analysis.core import (load_summary_cache,
+                                            save_summary_cache)
+    import msrflute_tpu.analysis.core as core
+
+    pkg = tmp_path / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(textwrap.dedent("""\
+        import jax
+
+        def _run_round(chunk):
+            a = jax.device_get(chunk.a)
+            b = jax.device_get(chunk.b)
+            return a, b
+        """))
+    cache = {}
+    first = core.analyze([str(pkg)], root=str(tmp_path), cache=cache)
+    path = tmp_path / "cache.json"
+    save_summary_cache(str(path), cache)
+    reloaded = load_summary_cache(str(path))
+    assert set(reloaded) == {"engine/mod.py"}
+    core._SUMMARY_CACHE.clear()
+    again = core.analyze([str(pkg)], root=str(tmp_path), cache=reloaded)
+    assert [f.baseline_key for f in again] == \
+        [f.baseline_key for f in first]
+    # garbage/old-version cache files degrade to cold, never crash
+    path.write_text("{not json")
+    assert load_summary_cache(str(path)) == {}
+
+
+# ======================================================================
+# suppression hygiene: unknown rules + renames
+# ======================================================================
+def test_unknown_suppression_is_an_error(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        def f(x):
+            # flint: disable=no-such-rule this rule never existed
+            return x
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["unknown-suppression"]
+    assert "no-such-rule" in found[0].message
+
+
+def test_renamed_rule_pragma_errors_with_migration_hint(tmp_path):
+    """A pragma naming a rule through its old (underscore) spelling is
+    an ERROR carrying the new name — never silently inert."""
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax.numpy as jnp
+
+        def f(x):
+            # flint: disable=host_sync summary scalar
+            return jnp.sum(x).item()
+        """, rules=["host-sync"])
+    rules = sorted(rules_of(found))
+    assert "unknown-suppression" in rules
+    assert "host-sync" in rules  # the finding is NOT suppressed
+    unknown = [f for f in found if f.rule == "unknown-suppression"][0]
+    assert "host_sync" in unknown.message
+    assert "host-sync" in unknown.hint
+
+
+# ======================================================================
+# CLI: --format json/sarif with stable ids, --changed incremental mode
+# ======================================================================
+def _bad_file(tmp_path):
+    bad = tmp_path / "engine" / "mod.py"
+    bad.parent.mkdir(parents=True, exist_ok=True)
+    bad.write_text("import jax.numpy as jnp\n"
+                   "def f(x):\n"
+                   "    return jnp.sum(x).item()\n")
+    return bad
+
+
+def test_cli_json_format_carries_stable_ids(tmp_path, capsys):
+    from msrflute_tpu.analysis.__main__ import main
+    bad = _bad_file(tmp_path)
+    assert main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                 "--format", "json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert len(out) == 1
+    assert out[0]["rule"] == "host-sync"
+    first_id = out[0]["id"]
+    assert first_id.startswith("host-sync-")
+    # the id survives the finding moving lines (line-free hash)
+    bad.write_text("\n\n" + bad.read_text())
+    assert main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                 "--format", "json"]) == 1
+    out2 = json.loads(capsys.readouterr().out)
+    assert out2[0]["id"] == first_id
+    assert out2[0]["line"] != out[0]["line"]
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    from msrflute_tpu.analysis.__main__ import main
+    bad = _bad_file(tmp_path)
+    assert main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                 "--format", "sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "fluteguard"
+    result = run["results"][0]
+    assert result["ruleId"] == "host-sync"
+    assert result["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"] == "engine/mod.py"
+    assert result["partialFingerprints"]["flintFindingId/v1"].startswith(
+        "host-sync-")
+
+
+def test_cli_changed_mode_scopes_to_git_diff(tmp_path, capsys):
+    """--changed analyzes only the edited file while the call graph
+    spans the package via the shared summary cache."""
+    import subprocess
+    from msrflute_tpu.analysis.__main__ import main
+
+    pkg = tmp_path / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "steps.py").write_text(
+        "import jax\n\nround_step = jax.jit(lambda s: (s, s.sum()))\n")
+    (pkg / "user.py").write_text(
+        "def f():\n    return 1\n")
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "-C", str(tmp_path), "add", "-A"], check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "-C", str(tmp_path), "commit", "-qm", "seed"],
+                   check=True)
+    # edit user.py to float() the imported jitted callable's result:
+    # only cross-module taint seeding (cached summaries for steps.py)
+    # can see this
+    (pkg / "user.py").write_text(textwrap.dedent("""\
+        from .steps import round_step
+
+        def drain(s):
+            s, norm = round_step(s)
+            return float(norm)
+        """))
+    rc = main(["--root", str(tmp_path), "--changed", "--no-baseline",
+               "--format", "json", str(pkg)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in out] == ["host-sync"]
+    assert out[0]["path"] == "engine/user.py"
+    assert (tmp_path / ".flint_cache.json").exists()
+    # unchanged tree: clean exit, nothing analyzed
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "-C", str(tmp_path), "add", "-A"], check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "-C", str(tmp_path), "commit", "-qm", "fix"],
+                   check=True)
+    rc = main(["--root", str(tmp_path), "--changed", "--no-baseline",
+               "--format", "json", str(pkg)])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
+def test_recompile_hazard_imported_static_jit_binding(tmp_path):
+    """A static_argnums jit binding IMPORTED from another module keeps
+    its spec — the unbounded-compile hazard must not go silent at the
+    module boundary."""
+    steps = tmp_path / "engine" / "steps.py"
+    user = tmp_path / "engine" / "user.py"
+    steps.parent.mkdir(parents=True)
+    steps.write_text(textwrap.dedent("""\
+        import jax
+
+        step = jax.jit(lambda s, n: s, static_argnums=(1,))
+        """))
+    user.write_text(textwrap.dedent("""\
+        from .steps import step
+
+        def round_step(s, xs):
+            return step(s, len(xs))
+        """))
+    found = analyze([str(user)], root=str(tmp_path),
+                    project_paths=[str(tmp_path / "engine")],
+                    rules={"recompile-hazard"})
+    assert rules_of(found) == ["recompile-hazard"]
+    assert "static arg" in found[0].message
+
+
+def test_summary_cache_is_root_scoped(tmp_path):
+    """A cache warmed under a different analysis root is discarded —
+    its entries carry root-relative paths that would misreport."""
+    from msrflute_tpu.analysis.core import (load_summary_cache,
+                                            save_summary_cache)
+    path = tmp_path / "cache.json"
+    save_summary_cache(str(path), {"engine/mod.py": {"stamp": [1, 2]}},
+                       root=str(tmp_path / "a"))
+    assert load_summary_cache(str(path),
+                              root=str(tmp_path / "a")) != {}
+    assert load_summary_cache(str(path),
+                              root=str(tmp_path / "b")) == {}
+
+
+def test_guard_matrix_dropped_block_owes_no_schema_check(tmp_path):
+    """A fork whose schema no longer knows `robust` is not flagged for
+    the missing robust strategy check (SCHEMA_GUARDED honors
+    SERVER_KEYS like the main guarded-block loop)."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": """\
+            SERVER_KEYS = {'max_iteration'}
+            """,
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+            """,
+        "docs/config_extensions.md": "# extensions\n"})
+    assert check_project(root) == []

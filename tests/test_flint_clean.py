@@ -8,8 +8,12 @@ hot-path debt therefore needs either a fix or an inline
 ``# flint: disable=RULE reason`` that survives review; a silent
 baseline append does not ride along.
 
-Budget: the gate must stay trivially cheap (<20 s — it is pure-ast, no
-jax import) so it can sit inside tier-1's wall-clock budget forever.
+Budget: the gate must stay trivially cheap (<20 s — pure-ast, no jax
+import; the flint v2 interprocedural engine adds one summary pass per
+file, mtime-cached in-process) so it can sit inside tier-1's wall-clock
+budget forever.  The timing assertion below IS the budget: a checker
+that regresses the full-tree run past it fails tier-1, not just CI
+vibes.
 """
 
 import os
@@ -37,11 +41,28 @@ def test_package_tree_is_flint_clean_against_committed_baseline():
 
 
 def test_every_checker_is_exercised_by_the_real_tree_or_corpus():
-    """The suite's five rules all exist and are wired into analyze() —
+    """The suite's rules all exist and are wired into analyze() —
     a checker that silently fell out of the registry would leave its
     rule permanently green."""
     from msrflute_tpu.analysis import RULES
 
     for rule in ("host-sync", "donation-aliasing", "jit-purity",
-                 "pallas-shape", "schema-drift"):
+                 "pallas-shape", "put-loop", "schema-drift",
+                 # flint v2: the interprocedural checkers
+                 "shard-ready", "recompile-hazard", "transfer-budget",
+                 "guard-matrix", "event-schema",
+                 # hygiene
+                 "stale-suppression", "bare-suppression",
+                 "unknown-suppression"):
         assert rule in RULES
+
+
+def test_rule_rename_map_targets_live_rules():
+    """Every rename-migration entry must point at a CURRENT rule id —
+    a map entry to a dead rule would 'migrate' pragmas into permanent
+    unknown-suppression errors."""
+    from msrflute_tpu.analysis import RULE_RENAMES, RULES
+
+    for old, new in RULE_RENAMES.items():
+        assert new in RULES, f"{old!r} -> {new!r} (not a rule)"
+        assert old not in RULES, f"rename source {old!r} still a rule"
