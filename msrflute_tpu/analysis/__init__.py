@@ -1,6 +1,6 @@
 """fluteguard — TPU-safety static analysis for msrflute_tpu.
 
-Eleven checkers on one interprocedural engine, one CLI::
+Fifteen checkers on one interprocedural engine, one CLI::
 
     python -m msrflute_tpu.analysis msrflute_tpu/     # or: tools/flint
 
@@ -42,6 +42,22 @@ body's helper in another file, a round path's fetch three calls deep.
   bespoke checks and ``docs/config_extensions.md``.
 - **event-schema**     telemetry event names and devbus publishers
   emitted by the code vs ``docs/observability.md``'s catalogue.
+- **signal-safety**    nothing reachable from a ``signal.signal``
+  handler may acquire a lock, do file IO, log or block (the PR 4
+  telemetry-flush deadlock class); the deferred-flush pattern (work
+  gated on a ``*_from_signal`` flag) is recognized as the blessed fix.
+- **lock-discipline**  consistent lock acquisition order project-wide;
+  no blocking call, file IO or ``device_get`` while holding a hot-path
+  lock (Tracer, dataset cache, checkpoint condition); explicit
+  acquire without release.
+- **thread-escape**    mutable state handed across a thread boundary
+  (``threading.Thread`` roots closed over the call graph) without a
+  snapshot/copy — the PR 1 torn-snapshot class; anonymous ``Thread``
+  spawns in hot paths flag too (telemetry attributes by thread name).
+- **atomic-write**     durable artifacts (checkpoints, scorecard,
+  baseline, status log) must use tmp + ``os.replace`` or hardlink
+  rotation; bare ``open(path, "w")`` and bare ``os.rename`` of a
+  committed slot flag, append-only JSONL streams stay silent.
 
 Static findings pair with a runtime strict mode: under
 ``MSRFLUTE_STRICT_TRANSFERS=1`` the server round loop runs inside a

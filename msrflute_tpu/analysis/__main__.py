@@ -33,10 +33,13 @@ from .core import (Finding, analyze, default_baseline_path,
                    write_baseline)
 
 #: file classes whose change triggers the project-level checkers in
-#: --changed mode (their inputs: docs, schema/config, hot-path modules)
+#: --changed mode (their inputs: docs, schema/config, hot-path modules,
+#: and — for the concurrency rules — anywhere threads/locks/handlers
+#: or durable writes live)
 _PROJECT_TRIGGER_PARTS = ("docs/", "README.md", "schema.py", "config.py",
                           "engine/", "strategies/", "ops/", "telemetry/",
-                          "robust/", "resilience/", "analysis/")
+                          "robust/", "resilience/", "analysis/",
+                          "data/", "rl/", "utils/")
 
 
 def _git_changed_files(root: str, base: Optional[str]
@@ -116,7 +119,8 @@ def main(argv=None) -> int:
                     "(host-sync, donation-aliasing, jit-purity, "
                     "pallas-shape, put-loop, schema-drift, shard-ready, "
                     "recompile-hazard, transfer-budget, guard-matrix, "
-                    "event-schema)")
+                    "event-schema, signal-safety, lock-discipline, "
+                    "thread-escape, atomic-write)")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/dirs to analyze (default: the "
                              "msrflute_tpu package)")

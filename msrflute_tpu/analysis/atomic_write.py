@@ -1,0 +1,131 @@
+"""atomic-write — durable artifacts land whole or not at all.
+
+A checkpoint, scorecard, baseline or status log is read by a DIFFERENT
+process epoch than the one that wrote it (resume after preemption, the
+scope diff gate, the tier-1 lint gate).  A bare ``open(path, "w")``
+truncates the only copy first and fills it back byte by byte — a crash
+(or the PR 3 chaos harness's injected IO fault) anywhere in that window
+leaves a torn artifact that fails checksum verification at best and
+parses as garbage at worst.  The shipped recipes:
+
+- **tmp + replace** — write ``path + ".tmp"`` completely, then
+  ``os.replace(tmp, path)``: the committed generation is never opened
+  for writing (``utils/io.py::update_json_log``, the scorecard, the
+  trace writer, the checkpoint ``_write_blob``).
+- **hardlink rotation** — promoting ``latest`` to ``.prev`` goes
+  ``os.link(src, lnk); os.replace(lnk, dst)`` so the committed slot
+  never disappears; a bare ``os.rename(latest, latest + ".prev")``
+  opens a crash instant with ZERO loadable slots (the PR 3 crash-window
+  class).
+
+Flagged, package-wide: ``open(…, "w"/"wb")`` — and ``os.rename``/
+``os.replace`` SOURCES — whose path text (one level of local-variable
+provenance deep) names a durable artifact and is not a scratch name
+(``.tmp``/``.new``/``.part``/``.lnk``…).  Append-mode streams
+(``events.jsonl``, ``metrics.jsonl``) are incremental by design and
+stay silent; so do writes to paths the rule cannot prove durable —
+the runtime chaos/IO-fault tests are the backstop there.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from .core import Finding, ModuleInfo, call_name, open_mode
+
+RULE = "atomic-write"
+
+#: path text that denotes a durable artifact.  Artifact-ish tokens
+#: only — a bare directory variable (`model_dir`, `out_dir`) must not
+#: mark every file written under it durable; `ckpt`/`checkpoint` DO
+#: stay in the set because anything placed in the checkpoint tree is
+#: resume territory.
+_DURABLE_RE = re.compile(
+    r"status_log|scorecard|baseline|checkpoint|ckpt|latest|best_val|"
+    r"model_name|msgpack|\.ptr\b|sidecar|\.sum\b|stats_name|trace\.json",
+    re.I)
+#: path text that denotes the scratch half of an atomic idiom (or a
+#: cache nobody resumes from)
+_SCRATCH_RE = re.compile(r"tmp|\.new\b|\.part\b|lnk|scratch|cache", re.I)
+
+_HINT_WRITE = ("write the full content to `path + \".tmp\"` and "
+               "`os.replace(tmp, path)` — the committed copy is never "
+               "open for writing (utils/io.py update_json_log is the "
+               "shared recipe); append-only streams use mode \"a\"")
+_HINT_RENAME = ("rotate by hardlink so the committed slot never "
+                "disappears: os.link(src, lnk); os.replace(lnk, dst) "
+                "(checkpoint._write_blob's _rotate), or write the new "
+                "generation to tmp and os.replace over the old")
+
+
+def _path_text(node: ast.AST, local_assigns: Dict[str, str],
+               depth: int = 3) -> str:
+    """Source text of a path expression, following bare local names
+    through their assignments a few levels deep."""
+    try:
+        src = ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return ""
+    seen = 0
+    while depth > seen and re.fullmatch(r"[A-Za-z_]\w*", src.strip()):
+        provenance = local_assigns.get(src.strip())
+        if provenance is None:
+            break
+        src = provenance
+        seen += 1
+    return src
+
+
+def check(info: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, local_assigns: Dict[str, str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                walk(child, {})  # fresh local scope
+                continue
+            if isinstance(child, ast.Assign) and \
+                    len(child.targets) == 1 and \
+                    isinstance(child.targets[0], ast.Name):
+                try:
+                    local_assigns[child.targets[0].id] = \
+                        ast.unparse(child.value)
+                except Exception:  # pragma: no cover
+                    pass
+            if isinstance(child, ast.Call):
+                _check_call(child, local_assigns)
+            walk(child, local_assigns)
+
+    def _check_call(node: ast.Call,
+                    local_assigns: Dict[str, str]) -> None:
+        name = call_name(node)
+        if name == "open" and node.args:
+            mode = open_mode(node)
+            if mode is None or "w" not in mode or "a" in mode:
+                return  # reads and append streams are fine
+            text = _path_text(node.args[0], local_assigns)
+            if _SCRATCH_RE.search(text) or not _DURABLE_RE.search(text):
+                return
+            findings.append(Finding(
+                RULE, info.path, node.lineno,
+                f"bare open({text!r}, {mode!r}) on a durable artifact "
+                "truncates the committed copy before the new content "
+                "is complete — a crash mid-write leaves a torn file",
+                hint=_HINT_WRITE))
+        elif name in ("os.rename", "os.replace") and len(node.args) >= 2:
+            src_text = _path_text(node.args[0], local_assigns)
+            if _SCRATCH_RE.search(src_text) or \
+                    not _DURABLE_RE.search(src_text):
+                return
+            findings.append(Finding(
+                RULE, info.path, node.lineno,
+                f"{name}({src_text!r}, …) moves the committed durable "
+                "copy away — between this and the replacement landing "
+                "there is a crash instant with no loadable slot at all",
+                hint=_HINT_RENAME))
+
+    walk(info.tree, {})
+    return findings

@@ -38,11 +38,25 @@ from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 #: packed-stats ride-along into per-scalar transfers.
 HOT_PATH_PARTS = ("engine", "ops", "strategies", "telemetry", "robust")
 
+#: the concurrency rules' wider scope: everything above plus the layers
+#: that own threads, locks and durable writes — the resilience handlers
+#: and the data-cache/user-blob locks.  One tuple, shared by
+#: lock-discipline and thread-escape, so a future package (fleet/?)
+#: joins every concurrency checker with one edit.
+CONC_HOT_PARTS = HOT_PATH_PARTS + ("resilience", "data")
+
+
+def conc_hot_path(path: str) -> bool:
+    segs = path.split("/")
+    return any(p in segs for p in CONC_HOT_PARTS)
+
 #: every rule id the suite can emit.  Lives here (not __init__) so the
 #: suppression linter can judge pragma validity without an import cycle.
 RULES = ("host-sync", "donation-aliasing", "jit-purity", "pallas-shape",
          "put-loop", "schema-drift", "shard-ready", "recompile-hazard",
          "transfer-budget", "guard-matrix", "event-schema",
+         "signal-safety", "lock-discipline", "thread-escape",
+         "atomic-write",
          "stale-suppression", "bare-suppression", "unknown-suppression",
          "parse-error")
 
@@ -63,6 +77,10 @@ RULE_RENAMES = {
     "transfer_budget": "transfer-budget",
     "guard_matrix": "guard-matrix",
     "event_schema": "event-schema",
+    "signal_safety": "signal-safety",
+    "lock_discipline": "lock-discipline",
+    "thread_escape": "thread-escape",
+    "atomic_write": "atomic-write",
 }
 
 #: factories whose RESULT is a compiled callable — shared by host-sync
@@ -269,10 +287,15 @@ def write_baseline(path: str, findings: Iterable[Finding]) -> None:
     entries = [{"rule": f.rule, "path": f.path, "line": f.line,
                 "message": f.message} for f in findings]
     entries.sort(key=lambda e: (e["path"], e["rule"], e["line"]))
-    with open(path, "w", encoding="utf-8") as fh:
+    # tmp + replace: the committed baseline is a durable artifact — a
+    # crash mid-write must not leave a torn JSON that makes
+    # every later run fail to parse it (the atomic-write discipline)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump({"version": 1, "entries": entries}, fh, indent=2,
                   sort_keys=True)
         fh.write("\n")
+    os.replace(tmp, path)
 
 
 def filter_baseline(findings: List[Finding],
@@ -369,6 +392,25 @@ class FunctionSummary:
     #: mutable-capture cross-check)
     self_reads: List[str] = field(default_factory=list)
     self_writes: List[str] = field(default_factory=list)
+    # -- concurrency fact layer (signal-safety / lock-discipline /
+    # -- thread-escape ride these; see the module comment) -------------
+    #: lock-held regions: (lock id, start line, end line) from ``with``
+    #: statements whose context expression names a lock/condition
+    lock_regions: List[Tuple[str, int, int]] = field(default_factory=list)
+    #: concurrency-relevant operations: (kind, line, detail); kind one of
+    #: lock-acquire / lock-release / file-io / log / blocking-join /
+    #: blocking-wait / blocking-sleep
+    conc_ops: List[Tuple[str, int, str]] = field(default_factory=list)
+    #: line spans of ``if`` statements whose test names a
+    #: ``*_from_signal``-style flag — the deferred-flush idiom
+    #: signal-safety blesses (work gated on the flag runs outside
+    #: signal context)
+    deferred_spans: List[Tuple[int, int]] = field(default_factory=list)
+    #: direct ``self.X = <expr>`` assignments: (attr, line, value src)
+    self_assigns: List[Tuple[str, int, str]] = field(default_factory=list)
+    #: simple local ``name = <expr>`` bindings (last wins) — one level
+    #: of value provenance for thread-escape's snapshot check
+    local_assigns: Dict[str, str] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"module": self.module, "qual": self.qual,
@@ -376,7 +418,12 @@ class FunctionSummary:
                 "calls": [list(c) for c in self.calls],
                 "device_gets": [list(d) for d in self.device_gets],
                 "self_reads": self.self_reads,
-                "self_writes": self.self_writes}
+                "self_writes": self.self_writes,
+                "lock_regions": [list(r) for r in self.lock_regions],
+                "conc_ops": [list(o) for o in self.conc_ops],
+                "deferred_spans": [list(s) for s in self.deferred_spans],
+                "self_assigns": [list(a) for a in self.self_assigns],
+                "local_assigns": self.local_assigns}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FunctionSummary":
@@ -385,7 +432,12 @@ class FunctionSummary:
                    [tuple(c) for c in d.get("calls", [])],
                    [tuple(g) for g in d.get("device_gets", [])],
                    list(d.get("self_reads", [])),
-                   list(d.get("self_writes", [])))
+                   list(d.get("self_writes", [])),
+                   [tuple(r) for r in d.get("lock_regions", [])],
+                   [tuple(o) for o in d.get("conc_ops", [])],
+                   [tuple(s) for s in d.get("deferred_spans", [])],
+                   [tuple(a) for a in d.get("self_assigns", [])],
+                   dict(d.get("local_assigns", {})))
 
 
 @dataclass
@@ -421,6 +473,14 @@ class ModuleSummary:
     events: List[Tuple[str, int, str]] = field(default_factory=list)
     #: devbus publishes: (metric name, line, publish|devbus_host)
     devbus: List[Tuple[str, int, str]] = field(default_factory=list)
+    #: thread spawns: (target ref as written or "", line, has name= kw,
+    #: enclosing class or None, enclosing function qual or "")
+    thread_spawns: List[Tuple[str, int, bool, Optional[str], str]] = \
+        field(default_factory=list)
+    #: ``signal.signal(sig, handler)`` registrations:
+    #: (handler ref as written, line, enclosing class or None)
+    signal_handlers: List[Tuple[str, int, Optional[str]]] = \
+        field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -436,6 +496,8 @@ class ModuleSummary:
             "class_markers": self.class_markers,
             "events": [list(e) for e in self.events],
             "devbus": [list(d) for d in self.devbus],
+            "thread_spawns": [list(t) for t in self.thread_spawns],
+            "signal_handlers": [list(h) for h in self.signal_handlers],
         }
 
     @classmethod
@@ -457,6 +519,10 @@ class ModuleSummary:
                              for k, v in d.get("class_markers", {}).items()}
         out.events = [(e[0], e[1], e[2]) for e in d.get("events", [])]
         out.devbus = [(e[0], e[1], e[2]) for e in d.get("devbus", [])]
+        out.thread_spawns = [(t[0], t[1], bool(t[2]), t[3], t[4])
+                             for t in d.get("thread_spawns", [])]
+        out.signal_handlers = [(h[0], h[1], h[2])
+                               for h in d.get("signal_handlers", [])]
         return out
 
 
@@ -464,6 +530,49 @@ _EVENT_APIS = {"log_event": 0, "emit_event": 1}
 _DEVGET_NAMES = ("jax.device_get", "device_get")
 _LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
                ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+# -- concurrency fact layer --------------------------------------------
+#: a with-statement context expression whose final name segment matches
+#: this is treated as a lock acquisition (threading.Lock / RLock /
+#: Condition / Semaphore attribute naming conventions)
+_LOCK_NAME_RE = re.compile(r"(lock|cond|mutex|sem)", re.I)
+#: an ``if`` test naming one of these flags marks its body as DEFERRED
+#: out of signal context — the blessed deferred-flush idiom (the
+#: handler sets a flag; the loop's next poll does the unsafe work)
+_SIGNAL_FLAG_RE = re.compile(r"from_signal|in_signal|signal_ctx", re.I)
+_THREAD_FACTORIES = {"threading.Thread", "Thread"}
+#: logger-receiver names whose level-method calls count as logging
+_LOGGER_RECV_RE = re.compile(r"(^|\.)(_?logger|log)$", re.I)
+_LOG_LEVEL_TAILS = {"debug", "info", "warning", "warn", "error",
+                    "exception", "critical", "log"}
+
+
+def lock_id_of(expr: ast.AST) -> Optional[str]:
+    """Normalized lock identity for a with-item / acquire receiver:
+    ``self._mp_cond`` -> ``_mp_cond``; inline ``threading.Lock()`` keeps
+    its dotted factory name.  None when the expression does not look
+    like a lock."""
+    name = dotted_name(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = call_name(expr)
+    if name is None:
+        return None
+    if not _LOCK_NAME_RE.search(name.rsplit(".", 1)[-1]):
+        return None
+    return name[5:] if name.startswith("self.") else name
+
+
+def open_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open(...)`` call (positional or
+    ``mode=``), or None when absent/non-literal.  Shared by the summary
+    extractor and atomic-write."""
+    mode: Optional[str] = None
+    if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+        mode = str(call.args[1].value)
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = str(kw.value.value)
+    return mode
 
 
 def _module_rel_for(dotted: str, importer: str, level: int,
@@ -593,6 +702,70 @@ class _SummaryVisitor(ast.NodeVisitor):
     visit_ListComp = visit_SetComp = visit_DictComp = _loop
     visit_GeneratorExp = _loop
 
+    # -- concurrency facts -------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        # `if not _from_signal:` BODIES are the deferred-flush idiom:
+        # signal-safety prunes call edges inside them from the handler
+        # closure.  Polarity matters — the guard must be the NEGATION
+        # of the flag, and only the body (never the orelse) is blessed:
+        # `if _from_signal: flush()` runs the flush IN signal context
+        # and must keep flagging.
+        if self.fn_stack and isinstance(node.test, ast.UnaryOp) and \
+                isinstance(node.test.op, ast.Not) and node.body:
+            for sub in ast.walk(node.test.operand):
+                ident = sub.id if isinstance(sub, ast.Name) else (
+                    sub.attr if isinstance(sub, ast.Attribute) else None)
+                if ident and _SIGNAL_FLAG_RE.search(ident):
+                    self.fn_stack[-1].deferred_spans.append(
+                        (node.body[0].lineno,
+                         node.body[-1].end_lineno or
+                         node.body[-1].lineno))
+                    break
+        self.generic_visit(node)
+
+    def _with(self, node) -> None:
+        if self.fn_stack:
+            for item in node.items:
+                lock = lock_id_of(item.context_expr)
+                if lock is not None:
+                    self.fn_stack[-1].lock_regions.append(
+                        (lock, node.lineno,
+                         node.end_lineno or node.lineno))
+        self.generic_visit(node)
+
+    visit_With = visit_AsyncWith = _with
+
+    def _record_conc_op(self, name: str, node: ast.Call) -> None:
+        """Classify one call as a concurrency-relevant operation on the
+        enclosing function (caller guarantees ``self.fn_stack``)."""
+        fn = self.fn_stack[-1]
+        tail = name.rsplit(".", 1)[-1]
+        recv = name[: -(len(tail) + 1)] if "." in name else ""
+        if name == "open":
+            fn.conc_ops.append(("file-io", node.lineno,
+                                open_mode(node) or ""))
+        elif name == "print" or name.endswith("print_rank") or \
+                name.startswith("logging."):
+            fn.conc_ops.append(("log", node.lineno, name))
+        elif tail in _LOG_LEVEL_TAILS and recv and \
+                _LOGGER_RECV_RE.search(recv):
+            fn.conc_ops.append(("log", node.lineno, name))
+        elif tail == "join" and not node.args:
+            # zero-arg `.join()` is a thread/process join; str.join
+            # always takes its iterable positionally
+            fn.conc_ops.append(("blocking-join", node.lineno, recv))
+        elif tail == "wait" and recv:
+            lock = recv[5:] if recv.startswith("self.") else recv
+            fn.conc_ops.append(("blocking-wait", node.lineno, lock))
+        elif name in ("time.sleep", "sleep"):
+            fn.conc_ops.append(("blocking-sleep", node.lineno, ""))
+        elif tail in ("acquire", "release") and recv and \
+                _LOCK_NAME_RE.search(recv.rsplit(".", 1)[-1]):
+            # same filter as with-statements: only lock-looking
+            # receivers register (`pool_slot.acquire()` is not a lock)
+            lock = recv[5:] if recv.startswith("self.") else recv
+            fn.conc_ops.append((f"lock-{tail}", node.lineno, lock))
+
     # -- statements -------------------------------------------------
     def visit_Assign(self, node: ast.Assign) -> None:
         value = node.value
@@ -613,7 +786,26 @@ class _SummaryVisitor(ast.NodeVisitor):
         if self.fn_stack:
             for tgt in node.targets:
                 self._record_self_write(tgt)
+            fn = self.fn_stack[-1]
+            for tgt in node.targets:
+                # direct `self.X = expr` / `name = expr` bindings carry
+                # their value source for the thread-escape snapshot check
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    fn.self_assigns.append(
+                        (tgt.attr, node.lineno, self._src_of(value)))
+                elif isinstance(tgt, ast.Name):
+                    fn.local_assigns[tgt.id] = self._src_of(value)
         self.generic_visit(node)
+
+    @staticmethod
+    def _src_of(node: ast.AST, limit: int = 200) -> str:
+        try:
+            src = ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is total on 3.9+
+            return ""
+        return src if len(src) <= limit else src[:limit]
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         if self.fn_stack:
@@ -696,6 +888,25 @@ class _SummaryVisitor(ast.NodeVisitor):
         name = call_name(node)
         if name is not None and self.fn_stack:
             self.fn_stack[-1].calls.append((name, node.lineno))
+            self._record_conc_op(name, node)
+        if name in _THREAD_FACTORIES:
+            target = ""
+            named = False
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = dotted_name(kw.value) or ""
+                elif kw.arg == "name":
+                    named = True
+            self.s.thread_spawns.append(
+                (target, node.lineno, named,
+                 self.class_stack[-1] if self.class_stack else None,
+                 self.fn_stack[-1].qual if self.fn_stack else ""))
+        if name == "signal.signal" and len(node.args) >= 2:
+            handler = dotted_name(node.args[1])
+            if handler:
+                self.s.signal_handlers.append(
+                    (handler, node.lineno,
+                     self.class_stack[-1] if self.class_stack else None))
         if name in _DEVGET_NAMES and self.fn_stack:
             arg_src = ast.unparse(node.args[0]) if node.args else ""
             self.fn_stack[-1].device_gets.append(
@@ -892,14 +1103,19 @@ class Project:
         self._traced = seen
         return seen
 
-    # -- round-path closure (transfer-budget) ------------------------
+    # -- round-path closure (transfer-budget, signal-safety, ...) ----
     def reachable_from(self, roots: Iterable[Tuple[str, str]],
-                       stop: Optional[re.Pattern] = None
+                       stop: Optional[re.Pattern] = None,
+                       skip_edge: Optional[Any] = None
                        ) -> Dict[Tuple[str, str], Tuple[str, str]]:
         """BFS closure over the host call graph from ``roots``; returns
         ``{function: caller}`` back-edges (roots map to themselves).
         ``stop`` prunes callees whose BARE NAME matches (cadence
-        boundaries: eval/checkpoint-class functions)."""
+        boundaries: eval/checkpoint-class functions).  ``skip_edge`` is
+        an optional ``(caller FunctionSummary, call line) -> bool``
+        predicate pruning individual call edges (signal-safety's
+        deferred-flush spans) — ONE closure walk serves every checker,
+        so resolution improvements can never make them disagree."""
         parents: Dict[Tuple[str, str], Tuple[str, str]] = {}
         frontier = []
         for key in roots:
@@ -911,7 +1127,9 @@ class Project:
             fn = self.function(key)
             if fn is None:
                 continue
-            for ref, _line in fn.calls:
+            for ref, line in fn.calls:
+                if skip_edge is not None and skip_edge(fn, line):
+                    continue
                 callee = self.resolve(key[0], ref, fn.cls)
                 if callee is None or callee in parents:
                     continue
@@ -984,6 +1202,16 @@ def build_project(root: str, project_files: List[str],
 # ----------------------------------------------------------------------
 _CACHE_VERSION = 1
 
+#: version of the SUMMARY EXTRACTOR's output shape.  Disk-cache entries
+#: are keyed by (mtime_ns, size) — stamps that do not change when the
+#: ANALYZER changes — so without this key a new PR's extractor could be
+#: served stale summaries missing its new fact fields and silently
+#: report nothing.  Bump it whenever ModuleSummary/FunctionSummary gain,
+#: lose or reinterpret a field; a mismatch discards the cache wholesale.
+#: History: 1 = flint v2 (PR 9); 2 = concurrency fact layer
+#: (lock regions, conc ops, thread spawns, signal handlers, assigns).
+SUMMARY_SCHEMA_VERSION = 2
+
 
 def default_cache_path(root: str) -> str:
     return os.path.join(root, ".flint_cache.json")
@@ -1002,6 +1230,8 @@ def load_summary_cache(path: str,
         return {}
     if raw.get("version") != _CACHE_VERSION:
         return {}
+    if raw.get("schema") != SUMMARY_SCHEMA_VERSION:
+        return {}  # summaries written by a different extractor: recompute
     if root is not None and raw.get("root") not in (None,
                                                    os.path.abspath(root)):
         return {}
@@ -1014,6 +1244,7 @@ def save_summary_cache(path: str, cache: Dict[str, Any],
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump({"version": _CACHE_VERSION,
+                   "schema": SUMMARY_SCHEMA_VERSION,
                    "root": os.path.abspath(root) if root else None,
                    "entries": cache}, fh)
     os.replace(tmp, path)
@@ -1097,9 +1328,10 @@ def analyze(paths: List[str], root: Optional[str] = None,
     checkers (schema-drift, guard-matrix, event-schema,
     transfer-budget) — the incremental mode's call when none of their
     inputs changed."""
-    from . import (donation, event_schema, guard_matrix, host_sync,
-                   jit_purity, pallas_shape, put_loop, recompile_hazard,
-                   schema_drift, shard_ready, transfer_budget)
+    from . import (atomic_write, donation, event_schema, guard_matrix,
+                   host_sync, jit_purity, lock_discipline, pallas_shape,
+                   put_loop, recompile_hazard, schema_drift, shard_ready,
+                   signal_safety, thread_escape, transfer_budget)
 
     root = os.path.abspath(root or os.getcwd())
     files = _iter_py_files(paths)
@@ -1156,6 +1388,7 @@ def analyze(paths: List[str], root: Optional[str] = None,
         (shard_ready.RULE, lambda i: shard_ready.check(i, project)),
         (recompile_hazard.RULE,
          lambda i: recompile_hazard.check(i, project)),
+        (atomic_write.RULE, atomic_write.check),
     ]
     for rel in sorted(infos):
         info = infos[rel]
@@ -1177,6 +1410,16 @@ def analyze(paths: List[str], root: Optional[str] = None,
         if rules is None or event_schema.RULE in rules:
             findings.extend(event_schema.check_project(
                 root, modules=project.modules))
+        emit = analyzed_rel if project_paths else None
+        if rules is None or signal_safety.RULE in rules:
+            findings.extend(signal_safety.check_project(
+                project, emit_paths=emit))
+        if rules is None or lock_discipline.RULE in rules:
+            findings.extend(lock_discipline.check_project(
+                project, emit_paths=emit))
+        if rules is None or thread_escape.RULE in rules:
+            findings.extend(thread_escape.check_project(
+                project, emit_paths=emit))
         # project-checker findings live in .py/.md files that may carry
         # inline pragmas; .md pragmas are not a thing, which is fine
         # because the actionable end of a doc drift is the doc itself.
@@ -1187,7 +1430,9 @@ def analyze(paths: List[str], root: Optional[str] = None,
     # must not mark its pragmas stale
     active = set(rules) if rules is not None else set(RULES)
     project_rules = {transfer_budget.RULE, schema_drift.RULE,
-                     guard_matrix.RULE, event_schema.RULE}
+                     guard_matrix.RULE, event_schema.RULE,
+                     signal_safety.RULE, lock_discipline.RULE,
+                     thread_escape.RULE}
     if not with_project_checkers:
         active -= project_rules
     else:

@@ -1,0 +1,224 @@
+"""lock-discipline — ordered, non-blocking critical sections.
+
+The host tail is the fleet's reliability bottleneck (the smart-NIC
+server thesis, PAPERS.md): a stall inside a hot-path lock — the Tracer
+lock every span emission takes, the dataset-cache lock every lazy read
+takes, the checkpoint writer's condition — stalls every thread that
+needs it, and an inconsistent acquisition order is a deadlock waiting
+for the right interleaving.  Three checks over the concurrency facts:
+
+1. **blocking while holding a lock** (hot-path modules incl. ``data/``
+   and ``resilience/``): inside a ``with <lock>:`` region, flag direct
+   blocking operations — ``open`` file IO, zero-arg ``.join()``,
+   ``time.sleep``, ``.wait()`` on a DIFFERENT object (``cond.wait()``
+   on the held condition is the sanctioned idiom: it releases the
+   lock), explicit ``jax.device_get`` device syncs — and calls whose
+   project-call-graph closure reaches such an operation (reported with
+   the offending callee).  The shipped Tracer is the model citizen:
+   span emission under its lock is a dict append; IO happens at
+   ``flush()`` via buffered writes and outside-lock rewrites.
+
+2. **acquisition order** (project-wide): every nested acquisition —
+   lexically nested ``with`` regions, or a call made while holding lock
+   A to a function that acquires lock B — contributes an A<B edge; a
+   pair of locks acquired in both orders anywhere in the project flags
+   both witnesses.
+
+3. **explicit acquire without release**: a function that calls
+   ``x.acquire()`` with no matching ``x.release()`` leaks the lock on
+   any exception path — use ``with``.
+
+Lock identity is the normalized attribute/name text (``self._mp_cond``
+-> ``_mp_cond``); with-items whose final name segment does not look
+like a lock (``lock``/``cond``/``mutex``/``sem``) are not tracked, so
+``with tracer.span(...)`` never registers.  File IO is the builtin
+``open`` only — serialization layers with their own locks (h5py) are
+deliberately out of scope: serializing IO under a dedicated IO lock is
+the user-blob reader's whole design.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, conc_hot_path
+
+RULE = "lock-discipline"
+
+_BLOCKING = {
+    "file-io": "opens a file",
+    "blocking-join": "joins `{d}`",
+    "blocking-wait": "waits on `{d}`",
+    "blocking-sleep": "sleeps",
+}
+
+#: memo key: (function, exempt lock) — the held lock travels into the
+#: closure so `cond.wait()` on the HELD condition stays sanctioned even
+#: when the wait loop is refactored into a helper
+_MemoKey = Tuple[Tuple[str, str], str]
+
+
+def _first_blocking(project: Project, key: Tuple[str, str],
+                    memo: Dict[_MemoKey, Optional[Tuple]],
+                    exempt_lock: str,
+                    ) -> Optional[Tuple[str, str, int, str]]:
+    """First blocking fact reachable from ``key`` (inclusive), as
+    (kind, module::qual, line, detail); None when the closure is clean.
+    ``blocking-wait`` on ``exempt_lock`` — the lock the caller holds —
+    does not count (Condition.wait releases it).  Memoized across the
+    project per exempt lock; cycles resolve to the memo's in-progress
+    None."""
+    mkey = (key, exempt_lock)
+    if mkey in memo:
+        return memo[mkey]
+    memo[mkey] = None  # cycle guard: in-progress counts as clean
+    fn = project.function(key)
+    if fn is None:
+        return None
+    for kind, line, detail in fn.conc_ops:
+        if kind not in _BLOCKING:
+            continue
+        if kind == "blocking-wait" and detail == exempt_lock:
+            continue
+        memo[mkey] = (kind, f"{fn.module}::{fn.qual}", line, detail)
+        return memo[mkey]
+    if fn.device_gets:
+        line, arg, _ = fn.device_gets[0]
+        memo[mkey] = ("device-sync", f"{fn.module}::{fn.qual}", line, arg)
+        return memo[mkey]
+    for ref, _line in fn.calls:
+        callee = project.resolve(key[0], ref, fn.cls)
+        if callee is None:
+            continue
+        found = _first_blocking(project, callee, memo, exempt_lock)
+        if found is not None:
+            memo[mkey] = found
+            return found
+    return None
+
+
+def check_project(project: Project,
+                  emit_paths: Optional[Set[str]] = None
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    memo: Dict[_MemoKey, Optional[Tuple]] = {}
+    #: (outer lock, inner lock) -> first witness (module, line)
+    order_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    for path in sorted(project.modules):
+        mod = project.modules[path]
+        for qual in sorted(mod.functions):
+            fn = mod.functions[qual]
+            regions = sorted(fn.lock_regions, key=lambda r: (r[1], -r[2]))
+            # -- order edges from lexical nesting (any module) --------
+            # sa == sb covers the multi-item form `with a_lock,
+            # b_lock:` — both items share the statement's span, and the
+            # stable sort keeps them in acquisition (item) order, so
+            # the earlier item is the outer lock
+            for i, (la, sa, ea) in enumerate(regions):
+                for lb, sb, eb in regions[i + 1:]:
+                    if sa <= sb and eb <= ea and la != lb:
+                        order_edges.setdefault((la, lb), (path, sb))
+            for la, sa, ea in regions:
+                # -- order edges via callees that acquire -------------
+                for ref, line in fn.calls:
+                    if not sa <= line <= ea:
+                        continue
+                    callee = project.resolve(path, ref, fn.cls)
+                    callee_fn = project.function(callee) if callee \
+                        else None
+                    if callee_fn is None:
+                        continue
+                    for lb, *_ in callee_fn.lock_regions:
+                        if lb != la:
+                            order_edges.setdefault((la, lb),
+                                                   (path, line))
+                if not conc_hot_path(path):
+                    continue
+                emit_ok = emit_paths is None or path in emit_paths
+                # -- blocking while holding --------------------------
+                for kind, line, detail in fn.conc_ops:
+                    if not sa <= line <= ea or kind not in _BLOCKING:
+                        continue
+                    if kind == "blocking-wait" and detail == la:
+                        continue  # cond.wait() releases the held lock
+                    if emit_ok:
+                        findings.append(Finding(
+                            RULE, path, line,
+                            f"`{fn.qual}` "
+                            f"{_BLOCKING[kind].format(d=detail or '?')} "
+                            f"while holding lock `{la}` — every thread "
+                            "needing the lock stalls behind the IO/wait",
+                            hint="move the blocking work outside the "
+                                 "critical section: snapshot under the "
+                                 "lock, do IO after (the Tracer flush "
+                                 "and dataset-cache patterns)"))
+                for line, arg, _loop in fn.device_gets:
+                    if sa <= line <= ea and emit_ok:
+                        findings.append(Finding(
+                            RULE, path, line,
+                            f"`{fn.qual}` device_get of `{arg}` while "
+                            f"holding lock `{la}` — a device sync can "
+                            "stall every thread needing the lock for a "
+                            "full round",
+                            hint="fetch before taking the lock; hold it "
+                                 "only for the host-state update"))
+                for ref, line in fn.calls:
+                    if not sa <= line <= ea:
+                        continue
+                    callee = project.resolve(path, ref, fn.cls)
+                    if callee is None:
+                        continue
+                    found = _first_blocking(project, callee, memo,
+                                             la)
+                    if found is not None and emit_ok:
+                        kind, where, _bline, detail = found
+                        phrase = _BLOCKING.get(kind, "syncs `{d}`")
+                        findings.append(Finding(
+                            RULE, path, line,
+                            f"`{fn.qual}` calls `{ref}` while holding "
+                            f"lock `{la}`, and `{where}` "
+                            f"{phrase.format(d=detail or '?')} — "
+                            "blocking inside the critical section",
+                            hint="restructure so the lock guards only "
+                                 "host-state mutation; do the blocking "
+                                 "work before/after the `with` block"))
+            # -- explicit acquire/release pairing ---------------------
+            acquired = [(line, d) for k, line, d in fn.conc_ops
+                        if k == "lock-acquire"]
+            released = {d for k, _line, d in fn.conc_ops
+                        if k == "lock-release"}
+            if conc_hot_path(path) and \
+                    (emit_paths is None or path in emit_paths):
+                for line, lock in acquired:
+                    if lock not in released:
+                        findings.append(Finding(
+                            RULE, path, line,
+                            f"`{fn.qual}` acquires `{lock}` explicitly "
+                            "with no release in the same function — an "
+                            "exception between them leaks the lock "
+                            "forever",
+                            hint="use `with lock:` (releases on every "
+                                 "path), or pair acquire/release in a "
+                                 "try/finally"))
+
+    # -- acquisition-order inversions (project-wide) -------------------
+    for (la, lb), (path, line) in sorted(order_edges.items()):
+        if (lb, la) not in order_edges or la > lb:
+            continue  # report each inverted pair once per direction
+        other_path, other_line = order_edges[(lb, la)]
+        for p, ln, outer, inner, op, ol in (
+                (path, line, la, lb, other_path, other_line),
+                (other_path, other_line, lb, la, path, line)):
+            if emit_paths is not None and p not in emit_paths:
+                continue
+            findings.append(Finding(
+                RULE, p, ln,
+                f"lock order inversion: `{inner}` is acquired while "
+                f"holding `{outer}` here, but the opposite order is "
+                f"taken at {op}:{ol} — two threads interleaving these "
+                "paths deadlock",
+                hint="pick one global acquisition order for the pair "
+                     "and restructure the later acquisition out of the "
+                     "other's critical section"))
+    return findings

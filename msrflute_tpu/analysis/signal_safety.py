@@ -1,0 +1,116 @@
+"""signal-safety — nothing reachable from a signal handler may block.
+
+A CPython signal handler runs BETWEEN bytecodes of whatever the main
+thread happened to be doing.  If that was ``Tracer._emit_complete``
+holding the tracer lock, a handler that flushes telemetry deadlocks the
+process on its own lock (the PR 4 bug: ``flush_metrics`` from the
+SIGTERM handler); if it was a buffered ``fh.write``, a handler write
+raises a reentrancy error; ``logging`` takes module-level locks and is
+documented as unsafe in handlers.  At fleet scale (ROADMAP item 5:
+days-long endurance runs under preemption) a one-in-a-million handler
+race is a daily hang, so the discipline is machine-checked:
+
+from every handler registered via ``signal.signal(sig, h)`` the project
+call graph is closed, and every reachable function is held to the
+async-signal-safe subset — flagged facts are lock acquisitions (with
+statements on lock-named objects, explicit ``.acquire()``), file IO
+(``open``), logging (``print``/``print_rank``/``logging.*``/logger
+level methods), blocking operations (zero-arg ``.join()``, ``.wait()``,
+``time.sleep``) and explicit ``jax.device_get`` syncs.
+
+The blessed fix is the DEFERRED-FLUSH pattern
+(``resilience/preemption.py``): the handler only sets flags; the round
+loop's next poll — outside signal context — runs the flush.
+Statically, work lexically inside the BODY of an ``if not <flag>:``
+whose negated test names a ``*_from_signal``-style flag is treated as
+deferred and pruned from the handler closure, so the idiom's carrier
+function stays clean while an UNguarded flush three calls deep still
+flags with its handler path.  Polarity is checked: ``if _from_signal:``
+bodies (and else-branches) run IN signal context and keep flagging.
+
+``os.write`` to a raw fd is async-signal-safe and deliberately not in
+the flagged set — it is the sanctioned way to say something from a
+handler that must speak even when the process is wedged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from .core import Finding, FunctionSummary, Project
+
+RULE = "signal-safety"
+
+#: conc-op kinds unsafe in signal context, with human phrasing
+_UNSAFE_OPS = {
+    "lock-acquire": "acquires lock `{d}`",
+    "file-io": "opens a file",
+    "log": "logs via `{d}` (logging takes module-level locks)",
+    "blocking-join": "joins `{d}` (blocks the interrupted thread)",
+    "blocking-wait": "waits on `{d}`",
+    "blocking-sleep": "sleeps",
+}
+
+_HINT = ("signal handlers may only set flags (threading.Event, plain "
+         "attributes) and os.write to raw fds; defer the real work to a "
+         "flag polled by the loop — the preemption deferred-flush "
+         "pattern (resilience/preemption.py), whose `if not "
+         "_from_signal:` guard this rule recognizes")
+
+
+def _in_deferred(fn: FunctionSummary, line: int) -> bool:
+    return any(s <= line <= e for s, e in fn.deferred_spans)
+
+
+def check_project(project: Project,
+                  emit_paths: Optional[Set[str]] = None
+                  ) -> List[Finding]:
+    roots: List[Tuple[str, str]] = []
+    for path, mod in project.modules.items():
+        for ref, _line, cls in mod.signal_handlers:
+            resolved = project.resolve(path, ref, cls)
+            if resolved:
+                roots.append(resolved)
+    if not roots:
+        return []
+    # the shared closure walk, minus call edges inside deferred
+    # (signal-flag-guarded) spans
+    parents = project.reachable_from(sorted(set(roots)),
+                                     skip_edge=_in_deferred)
+
+    findings: List[Finding] = []
+    for key in sorted(parents):
+        fn = project.function(key)
+        if fn is None:
+            continue
+        if emit_paths is not None and fn.module not in emit_paths:
+            continue
+        chain = project.call_path(parents, key)
+        via = (f" (handler path: {' -> '.join(chain)})"
+               if len(chain) > 1 else " (registered signal handler)")
+        for kind, line, detail in fn.conc_ops:
+            phrase = _UNSAFE_OPS.get(kind)
+            if phrase is None or _in_deferred(fn, line):
+                continue
+            findings.append(Finding(
+                RULE, fn.module, line,
+                f"`{fn.qual}` {phrase.format(d=detail or '?')} but is "
+                f"reachable from a signal handler{via}", hint=_HINT))
+        for lock, start, _end in fn.lock_regions:
+            if _in_deferred(fn, start):
+                continue
+            findings.append(Finding(
+                RULE, fn.module, start,
+                f"`{fn.qual}` acquires lock `{lock}` but is reachable "
+                f"from a signal handler — if the interrupted thread "
+                f"holds it, the process deadlocks on itself{via}",
+                hint=_HINT))
+        for line, arg, _loop in fn.device_gets:
+            if _in_deferred(fn, line):
+                continue
+            findings.append(Finding(
+                RULE, fn.module, line,
+                f"`{fn.qual}` device_get of `{arg}` but is reachable "
+                f"from a signal handler — a device sync mid-handler can "
+                f"block indefinitely{via}", hint=_HINT))
+    return findings

@@ -1,0 +1,146 @@
+"""thread-escape — mutable state handed across a thread boundary.
+
+The torn-snapshot class (PR 1's first satellite fix): the training
+thread submits a pytree to the async checkpoint writer's mailbox, then
+keeps mutating its numpy leaves in place — the writer's serialize reads
+a value that is half round k, half round k+1, and the checkpoint
+passes its own checksum because the tear happened BEFORE the write.
+Nothing crashes; the corruption surfaces rounds later on resume.
+
+Facts: thread roots are discovered from ``threading.Thread(target=…)``
+spawns and closed over the project call graph — the writer thread, any
+thread a future fleet-mode PR adds.  A ``self.X`` attribute assigned on
+the MAIN side (any function outside the worker closure) and read inside
+the worker closure of the same class is a cross-thread channel; the
+assigned value must be a snapshot:
+
+- a copy (``np.copy``/``jnp.copy``/``copy.deepcopy``/``.copy()`` —
+  matched anywhere in the value source, so a ``jax.tree.map`` whose
+  lambda copies its leaves passes; provenance follows bare local names
+  a few assignments deep);
+- a freshly constructed object (``dict(…)``/``list(…)``/capitalized
+  constructor calls) or an immutable literal/constant.
+
+A bare name or a plain call result (``self._mailbox = _payload(state)``
+— the exact pre-fix bug) flags.  Writes in ``__init__`` are exempt: the
+constructor runs before the class can have spawned its thread.
+Subscript stores (``self._cache[k] = v``) are lock-discipline's
+territory, not a handoff.
+
+Spawn hygiene rides along: an ANONYMOUS ``Thread(…)`` spawn (no
+``name=``) in a hot-path module flags — telemetry puts every span on a
+named thread track and watchdog/event records carry the emitting thread
+name, so a thread named "Thread-7" is unattributable in every trace and
+log the fleet-mode endurance harness will be debugged from.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FunctionSummary, Project, conc_hot_path
+
+RULE = "thread-escape"
+
+#: value-source text that denotes a snapshot/copy
+_COPY_RE = re.compile(
+    r"copy\(|deepcopy|\.copy\b|asarray\(|"
+    r"np\.array\(|dict\(|list\(|tuple\(|frozenset\(")
+#: a call whose final callable segment is Capitalized constructs a
+#: fresh object — no aliasing with training-thread state
+_CTOR_RE = re.compile(r"^[A-Za-z_][\w.]*\.?[A-Z]\w*\(")
+#: immutable SCALAR values need no copy.  Container displays are NOT
+#: here on purpose: `self._box = (state, 1)` builds a fresh tuple
+#: around the LIVE `state` object — the tear happens through the
+#: element, so a display only passes when its contents copy (matched
+#: by _COPY_RE) or it holds nothing but literals (checked below).
+_LITERAL_RE = re.compile(r"^(None|True|False|[-+]?\d|[\"'])")
+#: a container display with no bare-name element references: every
+#: identifier inside is a callable/attribute head (`np.copy(`,
+#: `dict(`), never a naked aliasing reference
+_PURE_DISPLAY_RE = re.compile(r"^[(\[{][^A-Za-z_]*[)\]}]$")
+
+
+def _copy_like(src: str, local_assigns: Dict[str, str],
+               depth: int = 3) -> bool:
+    src = src.strip()
+    if not src:
+        return False
+    # string literals are immutable — blank them out before the
+    # pure-display test so `("tag", 1)` reads as identifier-free
+    quoteless = re.sub(r"'[^']*'|\"[^\"]*\"", "''", src)
+    if _COPY_RE.search(src) or _CTOR_RE.match(src) or \
+            _LITERAL_RE.match(src) or _PURE_DISPLAY_RE.match(quoteless):
+        return True
+    if depth > 0 and re.fullmatch(r"[A-Za-z_]\w*", src):
+        provenance = local_assigns.get(src)
+        if provenance is not None:
+            return _copy_like(provenance, local_assigns, depth - 1)
+    return False
+
+
+def check_project(project: Project,
+                  emit_paths: Optional[Set[str]] = None
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    roots: List[Tuple[str, str]] = []
+    for path in sorted(project.modules):
+        mod = project.modules[path]
+        for target, line, named, cls, _fn in mod.thread_spawns:
+            if not named and conc_hot_path(path) and \
+                    (emit_paths is None or path in emit_paths):
+                findings.append(Finding(
+                    RULE, path, line,
+                    "anonymous thread spawn — an unnamed thread is "
+                    "unattributable in telemetry thread tracks, event "
+                    "records and watchdog messages",
+                    hint="pass name=... (e.g. threading.Thread(target="
+                         "..., name=\"ckpt-latest-writer\")); the name "
+                         "rides every span/event the thread emits"))
+            if target:
+                resolved = project.resolve(path, target, cls)
+                if resolved:
+                    roots.append(resolved)
+    if not roots:
+        return findings
+
+    worker = project.reachable_from(sorted(set(roots)))
+    #: (defining module, class, attr) -> a worker-side reader to name
+    #: in the report.  Module-qualified: a same-named but unrelated
+    #: class elsewhere must not inherit this one's channels.
+    worker_reads: Dict[Tuple[str, str, str], FunctionSummary] = {}
+    for key in worker:
+        fn = project.function(key)
+        if fn is None or fn.cls is None:
+            continue
+        for attr in fn.self_reads:
+            worker_reads.setdefault((fn.module, fn.cls, attr), fn)
+
+    for path in sorted(project.modules):
+        mod = project.modules[path]
+        for qual in sorted(mod.functions):
+            fn = mod.functions[qual]
+            if fn.cls is None or fn.name == "__init__" or \
+                    (path, qual) in worker:
+                continue
+            if emit_paths is not None and path not in emit_paths:
+                continue
+            for attr, line, src in fn.self_assigns:
+                reader = worker_reads.get((path, fn.cls, attr))
+                if reader is None:
+                    continue
+                if _copy_like(src, fn.local_assigns):
+                    continue
+                findings.append(Finding(
+                    RULE, path, line,
+                    f"`self.{attr} = {src}` hands live state across a "
+                    f"thread boundary — `{reader.module}::{reader.qual}`"
+                    " reads it on a spawned thread; an in-place "
+                    "mutation on this thread reaches the worker "
+                    "mid-operation (the torn-snapshot class)",
+                    hint="snapshot before the handoff: np.copy/jnp.copy "
+                         "the leaves (jax.tree.map over the pytree, as "
+                         "checkpoint._mp_submit does) or hand over an "
+                         "immutable/freshly-built value"))
+    return findings

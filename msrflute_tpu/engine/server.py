@@ -2155,7 +2155,11 @@ class OptimizationServer:
             (batches["sample_mask"], batches["user_idx"]))
         mask_np = np.asarray(mask_np) > 0
         uids_np = np.asarray(uids_np)
-        with open(path, "w", encoding="utf-8") as fh:
+        # tmp + os.replace: the dump streams one row per sample, so a
+        # crash mid-loop would otherwise leave a silently-truncated
+        # predictions file at the advertised path
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
             for t in range(T):
                 mask = mask_np[t]
                 if not mask.any():
@@ -2179,6 +2183,7 @@ class OptimizationServer:
                                "label": int(labels[i]),
                                "logits": np.round(logits[i], 6).tolist()}
                     fh.write(_json.dumps(row) + "\n")
+        os.replace(tmp, path)
         print_rank(f"wrote {split} predictions to {path}")
 
     def _fall_back(self) -> None:

@@ -22,6 +22,7 @@ deterministic ``server_config.chaos.preempt_at_round`` drill and direct
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
 from typing import Optional
@@ -98,32 +99,39 @@ class PreemptionHandler:
         (``preempt_at_round``) and tests come through here; the signal
         handler is a thin wrapper around it.
 
-        ``_from_signal``: the telemetry flush (file IO + tracer locks)
-        is DEFERRED to :meth:`flush_now`, which the round loop calls at
-        its next poll — a Python signal handler interrupting the main
-        thread mid-``Tracer._emit_complete`` would self-deadlock on the
-        tracer lock, and a buffered ``fh.write`` interrupted mid-call
-        raises a reentrancy error.  Programmatic requests flush inline.
+        ``_from_signal``: ALL observability — the telemetry flush (file
+        IO + tracer locks) AND the log line (``logging`` takes
+        module-level locks) — is DEFERRED to :meth:`flush_now`, which
+        the round loop calls at its next poll.  A Python signal handler
+        interrupting the main thread mid-``Tracer._emit_complete``
+        would self-deadlock on the tracer lock, a buffered ``fh.write``
+        interrupted mid-call raises a reentrancy error, and a handler
+        logging while the main thread holds the logging lock hangs the
+        process.  flint's ``signal-safety`` rule machine-checks exactly
+        this discipline (and recognizes this guard as the blessed
+        deferred-flush pattern).  Programmatic requests flush inline —
+        they are not in signal context.
         """
         if not self._event.is_set():
             self._reason = reason
             self._flush_pending = True
-            print_rank(f"preemption requested ({reason}); draining and "
-                       "checkpointing", loglevel=logging.WARNING)
             if not _from_signal:
                 self.flush_now()
         self._event.set()
 
     def flush_now(self) -> None:
         """Run the deferred observability flush exactly once per
-        request: structured ``preemption`` record + metrics-stream flush
-        + registered trace-writer hooks.  Safe to call repeatedly; the
-        round loop calls it when it observes ``requested`` (i.e. OUTSIDE
-        signal-handler context), before starting the drain, so a
-        SIGTERM'd run's streams are durable even if the drain wedges."""
+        request: the log line + structured ``preemption`` record +
+        metrics-stream flush + registered trace-writer hooks.  Safe to
+        call repeatedly; the round loop calls it when it observes
+        ``requested`` (i.e. OUTSIDE signal-handler context), before
+        starting the drain, so a SIGTERM'd run's streams are durable
+        even if the drain wedges."""
         if not getattr(self, "_flush_pending", False):
             return
         self._flush_pending = False
+        print_rank(f"preemption requested ({self._reason}); draining "
+                   "and checkpointing", loglevel=logging.WARNING)
         try:
             from ..telemetry.metrics import flush_metrics, log_event
             log_event("preemption", reason=self._reason or "requested")
@@ -144,10 +152,13 @@ class PreemptionHandler:
         if self._hits >= self.escalate_after:
             # a stuck drain must stay killable: restore the previous
             # dispositions so the NEXT signal behaves as if we were
-            # never here
+            # never here.  os.write to the raw stderr fd is the one
+            # async-signal-safe way to say so — this message must land
+            # even when the process is wedged mid-logging, which is
+            # precisely when logging from here would deadlock
             self.uninstall()
-            print_rank("repeated preemption signal: handlers restored; "
-                       "the next signal is fatal", loglevel=logging.WARNING)
+            os.write(2, b"repeated preemption signal: handlers "
+                        b"restored; the next signal is fatal\n")
 
     def install(self) -> bool:
         """Install handlers; True when actually installed (main thread

@@ -202,15 +202,22 @@ class RLAggregator:
 
     # ------------------------------------------------------------------
     def save(self) -> None:
+        # tmp + os.replace on both files: the RL tuner checkpoint is a
+        # resume anchor like any other — a crash mid-write must leave
+        # the previous generation loadable, not a torn msgpack
         blob = serialization.msgpack_serialize(serialization.to_state_dict({
             "params": jax.device_get(self.params),
             "opt_state": jax.device_get(self.opt_state),
         }))
-        with open(self.model_name, "wb") as fh:
+        tmp = self.model_name + ".tmp"
+        with open(tmp, "wb") as fh:
             fh.write(blob)
-        with open(self.stats_name, "w") as fh:
+        os.replace(tmp, self.model_name)
+        stats_tmp = self.stats_name + ".tmp"
+        with open(stats_tmp, "w") as fh:
             json.dump({"step": self.step, "epsilon": self.epsilon,
                        "running_loss": self.running_loss}, fh)
+        os.replace(stats_tmp, self.stats_name)
 
     def load_saved_status(self) -> None:
         if os.path.exists(self.model_name):

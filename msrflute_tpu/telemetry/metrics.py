@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -87,6 +88,12 @@ def log_event(kind: str, **fields: Any) -> None:
     grep-a-log-line observability those paths had before flutescope."""
     record = {"ts": time.time(), "event": kind}
     record.update({k: _to_py(v) for k, v in fields.items()})
+    # attribute off-main-thread emissions (the async checkpoint writer,
+    # future fleet-mode workers) to their named thread; every spawned
+    # thread carries a name (flint's thread-escape spawn-hygiene check)
+    emitter = threading.current_thread()
+    if emitter is not threading.main_thread():
+        record.setdefault("thread", emitter.name)
     _write_line(record)
     _LOGGER.info("event %s %s", kind,
                  {k: v for k, v in record.items()

@@ -41,6 +41,7 @@ emitted as a structured event whatever the action.
 from __future__ import annotations
 
 import math
+import threading
 from collections import deque
 from typing import Any, Callable, Dict, Optional
 
@@ -173,11 +174,17 @@ class Watchdog:
     # ------------------------------------------------------------------
     def _fire(self, kind: str, action: str, **fields: Any) -> None:
         self.findings.append({"kind": kind, "action": action, **fields})
-        self.on_event(f"watchdog_{kind}", action=action, **fields)
+        # name the observing thread in the event and the abort message:
+        # a ckpt_failure_streak seen from the named writer thread and
+        # one seen from the round loop are different debugging stories
+        thread = threading.current_thread().name
+        self.on_event(f"watchdog_{kind}", action=action, thread=thread,
+                      **fields)
         if action in ("mark", "abort"):
             self.on_mark(kind, fields)
         if action == "abort":
             raise WatchdogAbort(
-                f"watchdog {kind} fired ({fields}); configured action is "
-                "abort — set server_config.telemetry.watchdog to 'mark' "
-                "or 'log' to continue through this condition")
+                f"watchdog {kind} fired on thread {thread} ({fields}); "
+                "configured action is abort — set server_config."
+                "telemetry.watchdog to 'mark' or 'log' to continue "
+                "through this condition")

@@ -1778,3 +1778,819 @@ def test_guard_matrix_dropped_block_owes_no_schema_check(tmp_path):
             """,
         "docs/config_extensions.md": "# extensions\n"})
     assert check_project(root) == []
+
+
+# ======================================================================
+# flint-threads: signal-safety
+# ======================================================================
+def test_signal_safety_flags_logging_in_handler(tmp_path):
+    found = run_on(tmp_path, "resilience/mod.py", """\
+        import logging
+        import signal
+
+        def _on_term(signum, frame):
+            logging.warning("terminating")
+
+        def install():
+            signal.signal(signal.SIGTERM, _on_term)
+        """, rules=["signal-safety"])
+    assert rules_of(found) == ["signal-safety"]
+    assert "logs" in found[0].message
+
+
+def test_signal_safety_flags_lock_and_file_io_via_call_graph(tmp_path):
+    """The PR 4 shape: the handler itself looks innocent; the lock
+    acquisition and the file IO live two calls deep.  The finding names
+    the handler path."""
+    found = run_on(tmp_path, "telemetry/mod.py", """\
+        import signal
+        import threading
+
+        class Scope:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self.flush()
+
+            def flush(self):
+                with self._lock:
+                    fh = open("trace.json", "w")
+                    fh.close()
+        """, rules=["signal-safety"])
+    assert rules_of(found) == ["signal-safety", "signal-safety"]
+    assert any("acquires lock `_lock`" in f.message for f in found)
+    assert any("opens a file" in f.message for f in found)
+    assert all("_on_signal" in f.message for f in found)
+
+
+def test_signal_safety_deferred_flush_pattern_is_blessed(tmp_path):
+    """The shipped fix: the handler only sets flags; the flush call is
+    guarded on the `_from_signal` flag and runs at the loop's poll."""
+    assert run_on(tmp_path, "resilience/mod.py", """\
+        import signal
+
+        def flush_metrics():
+            fh = open("metrics.jsonl", "a")
+            fh.flush()
+
+        class Handler:
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self.request("signal", _from_signal=True)
+
+            def request(self, reason, _from_signal=False):
+                self._pending = True
+                if not _from_signal:
+                    self.flush_now()
+
+            def flush_now(self):
+                flush_metrics()
+        """, rules=["signal-safety"]) == []
+
+
+def test_signal_safety_flag_only_handler_is_clean(tmp_path):
+    """Setting events/attributes and os.write to a raw fd are the
+    async-signal-safe vocabulary — no findings, even with unsafe
+    functions elsewhere in the module that the handler never reaches."""
+    assert run_on(tmp_path, "resilience/mod.py", """\
+        import os
+        import signal
+        import threading
+
+        class Handler:
+            def __init__(self):
+                self._event = threading.Event()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self._hits = 1
+                self._event.set()
+                os.write(2, b"preempting\\n")
+
+            def drain(self):
+                fh = open("trace.json", "a")
+                fh.close()
+        """, rules=["signal-safety"]) == []
+
+
+# ======================================================================
+# flint-threads: lock-discipline
+# ======================================================================
+def test_lock_discipline_flags_blocking_while_holding_lock(tmp_path):
+    found = run_on(tmp_path, "telemetry/mod.py", """\
+        import threading
+        import time
+
+        class Tracer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def flush(self):
+                with self._lock:
+                    time.sleep(0.1)
+                    fh = open("out.log", "w")
+                    fh.close()
+        """, rules=["lock-discipline"])
+    assert rules_of(found) == ["lock-discipline", "lock-discipline"]
+    assert any("sleeps" in f.message for f in found)
+    assert any("opens a file" in f.message for f in found)
+
+
+def test_lock_discipline_flags_device_get_and_blocking_callee(tmp_path):
+    """A device sync under the lock flags directly; file IO two calls
+    deep flags at the call site, naming the blocking callee."""
+    found = run_on(tmp_path, "data/mod.py", """\
+        import threading
+        import jax
+
+        class Cache:
+            def __init__(self):
+                self._cache_lock = threading.Lock()
+
+            def insert(self, stats):
+                with self._cache_lock:
+                    host = jax.device_get(stats)
+                    self._persist(host)
+
+            def _persist(self, host):
+                fh = open("rows.log", "w")
+                fh.close()
+        """, rules=["lock-discipline"])
+    assert rules_of(found) == ["lock-discipline", "lock-discipline"]
+    assert any("device_get" in f.message for f in found)
+    assert any("_persist" in f.message and "opens a file" in f.message
+               for f in found)
+
+
+def test_lock_discipline_flags_inconsistent_acquisition_order(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+
+            def f(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        self.x = 1
+
+            def g(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        self.x = 2
+        """, rules=["lock-discipline"])
+    assert rules_of(found) == ["lock-discipline", "lock-discipline"]
+    assert all("order inversion" in f.message for f in found)
+
+
+def test_lock_discipline_flags_explicit_acquire_without_release(tmp_path):
+    found = run_on(tmp_path, "telemetry/mod.py", """\
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def grab(self):
+                self._lock.acquire()
+                self.x = 1
+        """, rules=["lock-discipline"])
+    assert rules_of(found) == ["lock-discipline"]
+    assert "no release" in found[0].message
+
+
+def test_lock_discipline_same_lock_condition_wait_is_fine(tmp_path):
+    """`cond.wait()` under `with cond:` releases the lock — the
+    checkpoint writer's mailbox idiom must stay silent."""
+    assert run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mp_cond = threading.Condition()
+                self.busy = False
+
+            def wait_done(self):
+                with self._mp_cond:
+                    while self.busy:
+                        self._mp_cond.wait()
+        """, rules=["lock-discipline"]) == []
+
+
+def test_lock_discipline_pure_regions_and_consistent_order_pass(tmp_path):
+    """Dict appends under the lock (the Tracer model) and a globally
+    consistent nesting order are clean."""
+    assert run_on(tmp_path, "telemetry/mod.py", """\
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._io_lock = threading.Lock()
+                self._events = []
+
+            def emit(self, record):
+                with self._lock:
+                    self._events.append(record)
+
+            def snapshot(self):
+                with self._lock:
+                    with self._io_lock:
+                        return list(self._events)
+
+            def snapshot_again(self):
+                with self._lock:
+                    with self._io_lock:
+                        return len(self._events)
+        """, rules=["lock-discipline"]) == []
+
+
+# ======================================================================
+# flint-threads: thread-escape
+# ======================================================================
+def test_thread_escape_flags_uncopied_mailbox_handoff(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        def payload(state):
+            return {"params": state["params"]}
+
+        class M:
+            def __init__(self):
+                self._box = None
+
+            def _loop(self):
+                while True:
+                    blob = self._box
+
+            def submit(self, state):
+                t = threading.Thread(target=self._loop, name="writer")
+                t.start()
+                self._box = payload(state)
+        """, rules=["thread-escape"])
+    assert rules_of(found) == ["thread-escape"]
+    assert "_box" in found[0].message
+    assert "_loop" in found[0].message
+
+
+def test_thread_escape_flags_direct_param_handoff(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._box = None
+
+            def _loop(self):
+                blob = self._box
+
+            def submit(self, state):
+                t = threading.Thread(target=self._loop, name="writer")
+                t.start()
+                self._box = state
+        """, rules=["thread-escape"])
+    assert rules_of(found) == ["thread-escape"]
+
+
+def test_thread_escape_copied_handoff_is_fine(tmp_path):
+    """np.copy'd leaves (one local-variable hop deep, the _mp_submit
+    shape) and fresh constructor/constant writes stay silent."""
+    assert run_on(tmp_path, "engine/mod.py", """\
+        import threading
+        import numpy as np
+
+        def payload(state):
+            return {"params": state["params"]}
+
+        class M:
+            def __init__(self):
+                self._box = None
+                self._cond = threading.Condition()
+
+            def _loop(self):
+                blob = self._box
+
+            def submit(self, state):
+                t = threading.Thread(target=self._loop, name="writer")
+                t.start()
+                snap = {k: np.copy(v)
+                        for k, v in payload(state).items()}
+                self._box = snap
+        """, rules=["thread-escape"]) == []
+
+
+def test_thread_escape_worker_side_and_init_writes_are_fine(tmp_path):
+    """The worker clearing its own mailbox and __init__ setting up
+    state before any thread exists are not handoffs."""
+    assert run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class M:
+            def __init__(self, model_dir):
+                self._box = None
+                self.model_dir = model_dir
+
+            def _loop(self):
+                blob = self._box
+                where = self.model_dir
+                self._box = None
+
+            def start(self):
+                t = threading.Thread(target=self._loop, name="writer")
+                t.start()
+        """, rules=["thread-escape"]) == []
+
+
+def test_thread_escape_flags_anonymous_thread_spawn_in_hot_path(tmp_path):
+    """Satellite: every spawned thread must be named — telemetry thread
+    tracks, event records and watchdog messages attribute by name."""
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+        """, rules=["thread-escape"])
+    assert rules_of(found) == ["thread-escape"]
+    assert "anonymous thread spawn" in found[0].message
+    # named spawns and cold-path spawns are fine
+    assert run_on(tmp_path, "engine/ok.py", """\
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn, name="worker", daemon=True)
+            t.start()
+            return t
+        """, rules=["thread-escape"]) == []
+    assert run_on(tmp_path, "toolsish/mod.py", """\
+        import threading
+
+        def start(fn):
+            return threading.Thread(target=fn)
+        """, rules=["thread-escape"]) == []
+
+
+# ======================================================================
+# flint-threads: atomic-write
+# ======================================================================
+def test_atomic_write_flags_bare_write_on_durable_path(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import json
+        import os
+
+        def update_status(model_dir, update):
+            with open(os.path.join(model_dir, "status_log.json"),
+                      "w") as fh:
+                json.dump(update, fh)
+        """, rules=["atomic-write"])
+    assert rules_of(found) == ["atomic-write"]
+    assert "truncates the committed copy" in found[0].message
+
+
+def test_atomic_write_flags_write_through_local_path_variable(tmp_path):
+    found = run_on(tmp_path, "telemetry/mod.py", """\
+        import json
+        import os
+
+        def write_scorecard(out_dir, card):
+            path = os.path.join(out_dir, "scorecard.json")
+            with open(path, "w") as fh:
+                json.dump(card, fh)
+        """, rules=["atomic-write"])
+    assert rules_of(found) == ["atomic-write"]
+
+
+def test_atomic_write_tmp_replace_idiom_is_fine(tmp_path):
+    assert run_on(tmp_path, "telemetry/mod.py", """\
+        import json
+        import os
+
+        def write_scorecard(out_dir, card):
+            path = os.path.join(out_dir, "scorecard.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(card, fh)
+            os.replace(tmp, path)
+        """, rules=["atomic-write"]) == []
+
+
+def test_atomic_write_append_streams_and_generic_paths_are_fine(tmp_path):
+    assert run_on(tmp_path, "telemetry/mod.py", """\
+        import json
+        import os
+
+        def open_metrics(log_dir):
+            return open(os.path.join(log_dir, "metrics.jsonl"), "a")
+
+        def dump_rows(outputpath, rows):
+            with open(outputpath, "w") as fh:
+                for row in rows:
+                    fh.write(json.dumps(row) + "\\n")
+        """, rules=["atomic-write"]) == []
+
+
+# ======================================================================
+# flint-threads: the three historical bug classes, as corpus fixtures
+# (each caught by exactly the intended rule; silent with the shipped
+# fix pattern applied)
+# ======================================================================
+_CONCURRENCY_RULES = ["signal-safety", "lock-discipline",
+                      "thread-escape", "atomic-write"]
+
+
+def test_historical_torn_snapshot_is_caught_by_thread_escape(tmp_path):
+    """Pre-PR-1 `_mp_submit`: the mailbox got the live payload by
+    reference; the writer serialized while training mutated in place."""
+    bad = """\
+        import threading
+
+        def payload(state):
+            return {"params": state["params"], "round": state["round"]}
+
+        def write_blob(blob):
+            return blob
+
+        class Manager:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._mailbox = None
+                self._worker = None
+
+            def _loop(self):
+                while True:
+                    with self._cond:
+                        while self._mailbox is None:
+                            self._cond.wait()
+                        snap = self._mailbox
+                        self._mailbox = None
+                    write_blob(snap)
+
+            def submit(self, state):
+                if self._worker is None:
+                    self._worker = threading.Thread(
+                        target=self._loop, name="ckpt-writer",
+                        daemon=True)
+                    self._worker.start()
+                with self._cond:
+                    self._mailbox = payload(state)
+                    self._cond.notify()
+        """
+    found = run_on(tmp_path, "engine/ckpt_bad.py", bad,
+                   rules=_CONCURRENCY_RULES)
+    assert rules_of(found) == ["thread-escape"]
+    assert "torn-snapshot" in found[0].message
+    # the shipped fix: np.copy the leaves before the handoff
+    fixed = bad.replace(
+        "                    self._mailbox = payload(state)",
+        "                    snap = {k: np.copy(v)\n"
+        "                            for k, v in "
+        "payload(state).items()}\n"
+        "                    self._mailbox = snap"
+    ).replace("        import threading",
+              "        import threading\n\n        import numpy as np")
+    assert fixed != bad
+    assert run_on(tmp_path, "engine/ckpt_fixed.py", fixed,
+                  rules=_CONCURRENCY_RULES) == []
+
+
+def test_historical_in_handler_flush_is_caught_by_signal_safety(tmp_path):
+    """Pre-PR-4: the SIGTERM handler flushed telemetry inline — file IO
+    and the tracer lock inside signal context."""
+    bad = """\
+        import signal
+
+        def flush_metrics():
+            fh = open("metrics.jsonl", "a")
+            fh.flush()
+
+        class PreemptionHandler:
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                flush_metrics()
+                self._requested = True
+        """
+    found = run_on(tmp_path, "resilience/pre_bad.py", bad,
+                   rules=_CONCURRENCY_RULES)
+    assert rules_of(found) == ["signal-safety"]
+    assert "_on_signal" in found[0].message
+    # the shipped fix: defer the flush behind the _from_signal flag,
+    # run it at the round loop's next poll
+    fixed = """\
+        import signal
+
+        def flush_metrics():
+            fh = open("metrics.jsonl", "a")
+            fh.flush()
+
+        class PreemptionHandler:
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self.request("signal", _from_signal=True)
+
+            def request(self, reason, _from_signal=False):
+                self._flush_pending = True
+                if not _from_signal:
+                    self.flush_now()
+
+            def flush_now(self):
+                self._flush_pending = False
+                flush_metrics()
+        """
+    assert run_on(tmp_path, "resilience/pre_fixed.py", fixed,
+                  rules=_CONCURRENCY_RULES) == []
+
+
+def test_historical_bare_rename_rotation_is_caught_by_atomic_write(
+        tmp_path):
+    """Pre-PR-3-hardening: `.prev` rotation via os.rename left a crash
+    instant with zero loadable latest slots."""
+    bad = """\
+        import os
+
+        def save_latest(model_dir, blob):
+            path = os.path.join(model_dir, "latest_model.msgpack")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            if os.path.exists(path):
+                os.rename(path, path + ".prev")
+            os.replace(tmp, path)
+        """
+    found = run_on(tmp_path, "engine/rotate_bad.py", bad,
+                   rules=_CONCURRENCY_RULES)
+    assert rules_of(found) == ["atomic-write"]
+    assert "no loadable slot" in found[0].message
+    # the shipped fix: hardlink rotation — the committed latest never
+    # disappears, so one slot always verifies
+    fixed = bad.replace(
+        "                os.rename(path, path + \".prev\")",
+        "                lnk = path + \".prev.lnk\"\n"
+        "                os.link(path, lnk)\n"
+        "                os.replace(lnk, path + \".prev\")")
+    assert fixed != bad
+    assert run_on(tmp_path, "engine/rotate_fixed.py", fixed,
+                  rules=_CONCURRENCY_RULES) == []
+
+
+# ======================================================================
+# flint-threads: disk-cache schema versioning
+# ======================================================================
+def test_summary_cache_invalidated_on_schema_bump(tmp_path):
+    """Entries are keyed by (mtime_ns, size) — stamps that do NOT
+    change when the ANALYZER changes — so a summary-extractor change in
+    a later PR could be served stale summaries missing its new fact
+    fields.  The schema key discards the cache wholesale on bump."""
+    import msrflute_tpu.analysis.core as core
+    from msrflute_tpu.analysis.core import (load_summary_cache,
+                                            save_summary_cache)
+
+    pkg = tmp_path / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text("def f():\n    return 1\n")
+    cache = {}
+    core.analyze([str(pkg)], root=str(tmp_path), cache=cache)
+    path = tmp_path / "cache.json"
+    save_summary_cache(str(path), cache)
+
+    raw = json.loads(path.read_text())
+    assert raw["schema"] == core.SUMMARY_SCHEMA_VERSION
+    assert set(load_summary_cache(str(path))) == {"engine/mod.py"}
+
+    # a cache written by yesterday's extractor: same stamps, old schema
+    raw["schema"] = core.SUMMARY_SCHEMA_VERSION - 1
+    path.write_text(json.dumps(raw))
+    assert load_summary_cache(str(path)) == {}
+    # ...and one with no schema key at all (the pre-versioning format)
+    del raw["schema"]
+    path.write_text(json.dumps(raw))
+    assert load_summary_cache(str(path)) == {}
+
+
+def test_signal_safety_deferred_guard_polarity_is_checked(tmp_path):
+    """`if _from_signal: flush()` runs the flush IN signal context —
+    only the NEGATED guard's body is blessed; the wrong polarity (and
+    its else-branch) keep flagging."""
+    found = run_on(tmp_path, "resilience/mod.py", """\
+        import signal
+
+        def flush_metrics():
+            fh = open("metrics.jsonl", "a")
+            fh.flush()
+
+        class Handler:
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self.request("signal", _from_signal=True)
+
+            def request(self, reason, _from_signal=False):
+                if _from_signal:
+                    self.flush_now()
+
+            def flush_now(self):
+                flush_metrics()
+        """, rules=["signal-safety"])
+    assert rules_of(found) == ["signal-safety"]
+    assert "opens a file" in found[0].message
+
+
+def test_lock_discipline_same_lock_wait_via_helper_is_fine(tmp_path):
+    """The checkpoint-writer wait loop refactored one call deep: the
+    held condition travels into the blocking closure, so `cond.wait()`
+    on the HELD lock stays sanctioned — while a wait on a different
+    lock through the same helper still flags."""
+    assert run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._box = None
+
+            def _wait_for_work(self):
+                while self._box is None:
+                    self._cond.wait()
+
+            def loop(self):
+                with self._cond:
+                    self._wait_for_work()
+        """, rules=["lock-discipline"]) == []
+    found = run_on(tmp_path, "engine/mod2.py", """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._io_cond = threading.Condition()
+
+            def _wait_for_io(self):
+                self._io_cond.wait()
+
+            def loop(self):
+                with self._cond:
+                    self._wait_for_io()
+        """, rules=["lock-discipline"])
+    assert rules_of(found) == ["lock-discipline"]
+    assert "_io_cond" in found[0].message
+
+
+def test_thread_escape_channels_are_module_scoped(tmp_path):
+    """An unrelated same-named class in another module must not
+    inherit a threaded class's cross-thread channels."""
+    (tmp_path / "engine").mkdir(parents=True)
+    (tmp_path / "engine" / "a.py").write_text(textwrap.dedent("""\
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._box = None
+
+            def _loop(self):
+                blob = self._box
+
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 name="writer").start()
+        """))
+    (tmp_path / "engine" / "b.py").write_text(textwrap.dedent("""\
+        class Manager:
+            def set_box(self, state):
+                self._box = state
+        """))
+    found = analyze([str(tmp_path / "engine")], root=str(tmp_path),
+                    rules={"thread-escape"})
+    assert found == []
+
+
+def test_thread_escape_container_display_of_live_refs_flags(tmp_path):
+    """`self._box = (state, 1)` builds a fresh tuple around the LIVE
+    object — the tear happens through the element, so a display is not
+    a snapshot unless its contents copy (or are pure literals)."""
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._box = None
+
+            def _loop(self):
+                blob = self._box
+
+            def submit(self, state):
+                threading.Thread(target=self._loop,
+                                 name="writer").start()
+                self._box = (state, 1)
+        """, rules=["thread-escape"])
+    assert rules_of(found) == ["thread-escape"]
+    # pure-literal displays stay fine
+    assert run_on(tmp_path, "engine/ok.py", """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._box = None
+
+            def _loop(self):
+                blob = self._box
+
+            def submit(self, state):
+                threading.Thread(target=self._loop,
+                                 name="writer").start()
+                self._box = (1, 2, 3)
+        """, rules=["thread-escape"]) == []
+
+
+def test_non_lock_acquire_receivers_do_not_register(tmp_path):
+    """`.acquire()` on a receiver that does not look like a lock (a
+    resource-pool slot) is not a lock op — no bogus acquire-without-
+    release, and no bogus signal-safety lock finding."""
+    assert run_on(tmp_path, "telemetry/mod.py", """\
+        class Pool:
+            def grab(self):
+                self._slot.acquire()
+                self.x = 1
+        """, rules=["lock-discipline"]) == []
+    assert run_on(tmp_path, "resilience/mod.py", """\
+        import signal
+
+        class H:
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+            def _on_signal(self, signum, frame):
+                self._slot.acquire()
+        """, rules=["signal-safety"]) == []
+
+
+def test_atomic_write_directory_variables_are_not_durable(tmp_path):
+    """A scratch file under the model directory is not a durable
+    artifact — the ARTIFACT tokens mark durability, not the directory
+    variable's name."""
+    assert run_on(tmp_path, "engine/mod.py", """\
+        import os
+
+        def write_notes(model_dir, text):
+            with open(os.path.join(model_dir, "notes.txt"), "w") as fh:
+                fh.write(text)
+        """, rules=["atomic-write"]) == []
+
+
+def test_lock_discipline_multi_item_with_contributes_order_edges(
+        tmp_path):
+    """`with a_lock, b_lock:` acquires in item order — an inversion
+    hiding behind the comma form must still flag."""
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+
+            def f(self):
+                with self.a_lock, self.b_lock:
+                    self.x = 1
+
+            def g(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        self.x = 2
+        """, rules=["lock-discipline"])
+    assert rules_of(found) == ["lock-discipline", "lock-discipline"]
+    assert all("order inversion" in f.message for f in found)
+
+
+def test_thread_escape_string_literal_displays_are_fine(tmp_path):
+    """A sentinel tuple of pure literals (`("stop", 0)`) is immutable
+    all the way down — no snapshot needed."""
+    assert run_on(tmp_path, "engine/mod.py", """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._box = None
+
+            def _loop(self):
+                blob = self._box
+
+            def submit(self):
+                threading.Thread(target=self._loop,
+                                 name="writer").start()
+                self._box = ("stop", 0)
+        """, rules=["thread-escape"]) == []

@@ -51,6 +51,9 @@ def test_every_checker_is_exercised_by_the_real_tree_or_corpus():
                  # flint v2: the interprocedural checkers
                  "shard-ready", "recompile-hazard", "transfer-budget",
                  "guard-matrix", "event-schema",
+                 # flint-threads: concurrency & durability
+                 "signal-safety", "lock-discipline", "thread-escape",
+                 "atomic-write",
                  # hygiene
                  "stale-suppression", "bare-suppression",
                  "unknown-suppression"):
