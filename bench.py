@@ -618,6 +618,19 @@ def bench_protocol(name, cfg, dataset, eval_users, *, warmup_rounds,
             "recompiles": int(server.engine.recompile_count),
             "compiled_programs": len(server.engine.compile_log),
         }
+        # compile-cost observability (ISSUE 12 satellite): the grad-step
+        # probe's own lower+compile seconds always, plus the per-entry-
+        # point map when the device-truth layer observed the run's
+        # compiles (telemetry.xla wraps every entry in _InstrumentedFn,
+        # which times the AOT path)
+        if cost is not None and cost.get("compile_seconds") is not None:
+            device_truth["grad_step_compile_seconds"] = \
+                cost["compile_seconds"]
+        if server.engine.xla is not None:
+            device_truth["compile_seconds"] = {
+                entry: rec["compile_seconds"]
+                for entry, rec in server.engine.xla.summary().items()
+                if "compile_seconds" in rec}
 
     secs_train = float(np.median(per_chunk))
     secs_per_round = secs_train + secs_eval / eval_every
@@ -704,6 +717,17 @@ def _server_overhead_extras(server) -> dict:
                          "devbus": server.engine.devbus.enabled,
                          "watchdog_findings":
                              len(scope.watchdog.findings)})
+    # precision mode joins the contract trio: a bf16-compute run is NOT
+    # comparable against an f32 baseline (different arithmetic, different
+    # convergence), so the policy rides every protocol entry — absent
+    # means the bit-identical f32 path
+    prec = None
+    sc_cfg = getattr(getattr(server, "config", None), "server_config",
+                     None)
+    if sc_cfg is not None:
+        prec = sc_cfg.get("precision")
+    out["precision"] = ({"enabled": False} if not prec else
+                        dict(prec, enabled=prec.get("enable", True)))
     # robust mode completes the trio: a fluteshield-defended run pays
     # screening (and possibly a sort-based robust combine) per round —
     # comparing it against an undefended baseline without the marker
@@ -1231,6 +1255,91 @@ def bench_robust_ab(on_tpu: bool) -> dict:
     return out
 
 
+def bench_megakernel_ab(on_tpu: bool) -> dict:
+    """Fused-epoch megakernel vs legacy unrolled epoch loop (ISSUE 12
+    acceptance): the SAME CNN protocol at ``num_epochs > 1``, run with
+    the default fused single-scan inner loop vs
+    ``megakernel.fused_epochs: false`` (the pre-PR trace, whose step-scan
+    body is CLONED once per epoch).  Steady-state per-step compute is
+    identical by construction — the bloat the fused path removes is
+    PROGRAM TEXT, so the headline ``secs_per_round`` here is
+    compile-INCLUSIVE (total wall from server build through ``rounds``
+    trained rounds, divided by rounds — what a short-lived or
+    shape-churning run actually pays); the steady-state number rides
+    along so nobody mistakes the win for a math change.  Per-arm
+    compile_seconds come from the device-truth layer's timed AOT path
+    (telemetry/xla.py) — the same observability the per-protocol
+    ``device_truth`` block now records."""
+    import tempfile
+
+    import jax
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.telemetry.timing import Stopwatch
+
+    epochs = 4 if on_tpu else 8
+    rounds = 10 if on_tpu else 2
+    steady = 10 if on_tpu else 2
+    out = {"protocol": "cnn_femnist" if on_tpu else "cnn_small",
+           "num_epochs": epochs,
+           "rounds_per_arm": rounds, "steady_rounds_per_arm": steady}
+    for arm, block in (("fused", None),
+                       ("legacy", {"fused_epochs": False})):
+        if on_tpu:
+            cfg = _flute_config({"model_type": "CNN", "num_classes": 62},
+                                20, 0.1, fuse=1)
+            data = _image_dataset(64, 240, (28, 28, 1), 62,
+                                  np.random.default_rng(0))
+        else:
+            # shrunken CNN (host-CPU conv minutes would blow the bench
+            # deadline at FEMNIST size); the program-bloat mechanism
+            # under test is identical — the legacy arm still clones the
+            # conv step-scan body once per epoch
+            cfg = _flute_config({"model_type": "CNN", "num_classes": 10,
+                                 "image_size": 14}, 8, 0.1, fuse=1)
+            cfg.server_config["num_clients_per_iteration"] = 8
+            data = _image_dataset(8, 8, (14, 14, 1), 10,
+                                  np.random.default_rng(0))
+        cfg.client_config["num_epochs"] = epochs
+        cfg.server_config["telemetry"] = {"enable": True}
+        if block is not None:
+            cfg.server_config["megakernel"] = dict(block)
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            with Stopwatch() as sw_cold:
+                server = OptimizationServer(task, cfg, data,
+                                            model_dir=tmp,
+                                            mesh=make_mesh(), seed=0)
+                cfg.server_config.max_iteration = rounds
+                server.train()
+                jax.block_until_ready(server.state.params)
+            cfg.server_config.max_iteration = rounds + steady
+            with Stopwatch() as sw_steady:
+                server.train()
+                jax.block_until_ready(server.state.params)
+            out[f"{arm}_secs_per_round"] = round(sw_cold.secs / rounds, 4)
+            out[f"{arm}_steady_secs_per_round"] = round(
+                sw_steady.secs / steady, 4)
+            if server.engine.xla is not None:
+                out[f"{arm}_compile_seconds"] = round(sum(
+                    rec.get("compile_seconds", 0.0)
+                    for rec in server.engine.xla.summary().values()), 3)
+            out[f"{arm}_compiled_programs"] = len(
+                server.engine.compile_log)
+            out[f"{arm}_recompiles"] = int(server.engine.recompile_count)
+    out["speedup"] = round(out["legacy_secs_per_round"]
+                           / max(out["fused_secs_per_round"], 1e-9), 3)
+    out["steady_speedup"] = round(
+        out["legacy_steady_secs_per_round"]
+        / max(out["fused_steady_secs_per_round"], 1e-9), 3)
+    out["regime"] = (
+        "compile-inclusive: the legacy arm's program text (and so its "
+        "compile time) grows linearly in num_epochs; steady-state "
+        "per-step math is identical by construction")
+    return out
+
+
 def _hetero_image_dataset(pool, shape, classes, rng, min_samples=4,
                           max_samples=256, small_frac=0.75):
     """Heterogeneous federated pool: ``small_frac`` of users hold a
@@ -1482,6 +1591,10 @@ def main() -> None:
     _env_block("robust", "BENCH_ROBUST",
                {"screen_nonfinite": True, "norm_multiplier": 5.0,
                 "aggregator": "mean"})
+    # precision contract marker (ISSUE 12): BENCH_PRECISION=1 runs every
+    # protocol under the default bf16-compute drill (f32 master params +
+    # f32 stats accumulators), or a JSON server_config.precision block
+    _env_block("precision", "BENCH_PRECISION", {"compute": "bfloat16"})
     if not on_tpu:
         # CPU fallback: carry the most recent committed raw on-chip
         # artifact, if any (written only by a fully successful TPU
@@ -1635,6 +1748,19 @@ def main() -> None:
                     bench_cohort_bucketing_ab(on_tpu)
         except Exception as exc:
             extras["cohort_bucketing_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # megakernel fused-epoch A/B: default-on for CPU runs (the epoch
+    # program-bloat acceptance evidence), env-gated on TPU like the rest
+    if (not on_tpu or os.environ.get("BENCH_MEGAKERNEL_AB")) and \
+            (keep is None or "megakernel_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("megakernel_ab"):
+                extras["megakernel_ab"] = bench_megakernel_ab(on_tpu)
+        except Exception as exc:
+            extras["megakernel_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

@@ -54,6 +54,10 @@ _SPEC_MAP = {
     "ROBUST_FIELD_SPECS": "ROBUST_KEYS",
     # cohort shape-bucketing (PR 8)
     "COHORT_BUCKETING_FIELD_SPECS": "COHORT_BUCKETING_KEYS",
+    # megakernel local SGD (PR 12); the precision block's fields are
+    # enum-typed (dtype names) so it keeps bespoke checks in validate()
+    # and has no scalar spec table
+    "MEGAKERNEL_FIELD_SPECS": "MEGAKERNEL_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -84,6 +88,14 @@ DOCUMENTED_KNOBS = (
     # tuning drill will keep paying masked FLOPs padding every client
     # to the slowest one
     "cohort_bucketing",
+    # megakernel local SGD: an operator who cannot find the fusion /
+    # pallas-apply knobs will keep paying per-epoch program bloat and
+    # sub-MXU optimizer tails on small models
+    "megakernel",
+    # precision policy: an operator who cannot find the bf16 drill will
+    # leave the MXU's half-rate f32 path on forever — or flip dtypes
+    # blind and lose bit-identity without knowing what they traded
+    "precision",
 )
 
 _DOC_MENTION_RE = re.compile(

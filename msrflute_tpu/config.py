@@ -419,6 +419,17 @@ class ServerConfig(Config):
     # schema.COHORT_BUCKETING_KEYS; absent (the default) keeps the
     # monolithic [K, S, B] round program
     cohort_bucketing: Optional[Dict[str, Any]] = None
+    # megakernel local SGD (engine/client_update.py): epoch/step loop
+    # fusion (default on even when the block is absent) and the opt-in
+    # pallas fused SGD apply — free-form dict validated by
+    # schema.MEGAKERNEL_KEYS; `enable: false` restores the legacy
+    # per-epoch unrolled trace for A/Bs
+    megakernel: Optional[Dict[str, Any]] = None
+    # precision policy (engine/client_update.py): params/compute/stats
+    # dtypes for the client inner loop — free-form dict validated by
+    # schema.PRECISION_KEYS; absent (the default) is the bit-identical
+    # f32 path
+    precision: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -441,7 +452,7 @@ class ServerConfig(Config):
             "initial_lr", "weight_train_loss", "stale_prob",
             "num_skip_decoding", "nbest_task_scheduler", "chaos",
             "checkpoint_retry", "telemetry", "robust",
-            "cohort_bucketing"]))
+            "cohort_bucketing", "megakernel", "precision"]))
         out.data_config = data
         out.optimizer_config = opt
         out.annealing_config = ann

@@ -9,7 +9,11 @@ preserved exactly (SURVEY.md §7):
 - fresh optimizer per client with the server-dictated LR
   (``core/client.py:309-312``) — optax init inside the function;
 - per-batch loss -> grad -> clip -> stats -> step
-  (``core/trainer.py:341-414``) — a ``lax.scan`` over the static step grid;
+  (``core/trainer.py:341-414``) — ONE ``lax.scan`` over the flattened
+  ``[num_epochs * steps]`` grid (megakernel epoch fusion, PR 12: the body
+  is traced once whatever the epoch count; ``megakernel.fused_epochs:
+  false`` restores the legacy one-scan-per-epoch unrolled trace, which is
+  bit-identical in f32 but whose program text grows linearly in epochs);
 - ``desired_max_samples`` early stop (``core/trainer.py:363-364``) — encoded
   in the batch packing (zero-mask beyond the cap), with all-padding steps
   gated so they change nothing;
@@ -42,6 +46,8 @@ import optax
 
 from ..models.base import BaseTask
 from ..optim import make_optimizer
+from ..optim.fused import (combine_grad_terms, fused_apply,
+                           sgd_pallas_fusable)
 
 
 @dataclass(frozen=True)
@@ -57,6 +63,33 @@ class ClientHParams:
     #: frozen at every inner step, like the reference's per-param lr=0
     #: (set_component_wise_lr, core/trainer.py:725-751)
     updatable_layers: Optional[Tuple[str, ...]] = None
+    #: megakernel epoch fusion (default ON): run all ``num_epochs *
+    #: steps`` local steps as ONE ``lax.scan`` instead of cloning the
+    #: step-scan body once per epoch — program size and compile time
+    #: stay flat in num_epochs (the PR-12 bloat fix;
+    #: ``server_config.megakernel.fused_epochs: false`` restores the
+    #: legacy unrolled trace for A/Bs).  num_epochs == 1 traces the
+    #: exact historical program either way.
+    fused_epochs: bool = True
+    #: opt-in pallas fused SGD apply (``server_config.megakernel.
+    #: pallas_apply``): the inner step's optimizer tail runs as ONE
+    #: kernel pass over the flattened param vector
+    #: (``ops.pallas_kernels.fused_sgd_apply``) instead of per-leaf XLA
+    #: ops — for small-model protocols whose leaves are too tiny to
+    #: tile.  Plain-SGD optimizers only (momentum ok); TPU-targeted
+    #: (interpret mode elsewhere).
+    pallas_apply: bool = False
+    #: precision policy (``server_config.precision``), each a dtype name
+    #: or None.  ``compute`` casts params + float batch features for the
+    #: forward/backward only (grads come back in the params dtype — the
+    #: f32 master-params discipline); ``params`` holds the client's
+    #: LOCAL working copy (and optimizer state) in that dtype;
+    #: ``stats`` sets the loss/grad-stat accumulator dtype.  None (or
+    #: "float32") compiles the exact f32 legacy trace — the bit-identity
+    #: default.
+    param_dtype: Optional[str] = None
+    compute_dtype: Optional[str] = None
+    stats_dtype: Optional[str] = None
 
 
 def _global_norm(tree: Any) -> jnp.ndarray:
@@ -93,6 +126,25 @@ def _derive_stats(s, s2, n) -> Dict[str, jnp.ndarray]:
     }
 
 
+def _resolve_dtype(name: Optional[str]):
+    """Dtype of a precision-policy entry; None for absent OR an explicit
+    "float32" — the two spellings must compile the identical program."""
+    if name is None or str(name) == "float32":
+        return None
+    dt = jnp.dtype(name)
+    if not jnp.issubdtype(dt, jnp.floating):
+        raise ValueError(f"precision dtype must be floating, got {name!r}")
+    return dt
+
+
+def _cast_floats(tree: Any, dt) -> Any:
+    """Cast every floating leaf to ``dt`` (ints/bools pass through —
+    token ids and masks keep their layouts)."""
+    return jax.tree.map(
+        lambda x: x.astype(dt)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x, tree)
+
+
 def build_client_update(task: BaseTask, client_opt_cfg,
                         hparams: ClientHParams) -> Callable:
     """Returns ``client_update(global_params, arrays, sample_mask, lr, rng)``
@@ -109,6 +161,36 @@ def build_client_update(task: BaseTask, client_opt_cfg,
     # Remat belongs INSIDE the model, per block — see model_config.remat
     # (models/ringlm.py, nn.remat around the transformer block).
     loss_fn = task.loss
+
+    # precision policy: "float32"/None compile the exact legacy trace —
+    # the cast helpers are built ONLY for a non-f32 dtype, so an absent
+    # (or explicit f32) policy cannot perturb bit-identity
+    pdt = _resolve_dtype(hparams.param_dtype)
+    cdt = _resolve_dtype(hparams.compute_dtype)
+    sdt = _resolve_dtype(hparams.stats_dtype) or jnp.float32
+    if cdt is not None:
+        base_loss = loss_fn
+
+        def loss_fn(p, batch, rng, train):  # noqa: F811 - deliberate wrap
+            # bf16 forward/backward: params + float features cast at the
+            # loss boundary; autodiff transposes the cast, so grads come
+            # back in the (f32 master) params dtype
+            return base_loss(_cast_floats(p, cdt),
+                             {k: _cast_floats(v, cdt)
+                              for k, v in batch.items()}, rng, train)
+
+    pallas_sgd = bool(hparams.pallas_apply)
+    if pallas_sgd and not sgd_pallas_fusable(client_opt_cfg):
+        raise ValueError(
+            "megakernel.pallas_apply requires a plain SGD client "
+            "optimizer (momentum ok; no nesterov/weight_decay) — got "
+            f"type={client_opt_cfg.get('type', 'sgd')!r}")
+    if pallas_sgd and hparams.updatable_layers is not None:
+        raise ValueError(
+            "megakernel.pallas_apply does not compose with "
+            "updatable_layers: the flat fused kernel has no per-leaf "
+            "freeze mask — drop one of them")
+    sgd_mu = float(client_opt_cfg.get("momentum", 0.0) or 0.0)
 
     def _updatable_mask(params):
         """Per-leaf PYTHON bools from the updatable_layers regex allowlist
@@ -139,8 +221,17 @@ def build_client_update(task: BaseTask, client_opt_cfg,
         ``c - c_i`` control variate (``strategies/scaffold.py``); it
         participates in clipping like any other gradient term.  ``None``
         compiles to the plain path."""
-        opt_state = tx.init(global_params)
-        opt_state.hyperparams["learning_rate"] = lr
+        local_params = (jax.tree.map(lambda w: w.astype(pdt), global_params)
+                        if pdt is not None else global_params)
+        if pallas_sgd:
+            # flat momentum carry + the trace-time unravel closure; the
+            # optax state machinery is bypassed entirely
+            from jax.flatten_util import ravel_pytree
+            flat0, unravel = ravel_pytree(local_params)
+            opt_state = jnp.zeros_like(flat0)
+        else:
+            opt_state = tx.init(local_params)
+            opt_state.hyperparams["learning_rate"] = lr
         update_mask = (_updatable_mask(global_params)
                        if hparams.updatable_layers is not None else None)
 
@@ -153,64 +244,88 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             rng, sub = jax.random.split(rng)
             (loss, _aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch, sub, True)
-            if grad_offset is not None:
-                grads = jax.tree.map(lambda g, o: g + o, grads, grad_offset)
-            if hparams.fedprox_mu > 0.0:
-                grads = jax.tree.map(
-                    lambda g, w, w0: g + hparams.fedprox_mu * (w - w0),
-                    grads, params, global_params)
-            if hparams.max_grad_norm is not None:
-                grads = _clip_by_global_norm(grads, hparams.max_grad_norm)
+            # offset + proximal + clip in one combining traversal
+            # (optim/fused.py; bit-identical association to the legacy
+            # three-pass spelling)
+            grads = combine_grad_terms(
+                grads, offset=grad_offset, prox_mu=hparams.fedprox_mu,
+                params=params, global_params=global_params,
+                max_norm=hparams.max_grad_norm)
             has_data = (jnp.sum(mask) > 0).astype(jnp.float32)
             # sufficient stats per batch (core/trainer.py:271-292)
             ds, ds2, dn = _suff_stats_of(grads)
-            s = s + has_data * ds
-            s2 = s2 + has_data * ds2
-            n_acc = n_acc + has_data * dn
-            loss_sum = loss_sum + has_data * loss
+            # the .astype(sdt) keeps the scan carry dtype stable under a
+            # non-f32 stats policy; same-dtype casts compile to nothing,
+            # so the f32 default trace is unchanged
+            s = (s + has_data * ds).astype(sdt)
+            s2 = (s2 + has_data * ds2).astype(sdt)
+            n_acc = (n_acc + has_data * dn).astype(sdt)
+            loss_sum = (loss_sum + has_data * loss).astype(sdt)
             # SAMPLE-weighted loss sum: loss is the batch's masked MEAN,
             # so loss * sum(mask) restores the per-sample sum — dividing
             # by (num_epochs * n_k) later gives a mean that is invariant
             # to how the samples were split into batches (q-FFL weights)
-            wloss_acc = wloss_acc + loss * jnp.sum(mask)
+            wloss_acc = (wloss_acc + loss * jnp.sum(mask)).astype(sdt)
             # the task decides how the trainer COUNTS its samples
             # (reference core/trainer.py:397-405: rows by default, token
             # positions for mlm/frame-bearing batches) — this feeds
             # aggregation weights and DGA's train_loss/num_samples metric
-            ns_acc = ns_acc + has_data * _aux.get(
-                "train_sample_count", jnp.sum(mask))
-            updates, new_opt = tx.update(grads, opt_state, params)
-            if update_mask is not None:
-                # frozen layers never move at ANY inner step (the per-param
-                # lr=0 semantics of the reference; momentum state still
-                # accumulates, exactly like torch SGD with lr=0); the mask
-                # is static, so frozen leaves are zero constants in XLA
-                updates = jax.tree.map(
-                    lambda u, keep: u if keep else jnp.zeros_like(u),
-                    updates, update_mask)
-            new_params = optax.apply_updates(params, updates)
-            # all-padding steps must be no-ops (momentum included)
-            params = jax.tree.map(
-                lambda new, old: jnp.where(has_data > 0, new, old),
-                new_params, params)
-            opt_state = jax.tree.map(
-                lambda new, old: jnp.where(has_data > 0, new, old),
-                new_opt, opt_state)
+            ns_acc = (ns_acc + has_data * _aux.get(
+                "train_sample_count", jnp.sum(mask))).astype(sdt)
+            if pallas_sgd:
+                # megakernel tail: the whole optimizer step is one
+                # fused pass over the flattened param vector, with the
+                # all-padding no-op gate folded into the kernel
+                from jax.flatten_util import ravel_pytree
+                from ..ops.pallas_kernels import fused_sgd_apply
+                new_p, opt_state = fused_sgd_apply(
+                    ravel_pytree(params)[0], ravel_pytree(grads)[0],
+                    opt_state, lr, sgd_mu, has_data)
+                params = unravel(new_p)
+            else:
+                # optimizer transform + frozen-layer mask + apply + the
+                # all-padding no-op pin (momentum included), apply+pin
+                # fused into one traversal (optim/fused.py)
+                params, opt_state = fused_apply(
+                    tx, grads, opt_state, params,
+                    update_mask=update_mask, has_data=has_data)
             return (params, opt_state, rng, loss_sum, s, s2, n_acc,
                     wloss_acc, ns_acc), None
 
-        params = global_params
-        loss_sum = jnp.zeros(())
-        s = jnp.zeros(())
-        s2 = jnp.zeros(())
-        n_acc = jnp.zeros(())
-        wloss_acc = jnp.zeros(())
-        ns_acc = jnp.zeros(())
+        params = local_params
+        loss_sum = jnp.zeros((), sdt)
+        s = jnp.zeros((), sdt)
+        s2 = jnp.zeros((), sdt)
+        n_acc = jnp.zeros((), sdt)
+        wloss_acc = jnp.zeros((), sdt)
+        ns_acc = jnp.zeros((), sdt)
         carry = (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc,
                  ns_acc)
-        for _ in range(hparams.num_epochs):
-            carry, _ = jax.lax.scan(carry_step := one_step, carry,
-                                    (arrays, sample_mask))
+        if hparams.num_epochs <= 1 or not hparams.fused_epochs:
+            # num_epochs == 1 is the exact historical trace either way;
+            # the legacy unrolled path (megakernel.fused_epochs: false)
+            # clones the scan body once per epoch — program size and
+            # compile time grow linearly in num_epochs (the A/B arm)
+            for _ in range(hparams.num_epochs):
+                carry, _ = jax.lax.scan(one_step, carry,
+                                        (arrays, sample_mask))
+        else:
+            # megakernel epoch fusion: ONE scan over the flattened
+            # [num_epochs * steps] grid — the body is traced once, and
+            # each step dynamic-slices its batch out of the resident
+            # [S, B, ...] grids (an HBM-local gather, no host bytes)
+            n_steps = sample_mask.shape[0]
+            step_ids = (jnp.arange(hparams.num_epochs * n_steps,
+                                   dtype=jnp.int32) % n_steps)
+
+            def fused_step(carry, t):
+                xs = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, t, 0, keepdims=False),
+                    (arrays, sample_mask))
+                return one_step(carry, xs)
+
+            carry, _ = jax.lax.scan(fused_step, carry, step_ids)
         (params, opt_state, rng, loss_sum, s, s2, n_acc, wloss_acc,
          ns_acc) = carry
 

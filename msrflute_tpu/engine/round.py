@@ -152,11 +152,53 @@ class RoundEngine:
         freeze = cc.get("freeze_layer") or []
         if isinstance(freeze, str):
             freeze = [freeze]
+        # megakernel local SGD (server_config.megakernel): epoch/step
+        # fusion is DEFAULT-ON (one scan over the flattened
+        # [num_epochs * steps] grid; num_epochs == 1 traces the exact
+        # historical program), the pallas fused SGD apply opt-in.  An
+        # explicit `enable: false` restores the full legacy trace.
+        _mk_raw = sc.get("megakernel") or {}
+        _mk_on = not _mk_raw or bool(_mk_raw.get("enable", True))
+        self.megakernel = {
+            "fused_epochs": bool(_mk_raw.get("fused_epochs", True))
+            if _mk_on else False,
+            "pallas_apply": bool(_mk_raw.get("pallas_apply", False))
+            if _mk_on else False,
+        }
+        if self.megakernel["pallas_apply"] and \
+                jax.default_backend() != "tpu":
+            # the round runs client_update inside shard_map over virtual
+            # CPU devices off-TPU, where interpret-mode pallas kernels
+            # deadlock (the documented reason ops/pallas_attention.py
+            # defaults to dense there) — refuse loudly instead of
+            # hanging the first round
+            raise ValueError(
+                "megakernel.pallas_apply requires a TPU backend: the "
+                "interpret-mode kernel cannot run inside the shard_map'd "
+                "round on CPU — drop the flag (fused_epochs still "
+                "applies) or run on TPU")
+        # precision policy (server_config.precision): params/compute/
+        # stats dtypes for the client inner loop.  Absent — or every
+        # entry "float32" — compiles the exact f32 legacy trace (the
+        # bit-identity default); `compute: bfloat16` runs the forward/
+        # backward in bf16 while master params and packed-stats
+        # accumulators stay f32.
+        _prec_raw = sc.get("precision") or {}
+        _prec_on = bool(_prec_raw) and bool(_prec_raw.get("enable", True))
+        self.precision = ({k: str(_prec_raw[k])
+                           for k in ("params", "compute", "stats")
+                           if _prec_raw.get(k) is not None}
+                          if _prec_on else {})
         self.hparams = ClientHParams(
             max_grad_norm=cc.get("max_grad_norm"),
             fedprox_mu=float(cc.get("fedprox_mu", 0.0) or 0.0),
             num_epochs=int(cc.get("num_epochs", 1) or 1),
             freeze_layers=tuple(freeze),
+            fused_epochs=self.megakernel["fused_epochs"],
+            pallas_apply=self.megakernel["pallas_apply"],
+            param_dtype=self.precision.get("params"),
+            compute_dtype=self.precision.get("compute"),
+            stats_dtype=self.precision.get("stats"),
         )
         self.client_update = build_client_update(
             task, cc.optimizer_config, self.hparams)

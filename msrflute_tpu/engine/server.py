@@ -1280,9 +1280,16 @@ class OptimizationServer:
     # ------------------------------------------------------------------
     def _drain_xla_events(self) -> None:
         """Emit the introspector's buffered compile/recompile events as
-        structured records (metrics stream + trace instants)."""
+        structured records (metrics stream + trace instants), plus the
+        attention dispatch gate's fallback records
+        (ops/pallas_attention.py — buffered at plan time, host-side)."""
+        if self.scope is None:
+            return
+        from ..ops.pallas_attention import drain_attention_events
+        for ev in drain_attention_events():
+            self.scope.event(ev.pop("kind"), **ev)
         reg = self.engine.xla
-        if reg is None or self.scope is None:
+        if reg is None:
             return
         for ev in reg.drain_events():
             self.scope.event(ev.pop("kind"), **ev)

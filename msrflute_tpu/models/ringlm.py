@@ -51,9 +51,11 @@ class _MHA(nn.Module):
     use_flash: bool = False
     #: Pallas kernel tiles (``flash_block_q`` x ``flash_block_k``) — the
     #: knobs tools/flash_crossover_sweep.py searches; config-settable so
-    #: a sweep's winning tiles apply without code edits
-    flash_block_q: int = 128
-    flash_block_k: int = 128
+    #: a sweep's winning tiles apply without code edits.  0 = let the
+    #: AOT-cost planner pick (local mode; ring mode needs concrete tiles
+    #: and treats 0 as 128)
+    flash_block_q: int = 0
+    flash_block_k: int = 0
 
     @nn.compact
     def __call__(self, x):  # [B, L, E]
@@ -69,9 +71,12 @@ class _MHA(nn.Module):
                                        flash_block_q=self.flash_block_q,
                                        flash_block_k=self.flash_block_k)
         elif self.use_flash:
+            # block 0 -> None: the dispatch gate prices candidate tiles
+            # against dense on the compiled cost model (and may fall
+            # back to dense with an attention_fallback_dense event)
             attn = flash_attention(q, k, v, causal=True,
-                                   block_q=self.flash_block_q,
-                                   block_k=self.flash_block_k)
+                                   block_q=self.flash_block_q or None,
+                                   block_k=self.flash_block_k or None)
         else:
             scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
             scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
@@ -251,8 +256,8 @@ def make_ringlm_task(model_config) -> RingLMTask:
         moe_experts=int(model_config.get("moe_experts", 0) or 0),
         use_flash=_resolve_flash(
             model_config.get("flash_attention", False), seq_len - 1),
-        flash_block_q=int(model_config.get("flash_block_q", 128)),
-        flash_block_k=int(model_config.get("flash_block_k", 128)))
+        flash_block_q=int(model_config.get("flash_block_q", 0) or 0),
+        flash_block_k=int(model_config.get("flash_block_k", 0) or 0))
     task = RingLMTask(module, seq_len=seq_len, name="ringlm")
     task.flash_flag = model_config.get("flash_attention", False)
     return task

@@ -53,12 +53,18 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_kernels import _resolve_interpret
 
 _LANES = 128
-# row statistics (lse/delta/glse) ride broadcast over a SMALL trailing dim:
-# a block whose last dim EQUALS the array dim is always legal, and 8 lanes
-# instead of 128 keeps the dkv pass's three full-length stat streams 16x
-# smaller in VMEM at long sequence lengths
-_STAT_LANES = 8
+# row statistics (lse/delta/glse) ride lane-broadcast over the trailing
+# dim.  PR-12 retile: the stat streams use FULL (8, 128)-aligned tiles —
+# the old 8-lane blocks saved VMEM but made every stat load/store a
+# sub-tile access, which mosaic serviced with masked sub-lane ops on the
+# hot dq/dkv inner loops (device truth measured the kernel at 0.53x of
+# dense at seq 2048 before the retile).  VMEM cost per grid step is
+# 3 stat blocks x block_q x 128 x 4B — comparable to one head-dim block,
+# well inside budget at the block sizes the planner picks.
+_STAT_LANES = _LANES
 _NEG = -1e30  # "minus infinity" that survives exp/max without NaNs
+#: default kernel tile when the caller pins blocks explicitly
+_DEF_BLOCK = 128
 
 
 def _pad_axis(x, axis, to):
@@ -276,8 +282,8 @@ def _specs(block_q, block_k, d_p):
                           lambda b, h, i, j, *_: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d_p),
                            lambda b, h, i, j, *_: (b, h, j, 0))
-    # per-row lse rides lane-broadcast as [B, H, lq_p, _STAT_LANES]
-    # flint: disable=pallas-shape 8-lane stat blocks are deliberate (lane-broadcast lse, jax's own tpu flash kernel trick); validated on silicon round 4
+    # per-row lse rides lane-broadcast as [B, H, lq_p, _STAT_LANES] —
+    # full (8, 128) tiles since the PR-12 retile
     lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
                             lambda b, h, i, j, *_: (b, h, i, 0))
     return q_spec, kv_spec, lse_spec
@@ -397,7 +403,6 @@ def _bwd(q, k, v, out, lse, q_offset, k_offset, g, g_lse, causal, scale,
                            lambda b, h, i, j, *_: (b, h, j, 0))
     kk_spec = pl.BlockSpec((1, 1, block_k, d_p),
                            lambda b, h, i, j, *_: (b, h, i, 0))
-    # flint: disable=pallas-shape 8-lane stat blocks are deliberate (lane-broadcast lse, see _specs); validated on silicon round 4
     kq_lse_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
                                lambda b, h, i, j, *_: (b, h, j, 0))
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal, scale=scale,
@@ -479,16 +484,190 @@ def _flash_lse_bwd(causal, block_q, block_k, interpret, res, cotangents):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+# ----------------------------------------------------------------------
+# AOT-cost dispatch gate (PR 12): never ship a losing kernel silently.
+#
+# The round-4 flash path regressed to 0.53x of dense at seq 2048 and
+# shipped anyway, because nothing compared the two compiled programs.
+# Now every compiled-TPU dispatch goes through a per-shape PLAN: the
+# flash forward is AOT-compiled at a handful of candidate (block_q,
+# block_k) tilings and the dense reference once, each scored on the
+# roofline estimate max(flops/peak, bytes/bandwidth) from the compiled
+# cost_analysis (telemetry/xla.py — the same helper PR 7 wired for
+# device truth).  The cheapest flash tiling wins the blocks; if DENSE
+# wins outright, the op falls back to dense and records an
+# ``attention_fallback_dense`` event the server drains into the
+# structured-event stream (docs/observability.md) — the regression is
+# loud, auditable, and costs nothing but the fallback itself.
+# ----------------------------------------------------------------------
+#: candidate kernel tilings the planner prices (explicit caller blocks
+#: are prepended); all (8, 128)-tile aligned
+_BLOCK_CANDIDATES = ((128, 128), (256, 256), (512, 512),
+                     (128, 256), (256, 128))
+#: shape-signature -> plan dict; one AOT shootout per distinct geometry
+_PLAN_CACHE: dict = {}
+#: pending ``{"kind": ...}`` structured-event records, drained by the
+#: server host tail (engine/server.py) — capped so an undrained CLI
+#: session cannot grow it unboundedly
+_PENDING_EVENTS: list = []
+_EVENTS_CAP = 64
+
+
+def drain_attention_events() -> list:
+    """Hand the buffered dispatch-gate events to the caller (the
+    server's host tail, which owns emitting them)."""
+    global _PENDING_EVENTS
+    out, _PENDING_EVENTS = _PENDING_EVENTS, []
+    return out
+
+
+def reset_attention_plans() -> None:
+    """Forget cached plans + pending events (tests)."""
+    _PLAN_CACHE.clear()
+    del _PENDING_EVENTS[:]
+
+
+def _roofline_secs(cost: Optional[dict]) -> float:
+    """Estimated execution seconds of a compiled program from its cost
+    analysis: ``max(flops / chip peak, bytes accessed / HBM bandwidth)``
+    — the roofline bound, the one-number score the gate compares."""
+    if not cost:
+        return float("inf")
+    from ..utils.compat import chip_hbm_bytes_per_sec, chip_peak_flops
+    flops = float(cost.get("flops") or 0.0)
+    bytes_acc = float(cost.get("bytes_accessed") or 0.0)
+    if flops <= 0.0 and bytes_acc <= 0.0:
+        return float("inf")
+    _, peak = chip_peak_flops()
+    _, bw = chip_hbm_bytes_per_sec()
+    return max(flops / peak, bytes_acc / bw)
+
+
+def _probe_costs(B, Lq, Lk, H, D, dtype, causal, candidates):
+    """Compiled cost analyses for the dense reference and each flash
+    candidate tiling, via the AOT path (abstract operands — nothing
+    touches device memory)."""
+    from ..telemetry.xla import aot_cost
+    q_s = jax.ShapeDtypeStruct((B, Lq, H, D), dtype)
+    kv_s = jax.ShapeDtypeStruct((B, Lk, H, D), dtype)
+    scale = float(1.0 / np.sqrt(D))
+
+    def dense_fn(q, k, v):
+        return _dense_lse(q, k, v, 0, 0, causal)
+
+    dense_cost = aot_cost(dense_fn, q_s, kv_s, kv_s)
+    flash_costs = {}
+    for bq, bk in candidates:
+        def flash_fn(q, k, v, _bq=bq, _bk=bk):
+            return _fwd(q, k, v, 0, 0, causal, scale, _bq, _bk, None)
+        flash_costs[(bq, bk)] = aot_cost(flash_fn, q_s, kv_s, kv_s)
+    return dense_cost, flash_costs
+
+
+def plan_attention(B: int, Lq: int, Lk: int, H: int, D: int, dtype,
+                   causal: bool, *, block_q: Optional[int] = None,
+                   block_k: Optional[int] = None,
+                   cost_probe=None) -> dict:
+    """Resolve (and cache) the dispatch plan for one attention geometry:
+    ``{"impl": "flash"|"dense", "block_q", "block_k", "flash_secs_est",
+    "dense_secs_est"}``.  Explicit ``block_q``/``block_k`` join the
+    candidate set in front (so a pinned tiling is honored when it wins)
+    but the gate still compares against dense — no silent-regression
+    path.  ``cost_probe`` overrides the AOT prober (tests).
+    """
+    dtype = jnp.dtype(dtype)
+    key = (B, Lq, Lk, H, D, str(dtype), bool(causal),
+           block_q, block_k, jax.default_backend())
+    plan = _PLAN_CACHE.get(key)
+    if plan is not None:
+        return plan
+    candidates = []
+    if block_q or block_k:
+        candidates.append((int(block_q or _DEF_BLOCK),
+                           int(block_k or _DEF_BLOCK)))
+    candidates += [c for c in _BLOCK_CANDIDATES if c not in candidates]
+    try:
+        dense_cost, flash_costs = (cost_probe or _probe_costs)(
+            B, Lq, Lk, H, D, dtype, bool(causal), candidates)
+        dense_secs = _roofline_secs(dense_cost)
+        # min() is stable: on tied roofline scores (cost_analysis often
+        # cannot see intra-kernel tiling differences) the FIRST candidate
+        # — the caller's pinned tiling when one was given — wins
+        scored = [(_roofline_secs(flash_costs[c]), c) for c in candidates
+                  if c in flash_costs]
+        flash_secs, best_blocks = min(scored, key=lambda t: t[0])
+        if not np.isfinite(flash_secs):
+            # no usable cost analysis for ANY kernel candidate (e.g. a
+            # backend whose cost_analysis() omits custom-call programs):
+            # that is a telemetry gap, not a measured loss — same policy
+            # as the probe-failure branch below, never a dense fallback
+            raise RuntimeError("no cost analysis for any flash candidate")
+    except Exception as exc:  # pragma: no cover - backend-specific
+        # planning failure is NOT a fallback trigger: keep the caller's
+        # pre-gate behavior (flash at the requested/default tiles) and
+        # say so — falling back to dense on an exotic probe error would
+        # turn a telemetry bug into an O(L^2) memory surprise
+        import logging
+
+        from ..utils.logging import print_rank
+        print_rank(f"attention plan probe failed ({exc!r}); keeping the "
+                   "flash kernel at the requested tiling",
+                   loglevel=logging.WARNING)
+        plan = {"impl": "flash",
+                "block_q": int(block_q or _DEF_BLOCK),
+                "block_k": int(block_k or _DEF_BLOCK),
+                "flash_secs_est": None, "dense_secs_est": None}
+        _PLAN_CACHE[key] = plan
+        return plan
+    plan = {"impl": "flash" if flash_secs <= dense_secs else "dense",
+            "block_q": int(best_blocks[0]), "block_k": int(best_blocks[1]),
+            "flash_secs_est": flash_secs, "dense_secs_est": dense_secs}
+    _PLAN_CACHE[key] = plan
+    if plan["impl"] == "dense":
+        import logging
+
+        from ..utils.logging import print_rank
+        if len(_PENDING_EVENTS) < _EVENTS_CAP:
+            _PENDING_EVENTS.append({
+                "kind": "attention_fallback_dense",
+                "batch": int(B), "seq_q": int(Lq), "seq_k": int(Lk),
+                "heads": int(H), "head_dim": int(D),
+                "causal": bool(causal),
+                "flash_secs_est": flash_secs,
+                "dense_secs_est": dense_secs,
+                "block_q": int(best_blocks[0]),
+                "block_k": int(best_blocks[1]),
+            })
+        print_rank(
+            "attention dispatch gate: dense beats the flash kernel on "
+            f"the compiled cost model at Lq={Lq} Lk={Lk} "
+            f"(est {dense_secs:.2e}s vs {flash_secs:.2e}s) — dense "
+            "fallback engaged (event: attention_fallback_dense)",
+            loglevel=logging.WARNING)
+    return plan
+
+
 def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         causal: bool = False, *, q_offset=0, k_offset=0,
-                        block_q: int = 128, block_k: int = 128,
-                        interpret: Optional[bool] = None):
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None,
+                        interpret: Optional[bool] = None,
+                        force_flash: bool = False):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp ``[B, H, Lq]`` (f32), with a VJP that honors its cotangent.
     ``q_offset``/``k_offset`` shift the global positions used by the
     causal mask — dynamic scalars, so ring rotations can jit one program.
     Rows whose keys are ALL masked come back as zeros with lse ≈ -1e30
-    (exact identity for the rotation-merge in ring attention)."""
+    (exact identity for the rotation-merge in ring attention).
+
+    ``block_q``/``block_k`` default to the AOT-cost planner's choice on
+    the compiled TPU path (explicit ints are priced as the first
+    candidate); the planner also compares the kernel against the dense
+    reference and falls back to dense — recording an
+    ``attention_fallback_dense`` event — when the compiled cost model
+    says the kernel loses.  ``force_flash=True`` bypasses the gate (ring
+    attention runs inside shard_map where per-shard planning would
+    re-probe per trace; its opt-in is explicit)."""
     if q.ndim != 4:
         raise ValueError(f"expected [B, L, H, D], got {q.shape}")
     if k.shape != v.shape:
@@ -497,14 +676,26 @@ def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # off-TPU default: exact dense math (see module docstring for why
         # interpret-mode kernels are not safe under shard_map)
         return _dense_lse(q, k, v, q_offset, k_offset, bool(causal))
+    if interpret is None and not force_flash:
+        # compiled TPU path: the dispatch gate
+        B, Lq, H, D = q.shape
+        plan = plan_attention(B, Lq, k.shape[1], H, D, q.dtype,
+                              bool(causal), block_q=block_q,
+                              block_k=block_k)
+        if plan["impl"] == "dense":
+            return _dense_lse(q, k, v, q_offset, k_offset, bool(causal))
+        block_q, block_k = plan["block_q"], plan["block_k"]
     return _flash_lse(q, k, v, q_offset, k_offset, bool(causal),
-                      int(block_q), int(block_k), interpret)
+                      int(block_q or _DEF_BLOCK),
+                      int(block_k or _DEF_BLOCK), interpret)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    causal: bool = False, *, block_q: int = 128,
-                    block_k: int = 128,
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
+                    causal: bool = False, *,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None,
+                    force_flash: bool = False) -> jnp.ndarray:
     """Exact attention over ``[B, L, H, D]`` tensors, tiled in VMEM.
 
     Softmax scale is ``1/sqrt(D)`` (matching ``models/ringlm.py``).
@@ -520,6 +711,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     math via a dense reference — O(Lq*Lk) score memory, not the tiled
     O(L) profile above (see module docstring for why).  The Pallas-tiled
     path runs only on TPU (compiled) or with ``interpret=True``.
+
+    The compiled-TPU path routes through the AOT-cost dispatch gate
+    (see :func:`flash_attention_lse`); ``force_flash=True`` bypasses it
+    — for kernel-validation tools that must exercise the kernel even
+    where the cost model prefers dense.
     """
     return flash_attention_lse(q, k, v, causal, block_q=block_q,
-                               block_k=block_k, interpret=interpret)[0]
+                               block_k=block_k, interpret=interpret,
+                               force_flash=force_flash)[0]

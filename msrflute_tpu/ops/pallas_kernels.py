@@ -1,8 +1,8 @@
-"""Pallas TPU kernels for the DP/quantization hot ops.
+"""Pallas TPU kernels for the DP/quantization/optimizer hot ops.
 
 SURVEY.md §2.9: the reference has no native components — its NCCL/Gloo layer
 maps to XLA collectives here, and the "custom kernel" obligation lands on
-the fused elementwise passes over flattened updates.  Two kernels:
+the fused elementwise passes over flattened updates.  Three kernels:
 
 - :func:`fused_gaussian_noise` — ``out = x * scale + sigma * N(0,1)`` with
   the Gaussian generated **on-core** (pltpu PRNG + Box-Muller).  The jnp
@@ -13,8 +13,15 @@ the fused elementwise passes over flattened updates.  Two kernels:
 - :func:`quant_bin_sparsify` — histogram binning to ``n_bins`` levels +
   magnitude sparsification in one pass (the elementwise core of
   ``ops.quantization``; min/max/quantile stay in XLA where sort belongs).
+- :func:`fused_sgd_apply` — the momentum-SGD parameter update over the
+  FLATTENED param vector in one pass: ``m' = g + mu*m``, ``p' = p -
+  lr*m'``, with the all-padding-step no-op gate folded in.  The opt-in
+  megakernel tail for small-model protocols whose per-leaf optimizer
+  ops are too tiny to feed the MXU (``server_config.megakernel.
+  pallas_apply``); XLA spells the same math as a dozen sub-lane-sized
+  ops per leaf, this kernel as three aligned HBM streams.
 
-Both degrade gracefully: on non-TPU backends they run in Pallas interpret
+All degrade gracefully: on non-TPU backends they run in Pallas interpret
 mode (tests) or fall back to jnp.
 """
 
@@ -168,3 +175,53 @@ def quant_bin_sparsify(flat: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
         interpret=interpret,
     )(jnp.asarray([lo, hi, thresh], jnp.float32), x2d)
     return out.reshape(-1)[:n].astype(flat.dtype)
+
+
+# ----------------------------------------------------------------------
+def _sgd_kernel(hyper_ref, p_ref, g_ref, m_ref, op_ref, om_ref):
+    lr = hyper_ref[0]
+    mu = hyper_ref[1]
+    gate = hyper_ref[2]
+    m_new = g_ref[:] + mu * m_ref[:]
+    p_new = p_ref[:] - lr * m_new
+    live = gate > 0
+    op_ref[:] = jnp.where(live, p_new, p_ref[:])
+    om_ref[:] = jnp.where(live, m_new, m_ref[:])
+
+
+def fused_sgd_apply(p_flat: jnp.ndarray, g_flat: jnp.ndarray,
+                    m_flat: jnp.ndarray, lr: jnp.ndarray,
+                    momentum: jnp.ndarray, gate: jnp.ndarray,
+                    interpret: Optional[bool] = None):
+    """One-pass momentum-SGD apply over flat f32 vectors.
+
+    ``(p', m') = (p - lr * m', g + mu * m)`` with ``gate <= 0`` pinning
+    both outputs to their inputs (the all-padding-step no-op of
+    ``engine/client_update.py``).  Matches ``optax.sgd(momentum=mu)``
+    exactly: the optax trace is ``t' = g + mu*t`` and the applied update
+    ``p + (-lr)*t'``, which is bitwise ``p - lr*t'`` in IEEE arithmetic
+    (tests/test_pallas_kernels.py pins the equivalence).
+    """
+    interpret = _resolve_interpret(interpret)
+    x2d, n = _pad_to_grid(p_flat.astype(jnp.float32))
+    g2d, _ = _pad_to_grid(g_flat.astype(jnp.float32))
+    m2d, _ = _pad_to_grid(m_flat.astype(jnp.float32))
+    rows = x2d.shape[0]
+    grid = rows // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i, *_: (i, 0))
+    new_p, new_m = pl.pallas_call(
+        _sgd_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(grid,),
+            in_specs=[spec, spec, spec],
+            out_specs=[spec, spec],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(x2d.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(x2d.shape, jnp.float32)],
+        interpret=interpret,
+    )(jnp.stack([jnp.asarray(lr, jnp.float32),
+                 jnp.asarray(momentum, jnp.float32),
+                 jnp.asarray(gate, jnp.float32)]), x2d, g2d, m2d)
+    return (new_p.reshape(-1)[:n].astype(p_flat.dtype),
+            new_m.reshape(-1)[:n].astype(m_flat.dtype))

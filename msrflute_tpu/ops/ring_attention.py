@@ -67,9 +67,13 @@ def ring_flash_attention_local(q, k0, v0, axis_name: str, causal: bool,
     def merge(acc, k_cur, v_cur, owner_shift):
         out_acc, lse_acc = acc
         owner = (idx - owner_shift) % n
+        # force_flash: the gate's AOT probe would re-run inside every
+        # shard_map trace, and use_flash=True is an explicit opt-in here
+        # (the crossover resolve in models/ringlm.py owns the choice)
         out_r, lse_r = flash_attention_lse(
             q, k_cur, v_cur, causal, q_offset=q_offset,
-            k_offset=owner * chunk, block_q=block_q, block_k=block_k)
+            k_offset=owner * chunk, block_q=int(block_q or 128),
+            block_k=int(block_k or 128), force_flash=True)
         # exact merge of independently-normalized rotation outputs:
         # out = sum_r exp(lse_r - lse_tot) * out_r
         lse_new = jnp.logaddexp(lse_acc, lse_r)

@@ -1,6 +1,9 @@
 from .factory import (  # noqa: F401
     make_optimizer, make_lr_schedule, PlateauTracker,
 )
+from .fused import (  # noqa: F401
+    combine_grad_terms, fused_apply, sgd_pallas_fusable,
+)
 from .schedulers import (  # noqa: F401
     NBestTaskScheduler, ScheduledSamplingScheduler,
 )

@@ -151,6 +151,29 @@ COHORT_BUCKETING_FIELD_SPECS = {
     # the scalar spec table cannot express
 }
 
+MEGAKERNEL_KEYS = {
+    "enable", "fused_epochs", "pallas_apply",
+}
+
+MEGAKERNEL_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    # epoch/step loop fusion (default ON, block absent or not): one
+    # lax.scan over the flattened [num_epochs * steps] grid — program
+    # size and compile time stay flat in num_epochs
+    "fused_epochs": ("bool", None, None),
+    # opt-in pallas fused SGD apply over the flattened param vector
+    # (plain-SGD client optimizers only; TPU-targeted)
+    "pallas_apply": ("bool", None, None),
+}
+
+PRECISION_KEYS = {
+    "enable", "params", "compute", "stats",
+}
+
+#: precision-policy dtype vocabulary (engine/client_update.py): each
+#: entry defaults to float32, the bit-identity spelling of "absent"
+ALLOWED_PRECISION_DTYPES = ["float32", "bfloat16", "float16"]
+
 #: robust aggregator vocabulary (mirrors robust.shield.AGGREGATORS)
 ALLOWED_ROBUST_AGGREGATORS = ["mean", "trimmed_mean", "median"]
 
@@ -315,6 +338,15 @@ SERVER_KEYS = {
     # per-client updates stay bit-identical to the monolithic grid
     # (docs/config_extensions.md, RUNBOOK "Tuning cohort buckets")
     "cohort_bucketing",
+    # megakernel local SGD: epoch/step loop fusion (default on) + the
+    # opt-in pallas fused SGD apply — `enable: false` restores the
+    # legacy per-epoch unrolled trace (docs/config_extensions.md)
+    "megakernel",
+    # precision policy: params/compute/stats dtypes for the client
+    # inner loop — absent is the bit-identical f32 path; compute:
+    # bfloat16 keeps f32 master params + f32 stats accumulators
+    # (docs/config_extensions.md, RUNBOOK "Choosing a precision policy")
+    "precision",
     "semisupervision", "updatable_names",
     "fedac_eta", "fedac_gamma", "fedac_alpha", "fedac_beta",
     "qffl_q",
@@ -732,6 +764,34 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                         "server_config.cohort_bucketing: "
                         f"{len(bounds)} boundaries exceed "
                         f"max_buckets={mb}")
+        mk = sc.get("megakernel")
+        if mk is not None and not isinstance(mk, dict):
+            errors.append(
+                "server_config.megakernel: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(mk).__name__}")
+        if isinstance(mk, dict):
+            _check_unknown(unknown, mk, "server_config.megakernel",
+                           MEGAKERNEL_KEYS)
+            _check_fields(errors, mk, "server_config.megakernel",
+                          MEGAKERNEL_FIELD_SPECS)
+        prec = sc.get("precision")
+        if prec is not None and not isinstance(prec, dict):
+            errors.append(
+                "server_config.precision: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(prec).__name__}")
+        if isinstance(prec, dict):
+            _check_unknown(unknown, prec, "server_config.precision",
+                           PRECISION_KEYS)
+            for key in ("params", "compute", "stats"):
+                _check_enum(errors, prec, "server_config.precision", key,
+                            ALLOWED_PRECISION_DTYPES)
+            en = prec.get("enable")
+            if en is not None and not isinstance(en, bool):
+                errors.append(
+                    "server_config.precision.enable: expected bool, got "
+                    f"{en!r}")
         ckpt_retry = sc.get("checkpoint_retry")
         if isinstance(ckpt_retry, dict):
             _check_unknown(unknown, ckpt_retry,

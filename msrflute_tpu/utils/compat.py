@@ -79,6 +79,43 @@ def chip_peak_flops(device=None):
     return kind, CPU_NOMINAL_PEAK_FLOPS
 
 
+#: HBM bandwidth (bytes/s) per TPU chip generation (vendor-published),
+#: matched like :data:`TPU_PEAK_FLOPS`.  The roofline denominator of the
+#: attention dispatch gate (ops/pallas_attention.py): estimated program
+#: seconds = max(flops / peak, bytes / bandwidth).
+TPU_HBM_BYTES_PER_SEC = {
+    "v2": 700e9,
+    "v3": 900e9,
+    "v4": 1228e9,
+    "v5e": 819e9,
+    "v5 lite": 819e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+    "v6 lite": 1640e9,
+}
+
+#: documented NOMINAL bandwidth for CPU / unknown kinds — the same
+#: fixed-round-number contract as :data:`CPU_NOMINAL_PEAK_FLOPS`
+CPU_NOMINAL_HBM_BYTES_PER_SEC = 5e10
+
+
+def chip_hbm_bytes_per_sec(device=None):
+    """``(kind, bytes_per_sec)`` for ``device`` (default: this process's
+    first jax device) — the memory-side twin of :func:`chip_peak_flops`,
+    with the identical longest-substring matching and CPU fallback."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "cpu") or "cpu").lower()
+    best = None
+    for key, bw in TPU_HBM_BYTES_PER_SEC.items():
+        if key in kind and (best is None or len(key) > len(best[0])):
+            best = (key, bw)
+    if best is not None:
+        return kind, best[1]
+    return kind, CPU_NOMINAL_HBM_BYTES_PER_SEC
+
+
 def profiler_start_trace(log_dir: str) -> bool:
     """Start a ``jax.profiler`` trace, tolerating old-jax/backend quirks
     (0.4.x raises from a second start or on backends without profiler

@@ -303,6 +303,26 @@ def test_pallas_shape_flags_tracer_dependent_loop_bound(tmp_path):
     assert "tracer-dependent" in found[0].message
 
 
+def test_pallas_shape_flags_sub_tile_stat_stream_blocks(tmp_path):
+    """The PR-2 flash-attention kernels rode 8-LANE lse/delta/glse stat
+    blocks behind two justified suppressions; device truth (PR 7)
+    measured the kernel at 0.53x of dense and PR 12 retiled them to full
+    (8, 128) tiles and DELETED the suppressions.  This corpus case pins
+    that the sub-(8, 128) stat-stream shape class stays flagged, so it
+    cannot quietly return."""
+    found = run_on(tmp_path, "ops/pallas_stat_stream.py", """\
+        from jax.experimental import pallas as pl
+
+        _STAT_LANES = 8
+
+        # lane-broadcast per-row statistic stream: [block_q, 8] blocks
+        lse_spec = pl.BlockSpec((1, 1, 128, _STAT_LANES),
+                                lambda b, h, i, j: (b, h, i, 0))
+        """, rules=["pallas-shape"])
+    assert rules_of(found) == ["pallas-shape"]
+    assert "trailing dim 8" in found[0].message
+
+
 def test_pallas_shape_aligned_constants_and_static_bounds_pass(tmp_path):
     found = run_on(tmp_path, "ops/pallas_good.py", """\
         import jax

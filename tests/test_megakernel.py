@@ -1,0 +1,250 @@
+"""Megakernel local SGD (ISSUE 12): fused epoch/step scan, fused
+apply-updates, the opt-in pallas SGD apply, and the epoch program-bloat
+regression guard.
+
+The two invariants this file pins:
+
+- **bit-identity** — the fused single-scan inner loop and the fused
+  apply-updates traversals compute the EXACT f32 bits of the legacy
+  per-epoch unrolled trace (engine-level: a whole federated run's params
+  match bitwise);
+- **program-size class** — a fused ``num_epochs=4`` program sits in the
+  same compiled-program size class as ``num_epochs=1``, pinned via
+  ``telemetry.xla.program_size_bytes`` (program TEXT, not wall-clock),
+  while the legacy unrolled trace demonstrably bloats linearly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig, ModelConfig, OptimizerConfig
+from msrflute_tpu.engine.client_update import (ClientHParams,
+                                               build_client_update)
+from msrflute_tpu.models import make_task
+from msrflute_tpu.telemetry.xla import program_size_bytes
+
+
+def _lr_task():
+    return make_task(ModelConfig(model_type="LR",
+                                 extra={"num_classes": 4, "input_dim": 8}))
+
+
+def _client_inputs(S=3, B=4, dim=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    arrays = {"x": jnp.asarray(rng.normal(size=(S, B, dim)), jnp.float32),
+              "y": jnp.asarray(rng.integers(0, classes, size=(S, B)),
+                               jnp.int32)}
+    # a ragged tail exercises the all-padding no-op pin
+    mask = jnp.ones((S, B), jnp.float32).at[S - 1, B // 2:].set(0.0)
+    return arrays, mask
+
+
+def _run(task, opt, hp, seed=42):
+    arrays, mask = _client_inputs()
+    cu = jax.jit(build_client_update(task, opt, hp))
+    return cu(task.init_params(jax.random.PRNGKey(0)), arrays, mask,
+              jnp.float32(0.1), jax.random.PRNGKey(seed))
+
+
+# ----------------------------------------------------------------------
+# bit-identity of the fused inner loop
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("opt", [
+    OptimizerConfig(type="sgd", lr=0.1, momentum=0.9),
+    OptimizerConfig(type="adam", lr=0.01),
+])
+def test_fused_epochs_bitwise_equals_legacy(opt):
+    task = _lr_task()
+    hp = dict(num_epochs=4, max_grad_norm=1.0, fedprox_mu=0.01)
+    out_f = _run(task, opt, ClientHParams(fused_epochs=True, **hp))
+    out_l = _run(task, opt, ClientHParams(fused_epochs=False, **hp))
+    for a, b in zip(jax.tree.leaves(out_f), jax.tree.leaves(out_l)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_single_epoch_identical_either_way():
+    """num_epochs == 1 must trace the exact historical program on both
+    paths (the fused grid degenerates to the plain scan)."""
+    task = _lr_task()
+    opt = OptimizerConfig(type="sgd", lr=0.1)
+    out_f = _run(task, opt, ClientHParams(num_epochs=1, fused_epochs=True))
+    out_l = _run(task, opt, ClientHParams(num_epochs=1, fused_epochs=False))
+    for a, b in zip(jax.tree.leaves(out_f), jax.tree.leaves(out_l)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------------------------
+# epoch program-bloat regression guard (ISSUE 12 satellite)
+# ----------------------------------------------------------------------
+def _program_size(num_epochs, fused):
+    task = _lr_task()
+    opt = OptimizerConfig(type="sgd", lr=0.1, momentum=0.9)
+    cu = build_client_update(task, opt, ClientHParams(
+        num_epochs=num_epochs, fused_epochs=fused, max_grad_norm=1.0))
+    arrays, mask = _client_inputs()
+    size = program_size_bytes(
+        jax.jit(cu), task.init_params(jax.random.PRNGKey(0)), arrays,
+        mask, jnp.float32(0.1), jax.random.PRNGKey(1))
+    assert size is not None and size > 0
+    return size
+
+
+def test_fused_epochs_hold_program_size_class():
+    """num_epochs=4 compiles the same program SIZE class as num_epochs=1
+    on the fused path (pinned via telemetry.xla program bytes, not
+    wall-clock): the scan body is traced once whatever the epoch count.
+    The legacy unrolled trace is the control — it must show the linear
+    bloat the fused path removes, or this guard guards nothing."""
+    fused_1 = _program_size(1, fused=True)
+    fused_4 = _program_size(4, fused=True)
+    fused_8 = _program_size(8, fused=True)
+    # one-time delta for the indexed-gather body is allowed; past that
+    # the program must be FLAT in the epoch count
+    assert fused_4 <= 1.25 * fused_1, (fused_1, fused_4)
+    assert fused_8 == fused_4, (fused_4, fused_8)
+    # control: the legacy unrolled trace must show the linear bloat this
+    # guard exists to catch (~one cloned scan body per extra epoch)
+    legacy_1 = _program_size(1, fused=False)
+    legacy_8 = _program_size(8, fused=False)
+    assert legacy_8 >= 1.8 * legacy_1, (legacy_1, legacy_8)
+    assert legacy_8 > 1.5 * fused_8, (fused_8, legacy_8)
+
+
+# ----------------------------------------------------------------------
+# fused apply-updates building blocks (optim/fused.py)
+# ----------------------------------------------------------------------
+def test_combine_grad_terms_matches_three_pass_spelling():
+    from msrflute_tpu.engine.client_update import _clip_by_global_norm
+    from msrflute_tpu.optim.fused import combine_grad_terms
+    rng = np.random.default_rng(3)
+    mk = lambda: {"a": jnp.asarray(rng.normal(size=(4, 3)), jnp.float32),
+                  "b": jnp.asarray(rng.normal(size=(5,)), jnp.float32)}
+    g, off, w, w0 = mk(), mk(), mk(), mk()
+    mu, max_norm = 0.05, 0.7
+    legacy = jax.tree.map(lambda x, o: x + o, g, off)
+    legacy = jax.tree.map(lambda x, a, b: x + mu * (a - b), legacy, w, w0)
+    legacy = _clip_by_global_norm(legacy, max_norm)
+    fused = combine_grad_terms(g, offset=off, prox_mu=mu, params=w,
+                               global_params=w0, max_norm=max_norm)
+    for a, b in zip(jax.tree.leaves(fused), jax.tree.leaves(legacy)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_apply_pins_no_data_steps():
+    import optax
+
+    from msrflute_tpu.optim.fused import fused_apply
+    tx = optax.sgd(0.1, momentum=0.9)
+    params = {"w": jnp.ones((3,))}
+    state = tx.init(params)
+    grads = {"w": jnp.full((3,), 2.0)}
+    moved, moved_state = fused_apply(tx, grads, state, params,
+                                     has_data=jnp.float32(1.0))
+    pinned, pinned_state = fused_apply(tx, grads, state, params,
+                                       has_data=jnp.float32(0.0))
+    assert not np.allclose(np.asarray(moved["w"]), np.asarray(params["w"]))
+    np.testing.assert_array_equal(np.asarray(pinned["w"]),
+                                  np.asarray(params["w"]))
+    for a, b in zip(jax.tree.leaves(pinned_state), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------------------------
+# pallas fused SGD apply (opt-in megakernel tail)
+# ----------------------------------------------------------------------
+def test_fused_sgd_apply_kernel_matches_optax():
+    import optax
+
+    from msrflute_tpu.ops.pallas_kernels import fused_sgd_apply
+    rng = np.random.default_rng(7)
+    n, mu, lr = 1000, 0.9, 0.05
+    p = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    m = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    tx = optax.sgd(lr, momentum=mu)
+    state = tx.init(p)
+    state = (optax.TraceState(trace=m),) + tuple(state[1:])
+    updates, new_state = tx.update(g, state, p)
+    want_p = optax.apply_updates(p, updates)
+    got_p, got_m = fused_sgd_apply(p, g, m, lr, mu, jnp.float32(1.0))
+    np.testing.assert_allclose(np.asarray(got_p), np.asarray(want_p),
+                               rtol=1e-7, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(got_m),
+                               np.asarray(new_state[0].trace),
+                               rtol=1e-7, atol=1e-7)
+    # gate <= 0 pins both outputs
+    pin_p, pin_m = fused_sgd_apply(p, g, m, lr, mu, jnp.float32(0.0))
+    np.testing.assert_array_equal(np.asarray(pin_p), np.asarray(p))
+    np.testing.assert_array_equal(np.asarray(pin_m), np.asarray(m))
+
+
+def test_pallas_apply_client_update_matches_optax_path():
+    task = _lr_task()
+    opt = OptimizerConfig(type="sgd", lr=0.1, momentum=0.9)
+    out_p = _run(task, opt, ClientHParams(num_epochs=2, pallas_apply=True))
+    out_o = _run(task, opt, ClientHParams(num_epochs=2, pallas_apply=False))
+    for a, b in zip(jax.tree.leaves(out_p), jax.tree.leaves(out_o)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_pallas_apply_refuses_unfusable_optimizers():
+    task = _lr_task()
+    with pytest.raises(ValueError, match="plain SGD"):
+        build_client_update(task, OptimizerConfig(type="adam", lr=0.01),
+                            ClientHParams(pallas_apply=True))
+    with pytest.raises(ValueError, match="updatable_layers"):
+        build_client_update(task, OptimizerConfig(type="sgd", lr=0.01),
+                            ClientHParams(pallas_apply=True,
+                                          updatable_layers=("dense",)))
+
+
+# ----------------------------------------------------------------------
+# engine-level f32 bit-identity: fused default vs full legacy trace
+# ----------------------------------------------------------------------
+def _server_cfg(megakernel=None):
+    raw = {
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": 4, "num_clients_per_iteration": 8,
+            "initial_lr_client": 0.3,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 10_000, "initial_val": False,
+            "data_config": {"val": {"batch_size": 64}},
+        },
+        "client_config": {
+            "num_epochs": 3,
+            "optimizer_config": {"type": "sgd", "lr": 0.3},
+            "data_config": {"train": {"batch_size": 4}},
+        },
+    }
+    if megakernel is not None:
+        raw["server_config"]["megakernel"] = megakernel
+    return FLUTEConfig.from_dict(raw)
+
+
+def _train_params(cfg, synth_dataset, mesh8, tmp_path, tag):
+    from msrflute_tpu.engine import OptimizationServer
+    task = make_task(cfg.model_config)
+    server = OptimizationServer(task, cfg, synth_dataset,
+                                model_dir=str(tmp_path / tag), mesh=mesh8,
+                                seed=0)
+    server.train()
+    return server.state.params
+
+
+def test_engine_fused_default_bitwise_equals_legacy(synth_dataset, mesh8,
+                                                    tmp_path):
+    """A whole multi-epoch federated run under the default fused inner
+    loop produces bit-identical params to `megakernel: {enable: false}`
+    (the pre-PR trace) — the engine-level f32 identity anchor."""
+    p_fused = _train_params(_server_cfg(), synth_dataset, mesh8,
+                            tmp_path, "fused")
+    p_legacy = _train_params(_server_cfg({"enable": False}), synth_dataset,
+                             mesh8, tmp_path, "legacy")
+    for a, b in zip(jax.tree.leaves(p_fused), jax.tree.leaves(p_legacy)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

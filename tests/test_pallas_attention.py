@@ -146,3 +146,160 @@ def test_flash_lse_cotangent_kernel():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=3e-5, rtol=3e-5,
                                    err_msg=f"d{name} (lse cotangent)")
+
+
+# ======================================================================
+# retiled stat streams (PR 12): the lse path at full (8, 128) tiles
+# ======================================================================
+def test_retiled_stat_lanes_are_full_tiles():
+    """The PR-2 8-lane lse/delta/glse stat blocks are gone: the streams
+    ride full 128-lane tiles (the pallas-shape rule now passes this
+    module with ZERO suppressions — tests/test_flint_clean.py gates the
+    tree)."""
+    from msrflute_tpu.ops.pallas_attention import _LANES, _STAT_LANES
+    assert _STAT_LANES == _LANES == 128
+
+
+def test_lse_values_match_dense_after_retile():
+    """flash_attention_lse's per-row logsumexp (the retiled stream's
+    payload) matches the dense reference exactly-enough, including
+    padded rows pinned at the -1e30 identity."""
+    from msrflute_tpu.ops.pallas_attention import (_dense_lse,
+                                                   flash_attention_lse)
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(2, 40, 2, 24)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 56, 2, 24)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 56, 2, 24)), jnp.float32)
+    out_k, lse_k = flash_attention_lse(q, k, v, causal=True, block_q=16,
+                                       block_k=16, interpret=True)
+    out_d, lse_d = _dense_lse(q, k, v, 0, 0, True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse_k), np.asarray(lse_d),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ======================================================================
+# AOT-cost dispatch gate (PR 12): no silent-regression path
+# ======================================================================
+def _fake_probe(dense, flash_of):
+    def probe(B, Lq, Lk, H, D, dtype, causal, candidates):
+        return dense, {c: flash_of(c) for c in candidates}
+    return probe
+
+
+def test_gate_falls_back_to_dense_and_records_event():
+    from msrflute_tpu.ops import pallas_attention as pa
+    pa.reset_attention_plans()
+    try:
+        plan = pa.plan_attention(
+            2, 2048, 2048, 8, 64, jnp.float32, True,
+            cost_probe=_fake_probe(
+                {"flops": 1e9, "bytes_accessed": 1e6},
+                lambda c: {"flops": 5e9, "bytes_accessed": 5e6}))
+        assert plan["impl"] == "dense"
+        assert plan["dense_secs_est"] < plan["flash_secs_est"]
+        events = pa.drain_attention_events()
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["kind"] == "attention_fallback_dense"
+        assert ev["seq_q"] == 2048 and ev["causal"] is True
+        # drained means drained; and the cached plan does not re-emit
+        assert pa.drain_attention_events() == []
+        again = pa.plan_attention(2, 2048, 2048, 8, 64, jnp.float32, True)
+        assert again is plan and pa.drain_attention_events() == []
+    finally:
+        pa.reset_attention_plans()
+
+
+def test_gate_picks_cheapest_flash_blocks_when_kernel_wins():
+    from msrflute_tpu.ops import pallas_attention as pa
+    pa.reset_attention_plans()
+    try:
+        def flash_cost(c):
+            # (256, 256) is the planted winner
+            penalty = 0.0 if c == (256, 256) else 1e9
+            return {"flops": 1e9 + penalty, "bytes_accessed": 1e6}
+        plan = pa.plan_attention(
+            2, 2048, 2048, 8, 64, jnp.float32, False,
+            cost_probe=_fake_probe(
+                {"flops": 9e9, "bytes_accessed": 9e6}, flash_cost))
+        assert plan["impl"] == "flash"
+        assert (plan["block_q"], plan["block_k"]) == (256, 256)
+        assert pa.drain_attention_events() == []
+    finally:
+        pa.reset_attention_plans()
+
+
+def test_gate_prices_explicit_blocks_first():
+    from msrflute_tpu.ops import pallas_attention as pa
+    pa.reset_attention_plans()
+    try:
+        seen = []
+        def probe(B, Lq, Lk, H, D, dtype, causal, candidates):
+            seen.extend(candidates)
+            return ({"flops": 9e9, "bytes_accessed": 1e6},
+                    {c: {"flops": 1e9, "bytes_accessed": 1e6}
+                     for c in candidates})
+        plan = pa.plan_attention(1, 512, 512, 2, 64, jnp.float32, True,
+                                 block_q=64, block_k=64, cost_probe=probe)
+        assert seen[0] == (64, 64)
+        # equal scores: sorted() keeps the cheapest-first winner stable
+        assert plan["impl"] == "flash"
+    finally:
+        pa.reset_attention_plans()
+
+
+def test_gate_real_probe_runs_on_cpu():
+    """The real AOT prober end-to-end on a tiny shape (interpret-mode
+    kernel + dense reference through telemetry.xla.aot_cost): whatever
+    impl wins, the plan is complete and cached."""
+    from msrflute_tpu.ops import pallas_attention as pa
+    pa.reset_attention_plans()
+    try:
+        plan = pa.plan_attention(1, 64, 64, 2, 32, jnp.float32, True,
+                                 block_q=32, block_k=32)
+        assert plan["impl"] in ("flash", "dense")
+        assert plan["block_q"] > 0 and plan["block_k"] > 0
+        assert plan["flash_secs_est"] is not None
+    finally:
+        pa.reset_attention_plans()
+
+
+def test_gate_tied_scores_honor_pinned_blocks():
+    """cost_analysis often cannot see intra-kernel tiling, so candidate
+    scores tie — a caller-pinned tiling must win the tie, not whichever
+    tuple sorts first."""
+    from msrflute_tpu.ops import pallas_attention as pa
+    pa.reset_attention_plans()
+    try:
+        plan = pa.plan_attention(
+            1, 2048, 2048, 4, 64, jnp.float32, True,
+            block_q=512, block_k=512,
+            cost_probe=_fake_probe(
+                {"flops": 9e9, "bytes_accessed": 9e6},
+                lambda c: {"flops": 1e9, "bytes_accessed": 1e6}))
+        assert plan["impl"] == "flash"
+        assert (plan["block_q"], plan["block_k"]) == (512, 512)
+    finally:
+        pa.reset_attention_plans()
+
+
+def test_gate_treats_missing_flash_costs_as_probe_failure():
+    """A backend whose cost_analysis omits the kernel programs (inf
+    score) while pricing dense finitely must NOT fall back to dense —
+    a telemetry gap is not a measured loss (the O(L^2) surprise the
+    policy forbids)."""
+    from msrflute_tpu.ops import pallas_attention as pa
+    pa.reset_attention_plans()
+    try:
+        plan = pa.plan_attention(
+            1, 2048, 2048, 4, 64, jnp.float32, True,
+            block_q=256, block_k=256,
+            cost_probe=_fake_probe({"flops": 1e9, "bytes_accessed": 1e6},
+                                   lambda c: {}))
+        assert plan["impl"] == "flash"
+        assert (plan["block_q"], plan["block_k"]) == (256, 256)
+        assert pa.drain_attention_events() == []
+    finally:
+        pa.reset_attention_plans()

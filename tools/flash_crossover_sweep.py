@@ -84,7 +84,8 @@ def main() -> int:
             if bq > L or bk > L:
                 continue
             fa = functools.partial(flash_attention, causal=True,
-                                   block_q=bq, block_k=bk)
+                                   block_q=bq, block_k=bk,
+                                   force_flash=True)
             try:
                 row[f"flash_{bq}x{bk}_fwd_bwd_ms"] = \
                     1e3 * grad_wall(fa, q, k, v)

@@ -67,7 +67,10 @@ def main() -> int:
         return jnp.einsum("bhlm,bmhd->blhd", p, v)
 
     def flash(q, k, v):
-        return flash_attention(q, k, v, causal=True)
+        # force_flash: this arm must TIME THE KERNEL — the dispatch gate
+        # substituting dense here would validate the crossover constant
+        # against dense-vs-dense timings (vacuously)
+        return flash_attention(q, k, v, causal=True, force_flash=True)
 
     out = {"backend": "tpu", "flash_auto_min_len": FLASH_AUTO_MIN_LEN,
            "sweep_crossover": cross, "sides": {}}

@@ -33,12 +33,13 @@ def main() -> int:
 
     ok = True
     for causal in (False, True):
-        o = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal))(q, k, v)
+        o = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal, force_flash=True))(q, k, v)
         err = float(jnp.max(jnp.abs(o - dense(q, k, v, causal))))
         print(("causal" if causal else "full  "), "fwd max err:", err)
         ok &= err < 1e-2
         gf = jax.jit(jax.grad(
-            lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal) ** 2),
+            lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal,
+                                    force_flash=True) ** 2),
             argnums=(0, 1, 2)))(q, k, v)
         gd = jax.grad(
             lambda q, k, v: jnp.sum(dense(q, k, v, causal) ** 2),
@@ -56,7 +57,8 @@ def main() -> int:
         def f(q, k, v):
             if flash:
                 out, lse = flash_attention_lse(q, k, v, causal=True,
-                                               q_offset=256, k_offset=64)
+                                               q_offset=256, k_offset=64,
+                                               force_flash=True)
             else:
                 out, lse = _dense_lse(q, k, v, 256, 64, True)
             return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
