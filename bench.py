@@ -717,6 +717,17 @@ def _server_overhead_extras(server) -> dict:
                          "devbus": server.engine.devbus.enabled,
                          "watchdog_findings":
                              len(scope.watchdog.findings)})
+    # endurance marker (ISSUE 13): whether the longitudinal layer —
+    # windowed rollups + flight recorder — was live for this protocol,
+    # and how many rollup windows actually flushed; a run babysat by
+    # `scope watch`/`scope health` can never be silently compared
+    # against one that wasn't
+    rollup = getattr(scope, "rollup", None)
+    out["endurance"] = ({"enabled": False} if rollup is None else
+                        {"enabled": True,
+                         "rollup_windows": int(rollup.windows_flushed),
+                         "flight": getattr(scope, "flight", None)
+                         is not None})
     # precision mode joins the contract trio: a bf16-compute run is NOT
     # comparable against an f32 baseline (different arithmetic, different
     # convergence), so the policy rides every protocol entry — absent
@@ -1595,6 +1606,31 @@ def main() -> None:
     # protocol under the default bf16-compute drill (f32 master params +
     # f32 stats accumulators), or a JSON server_config.precision block
     _env_block("precision", "BENCH_PRECISION", {"compute": "bfloat16"})
+    # endurance guard (ISSUE 13): BENCH_ENDURANCE=1 arms the days-long
+    # posture on every protocol — rollups + flight recorder +
+    # longitudinal watchdogs AND the chaos drill — or a JSON object of
+    # server_config blocks for a custom drill.  Composite (telemetry
+    # plus chaos), so it cannot ride the single-block _env_block helper;
+    # the marker discipline is the same: always recorded.
+    env = os.environ.get("BENCH_ENDURANCE")
+    if not env:
+        extras["endurance"] = {"enabled": False}
+    else:
+        blocks = (json.loads(env) if env.strip().startswith("{") else {
+            "telemetry": {"enable": True, "rollup_window": 4,
+                          "max_log_mb": 64,
+                          "watchdog": {"rss_leak_action": "log",
+                                       "throughput_drift_action": "log",
+                                       "stall_action": "log",
+                                       "stall_grace_secs": 300.0}},
+            "chaos": {"seed": 0, "dropout_rate": 0.1,
+                      "straggler_rate": 0.1,
+                      "straggler_inflation": 2.0,
+                      "ckpt_io_error_rate": 0.05}})
+        for spec in protocols.values():
+            for key, blk in blocks.items():
+                spec["cfg"].server_config[key] = dict(blk)
+        extras["endurance"] = dict(blocks, enabled=True)
     if not on_tpu:
         # CPU fallback: carry the most recent committed raw on-chip
         # artifact, if any (written only by a fully successful TPU

@@ -40,6 +40,7 @@ from ..resilience import PreemptionHandler, make_chaos
 from ..resilience.integrity import RetryPolicy
 from ..strategies import select_strategy
 from ..telemetry import NULL_SPAN, emit_event, make_telemetry
+from ..telemetry.rollup import host_rss_bytes
 from ..utils.logging import flush_metrics, log_metric, print_rank
 from ..utils.metrics import Metric, MetricsDict
 from ..utils.strict import strict_transfer_scope
@@ -215,9 +216,15 @@ class OptimizationServer:
         if self.scope is not None:
             self.ckpt.telemetry = self.scope
             self.scope.watchdog.on_mark = self._watchdog_mark
+            # flight-record context (ISSUE 13): the persisted forensic
+            # snapshot embeds the run's scorecard, built at persist time
+            self.scope.set_flight_context(self.build_scorecard)
             # a SIGTERM must make the trace/metrics durable BEFORE the
-            # drain starts (the drain itself may wedge)
+            # drain starts (the drain itself may wedge); the flight
+            # record persists in the same window — if the drain then
+            # wedges past the grace period, the black box is on disk
             self.preemption.add_flush_hook(self.scope.flush)
+            self.preemption.add_flush_hook(self._flight_on_preempt)
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -633,6 +640,13 @@ class OptimizationServer:
         log so a post-mortem sees it without the metrics stream."""
         self.ckpt.update_status({f"watchdog_{kind}": dict(fields)})
 
+    def _flight_on_preempt(self) -> None:
+        """Preemption flush hook: persist the flight record as part of
+        the pre-drain durability window (runs OUTSIDE signal context,
+        at the round loop's poll — the deferred-flush discipline)."""
+        self.scope.record_flight(
+            f"preemption: {self.preemption.reason or 'requested'}")
+
     # ------------------------------------------------------------------
     def _next_rng(self) -> jax.Array:
         """The run's next device RNG stream: ``fold_in(base, n)`` with a
@@ -687,6 +701,11 @@ class OptimizationServer:
         self.preempted = False
         self.preemption.reset()  # a past preemption must not latch forever
         self.preemption.install()
+        if self.scope is not None:
+            # stall monitor (ISSUE 13): a named daemon thread polling
+            # the round-completion heartbeat — spawned only when
+            # telemetry.watchdog.stall_action is not "off"
+            self.scope.watchdog.start_stall_monitor()
         try:
             # strict transfer mode (MSRFLUTE_STRICT_TRANSFERS=1,
             # fluteguard's runtime half): the whole round loop — fused,
@@ -697,7 +716,7 @@ class OptimizationServer:
             # the env flag.
             with strict_transfer_scope():
                 return self._train_loop()
-        except BaseException:
+        except BaseException as exc:
             # a mid-loop abort (WatchdogAbort, checkpoint escalation,
             # Ctrl-C) skips _train_loop's normal tail: await in-flight
             # async checkpoint saves so the resume anchor is not missing
@@ -706,8 +725,28 @@ class OptimizationServer:
                 self.ckpt.wait()
             except Exception:
                 pass
+            if self.scope is not None:
+                # the flight record IS the abnormal exit's deliverable:
+                # last-N events + live rollup window + scorecard,
+                # persisted atomically before the stack unwinds further
+                try:
+                    self.scope.record_flight(
+                        f"exception: {type(exc).__name__}",
+                        detail=str(exc))
+                except Exception:
+                    pass
             raise
         finally:
+            if self.scope is not None:
+                self.scope.watchdog.stop_stall_monitor()
+                if self.scope.rollup is not None:
+                    # the trailing partial window still holds up to
+                    # window-1 rounds of trend data — flush it so the
+                    # on-disk rollup stream covers the whole run
+                    try:
+                        self.scope.rollup.flush_window(partial=True)
+                    except Exception:
+                        pass
             if self.scope is not None:
                 # the trace of an ABORTED run is exactly the trace the
                 # operator needs; close any open profiler window and
@@ -1144,8 +1183,20 @@ class OptimizationServer:
         self.run_stats["secsPerRoundHostTail"].append(
             (time.time() - toc) / R)
         if self.scope is not None:
+            mfu_before = len(self.run_stats["mfuPerRound"])
             self._drain_device_truth(chunk, round0, R)
-        if self.scope is not None:
+            # this chunk's live MFU, iff the device-truth tail computed
+            # one just now — the rollup's per-round mfu column
+            chunk_mfu = (self.run_stats["mfuPerRound"][-1]
+                         if len(self.run_stats["mfuPerRound"]) > mfu_before
+                         else None)
+            # one host RSS reading per chunk (a /proc line — pure host
+            # IO, zero device access) feeds the rss_leak detector and
+            # the rollup gauge
+            rss = host_rss_bytes()
+            xla_snap = (self.engine.xla.snapshot()
+                        if self.engine.xla is not None else
+                        {"recompiles": int(self.engine.recompile_count)})
             # watchdogs run over values this tail ALREADY holds: the
             # fetched per-round losses, the wall clock, the checkpoint
             # escalator's consecutive-failure count.  A configured
@@ -1171,7 +1222,15 @@ class OptimizationServer:
                     quarantine_frac=quarantine_frac,
                     # always-on engine counter (compiled variants beyond
                     # the first per entry point) — feeds recompile_storm
-                    recompiles=self.engine.recompile_count)
+                    recompiles=self.engine.recompile_count,
+                    host_rss_bytes=rss)
+                # endurance rollup (ISSUE 13): the same already-held
+                # host values, windowed — zero new transfers
+                self.scope.rollup_observe(
+                    round0 + j, secs,
+                    clients=float(stats["client_count"][j]),
+                    mfu=chunk_mfu, rss_bytes=rss,
+                    xla_snapshot=xla_snap)
 
     def _drain_host_tail(self, chunk: Dict[str, Any], stats,
                          val_freq: int, rec_freq: int) -> None:
@@ -1356,6 +1415,14 @@ class OptimizationServer:
                 kind = str(finding.get("kind", "?"))
                 fires[kind] = fires.get(kind, 0) + 1
         card["watchdog_fires"] = fires
+        if self.scope is not None and self.scope.tracer is not None:
+            # the Tracer's 1M-event cap used to drop silently past the
+            # in-trace flag; endurance gates need the drop COUNT on the
+            # regression surface (ISSUE 13 satellite)
+            card["trace_events_dropped"] = int(self.scope.tracer.dropped)
+        if self.scope is not None and self.scope.rollup is not None:
+            card["rollup_windows"] = int(
+                self.scope.rollup.windows_flushed)
         if self.cohort_bucketing is not None:
             card["cohort_bucketing"] = {
                 "boundaries": list(self.cohort_bucketing["boundaries"]),
@@ -1697,6 +1764,11 @@ class OptimizationServer:
             # the rewrite is O(events), paid at most every
             # Tracer.FLUSH_INTERVAL_SECS)
             self.scope.flush_throttled()
+            # endurance rollups flush on the same cadence: at most one
+            # appended record per rollup_window rounds, then the window
+            # state resets — host memory stays O(window) for any run
+            # length (ISSUE 13)
+            self.scope.rollup_housekeeping()
         self.run_stats["secsPerRoundHousekeeping"].append(
             time.time() - housekeeping_tic)
 

@@ -25,6 +25,7 @@ import logging
 import os
 import signal
 import threading
+import time
 from typing import Optional
 
 from ..utils.logging import print_rank
@@ -61,6 +62,10 @@ class PreemptionHandler:
         self.escalate_after = max(int(escalate_after), 1)
         self._event = threading.Event()
         self._reason: Optional[str] = None
+        #: epoch seconds of the first request this window (None until
+        #: one lands) — the flight recorder / endurance harness read it
+        #: to bound how long the drain has been running
+        self._requested_at: Optional[float] = None
         self._prev = {}
         self._installed = False
         self._hits = 0
@@ -84,6 +89,10 @@ class PreemptionHandler:
     def reason(self) -> Optional[str]:
         return self._reason
 
+    @property
+    def requested_at(self) -> Optional[float]:
+        return self._requested_at
+
     def reset(self) -> None:
         """Clear a latched request + the signal hit-count — called at the
         start of each training window so a server that preempted once
@@ -91,6 +100,7 @@ class PreemptionHandler:
         next ``train()`` instantly with zero progress."""
         self._event.clear()
         self._reason = None
+        self._requested_at = None
         self._hits = 0
         self._flush_pending = False
 
@@ -114,6 +124,10 @@ class PreemptionHandler:
         """
         if not self._event.is_set():
             self._reason = reason
+            # time.time() is async-signal-safe enough for a float stamp
+            # (no locks, no allocation beyond the float) — unlike the
+            # IO/logging deferred to flush_now
+            self._requested_at = time.time()
             self._flush_pending = True
             if not _from_signal:
                 self.flush_now()

@@ -197,6 +197,9 @@ CHECKPOINT_RETRY_KEYS = {
 TELEMETRY_KEYS = {
     "enable", "trace", "devbus", "profile_rounds", "watchdog",
     "xla", "scorecard",
+    # endurance layer (ISSUE 13): windowed rollups, flight recorder,
+    # size-capped log rotation
+    "rollup", "rollup_window", "flight", "flight_events", "max_log_mb",
 }
 
 WATCHDOG_KEYS = {
@@ -205,6 +208,11 @@ WATCHDOG_KEYS = {
     "quarantine_rate_action", "quarantine_rate_threshold",
     "recompile_storm_action", "recompile_storm_threshold",
     "recompile_storm_warmup_rounds",
+    # longitudinal detectors (ISSUE 13)
+    "stall_action", "stall_factor", "stall_poll_secs",
+    "stall_grace_secs", "rss_leak_action", "rss_leak_window",
+    "rss_leak_mb_per_round", "throughput_drift_action",
+    "throughput_drift_window", "throughput_drift_factor",
 }
 
 TELEMETRY_FIELD_SPECS = {
@@ -216,6 +224,16 @@ TELEMETRY_FIELD_SPECS = {
     "xla": ("bool", None, None),
     # compact per-run regression surface (telemetry/scorecard.json)
     "scorecard": ("bool", None, None),
+    # endurance rollups (telemetry/rollup.py): one rollups.jsonl record
+    # per rollup_window rounds, O(window) host memory
+    "rollup": ("bool", None, None),
+    "rollup_window": ("int", 1, None),
+    # flight recorder: ring of the last flight_events structured events
+    # persisted as flight.json on abort/preemption/exception
+    "flight": ("bool", None, None),
+    "flight_events": ("int", 8, None),
+    # size-capped metrics.jsonl/events.jsonl rotation (MB; 0 = off)
+    "max_log_mb": ("num", 0, None),
     # profile_rounds keeps a bespoke check in validate(): int | "lo:hi"
     # | [lo, hi] is a union type the scalar spec table cannot express
 }
@@ -231,6 +249,17 @@ WATCHDOG_FIELD_SPECS = {
     # past the warmup rounds (a steady-state loop recompiles ZERO times)
     "recompile_storm_threshold": ("int", 1, None),
     "recompile_storm_warmup_rounds": ("int", 0, None),
+    # stall: no round-completion heartbeat within
+    # max(stall_factor x trailing-median round time, stall_grace_secs)
+    "stall_factor": ("num", 1.0, None),
+    "stall_poll_secs": ("num", 0.01, None),
+    "stall_grace_secs": ("num", 0.0, None),
+    # rss_leak: least-squares host-RSS slope over a trailing window
+    "rss_leak_window": ("int", 4, None),
+    "rss_leak_mb_per_round": ("num", 0.0, None),
+    # throughput_drift: trailing-median secs/round vs the anchor window
+    "throughput_drift_window": ("int", 4, None),
+    "throughput_drift_factor": ("num", 1.0, None),
 }
 
 #: watchdog detector actions (telemetry/watchdog.py ACTIONS)
@@ -839,7 +868,9 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                 for key in ("nan_loss", "round_time_action",
                             "ckpt_failure_action",
                             "quarantine_rate_action",
-                            "recompile_storm_action"):
+                            "recompile_storm_action", "stall_action",
+                            "rss_leak_action",
+                            "throughput_drift_action"):
                     _check_enum(errors, wd,
                                 "server_config.telemetry.watchdog", key,
                                 ALLOWED_WATCHDOG_ACTIONS)

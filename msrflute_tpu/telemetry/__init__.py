@@ -11,7 +11,11 @@ with a measured-zero-overhead fast path — see docs/observability.md):
 - :mod:`.profiling` — opt-in ``jax.profiler`` capture for a configured
   round window, compat-guarded for old jax;
 - :mod:`.watchdog` — NaN-loss / round-time-regression /
-  checkpoint-failure-streak detectors with log/mark/abort actions.
+  checkpoint-failure-streak detectors with log/mark/abort actions, plus
+  the longitudinal tier (stall / rss_leak / throughput_drift);
+- :mod:`.rollup` — ISSUE 13's endurance layer: incremental windowed
+  rollups (``rollups.jsonl``, O(window) host memory) and the flight
+  recorder (``flight.json`` persisted on abort/preemption/exception).
 
 Plus :mod:`.metrics` (the always-on ``metrics.jsonl`` writer + structured
 event records, re-exported by ``utils.logging``) and :mod:`.timing` (the
@@ -24,10 +28,12 @@ backend before jax loads); :mod:`.profiling` touches jax only through
 
 from __future__ import annotations
 
+import contextlib
 import os
+import time
 from typing import Any, Dict, Optional
 
-from . import metrics
+from . import metrics, rollup
 from .devbus import DeviceMetricBus
 from .spans import NULL_SPAN, SpanToken, Tracer
 from .timing import Stopwatch, scalar_time
@@ -88,6 +94,35 @@ class Telemetry:
         self.watchdog = Watchdog(self.raw.get("watchdog"),
                                  on_event=self.event)
         self._nonscalar_warned: set = set()
+        # endurance layer (ISSUE 13): windowed rollups + flight recorder
+        # — both default ON with telemetry (they are the days-long-run
+        # observability; telemetry-off still constructs neither)
+        self.rollup: Optional[rollup.RollupEngine] = None
+        if self.raw.get("rollup", True):
+            self.rollup = rollup.RollupEngine(
+                self.out_dir,
+                window=int(self.raw.get(
+                    "rollup_window", rollup.RollupEngine.DEFAULT_WINDOW)))
+        self.flight: Optional[rollup.FlightRecorder] = None
+        if self.raw.get("flight", True):
+            self.flight = rollup.FlightRecorder(
+                self.out_dir,
+                max_events=int(self.raw.get(
+                    "flight_events", rollup.FlightRecorder.DEFAULT_EVENTS)))
+            self.flight.rollup = self.rollup
+        # the stall monitor persists the flight record BEFORE it
+        # interrupts a hung main thread (watchdog.py) — wire it here so
+        # the pairing exists whether or not the server adds context
+        self.watchdog.on_flight = self.record_flight
+        # bounded log growth (telemetry.max_log_mb): arms size-capped
+        # rotation for metrics.jsonl AND events.jsonl at flush cadence.
+        # Set UNCONDITIONALLY — the metrics cap is a process global, and
+        # a later server constructed without the knob must get the
+        # documented unbounded default back, not the previous run's cap
+        max_log_mb = float(self.raw.get("max_log_mb", 0) or 0)
+        metrics.set_max_log_mb(max_log_mb)
+        if self.tracer is not None and max_log_mb > 0:
+            self.tracer.max_log_bytes = int(max_log_mb * 2 ** 20)
         # lazy import: profiling reaches for jax (via utils.compat) only
         # when a capture window is configured and actually starts
         from .profiling import RoundProfiler
@@ -96,24 +131,62 @@ class Telemetry:
 
     # -- spans ----------------------------------------------------------
     def span(self, name: str, **args: Any):
-        return self.tracer.span(name, **args) if self.tracer is not None \
-            else NULL_SPAN
+        inner = (self.tracer.span(name, **args)
+                 if self.tracer is not None else NULL_SPAN)
+        if self.rollup is None:
+            return inner
+        # rollup-fed spans: ONE extra perf_counter pair per phase — the
+        # windowed per-phase quantiles come from here, so they exist
+        # even when the trace itself is disabled (trace: false)
+        return self._rollup_span(name, inner)
+
+    @contextlib.contextmanager
+    def _rollup_span(self, name: str, inner):
+        t0 = time.perf_counter()
+        try:
+            with inner:
+                yield
+        finally:
+            self.rollup.observe_phase(name, time.perf_counter() - t0)
 
     def begin(self, name: str, **args: Any) -> Optional[SpanToken]:
-        return self.tracer.begin(name, **args) if self.tracer is not None \
-            else None
+        if self.tracer is not None:
+            return self.tracer.begin(name, **args)
+        if self.rollup is not None:
+            # trace:false still feeds the rollup's per-phase quantiles
+            # (the documented contract): a plain timing token on the
+            # same µs convention, no tracer track behind it (tid -1)
+            return SpanToken(name, args, time.perf_counter() * 1e6, -1)
+        return None
 
     def end(self, token: Optional[SpanToken]) -> None:
+        if token is None or token.done:
+            return
         if self.tracer is not None:
+            if self.rollup is not None:
+                self.rollup.observe_phase(
+                    token.name,
+                    (self.tracer._now_us() - token.t0_us) / 1e6)
             self.tracer.end(token)
+            return
+        token.done = True
+        if self.rollup is not None:
+            self.rollup.observe_phase(
+                token.name,
+                (time.perf_counter() * 1e6 - token.t0_us) / 1e6)
 
     # -- events / devbus ------------------------------------------------
     def event(self, kind: str, **fields: Any) -> None:
         """Structured record in BOTH streams: the always-on metrics
-        stream and (when tracing) the trace's instant-event track."""
+        stream and (when tracing) the trace's instant-event track —
+        plus the rollup window's event counters and the flight ring."""
         metrics.log_event(kind, **fields)
         if self.tracer is not None:
             self.tracer.instant(kind, **fields)
+        if self.rollup is not None:
+            self.rollup.observe_event(kind)
+        if self.flight is not None:
+            self.flight.record_event(kind, fields)
 
     def devbus_host(self, name: str, value: float,
                     step: Optional[int] = None) -> None:
@@ -167,6 +240,45 @@ class Telemetry:
         os.replace(tmp, path)
         return path
 
+    # -- endurance rollups + flight recorder (ISSUE 13) -----------------
+    def rollup_observe(self, round_no: int, secs: float, clients: float,
+                       mfu: Optional[float] = None,
+                       rss_bytes: Optional[int] = None,
+                       xla_snapshot: Optional[Dict[str, Any]] = None
+                       ) -> None:
+        """One completed round's longitudinal observations (all values
+        the host tail already holds — the zero-transfer contract)."""
+        if self.rollup is None:
+            return
+        gauges = dict(xla_snapshot or {})
+        if self.tracer is not None:
+            gauges["trace_events_dropped"] = self.tracer.dropped
+        if gauges:
+            self.rollup.update_gauges(gauges)
+        self.rollup.observe_round(round_no, secs, clients, mfu=mfu,
+                                  rss_bytes=rss_bytes)
+
+    def rollup_housekeeping(self) -> None:
+        """Round-housekeeping flush point: append the rollup record
+        when the window completed (bounded work, no throttle needed —
+        at most one record per ``rollup_window`` rounds)."""
+        if self.rollup is not None:
+            self.rollup.maybe_flush()
+
+    def record_flight(self, reason: str,
+                      detail: Optional[str] = None) -> Optional[str]:
+        """Persist ``flight.json`` (no-op when the recorder is off) —
+        the abort/preemption/exception paths' forensic snapshot."""
+        if self.flight is None:
+            return None
+        return self.flight.persist(reason, detail=detail)
+
+    def set_flight_context(self, card_fn) -> None:
+        """Wire the server's scorecard builder into the flight record
+        (called best-effort at persist time, never earlier)."""
+        if self.flight is not None:
+            self.flight.card_fn = card_fn
+
     # -- lifecycle ------------------------------------------------------
     def flush(self) -> None:
         if self.tracer is not None:
@@ -183,6 +295,9 @@ class Telemetry:
 
     def close(self) -> None:
         self.profiler.finish()
+        self.watchdog.stop_stall_monitor()
+        if self.rollup is not None:
+            self.rollup.close()
         if self.tracer is not None:
             self.tracer.close()
         metrics.flush_metrics()

@@ -11,7 +11,16 @@ observability surface lives under ``telemetry/``:
 - structured EVENT records (``{"event": kind, ...}`` lines in the same
   stream): preemption requests, chaos fault rounds, checkpoint
   fallback/recovery — previously only greppable log text, now records a
-  reader (``tools/scope``) can tabulate.
+  reader (``tools/scope``) can tabulate;
+- bounded growth (ISSUE 13): ``telemetry.max_log_mb`` size-caps the
+  stream.  At a flush point past the cap the current file rotates to a
+  numbered segment — hardlink the live inode to ``metrics.jsonl.N``,
+  then atomically swap an empty inode into the primary name (tmp +
+  ``os.replace``, the blessed idiom: no crash instant loses lines; the
+  worst case is the link-then-swap window, where a crash leaves the
+  newest lines under BOTH names and readers may double-count that one
+  segment's tail) — and a ``log_rotated`` event opens the new segment.
+  ``tools/scope`` readers walk rotated segments transparently.
 
 No jax import, no telemetry-object dependency: this module is the
 always-on half of flutescope (the span tracer is the opt-in half), so
@@ -30,24 +39,119 @@ from typing import Any, Dict, Optional
 
 _LOGGER = logging.getLogger("msrflute_tpu")
 _METRICS_FH = None
+_METRICS_PATH = None
 #: seconds between forced metrics-stream flushes; between them lines sit
 #: in the file buffer (the server also flushes at every round-housekeeping
 #: boundary, at train() exit, and from the preemption drain path, so
 #: round granularity is never lost)
 _FLUSH_INTERVAL_SECS = 1.0
 _LAST_FLUSH = 0.0
+#: size cap in bytes (0 = unbounded, the default); set from the
+#: telemetry block's ``max_log_mb`` knob at scope construction
+_MAX_LOG_BYTES = 0
+_BYTES_WRITTEN = 0
+#: guards the file handle against the rotation swap: writers land on
+#: other threads too (the async checkpoint writer's events), and a
+#: write racing a close would turn log rotation into spurious stream
+#: errors.  Held only around buffered writes/flushes and the handle
+#: exchange — never around a file open (the lock-discipline contract).
+_FH_LOCK = threading.Lock()
 
 
 def open_metrics(log_dir: str) -> None:
     """Open (append) ``<log_dir>/metrics.jsonl`` as the process's metric
     stream and register the at-exit flush."""
-    global _METRICS_FH
+    global _METRICS_FH, _METRICS_PATH, _BYTES_WRITTEN
     os.makedirs(log_dir, exist_ok=True)
-    _METRICS_FH = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+    _METRICS_PATH = os.path.join(log_dir, "metrics.jsonl")
+    try:
+        _BYTES_WRITTEN = os.path.getsize(_METRICS_PATH)
+    except OSError:
+        _BYTES_WRITTEN = 0
+    _METRICS_FH = open(_METRICS_PATH, "a")
     # buffered lines must still land if the process exits without a
     # final explicit flush (e.g. a CLI run killed between rounds)
     import atexit
     atexit.register(flush_metrics)
+
+
+def set_max_log_mb(mb: float) -> None:
+    """Arm size-capped rotation for the metrics stream (``telemetry.
+    max_log_mb``; 0 disables).  Rotation happens only at flush points —
+    never mid-line — so a reader's torn-tail tolerance is the only
+    crash concession."""
+    global _MAX_LOG_BYTES
+    _MAX_LOG_BYTES = int(float(mb) * 2 ** 20) if mb else 0
+
+
+def rotate_jsonl(path: str, fh):
+    """Rotate one append-mode jsonl stream to its next numbered segment
+    and hand back ``(new_fh, segment_index)`` — WITHOUT closing ``fh``
+    (the caller exchanges handles under its own lock, then closes the
+    old one; a concurrent writer still holding it writes the OLD inode,
+    which is exactly the segment file, so no line is ever lost to a
+    closed handle).
+
+    The blessed crash-ordering: (1) flush + hardlink the live inode to
+    ``<path>.N`` — both names now reference every line ever written;
+    (2) atomically swap a fresh empty inode into the primary name via
+    tmp + ``os.replace``; (3) open the new primary for append.  The
+    only crash artifact is the link-to-swap window where segment N and
+    the primary briefly alias the same inode (readers may double-count
+    that tail once)."""
+    fh.flush()
+    seg = 1
+    while os.path.exists(f"{path}.{seg}"):
+        seg += 1
+    os.link(path, f"{path}.{seg}")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8"):
+        pass
+    os.replace(tmp, path)
+    return open(path, "a", encoding="utf-8"), seg
+
+
+def jsonl_segment_paths(path: str) -> list:
+    """Rotated segments of one jsonl stream, oldest first, primary
+    last — the reader-side mirror of :func:`rotate_jsonl` (tools/scope
+    carries its own pure-stdlib copy of this walk; the two are pinned
+    together by tests/test_endurance.py)."""
+    out = []
+    seg = 1
+    while os.path.exists(f"{path}.{seg}"):
+        out.append(f"{path}.{seg}")
+        seg += 1
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def _maybe_rotate() -> None:
+    """Flush-point rotation check (never per line).  The new handle
+    opens OUTSIDE the lock, the exchange happens under it, and only
+    then does the old handle close — a writer that raced the swap was
+    either holding the lock (so it finished first) or lands on the new
+    handle.  Emits the ``log_rotated`` event as the NEW segment's
+    first record so the rotation is observable in the stream it
+    rotated."""
+    global _METRICS_FH, _BYTES_WRITTEN
+    if not _MAX_LOG_BYTES or _METRICS_FH is None or \
+            _METRICS_PATH is None or _BYTES_WRITTEN < _MAX_LOG_BYTES:
+        return
+    try:
+        new_fh, seg = rotate_jsonl(_METRICS_PATH, _METRICS_FH)
+    except OSError:
+        return  # rotation is an optimization; never kill the stream
+    with _FH_LOCK:
+        old, _METRICS_FH = _METRICS_FH, new_fh
+        rotated_bytes = _BYTES_WRITTEN
+        _BYTES_WRITTEN = 0
+    try:
+        old.close()
+    except OSError:
+        pass
+    log_event("log_rotated", file="metrics.jsonl", segment=seg,
+              rotated_bytes=rotated_bytes)
 
 
 def metrics_open() -> bool:
@@ -55,12 +159,18 @@ def metrics_open() -> bool:
 
 
 def _write_line(record: Dict[str, Any]) -> None:
-    global _LAST_FLUSH
+    global _LAST_FLUSH, _BYTES_WRITTEN
     if _METRICS_FH is not None:
-        _METRICS_FH.write(json.dumps(record) + "\n")
-        if record["ts"] - _LAST_FLUSH >= _FLUSH_INTERVAL_SECS:
-            _METRICS_FH.flush()
-            _LAST_FLUSH = record["ts"]
+        line = json.dumps(record) + "\n"
+        with _FH_LOCK:
+            fh = _METRICS_FH
+            if fh is None or fh.closed:
+                return
+            fh.write(line)
+            _BYTES_WRITTEN += len(line)
+            if record["ts"] - _LAST_FLUSH >= _FLUSH_INTERVAL_SECS:
+                fh.flush()
+                _LAST_FLUSH = record["ts"]
 
 
 def log_metric(name: str, value: Any, step: Optional[int] = None,
@@ -106,8 +216,12 @@ def flush_metrics() -> None:
     in-flight round records are durable before the process exits."""
     global _LAST_FLUSH
     if _METRICS_FH is not None:
-        _METRICS_FH.flush()
-        _LAST_FLUSH = time.time()
+        with _FH_LOCK:
+            fh = _METRICS_FH
+            if fh is not None and not fh.closed:
+                fh.flush()
+            _LAST_FLUSH = time.time()
+        _maybe_rotate()
 
 
 def _to_py(value: Any) -> Any:

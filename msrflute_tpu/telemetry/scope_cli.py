@@ -1,6 +1,7 @@
-"""``tools/scope`` — summarize, diff and trend flutescope output.
+"""``tools/scope`` — summarize, diff, trend, watch and health-gate
+flutescope output.
 
-Three commands (the bare form stays ``tools/scope <run_dir>``):
+Five commands (the bare form stays ``tools/scope <run_dir>``):
 
 - ``tools/scope <run_dir>`` / ``tools/scope summarize <run_dir>`` —
   ONE JSON object summarizing a run's telemetry (below);
@@ -11,7 +12,20 @@ Three commands (the bare form stays ``tools/scope <run_dir>``):
 - ``tools/scope trend BENCH_*.json... [--gate] [--pct N]`` — walk a
   series of committed bench artifacts and flag a headline / per-protocol
   round-time regression between the last two measured entries (same
-  exit-code contract).
+  exit-code contract);
+- ``tools/scope watch <run_dir> [--interval S] [--once]`` — live tail
+  of the endurance rollup stream (``rollups.jsonl``), one compact line
+  per flushed window: the babysitting view of a days-long run;
+- ``tools/scope health <run_dir> [--gate]`` — the endurance health
+  ORACLE: one verdict over the rollup stream, watchdog firings, the
+  flight record and the scorecard.  ``--gate`` exits **3** when the
+  run is unhealthy (naming every finding), **2** when the inputs are
+  unreadable — the exit code the endurance harness and CI smoke gate
+  on (ISSUE 13).
+
+All readers walk size-capped rotation segments
+(``metrics.jsonl.1``, ``events.jsonl.2``, ...) transparently, oldest
+first, and tolerate a torn trailing line from a crash mid-write.
 
 Summarize input: a model dir (or its ``telemetry/`` subdir) holding any
 of ``telemetry/trace.json``, ``telemetry/events.jsonl``,
@@ -75,17 +89,36 @@ def _load_trace(path: str) -> List[Dict[str, Any]]:
     return list(parsed) if isinstance(parsed, list) else []
 
 
-def _jsonl(path: str) -> List[Dict[str, Any]]:
+def _segment_paths(path: str) -> List[str]:
+    """Rotated segments of one jsonl stream, oldest first, primary
+    last — the reader-side mirror of the writer's size-capped rotation
+    (``telemetry.max_log_mb``; telemetry/metrics.py ``rotate_jsonl``).
+    Duplicated here as pure stdlib on purpose: tools/scope must never
+    import the package (the flint discipline); the two walks are
+    pinned together by tests/test_endurance.py."""
     out = []
-    with open(path, "r", encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue  # torn tail line of a killed run
+    seg = 1
+    while os.path.exists(f"{path}.{seg}"):
+        out.append(f"{path}.{seg}")
+        seg += 1
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def _jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for seg in _segment_paths(path) or ([path] if os.path.exists(path)
+                                        else []):
+        with open(seg, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail line of a killed run
     return out
 
 
@@ -478,12 +511,268 @@ def _trend_main(argv: List[str]) -> int:
     return 0
 
 
+# ======================================================================
+# endurance: rollup watch + the health oracle (ISSUE 13)
+# ======================================================================
+def _telemetry_dir(run_dir: str) -> str:
+    if os.path.isdir(os.path.join(run_dir, "telemetry")):
+        return os.path.join(run_dir, "telemetry")
+    return run_dir
+
+
+def _format_rollup(rec: Dict[str, Any]) -> str:
+    """One compact human line per rollup window (the ``watch`` view)."""
+    def num(key: str, fmt: str = "{:.3g}") -> str:
+        value = rec.get(key)
+        return fmt.format(value) if isinstance(value, (int, float)) \
+            else "-"
+
+    events = rec.get("events") or {}
+    ev = " ".join(f"{k}:{v}" for k, v in sorted(events.items())) or "-"
+    rss = rec.get("host_rss_bytes")
+    rss_mb = f"{rss / 2**20:.0f}MB" if isinstance(rss, (int, float)) \
+        else "-"
+    return (f"w{rec.get('window', '?'):>3} "
+            f"r[{rec.get('round_lo', '?')},{rec.get('round_hi', '?')}] "
+            f"{num('secs_per_round_p50')}s/r "
+            f"p95 {num('secs_per_round_p95')} "
+            f"cl/s {num('clients_per_sec')} "
+            f"mfu {num('mfu_p50', '{:.4f}')} "
+            f"rss {rss_mb} "
+            f"drop {rec.get('trace_events_dropped', 0)} "
+            f"rc {rec.get('recompiles', 0)}"
+            + (" PARTIAL" if rec.get("partial") else "")
+            + f" | {ev}")
+
+
+def _watch_main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="scope watch",
+        description="live tail of a run's endurance rollup stream "
+                    "(rollups.jsonl) — one line per flushed window")
+    ap.add_argument("run_dir", help="model dir (or its telemetry/ subdir)")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="poll seconds between reads (default 5)")
+    ap.add_argument("--once", action="store_true",
+                    help="print what exists and exit (no follow)")
+    args = ap.parse_args(argv)
+    path = os.path.join(_telemetry_dir(args.run_dir), "rollups.jsonl")
+    offset = 0
+    printed_header = False
+    import time as _time
+    while True:
+        if os.path.exists(path):
+            size = os.path.getsize(path)
+            if size < offset:
+                offset = 0  # stream truncated/replaced: start over
+            if size > offset:
+                # binary read + byte offsets: text-mode seek is only
+                # defined for cookies from tell(), and the tail we skip
+                # may be a torn multi-byte write
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read()
+                # only consume complete lines; a torn tail stays
+                # buffered for the next poll
+                consumed = chunk.rfind(b"\n") + 1
+                offset += consumed
+                for raw in chunk[:consumed].splitlines():
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if not printed_header:
+                        printed_header = True
+                        print("# scope watch:", path, flush=True)
+                    print(_format_rollup(rec), flush=True)
+        if args.once:
+            return 0
+        try:
+            _time.sleep(max(args.interval, 0.1))
+        except KeyboardInterrupt:
+            return 0
+
+
+#: watchdog kinds whose firing makes a run UNHEALTHY for the gate.
+#: round_time (a spiky chunk under chaos straggler inflation) and
+#: quarantine_rate (the defense working) are informational; these six
+#: mean the run is dying, leaking, drifting, or churning shapes.
+CRITICAL_WATCHDOGS = ("stall", "nan_loss", "rss_leak",
+                      "throughput_drift", "recompile_storm",
+                      "ckpt_failure_streak")
+
+#: last-vs-first rollup-window slowdown the static check tolerates
+#: before calling the run unhealthy even without a watchdog firing
+HEALTH_DRIFT_PCT = 75.0
+
+
+def health(run_dir: str,
+           pct: Optional[float] = None) -> Dict[str, Any]:
+    """The endurance health verdict for one run directory.
+
+    Sources (every one torn-line/rotation tolerant): the structured
+    event streams (``metrics.jsonl`` + ``events.jsonl``), the rollup
+    stream (``rollups.jsonl``), the flight record (``flight.json``) and
+    the scorecard.  ``findings`` gate (exit 3); ``warnings`` inform.
+    """
+    tdir = _telemetry_dir(run_dir)
+    findings: List[Dict[str, Any]] = []
+    warnings: List[Dict[str, Any]] = []
+    out: Dict[str, Any] = {"run_dir": os.path.basename(
+        os.path.abspath(run_dir))}
+
+    # ---- watchdog firings from the event streams + scorecard ---------
+    fires: Dict[str, int] = {}
+    metrics_path = os.path.join(run_dir, "metrics.jsonl")
+    if not _segment_paths(metrics_path):
+        metrics_path = os.path.join(tdir, "metrics.jsonl")
+    # one firing reaches up to three streams; per-kind MAX across
+    # sources (the summarize() convention) so nothing double-counts and
+    # a stream a killed run lost does not under-count
+    from_metrics: Dict[str, int] = {}
+    for rec in _jsonl(metrics_path):
+        if "event" in rec and str(rec["event"]).startswith("watchdog_"):
+            kind = str(rec["event"])[len("watchdog_"):]
+            from_metrics[kind] = from_metrics.get(kind, 0) + 1
+    from_events: Dict[str, int] = {}
+    for rec in _jsonl(os.path.join(tdir, "events.jsonl")):
+        if rec.get("kind") == "event" and \
+                str(rec.get("name", "")).startswith("watchdog_"):
+            kind = str(rec["name"])[len("watchdog_"):]
+            from_events[kind] = from_events.get(kind, 0) + 1
+    for counts in (from_metrics, from_events):
+        for kind, n in counts.items():
+            fires[kind] = max(fires.get(kind, 0), n)
+    card: Dict[str, Any] = {}
+    card_path = os.path.join(tdir, "scorecard.json")
+    if os.path.exists(card_path):
+        try:
+            with open(card_path, "r", encoding="utf-8") as fh:
+                card = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            warnings.append({"check": "scorecard_unreadable"})
+        for kind, n in (card.get("watchdog_fires") or {}).items():
+            fires[kind] = max(fires.get(kind, 0), int(n))
+    out["watchdog_fires"] = dict(sorted(fires.items()))
+    for kind in CRITICAL_WATCHDOGS:
+        if fires.get(kind):
+            findings.append({"check": f"watchdog_{kind}",
+                             "count": fires[kind]})
+
+    # ---- flight record: a preemption flight is a drill/scheduler
+    # artifact (the run resumed); any other reason means the run died
+    # abnormally and the gate must say so --------------------------------
+    flight_path = os.path.join(tdir, "flight.json")
+    if os.path.exists(flight_path):
+        try:
+            with open(flight_path, "r", encoding="utf-8") as fh:
+                flight = json.load(fh)
+            reasons = [str(r.get("reason", "")) for r in
+                       (flight.get("reasons") or [])]
+            out["flight_reasons"] = reasons
+            abnormal = [r for r in reasons
+                        if not r.startswith("preemption")]
+            if abnormal:
+                findings.append({"check": "flight_abnormal",
+                                 "reasons": abnormal})
+            else:
+                warnings.append({"check": "flight_preemption",
+                                 "reasons": reasons})
+        except (OSError, json.JSONDecodeError):
+            warnings.append({"check": "flight_unreadable"})
+
+    # ---- the rollup stream: presence + longitudinal statics ----------
+    rollups = [r for r in _jsonl(os.path.join(tdir, "rollups.jsonl"))
+               if r.get("kind") == "rollup" and r.get("rounds")]
+    out["rollup_windows"] = len(rollups)
+    if tdir != run_dir and not rollups:
+        # a telemetry/ subdir exists, so telemetry RAN — a missing
+        # rollup stream there means the endurance layer was disabled or
+        # broken, which an endurance gate must refuse.  A run with no
+        # telemetry dir at all simply has nothing to judge here
+        # (telemetry-off runs are not unhealthy, just unobserved).
+        findings.append({"check": "no_rollups",
+                         "detail": "telemetry ran but no rollup window "
+                                   "was ever flushed"})
+    if len(rollups) >= 2:
+        first, last = rollups[0], rollups[-1]
+        a = first.get("secs_per_round_p50")
+        b = last.get("secs_per_round_p50")
+        thresh = (float(pct) if pct is not None else HEALTH_DRIFT_PCT) \
+            / 100.0
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                and a > 0 and b > a * (1.0 + thresh):
+            findings.append({
+                "check": "rollup_throughput_drift",
+                "first_p50": a, "last_p50": b,
+                "limit": round(a * (1.0 + thresh), 6)})
+        out["secs_per_round_p50"] = {"first": a, "last": b}
+    if rollups:
+        dropped = rollups[-1].get("trace_events_dropped")
+        if dropped:
+            warnings.append({"check": "trace_events_dropped",
+                             "count": int(dropped)})
+        out["last_window"] = {
+            k: rollups[-1].get(k)
+            for k in ("window", "round_hi", "secs_per_round_p50",
+                      "clients_per_sec", "mfu_p50", "host_rss_bytes",
+                      "recompiles")}
+
+    if card:
+        out["recompiles"] = card.get("recompiles")
+        if card.get("trace_events_dropped"):
+            warnings.append({"check": "scorecard_trace_events_dropped",
+                             "count": card["trace_events_dropped"]})
+    out["findings"] = findings
+    out["warnings"] = warnings
+    out["ok"] = not findings
+    return out
+
+
+def _health_main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="scope health",
+        description="endurance health oracle over rollups + watchdog "
+                    "firings + flight record + scorecard")
+    ap.add_argument("run_dir", help="model dir (or its telemetry/ subdir)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 3 when the run is unhealthy")
+    ap.add_argument("--pct", type=float, default=None,
+                    help="last-vs-first rollup slowdown tolerance "
+                         "(%%, default 75)")
+    ap.add_argument("--indent", type=int, default=None)
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"scope health: {args.run_dir!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    try:
+        out = health(args.run_dir, pct=args.pct)
+    except OSError as exc:
+        print(f"scope health: {exc}", file=sys.stderr)
+        return 2
+    print(json.dumps(out, indent=args.indent, sort_keys=True))
+    if out["findings"]:
+        names = ", ".join(f["check"] for f in out["findings"])
+        print(f"scope health: UNHEALTHY ({names})", file=sys.stderr)
+        if args.gate:
+            return 3
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "diff":
         return _diff_main(argv[1:])
     if argv and argv[0] == "trend":
         return _trend_main(argv[1:])
+    if argv and argv[0] == "watch":
+        return _watch_main(argv[1:])
+    if argv and argv[0] == "health":
+        return _health_main(argv[1:])
     if argv and argv[0] == "summarize":
         argv = argv[1:]
     ap = argparse.ArgumentParser(

@@ -436,6 +436,14 @@ class XlaIntrospector:
                           "hbm_bytes", "compile_seconds") if k in entry}
         return out
 
+    def snapshot(self) -> Dict[str, Any]:
+        """The cumulative device-truth gauges one rollup window carries
+        (ISSUE 13): compile/recompile counters + the HBM high-watermark.
+        Host metadata only — reading it never touches a device."""
+        return {"compiles": int(self.compiles),
+                "recompiles": int(self.recompiles),
+                "hbm_peak_bytes": self.hbm_peak_bytes()}
+
     def hbm_peak_bytes(self) -> Optional[int]:
         """High-watermark resident HBM footprint across every compiled
         entry point (the biggest single program the run dispatched)."""

@@ -687,6 +687,37 @@ def test_schema_drift_covers_device_truth_keys(tmp_path):
                for m in msgs)
 
 
+def test_schema_drift_covers_endurance_keys(tmp_path):
+    """ISSUE 13 corpus: the endurance knobs (``telemetry.rollup`` /
+    ``max_log_mb``, the ``stall_*``/``rss_leak_*``/``throughput_drift_*``
+    watchdog keys) are drift-checked like the device-truth block — a
+    spec row whose key the unknown-key pass doesn't know is dead config
+    and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'telemetry'}\n"
+        # 'rollup' missing from TELEMETRY_KEYS, stall_factor missing
+        # from WATCHDOG_KEYS: both spec rows are unreachable
+        "TELEMETRY_KEYS = {'enable', 'max_log_mb'}\n"
+        "WATCHDOG_KEYS = {'stall_action', 'rss_leak_action'}\n"
+        "TELEMETRY_FIELD_SPECS = {'max_log_mb': ('num', 0, None),"
+        " 'rollup': ('bool', None, None)}\n"
+        "WATCHDOG_FIELD_SPECS = {'stall_factor': ('num', 1.0, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.telemetry` holds the endurance knobs.")
+    found = check_project(str(tmp_path), documented_knobs=("telemetry",))
+    msgs = sorted(f.message for f in found)
+    assert [f.rule for f in found] == ["schema-drift", "schema-drift"]
+    assert any("rollup" in m and "TELEMETRY_KEYS" in m for m in msgs)
+    assert any("stall_factor" in m and "WATCHDOG_KEYS" in m
+               for m in msgs)
+
+
 def test_schema_drift_flags_undocumented_telemetry_knob(tmp_path):
     pkg = tmp_path / "msrflute_tpu"
     pkg.mkdir(parents=True)

@@ -1,0 +1,664 @@
+"""flutescope endurance (ISSUE 13): rollups, flight recorder,
+longitudinal watchdogs, log rotation, and the health oracle.
+
+Coverage map (the ISSUE's test satellite):
+
+- rollup window quantiles/counters pinned against an offline numpy
+  recompute of the full observation stream (windows are EXACT; the
+  cumulative P2 sketch is tolerance-pinned);
+- the watchdog action matrix (off/log/mark/abort) for the three new
+  longitudinal detectors: stall, rss_leak, throughput_drift;
+- flight.json written on WatchdogAbort, on a preemption request (the
+  SIGTERM path's programmatic spelling — the real-signal wiring is
+  test_preempt_resume's territory), and on a raised exception;
+- size-capped rotation of metrics.jsonl/events.jsonl: the log_rotated
+  event, reader-side segment walking, torn-trailing-line tolerance,
+  and the writer/reader walk parity pin;
+- `scope health` golden fixtures: the clean run gates 0, the
+  seeded-stall run gates 3;
+- the endurance harness driver end to end (chaos + forced
+  preemption/resume + cohort bucketing + depth-3 pipeline under
+  MSRFLUTE_STRICT_TRANSFERS=1).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from msrflute_tpu.telemetry.rollup import (FlightRecorder, P2Quantile,
+                                           RollupEngine, host_rss_bytes)
+from msrflute_tpu.telemetry.watchdog import Watchdog, WatchdogAbort
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "endurance_fixture")
+
+
+# ======================================================================
+# rollup quantiles + counters vs offline numpy recompute
+# ======================================================================
+def _nearest_rank(values, p):
+    ordered = sorted(values)
+    return ordered[min(int(len(ordered) * p), len(ordered) - 1)]
+
+
+def test_window_quantiles_match_numpy_recompute(tmp_path):
+    """Per-window p50/p95 are EXACT: recomputing them offline from the
+    full observation stream must reproduce every flushed record."""
+    rng = np.random.default_rng(7)
+    window = 8
+    eng = RollupEngine(str(tmp_path), window=window)
+    secs = rng.lognormal(-1.0, 0.5, 40)
+    phases = rng.lognormal(-3.0, 0.7, 40)
+    clients = rng.integers(4, 12, 40)
+    for r in range(40):
+        eng.observe_phase("host_tail", float(phases[r]))
+        eng.observe_round(r, float(secs[r]), float(clients[r]))
+        eng.maybe_flush()
+    eng.close()
+    records = [json.loads(line) for line in
+               open(tmp_path / "rollups.jsonl", encoding="utf-8")]
+    assert len(records) == 5 and not any(r.get("partial")
+                                         for r in records)
+    for i, rec in enumerate(records):
+        lo, hi = i * window, (i + 1) * window
+        assert (rec["round_lo"], rec["round_hi"]) == (lo, hi - 1)
+        assert rec["rounds"] == window
+        w = secs[lo:hi].tolist()
+        assert rec["secs_per_round_p50"] == pytest.approx(
+            _nearest_rank(w, 0.5), abs=0)
+        assert rec["secs_per_round_p95"] == pytest.approx(
+            _nearest_rank(w, 0.95), abs=0)
+        assert rec["clients"] == pytest.approx(
+            float(clients[lo:hi].sum()))
+        ph = phases[lo:hi].tolist()
+        got = rec["phase_secs"]["host_tail"]
+        assert got["count"] == window
+        assert got["total"] == pytest.approx(sum(ph), rel=1e-5)
+        assert got["p50"] == pytest.approx(_nearest_rank(ph, 0.5),
+                                           rel=1e-5)
+    # cumulative sketch: exact small-n convention aside, the P2 value
+    # must land within a few percent of the true quantile
+    cum = records[-1]["cum"]
+    assert cum["rounds"] == 40
+    assert cum["secs_per_round_p50"] == pytest.approx(
+        np.percentile(secs, 50), rel=0.10)
+
+
+def test_rollup_event_counters_match_stream(tmp_path):
+    eng = RollupEngine(str(tmp_path), window=4)
+    stream = (["chaos_faults"] * 5 + ["ckpt_io_fault"] * 2 +
+              ["watchdog_stall"])
+    for r in range(8):
+        for kind in stream[r:r + 1]:
+            eng.observe_event(kind)
+        eng.observe_round(r, 0.1, 4)
+        eng.maybe_flush()
+    eng.close()
+    records = [json.loads(line) for line in
+               open(tmp_path / "rollups.jsonl", encoding="utf-8")]
+    # offline recompute: the two windows partition the stream
+    assert records[0]["events"] == {"chaos_faults": 4}
+    assert records[1]["events"] == {"chaos_faults": 1,
+                                    "ckpt_io_fault": 2,
+                                    "watchdog_stall": 1}
+    assert records[-1]["cum"]["events"] == {
+        "chaos_faults": 5, "ckpt_io_fault": 2, "watchdog_stall": 1}
+
+
+def test_p2_sketch_exact_small_and_close_large():
+    q = P2Quantile(0.5)
+    for v in [5.0, 1.0, 3.0]:
+        q.observe(v)
+    assert q.value == 3.0  # exact nearest-rank under 5 samples
+    rng = np.random.default_rng(0)
+    xs = rng.normal(100.0, 15.0, 4000)
+    q95 = P2Quantile(0.95)
+    for x in xs:
+        q95.observe(float(x))
+    assert q95.value == pytest.approx(np.percentile(xs, 95), rel=0.03)
+
+
+def test_host_rss_bytes_is_live():
+    assert host_rss_bytes() > 10 * 2 ** 20  # a jax-loaded process
+
+
+# ======================================================================
+# watchdog action matrix: stall / rss_leak / throughput_drift
+# ======================================================================
+def _collector():
+    events, marks = [], []
+    return events, marks, (lambda kind, **f: events.append((kind, f))), \
+        (lambda kind, fields: marks.append((kind, fields)))
+
+
+@pytest.mark.parametrize("action", ["off", "log", "mark", "abort"])
+def test_rss_leak_action_matrix(action):
+    events, marks, on_event, on_mark = _collector()
+    wd = Watchdog({"rss_leak_action": action, "rss_leak_window": 6,
+                   "rss_leak_mb_per_round": 2.0},
+                  on_event=on_event, on_mark=on_mark)
+    fired = False
+    try:
+        for r in range(6):
+            wd.observe_round(r, host_rss_bytes=2 ** 30 + r * 5 * 2 ** 20)
+    except WatchdogAbort:
+        fired = True
+    kinds = [f["kind"] for f in wd.findings]
+    if action == "off":
+        assert kinds == [] and not events and not marks
+        return
+    assert kinds == ["rss_leak"]
+    assert events and events[0][0] == "watchdog_rss_leak"
+    assert events[0][1]["slope_mb_per_round"] == pytest.approx(5.0,
+                                                               rel=0.01)
+    assert bool(marks) == (action in ("mark", "abort"))
+    assert fired == (action == "abort")
+    # re-anchor: the window cleared, so the very next round cannot fire
+    wd.observe_round(6, host_rss_bytes=2 ** 30 + 6 * 5 * 2 ** 20)
+    assert [f["kind"] for f in wd.findings] == ["rss_leak"]
+
+
+@pytest.mark.parametrize("action", ["off", "log", "mark", "abort"])
+def test_throughput_drift_action_matrix(action):
+    events, marks, on_event, on_mark = _collector()
+    wd = Watchdog({"throughput_drift_action": action,
+                   "throughput_drift_window": 4,
+                   "throughput_drift_factor": 1.5,
+                   "round_time_action": "off"},
+                  on_event=on_event, on_mark=on_mark)
+    fired = False
+    try:
+        for r in range(4):          # anchor window: 1s rounds
+            wd.observe_round(r, round_secs=1.0)
+        for r in range(4, 9):       # drifted: 2x the anchor median
+            wd.observe_round(r, round_secs=2.0)
+    except WatchdogAbort:
+        fired = True
+    kinds = [f["kind"] for f in wd.findings]
+    if action == "off":
+        assert kinds == []
+        return
+    # latched: ONE finding for the sustained excursion, not one/round
+    assert kinds == ["throughput_drift"]
+    finding = wd.findings[0]
+    assert finding["trailing_median_secs"] == pytest.approx(2.0)
+    assert finding["anchor_median_secs"] == pytest.approx(1.0)
+    assert bool(marks) == (action in ("mark", "abort"))
+    assert fired == (action == "abort")
+    if action != "abort":
+        # recovery below the factor re-arms; a second excursion fires
+        # a second finding
+        for r in range(9, 13):
+            wd.observe_round(r, round_secs=1.0)
+        for r in range(13, 17):
+            wd.observe_round(r, round_secs=2.0)
+        assert [f["kind"] for f in wd.findings].count(
+            "throughput_drift") == 2
+
+
+@pytest.mark.parametrize("action", ["off", "log", "mark", "abort"])
+def test_stall_action_matrix(action, monkeypatch):
+    events, marks, on_event, on_mark = _collector()
+    interrupts = []
+    import _thread
+    monkeypatch.setattr(_thread, "interrupt_main",
+                        lambda: interrupts.append(1))
+    wd = Watchdog({"stall_action": action, "stall_poll_secs": 0.01,
+                   "stall_grace_secs": 0.08, "stall_factor": 2.0},
+                  on_event=on_event, on_mark=on_mark)
+    flights = []
+    wd.on_flight = flights.append
+    started = wd.start_stall_monitor()
+    assert started == (action != "off")
+    try:
+        if action == "off":
+            time.sleep(0.15)
+            assert wd.findings == []
+            return
+        # heartbeat, then go silent past the grace: the monitor fires
+        wd.observe_round(0, round_secs=0.01)
+        time.sleep(0.3)
+        kinds = [f["kind"] for f in wd.findings]
+        assert kinds == ["stall"], kinds  # fired once, then re-armed
+        assert events[0][0] == "watchdog_stall"
+        assert events[0][1]["thread"] == "flutescope-stall-monitor"
+        assert bool(marks) == (action in ("mark", "abort"))
+        if action == "abort":
+            # flight persisted BEFORE the main-thread interrupt
+            assert flights and flights[0].startswith("watchdog_stall")
+            assert interrupts
+        else:
+            assert not interrupts
+            # a fresh heartbeat re-arms the detector
+            wd.observe_round(1, round_secs=0.01)
+            time.sleep(0.3)
+            assert [f["kind"] for f in wd.findings].count("stall") == 2
+    finally:
+        wd.stop_stall_monitor()
+    assert not any(t.name == "flutescope-stall-monitor" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_stall_monitor_arms_at_first_heartbeat():
+    """Compile warmup (train entry -> first drained round) must never
+    false-fire, whatever the grace."""
+    wd = Watchdog({"stall_action": "log", "stall_poll_secs": 0.01,
+                   "stall_grace_secs": 0.02, "stall_factor": 2.0})
+    wd.start_stall_monitor()
+    try:
+        time.sleep(0.2)  # long silence BEFORE any heartbeat
+        assert wd.findings == []
+    finally:
+        wd.stop_stall_monitor()
+
+
+# ======================================================================
+# flight recorder unit + the three persist triggers through the server
+# ======================================================================
+def test_flight_recorder_ring_and_reasons(tmp_path):
+    fr = FlightRecorder(str(tmp_path), max_events=16)
+    for i in range(40):
+        fr.record_event("chaos_faults", {"round": i})
+    fr.rollup = RollupEngine(str(tmp_path), window=4)
+    fr.rollup.observe_round(0, 0.5, 8)
+    fr.card_fn = lambda: {"rounds": 1}
+    path = fr.persist("watchdog_stall: drill")
+    path2 = fr.persist("exception: RuntimeError", detail="boom")
+    assert path == path2
+    record = json.load(open(path, encoding="utf-8"))
+    assert [r["reason"] for r in record["reasons"]] == [
+        "watchdog_stall: drill", "exception: RuntimeError"]
+    assert len(record["events"]) == 16  # bounded ring kept the LAST 16
+    assert record["events"][0]["round"] == 24
+    assert record["live_window"]["rounds"] == 1
+    assert record["scorecard"] == {"rounds": 1}
+    assert record["host_rss_bytes"] > 0
+
+
+def _server(tmp_path, telemetry=None, rounds=6, chaos=None):
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    raw = {
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": rounds, "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.2, "pipeline_depth": 1,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 100, "initial_val": False, "data_config": {}},
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    }
+    if telemetry is not None:
+        raw["server_config"]["telemetry"] = telemetry
+    if chaos is not None:
+        raw["server_config"]["chaos"] = chaos
+    cfg = FLUTEConfig.from_dict(raw)
+    rng = np.random.default_rng(0)
+    users = [f"u{u}" for u in range(8)]
+    per = [{"x": rng.normal(size=(8, 8)).astype(np.float32),
+            "y": rng.integers(0, 4, 8).astype(np.int32)}
+           for _ in users]
+    return OptimizationServer(make_task(cfg.model_config), cfg,
+                              ArraysDataset(users, per),
+                              model_dir=str(tmp_path), seed=0)
+
+
+def _flight(tmp_path):
+    return json.load(open(os.path.join(tmp_path, "telemetry",
+                                       "flight.json"), encoding="utf-8"))
+
+
+def test_flight_on_watchdog_abort(tmp_path):
+    server = _server(tmp_path, telemetry={"enable": True,
+                                          "rollup_window": 2})
+    orig = server.scope.watchdog.observe_round
+
+    def firing(round_no, **kw):
+        if round_no >= 2:
+            server.scope.watchdog._fire("nan_loss", "abort",
+                                        round=round_no)
+        orig(round_no, **kw)
+
+    server.scope.watchdog.observe_round = firing
+    with pytest.raises(WatchdogAbort):
+        server.train()
+    record = _flight(tmp_path)
+    assert [r["reason"] for r in record["reasons"]] == [
+        "exception: WatchdogAbort"]
+    assert record["scorecard"]["watchdog_fires"] == {"nan_loss": 1}
+    assert any(e["kind"] == "watchdog_nan_loss"
+               for e in record["events"])
+    # the scorecard survives the abort too, with the new columns
+    card = json.load(open(tmp_path / "telemetry" / "scorecard.json",
+                          encoding="utf-8"))
+    assert card["trace_events_dropped"] == 0
+    assert "rollup_windows" in card
+
+
+def test_flight_on_preemption_request(tmp_path):
+    """The SIGTERM path: a preemption request persists the flight
+    record inside the pre-drain durability window (the real-signal
+    delivery of the same request is test_preempt_resume territory)."""
+    server = _server(tmp_path, telemetry={"enable": True},
+                     chaos={"seed": 3, "preempt_at_round": 2})
+    server.train()
+    assert server.preempted
+    record = _flight(tmp_path)
+    assert record["reasons"][0]["reason"].startswith("preemption")
+    assert "live_window" in record
+
+
+def test_flight_on_raised_exception(tmp_path):
+    server = _server(tmp_path, telemetry={"enable": True})
+    real = server.engine.dispatch_rounds
+
+    def exploding(*a, **k):
+        if server.state.round >= 2:
+            raise RuntimeError("synthetic dispatch failure")
+        return real(*a, **k)
+
+    server.engine.dispatch_rounds = exploding
+    with pytest.raises(RuntimeError):
+        server.train()
+    record = _flight(tmp_path)
+    assert record["reasons"][0]["reason"] == "exception: RuntimeError"
+    assert record["reasons"][0]["detail"] == "synthetic dispatch failure"
+
+
+# ======================================================================
+# bounded log growth: rotation + reader walking + torn tails
+# ======================================================================
+def test_metrics_rotation_and_reader_walk(tmp_path, monkeypatch):
+    from msrflute_tpu.telemetry import metrics as m
+    from msrflute_tpu.telemetry.scope_cli import _jsonl, _segment_paths
+
+    monkeypatch.setattr(m, "_METRICS_FH", None)
+    monkeypatch.setattr(m, "_METRICS_PATH", None)
+    m.open_metrics(str(tmp_path))
+    m.set_max_log_mb(0.002)  # ~2 KB: a handful of lines per segment
+    try:
+        for i in range(100):
+            m.log_metric("endurance_test_metric", float(i), step=i)
+            m.flush_metrics()
+    finally:
+        m.set_max_log_mb(0)
+        m.flush_metrics()
+    path = os.path.join(str(tmp_path), "metrics.jsonl")
+    segments = _segment_paths(path)
+    assert len(segments) > 2, "no rotation happened"
+    # writer-side and reader-side walks agree (the parity pin)
+    assert segments == m.jsonl_segment_paths(path)
+    records = _jsonl(path)
+    values = [r["value"] for r in records if "value" in r and
+              r.get("name") == "endurance_test_metric"]
+    assert values == [float(i) for i in range(100)], \
+        "rotation lost or reordered lines"
+    rotated = [r for r in records if r.get("event") == "log_rotated"]
+    assert rotated and rotated[0]["file"] == "metrics.jsonl"
+    assert rotated[0]["rotated_bytes"] > 0
+
+
+def test_reader_tolerates_torn_trailing_line(tmp_path):
+    from msrflute_tpu.telemetry.scope_cli import _jsonl
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"ts": 1.0, "event": "chaos_faults"}) + "\n")
+        fh.write('{"ts": 2.0, "event": "ckpt_io')  # killed mid-write
+    records = _jsonl(str(path))
+    assert len(records) == 1 and records[0]["event"] == "chaos_faults"
+
+
+def test_events_jsonl_rotation_in_run(tmp_path):
+    server = _server(tmp_path, telemetry={"enable": True,
+                                          "max_log_mb": 0.005},
+                     rounds=8)
+    server.train()
+    server.scope.close()
+    tdir = tmp_path / "telemetry"
+    assert os.path.exists(tdir / "events.jsonl.1"), \
+        "events.jsonl never rotated under a 5 KB cap"
+    from msrflute_tpu.telemetry.scope_cli import _jsonl
+    records = _jsonl(str(tdir / "events.jsonl"))
+    assert any(r.get("name") == "log_rotated" for r in records
+               if r.get("kind") == "event")
+    # spans from before AND after the rotation survive the walk
+    spans = [r for r in records if r.get("kind") == "span"]
+    assert len(spans) > 20
+
+
+def test_rollup_feeds_survive_concurrent_threads(tmp_path):
+    """The rollup engine is fed from three threads in a real run (main
+    drain, ckpt-latest-writer spans, stall-monitor events) while the
+    main thread flushes: hammer that shape and pin that no flush ever
+    crashes and no observation is lost."""
+    eng = RollupEngine(str(tmp_path), window=5)
+    stop = threading.Event()
+    errors = []
+
+    def pound(fn, *args):
+        try:
+            while not stop.is_set():
+                fn(*args)
+        except Exception as exc:  # pragma: no cover - the regression
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=pound, args=(eng.observe_phase,
+                                             "ckpt_async_write", 0.001),
+                         name="hammer-phase"),
+        threading.Thread(target=pound, args=(eng.observe_event,
+                                             "watchdog_stall"),
+                         name="hammer-event"),
+    ]
+    for t in threads:
+        t.start()
+    flushed = 0
+    for r in range(400):
+        eng.observe_round(r, 0.001, 4)
+        if eng.maybe_flush() is not None:
+            flushed += 1
+        eng.window_record(partial=True)  # the flight recorder's read
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    eng.close()
+    assert not errors, errors
+    records = [json.loads(line) for line in
+               open(tmp_path / "rollups.jsonl", encoding="utf-8")]
+    assert flushed == 80 and records[-1]["cum"]["rounds"] == 400
+
+
+def test_metrics_rotation_safe_under_concurrent_writers(tmp_path,
+                                                        monkeypatch):
+    """A writer on another thread (the async checkpoint writer's
+    events) racing the rotation swap must never hit a closed handle —
+    every line lands in some segment."""
+    from msrflute_tpu.telemetry import metrics as m
+    from msrflute_tpu.telemetry.scope_cli import _jsonl
+
+    monkeypatch.setattr(m, "_METRICS_FH", None)
+    monkeypatch.setattr(m, "_METRICS_PATH", None)
+    m.open_metrics(str(tmp_path))
+    m.set_max_log_mb(0.001)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                m.log_event("ckpt_io_fault", seq=i)
+                i += 1
+        except Exception as exc:  # pragma: no cover - the regression
+            errors.append(exc)
+
+    thread = threading.Thread(target=writer, name="hammer-writer")
+    thread.start()
+    try:
+        for i in range(300):
+            m.log_metric("hammered", float(i))
+            m.flush_metrics()
+    finally:
+        stop.set()
+        thread.join(timeout=5)
+        m.set_max_log_mb(0)
+        m.flush_metrics()
+    assert not errors, errors
+    records = _jsonl(os.path.join(str(tmp_path), "metrics.jsonl"))
+    values = [r["value"] for r in records if r.get("name") == "hammered"]
+    assert values == [float(i) for i in range(300)]
+
+
+def test_max_log_mb_resets_between_telemetry_instances(tmp_path):
+    """The metrics cap is a process global: a scope WITHOUT the knob
+    must restore the documented unbounded default, not inherit the
+    previous run's cap."""
+    from msrflute_tpu.telemetry import Telemetry
+    from msrflute_tpu.telemetry import metrics as m
+    Telemetry({"max_log_mb": 4, "trace": False, "rollup": False,
+               "flight": False}, str(tmp_path / "a"))
+    assert m._MAX_LOG_BYTES == 4 * 2 ** 20
+    Telemetry({"trace": False, "rollup": False, "flight": False},
+              str(tmp_path / "b"))
+    assert m._MAX_LOG_BYTES == 0
+
+
+def test_rollup_phases_exist_with_trace_off(tmp_path):
+    """The documented contract: per-phase rollup quantiles — including
+    the begin/end-style round_device window — exist with trace:false."""
+    server = _server(tmp_path, telemetry={"enable": True, "trace": False,
+                                          "rollup_window": 2}, rounds=4)
+    server.train()
+    assert server.scope.tracer is None
+    assert not os.path.exists(tmp_path / "telemetry" / "trace.json")
+    records = [json.loads(line) for line in
+               open(tmp_path / "telemetry" / "rollups.jsonl",
+                    encoding="utf-8")]
+    phases = set()
+    for rec in records:
+        phases.update(rec["phase_secs"])
+    assert {"round_device", "host_tail", "dispatch", "pack"} <= phases
+
+
+def test_health_is_silent_on_telemetry_off_runs(tmp_path):
+    """A run with no telemetry/ dir has nothing to judge: health must
+    not invent a no_rollups finding for it."""
+    from msrflute_tpu.telemetry.scope_cli import health
+    with open(tmp_path / "metrics.jsonl", "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"ts": 1.0, "name": "Training loss",
+                             "value": 0.5}) + "\n")
+    verdict = health(str(tmp_path))
+    assert verdict["ok"] and verdict["findings"] == []
+
+
+def test_trace_drop_counter_surfaces_in_rollups_and_scorecard(
+        tmp_path, monkeypatch):
+    """The Tracer's in-memory cap used to drop silently past the
+    in-trace flag; the cumulative drop count must now ride the rollup
+    gauges and the scorecard (ISSUE 13 satellite)."""
+    from msrflute_tpu.telemetry.spans import Tracer
+    monkeypatch.setattr(Tracer, "MAX_EVENTS", 8)
+    server = _server(tmp_path, telemetry={"enable": True,
+                                          "rollup_window": 2}, rounds=4)
+    server.train()
+    assert server.scope.tracer.dropped > 0
+    records = [json.loads(line) for line in
+               open(tmp_path / "telemetry" / "rollups.jsonl",
+                    encoding="utf-8")]
+    assert records[-1]["trace_events_dropped"] > 0
+    card = json.load(open(tmp_path / "telemetry" / "scorecard.json",
+                          encoding="utf-8"))
+    assert card["trace_events_dropped"] == server.scope.tracer.dropped
+
+
+# ======================================================================
+# the health oracle: golden fixtures + live runs
+# ======================================================================
+def test_health_golden_clean_gates_zero(capsys):
+    from msrflute_tpu.telemetry.scope_cli import main
+    rc = main(["health", os.path.join(FIXTURES, "clean"), "--gate"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"]
+    assert out["rollup_windows"] == 3
+    assert out["watchdog_fires"] == {"round_time_regression": 2}
+
+
+def test_health_golden_stalled_gates_three(capsys):
+    from msrflute_tpu.telemetry.scope_cli import main
+    rc = main(["health", os.path.join(FIXTURES, "stalled"), "--gate"])
+    captured = capsys.readouterr()
+    out = json.loads(captured.out)
+    assert rc == 3 and not out["ok"]
+    checks = {f["check"] for f in out["findings"]}
+    assert "watchdog_stall" in checks
+    assert "flight_abnormal" in checks
+    assert "watchdog_stall" in captured.err
+
+
+def test_health_flags_missing_rollups(tmp_path):
+    from msrflute_tpu.telemetry.scope_cli import health
+    os.makedirs(tmp_path / "telemetry")
+    verdict = health(str(tmp_path))
+    assert not verdict["ok"]
+    assert [f["check"] for f in verdict["findings"]] == ["no_rollups"]
+
+
+def test_scope_watch_once_formats_rollups(tmp_path, capsys):
+    from msrflute_tpu.telemetry.scope_cli import main
+    tdir = tmp_path / "telemetry"
+    os.makedirs(tdir)
+    with open(tdir / "rollups.jsonl", "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({
+            "kind": "rollup", "window": 0, "round_lo": 0,
+            "round_hi": 15, "rounds": 16, "secs_per_round_p50": 1.25,
+            "secs_per_round_p95": 2.0, "clients_per_sec": 10.5,
+            "mfu_p50": 0.031, "host_rss_bytes": 512 * 2 ** 20,
+            "events": {"chaos_faults": 3}}) + "\n")
+    rc = main(["watch", str(tmp_path), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "r[0,15]" in out and "1.25s/r" in out
+    assert "chaos_faults:3" in out and "rss 512MB" in out
+
+
+# ======================================================================
+# the harness driver end to end (the acceptance run, compressed)
+# ======================================================================
+def test_endurance_harness_clean(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from endurance import run_endurance
+    record = run_endurance(rounds=12, num_users=12,
+                           out_dir=str(tmp_path),
+                           report_path=str(tmp_path / "report.json"))
+    assert record["health"]["ok"]
+    extras = record["extras"]["endurance"]
+    assert extras["rollup_windows"] >= 2
+    assert extras["preempt_resume"] is True
+    assert extras["padding_efficiency"] is not None
+    # the trajectory record is scope-trend walkable
+    from msrflute_tpu.telemetry.scope_cli import trend_bench
+    out = trend_bench([str(tmp_path / "report.json"),
+                       str(tmp_path / "report.json")])
+    assert out["ok"] and "endurance" in out["series"][0]["protocols"]
+
+
+def test_endurance_harness_seeded_stall(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from endurance import run_endurance
+    record = run_endurance(rounds=12, num_users=12,
+                           out_dir=str(tmp_path), seed_stall=True)
+    assert not record["health"]["ok"]
+    checks = {f["check"] for f in record["health"]["findings"]}
+    assert "watchdog_stall" in checks

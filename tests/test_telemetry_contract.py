@@ -90,6 +90,10 @@ def test_telemetry_off_constructs_no_telemetry_state(tmp_path,
     monkeypatch.setattr(tel, "Telemetry", bomb)
     monkeypatch.setattr(tel.spans, "Tracer", bomb)
     monkeypatch.setattr(tel.xla, "XlaIntrospector", bomb)
+    # the endurance layer (ISSUE 13) honours the same contract:
+    # telemetry off constructs no rollup engine and no flight recorder
+    monkeypatch.setattr(tel.rollup, "RollupEngine", bomb)
+    monkeypatch.setattr(tel.rollup, "FlightRecorder", bomb)
     server, state = _run(_cfg(pipeline_depth=1), tmp_path)
     assert state.round == 6
     assert server.scope is None
@@ -187,6 +191,12 @@ def test_telemetry_on_zero_implicit_syncs_and_bit_identical(tmp_path,
     assert server.engine.xla.entries and server.engine.xla.recompiles == 0
     assert os.path.exists(
         tmp_path / f"tel{depth}" / "telemetry" / "scorecard.json")
+    # the rollup path ran through the same interception harness: the
+    # endurance layer is transfer-neutral and bit-neutral too (its
+    # default-on state is covered by the bit-identity assert above)
+    assert server.scope.rollup is not None
+    assert os.path.exists(
+        tmp_path / f"tel{depth}" / "telemetry" / "rollups.jsonl")
 
 
 def test_telemetry_on_keeps_one_packed_fetch_per_round(tmp_path,

@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""Endurance harness — the days-long-run drill, compressed (ISSUE 13).
+
+Composes what PRs 3-12 built into ONE driver with a machine oracle:
+
+- a heterogeneous client population (75% tiny clients + a heavy tail,
+  the cohort-bucketing shape) trained for ``--rounds`` rounds under
+  **chaos** (dropout + stragglers + checkpoint-IO faults), a **forced
+  preemption + resume** at the midpoint (the PR-3 drill, driven by
+  ``chaos.preempt_at_round``), **cohort shape-bucketing**, a
+  **depth-3 pipeline**, and ``MSRFLUTE_STRICT_TRANSFERS=1``;
+- flutescope endurance fully armed: windowed **rollups**
+  (``rollups.jsonl``), the **flight recorder**, size-capped log
+  rotation, and the longitudinal watchdogs (stall / rss_leak /
+  throughput_drift);
+- the pass/fail oracle is ``tools/scope health --gate`` over the run
+  directory — rollups present, no critical watchdog firing, no
+  abnormal flight record (the preemption flight is expected and
+  benign).
+
+``--seed-stall`` runs the adversarial arm instead: a deliberate hang is
+injected into one round's host tail, the stall watchdog (action
+``abort``) must fire, the flight record must carry it, and the health
+oracle must gate **exit 3** — proving the tripwire trips.
+
+The run also emits a BENCH_FLEET-style trajectory record
+(``--report``): clients/sec, rounds/hour, padding-efficiency and
+overlap-efficiency-% under an ``extras.endurance`` block shaped so
+``tools/scope trend`` can walk a committed series of them.
+
+Run: ``python tools/endurance.py`` (CPU, tens of seconds at the default
+``--rounds 40``); ``tests/test_endurance.py`` drives :func:`run_endurance`
+in-process with a smaller geometry.  Exit 0 iff every expectation held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+#: the chaos drill: every client-fault class live, plus the forced
+#: midpoint preemption the driver adds per-run
+CHAOS = {
+    "seed": 11,
+    "dropout_rate": 0.15,
+    "straggler_rate": 0.15,
+    "straggler_inflation": 2.0,
+    "ckpt_io_error_rate": 0.1,
+}
+
+#: endurance telemetry: small windows so a short drill still flushes
+#: several rollup records; longitudinal watchdogs on (log), stall armed
+#: to ABORT only in the seeded-stall arm
+TELEMETRY = {
+    "enable": True,
+    "rollup_window": 4,
+    "max_log_mb": 8,
+    "watchdog": {
+        "rss_leak_action": "log",
+        "rss_leak_window": 8,
+        "rss_leak_mb_per_round": 256.0,
+        "throughput_drift_action": "log",
+        "throughput_drift_window": 8,
+        "throughput_drift_factor": 3.0,
+    },
+}
+
+
+def _hetero_dataset(num_users: int, seed: int = 0):
+    """75% tiny clients + a log-spaced heavy tail (the skew cohort
+    bucketing exists for), on the LR protocol's feature geometry."""
+    import numpy as np
+    from msrflute_tpu.data import ArraysDataset
+
+    rng = np.random.default_rng(seed)
+    users, per = [], []
+    for u in range(num_users):
+        if u % 4 == 0:
+            n = int(8 * 2 ** (u % 3 + 1))  # heavy tail: 16/32/64
+        else:
+            n = 8
+        users.append(f"u{u}")
+        per.append({
+            "x": rng.normal(size=(n, 8)).astype(np.float32),
+            "y": rng.integers(0, 4, n).astype(np.int32)})
+    return ArraysDataset(users, per)
+
+
+def _config(rounds: int, preempt_at: int, stall: bool):
+    from msrflute_tpu.config import FLUTEConfig
+
+    telemetry = json.loads(json.dumps(TELEMETRY))  # deep copy
+    if stall:
+        telemetry["watchdog"].update({
+            "stall_action": "abort",
+            # tuned to the injected 2 s hang against ~ms CPU rounds
+            "stall_poll_secs": 0.05,
+            "stall_grace_secs": 0.5,
+            "stall_factor": 10.0,
+        })
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedavg",
+        "server_config": {
+            "max_iteration": rounds,
+            "num_clients_per_iteration": 8,
+            "initial_lr_client": 0.1,
+            "rounds_per_step": 2,
+            "pipeline_depth": 3,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 1000, "initial_val": False,
+            "resume_from_checkpoint": True,
+            "data_config": {},
+            "cohort_bucketing": {"max_buckets": 3, "slack": 2.0},
+            "chaos": dict(CHAOS, preempt_at_round=preempt_at),
+            "checkpoint_retry": {"retries": 3, "backoff_base_s": 0.0,
+                                 "jitter": 0.0},
+            "telemetry": telemetry,
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.1},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def run_endurance(rounds: int = 40, num_users: int = 24,
+                  out_dir: str | None = None,
+                  seed_stall: bool = False,
+                  report_path: str | None = None) -> dict:
+    """Drive the full drill; returns the result record (also written to
+    ``report_path``).  Raises AssertionError on any broken expectation
+    — the CI smoke job runs this under ``python tools/endurance.py``."""
+    os.environ.setdefault("MSRFLUTE_STRICT_TRANSFERS", "1")
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.telemetry.scope_cli import health, summarize
+    from msrflute_tpu.utils.logging import init_logging
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="endurance_")
+    init_logging(out_dir)
+    dataset = _hetero_dataset(num_users)
+    preempt_at = max(rounds // 2, 1)
+    tic = time.time()
+
+    # ---- leg 1: train into the forced preemption ---------------------
+    cfg = _config(rounds, preempt_at, stall=False)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                dataset, model_dir=out_dir, seed=0)
+    server.train()
+    assert server.preempted, "forced preemption never fired"
+    assert server.state.round >= preempt_at, (
+        server.state.round, preempt_at)
+    flight_path = os.path.join(out_dir, "telemetry", "flight.json")
+    assert os.path.exists(flight_path), \
+        "preemption did not persist flight.json"
+    rollups_path = os.path.join(out_dir, "telemetry", "rollups.jsonl")
+    assert os.path.exists(rollups_path), \
+        "no rollups.jsonl after leg 1 — incremental flush broken"
+
+    # ---- leg 2: resume to completion (optionally stall-seeded) -------
+    cfg2 = _config(rounds, preempt_at, stall=seed_stall)
+    server2 = OptimizationServer(make_task(cfg2.model_config), cfg2,
+                                 dataset, model_dir=out_dir, seed=0)
+    stalled = False
+    if seed_stall:
+        drain = server2._drain_chunk
+        hit = {"n": 0}
+
+        def hanging_drain(chunk, vf, rf):
+            hit["n"] += 1
+            # hang on the SECOND drain: the first drain's heartbeat has
+            # armed the monitor and seeded the trailing median by then,
+            # and even the smallest test geometry reaches drain 2.  The
+            # hang must out-sleep the LIVE limit — the trailing median
+            # here includes leg-2 recompile rounds, so a fixed sleep
+            # would under-shoot exactly when compiles are slow
+            if hit["n"] == 2:
+                wd = server2.scope.watchdog
+                limit = max(float(wd.cfg["stall_factor"]) *
+                            float(wd._beat[1]),
+                            float(wd.cfg["stall_grace_secs"]))
+                time.sleep(limit + 1.0)  # the "hung dispatch" stand-in
+            drain(chunk, vf, rf)
+
+        server2._drain_chunk = hanging_drain
+    try:
+        server2.train()
+    except BaseException as exc:  # KeyboardInterrupt from the monitor
+        stalled = True
+        print(f"endurance: stall unwind via {type(exc).__name__}")
+    if seed_stall and not stalled:
+        # the monitor's interrupt landed as a graceful SIGINT
+        # preemption (the installed handler's territory) — the stall
+        # FINDING is the contract either way
+        stalled = any(f.get("kind") == "stall"
+                      for f in server2.scope.watchdog.findings)
+    wall = time.time() - tic
+
+    # ---- the oracle --------------------------------------------------
+    verdict = health(out_dir)
+    gate_exit = 0 if verdict["ok"] else 3
+    if seed_stall:
+        assert stalled, "seeded stall never fired the stall watchdog"
+        assert gate_exit == 3, (
+            "seeded-stall run must gate unhealthy", verdict)
+        kinds = {f["check"] for f in verdict["findings"]}
+        assert "watchdog_stall" in kinds, verdict
+    else:
+        assert server2.state.round == rounds, (
+            server2.state.round, rounds)
+        assert gate_exit == 0, ("clean run must gate healthy", verdict)
+        assert verdict["rollup_windows"] >= 2, verdict
+
+    # ---- trajectory record (BENCH_FLEET shape; scope trend walks the
+    # extras.<name>.secs_per_round convention) -------------------------
+    summary = summarize(out_dir)
+    card = (summary.get("scorecard") or {}) if isinstance(
+        summary.get("scorecard"), dict) else {}
+    secs_p50 = card.get("round_secs_p50")
+    rollup_last = (verdict.get("last_window") or {})
+    record = {
+        "kind": "endurance",
+        "metric": "endurance_secs_per_round",
+        "value": secs_p50,
+        "rounds": rounds,
+        "seed_stall": bool(seed_stall),
+        "wall_secs": round(wall, 2),
+        "health": {"ok": verdict["ok"],
+                   "findings": verdict["findings"],
+                   "warnings": verdict["warnings"]},
+        "extras": {
+            "endurance": {
+                "secs_per_round": secs_p50,
+                "rounds_per_hour": (round(3600.0 / secs_p50, 1)
+                                    if secs_p50 else None),
+                "clients_per_sec": rollup_last.get("clients_per_sec"),
+                "padding_efficiency": card.get("padding_efficiency"),
+                "overlap_efficiency_pct":
+                    card.get("overlap_efficiency_pct"),
+                "mfu_p50": card.get("mfu_p50"),
+                "recompiles": card.get("recompiles"),
+                "rollup_windows": verdict.get("rollup_windows"),
+                "preempt_resume": True,
+            },
+        },
+    }
+    if report_path:
+        tmp = report_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(record, fh, indent=1, sort_keys=True)
+        os.replace(tmp, report_path)
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--rounds", type=int, default=40)
+    ap.add_argument("--users", type=int, default=24)
+    ap.add_argument("--out-dir", default=None)
+    ap.add_argument("--seed-stall", action="store_true",
+                    help="adversarial arm: inject a hang, expect the "
+                         "stall watchdog + health gate 3")
+    ap.add_argument("--report", default=None,
+                    help="write the trajectory record here")
+    args = ap.parse_args(argv)
+    record = run_endurance(rounds=args.rounds, num_users=args.users,
+                           out_dir=args.out_dir,
+                           seed_stall=args.seed_stall,
+                           report_path=args.report)
+    print(json.dumps(record, indent=1, sort_keys=True))
+    ok = record["health"]["ok"] if not args.seed_stall else \
+        not record["health"]["ok"]
+    print("endurance:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
