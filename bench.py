@@ -739,6 +739,21 @@ def _server_overhead_extras(server) -> dict:
         prec = sc_cfg.get("precision")
     out["precision"] = ({"enabled": False} if not prec else
                         dict(prec, enabled=prec.get("enable", True)))
+    # fleet marker (ISSUE 14): paged-carry / O(cohort)-sampling runs
+    # join the contract trio — a fleet run pays page-in/writeback
+    # transfers per round and draws (optionally) a different sampling
+    # trail, so comparing it against a resident baseline without the
+    # marker would misattribute both
+    pager = getattr(server, "fleet_pager", None)
+    if getattr(server, "_fleet_cfg", None) is None:
+        out["fleet"] = {"enabled": False}
+    else:
+        out["fleet"] = dict(
+            {"enabled": True,
+             "sampling": str(server._fleet_cfg.get("sampling",
+                                                   "uniform")),
+             "paged_carry": pager is not None},
+            **(pager.describe() if pager is not None else {}))
     # robust mode completes the trio: a fluteshield-defended run pays
     # screening (and possibly a sort-based robust combine) per round —
     # comparing it against an undefended baseline without the marker

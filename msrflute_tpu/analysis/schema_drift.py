@@ -58,6 +58,9 @@ _SPEC_MAP = {
     # enum-typed (dtype names) so it keeps bespoke checks in validate()
     # and has no scalar spec table
     "MEGAKERNEL_FIELD_SPECS": "MEGAKERNEL_KEYS",
+    # fleet mode (PR 14); `sampling` is enum-typed and keeps its
+    # bespoke check in validate()
+    "FLEET_FIELD_SPECS": "FLEET_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -96,6 +99,10 @@ DOCUMENTED_KNOBS = (
     # leave the MXU's half-rate f32 path on forever — or flip dtypes
     # blind and lose bit-identity without knowing what they traded
     "precision",
+    # fleet mode: an operator who cannot find the paging / O(cohort)
+    # sampling drill will keep sizing HBM by population and believe
+    # million-client runs are impossible
+    "fleet",
 )
 
 _DOC_MENTION_RE = re.compile(

@@ -586,3 +586,13 @@ def parse_clients_per_round(spec: Any, rng) -> int:
         lo, hi = (int(x) for x in spec.split(":"))
         return int(rng.integers(lo, hi + 1))
     return int(spec)
+
+
+def cohort_upper_bound(spec: Any) -> int:
+    """The largest cohort ``num_clients_per_iteration`` can draw — the
+    rng-free companion of :func:`parse_clients_per_round` (one parser
+    for the ``"lo:hi"`` spec; capacity/pool sizing must never desync
+    from the draw's format)."""
+    if isinstance(spec, str) and ":" in spec:
+        return int(spec.split(":")[1])
+    return int(spec)

@@ -6,3 +6,7 @@ from .batching import (  # noqa: F401
     pack_round_indices, padding_efficiency, pow2_ceil, steps_for,
 )
 from .samplers import BatchSampler, DynamicBatchSampler  # noqa: F401
+from .fleet import (  # noqa: F401
+    SyntheticFleetDataset, floyd_sample, sample_cohort, steps_for_array,
+    weighted_reservoir_sample,
+)

@@ -41,6 +41,11 @@ class RoundBatch:
     num_samples: np.ndarray
     client_mask: np.ndarray
     client_ids: np.ndarray
+    #: fleet paging (server_config.fleet): per-lane PAGE-POOL SLOT ids
+    #: for the carry gather/scatter, parallel to ``client_ids`` (-1 for
+    #: padding).  None outside paged-carry mode — the engine then uses
+    #: ``client_ids`` for both, which is the resident-table program.
+    carry_slots: Optional[np.ndarray] = None
 
     @property
     def shape(self):
@@ -181,6 +186,8 @@ class IndexRoundBatch:
     num_samples: np.ndarray
     client_mask: np.ndarray
     client_ids: np.ndarray
+    #: see :class:`RoundBatch.carry_slots`
+    carry_slots: Optional[np.ndarray] = None
 
     @property
     def shape(self):
@@ -344,10 +351,15 @@ def bucket_boundaries(needs: Sequence[int], max_buckets: int,
     """
     if max_buckets < 1:
         raise ValueError("cohort_bucketing.max_buckets must be >= 1")
-    pops: dict = {}
-    for need in needs:
-        s = min(pow2_ceil(max(int(need), 1)), int(max_steps))
-        pops[s] = pops.get(s, 0) + 1
+    # vectorized pow2-ceil histogram (fleet scale: a 10^6-entry needs
+    # array is one numpy pass, not 10^6 interpreter iterations) —
+    # searchsorted against the exact power table, no float log2 detour
+    arr = np.maximum(np.asarray(needs, dtype=np.int64), 1)
+    pow_table = np.int64(1) << np.arange(63, dtype=np.int64)
+    ceils = np.minimum(pow_table[np.searchsorted(pow_table, arr)],
+                       np.int64(max_steps))
+    uniq, counts = np.unique(ceils, return_counts=True)
+    pops: dict = {int(s): int(c) for s, c in zip(uniq, counts)}
     bounds = sorted(pops)
     # greedy merge: absorbing bucket b into the next-larger one costs its
     # population x the extra padded steps; drop the cheapest until bounded
@@ -390,24 +402,30 @@ def assign_step_buckets(needs: Sequence[int],
     if any(b <= a for a, b in zip(bounds, bounds[1:])):
         raise ValueError(
             f"bucket boundaries must be strictly increasing, got {bounds}")
+    # vectorized first-fit-with-spill (fleet scale: 10^6-entry cohorts
+    # must assign in one numpy pass per bucket, not a python scan per
+    # client).  Semantics are EXACTLY the sequential first-fit's:
+    # bucket i holds the first cap_i cohort-order clients whose need
+    # fits and who weren't placed lower — proved by induction on i and
+    # pinned against the brute loop in tests/test_fleet.py.
+    arr = np.maximum(np.asarray(needs, dtype=np.int64), 1)
+    b_arr = np.asarray(bounds, dtype=np.int64)
+    if arr.size and int(arr.max()) > int(b_arr[-1]):
+        bad = int(arr.max())
+        raise ValueError(
+            f"client step need {bad} exceeds the largest bucket "
+            f"boundary {bounds[-1]} — boundaries must cover max_steps")
+    first_fit = np.searchsorted(b_arr, arr)  # smallest covering bucket
     out: Dict[int, list] = ({s: [] for s in bounds}
                             if capacities is not None else {})
-    for j, need in enumerate(needs):
-        need = max(int(need), 1)
-        placed = False
-        for i, s in enumerate(bounds):
-            if need > s:
-                continue
-            if capacities is not None and i < len(bounds) - 1 and \
-                    len(out[s]) >= int(capacities[i]):
-                continue  # bucket full: spill up to the next larger S
-            out.setdefault(s, []).append(j)
-            placed = True
-            break
-        if not placed:
-            raise ValueError(
-                f"client step need {need} exceeds the largest bucket "
-                f"boundary {bounds[-1]} — boundaries must cover max_steps")
+    placed = np.zeros(arr.shape, dtype=bool)
+    for i, s in enumerate(bounds):
+        elig = np.flatnonzero((first_fit <= i) & ~placed)
+        if capacities is not None and i < len(bounds) - 1:
+            elig = elig[:int(capacities[i])]  # overflow spills UP
+        if elig.size:
+            out.setdefault(s, []).extend(int(j) for j in elig)
+            placed[elig] = True
     return {s: out[s] for s in sorted(out)}
 
 
@@ -429,13 +447,14 @@ def bucket_capacities(needs: Sequence[int], boundaries: Sequence[int],
     is pow2-quantized so even pathological overflow stays logarithmic
     in compiled variants)."""
     bounds = list(boundaries)
-    counts = {s: 0 for s in bounds}
-    for need in needs:
-        need = max(int(need), 1)
-        for s in bounds:
-            if need <= s:
-                counts[s] += 1
-                break
+    # vectorized smallest-covering-bucket histogram (fleet scale): one
+    # searchsorted over the population instead of a per-client scan
+    arr = np.maximum(np.asarray(needs, dtype=np.int64), 1)
+    b_arr = np.asarray(bounds, dtype=np.int64)
+    fit = np.searchsorted(b_arr, arr)
+    fit = fit[fit < len(bounds)]  # needs beyond the top bucket: uncounted
+    hist = np.bincount(fit, minlength=len(bounds))
+    counts = {s: int(hist[i]) for i, s in enumerate(bounds)}
     total = max(sum(counts.values()), 1)
     caps = []
     for s in bounds:

@@ -124,12 +124,29 @@ class LazyUserDataset(BaseDataset):
         # discipline or a concurrent insert's eviction can race a reader's
         # membership-check -> move_to_end sequence
         self._cache_lock = threading.Lock()
+        #: monotone cache counters (fleet observability): the server
+        #: publishes these through the host-side devbus per drained
+        #: chunk, so a fleet run's featurize-IO behavior is a rollup
+        #: column instead of a guess — see :meth:`cache_stats`
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Hit/miss/eviction counters + live resident size, read under
+        the cache lock (the structured-telemetry surface)."""
+        with self._cache_lock:
+            return {"hits": self.cache_hits, "misses": self.cache_misses,
+                    "evictions": self.cache_evictions,
+                    "resident": len(self._cache)}
 
     def user_arrays(self, user_idx: int) -> Dict[str, np.ndarray]:
         with self._cache_lock:
             if user_idx in self._cache:
+                self.cache_hits += 1
                 self._cache.move_to_end(user_idx)
                 return self._cache[user_idx]
+            self.cache_misses += 1
         data, label = self._users.read(self.user_list[user_idx])
         arrays = self._featurize(data, label)
         # the eager ArraysDataset validates array lengths against
@@ -146,6 +163,7 @@ class LazyUserDataset(BaseDataset):
             self._cache[user_idx] = arrays
             if len(self._cache) > self._cache_users:
                 self._cache.popitem(last=False)
+                self.cache_evictions += 1
         return arrays
 
     def subset(self, keep: Sequence[int]) -> "LazyUserDataset":

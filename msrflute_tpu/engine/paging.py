@@ -1,0 +1,468 @@
+"""Fleet paged carry tables (``server_config.fleet``) — O(cache) HBM.
+
+The PR 6 carry design keeps each device-carry strategy's per-client
+state (SCAFFOLD controls, EF residuals, personalization heads/alphas)
+as ``[N, n_params]`` device residents inside ``strategy_state``.  That
+is exactly the thing that cannot scale to 10^6 clients: at fleet size
+the tables, not the model, own HBM.
+
+This module replaces the resident tables with a **fixed-capacity page
+pool** plus a **host backing store**, behind the SAME
+``client_step_carry`` / ``apply_carry`` gather/scatter hooks:
+
+- the tables shrink to ``[P, ...]`` where ``P = fleet.page_pool_slots``
+  (``strategy.carry_rows``); the in-program math is unchanged because
+  the engine feeds the carry hooks host-remapped SLOT ids instead of
+  client ids (the per-client rng streams keep folding on the TRUE
+  client id, so per-client math is bit-identical to resident mode);
+- before each chunk dispatches, :meth:`CarryPager.prepare_chunk` maps
+  the cohort onto slots: hits reuse their resident row, misses page in
+  from the host store as ONE fixed-shape scatter (width pow2-quantized,
+  sentinel-padded with out-of-bounds drop — zero post-warmup
+  recompiles by construction) that donates the tables in sequence with
+  the round programs;
+- right after dispatch, :meth:`queue_writeback` dispatches a small
+  gather of the chunk's slot rows from the post-chunk tables (reading
+  BEFORE the next dispatch donates them — the ``dp_clip`` stash
+  discipline); the pipeline drain completes it with one explicit
+  ``device_get`` and writes the rows through to the host store, so a
+  slot is evictable exactly when no in-flight chunk pins it;
+- eviction is LRU over unpinned slots; pinned (in-flight) rows are
+  never evicted, so depth-N pipelining stays safe — a pool too small
+  for ``(depth+1)`` cohorts refuses loudly instead of corrupting rows;
+- durability rides the :class:`FleetRowStore`: RAM-LRU rows with
+  crash-safe ``.npz`` spill under the model dir and the same
+  round-marker pairing as the SCAFFOLD ``ControlStore`` — a resumed
+  run reloads rows from disk into an EMPTY pool (slot numbering is
+  invisible to the math), so preempt-and-resume stays bit-identical.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _pow2_width(n: int, floor: int = 8) -> int:
+    """Pow2-quantized program width for the page-in/writeback programs:
+    the compiled-variant set stays logarithmic and closes after
+    warmup."""
+    n = max(int(n), int(floor))
+    return 1 << max(n - 1, 0).bit_length()
+
+
+class FleetRowStore:
+    """Host backing store for paged carry rows.
+
+    One logical row per client: a dict ``{table_key: np.ndarray}``.
+    RAM is LRU-bounded at ``cache_rows``; evicting a dirty row writes
+    it through to disk first (crash-safe tmp+rename ``.npz``), so the
+    union of RAM and disk is always the current row set.  ``flush()``
+    writes the remaining dirty rows through — the server calls it at
+    ``fleet.spill_freq`` cadence and commits the round marker only
+    after the paired model checkpoint is durable (the ControlStore
+    discipline; a marker/checkpoint mismatch on resume resets the
+    rows — carry state belongs to exactly one parameter trajectory).
+    """
+
+    def __init__(self, store_dir: Optional[str], cache_rows: int = 8192,
+                 resume: bool = False):
+        self.store_dir = store_dir
+        self.cache_rows = max(int(cache_rows), 1)
+        self._rows: "OrderedDict[int, Dict[str, np.ndarray]]" = \
+            OrderedDict()
+        self._dirty: set = set()
+        self.spilled_rows = 0
+        if store_dir is not None:
+            os.makedirs(store_dir, exist_ok=True)
+            if not resume:
+                self._wipe_files()
+
+    # -- paths ----------------------------------------------------------
+    def _path(self, cid: int) -> str:
+        return os.path.join(self.store_dir, f"row_{int(cid)}.npz")
+
+    def _marker_path(self) -> str:
+        return os.path.join(self.store_dir, "fleet_round.npy")
+
+    def _wipe_files(self) -> None:
+        for name in os.listdir(self.store_dir):
+            if name.startswith("row_") or name == "fleet_round.npy":
+                os.remove(os.path.join(self.store_dir, name))
+
+    # -- rows -----------------------------------------------------------
+    def get(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
+        cid = int(cid)
+        row = self._rows.get(cid)
+        if row is not None:
+            self._rows.move_to_end(cid)
+            return row
+        if self.store_dir is not None:
+            path = self._path(cid)
+            if os.path.exists(path):
+                with np.load(path) as zf:
+                    row = {k: zf[k] for k in zf.files}
+                self._insert(cid, row, dirty=False)
+                return row
+        return None
+
+    def put(self, cid: int, row: Dict[str, np.ndarray]) -> None:
+        self._insert(int(cid), row, dirty=True)
+
+    def _insert(self, cid: int, row: Dict[str, np.ndarray],
+                dirty: bool) -> None:
+        self._rows.pop(cid, None)
+        self._rows[cid] = row
+        if dirty:
+            self._dirty.add(cid)
+        while len(self._rows) > self.cache_rows:
+            old_cid, old_row = self._rows.popitem(last=False)
+            if old_cid in self._dirty:
+                # nowhere else holds the latest value: spill-through
+                self._write(old_cid, old_row)
+                self._dirty.discard(old_cid)
+                self.spilled_rows += 1
+
+    def _write(self, cid: int, row: Dict[str, np.ndarray]) -> None:
+        if self.store_dir is None:
+            return
+        path = self._path(cid)
+        tmp = path + ".tmp.npz"  # .npz suffix stops np.savez appending one
+        np.savez(tmp, **row)
+        os.replace(tmp, path)
+
+    def has_rows(self) -> bool:
+        """Whether ANY client has a stored row (RAM or disk) — the
+        cheap personalized-eval seen gate.  scandir short-circuits at
+        the first row file: O(1), never an O(N)-filename listing."""
+        if self._rows:
+            return True
+        if self.store_dir is None:
+            return False
+        with os.scandir(self.store_dir) as it:
+            return any(entry.name.startswith("row_") for entry in it)
+
+    # -- durability -----------------------------------------------------
+    def flush(self) -> int:
+        """Write every dirty RAM row through to disk; returns the row
+        count (the spill transfer meter)."""
+        if self.store_dir is None:
+            self._dirty.clear()
+            return 0
+        n = 0
+        for cid in sorted(self._dirty):
+            row = self._rows.get(cid)
+            if row is not None:
+                self._write(cid, row)
+                n += 1
+        self._dirty.clear()
+        return n
+
+    def set_round(self, round_no: int) -> None:
+        if self.store_dir is None:
+            return
+        path = self._marker_path()
+        tmp = path + ".tmp.npy"
+        np.save(tmp, np.asarray([int(round_no)], np.int64))
+        os.replace(tmp, path)
+
+    def round(self) -> Optional[int]:
+        if self.store_dir is None or not os.path.exists(
+                self._marker_path()):
+            return None
+        return int(np.load(self._marker_path())[0])
+
+    def reset(self) -> None:
+        """Drop every row + marker (trajectory-mismatch semantics)."""
+        self._rows.clear()
+        self._dirty.clear()
+        if self.store_dir is not None:
+            self._wipe_files()
+
+
+class CarryPager:
+    """Slot allocator + page-in/writeback programs for ONE run's carry
+    tables.  Single-threaded by design: every method is called from the
+    server's round loop (prepare -> dispatch -> queue -> drain)."""
+
+    def __init__(self, strategy, state_tables: Dict[str, Any],
+                 slots: int, mesh,
+                 store_dir: Optional[str] = None,
+                 host_cache_rows: int = 8192,
+                 resume: bool = False):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.strategy = strategy
+        self.keys = tuple(strategy.carry_tables)
+        if not self.keys:
+            raise ValueError(
+                f"{type(strategy).__name__} declares no carry_tables — "
+                "fleet paging has nothing to page; drop the fleet block "
+                "or use a device-carry strategy")
+        self.n_slots = int(slots)
+        # per-key row geometry straight off the live tables (shape[0]
+        # is the slot count; everything after is the row)
+        self._row_shape = {}
+        self._row_dtype = {}
+        for k in self.keys:
+            leaf = state_tables[k]
+            if int(leaf.shape[0]) != self.n_slots:
+                raise ValueError(
+                    f"fleet paging: strategy_state[{k!r}] has "
+                    f"{int(leaf.shape[0])} rows but the page pool is "
+                    f"{self.n_slots} slots — carry_rows was not applied "
+                    "before init_state")
+            self._row_shape[k] = tuple(int(d) for d in leaf.shape[1:])
+            self._row_dtype[k] = np.dtype(str(leaf.dtype))
+        self._defaults = dict(strategy.carry_row_defaults())
+        self._rep = NamedSharding(mesh, P())
+        self.store = FleetRowStore(store_dir, cache_rows=host_cache_rows,
+                                   resume=resume)
+
+        # ---- slot state ----------------------------------------------
+        self._free: List[int] = list(range(self.n_slots - 1, -1, -1))
+        self._slot_client = np.full((self.n_slots,), -1, np.int64)
+        self._client_slot: Dict[int, int] = {}
+        self._pins = np.zeros((self.n_slots,), np.int64)
+        #: unpinned slots in LRU order (front = evict first)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._ticket: Optional[Dict[str, Any]] = None
+
+        # ---- compiled program caches (one per pow2 width) ------------
+        self._scatter_cache: Dict[int, Any] = {}
+        self._gather_cache: Dict[int, Any] = {}
+        self._jax = jax
+
+        # ---- counters (bench marker + devbus gauges) -----------------
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.page_in_rows = 0
+        self.writeback_rows = 0
+
+    # ------------------------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "pool_slots": self.n_slots,
+            "resident": int(len(self._client_slot)),
+            "hits": int(self.hits),
+            "misses": int(self.misses),
+            "evictions": int(self.evictions),
+            "page_in_rows": int(self.page_in_rows),
+            "writeback_rows": int(self.writeback_rows),
+            "spilled_rows": int(self.store.spilled_rows),
+            "tables": list(self.keys),
+        }
+
+    def hbm_row_bytes(self) -> int:
+        """Bytes one pool row costs across all table keys — the pool's
+        HBM budget is ``n_slots * hbm_row_bytes()``, independent of N."""
+        return int(sum(
+            int(np.prod(self._row_shape[k], dtype=np.int64) or 1)
+            * self._row_dtype[k].itemsize for k in self.keys))
+
+    # ------------------------------------------------------------------
+    # slot allocation
+    # ------------------------------------------------------------------
+    def _pin(self, slot: int) -> None:
+        if self._pins[slot] == 0:
+            self._lru.pop(slot, None)
+        self._pins[slot] += 1
+
+    def _unpin(self, slot: int) -> None:
+        self._pins[slot] -= 1
+        if self._pins[slot] <= 0:
+            self._pins[slot] = 0
+            if self._slot_client[slot] >= 0:
+                self._lru[slot] = None  # tail = most recently used
+
+    def _alloc(self, cid: int) -> int:
+        if self._free:
+            slot = self._free.pop()
+        elif self._lru:
+            slot, _ = self._lru.popitem(last=False)  # LRU head
+            old = int(self._slot_client[slot])
+            # the host store already holds the evictee's current row:
+            # unpinned means every chunk that touched it drained, and
+            # the drain wrote the row back — eviction costs zero device
+            # traffic
+            self._client_slot.pop(old, None)
+            self.evictions += 1
+        else:
+            raise ValueError(
+                f"fleet.page_pool_slots={self.n_slots} cannot hold the "
+                "in-flight cohorts: every slot is pinned by a dispatched "
+                "chunk — raise page_pool_slots (it must cover "
+                "(pipeline_depth + 1) x cohort x rounds_per_step rows)")
+        self._slot_client[slot] = cid
+        self._client_slot[cid] = slot
+        return slot
+
+    # ------------------------------------------------------------------
+    # per-chunk flow
+    # ------------------------------------------------------------------
+    def prepare_chunk(self, batches: list, strategy_state: Any) -> Any:
+        """Map the chunk's cohorts onto pool slots (writes
+        ``batch.carry_slots`` on every grid, -1 for padding lanes),
+        page missing rows in as one fixed-shape donated scatter, and
+        pin the touched slots until this chunk drains.  Returns the
+        (possibly updated) ``strategy_state``."""
+        if self._ticket is not None:
+            raise RuntimeError(
+                "fleet pager: prepare_chunk called with an unconsumed "
+                "ticket — queue_writeback must run after each dispatch")
+        flat = [b for entry in batches
+                for b in (entry if isinstance(entry, list) else [entry])]
+        chunk_slots: "OrderedDict[int, int]" = OrderedDict()  # slot->cid
+        miss: List[tuple] = []
+        for b in flat:
+            ids = np.asarray(b.client_ids)
+            slots = np.full(ids.shape, -1, np.int32)
+            for j, cid in enumerate(ids):
+                cid = int(cid)
+                if cid < 0:
+                    continue
+                slot = self._client_slot.get(cid)
+                if slot is None:
+                    slot = self._alloc(cid)
+                    miss.append((cid, slot))
+                    self.misses += 1
+                else:
+                    self.hits += 1
+                    if self._pins[slot] == 0 and slot in self._lru:
+                        self._lru.move_to_end(slot)
+                slots[j] = slot
+                if slot not in chunk_slots:
+                    chunk_slots[slot] = cid
+                    self._pin(slot)
+            b.carry_slots = slots
+        self._ticket = {
+            "slots": np.asarray(list(chunk_slots), np.int32),
+            "ids": np.asarray(list(chunk_slots.values()), np.int64),
+        }
+        if miss:
+            strategy_state = self._page_in(strategy_state, miss)
+        return strategy_state
+
+    def _page_in(self, strategy_state: Any, miss: List[tuple]) -> Any:
+        jax = self._jax
+        import jax.numpy as jnp
+        W = _pow2_width(len(miss))
+        slot_arr = np.full((W,), self.n_slots, np.int32)  # sentinel: drop
+        rows = {k: np.full((W,) + self._row_shape[k],
+                           self._defaults.get(k, 0.0),
+                           self._row_dtype[k]) for k in self.keys}
+        for i, (cid, slot) in enumerate(miss):
+            slot_arr[i] = slot
+            stored = self.store.get(cid)
+            if stored is not None:
+                for k in self.keys:
+                    rows[k][i] = stored[k]
+        self.page_in_rows += len(miss)
+        fn = self._scatter_cache.get(W)
+        if fn is None:
+            keys = self.keys
+
+            def scatter(tables, slots, new_rows):
+                # sentinel-padded lanes target index n_slots: out of
+                # bounds, mode="drop" — the fixed [W] shape never
+                # retraces on the miss count
+                return {k: tables[k].at[slots].set(new_rows[k],
+                                                   mode="drop")
+                        for k in keys}
+
+            fn = jax.jit(scatter, donate_argnums=(0,))
+            self._scatter_cache[W] = fn
+        tables = {k: strategy_state[k] for k in self.keys}
+        # one replicated put for the whole padded row dict — the page-in
+        # transfer is len(keys) buffers regardless of miss count
+        rows_dev = jax.device_put(rows, self._rep)
+        new_tables = fn(tables, jnp.asarray(slot_arr), rows_dev)
+        new_state = dict(strategy_state)
+        new_state.update(new_tables)
+        return new_state
+
+    def queue_writeback(self, strategy_state: Any) -> Dict[str, Any]:
+        """Dispatch the async gather of this chunk's slot rows from the
+        POST-chunk tables.  Must run before the next dispatch donates
+        ``strategy_state`` (program order then guarantees the gather
+        reads the chunk's output).  Returns the handle the drain
+        completes."""
+        ticket = self._ticket
+        self._ticket = None
+        if ticket is None or ticket["slots"].size == 0:
+            return {"ids": np.empty((0,), np.int64), "rows": None,
+                    "slots": np.empty((0,), np.int32)}
+        jax = self._jax
+        import jax.numpy as jnp
+        W = _pow2_width(int(ticket["slots"].size))
+        slot_arr = np.zeros((W,), np.int32)
+        slot_arr[:ticket["slots"].size] = ticket["slots"]
+        fn = self._gather_cache.get(W)
+        if fn is None:
+            n_slots = self.n_slots
+            keys = self.keys
+
+            def gather(tables, slots):
+                idx = jnp.clip(slots, 0, n_slots - 1)
+                return {k: tables[k][idx] for k in keys}
+
+            fn = jax.jit(gather)
+            self._gather_cache[W] = fn
+        tables = {k: strategy_state[k] for k in self.keys}
+        rows = fn(tables, jnp.asarray(slot_arr))
+        return {"ids": ticket["ids"], "slots": ticket["slots"],
+                "rows": rows}
+
+    def complete_writeback(self, handle: Dict[str, Any]) -> None:
+        """Drain half: ONE explicit fetch of the gathered rows, write
+        them through to the host store, unpin the chunk's slots."""
+        ids = handle["ids"]
+        if handle["rows"] is None or ids.size == 0:
+            return
+        jax = self._jax
+        fetched = jax.device_get(handle["rows"])
+        for i, cid in enumerate(ids):
+            self.store.put(int(cid),
+                           {k: np.asarray(fetched[k][i])
+                            for k in self.keys})
+        self.writeback_rows += int(ids.size)
+        for slot in handle["slots"]:
+            self._unpin(int(slot))
+
+    # ------------------------------------------------------------------
+    # host-side reads (personalized eval) + durability
+    # ------------------------------------------------------------------
+    def user_row(self, uid: int) -> Optional[Dict[str, np.ndarray]]:
+        """The client's CURRENT carry row from the host store (valid at
+        any drained boundary — eval boundaries fully drain the ring),
+        or None for a never-participated client."""
+        return self.store.get(int(uid))
+
+    def has_rows(self) -> bool:
+        return self.store.has_rows()
+
+    def flush(self) -> int:
+        return self.store.flush()
+
+    def set_round(self, round_no: int) -> None:
+        self.store.set_round(round_no)
+
+    def round(self) -> Optional[int]:
+        return self.store.round()
+
+    def reset(self) -> None:
+        """Trajectory mismatch on resume: drop the host rows AND the
+        slot map — every next touch cold-starts from the defaults,
+        exactly like a fresh table."""
+        self.store.reset()
+        self._free = list(range(self.n_slots - 1, -1, -1))
+        self._slot_client[:] = -1
+        self._client_slot.clear()
+        self._pins[:] = 0
+        self._lru.clear()
+        self._ticket = None

@@ -388,7 +388,41 @@ class PersonalizationServer(OptimizationServer):
         configured ``desired_max_samples`` cap when present."""
         if not hasattr(self.task, "apply"):
             return None
-        if self.store is None:
+        if self.store is None and \
+                getattr(self, "fleet_pager", None) is not None:
+            # fleet paged carry: the device tables hold only the page
+            # pool's resident slots, but eval boundaries fully drain
+            # the pipeline ring, so the pager's HOST store holds every
+            # participated user's current (local, alpha, seen) row —
+            # zero device reads here at all
+            pager = self.fleet_pager
+            if not pager.has_rows():
+                return None  # nothing personalized yet
+            gp_host = jax.device_get(self.state.params)
+            leaves, treedef = jax.tree.flatten(gp_host)
+            spans = []
+            off = 0
+            for leaf in leaves:
+                spans.append((off, int(np.prod(leaf.shape)), leaf.shape))
+                off += spans[-1][1]
+
+            def _unravel_np(vec):
+                return jax.tree.unflatten(treedef, [
+                    np.asarray(vec[o:o + n]).reshape(shp)
+                    for o, n, shp in spans])
+
+            def get_lp(u):
+                row = pager.user_row(u)
+                return (_unravel_np(row["local"])
+                        if row is not None and float(row["seen"]) > 0
+                        else gp_host)
+
+            def get_alpha(u):
+                row = pager.user_row(u)
+                return (float(row["alpha"])
+                        if row is not None and float(row["seen"]) > 0
+                        else self.alpha0)
+        elif self.store is None:
             # fused_carry: ONE explicit fetch of the carry tables at this
             # eval boundary (the sanctioned crossing — eval boundaries
             # already fetch; the per-round loop still pays exactly one

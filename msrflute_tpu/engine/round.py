@@ -251,6 +251,19 @@ class RoundEngine:
                 "fused_carry is incompatible with clients_per_chunk: the "
                 "carry scatter needs every client's update row, which "
                 "chunked accumulation never materializes — disable one")
+        # fleet paged carry (server_config.fleet + fused_carry): the
+        # carry tables are a fixed-capacity page pool (engine/paging.py)
+        # and the round program takes ONE extra per-round data operand —
+        # carry_slots [K] int32, the host-remapped pool slot per lane —
+        # which the carry gather/scatter indexes INSTEAD of client_ids.
+        # Per-client rng streams keep folding on the true client id, so
+        # per-client math is bit-identical to resident tables.  Static
+        # at engine build: without the fleet block the program is byte-
+        # for-byte the PR 6 trace (carry_slots IS client_ids in-trace).
+        _fleet_raw = sc.get("fleet") or {}
+        self.carry_paged = bool(
+            self.device_carry and _fleet_raw and
+            _fleet_raw.get("enable", True))
 
         # fused RL (server_config.wantRL + fused_carry): the DQN
         # aggregation-weight tuner lives in strategy_state (rl/fused.py)
@@ -614,6 +627,7 @@ class RoundEngine:
         # universal-overlap statics: both compile-time branches — a
         # config without fused_carry traces the exact legacy program
         device_carry = self.device_carry
+        carry_paged = self.carry_paged
         rl_fused = self.rl_fused
         fused_rl = self._rl
 
@@ -621,7 +635,7 @@ class RoundEngine:
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng,
                        cohort_ids=None, cohort_mask=None,
-                       corrupt_mode=None, pool=None):
+                       carry_slots=None, corrupt_mode=None, pool=None):
             if self.partition_mode == "shard_map":
                 # shard-local [K_local] -> full replicated [K] cohort
                 # (the median vote and the robust payload stack need
@@ -648,9 +662,14 @@ class RoundEngine:
                                 ).astype(pool[k].dtype)
                     for k in pool}
 
-            def per_client(arr_c, mask_c, cm_c, cid_c, corrupt_c=None):
+            def per_client(arr_c, mask_c, cm_c, cid_c, *rest):
                 # Deterministic independent stream per (round, client):
                 # jax.random.fold_in discipline (SURVEY.md §7 hard parts).
+                # rng folds on the TRUE client id even under fleet
+                # paging — only the carry table index is remapped.
+                rest = list(rest)
+                slot_c = rest.pop(0) if carry_paged else cid_c
+                corrupt_c = rest.pop(0) if chaos_corruption else None
                 rng_c = jax.random.fold_in(rng, cid_c)
                 cohort_kw = {}
                 if strategy.wants_cohort:
@@ -663,12 +682,14 @@ class RoundEngine:
                 carry_row = None
                 if device_carry:
                     # carry strategies gather their own table rows from
-                    # strategy_state by client id and return the
-                    # per-client carry update row alongside the payload
+                    # strategy_state by row id (the client id for
+                    # resident tables, the page-pool SLOT id under
+                    # fleet paging) and return the per-client carry
+                    # update row alongside the payload
                     parts, tl, ns, stats, carry_row = \
                         strategy.client_step_carry(
                             client_update, params, arr_c, mask_c,
-                            client_lr, rng_c, client_id=cid_c,
+                            client_lr, rng_c, client_id=slot_c,
                             live_mask=cm_c, round_idx=round_idx,
                             leakage_threshold=leakage_threshold,
                             quant_threshold=quant_threshold,
@@ -713,13 +734,17 @@ class RoundEngine:
                 # through) unless the strategy runs in device-carry mode
                 return parts, tl * cm_c, ns * cm_c, stats, stale, carry_row
 
-            def process_chunk(arr_k, sm_k, cm_k, cid_k, corrupt_k=None):
+            def process_chunk(arr_k, sm_k, cm_k, cid_k, *rest_k):
                 """One chunk of clients -> (summed locals, per-client
                 privacy stats, raw parts, effective client mask).  The
                 whole shard is one chunk in the default path."""
+                rest_k = list(rest_k)
+                slot_k = rest_k.pop(0) if carry_paged else None
+                corrupt_k = rest_k.pop(0) if chaos_corruption else None
                 if pool is not None:
                     arr_k = gather_pool(arr_k, sm_k)
                 vmap_args = (arr_k, sm_k, cm_k, cid_k) + \
+                    ((slot_k,) if carry_paged else ()) + \
                     ((corrupt_k,) if chaos_corruption else ())
                 parts, tls, nss, stats, stale, carry_rows = \
                     jax.vmap(per_client)(*vmap_args)
@@ -857,7 +882,8 @@ class RoundEngine:
                 (local, privacy_per_client, parts, cm_eff,
                  extras) = process_chunk(
                     arrays, sample_mask, client_mask, client_ids,
-                    corrupt_mode if chaos_corruption else None)
+                    *((carry_slots,) if carry_paged else ()),
+                    *((corrupt_mode,) if chaos_corruption else ()))
             if self.partition_mode == "shard_map":
                 # the "harvest": one collective instead of K P2P recvs
                 total = jax.lax.psum(local, CLIENTS_AXIS)
@@ -916,14 +942,15 @@ class RoundEngine:
             # route them to the right keyword here (with corruption off
             # and the pool on, the pool must not land in corrupt_mode)
             rest = list(rest)
+            slots = rest.pop(0) if carry_paged else None
             corrupt = rest.pop(0) if chaos_corruption else None
             pool_arg = rest.pop(0) if pool_mode else None
             return shard_body(params, strategy_state, arrays, sample_mask,
                               client_mask, client_ids, client_lr,
                               round_idx, leakage_threshold,
                               quant_threshold, rng, cohort_ids,
-                              cohort_mask, corrupt_mode=corrupt,
-                              pool=pool_arg)
+                              cohort_mask, carry_slots=slots,
+                              corrupt_mode=corrupt, pool=pool_arg)
 
         if self.partition_mode == "shard_map":
             out_specs = (rspec, cspec) + \
@@ -934,6 +961,7 @@ class RoundEngine:
                 shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec, rspec, rspec) +
+                         ((cspec,) if carry_paged else ()) +
                          ((cspec,) if chaos_corruption else ()) +
                          ((rspec,) if pool_mode else ()),
                 out_specs=out_specs, check_vma=False)
@@ -961,9 +989,18 @@ class RoundEngine:
             # packed single-transfer buffer as every other stat.
             chaos_stats = {}
             n_used = 0
+            if carry_paged:
+                # fleet paging: the host-remapped pool slot per lane —
+                # the carry gather/scatter index; everything else keeps
+                # using the true client ids
+                carry_slots = extra_args[0]
+                n_used = 1
+            else:
+                carry_slots = client_ids
             if chaos_faults:
-                chaos_drop, chaos_keep = extra_args[0], extra_args[1]
-                n_used = 2
+                chaos_drop, chaos_keep = \
+                    extra_args[n_used], extra_args[n_used + 1]
+                n_used += 2
                 step_live = (jnp.sum(sample_mask, axis=-1) > 0)      # [K, S]
                 real_steps = jnp.sum(step_live, axis=-1)             # [K]
                 keep_f = (jnp.arange(sample_mask.shape[-2])[None, :]
@@ -1008,6 +1045,7 @@ class RoundEngine:
                 bcast, strategy_state, arrays, sample_mask, client_mask,
                 client_ids, client_lr, round_idx, leakage_threshold,
                 quant_threshold, rng, client_ids, client_mask,
+                *((carry_slots,) if carry_paged else ()),
                 *corrupt_args, *pool_args)
             collected, privacy_per_client = collect_out[0], collect_out[1]
             pos = 2
@@ -1057,9 +1095,10 @@ class RoundEngine:
                 # scatter the round's per-client carry rows (SCAFFOLD
                 # controls / EF residuals / personalization heads) back
                 # into the donated strategy_state tables — the round-k ->
-                # k+1 dependency the pipeline needed off the host
+                # k+1 dependency the pipeline needed off the host.
+                # carry_slots IS client_ids outside fleet paging.
                 new_strategy_state = strategy.apply_carry(
-                    new_strategy_state, client_ids, carry_full,
+                    new_strategy_state, carry_slots, carry_full,
                     rng=jax.random.fold_in(rng, 31))
             if self.server_max_grad_norm is not None:
                 agg = _clip_by_global_norm(agg, float(self.server_max_grad_norm))
@@ -1148,17 +1187,19 @@ class RoundEngine:
         core = self._round_step_core
         chaos_faults = self.chaos_client_faults
         chaos_corruption = self.chaos_corruption
-        n_chaos = (2 if chaos_faults else 0) + (1 if chaos_corruption else 0)
+        n_extra = (1 if self.carry_paged else 0) + \
+            (2 if chaos_faults else 0) + (1 if chaos_corruption else 0)
 
         def multi(params, opt_state, strategy_state, arrays, sample_mask,
                   client_mask, client_ids, client_lrs, server_lrs,
                   round_idxs, leakage_threshold, quant_thresholds, rngs,
                   *extra_args):
-            # chaos operands (drop/keep and/or corrupt modes) are
-            # per-round ([R, K]) and scan with the rest of the round
-            # inputs; the resident pool stays a carried constant
-            chaos_args = extra_args[:n_chaos]
-            pool_args = extra_args[n_chaos:]
+            # per-round trailing operands — carry slots ([R, K], fleet
+            # paging) then chaos drop/keep and/or corrupt modes — scan
+            # with the rest of the round inputs; the resident pool
+            # stays a carried constant
+            chaos_args = extra_args[:n_extra]
+            pool_args = extra_args[n_extra:]
 
             def body(carry, xs):
                 p, o, s = carry
@@ -1374,10 +1415,13 @@ class RoundEngine:
         stacked = R > 1
         core = self._multi_core(R) if stacked else self._round_step_core
 
+        carry_paged = self.carry_paged
+
         def staged(params, opt_state, strategy_state, ax_bufs, sc_bufs,
                    rng, *pool_args):
             ax = ax_packer.unpack(ax_bufs)
             sc = stager.unpack(sc_bufs)
+            carry = (ax["carry_slots"],) if carry_paged else ()
             chaos = ax.get("chaos", ())
             if not stacked:
                 return core(params, opt_state, strategy_state,
@@ -1385,7 +1429,7 @@ class RoundEngine:
                             ax["client_mask"], ax["client_ids"],
                             sc["client_lr"], sc["server_lr"],
                             sc["round_idx"], sc["leakage"], sc["quant"],
-                            rng, *chaos, *pool_args)
+                            rng, *carry, *chaos, *pool_args)
             # splitting inside the trace produces the same keys the
             # legacy path computed eagerly — split is a pure function
             rngs = jax.random.split(rng, R)
@@ -1393,7 +1437,7 @@ class RoundEngine:
                         ax["sample_mask"], ax["client_mask"],
                         ax["client_ids"], sc["client_lr"], sc["server_lr"],
                         sc["round_idx"], sc["leakage"], sc["quant"], rngs,
-                        *chaos, *pool_args)
+                        *carry, *chaos, *pool_args)
 
         return jax.jit(staged, donate_argnums=(0, 1, 2))
 
@@ -1421,6 +1465,8 @@ class RoundEngine:
             "client_mask": stack(lambda b: b.client_mask),
             "client_ids": stack(lambda b: b.client_ids),
         }
+        if self.carry_paged:
+            axis_tree["carry_slots"] = stack(self._batch_slots)
         chaos_host = self._chaos_host(chaos_vecs, stacked)
         if chaos_host:
             axis_tree["chaos"] = tuple(chaos_host)
@@ -1504,6 +1550,10 @@ class RoundEngine:
                 chaos_vecs)
         chaos_args = self._stage_chaos(chaos_vecs, self._client_sharding,
                                        stacked=False)
+        carry_args = ()
+        if self.carry_paged:
+            carry_args = (jax.device_put(self._batch_slots(batch),
+                                         self._client_sharding),)
         arrays, pool_args = self._stage_arrays([batch], self._client_sharding)
         sample_mask = jax.device_put(batch.sample_mask, self._client_sharding)
         client_mask = jax.device_put(batch.client_mask, self._client_sharding)
@@ -1526,13 +1576,26 @@ class RoundEngine:
             jnp.asarray(leakage_threshold if leakage_threshold is not None
                         else jnp.inf, jnp.float32),
             jnp.asarray(quant_threshold if quant_threshold is not None
-                        else -1.0, jnp.float32), rng, *chaos_args,
-            *pool_args)
+                        else -1.0, jnp.float32), rng, *carry_args,
+            *chaos_args, *pool_args)
         self._note_compiles("round_step", self._round_step)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + 1)
         packer = self._stats_packers[("single", batch.sample_mask.shape[0])]
         return new_state, PackedStats(vecs, packer, rounds=1, stacked=False)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _batch_slots(batch) -> np.ndarray:
+        """The batch's fleet page-pool slot vector; a paged-carry
+        dispatch without one is a programming error (the pager sets it
+        at prepare time) — fail loudly instead of gathering garbage."""
+        slots = getattr(batch, "carry_slots", None)
+        if slots is None:
+            raise ValueError(
+                "fleet paged carry: batch has no carry_slots — the "
+                "CarryPager must prepare every chunk before dispatch")
+        return np.asarray(slots, np.int32)
 
     # ------------------------------------------------------------------
     def _host_arrays(self, batches: list) -> Tuple[Dict[str, np.ndarray],
@@ -1598,6 +1661,11 @@ class RoundEngine:
         stacked_sharding = NamedSharding(self.mesh, P(None, CLIENTS_AXIS))
         chaos_args = self._stage_chaos(chaos_vecs, stacked_sharding,
                                        stacked=True)
+        carry_args = ()
+        if self.carry_paged:
+            carry_args = (jax.device_put(
+                np.stack([self._batch_slots(b) for b in batches]),
+                stacked_sharding),)
         arrays, pool_args = self._stage_arrays(batches, stacked_sharding)
         sample_mask = jax.device_put(
             np.stack([b.sample_mask for b in batches]), stacked_sharding)
@@ -1622,8 +1690,8 @@ class RoundEngine:
             jnp.asarray(leakage_threshold if leakage_threshold is not None
                         else jnp.inf, jnp.float32),
             jnp.asarray(quant_thresholds if quant_thresholds is not None
-                        else [-1.0] * R, jnp.float32), rngs, *chaos_args,
-            *pool_args)
+                        else [-1.0] * R, jnp.float32), rngs, *carry_args,
+            *chaos_args, *pool_args)
         self._note_compiles(f"multi_round_r{R}", fn)
         new_state = ServerState(params, opt_state, strategy_state,
                                 state.round + R)
@@ -1671,11 +1739,12 @@ class RoundEngine:
         corrupt_scale = self._corrupt_scale
         corrupt_flip_scale = self._corrupt_flip_scale
         device_carry = self.device_carry
+        carry_paged = self.carry_paged
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng,
-                       corrupt_mode=None, pool=None):
+                       carry_slots=None, corrupt_mode=None, pool=None):
             if self.partition_mode == "shard_map":
                 def gather_axis(x):
                     return jax.lax.all_gather(x, CLIENTS_AXIS, axis=0,
@@ -1695,18 +1764,21 @@ class RoundEngine:
                                 ).astype(pool[k].dtype)
                     for k in pool}
 
-            def per_client(arr_c, mask_c, cm_c, cid_c, corrupt_c=None):
+            def per_client(arr_c, mask_c, cm_c, cid_c, *rest):
                 # SAME per-client stream discipline as the fused round:
                 # fold_in on the CLIENT ID, so a client's rng (and hence
                 # its whole local update) is independent of which grid
                 # slot or bucket it landed in — the bit-identity anchor
+                rest = list(rest)
+                slot_c = rest.pop(0) if carry_paged else cid_c
+                corrupt_c = rest.pop(0) if chaos_corruption else None
                 rng_c = jax.random.fold_in(rng, cid_c)
                 carry_row = None
                 if device_carry:
                     parts, tl, ns, stats, carry_row = \
                         strategy.client_step_carry(
                             client_update, params, arr_c, mask_c,
-                            client_lr, rng_c, client_id=cid_c,
+                            client_lr, rng_c, client_id=slot_c,
                             live_mask=cm_c, round_idx=round_idx,
                             leakage_threshold=leakage_threshold,
                             quant_threshold=quant_threshold,
@@ -1746,6 +1818,7 @@ class RoundEngine:
             if pool is not None:
                 arrays = gather_pool(arrays, sample_mask)
             vmap_args = (arrays, sample_mask, client_mask, client_ids) + \
+                ((carry_slots,) if carry_paged else ()) + \
                 ((corrupt_mode,) if chaos_corruption else ())
             parts, tls, nss, stats, stale, carry_rows = \
                 jax.vmap(per_client)(*vmap_args)
@@ -1819,13 +1892,14 @@ class RoundEngine:
                         client_mask, client_ids, client_lr, round_idx,
                         leakage_threshold, quant_threshold, rng, *rest):
             rest = list(rest)
+            slots = rest.pop(0) if carry_paged else None
             corrupt = rest.pop(0) if chaos_corruption else None
             pool_arg = rest.pop(0) if pool_mode else None
             return shard_body(params, strategy_state, arrays, sample_mask,
                               client_mask, client_ids, client_lr,
                               round_idx, leakage_threshold,
-                              quant_threshold, rng, corrupt_mode=corrupt,
-                              pool=pool_arg)
+                              quant_threshold, rng, carry_slots=slots,
+                              corrupt_mode=corrupt, pool=pool_arg)
 
         if self.partition_mode == "shard_map":
             out_specs = ((rspec, cspec) if defer_screen else
@@ -1835,6 +1909,7 @@ class RoundEngine:
                 shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec) +
+                         ((cspec,) if carry_paged else ()) +
                          ((cspec,) if chaos_corruption else ()) +
                          ((rspec,) if pool_mode else ()),
                 out_specs=out_specs, check_vma=False)
@@ -1851,9 +1926,15 @@ class RoundEngine:
             # the per-bucket counters sum additively in finalize
             chaos_stats = {}
             n_used = 0
+            if carry_paged:
+                carry_slots = extra_args[0]
+                n_used = 1
+            else:
+                carry_slots = client_ids
             if chaos_faults:
-                chaos_drop, chaos_keep = extra_args[0], extra_args[1]
-                n_used = 2
+                chaos_drop, chaos_keep = \
+                    extra_args[n_used], extra_args[n_used + 1]
+                n_used += 2
                 step_live = (jnp.sum(sample_mask, axis=-1) > 0)
                 real_steps = jnp.sum(step_live, axis=-1)
                 keep_f = (jnp.arange(sample_mask.shape[-2])[None, :]
@@ -1890,6 +1971,7 @@ class RoundEngine:
             out = sharded(bcast, strategy_state, arrays, sample_mask,
                           client_mask, client_ids, client_lr, round_idx,
                           leakage_threshold, quant_threshold, rng,
+                          *((carry_slots,) if carry_paged else ()),
                           *corrupt_args, *pool_args)
             if defer_screen:
                 result = {"pc": out[0], "privacy": out[1]}
@@ -1899,6 +1981,9 @@ class RoundEngine:
                     result["carry"] = out[2]
             result["chaos"] = chaos_stats
             result["ids"] = client_ids
+            if carry_paged:
+                # the finalize's apply_carry scatters by pool slot
+                result["slots"] = carry_slots
             # trace-time hygiene: a strategy publish during a COLLECT
             # trace would otherwise be drained by the finalize trace as
             # a leaked tracer; bucket collects drop such publishes (the
@@ -1922,16 +2007,19 @@ class RoundEngine:
             return fn
         core = self._get_bucket_collect_core()
 
+        carry_paged = self.carry_paged
+
         def staged(params, strategy_state, ax_bufs, sc_bufs, rng,
                    *pool_args):
             ax = ax_packer.unpack(ax_bufs)
             sc = stager.unpack(sc_bufs)
+            carry = (ax["carry_slots"],) if carry_paged else ()
             chaos = ax.get("chaos", ())
             return core(params, strategy_state, ax["arrays"],
                         ax["sample_mask"], ax["client_mask"],
                         ax["client_ids"], sc["client_lr"],
                         sc["round_idx"], sc["leakage"], sc["quant"],
-                        rng, *chaos, *pool_args)
+                        rng, *carry, *chaos, *pool_args)
 
         fn = self._instrument(f"bucket_collect_s{S}", jax.jit(staged))
         self._bucket_collect_cache[key] = fn
@@ -2037,10 +2125,13 @@ class RoundEngine:
             if device_carry:
                 # per-bucket scatters commute (a client id appears in
                 # exactly one bucket), so sequential application equals
-                # the monolithic single scatter
+                # the monolithic single scatter; under fleet paging the
+                # scatter index is the pool slot the pager assigned
                 for b, o in enumerate(outs):
                     new_strategy_state = strategy.apply_carry(
-                        new_strategy_state, o["ids"], o["carry"],
+                        new_strategy_state,
+                        o["slots"] if "slots" in o else o["ids"],
+                        o["carry"],
                         rng=jax.random.fold_in(
                             jax.random.fold_in(rng, 31), b))
             if self.server_max_grad_norm is not None:
@@ -2148,6 +2239,8 @@ class RoundEngine:
                     "client_mask": batch.client_mask,
                     "client_ids": batch.client_ids,
                 }
+                if self.carry_paged:
+                    axis_tree["carry_slots"] = self._batch_slots(batch)
                 entry = (chaos_vecs[r][b] if chaos_vecs is not None
                          else None)
                 chaos_host = self._chaos_host(

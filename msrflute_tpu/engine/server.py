@@ -92,6 +92,57 @@ class OptimizationServer:
         fused_carry = self._fused_carry
         if fused_carry:
             self.strategy.carry_clients = len(train_dataset)
+        # fleet mode (server_config.fleet): population size becomes a
+        # free variable — O(cohort) cohort draws and, for device-carry
+        # strategies, a fixed-capacity page pool replacing the
+        # [N, n_params] resident carry tables (engine/paging.py).
+        # Parsed BEFORE the engine builds its programs: carry_rows must
+        # be set before init_state sizes the tables, and the engine
+        # compiles the slot operand in when paging is on.
+        _fl = sc.get("fleet") or {}
+        self._fleet_cfg = _fl if (_fl and _fl.get("enable", True)) else None
+        self._fleet_paged = bool(
+            self._fleet_cfg is not None and
+            getattr(self.strategy, "device_carry", False))
+        if self._fleet_cfg is not None:
+            if sc.get("scaffold_device_controls") or \
+                    sc.get("ef_device_residuals"):
+                raise ValueError(
+                    "server_config.fleet does not compose with "
+                    "scaffold_device_controls / ef_device_residuals — "
+                    "those keep a FULL [N, n_params] table in HBM, the "
+                    "exact residency fleet paging exists to replace; "
+                    "use fused_carry + fleet instead")
+        if self._fleet_paged:
+            from ..config import cohort_upper_bound
+            cohort_hi = min(cohort_upper_bound(
+                sc.get("num_clients_per_iteration", 10)),
+                len(train_dataset))
+            pad = pad_to_mesh(cohort_hi, self.mesh)
+            depth = max(int(sc.get("pipeline_depth", 1) or 0), 0)
+            rps = max(int(sc.get("rounds_per_step", 1) or 1), 1)
+            # default pool: (in-flight chunks + the one being prepared)
+            # cohorts' worth of rows with 2x headroom for cross-round
+            # revisits, pow2-quantized; never more rows than clients
+            from ..data.batching import pow2_ceil
+            auto = pow2_ceil(max(pad * rps * (depth + 1) * 2, pad + 1))
+            slots = int(self._fleet_cfg.get("page_pool_slots") or auto)
+            slots = min(max(slots, pad), len(train_dataset))
+            # in-flight floor: with depth-N pipelining, (depth+1) chunks
+            # of rps cohorts each can pin rows simultaneously — a pool
+            # below that would deadlock allocation mid-run; refuse at
+            # construction instead (capped at N: once every client is
+            # resident no allocation ever happens)
+            required = min(pad * rps * (depth + 1), len(train_dataset))
+            if slots < required:
+                raise ValueError(
+                    f"server_config.fleet.page_pool_slots={slots} is "
+                    f"below the in-flight floor {required} "
+                    f"(= padded cohort {pad} x rounds_per_step {rps} x "
+                    f"(pipeline_depth {depth} + 1), capped at the "
+                    "population) — raise page_pool_slots or lower "
+                    "pipeline_depth")
+            self.strategy.carry_rows = slots
         self.engine = RoundEngine(task, config, self.strategy, self.mesh)
         #: fluteshield screening policy (None = firewall path); the ONE
         #: live Shield belongs to the engine — the server reads its
@@ -274,7 +325,11 @@ class OptimizationServer:
         self.batch_size = int(cc.data_config.train.get("batch_size", 32))
         self.desired_max_samples = cc.get("desired_max_samples") or \
             cc.data_config.train.get("desired_max_samples")
-        max_client_samples = int(max(train_dataset.num_samples))
+        # np.max, not builtin max: the fleet path hands num_samples in
+        # as a 10^6-entry int32 array, and builtin max would iterate it
+        # element-by-element in the interpreter
+        max_client_samples = int(np.max(np.asarray(
+            train_dataset.num_samples)))
         self.max_steps = steps_for(max_client_samples, self.batch_size,
                                    self.desired_max_samples)
         # per-chunk step bucketing: size each fused chunk's [K, S, B] grid
@@ -317,10 +372,13 @@ class OptimizationServer:
                     "and would silently run unbucketed; drop the block "
                     "or lift the strategy with fused_carry")
             from ..data.batching import bucket_boundaries
-            needs = np.array(
-                [steps_for(int(n), self.batch_size,
-                           self.desired_max_samples)
-                 for n in train_dataset.num_samples], dtype=np.int64)
+            from ..data.fleet import steps_for_array
+            # one vectorized metadata pass over the population (fleet
+            # scale: a 10^6-user pool must not pay an O(N) python loop
+            # at server init)
+            needs = steps_for_array(train_dataset.num_samples,
+                                    self.batch_size,
+                                    self.desired_max_samples)
             max_need = int(needs.max()) if needs.size else 1
             _mb = _cb.get("max_buckets")
             max_buckets = 4 if _mb is None else int(_mb)
@@ -341,7 +399,7 @@ class OptimizationServer:
                 top = max(top, max_need)
                 bounds = [b for b in bounds if b < top] + [top]
             else:
-                bounds = bucket_boundaries(needs.tolist(), max_buckets,
+                bounds = bucket_boundaries(needs, max_buckets,
                                            self.max_steps)
             if len(bounds) > max_buckets:
                 raise ValueError(
@@ -354,15 +412,13 @@ class OptimizationServer:
             # bucket + one finalize — closed by construction; overflow
             # spills up, top-bucket overflow (rare) enlarges that grid
             # and is exactly what the recompile sentinel exists to see
+            from ..config import cohort_upper_bound
             from ..data.batching import bucket_capacities
-            ncpi = sc.get("num_clients_per_iteration", 10)
-            if isinstance(ncpi, str) and ":" in ncpi:
-                cohort_hi = int(ncpi.split(":")[1])
-            else:
-                cohort_hi = int(ncpi)
-            cohort_hi = min(cohort_hi, len(train_dataset))
+            cohort_hi = min(cohort_upper_bound(
+                sc.get("num_clients_per_iteration", 10)),
+                len(train_dataset))
             caps = bucket_capacities(
-                needs.tolist(), bounds, cohort_hi,
+                needs, bounds, cohort_hi,
                 quantum=self.mesh.shape[CLIENTS_AXIS],
                 slack=float(_cb.get("slack", 1.5) or 1.5))
             self.cohort_bucketing = {"boundaries": bounds,
@@ -620,6 +676,35 @@ class OptimizationServer:
                        f"{self.ef_device.n_rows} x "
                        f"{self.ef_store.n_params} ({gb:.2f} GiB HBM)")
 
+        # fleet paged carry (server_config.fleet + fused_carry): the
+        # page pool + host backing store behind the carry tables.
+        # Built AFTER the resume decision so the durable row store and
+        # the restored params stay on one trajectory (the ControlStore
+        # marker discipline) — a marker/round mismatch resets the rows.
+        self.fleet_pager = None
+        if self._fleet_paged:
+            from .paging import CarryPager
+            self.fleet_pager = CarryPager(
+                self.strategy, self.state.strategy_state,
+                slots=int(self.strategy.carry_rows), mesh=self.mesh,
+                store_dir=os.path.join(model_dir, "fleet_carry"),
+                host_cache_rows=int(
+                    self._fleet_cfg.get("host_cache_rows", 8192) or 8192),
+                resume=resumed)
+            if resumed and self.fleet_pager.round() != self.state.round:
+                print_rank(
+                    f"fleet carry rows were at round "
+                    f"{self.fleet_pager.round()} but the checkpoint "
+                    f"resumed at {self.state.round}; resetting carry "
+                    "rows (one-trajectory rule)")
+                self.fleet_pager.reset()
+            mb = (self.fleet_pager.n_slots *
+                  self.fleet_pager.hbm_row_bytes()) / 2**20
+            print_rank(
+                f"fleet paged carry: {self.fleet_pager.n_slots} pool "
+                f"slots x {sorted(self.strategy.carry_tables)} "
+                f"({mb:.1f} MiB HBM) over {len(train_dataset)} clients")
+
     # ------------------------------------------------------------------
     def _select_strategy(self, config) -> type:
         """The strategy class this server will construct.  Subclasses
@@ -685,7 +770,28 @@ class OptimizationServer:
         n = parse_clients_per_round(sc.get("num_clients_per_iteration", 10),
                                     self._np_rng)
         n = min(n, len(self.train_dataset))
-        # random.sample equivalent (core/server.py:300-302)
+        fleet_mode = (str(self._fleet_cfg.get("sampling", "uniform"))
+                      if self._fleet_cfg is not None else "uniform")
+        if fleet_mode != "uniform":
+            # fleet cohort draw (data/fleet.py): explicit Floyd /
+            # weighted-reservoir sampling.  NOTE the rng-trail contract
+            # (docs/config_extensions.md): these modes draw a NEW
+            # sampling trail — like changing the seed — while staying
+            # deterministic and resume-stable within it.  The default
+            # `uniform` mode keeps the numpy draw below, so plain fleet
+            # runs stay trail- (and bit-) identical to non-fleet runs.
+            from ..data.fleet import sample_cohort
+            return sample_cohort(
+                self._np_rng, len(self.train_dataset), n,
+                mode=fleet_mode,
+                num_samples=self.train_dataset.num_samples)
+        # random.sample equivalent (core/server.py:300-302).  Already
+        # O(cohort) at any population size: numpy's Generator.choice
+        # with replace=False uses Floyd's algorithm (time and memory
+        # scale with `size`, not the population — pinned by
+        # tests/test_fleet.py::test_default_cohort_draw_is_o_cohort),
+        # so the default path keeps its historical rng trail even at
+        # 10^6+ clients.
         return list(self._np_rng.choice(len(self.train_dataset), size=n,
                                         replace=False))
 
@@ -963,6 +1069,20 @@ class OptimizationServer:
                 if not ch["latest_saved"]:
                     self.ckpt.save_latest(ch["state"])
                     ch["latest_saved"] = True
+            if self.fleet_pager is not None:
+                # fleet paging: map the chunk's cohorts onto pool slots
+                # and page missing rows in (one fixed-shape donated
+                # scatter, sequenced after the save_latest copies above
+                # and before this dispatch) — batches gain their
+                # carry_slots vectors here
+                with self._tspan("fleet_page", round0=round_no,
+                                 rounds=R):
+                    new_sstate = self.fleet_pager.prepare_chunk(
+                        batches, self.state.strategy_state)
+                    if new_sstate is not self.state.strategy_state:
+                        self.state = ServerState(
+                            self.state.params, self.state.opt_state,
+                            new_sstate, self.state.round)
             chaos_vecs = None
             if self.engine.chaos_client_faults or \
                     self.engine.chaos_corruption:
@@ -1059,6 +1179,13 @@ class OptimizationServer:
                                  self.engine.xla.last_dispatch is not None
                                  else None),
             }
+            if self.fleet_pager is not None:
+                # dispatch the writeback gather NOW (async, reads this
+                # chunk's output tables before any later program donates
+                # them — the dp_clip stash discipline); the drain
+                # completes it with one explicit fetch
+                chunk["fleet_wb"] = self.fleet_pager.queue_writeback(
+                    self.state.strategy_state)
             # dispatch is async: pack the next chunk NOW, while the device
             # executes this one (reading the stats below is what blocks)
             if prefetch_ok and round_no + R < max_iteration:
@@ -1178,6 +1305,16 @@ class OptimizationServer:
             (toc - max(chunk["tic"], self._last_fence)) / R)
         self._last_fence = toc
 
+        if self.fleet_pager is not None and chunk.get("fleet_wb"):
+            # fleet paging drain half: ONE explicit fetch of this
+            # chunk's updated carry rows, written through to the host
+            # store; the chunk's slots unpin and become evictable.
+            # Runs BEFORE the host tail so housekeeping/eval at this
+            # boundary read current rows.
+            with self._tspan("fleet_writeback", round0=round0,
+                             rounds=R):
+                self.fleet_pager.complete_writeback(chunk["fleet_wb"])
+
         with self._tspan("host_tail", round0=round0, rounds=R):
             self._drain_host_tail(chunk, stats, val_freq, rec_freq)
         self.run_stats["secsPerRoundHostTail"].append(
@@ -1197,6 +1334,27 @@ class OptimizationServer:
             xla_snap = (self.engine.xla.snapshot()
                         if self.engine.xla is not None else
                         {"recompiles": int(self.engine.recompile_count)})
+            # fleet + dataset-cache gauges: host counters the loop
+            # already owns (zero device access), published per chunk
+            # through the host-side bus and handed to the rollup window
+            # so `scope watch`/`scope health` see paging pressure live
+            fleet_gauges = {}
+            if self.fleet_pager is not None:
+                pd = self.fleet_pager.describe()
+                for key in ("hits", "misses", "evictions", "resident"):
+                    fleet_gauges[f"fleet_page_{key}"] = pd[key]
+                    self.scope.devbus_host(f"fleet_page_{key}", pd[key],
+                                           step=round0 + R - 1)
+            cache_stats_fn = getattr(self.train_dataset, "cache_stats",
+                                     None)
+            if cache_stats_fn is not None:
+                cs = cache_stats_fn()
+                for key in ("hits", "misses", "evictions", "resident"):
+                    fleet_gauges[f"lazy_cache_{key}"] = cs[key]
+                    self.scope.devbus_host(f"lazy_cache_{key}", cs[key],
+                                           step=round0 + R - 1)
+            if fleet_gauges and self.scope.rollup is not None:
+                self.scope.rollup.update_gauges(fleet_gauges)
             # watchdogs run over values this tail ALREADY holds: the
             # fetched per-round losses, the wall clock, the checkpoint
             # escalator's consecutive-failure count.  A configured
@@ -1423,6 +1581,14 @@ class OptimizationServer:
         if self.scope is not None and self.scope.rollup is not None:
             card["rollup_windows"] = int(
                 self.scope.rollup.windows_flushed)
+        if self.fleet_pager is not None:
+            # paging pressure joins the regression surface: a hit-rate
+            # collapse or an eviction storm is a fleet-sizing regression
+            # `scope diff`/`scope health` should see
+            card["fleet"] = self.fleet_pager.describe()
+        cache_stats_fn = getattr(self.train_dataset, "cache_stats", None)
+        if cache_stats_fn is not None:
+            card["lazy_cache"] = cache_stats_fn()
         if self.cohort_bucketing is not None:
             card["cohort_bucketing"] = {
                 "boundaries": list(self.cohort_bucketing["boundaries"]),
@@ -1736,6 +1902,20 @@ class OptimizationServer:
                     self.ef_store.set_round(int(self.state.round))
             else:
                 self.ef_store.set_round(int(self.state.round))
+        if self.fleet_pager is not None:
+            # fleet paged-carry durability: the host store already holds
+            # every drained row (writeback-on-drain); spill the dirty
+            # ones to disk and commit the round marker only once the
+            # paired model checkpoint is durable — the ControlStore
+            # pairing rule.  fleet.spill_freq > 1 amortizes the disk IO;
+            # a stop inside the window resets rows on resume (marker
+            # mismatch), the same tradeoff as scaffold_flush_freq.
+            spill_freq = int(self._fleet_cfg.get("spill_freq", 1) or 1)
+            final = round_no >= self._max_iteration
+            if spill_freq <= 1 or round_no % spill_freq == 0 or final:
+                self.ckpt.wait()
+                self.fleet_pager.flush()
+                self.fleet_pager.set_round(int(self.state.round))
         status_update = {
             "i": round_no,
             "weight": self.lr_weight,

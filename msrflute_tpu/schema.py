@@ -151,6 +151,34 @@ COHORT_BUCKETING_FIELD_SPECS = {
     # the scalar spec table cannot express
 }
 
+FLEET_KEYS = {
+    "enable", "page_pool_slots", "host_cache_rows", "spill_freq",
+    "sampling",
+}
+
+#: fleet cohort-draw vocabulary (data/fleet.py sample_cohort):
+#: `uniform` = numpy Generator.choice (O(cohort) via Floyd's algorithm,
+#: trail-identical to the non-fleet path); `floyd` = the explicit Floyd
+#: implementation; `by_samples` = sample-count-weighted reservoir —
+#: the latter two start new rng trails
+ALLOWED_FLEET_SAMPLING = ["uniform", "floyd", "by_samples"]
+
+FLEET_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    # device page-pool rows per carry table (HBM = slots x row bytes,
+    # independent of population); must cover (pipeline_depth + 1)
+    # in-flight cohorts or dispatch refuses — default auto-sizes from
+    # the cohort geometry
+    "page_pool_slots": ("int", 1, None),
+    # host RAM rows before LRU spill-through to the durable .npz store
+    "host_cache_rows": ("int", 1, None),
+    # rounds between durable spill + round-marker commits (the
+    # scaffold_flush_freq tradeoff: > 1 amortizes disk IO, a stop
+    # inside the window resets carry rows on resume)
+    "spill_freq": ("int", 1, None),
+    # `sampling` keeps a bespoke enum check in validate()
+}
+
 MEGAKERNEL_KEYS = {
     "enable", "fused_epochs", "pallas_apply",
 }
@@ -371,6 +399,13 @@ SERVER_KEYS = {
     # opt-in pallas fused SGD apply — `enable: false` restores the
     # legacy per-epoch unrolled trace (docs/config_extensions.md)
     "megakernel",
+    # fleet mode: million-client populations — O(cohort) cohort draws
+    # (Floyd / weighted reservoir) and, with fused_carry, a fixed-
+    # capacity device page pool + durable host backing store replacing
+    # the [N, n_params] resident carry tables — default off; see
+    # docs/config_extensions.md and RUNBOOK "Running a fleet-scale
+    # population"
+    "fleet",
     # precision policy: params/compute/stats dtypes for the client
     # inner loop — absent is the bit-identical f32 path; compute:
     # bfloat16 keeps f32 master params + f32 stats accumulators
@@ -793,6 +828,19 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                         "server_config.cohort_bucketing: "
                         f"{len(bounds)} boundaries exceed "
                         f"max_buckets={mb}")
+        fl = sc.get("fleet")
+        if fl is not None and not isinstance(fl, dict):
+            errors.append(
+                "server_config.fleet: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(fl).__name__}")
+        if isinstance(fl, dict):
+            _check_unknown(unknown, fl, "server_config.fleet",
+                           FLEET_KEYS)
+            _check_fields(errors, fl, "server_config.fleet",
+                          FLEET_FIELD_SPECS)
+            _check_enum(errors, fl, "server_config.fleet", "sampling",
+                        ALLOWED_FLEET_SAMPLING)
         mk = sc.get("megakernel")
         if mk is not None and not isinstance(mk, dict):
             errors.append(

@@ -89,6 +89,31 @@ class BaseStrategy:
     #: total client-pool size for the carry tables; the server sets this
     #: (``len(train_dataset)``) before ``init_state`` builds the tables
     carry_clients: int = 0
+    #: fleet paging (server_config.fleet): when nonzero, the per-client
+    #: carry tables are sized to THIS many page-pool slots instead of
+    #: ``carry_clients`` rows — the engine then indexes them with
+    #: host-remapped SLOT ids while population-level math (e.g.
+    #: SCAFFOLD's ``c`` normalization) keeps using ``carry_clients``.
+    #: 0 (default) = resident ``[N, ...]`` tables, the PR 6 behavior.
+    carry_rows: int = 0
+    #: names of the ``strategy_state`` dict keys that are per-client
+    #: row tables (leading dim == the carry row count) — what the fleet
+    #: pager pages in/out; non-listed keys (SCAFFOLD's server control
+    #: ``c``) stay resident and replicated
+    carry_tables: tuple = ()
+
+    def carry_row_defaults(self) -> Dict[str, float]:
+        """Fill value per carry-table key for a client that has never
+        participated (the paged analogue of ``init_state``'s uniform
+        fill; zero unless a strategy overrides — personalization's
+        ``alpha`` cold-starts at ``alpha0``)."""
+        return {k: 0.0 for k in self.carry_tables}
+
+    def _carry_table_rows(self) -> int:
+        """Leading dim for the carry tables ``init_state`` builds: the
+        fleet page-pool slot count when paging is on, else the full
+        client pool."""
+        return int(self.carry_rows or self.carry_clients)
 
     def __init__(self, config, dp_config=None):
         self.config = config

@@ -298,6 +298,8 @@ class EFQuant(FedAvg):
     supports_rl = False
     #: selects the host-orchestrated EF round path
     ef_rounds = True
+    #: fleet paging: the residual table is the pageable state
+    carry_tables = ("res",)
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
@@ -343,7 +345,8 @@ class EFQuant(FedAvg):
                 "does this from len(train_dataset)")
         n_params = sum(int(np.prod(leaf.shape))
                        for leaf in jax.tree.leaves(params_like))
-        return {"res": jnp.zeros((int(self.carry_clients), n_params),
+        # leading dim: page-pool slots under fleet paging, else the pool
+        return {"res": jnp.zeros((self._carry_table_rows(), n_params),
                                  jnp.float32)}
 
     def client_step_carry(self, client_update, global_params, arrays,

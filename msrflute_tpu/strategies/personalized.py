@@ -36,6 +36,14 @@ class PersonalizedFedAvg(FedAvg):
     device_carry = True
     supports_staleness = False
     supports_rl = False
+    #: fleet paging: every per-user table pages (local model rows,
+    #: alphas, the seen gate)
+    carry_tables = ("local", "alpha", "seen")
+
+    def carry_row_defaults(self):
+        # a never-seen user cold-starts at alpha0 with seen == 0 (the
+        # in-program global-clone init keys off seen, not local)
+        return {"local": 0.0, "alpha": self.alpha0, "seen": 0.0}
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
@@ -65,7 +73,8 @@ class PersonalizedFedAvg(FedAvg):
                 "server does this from len(train_dataset)")
         n_params = sum(int(np.prod(leaf.shape))
                        for leaf in jax.tree.leaves(params_like))
-        n = int(self.carry_clients)
+        # leading dim: page-pool slots under fleet paging, else the pool
+        n = self._carry_table_rows()
         return {
             "local": jnp.zeros((n, n_params), jnp.float32),
             "alpha": jnp.full((n,), self.alpha0, jnp.float32),

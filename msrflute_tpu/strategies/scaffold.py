@@ -357,6 +357,9 @@ class Scaffold(FedAvg):
     # control updates assume the single-payload flow
     supports_staleness = False
     supports_rl = False
+    #: fleet paging: the per-client control table is the pageable state;
+    #: the server control ``c`` stays resident/replicated
+    carry_tables = ("ci",)
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)
@@ -439,8 +442,12 @@ class Scaffold(FedAvg):
         return {
             "c": jnp.zeros((n_params,), jnp.float32),
             # per-client controls; scatters to dropped rows target index
-            # n_rows (out of bounds -> mode="drop"), like the device table
-            "ci": jnp.zeros((int(self.carry_clients), n_params),
+            # n_rows (out of bounds -> mode="drop"), like the device
+            # table.  Under fleet paging the leading dim is the PAGE
+            # POOL's slot count (carry_rows) and rows hold whichever
+            # clients the pager made resident — the ``c`` normalization
+            # below keeps dividing by the true population.
+            "ci": jnp.zeros((self._carry_table_rows(), n_params),
                             jnp.float32),
         }
 

@@ -472,6 +472,29 @@ def test_schema_drift_flags_undocumented_resilience_knob(tmp_path):
     assert "chaos" in msgs and "checkpoint_retry" in msgs
 
 
+def test_schema_drift_covers_fleet_specs(tmp_path):
+    """PR 14 corpus: the fleet block's field specs are drift-checked
+    like every other section — a FLEET_FIELD_SPECS rule for a key the
+    unknown-key pass doesn't know is dead and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'fleet'}\n"
+        "FLEET_KEYS = {'enable', 'page_pool_slots'}\n"
+        "FLEET_FIELD_SPECS = {'page_pool_slots': ('int', 1, None),"
+        " 'ghost_slots': ('int', 1, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.fleet` is the million-client knob.")
+    found = check_project(str(tmp_path), documented_knobs=("fleet",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "ghost_slots" in found[0].message and \
+        "FLEET_KEYS" in found[0].message
+
+
 def test_schema_drift_real_tree_is_consistent():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     found = check_project(repo)

@@ -128,6 +128,12 @@ def test_bench_records_device_truth_for_every_measured_protocol():
         assert truth is not None, (name, line)
         assert set(truth) >= {"chip", "mfu", "hbm_peak_bytes",
                               "recompiles", "compiled_programs"}, truth
+        # fleet marker (ISSUE 14): every protocol entry declares its
+        # fleet posture — the chaos/telemetry/robust/endurance guard
+        # discipline applied to paged-carry / O(cohort)-sampling runs,
+        # so a fleet run can never be silently compared against a
+        # resident baseline
+        assert line.get("fleet") == {"enabled": False}, (name, line)
         # a steady-state bench protocol never recompiles (the sentinel's
         # no-churn invariant holds on the bench path too)
         assert truth["recompiles"] == 0, (name, truth)

@@ -260,18 +260,191 @@ def run_endurance(rounds: int = 40, num_users: int = 24,
     return record
 
 
+def _fleet_config(rounds: int, cohort: int, preempt_at):
+    """The fleet posture: fused-carry SCAFFOLD (the richest carry
+    state: a pageable per-client table plus the resident server
+    control) under chaos + cohort bucketing + a depth-3 pipeline, with
+    the ``fleet`` block on and the rss_leak watchdog armed."""
+    from msrflute_tpu.config import FLUTEConfig
+
+    telemetry = json.loads(json.dumps(TELEMETRY))
+    chaos = dict(CHAOS)
+    if preempt_at is not None:
+        chaos["preempt_at_round"] = preempt_at
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "scaffold",
+        "server_config": {
+            "max_iteration": rounds,
+            "num_clients_per_iteration": cohort,
+            "initial_lr_client": 0.1,
+            "fused_carry": True,
+            "pipeline_depth": 3,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 100000, "initial_val": False,
+            "resume_from_checkpoint": True,
+            "data_config": {},
+            "cohort_bucketing": {"max_buckets": 3, "slack": 2.0},
+            "chaos": chaos,
+            "fleet": {"enable": True},
+            "telemetry": telemetry,
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.1},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def run_fleet(rounds: int = 8, population: int = 1_000_000,
+              cohort: int = 1024, out_dir: str | None = None,
+              report_path: str | None = None) -> dict:
+    """The fleet-scale smoke drill (ISSUE 14 acceptance): a synthetic
+    10^6-user population, cohort ~1k, chaos + bucketing + a depth-3
+    pipeline under ``MSRFLUTE_STRICT_TRANSFERS=1``, with a forced
+    midpoint preemption + resume.  Asserts:
+
+    - device carry HBM is bounded by the PAGE POOL, not the population
+      (the ``ci`` table's leading dim is the slot count);
+    - zero post-warmup recompiles (the engine's always-on counter is
+      flat across the resumed leg's steady-state chunks);
+    - host RSS stays flat (the rss_leak watchdog never fires — it is
+      armed) and ``scope health --gate`` exits 0;
+
+    and emits a BENCH_FLEET trajectory record (clients/sec,
+    rounds/hour, padding-efficiency + paging counters) under
+    ``extras.fleet`` so ``tools/scope trend`` can walk a committed
+    series of them.
+    """
+    os.environ.setdefault("MSRFLUTE_STRICT_TRANSFERS", "1")
+    from msrflute_tpu.data.fleet import SyntheticFleetDataset
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.telemetry.scope_cli import health, summarize
+    from msrflute_tpu.utils.logging import init_logging
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="fleet_")
+    init_logging(out_dir)
+    dataset = SyntheticFleetDataset(population, cache_users=512)
+    preempt_at = max(rounds // 2, 1)
+    tic = time.time()
+
+    # ---- leg 1: into the forced preemption ---------------------------
+    cfg = _fleet_config(rounds, cohort, preempt_at)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                dataset, model_dir=out_dir, seed=0)
+    pool_slots = server.fleet_pager.n_slots
+    assert pool_slots < population, (pool_slots, population)
+    server.train()
+    assert server.preempted, "forced preemption never fired"
+    ci_rows = int(server.state.strategy_state["ci"].shape[0])
+    assert ci_rows == pool_slots, (
+        "carry HBM must be bounded by the page pool, not N",
+        ci_rows, pool_slots)
+
+    # ---- leg 2: resume to completion, recompile-flat past warmup -----
+    cfg2 = _fleet_config(rounds, cohort, preempt_at)
+    server2 = OptimizationServer(make_task(cfg2.model_config), cfg2,
+                                 dataset, model_dir=out_dir, seed=0)
+    recompiles_per_chunk: list = []
+    drain = server2._drain_chunk
+
+    def observing_drain(chunk, vf, rf):
+        drain(chunk, vf, rf)
+        recompiles_per_chunk.append(int(server2.engine.recompile_count))
+
+    server2._drain_chunk = observing_drain
+    server2.train()
+    assert server2.state.round == rounds, (server2.state.round, rounds)
+    # zero post-warmup recompiles: once the resumed leg's program set
+    # warmed (first two drained chunks cover the bucket-grid variants),
+    # the counter must not move again
+    warm = min(2, max(len(recompiles_per_chunk) - 1, 0))
+    steady = recompiles_per_chunk[warm:]
+    assert not steady or steady[-1] == steady[0], (
+        "post-warmup recompiles", recompiles_per_chunk)
+    wall = time.time() - tic
+
+    # ---- the oracle --------------------------------------------------
+    verdict = health(out_dir)
+    gate_exit = 0 if verdict["ok"] else 3
+    assert gate_exit == 0, ("fleet run must gate healthy", verdict)
+    rss_fires = [f for f in (verdict.get("findings") or [])
+                 if "rss" in str(f.get("check", ""))]
+    assert not rss_fires, ("host RSS leaked across rounds", rss_fires)
+
+    summary = summarize(out_dir)
+    card = (summary.get("scorecard") or {}) if isinstance(
+        summary.get("scorecard"), dict) else {}
+    secs_p50 = card.get("round_secs_p50")
+    rollup_last = (verdict.get("last_window") or {})
+    record = {
+        "kind": "fleet",
+        "metric": "fleet_secs_per_round",
+        "value": secs_p50,
+        "rounds": rounds,
+        "population": population,
+        "cohort": cohort,
+        "wall_secs": round(wall, 2),
+        "health": {"ok": verdict["ok"],
+                   "findings": verdict["findings"],
+                   "warnings": verdict["warnings"]},
+        "extras": {
+            "fleet": {
+                "secs_per_round": secs_p50,
+                "rounds_per_hour": (round(3600.0 / secs_p50, 1)
+                                    if secs_p50 else None),
+                "clients_per_sec": rollup_last.get("clients_per_sec"),
+                "padding_efficiency": card.get("padding_efficiency"),
+                "page_pool_slots": pool_slots,
+                "paging": card.get("fleet"),
+                "lazy_cache": card.get("lazy_cache"),
+                "recompiles_per_chunk": recompiles_per_chunk,
+                "preempt_resume": True,
+            },
+        },
+    }
+    if report_path:
+        tmp = report_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(record, fh, indent=1, sort_keys=True)
+        os.replace(tmp, report_path)
+    return record
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--rounds", type=int, default=40)
+    # None sentinel: each posture resolves its own default (40-round
+    # endurance, 8-round fleet) — an EXPLICIT --rounds always wins
+    ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--users", type=int, default=24)
     ap.add_argument("--out-dir", default=None)
     ap.add_argument("--seed-stall", action="store_true",
                     help="adversarial arm: inject a hang, expect the "
                          "stall watchdog + health gate 3")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet posture: synthetic million-user "
+                         "population, paged carry, O(cohort) host state "
+                         "(ISSUE 14); emits a BENCH_FLEET record")
+    ap.add_argument("--fleet-population", type=int, default=1_000_000)
+    ap.add_argument("--fleet-cohort", type=int, default=1024)
     ap.add_argument("--report", default=None,
                     help="write the trajectory record here")
     args = ap.parse_args(argv)
-    record = run_endurance(rounds=args.rounds, num_users=args.users,
+    if args.fleet:
+        record = run_fleet(rounds=(8 if args.rounds is None
+                                   else args.rounds),
+                           population=args.fleet_population,
+                           cohort=args.fleet_cohort,
+                           out_dir=args.out_dir,
+                           report_path=args.report)
+        print(json.dumps(record, indent=1, sort_keys=True))
+        ok = record["health"]["ok"]
+        print("fleet:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+    record = run_endurance(rounds=(40 if args.rounds is None
+                                   else args.rounds),
+                           num_users=args.users,
                            out_dir=args.out_dir,
                            seed_stall=args.seed_stall,
                            report_path=args.report)
