@@ -45,6 +45,15 @@ RULE = "transfer-budget"
 #: engine functions that anchor a per-round path
 ROUND_ROOT_RE = re.compile(r"(^|_)(run|drain)_?\w*?(round|chunk|tail)",
                            re.I)
+#: the fleet pager's per-chunk entry points (engine/paging.py): the
+#: server drives them through an attribute-of-attribute receiver
+#: (``self.fleet_pager.prepare_chunk``) the call graph cannot resolve,
+#: so they anchor their own round paths — the writeback's ONE explicit
+#: fetch (and any force-completed early fetch, which reuses the same
+#: site) is budget-checked like every other per-round transfer
+PAGER_ROOT_RE = re.compile(
+    r"^(prepare_chunk|queue_writeback|complete_writeback|"
+    r"prefetch_chunk)$")
 #: callees NOT on the per-round cadence (their own budgets apply at
 #: their own boundaries): eval, checkpoint/persistence, prediction
 #: dumps, replay, setup/teardown
@@ -71,7 +80,8 @@ def check_project(project: Project,
         if not _has_part(path, _ROOT_PARTS):
             continue
         for qual, fn in mod.functions.items():
-            if ROUND_ROOT_RE.search(fn.name) and \
+            if (ROUND_ROOT_RE.search(fn.name) or
+                    PAGER_ROOT_RE.match(fn.name)) and \
                     not BOUNDARY_RE.search(fn.name):
                 roots.append((path, qual))
     if not roots:

@@ -52,8 +52,31 @@ from .dataset import BaseDataset
 
 __all__ = [
     "floyd_sample", "weighted_reservoir_sample", "sample_cohort",
-    "steps_for_array", "LazyNameList", "SyntheticFleetDataset",
+    "steps_for_array", "lane_shard_map", "LazyNameList",
+    "SyntheticFleetDataset",
 ]
+
+
+def lane_shard_map(k: int, num_shards: int) -> np.ndarray:
+    """Shard index per lane of a ``[K]`` cohort grid — THE cohort→shard
+    layout contract of the sharded fleet transfer plane.
+
+    ``shard_map``/``NamedSharding`` split the client axis into
+    ``num_shards`` CONTIGUOUS blocks in device order, so lane ``j``
+    executes on shard ``j // (K / num_shards)``.  The carry-page
+    allocator keys slot placement off this map (a lane's carry row must
+    live on the shard that computes the lane — the no-cross-shard-
+    collective invariant), and the multihost row store needs only the
+    rows of its own hosts' lanes because of it.  One vectorized pass,
+    int32; refuses a grid the mesh cannot split."""
+    k, num_shards = int(k), int(num_shards)
+    if num_shards <= 0 or k % num_shards:
+        raise ValueError(
+            f"lane_shard_map: grid of {k} lanes does not split over "
+            f"{num_shards} shards — pad the cohort to a mesh multiple "
+            "(pad_to_mesh / mesh-quantized bucket capacities)")
+    return (np.arange(k, dtype=np.int32) // (k // num_shards)) \
+        .astype(np.int32)
 
 
 # ----------------------------------------------------------------------

@@ -1,4 +1,5 @@
-"""Fleet paged carry tables (``server_config.fleet``) — O(cache) HBM.
+"""Fleet paged carry tables (``server_config.fleet``) — O(cache) HBM,
+mesh-sharded transfer plane.
 
 The PR 6 carry design keeps each device-carry strategy's per-client
 state (SCAFFOLD controls, EF residuals, personalization heads/alphas)
@@ -15,35 +16,71 @@ pool** plus a **host backing store**, behind the SAME
   the engine feeds the carry hooks host-remapped SLOT ids instead of
   client ids (the per-client rng streams keep folding on the TRUE
   client id, so per-client math is bit-identical to resident mode);
+- **the pool's slot axis is sharded over the clients mesh axis**
+  (``parallel.sharding.slot_pool_sharding``), exactly like the resident
+  tables it replaced: slots partition into ``mesh_size`` contiguous
+  per-shard blocks, and the allocator is SHARD-AWARE — a lane's client
+  gets a slot on the shard that computes the lane
+  (``data.fleet.lane_shard_map``), so the in-program gather/scatter by
+  ``carry_slots`` is shard-local with no cross-shard collective, and
+  pool HBM / page-in bytes / writeback bytes all cost total/mesh_size
+  per device instead of xmesh_size;
 - before each chunk dispatches, :meth:`CarryPager.prepare_chunk` maps
   the cohort onto slots: hits reuse their resident row, misses page in
-  from the host store as ONE fixed-shape scatter (width pow2-quantized,
+  from the host store as ONE fixed-shape SHARDED scatter — per-shard
+  segments of a single ``[M*W]`` buffer (width pow2-quantized,
   sentinel-padded with out-of-bounds drop — zero post-warmup
   recompiles by construction) that donates the tables in sequence with
-  the round programs;
+  the round programs; each device receives only its own segment;
+- a client resampled onto a DIFFERENT shard migrates: its old slot is
+  freed and the row pages in from the host store on the new shard.  If
+  the old slot is still pinned by an in-flight chunk, the pager
+  force-completes that chunk's already-dispatched writeback gather
+  first (one explicit early fetch — the gather's value is the
+  post-chunk row, so the host store is current before the migration
+  pages it back in);
 - right after dispatch, :meth:`queue_writeback` dispatches a small
-  gather of the chunk's slot rows from the post-chunk tables (reading
-  BEFORE the next dispatch donates them — the ``dp_clip`` stash
-  discipline); the pipeline drain completes it with one explicit
-  ``device_get`` and writes the rows through to the host store, so a
-  slot is evictable exactly when no in-flight chunk pins it;
-- eviction is LRU over unpinned slots; pinned (in-flight) rows are
-  never evicted, so depth-N pipelining stays safe — a pool too small
-  for ``(depth+1)`` cohorts refuses loudly instead of corrupting rows;
+  per-shard gather of the chunk's slot rows from the post-chunk tables
+  (reading BEFORE the next dispatch donates them — the ``dp_clip``
+  stash discipline); the pipeline drain completes it with one explicit
+  ``device_get`` that fetches the per-shard slices, and writes the
+  rows through to the host store, so a slot is evictable exactly when
+  no in-flight chunk pins it;
+- **prefetch** (``fleet.prefetch``, default on): while round k
+  executes, a named ``fleet-prefetch`` worker thread stages round
+  k+1's missing rows from the host store into a staging buffer —
+  read-only against the store (RAM peek under the store lock, direct
+  ``.npz`` read otherwise), so the allocator stays single-threaded and
+  the staged values are exactly what the synchronous path would load
+  (a prefetch-missing client cannot be resident, hence cannot have a
+  pending writeback that would make the staged row stale).  The
+  page-in's host IO leaves the critical path; the hit rate is a
+  devbus gauge;
+- eviction is LRU over unpinned slots PER SHARD; pinned (in-flight)
+  rows are never evicted, so depth-N pipelining stays safe — per-shard
+  contention drains the oldest outstanding writeback before giving up,
+  and a pool too small overall refuses loudly instead of corrupting
+  rows;
 - durability rides the :class:`FleetRowStore`: RAM-LRU rows with
   crash-safe ``.npz`` spill under the model dir and the same
   round-marker pairing as the SCAFFOLD ``ControlStore`` — a resumed
   run reloads rows from disk into an EMPTY pool (slot numbering is
   invisible to the math), so preempt-and-resume stays bit-identical.
+  Rows key by GLOBAL client id, so under multihost each host's shard
+  of the page-in never needs another host's rows.
 """
 
 from __future__ import annotations
 
 import os
-from collections import OrderedDict
+import threading
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from ..data.fleet import lane_shard_map
+from ..parallel.mesh import CLIENTS_AXIS, clients_axis_size
 
 
 def _pow2_width(n: int, floor: int = 8) -> int:
@@ -57,15 +94,23 @@ def _pow2_width(n: int, floor: int = 8) -> int:
 class FleetRowStore:
     """Host backing store for paged carry rows.
 
-    One logical row per client: a dict ``{table_key: np.ndarray}``.
-    RAM is LRU-bounded at ``cache_rows``; evicting a dirty row writes
-    it through to disk first (crash-safe tmp+rename ``.npz``), so the
-    union of RAM and disk is always the current row set.  ``flush()``
-    writes the remaining dirty rows through — the server calls it at
-    ``fleet.spill_freq`` cadence and commits the round marker only
-    after the paired model checkpoint is durable (the ControlStore
-    discipline; a marker/checkpoint mismatch on resume resets the
-    rows — carry state belongs to exactly one parameter trajectory).
+    One logical row per client, keyed by GLOBAL client id: a dict
+    ``{table_key: np.ndarray}``.  RAM is LRU-bounded at ``cache_rows``;
+    evicting a dirty row writes it through to disk first (crash-safe
+    tmp+rename ``.npz``), so the union of RAM and disk is always the
+    current row set.  ``flush()`` writes the remaining dirty rows
+    through — the server calls it at ``fleet.spill_freq`` cadence and
+    commits the round marker only after the paired model checkpoint is
+    durable (the ControlStore discipline; a marker/checkpoint mismatch
+    on resume resets the rows — carry state belongs to exactly one
+    parameter trajectory).
+
+    Mutations happen only on the server's round-loop thread; the
+    ``fleet-prefetch`` worker reads through :meth:`peek` (RAM/spilling
+    maps under ``_ram_lock``, no LRU mutation) and :meth:`_read_file`
+    (atomic-replace ``.npz``, torn-read safe) — dirty evictees sit in
+    the ``_spilling`` map until their file write lands, so a
+    concurrent peek never sees a row in neither place.
     """
 
     def __init__(self, store_dir: Optional[str], cache_rows: int = 8192,
@@ -75,6 +120,10 @@ class FleetRowStore:
         self._rows: "OrderedDict[int, Dict[str, np.ndarray]]" = \
             OrderedDict()
         self._dirty: set = set()
+        #: dirty evictees between pop-from-RAM and the (outside-lock)
+        #: file write — readable by peek() so the row never vanishes
+        self._spilling: Dict[int, Dict[str, np.ndarray]] = {}
+        self._ram_lock = threading.Lock()
         self.spilled_rows = 0
         if store_dir is not None:
             os.makedirs(store_dir, exist_ok=True)
@@ -94,37 +143,68 @@ class FleetRowStore:
                 os.remove(os.path.join(self.store_dir, name))
 
     # -- rows -----------------------------------------------------------
+    def _read_file(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
+        """Stateless disk read (no RAM insert, no LRU motion) — the
+        prefetch thread's half of :meth:`get`."""
+        if self.store_dir is None:
+            return None
+        path = self._path(cid)
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as zf:
+            return {k: zf[k] for k in zf.files}
+
+    def peek(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
+        """RAM (or in-spill) row WITHOUT LRU mutation — safe from the
+        prefetch thread; row dicts are replaced, never mutated in
+        place, so the returned mapping is stable."""
+        cid = int(cid)
+        with self._ram_lock:
+            row = self._rows.get(cid)
+            if row is None:
+                row = self._spilling.get(cid)
+        return row
+
     def get(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
         cid = int(cid)
-        row = self._rows.get(cid)
-        if row is not None:
-            self._rows.move_to_end(cid)
-            return row
-        if self.store_dir is not None:
-            path = self._path(cid)
-            if os.path.exists(path):
-                with np.load(path) as zf:
-                    row = {k: zf[k] for k in zf.files}
-                self._insert(cid, row, dirty=False)
+        with self._ram_lock:
+            row = self._rows.get(cid)
+            if row is not None:
+                self._rows.move_to_end(cid)
                 return row
-        return None
+            row = self._spilling.get(cid)
+            if row is not None:
+                return row
+        row = self._read_file(cid)
+        if row is not None:
+            self._insert(cid, row, dirty=False)
+        return row
 
     def put(self, cid: int, row: Dict[str, np.ndarray]) -> None:
         self._insert(int(cid), row, dirty=True)
 
     def _insert(self, cid: int, row: Dict[str, np.ndarray],
                 dirty: bool) -> None:
-        self._rows.pop(cid, None)
-        self._rows[cid] = row
-        if dirty:
-            self._dirty.add(cid)
-        while len(self._rows) > self.cache_rows:
-            old_cid, old_row = self._rows.popitem(last=False)
-            if old_cid in self._dirty:
-                # nowhere else holds the latest value: spill-through
-                self._write(old_cid, old_row)
-                self._dirty.discard(old_cid)
-                self.spilled_rows += 1
+        to_spill: List[tuple] = []
+        with self._ram_lock:
+            self._rows.pop(cid, None)
+            self._rows[cid] = row
+            if dirty:
+                self._dirty.add(cid)
+            while len(self._rows) > self.cache_rows:
+                old_cid, old_row = self._rows.popitem(last=False)
+                if old_cid in self._dirty:
+                    # nowhere else holds the latest value: spill-through
+                    # (file IO deferred past the lock; the row stays
+                    # visible via _spilling until the write lands)
+                    self._dirty.discard(old_cid)
+                    self._spilling[old_cid] = old_row
+                    to_spill.append((old_cid, old_row))
+        for old_cid, old_row in to_spill:
+            self._write(old_cid, old_row)
+            with self._ram_lock:
+                self._spilling.pop(old_cid, None)
+            self.spilled_rows += 1
 
     def _write(self, cid: int, row: Dict[str, np.ndarray]) -> None:
         if self.store_dir is None:
@@ -153,12 +233,14 @@ class FleetRowStore:
             self._dirty.clear()
             return 0
         n = 0
-        for cid in sorted(self._dirty):
-            row = self._rows.get(cid)
+        with self._ram_lock:
+            pending = [(cid, self._rows.get(cid))
+                       for cid in sorted(self._dirty)]
+            self._dirty.clear()
+        for cid, row in pending:
             if row is not None:
                 self._write(cid, row)
                 n += 1
-        self._dirty.clear()
         return n
 
     def set_round(self, round_no: int) -> None:
@@ -177,24 +259,30 @@ class FleetRowStore:
 
     def reset(self) -> None:
         """Drop every row + marker (trajectory-mismatch semantics)."""
-        self._rows.clear()
-        self._dirty.clear()
+        with self._ram_lock:
+            self._rows.clear()
+            self._dirty.clear()
+            self._spilling.clear()
         if self.store_dir is not None:
             self._wipe_files()
 
 
 class CarryPager:
-    """Slot allocator + page-in/writeback programs for ONE run's carry
-    tables.  Single-threaded by design: every method is called from the
-    server's round loop (prepare -> dispatch -> queue -> drain)."""
+    """Shard-aware slot allocator + sharded page-in/writeback programs
+    for ONE run's carry tables.  Allocator state is single-threaded by
+    design: every mutating method is called from the server's round
+    loop (prefetch -> prepare -> dispatch -> queue -> drain); the
+    prefetch worker only stages row VALUES."""
 
     def __init__(self, strategy, state_tables: Dict[str, Any],
                  slots: int, mesh,
                  store_dir: Optional[str] = None,
                  host_cache_rows: int = 8192,
-                 resume: bool = False):
+                 resume: bool = False,
+                 partition_mode: str = "shard_map",
+                 prefetch: bool = True):
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.sharding import slot_pool_sharding
 
         self.strategy = strategy
         self.keys = tuple(strategy.carry_tables)
@@ -204,6 +292,16 @@ class CarryPager:
                 "fleet paging has nothing to page; drop the fleet block "
                 "or use a device-carry strategy")
         self.n_slots = int(slots)
+        self.mesh_shards = clients_axis_size(mesh)
+        if self.n_slots % self.mesh_shards:
+            raise ValueError(
+                f"fleet.page_pool_slots={self.n_slots} does not split "
+                f"over the {self.mesh_shards}-shard clients mesh axis — "
+                "the server quantizes the pool to a mesh multiple; "
+                "constructing CarryPager directly, do the same")
+        #: per-shard block width: slot s lives on shard s // shard_slots
+        self.shard_slots = self.n_slots // self.mesh_shards
+        self.partition_mode = str(partition_mode)
         # per-key row geometry straight off the live tables (shape[0]
         # is the slot count; everything after is the row)
         self._row_shape = {}
@@ -219,18 +317,43 @@ class CarryPager:
             self._row_shape[k] = tuple(int(d) for d in leaf.shape[1:])
             self._row_dtype[k] = np.dtype(str(leaf.dtype))
         self._defaults = dict(strategy.carry_row_defaults())
-        self._rep = NamedSharding(mesh, P())
+        #: slot-axis tables and page-in/writeback buffers are SHARDED
+        #: over the clients axis — per-device bytes = total/mesh_size
+        self._pool_spec = slot_pool_sharding(mesh)
         self.store = FleetRowStore(store_dir, cache_rows=host_cache_rows,
                                    resume=resume)
 
-        # ---- slot state ----------------------------------------------
-        self._free: List[int] = list(range(self.n_slots - 1, -1, -1))
+        # ---- slot state (per shard) ----------------------------------
+        self._free: List[List[int]] = [
+            list(range((s + 1) * self.shard_slots - 1,
+                       s * self.shard_slots - 1, -1))
+            for s in range(self.mesh_shards)]
         self._slot_client = np.full((self.n_slots,), -1, np.int64)
         self._client_slot: Dict[int, int] = {}
         self._pins = np.zeros((self.n_slots,), np.int64)
-        #: unpinned slots in LRU order (front = evict first)
-        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        #: per-shard unpinned slots in LRU order (front = evict first)
+        self._lru: List["OrderedDict[int, None]"] = [
+            OrderedDict() for _ in range(self.mesh_shards)]
         self._ticket: Optional[Dict[str, Any]] = None
+        #: queued-but-uncompleted writeback handles, dispatch order —
+        #: what a shard-migration force-completes to unpin old slots
+        self._outstanding: deque = deque()
+
+        # ---- prefetch staging ----------------------------------------
+        self.prefetch_enabled = bool(prefetch)
+        #: set on the first prefetch_chunk call — hit/miss accounting
+        #: starts only once the server actually ENGAGES prefetch (a
+        #: serial or sample-hooked run never does; its cold page-ins
+        #: must not read as a 0.0 hit rate to the scope diff gate)
+        self._prefetch_engaged = False
+        self._staging: Dict[int, Optional[Dict[str, np.ndarray]]] = {}
+        self._staging_lock = threading.Lock()
+        self._prefetch_thread: Optional[threading.Thread] = None
+        #: optional flutescope Telemetry (the server wires it): the
+        #: worker opens a `fleet_prefetch` span on its OWN thread
+        #: track, so the trace shows the paging host IO overlapping
+        #: the device window instead of sitting on the critical path
+        self.scope = None
 
         # ---- compiled program caches (one per pow2 width) ------------
         self._scatter_cache: Dict[int, Any] = {}
@@ -241,36 +364,74 @@ class CarryPager:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.migrations = 0
+        self.forced_drains = 0
         self.page_in_rows = 0
         self.writeback_rows = 0
+        self.page_in_bytes = 0
+        self.writeback_bytes = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
 
     # ------------------------------------------------------------------
     def describe(self) -> Dict[str, Any]:
+        total_pf = self.prefetch_hits + self.prefetch_misses
         return {
             "pool_slots": self.n_slots,
+            "mesh_shards": int(self.mesh_shards),
+            "shard_slots": int(self.shard_slots),
             "resident": int(len(self._client_slot)),
             "hits": int(self.hits),
             "misses": int(self.misses),
             "evictions": int(self.evictions),
+            "migrations": int(self.migrations),
+            "forced_drains": int(self.forced_drains),
             "page_in_rows": int(self.page_in_rows),
             "writeback_rows": int(self.writeback_rows),
+            "page_in_bytes": int(self.page_in_bytes),
+            "page_in_bytes_per_device":
+                int(self.page_in_bytes // self.mesh_shards),
+            "writeback_bytes": int(self.writeback_bytes),
+            "writeback_bytes_per_device":
+                int(self.writeback_bytes // self.mesh_shards),
+            "prefetch_hits": int(self.prefetch_hits),
+            "prefetch_misses": int(self.prefetch_misses),
+            # None (not 0.0) when prefetch never engaged: a serial /
+            # sample-hooked / prefetch-off run has no coverage to
+            # report, and a 0.0 would trip the scope-diff hit-rate gate
+            # against any prefetching baseline
+            "prefetch_hit_rate": (float(self.prefetch_hits) / total_pf
+                                  if total_pf else
+                                  (0.0 if self._prefetch_engaged
+                                   else None)),
             "spilled_rows": int(self.store.spilled_rows),
+            "hbm_bytes_per_device":
+                int(self.shard_slots * self.hbm_row_bytes()),
             "tables": list(self.keys),
         }
 
     def hbm_row_bytes(self) -> int:
-        """Bytes one pool row costs across all table keys — the pool's
-        HBM budget is ``n_slots * hbm_row_bytes()``, independent of N."""
+        """Bytes one pool row costs across all table keys — PER-DEVICE
+        pool HBM is ``shard_slots * hbm_row_bytes()`` (the slot axis is
+        sharded), independent of N."""
         return int(sum(
             int(np.prod(self._row_shape[k], dtype=np.int64) or 1)
             * self._row_dtype[k].itemsize for k in self.keys))
 
+    def pool_sharding(self):
+        """The slot-axis NamedSharding the engine puts the carry tables
+        with (``P(CLIENTS_AXIS)`` on axis 0)."""
+        return self._pool_spec
+
     # ------------------------------------------------------------------
-    # slot allocation
+    # slot allocation (shard-aware)
     # ------------------------------------------------------------------
+    def _shard_of(self, slot: int) -> int:
+        return slot // self.shard_slots
+
     def _pin(self, slot: int) -> None:
         if self._pins[slot] == 0:
-            self._lru.pop(slot, None)
+            self._lru[self._shard_of(slot)].pop(slot, None)
         self._pins[slot] += 1
 
     def _unpin(self, slot: int) -> None:
@@ -278,38 +439,154 @@ class CarryPager:
         if self._pins[slot] <= 0:
             self._pins[slot] = 0
             if self._slot_client[slot] >= 0:
-                self._lru[slot] = None  # tail = most recently used
+                # tail = most recently used
+                self._lru[self._shard_of(slot)][slot] = None
 
-    def _alloc(self, cid: int) -> int:
-        if self._free:
-            slot = self._free.pop()
-        elif self._lru:
-            slot, _ = self._lru.popitem(last=False)  # LRU head
-            old = int(self._slot_client[slot])
-            # the host store already holds the evictee's current row:
-            # unpinned means every chunk that touched it drained, and
-            # the drain wrote the row back — eviction costs zero device
-            # traffic
-            self._client_slot.pop(old, None)
-            self.evictions += 1
-        else:
-            raise ValueError(
-                f"fleet.page_pool_slots={self.n_slots} cannot hold the "
-                "in-flight cohorts: every slot is pinned by a dispatched "
-                "chunk — raise page_pool_slots (it must cover "
-                "(pipeline_depth + 1) x cohort x rounds_per_step rows)")
+    def _force_drain_oldest(self) -> bool:
+        """Complete the oldest outstanding writeback early (an explicit
+        fetch of an already-dispatched gather — the value is the
+        post-chunk rows, so the host store is current afterwards).
+        Unblocks shard migrations and per-shard slot contention."""
+        if not self._outstanding:
+            return False
+        self.forced_drains += 1
+        self.complete_writeback(self._outstanding[0])
+        return True
+
+    def _alloc(self, cid: int, shard: int) -> int:
+        while True:
+            if self._free[shard]:
+                slot = self._free[shard].pop()
+                break
+            if self._lru[shard]:
+                slot, _ = self._lru[shard].popitem(last=False)  # LRU head
+                old = int(self._slot_client[slot])
+                # the host store already holds the evictee's current
+                # row: unpinned means every chunk that touched it
+                # drained, and the drain wrote the row back — eviction
+                # costs zero device traffic
+                self._client_slot.pop(old, None)
+                self.evictions += 1
+                break
+            # every slot of this shard is pinned by an in-flight chunk:
+            # drain the oldest outstanding writeback (early explicit
+            # fetch) and retry — only a pool too small overall gives up
+            if not self._force_drain_oldest():
+                raise ValueError(
+                    f"fleet.page_pool_slots={self.n_slots} cannot hold "
+                    f"the in-flight cohorts: every slot of shard {shard} "
+                    f"({self.shard_slots} of {self.n_slots}) is pinned "
+                    "by a dispatched chunk — raise page_pool_slots (it "
+                    "must cover (pipeline_depth + 1) x cohort x "
+                    "rounds_per_step rows per shard)")
         self._slot_client[slot] = cid
         self._client_slot[cid] = slot
         return slot
+
+    def _migrate_out(self, cid: int, slot: int) -> None:
+        """Free a client's slot on the wrong shard so it can re-alloc
+        on the shard that computes its lane.  An in-flight pin means an
+        undrained chunk still owns the row — force-complete writebacks
+        (oldest first) until the pin drops, so the host store holds the
+        post-chunk value before the migration pages it back in."""
+        while self._pins[slot] > 0:
+            if not self._force_drain_oldest():
+                raise RuntimeError(
+                    "fleet pager: slot pinned with no outstanding "
+                    "writeback — prepare/queue discipline broken")
+        shard = self._shard_of(slot)
+        self._lru[shard].pop(slot, None)
+        self._client_slot.pop(cid, None)
+        self._slot_client[slot] = -1
+        self._free[shard].append(slot)
+        self.migrations += 1
+
+    # ------------------------------------------------------------------
+    # prefetch (host-side async stage of next chunk's missing rows)
+    # ------------------------------------------------------------------
+    def prefetch_chunk(self, batches: list) -> int:
+        """Stage the NEXT chunk's missing rows on a background thread
+        while the device executes the current one.  Read-only against
+        the store (peek + direct file read) — the allocator and LRU
+        stay single-threaded, and a staged value cannot go stale: a
+        client missing from the pool is in no in-flight chunk, so no
+        writeback can update its row before the next prepare_chunk
+        consumes the staging.  Returns the number of rows queued."""
+        if not self.prefetch_enabled:
+            return 0
+        self._prefetch_engaged = True
+        self._join_prefetch()
+        flat = [b for entry in batches
+                for b in (entry if isinstance(entry, list) else [entry])]
+        want: List[int] = []
+        seen: set = set()
+        for b in flat:
+            for cid in np.asarray(b.client_ids).ravel():
+                cid = int(cid)
+                if cid < 0 or cid in seen or cid in self._client_slot:
+                    continue
+                seen.add(cid)
+                want.append(cid)
+        with self._staging_lock:
+            self._staging = {}
+            staging = self._staging
+        if not want:
+            return 0
+        t = threading.Thread(
+            target=self._prefetch_worker, args=(want, staging),
+            name="fleet-prefetch", daemon=True)
+        self._prefetch_thread = t
+        t.start()
+        return len(want)
+
+    def _prefetch_worker(self, cids: List[int], staging: dict) -> None:
+        scope = self.scope
+        if scope is not None:
+            with scope.span("fleet_prefetch", rows=len(cids)):
+                self._prefetch_rows(cids, staging)
+        else:
+            self._prefetch_rows(cids, staging)
+
+    def _prefetch_rows(self, cids: List[int], staging: dict) -> None:
+        store = self.store
+        for cid in cids:
+            row = store.peek(cid)
+            if row is None:
+                row = store._read_file(cid)
+            with self._staging_lock:
+                if staging is not self._staging:
+                    return  # superseded generation: stop loading
+                staging[cid] = row
+
+    def _join_prefetch(self) -> None:
+        t = self._prefetch_thread
+        if t is not None and t.is_alive():
+            t.join()
+        self._prefetch_thread = None
+
+    def _load_row(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
+        """A miss's row: the prefetch staging if the worker got there
+        (hit — host IO already off the critical path), else the
+        synchronous store read (cold path; bit-identical values)."""
+        if self._prefetch_engaged:
+            with self._staging_lock:
+                if cid in self._staging:
+                    self.prefetch_hits += 1
+                    return self._staging.pop(cid)
+            self.prefetch_misses += 1
+        return self.store.get(cid)
 
     # ------------------------------------------------------------------
     # per-chunk flow
     # ------------------------------------------------------------------
     def prepare_chunk(self, batches: list, strategy_state: Any) -> Any:
         """Map the chunk's cohorts onto pool slots (writes
-        ``batch.carry_slots`` on every grid, -1 for padding lanes),
-        page missing rows in as one fixed-shape donated scatter, and
-        pin the touched slots until this chunk drains.  Returns the
+        ``batch.carry_slots`` on every grid — GLOBAL slot ids; the
+        engine converts to shard-local indices inside ``shard_map`` —
+        -1 for padding lanes), page missing rows in as one fixed-shape
+        donated SHARDED scatter, and pin the touched slots until this
+        chunk drains.  Slot placement follows ``lane_shard_map``: each
+        lane's row lands on the shard that computes it.  Returns the
         (possibly updated) ``strategy_state``."""
         if self._ticket is not None:
             raise RuntimeError(
@@ -318,117 +595,233 @@ class CarryPager:
         flat = [b for entry in batches
                 for b in (entry if isinstance(entry, list) else [entry])]
         chunk_slots: "OrderedDict[int, int]" = OrderedDict()  # slot->cid
+        chunk_shard: Dict[int, int] = {}  # cid -> required shard
         miss: List[tuple] = []
         for b in flat:
             ids = np.asarray(b.client_ids)
+            shards = lane_shard_map(ids.shape[0], self.mesh_shards)
             slots = np.full(ids.shape, -1, np.int32)
             for j, cid in enumerate(ids):
                 cid = int(cid)
                 if cid < 0:
                     continue
+                shard = int(shards[j])
+                prev = chunk_shard.get(cid)
+                if prev is not None and prev != shard:
+                    # the server refuses rounds_per_step > 1 on a >1-
+                    # shard mesh exactly because this row dependency
+                    # cannot be satisfied without a cross-shard
+                    # collective; reaching here is a logic error
+                    raise RuntimeError(
+                        f"fleet pager: client {cid} appears on shards "
+                        f"{prev} and {shard} within one chunk — "
+                        "mid-chunk cross-shard carry reuse is "
+                        "unsupported (rounds_per_step must be 1 on a "
+                        "multi-device mesh)")
+                chunk_shard[cid] = shard
                 slot = self._client_slot.get(cid)
+                if slot is not None and self._shard_of(slot) != shard:
+                    # resampled onto a different shard: free the old
+                    # slot (force-draining its in-flight writeback if
+                    # needed) and treat as a miss on the new shard —
+                    # the host store holds the current row
+                    self._migrate_out(cid, slot)
+                    slot = None
                 if slot is None:
-                    slot = self._alloc(cid)
+                    slot = self._alloc(cid, shard)
                     miss.append((cid, slot))
                     self.misses += 1
                 else:
                     self.hits += 1
-                    if self._pins[slot] == 0 and slot in self._lru:
-                        self._lru.move_to_end(slot)
+                    shard_lru = self._lru[shard]
+                    if self._pins[slot] == 0 and slot in shard_lru:
+                        shard_lru.move_to_end(slot)
                 slots[j] = slot
                 if slot not in chunk_slots:
                     chunk_slots[slot] = cid
                     self._pin(slot)
             b.carry_slots = slots
+        page_in_bytes = 0
+        if miss:
+            strategy_state, page_in_bytes = \
+                self._page_in(strategy_state, miss)
         self._ticket = {
             "slots": np.asarray(list(chunk_slots), np.int32),
             "ids": np.asarray(list(chunk_slots.values()), np.int64),
+            "page_in_bytes": int(page_in_bytes),
         }
-        if miss:
-            strategy_state = self._page_in(strategy_state, miss)
+        if self.prefetch_enabled:
+            # generation boundary: anything the worker staged for this
+            # chunk and nobody consumed is dead weight now
+            with self._staging_lock:
+                self._staging = {}
         return strategy_state
 
-    def _page_in(self, strategy_state: Any, miss: List[tuple]) -> Any:
+    def _page_in(self, strategy_state: Any, miss: List[tuple]) -> tuple:
         jax = self._jax
-        import jax.numpy as jnp
-        W = _pow2_width(len(miss))
-        slot_arr = np.full((W,), self.n_slots, np.int32)  # sentinel: drop
-        rows = {k: np.full((W,) + self._row_shape[k],
+        M, SS = self.mesh_shards, self.shard_slots
+        per_shard: List[List[tuple]] = [[] for _ in range(M)]
+        for cid, slot in miss:
+            per_shard[self._shard_of(slot)].append((cid, slot))
+        W = _pow2_width(max(len(g) for g in per_shard))
+        local_ids = self.partition_mode == "shard_map"
+        # sentinel index: one past the (local or global) slot range —
+        # out of bounds, mode="drop", so padded lanes scatter nothing
+        sentinel = SS if local_ids else self.n_slots
+        slot_arr = np.full((M * W,), sentinel, np.int32)
+        rows = {k: np.full((M * W,) + self._row_shape[k],
                            self._defaults.get(k, 0.0),
                            self._row_dtype[k]) for k in self.keys}
-        for i, (cid, slot) in enumerate(miss):
-            slot_arr[i] = slot
-            stored = self.store.get(cid)
-            if stored is not None:
-                for k in self.keys:
-                    rows[k][i] = stored[k]
+        for s, group in enumerate(per_shard):
+            for i, (cid, slot) in enumerate(group):
+                slot_arr[s * W + i] = (slot - s * SS) if local_ids \
+                    else slot
+                stored = self._load_row(cid)
+                if stored is not None:
+                    for k in self.keys:
+                        rows[k][s * W + i] = stored[k]
         self.page_in_rows += len(miss)
+        nbytes = int(sum(r.nbytes for r in rows.values())
+                     + slot_arr.nbytes)
+        self.page_in_bytes += nbytes
         fn = self._scatter_cache.get(W)
         if fn is None:
-            keys = self.keys
-
-            def scatter(tables, slots, new_rows):
-                # sentinel-padded lanes target index n_slots: out of
-                # bounds, mode="drop" — the fixed [W] shape never
-                # retraces on the miss count
-                return {k: tables[k].at[slots].set(new_rows[k],
-                                                   mode="drop")
-                        for k in keys}
-
-            fn = jax.jit(scatter, donate_argnums=(0,))
+            fn = self._build_scatter(W)
             self._scatter_cache[W] = fn
         tables = {k: strategy_state[k] for k in self.keys}
-        # one replicated put for the whole padded row dict — the page-in
-        # transfer is len(keys) buffers regardless of miss count
-        rows_dev = jax.device_put(rows, self._rep)
-        new_tables = fn(tables, jnp.asarray(slot_arr), rows_dev)
+        # ONE sharded put for the whole padded row dict: the leading
+        # axis is P(CLIENTS_AXIS), so each device receives only its own
+        # [W] segment — per-device page-in bytes = total / mesh_size
+        rows_dev = jax.device_put(rows, self._pool_spec)
+        slots_dev = jax.device_put(slot_arr, self._pool_spec)
+        new_tables = fn(tables, slots_dev, rows_dev)
         new_state = dict(strategy_state)
         new_state.update(new_tables)
-        return new_state
+        return new_state, nbytes
+
+    def _build_scatter(self, W: int):
+        jax = self._jax
+        keys = self.keys
+
+        def scatter(tables, slots, new_rows):
+            # sentinel-padded lanes target one past the slot range:
+            # out of bounds, mode="drop" — the fixed [M*W] shape never
+            # retraces on the miss count
+            return {k: tables[k].at[slots].set(new_rows[k], mode="drop")
+                    for k in keys}
+
+        if self.partition_mode == "shard_map":
+            from jax.sharding import PartitionSpec as P
+            from ..utils.compat import shard_map
+            cspec = P(CLIENTS_AXIS)
+            scatter = shard_map(
+                scatter, mesh=self._pool_spec.mesh,
+                in_specs=(cspec, cspec, cspec), out_specs=cspec,
+                check_vma=False)
+        return jax.jit(scatter, donate_argnums=(0,))
+
+    def _build_gather(self, W: int):
+        jax = self._jax
+        import jax.numpy as jnp
+        keys = self.keys
+        hi = (self.shard_slots if self.partition_mode == "shard_map"
+              else self.n_slots) - 1
+
+        def gather(tables, slots):
+            idx = jnp.clip(slots, 0, hi)
+            return {k: tables[k][idx] for k in keys}
+
+        if self.partition_mode == "shard_map":
+            from jax.sharding import PartitionSpec as P
+            from ..utils.compat import shard_map
+            cspec = P(CLIENTS_AXIS)
+            gather = shard_map(
+                gather, mesh=self._pool_spec.mesh,
+                in_specs=(cspec, cspec), out_specs=cspec,
+                check_vma=False)
+        return jax.jit(gather)
 
     def queue_writeback(self, strategy_state: Any) -> Dict[str, Any]:
-        """Dispatch the async gather of this chunk's slot rows from the
-        POST-chunk tables.  Must run before the next dispatch donates
-        ``strategy_state`` (program order then guarantees the gather
-        reads the chunk's output).  Returns the handle the drain
-        completes."""
+        """Dispatch the async per-shard gather of this chunk's slot
+        rows from the POST-chunk tables.  Must run before the next
+        dispatch donates ``strategy_state`` (program order then
+        guarantees the gather reads the chunk's output).  Returns the
+        handle the drain completes (idempotently — a shard migration
+        may have force-completed it early)."""
         ticket = self._ticket
         self._ticket = None
         if ticket is None or ticket["slots"].size == 0:
             return {"ids": np.empty((0,), np.int64), "rows": None,
-                    "slots": np.empty((0,), np.int32)}
+                    "slots": np.empty((0,), np.int32),
+                    "pos": np.empty((0,), np.int64), "done": True,
+                    "page_in_bytes": int((ticket or {}).get(
+                        "page_in_bytes", 0)),
+                    "writeback_bytes": 0}
         jax = self._jax
-        import jax.numpy as jnp
-        W = _pow2_width(int(ticket["slots"].size))
-        slot_arr = np.zeros((W,), np.int32)
-        slot_arr[:ticket["slots"].size] = ticket["slots"]
+        M, SS = self.mesh_shards, self.shard_slots
+        per_shard: List[List[int]] = [[] for _ in range(M)]
+        order: List[int] = []  # ticket index in segment-layout order
+        for i, slot in enumerate(ticket["slots"]):
+            per_shard[self._shard_of(int(slot))].append(i)
+        W = _pow2_width(max(len(g) for g in per_shard))
+        local_ids = self.partition_mode == "shard_map"
+        slot_arr = np.zeros((M * W,), np.int32)
+        pos = np.empty((ticket["slots"].size,), np.int64)
+        n = 0
+        for s, group in enumerate(per_shard):
+            for i, tick_i in enumerate(group):
+                slot = int(ticket["slots"][tick_i])
+                slot_arr[s * W + i] = (slot - s * SS) if local_ids \
+                    else slot
+                pos[n] = s * W + i
+                order.append(tick_i)
+                n += 1
         fn = self._gather_cache.get(W)
         if fn is None:
-            n_slots = self.n_slots
-            keys = self.keys
-
-            def gather(tables, slots):
-                idx = jnp.clip(slots, 0, n_slots - 1)
-                return {k: tables[k][idx] for k in keys}
-
-            fn = jax.jit(gather)
+            fn = self._build_gather(W)
             self._gather_cache[W] = fn
         tables = {k: strategy_state[k] for k in self.keys}
-        rows = fn(tables, jnp.asarray(slot_arr))
-        return {"ids": ticket["ids"], "slots": ticket["slots"],
-                "rows": rows}
+        slots_dev = jax.device_put(slot_arr, self._pool_spec)
+        rows = fn(tables, slots_dev)
+        wb_bytes = int(sum(
+            int(np.prod((M * W,) + self._row_shape[k], dtype=np.int64))
+            * self._row_dtype[k].itemsize for k in self.keys))
+        self.writeback_bytes += wb_bytes
+        handle = {"ids": ticket["ids"][order],
+                  "slots": ticket["slots"][order],
+                  "pos": pos, "rows": rows, "done": False,
+                  "page_in_bytes": int(ticket["page_in_bytes"]),
+                  "writeback_bytes": wb_bytes}
+        self._outstanding.append(handle)
+        return handle
 
     def complete_writeback(self, handle: Dict[str, Any]) -> None:
-        """Drain half: ONE explicit fetch of the gathered rows, write
-        them through to the host store, unpin the chunk's slots."""
+        """Drain half: ONE explicit fetch of the gathered rows — the
+        per-shard slices of the sharded gather output come back in the
+        one ``device_get`` — written through to the host store; the
+        chunk's slots unpin.  Idempotent: a shard migration may have
+        force-completed this handle before the pipeline drain reaches
+        it."""
+        if handle.get("done"):
+            return
+        handle["done"] = True
+        # identity scan, not deque.remove: == on handle dicts would
+        # element-wise compare their numpy members
+        for i, h in enumerate(self._outstanding):
+            if h is handle:
+                del self._outstanding[i]
+                break
         ids = handle["ids"]
         if handle["rows"] is None or ids.size == 0:
             return
         jax = self._jax
         fetched = jax.device_get(handle["rows"])
+        pos = handle["pos"]
         for i, cid in enumerate(ids):
+            # np.array (copy), not np.asarray (view): a view would pin
+            # the whole padded [M*W] fetch buffer in the host row cache
             self.store.put(int(cid),
-                           {k: np.asarray(fetched[k][i])
+                           {k: np.array(fetched[k][pos[i]])
                             for k in self.keys})
         self.writeback_rows += int(ids.size)
         for slot in handle["slots"]:
@@ -459,10 +852,18 @@ class CarryPager:
         """Trajectory mismatch on resume: drop the host rows AND the
         slot map — every next touch cold-starts from the defaults,
         exactly like a fresh table."""
+        self._join_prefetch()
         self.store.reset()
-        self._free = list(range(self.n_slots - 1, -1, -1))
+        self._free = [
+            list(range((s + 1) * self.shard_slots - 1,
+                       s * self.shard_slots - 1, -1))
+            for s in range(self.mesh_shards)]
         self._slot_client[:] = -1
         self._client_slot.clear()
         self._pins[:] = 0
-        self._lru.clear()
+        for lru in self._lru:
+            lru.clear()
         self._ticket = None
+        self._outstanding.clear()
+        with self._staging_lock:
+            self._staging = {}

@@ -264,6 +264,23 @@ class RoundEngine:
         self.carry_paged = bool(
             self.device_carry and _fleet_raw and
             _fleet_raw.get("enable", True))
+        # mesh-sharded page pool: the tables' slot axis splits over
+        # CLIENTS_AXIS into contiguous per-shard blocks (the same split
+        # shard_map applies to the cohort grids), so the in-program
+        # carry gather/scatter is shard-local — the engine converts the
+        # GLOBAL carry_slots operand to shard-local indices inside the
+        # shard_map body using this block width.
+        self._carry_shard_slots = 0
+        if self.carry_paged:
+            rows = int(getattr(strategy, "carry_rows", 0) or 0)
+            shards = int(self.mesh.shape[CLIENTS_AXIS])
+            if rows <= 0 or rows % shards:
+                raise ValueError(
+                    f"fleet paged carry: page pool of {rows} slots does "
+                    f"not split over the {shards}-shard clients mesh "
+                    "axis — the server quantizes page_pool_slots to a "
+                    "mesh multiple before building the engine")
+            self._carry_shard_slots = rows // shards
 
         # fused RL (server_config.wantRL + fused_carry): the DQN
         # aggregation-weight tuner lives in strategy_state (rl/fused.py)
@@ -571,6 +588,8 @@ class RoundEngine:
             opt_state = jax.jit(self.server_tx.init,
                                 out_shardings=self._replicated)(params)
         strategy_state = self.strategy.init_state(params)
+        if self.carry_paged:
+            strategy_state = self.shard_carry_state(strategy_state)
         if self.rl_fused:
             # the DQN tuner's carry (net params, optimizer state, replay
             # ring, epsilon, delayed-reward anchors) rides strategy_state
@@ -584,6 +603,28 @@ class RoundEngine:
             strategy_state=strategy_state,
             round=0,
         )
+
+    # ------------------------------------------------------------------
+    def shard_carry_state(self, strategy_state: Any) -> Any:
+        """Lay the paged carry tables out with the slot axis SHARDED
+        over the clients mesh axis (the fleet transfer plane's HBM
+        divisor: per-device pool bytes = total / mesh_size) and the
+        rest of the state replicated.  Applied at init and again after
+        a checkpoint restore, so the donated round program always sees
+        one stable layout (no resharding copies, no donation-layout
+        churn the recompile sentinel would flag)."""
+        if not isinstance(strategy_state, dict):
+            raise ValueError(
+                "fleet paged carry requires a dict strategy_state with "
+                f"the carry tables as keys — got "
+                f"{type(strategy_state).__name__}")
+        from ..parallel.sharding import slot_pool_sharding
+        pool_spec = slot_pool_sharding(self.mesh)
+        carry_keys = set(self.strategy.carry_tables)
+        # flint: disable=put-loop one-time layout at init/resume, not per-round dispatch
+        return {k: jax.device_put(v, pool_spec if k in carry_keys
+                                  else self._replicated)
+                for k, v in strategy_state.items()}
 
     # ------------------------------------------------------------------
     def attach_pool(self, pool_arrays: Dict[str, np.ndarray]) -> None:
@@ -630,6 +671,17 @@ class RoundEngine:
         carry_paged = self.carry_paged
         rl_fused = self.rl_fused
         fused_rl = self._rl
+        # mesh-sharded page pool (fleet paging x shard_map): the carry
+        # tables enter the shard_map as their OWN operand with a
+        # P(CLIENTS_AXIS) slot-axis spec (the rest of strategy_state
+        # stays replicated), and the GLOBAL carry_slots convert to
+        # shard-local indices in-body — the gather/scatter is local to
+        # the shard that computes the lane, no cross-shard collective.
+        # GSPMD mode keeps global ids and lets the partitioner place
+        # the (still slot-axis-sharded) tables.
+        carry_split = carry_paged and self.partition_mode == "shard_map"
+        carry_keys = tuple(strategy.carry_tables) if carry_paged else ()
+        shard_slots = self._carry_shard_slots
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
@@ -942,7 +994,17 @@ class RoundEngine:
             # route them to the right keyword here (with corruption off
             # and the pool on, the pool must not land in corrupt_mode)
             rest = list(rest)
+            if carry_split:
+                # sharded pool: this shard's table block rejoins the
+                # replicated state, and the global slot ids drop to
+                # block-local (padding stays -1) — the allocator
+                # guaranteed every lane's slot lives on this shard
+                tables = rest.pop(0)
+                strategy_state = {**strategy_state, **tables}
             slots = rest.pop(0) if carry_paged else None
+            if carry_split:
+                off = jax.lax.axis_index(CLIENTS_AXIS) * shard_slots
+                slots = jnp.where(slots >= 0, slots - off, -1)
             corrupt = rest.pop(0) if chaos_corruption else None
             pool_arg = rest.pop(0) if pool_mode else None
             return shard_body(params, strategy_state, arrays, sample_mask,
@@ -961,6 +1023,7 @@ class RoundEngine:
                 shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec, rspec, rspec) +
+                         ((cspec,) if carry_split else ()) +
                          ((cspec,) if carry_paged else ()) +
                          ((cspec,) if chaos_corruption else ()) +
                          ((rspec,) if pool_mode else ()),
@@ -1041,10 +1104,21 @@ class RoundEngine:
             # strategies may move the broadcast point off the canonical
             # params (e.g. FedAC's momentum-like md point); default identity
             bcast = strategy.broadcast_params(params, strategy_state)
+            if carry_split:
+                # the sharded pool tables ride their own cspec operand;
+                # everything else in strategy_state stays replicated
+                collect_state = {k: v for k, v in strategy_state.items()
+                                 if k not in carry_keys}
+                carry_tab_args = ({k: strategy_state[k]
+                                   for k in carry_keys},)
+            else:
+                collect_state = strategy_state
+                carry_tab_args = ()
             collect_out = sharded_collect(
-                bcast, strategy_state, arrays, sample_mask, client_mask,
+                bcast, collect_state, arrays, sample_mask, client_mask,
                 client_ids, client_lr, round_idx, leakage_threshold,
                 quant_threshold, rng, client_ids, client_mask,
+                *carry_tab_args,
                 *((carry_slots,) if carry_paged else ()),
                 *corrupt_args, *pool_args)
             collected, privacy_per_client = collect_out[0], collect_out[1]
@@ -1740,6 +1814,11 @@ class RoundEngine:
         corrupt_flip_scale = self._corrupt_flip_scale
         device_carry = self.device_carry
         carry_paged = self.carry_paged
+        # mesh-sharded page pool: same split as the monolithic round —
+        # tables ride a cspec operand, global slots drop to shard-local
+        carry_split = carry_paged and self.partition_mode == "shard_map"
+        carry_keys = tuple(strategy.carry_tables) if carry_paged else ()
+        shard_slots = self._carry_shard_slots
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
@@ -1892,7 +1971,13 @@ class RoundEngine:
                         client_mask, client_ids, client_lr, round_idx,
                         leakage_threshold, quant_threshold, rng, *rest):
             rest = list(rest)
+            if carry_split:
+                tables = rest.pop(0)
+                strategy_state = {**strategy_state, **tables}
             slots = rest.pop(0) if carry_paged else None
+            if carry_split:
+                off = jax.lax.axis_index(CLIENTS_AXIS) * shard_slots
+                slots = jnp.where(slots >= 0, slots - off, -1)
             corrupt = rest.pop(0) if chaos_corruption else None
             pool_arg = rest.pop(0) if pool_mode else None
             return shard_body(params, strategy_state, arrays, sample_mask,
@@ -1909,6 +1994,7 @@ class RoundEngine:
                 shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec) +
+                         ((cspec,) if carry_split else ()) +
                          ((cspec,) if carry_paged else ()) +
                          ((cspec,) if chaos_corruption else ()) +
                          ((rspec,) if pool_mode else ()),
@@ -1968,9 +2054,18 @@ class RoundEngine:
                 corrupt_args = (corrupt_mode,)
             pool_args = extra_args[n_used:]
             bcast = strategy.broadcast_params(params, strategy_state)
-            out = sharded(bcast, strategy_state, arrays, sample_mask,
+            if carry_split:
+                collect_state = {k: v for k, v in strategy_state.items()
+                                 if k not in carry_keys}
+                carry_tab_args = ({k: strategy_state[k]
+                                   for k in carry_keys},)
+            else:
+                collect_state = strategy_state
+                carry_tab_args = ()
+            out = sharded(bcast, collect_state, arrays, sample_mask,
                           client_mask, client_ids, client_lr, round_idx,
                           leakage_threshold, quant_threshold, rng,
+                          *carry_tab_args,
                           *((carry_slots,) if carry_paged else ()),
                           *corrupt_args, *pool_args)
             if defer_screen:

@@ -125,9 +125,31 @@ class OptimizationServer:
             # cohorts' worth of rows with 2x headroom for cross-round
             # revisits, pow2-quantized; never more rows than clients
             from ..data.batching import pow2_ceil
+            mesh_shards = self.mesh.shape[CLIENTS_AXIS]
+            if rps > 1 and mesh_shards > 1:
+                # a client resampled in a LATER round of one fused
+                # chunk can land on a different shard; its carry row
+                # would have to cross shards mid-program — exactly the
+                # collective the sharded pool exists to avoid.  Single-
+                # round chunks migrate between dispatches instead (the
+                # pager force-completes the in-flight writeback), so
+                # pipeline_depth still provides the overlap.
+                raise ValueError(
+                    "fleet paged carry on a multi-device clients mesh "
+                    f"({mesh_shards} shards) requires rounds_per_step: "
+                    f"1 (got {rps}) — a mid-chunk resample onto another "
+                    "shard would need a cross-shard carry collective; "
+                    "use pipeline_depth for overlap instead")
             auto = pow2_ceil(max(pad * rps * (depth + 1) * 2, pad + 1))
             slots = int(self._fleet_cfg.get("page_pool_slots") or auto)
             slots = min(max(slots, pad), len(train_dataset))
+            # mesh-sharded pool: the slot axis splits over CLIENTS_AXIS
+            # into contiguous per-shard blocks (per-device HBM =
+            # slots / mesh_size rows), so the pool must be a mesh
+            # multiple — quantize UP (a pool slightly past N just means
+            # some slots never allocate)
+            slots = ((slots + mesh_shards - 1) // mesh_shards) \
+                * mesh_shards
             # in-flight floor: with depth-N pipelining, (depth+1) chunks
             # of rps cohorts each can pin rows simultaneously — a pool
             # below that would deadlock allocation mid-run; refuse at
@@ -684,13 +706,29 @@ class OptimizationServer:
         self.fleet_pager = None
         if self._fleet_paged:
             from .paging import CarryPager
+            if resumed:
+                # the restored tables came off the checkpoint as host
+                # arrays: re-lay them out with the slot axis sharded so
+                # the donated round program sees the SAME layout a fresh
+                # init builds (no resharding copy, no donation churn)
+                self.state = ServerState(
+                    self.state.params, self.state.opt_state,
+                    self.engine.shard_carry_state(
+                        self.state.strategy_state),
+                    self.state.round)
             self.fleet_pager = CarryPager(
                 self.strategy, self.state.strategy_state,
                 slots=int(self.strategy.carry_rows), mesh=self.mesh,
                 store_dir=os.path.join(model_dir, "fleet_carry"),
                 host_cache_rows=int(
                     self._fleet_cfg.get("host_cache_rows", 8192) or 8192),
-                resume=resumed)
+                resume=resumed,
+                partition_mode=self.engine.partition_mode,
+                prefetch=bool(self._fleet_cfg.get("prefetch", True)))
+            # the prefetch worker spans its host IO on its own thread
+            # track — the trace then SHOWS the paging stage overlapping
+            # the device window instead of on the critical path
+            self.fleet_pager.scope = self.scope
             if resumed and self.fleet_pager.round() != self.state.round:
                 print_rank(
                     f"fleet carry rows were at round "
@@ -703,7 +741,10 @@ class OptimizationServer:
             print_rank(
                 f"fleet paged carry: {self.fleet_pager.n_slots} pool "
                 f"slots x {sorted(self.strategy.carry_tables)} "
-                f"({mb:.1f} MiB HBM) over {len(train_dataset)} clients")
+                f"({mb:.1f} MiB HBM total, "
+                f"{mb / self.fleet_pager.mesh_shards:.1f} MiB/device "
+                f"over {self.fleet_pager.mesh_shards} shards) over "
+                f"{len(train_dataset)} clients")
 
     # ------------------------------------------------------------------
     def _select_strategy(self, config) -> type:
@@ -973,6 +1014,17 @@ class OptimizationServer:
         pipelined = self.pipeline_depth > 0 and self._pipeline_ok()
         if pipelined:
             prefetch_ok = False
+        # fleet row prefetch: stage the NEXT chunk's missing carry rows
+        # (host-store IO) on the pager's worker thread while this
+        # chunk executes, so the page-in's host half leaves the
+        # critical path.  Needs lookahead packing — the same sampling-
+        # order discipline prefetch_ok already guards (the rng draw
+        # order is unchanged: cohorts are data-independent lookahead).
+        fleet_prefetch = (self.fleet_pager is not None and
+                          self.fleet_pager.prefetch_enabled and
+                          self.rl is None and self.server_replay is None
+                          and not self._sample_hooked)
+        lookahead_pack = prefetch_ok or (pipelined and fleet_prefetch)
         # the ring of dispatched-but-undrained chunks, oldest first: up to
         # ``pipeline_depth`` stay in flight; each dispatch drains the
         # oldest once the ring is full, so with depth N the host tail of
@@ -1188,9 +1240,15 @@ class OptimizationServer:
                     self.state.strategy_state)
             # dispatch is async: pack the next chunk NOW, while the device
             # executes this one (reading the stats below is what blocks)
-            if prefetch_ok and round_no + R < max_iteration:
+            if lookahead_pack and round_no + R < max_iteration:
                 next_R = chunk_R(round_no + R)
                 prefetched = (next_R, pack_chunk(next_R))
+                if fleet_prefetch:
+                    # hand the packed cohort to the fleet-prefetch
+                    # worker: missing carry rows stage off-thread while
+                    # the device executes, so the next prepare_chunk's
+                    # page-in assembly is a staging-buffer copy
+                    self.fleet_pager.prefetch_chunk(prefetched[1])
             if profile_this:
                 jax.block_until_ready(self.state.params)
                 jax.profiler.stop_trace()
@@ -1345,6 +1403,33 @@ class OptimizationServer:
                     fleet_gauges[f"fleet_page_{key}"] = pd[key]
                     self.scope.devbus_host(f"fleet_page_{key}", pd[key],
                                            step=round0 + R - 1)
+                # transfer-plane accounting (mesh-sharded pool): this
+                # chunk's page-in/writeback bytes off the completed
+                # handle, plus the cumulative per-device split and the
+                # prefetch hit rate — what `scope diff/trend --gate`
+                # watches for a replication regression (per-device
+                # bytes snapping back to the total)
+                wb = chunk.get("fleet_wb") or {}
+                self.scope.devbus_host(
+                    "fleet_page_in_bytes",
+                    wb.get("page_in_bytes", 0), step=round0 + R - 1)
+                self.scope.devbus_host(
+                    "fleet_writeback_bytes",
+                    wb.get("writeback_bytes", 0), step=round0 + R - 1)
+                if pd["prefetch_hit_rate"] is not None:
+                    # None = prefetch never engaged this run (serial /
+                    # sample-hooked / prefetch-off): no coverage to
+                    # report, nothing for the diff gate to read
+                    self.scope.devbus_host(
+                        "fleet_prefetch_hit_rate",
+                        pd["prefetch_hit_rate"], step=round0 + R - 1)
+                for key in ("page_in_bytes", "page_in_bytes_per_device",
+                            "writeback_bytes",
+                            "writeback_bytes_per_device",
+                            "prefetch_hit_rate", "migrations",
+                            "forced_drains"):
+                    if pd[key] is not None:
+                        fleet_gauges[f"fleet_{key}"] = pd[key]
             cache_stats_fn = getattr(self.train_dataset, "cache_stats",
                                      None)
             if cache_stats_fn is not None:
@@ -1586,6 +1671,20 @@ class OptimizationServer:
             # collapse or an eviction storm is a fleet-sizing regression
             # `scope diff`/`scope health` should see
             card["fleet"] = self.fleet_pager.describe()
+            # flat copies for the `scope diff --gate` rules (DIFF_RULES
+            # reads top-level scorecard keys): per-device transfer
+            # bytes are the replication-regression tripwire — a
+            # replicated pool multiplies them by mesh_size
+            card["fleet_page_in_bytes_per_device"] = \
+                card["fleet"]["page_in_bytes_per_device"]
+            card["fleet_writeback_bytes_per_device"] = \
+                card["fleet"]["writeback_bytes_per_device"]
+            if card["fleet"]["prefetch_hit_rate"] is not None:
+                # absent (not 0.0) when prefetch never engaged, so the
+                # diff gate's lower_abs rule skips instead of flagging
+                # a non-prefetching arm as a coverage regression
+                card["fleet_prefetch_hit_rate"] = \
+                    card["fleet"]["prefetch_hit_rate"]
         cache_stats_fn = getattr(self.train_dataset, "cache_stats", None)
         if cache_stats_fn is not None:
             card["lazy_cache"] = cache_stats_fn()

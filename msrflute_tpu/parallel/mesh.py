@@ -63,6 +63,13 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def clients_axis_size(mesh: Mesh) -> int:
+    """Number of shards the client axis splits into — the divisor of
+    every per-device cost in the fleet transfer plane (page-pool HBM,
+    page-in bytes, writeback bytes are all total / this)."""
+    return int(mesh.shape[CLIENTS_AXIS])
+
+
 def pad_to_mesh(k: int, mesh: Mesh) -> int:
     """Round client count up to a multiple of the clients-axis size."""
     n = mesh.shape[CLIENTS_AXIS]

@@ -18,7 +18,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import MODEL_AXIS
+from .mesh import CLIENTS_AXIS, MODEL_AXIS
+
+
+def slot_pool_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for slot-axis tables (the fleet carry page pool): the
+    slot axis splits over ``clients`` exactly like the resident
+    ``[N, ...]`` tables it replaced, so per-device pool HBM is
+    ``slots / mesh_size`` rows.  A replicated spec here is the
+    replicated-pool bug class flint's shard-ready rule pins: page-in
+    bytes, writeback fetches, and pool HBM all multiply by mesh size
+    instead of dividing."""
+    return NamedSharding(mesh, P(CLIENTS_AXIS))
 
 
 def infer_model_sharding(params: Any, mesh: Mesh,

@@ -153,7 +153,7 @@ COHORT_BUCKETING_FIELD_SPECS = {
 
 FLEET_KEYS = {
     "enable", "page_pool_slots", "host_cache_rows", "spill_freq",
-    "sampling",
+    "sampling", "prefetch",
 }
 
 #: fleet cohort-draw vocabulary (data/fleet.py sample_cohort):
@@ -176,6 +176,10 @@ FLEET_FIELD_SPECS = {
     # scaffold_flush_freq tradeoff: > 1 amortizes disk IO, a stop
     # inside the window resets carry rows on resume)
     "spill_freq": ("int", 1, None),
+    # stage the next chunk's missing carry rows on the fleet-prefetch
+    # worker thread while the current chunk executes (bit-identical to
+    # the cold path; default on — off only for the prefetch A/B)
+    "prefetch": ("bool", None, None),
     # `sampling` keeps a bespoke enum check in validate()
 }
 

@@ -483,13 +483,25 @@ class Scaffold(FedAvg):
         # and chaos-dropped clients must not leak into the controls
         keep = valid * live_mask * (w > 0).astype(jnp.float32)
         carry = {"row": jnp.where(keep > 0, ci_new, ci), "keep": keep}
+        if self.carry_rows:
+            # fleet paged pool only: "old" carries the pre-round control
+            # row out of the collect so apply_carry's `c` delta never
+            # re-gathers from the table — the slot axis is sharded there
+            # and a post-collect gather would cost a cross-shard
+            # collective (and a partitioner-chosen association).  In
+            # resident mode the table is replicated, apply_carry's own
+            # gather is local and free, and carrying "old" would only
+            # add a [K, n_params] all-gather to every round.
+            carry["old"] = ci
         return parts, tl, ns, stats, carry
 
     def apply_carry(self, state, client_ids, carry, rng=None):
         import jax.numpy as jnp
         rows, keep = carry["row"], carry["keep"]
         n_rows = state["ci"].shape[0]
-        ci_old = state["ci"][jnp.clip(client_ids, 0, n_rows - 1)]
+        ci_old = carry.get("old")
+        if ci_old is None:
+            ci_old = state["ci"][jnp.clip(client_ids, 0, n_rows - 1)]
         keep_b = keep > 0
         delta = jnp.where(keep_b[:, None], rows - ci_old, 0.0)
         new_c = state["c"] + delta.sum(axis=0) / max(

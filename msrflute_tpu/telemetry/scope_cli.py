@@ -316,6 +316,14 @@ DIFF_RULES: Dict[str, Tuple[str, float]] = {
     "overlap_efficiency_pct": ("lower_abs", 10.0),
     "recompiles": ("higher_abs", 0.0),
     "puts_per_dispatch": ("higher_abs", 0.0),
+    # fleet transfer plane (mesh-sharded page pool): per-device paging
+    # bytes are total/mesh_size by construction — a replicated pool
+    # snaps them back to the total (xmesh_size), far past this margin
+    "fleet_page_in_bytes_per_device": ("higher_frac", 0.5),
+    "fleet_writeback_bytes_per_device": ("higher_frac", 0.5),
+    # prefetch coverage collapsing means the page-in host IO moved back
+    # onto the critical path
+    "fleet_prefetch_hit_rate": ("lower_abs", 0.25),
 }
 
 #: metrics whose thresholds scale with --pct (the wall-clock-ish ones)

@@ -1124,6 +1124,86 @@ def test_shard_ready_vmap_width_and_cold_paths_are_fine(tmp_path):
     assert found == []
 
 
+def test_shard_ready_flags_replicated_pool_spec_binding(tmp_path):
+    # the PR 14 bug class: a slot-axis table pinned to NamedSharding(
+    # mesh, P()) — pool HBM and page-in bytes go xmesh_size
+    found = run_on(tmp_path, "engine/pager.py", """\
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        class Pool:
+            def __init__(self, mesh):
+                self.pool_spec = NamedSharding(mesh, P())
+        """, rules=["shard-ready"])
+    assert rules_of(found) == ["shard-ready"]
+    assert "REPLICATED" in found[0].message
+
+
+def test_shard_ready_flags_replicated_put_of_row_buffer(tmp_path):
+    found = run_on(tmp_path, "engine/pager.py", """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def page_in(mesh, rows):
+            rep = NamedSharding(mesh, P())
+            return jax.device_put(rows, rep)
+        """, rules=["shard-ready"])
+    assert rules_of(found) == ["shard-ready"]
+    assert "device_put of slot-axis table" in found[0].message
+
+
+def test_shard_ready_sharded_pool_spec_is_fine(tmp_path):
+    # the sharded spec (P over the clients axis) stays silent, as do
+    # replicated specs bound to non-table names and non-engine modules
+    found = run_on(tmp_path, "engine/pager.py", """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def page_in(mesh, rows, scalars):
+            pool_spec = NamedSharding(mesh, P("clients"))
+            replicated = NamedSharding(mesh, P())
+            dev = jax.device_put(rows, pool_spec)
+            return dev, jax.device_put(scalars, replicated)
+        """, rules=["shard-ready"])
+    assert found == []
+
+
+def test_shard_ready_replicated_pool_outside_engine_is_fine(tmp_path):
+    found = run_on(tmp_path, "tools/mod.py", """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def stage(mesh, rows):
+            return jax.device_put(rows, NamedSharding(mesh, P()))
+        """, rules=["shard-ready"])
+    assert found == []
+
+
+def test_transfer_budget_covers_pager_writeback_root(tmp_path):
+    # engine/paging.py's per-chunk entry points anchor their own round
+    # paths: a second device_get site in complete_writeback flags...
+    found = run_on(tmp_path, "engine/paging.py", """\
+        import jax
+
+        class Pager:
+            def complete_writeback(self, handle):
+                rows = jax.device_get(handle["rows"])
+                ids = jax.device_get(handle["ids"])
+                return rows, ids
+        """, rules=["transfer-budget"])
+    assert rules_of(found) == ["transfer-budget"]
+    # ...and the shipped one-fetch shape stays silent
+    found = run_on(tmp_path, "engine/paging.py", """\
+        import jax
+
+        class Pager:
+            def complete_writeback(self, handle):
+                fetched = jax.device_get(handle["rows"])
+                for i in handle["ids"]:
+                    self.store[i] = fetched[i]
+        """, rules=["transfer-budget"])
+    assert found == []
+
+
 # ======================================================================
 # recompile-hazard
 # ======================================================================

@@ -1,0 +1,217 @@
+"""Fleet transfer plane (ISSUE 15): paged carry x multi-device mesh.
+
+The tentpole contract on the conftest's forced 8-device CPU mesh:
+
+- the page pool's slot axis is SHARDED over CLIENTS_AXIS (per-device
+  pool HBM = slots/mesh rows), in shard_map AND gspmd partition modes;
+- page-in and writeback move per-shard slices (per-device bytes =
+  total / mesh_size) and slot allocation is lane-local
+  (``lane_shard_map``), so the in-program carry gather/scatter needs
+  no cross-shard collective;
+- a client resampled onto another shard migrates via a force-completed
+  writeback (explicit early fetch) — still bitwise identical to
+  resident tables;
+- the prefetch worker stages rows off the critical path and is
+  bit-identical to the cold path.
+"""
+
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+import jax
+from conftest import make_synthetic_classification
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data.fleet import lane_shard_map
+from msrflute_tpu.engine.server import select_server
+from msrflute_tpu.models import make_task
+from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+MESH = 8  # conftest forces 8 virtual CPU devices
+
+
+# ======================================================================
+# lane -> shard layout contract
+# ======================================================================
+def test_lane_shard_map_contiguous_blocks():
+    m = lane_shard_map(16, 4)
+    assert m.tolist() == [0] * 4 + [1] * 4 + [2] * 4 + [3] * 4
+    assert m.dtype == np.int32
+    assert lane_shard_map(8, 8).tolist() == list(range(8))
+
+
+def test_lane_shard_map_refuses_indivisible_grid():
+    with pytest.raises(ValueError, match="does not split"):
+        lane_shard_map(10, 4)
+    with pytest.raises(ValueError, match="does not split"):
+        lane_shard_map(8, 0)
+
+
+# ======================================================================
+# end-to-end paged runs on the 8-device mesh
+# ======================================================================
+def _cfg(depth, *, fleet=None, rounds=5, strategy="scaffold",
+         server_over=None, mesh_config=None):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": 4,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "fused_carry": True, "rounds_per_step": 1,
+        "val_freq": 100, "initial_val": False,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "data_config": {"val": {"batch_size": 8}},
+    }
+    if fleet is not None:
+        sc["fleet"] = fleet
+    if server_over:
+        sc.update(server_over)
+    raw = {
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": strategy,
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    }
+    if mesh_config is not None:
+        raw["mesh_config"] = mesh_config
+    return FLUTEConfig.from_dict(raw)
+
+
+def _run(cfg, tmp, seed=7):
+    ds = make_synthetic_classification()
+    server = select_server(cfg.server_config.get("type"))(
+        make_task(cfg.model_config), cfg, ds, model_dir=str(tmp),
+        seed=seed)
+    state = server.train()
+    flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return flat, server, state
+
+
+def test_pool_tables_sharded_over_clients_axis(tmp_path):
+    flat, server, state = _run(
+        _cfg(0, fleet={"page_pool_slots": 16}), tmp_path / "a")
+    pager = server.fleet_pager
+    assert pager.mesh_shards == MESH
+    assert pager.shard_slots == 16 // MESH
+    for key in server.strategy.carry_tables:
+        leaf = state.strategy_state[key]
+        spec = leaf.sharding.spec
+        assert tuple(spec)[:1] == (CLIENTS_AXIS,), (key, spec)
+        # per-device HBM: each addressable shard holds slots/mesh rows
+        shard_rows = {s.data.shape[0] for s in leaf.addressable_shards}
+        assert shard_rows == {16 // MESH}
+    desc = pager.describe()
+    assert desc["hbm_bytes_per_device"] * MESH == \
+        16 * pager.hbm_row_bytes()
+
+
+def test_page_in_and_writeback_bytes_split_per_device(tmp_path):
+    _, server, _ = _run(_cfg(0, fleet={"page_pool_slots": 16}),
+                        tmp_path / "a")
+    d = server.fleet_pager.describe()
+    assert d["page_in_rows"] > 0 and d["writeback_rows"] > 0
+    assert d["page_in_bytes"] > 0 and d["writeback_bytes"] > 0
+    assert d["page_in_bytes_per_device"] * MESH == d["page_in_bytes"]
+    assert d["writeback_bytes_per_device"] * MESH == \
+        d["writeback_bytes"]
+
+
+def test_slot_allocation_is_lane_local(tmp_path):
+    """Every lane's slot lives on the shard that computes the lane —
+    the no-cross-shard-collective invariant, checked on the grids the
+    run actually dispatched."""
+    seen = {"n": 0}
+    from msrflute_tpu.engine.paging import CarryPager
+    orig = CarryPager.prepare_chunk
+
+    def checked(self, batches, strategy_state):
+        out = orig(self, batches, strategy_state)
+        flat = [b for e in batches
+                for b in (e if isinstance(e, list) else [e])]
+        for b in flat:
+            ids = np.asarray(b.client_ids)
+            shards = lane_shard_map(ids.shape[0], self.mesh_shards)
+            for j, cid in enumerate(ids):
+                if int(cid) < 0:
+                    continue
+                slot = int(b.carry_slots[j])
+                assert slot // self.shard_slots == int(shards[j])
+                seen["n"] += 1
+        return out
+
+    CarryPager.prepare_chunk = checked
+    try:
+        _run(_cfg(2, fleet={"enable": True}), tmp_path / "a")
+    finally:
+        CarryPager.prepare_chunk = orig
+    assert seen["n"] > 0
+
+
+def test_migrations_force_drain_and_stay_bit_identical(tmp_path,
+                                                       monkeypatch):
+    """At toy scale a client's lane moves between rounds, so its row
+    migrates across shards (force-completing the in-flight writeback);
+    the result must still be bitwise resident, strict-transfers
+    clean."""
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    resident, _, _ = _run(_cfg(3), tmp_path / "res")
+    flat, server, _ = _run(_cfg(3, fleet={"enable": True}),
+                           tmp_path / "paged")
+    d = server.fleet_pager.describe()
+    assert d["migrations"] > 0  # cross-shard resample really happened
+    assert d["forced_drains"] > 0  # pinned slots drained early
+    np.testing.assert_array_equal(resident, flat)
+
+
+def test_prefetch_hits_and_bit_identical_to_cold_path(tmp_path):
+    cold, srv_cold, _ = _run(
+        _cfg(2, fleet={"enable": True, "prefetch": False}),
+        tmp_path / "cold")
+    warm, srv_warm, _ = _run(_cfg(2, fleet={"enable": True}),
+                             tmp_path / "warm")
+    assert srv_cold.fleet_pager.prefetch_hits == 0
+    assert srv_warm.fleet_pager.prefetch_hits > 0
+    d = srv_warm.fleet_pager.describe()
+    assert 0.0 < d["prefetch_hit_rate"] <= 1.0
+    np.testing.assert_array_equal(cold, warm)
+
+
+def test_zero_recompiles_after_warmup_with_sharded_pool(tmp_path):
+    _, server, _ = _run(_cfg(2, fleet={"enable": True}, rounds=6),
+                        tmp_path / "a")
+    assert server.engine.recompile_count == 0
+
+
+def test_rounds_per_step_gt1_refused_on_multidevice_mesh(tmp_path):
+    with pytest.raises(ValueError, match="rounds_per_step"):
+        _run(_cfg(0, fleet={"enable": True},
+                  server_over={"rounds_per_step": 2}), tmp_path / "a")
+
+
+def test_gspmd_partition_mode_pool_sharded(tmp_path):
+    over = {"partition": "gspmd"}
+    resident, _, _ = _run(_cfg(0, mesh_config=over), tmp_path / "res")
+    flat, server, state = _run(
+        _cfg(0, fleet={"page_pool_slots": 16}, mesh_config=over),
+        tmp_path / "paged")
+    assert server.engine.partition_mode == "gspmd"
+    for key in server.strategy.carry_tables:
+        spec = state.strategy_state[key].sharding.spec
+        assert tuple(spec)[:1] == (CLIENTS_AXIS,), (key, spec)
+    d = server.fleet_pager.describe()
+    assert d["page_in_bytes_per_device"] * MESH == d["page_in_bytes"]
+    np.testing.assert_array_equal(resident, flat)
+
+
+def test_scorecard_gains_flat_fleet_transfer_keys(tmp_path):
+    cfg = _cfg(2, fleet={"enable": True},
+               server_over={"telemetry": {"enable": True}})
+    _, server, _ = _run(cfg, tmp_path / "a")
+    card = server.build_scorecard()
+    assert card["fleet"]["page_in_bytes_per_device"] > 0
+    assert card["fleet_page_in_bytes_per_device"] == \
+        card["fleet"]["page_in_bytes_per_device"]
+    assert card["fleet_writeback_bytes_per_device"] == \
+        card["fleet"]["writeback_bytes_per_device"]
+    assert "fleet_prefetch_hit_rate" in card

@@ -45,6 +45,15 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
+# the fleet drill must exercise the SHARDED transfer plane (per-shard
+# slot allocation, sharded page-in/writeback, migrations): force a
+# 4-virtual-device CPU mesh before anything initializes the backend —
+# the same XLA_FLAGS emulation the test conftest uses at 8.  An
+# ambient larger count (e.g. running under the test env) is kept.
+if "--fleet" in (sys.argv or []):
+    from msrflute_tpu.utils.backend import force_cpu_backend
+    force_cpu_backend(4)
+
 #: the chaos drill: every client-fault class live, plus the forced
 #: midpoint preemption the driver adds per-run
 CHAOS = {
@@ -334,6 +343,7 @@ def run_fleet(rounds: int = 8, population: int = 1_000_000,
     server = OptimizationServer(make_task(cfg.model_config), cfg,
                                 dataset, model_dir=out_dir, seed=0)
     pool_slots = server.fleet_pager.n_slots
+    mesh_shards = server.fleet_pager.mesh_shards
     assert pool_slots < population, (pool_slots, population)
     server.train()
     assert server.preempted, "forced preemption never fired"
@@ -341,6 +351,14 @@ def run_fleet(rounds: int = 8, population: int = 1_000_000,
     assert ci_rows == pool_slots, (
         "carry HBM must be bounded by the page pool, not N",
         ci_rows, pool_slots)
+    # mesh-sharded pool (ISSUE 15): each DEVICE holds slots/mesh_size
+    # rows, not the whole pool — a replicated table here is exactly the
+    # transfer-plane regression the sharded spec removed
+    per_dev_rows = {s.data.shape[0] for s in
+                    server.state.strategy_state["ci"].addressable_shards}
+    assert per_dev_rows == {pool_slots // mesh_shards}, (
+        "pool HBM must be slots/mesh_size rows per device",
+        per_dev_rows, pool_slots, mesh_shards)
 
     # ---- leg 2: resume to completion, recompile-flat past warmup -----
     cfg2 = _fleet_config(rounds, cohort, preempt_at)
@@ -397,6 +415,17 @@ def run_fleet(rounds: int = 8, population: int = 1_000_000,
                 "clients_per_sec": rollup_last.get("clients_per_sec"),
                 "padding_efficiency": card.get("padding_efficiency"),
                 "page_pool_slots": pool_slots,
+                "mesh_shards": mesh_shards,
+                # transfer-plane accounting (ISSUE 15): per-device vs
+                # total paging bytes + prefetch coverage, so `scope
+                # diff/trend --gate` catches a replication regression
+                # in the committed BENCH_FLEET series
+                "page_in_bytes_per_device": card.get(
+                    "fleet_page_in_bytes_per_device"),
+                "writeback_bytes_per_device": card.get(
+                    "fleet_writeback_bytes_per_device"),
+                "prefetch_hit_rate": card.get(
+                    "fleet_prefetch_hit_rate"),
                 "paging": card.get("fleet"),
                 "lazy_cache": card.get("lazy_cache"),
                 "recompiles_per_chunk": recompiles_per_chunk,
