@@ -701,6 +701,23 @@ def _server_overhead_extras(server) -> dict:
             "bucket_grid_variants":
                 len(getattr(server.engine, "bucket_shapes_seen", ())),
         }
+    mgb = getattr(server, "megabatch", None)
+    if mgb is None:
+        # megabatch joins the contract trio: a super-batch-taped run
+        # reshapes the per-bucket compute entirely — comparing it
+        # against a per-client-vmap baseline without the marker would
+        # misattribute the win
+        out["megabatch"] = {"enabled": False}
+    else:
+        util = server.megabatch_utilization
+        out["megabatch"] = {
+            "enabled": True,
+            "lanes": [int(l) for l in mgb["lanes"]],
+            "utilization": (round(float(util), 4)
+                            if util is not None else None),
+            "gate_arms": {f"K{k}_S{s}": arm for (k, s), arm in
+                          sorted(server.engine._mega_gate.items())},
+        }
     chaos = getattr(server, "chaos", None)
     if chaos is not None:
         out["chaos"] = dict(chaos.describe(),
@@ -1181,7 +1198,8 @@ def bench_fused_carry_ab(on_tpu: bool) -> dict:
 
 
 def _config_block_ab(on_tpu: bool, key: str, arms: dict,
-                     data_fn=None, protocol=None, per_arm=None) -> dict:
+                     data_fn=None, protocol=None, per_arm=None,
+                     server_over=None) -> dict:
     """Shared off-vs-on overhead harness: run the SAME faithful-mode
     protocol once per arm with ``server_config[key]`` set to that arm's
     block (``None`` = block absent), many rounds inside one ``train()``
@@ -1193,7 +1211,9 @@ def _config_block_ab(on_tpu: bool, key: str, arms: dict,
     ``data_fn()`` overrides the default homogeneous dataset (the
     cohort-bucketing A/B needs heterogeneous client sizes — the whole
     point of the optimization); ``protocol`` labels it; ``per_arm(server,
-    arm)`` returns extra per-arm fields recorded under ``{key}_{arm}_*``.
+    arm)`` returns extra per-arm fields recorded under ``{key}_{arm}_*``;
+    ``server_over`` applies extra server_config blocks to EVERY arm (the
+    megabatch A/B needs cohort_bucketing live on both sides).
     """
     import tempfile
 
@@ -1220,6 +1240,11 @@ def _config_block_ab(on_tpu: bool, key: str, arms: dict,
             data = (data_fn() if data_fn is not None else
                     _image_dataset(16, 60, (784,), 10,
                                    np.random.default_rng(0)))
+        if server_over:
+            for okey, oval in server_over.items():
+                cfg.server_config[okey] = (dict(oval)
+                                           if isinstance(oval, dict)
+                                           else oval)
         if block is not None:
             cfg.server_config[key] = dict(block)
         task = make_task(cfg.model_config)
@@ -1393,6 +1418,25 @@ def _hetero_image_dataset(pool, shape, classes, rng, min_samples=4,
     return ArraysDataset(users, per_user)
 
 
+def _bimodal_image_dataset(pool, shape, classes, rng, n_big=3,
+                           small=(30, 61), big=1500):
+    """Bimodal federated pool: nearly all users tiny (uniform over
+    ``small`` samples), ``n_big`` users at ``big`` samples.  Under
+    COARSE bucketing every tiny client pads to the big clients' step
+    count — the regime cross-client megabatching exists for: the tape
+    repacks the tiny clients' step-t batches into a few dense lanes
+    while the per-client vmap arm pays the full ``K x S_max`` grid."""
+    from msrflute_tpu.data import ArraysDataset
+    users, per_user = [], []
+    for u in range(pool):
+        n = big if u >= pool - n_big else int(rng.integers(*small))
+        x = rng.integers(0, 256, size=(n,) + shape, dtype=np.uint8)
+        y = rng.integers(0, classes, size=(n,)).astype(np.int32)
+        users.append(f"u{u:04d}")
+        per_user.append({"x": x, "y": y})
+    return ArraysDataset(users, per_user)
+
+
 def bench_cohort_bucketing_ab(on_tpu: bool) -> dict:
     """Monolithic vs bucketed A/B on a HETEROGENEOUS cohort (ISSUE 8
     acceptance): same protocol, same log-uniform client-size spread,
@@ -1453,12 +1497,109 @@ def bench_cohort_bucketing_ab(on_tpu: bool) -> dict:
         off / max(out["cohort_bucketing_on_secs_per_round"], 1e-9), 3)
     pe_off = out.get("cohort_bucketing_off_padding_efficiency")
     pe_on = out.get("cohort_bucketing_on_padding_efficiency")
-    if pe_off and pe_on:
-        out["padding_efficiency_gain"] = round(pe_on / pe_off, 3)
+    # `is not None`, not truthiness: a legitimately 0.0 efficiency arm
+    # (all-padding pathology) must still report its gain and FLOPs
+    # ratio, else the exact run that most needs the evidence drops it
+    if pe_off is not None and pe_on is not None:
+        out["padding_efficiency_gain"] = round(
+            pe_on / max(pe_off, 1e-9), 3)
         # FLOPs ratio == slots ratio at fixed per-step cost: padding
         # efficiency is real/slots with identical real work per arm
         out["flops_ratio_bucketed_vs_monolithic"] = round(
-            pe_off / pe_on, 3)
+            pe_off / max(pe_on, 1e-9), 3)
+    return out
+
+
+def bench_megabatch_ab(on_tpu: bool) -> dict:
+    """Cross-client megabatching A/B (ISSUE 16 acceptance): the SAME
+    heterogeneous protocol with cohort bucketing live in BOTH arms,
+    ``server_config.megabatch`` off vs on.  The pool is BIMODAL (most
+    clients tiny, a few huge) and bucketing deliberately COARSE
+    (``max_buckets: 1``) — the regime megabatch exists for: a wide
+    step-need spread inside one bucket means the per-client vmap arm
+    pays ``K_b * S_b`` slots while the tape pays only ``lanes *
+    depth``, fusing many small clients' step-t batches into one
+    device-saturating super-batch per scan step.  ``lanes`` is pinned
+    so the worst-case cohort fits one tape group — group membership
+    then matches the vmap arm and the finalize sum association is
+    unchanged.  Records per-arm steady-state s/round, padding
+    efficiency (tape-slot-aware: real samples / compute sample slots),
+    megabatch_utilization, mfu_p50 where the device-truth layer is
+    live, recompiles, the dispatch gate's chosen arm per bucket shape
+    — and pins EQUAL FINAL PARAMS across arms (bitwise on this f32
+    single-epoch protocol), so the speedup can never be bought with
+    different math."""
+    def data_fn():
+        if on_tpu:
+            return _bimodal_image_dataset(64, (28, 28, 1), 62,
+                                          np.random.default_rng(7),
+                                          n_big=3, small=(40, 81),
+                                          big=4800)
+        return _bimodal_image_dataset(48, (784,), 10,
+                                      np.random.default_rng(7),
+                                      n_big=3, small=(30, 61), big=1500)
+
+    flats = {}
+
+    def per_arm(server, arm):
+        import jax
+        from jax.flatten_util import ravel_pytree
+        flats[arm] = np.asarray(ravel_pytree(
+            jax.device_get(server.state.params))[0])
+        pad = getattr(server, "padding_efficiency", None)
+        util = (server.megabatch_utilization
+                if getattr(server, "megabatch", None) is not None
+                else None)
+        rounds = max(int(server.state.round), 1)
+        extra = {
+            "padding_efficiency": round(float(pad), 4)
+            if pad is not None else None,
+            "megabatch_utilization": round(float(util), 4)
+            if util is not None else None,
+            "recompiles": int(server.engine.recompile_count),
+            "compiled_programs": len(server.engine.compile_log),
+            "gate_arms": {f"K{k}_S{s}": a for (k, s), a in
+                          sorted(server.engine._mega_gate.items())},
+            # compute proxy: sample slots the round programs actually
+            # paid for (tape slots on taped buckets, grid slots else)
+            "compute_slots_per_round": int(server._pad_slots / rounds),
+        }
+        mfus = server.run_stats.get("mfuPerRound") or []
+        if mfus:
+            extra["mfu_p50"] = round(
+                float(np.percentile(mfus, 50)), 5)
+        return extra
+
+    # lanes=4 covers the worst-case cohort (3 big + 7 tiny clients) in
+    # ONE tape group, so the on-arm never splits the cohort differently
+    # from the vmap arm and final params stay bitwise-comparable
+    out = _config_block_ab(
+        on_tpu, "megabatch",
+        {"off": None, "on": {"enable": True, "lanes": 4}},
+        data_fn=data_fn,
+        protocol=("cnn_femnist_bimodal" if on_tpu else "lr_mnist_bimodal"),
+        per_arm=per_arm,
+        server_over={
+            # a wide cohort is the point: 24 clients x B rows per step in
+            # the vmap grid vs lanes x B in the tape
+            "num_clients_per_iteration": 24,
+            "cohort_bucketing": {
+                "enable": True, "max_buckets": 1, "slack": 1.25}})
+    off = out["megabatch_off_secs_per_round"]
+    out["speedup"] = round(
+        off / max(out["megabatch_on_secs_per_round"], 1e-9), 3)
+    pe_off = out.get("megabatch_off_padding_efficiency")
+    pe_on = out.get("megabatch_on_padding_efficiency")
+    if pe_off is not None and pe_on is not None:
+        out["padding_efficiency_gain"] = round(
+            pe_on / max(pe_off, 1e-9), 3)
+        out["flops_ratio_mega_vs_vmap"] = round(
+            pe_off / max(pe_on, 1e-9), 3)
+    if "off" in flats and "on" in flats:
+        out["final_params_max_abs_diff"] = float(
+            np.max(np.abs(flats["on"] - flats["off"])))
+        out["final_params_bitwise_equal"] = bool(
+            np.array_equal(flats["on"], flats["off"]))
     return out
 
 
@@ -1799,6 +1940,20 @@ def main() -> None:
                     bench_cohort_bucketing_ab(on_tpu)
         except Exception as exc:
             extras["cohort_bucketing_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # cross-client megabatching A/B on the same heterogeneous cohort:
+    # default-on for CPU runs (the super-batch acceptance evidence),
+    # env-gated on TPU like the others
+    if (not on_tpu or os.environ.get("BENCH_MEGABATCH_AB")) and \
+            (keep is None or "megabatch_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("megabatch_ab"):
+                extras["megabatch_ab"] = bench_megabatch_ab(on_tpu)
+        except Exception as exc:
+            extras["megabatch_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

@@ -49,7 +49,7 @@ from .core import Finding
 RULE = "guard-matrix"
 
 #: config blocks that require the fused round path at runtime
-GUARDED_BLOCKS = ("robust", "chaos", "cohort_bucketing")
+GUARDED_BLOCKS = ("robust", "chaos", "cohort_bucketing", "megabatch")
 
 #: the incompatibility vocabulary the matrix is checked over: config
 #: keys, strategy names and flags that appear in refusal messages and
@@ -58,11 +58,13 @@ GUARDED_BLOCKS = ("robust", "chaos", "cohort_bucketing")
 VOCAB = ("wantRL", "scaffold", "ef_quant", "personalization",
          "clients_per_chunk", "adaptive_clipping", "dump_norm_stats",
          "secure_agg", "input_staging", "fused_carry", "stale_prob",
-         "fedavg", "fedprox")
+         "fedavg", "fedprox",
+         # cross-client megabatching refusal tokens (PR 16)
+         "apply_metrics", "fedlabels", "pallas_apply")
 
 #: blocks whose strategy incompatibility is decidable at config load —
 #: schema.py must carry the bespoke check (the quiet-failure rule)
-SCHEMA_GUARDED = ("robust", "fedbuff")
+SCHEMA_GUARDED = ("robust", "fedbuff", "megabatch")
 
 #: class-attr suffix marking a strategy as host-orchestrated; every
 #: marker any strategy sets must appear in the predicate

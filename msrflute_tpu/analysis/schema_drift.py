@@ -61,6 +61,9 @@ _SPEC_MAP = {
     # fleet mode (PR 14); `sampling` is enum-typed and keeps its
     # bespoke check in validate()
     "FLEET_FIELD_SPECS": "FLEET_KEYS",
+    # cross-client megabatching (PR 16); the cohort_bucketing
+    # prerequisite is a cross-block rule and stays bespoke in validate()
+    "MEGABATCH_FIELD_SPECS": "MEGABATCH_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -103,6 +106,10 @@ DOCUMENTED_KNOBS = (
     # sampling drill will keep sizing HBM by population and believe
     # million-client runs are impossible
     "fleet",
+    # cross-client megabatching: an operator who cannot find the lane
+    # tuning drill will keep paying the padded [K, S] grid on every
+    # heterogeneous cohort a coarse bucket layout produces
+    "megabatch",
 )
 
 _DOC_MENTION_RE = re.compile(

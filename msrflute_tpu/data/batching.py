@@ -46,6 +46,11 @@ class RoundBatch:
     #: padding).  None outside paged-carry mode — the engine then uses
     #: ``client_ids`` for both, which is the resident-table program.
     carry_slots: Optional[np.ndarray] = None
+    #: cross-client megabatching (server_config.megabatch): the
+    #: super-batch pointer tape covering this grid, attached by the
+    #: server's bucket packer when the bucket's analytic gate holds.
+    #: None = per-client vmap arm only.
+    mega: Optional["MegaTape"] = None
 
     @property
     def shape(self):
@@ -118,6 +123,11 @@ def pack_round_batches(
     rng trail — and hence every client's sample order — is identical to
     what the monolithic pack would have drawn (the cross-mode
     bit-identity anchor, ``tests/test_cohort_bucketing.py``).
+
+    A ``-1`` entry in ``client_indices`` is an explicit PADDING HOLE:
+    the row packs as all-padding (mask 0, id -1) exactly like the tail
+    padding.  Megabatch grouping uses holes to shard-align rows with
+    the super-batch tape's lane blocks (``plan_megabatch``).
     """
     rng = rng or np.random.default_rng(0)
     K = len(client_indices)
@@ -127,8 +137,9 @@ def pack_round_batches(
 
     # an EMPTY client list still packs a valid all-padding grid (a
     # bucketed round dispatches every bucket at its static capacity,
-    # occupied or not) — dtypes come from user 0
-    ref = dataset.user_arrays(client_indices[0] if K else 0)
+    # occupied or not) — dtypes come from the first real user (or 0)
+    first_real = next((int(ci) for ci in client_indices if int(ci) >= 0), 0)
+    ref = dataset.user_arrays(first_real)
     arrays = {k: np.zeros((K_pad, S, B) + shape, dtype=ref[k].dtype)
               for k, shape in spec.items()}
     sample_mask = np.zeros((K_pad, S, B), dtype=np.float32)
@@ -139,6 +150,13 @@ def pack_round_batches(
     cap = _sample_cap(S, B, desired_max_samples)
     users, takes = [], []
     for j, ci in enumerate(client_indices):
+        if int(ci) < 0:
+            # hole row: keep users/takes aligned with grid row j so the
+            # parallel gather below writes nothing into it
+            users.append({k: np.zeros((0,) + shape, dtype=ref[k].dtype)
+                          for k, shape in spec.items()})
+            takes.append(np.zeros((0,), dtype=np.int64))
+            continue
         user = dataset.user_arrays(ci)
         n = len(next(iter(user.values())))
         if orders is not None:
@@ -188,6 +206,8 @@ class IndexRoundBatch:
     client_ids: np.ndarray
     #: see :class:`RoundBatch.carry_slots`
     carry_slots: Optional[np.ndarray] = None
+    #: see :class:`RoundBatch.mega`
+    mega: Optional["MegaTape"] = None
 
     @property
     def shape(self):
@@ -244,7 +264,7 @@ def pack_round_indices(
     consumption, so a pool-mode round is bit-comparable to a host-packed
     one), but the output is ``[K, S, B]`` int32 indices into the
     :func:`build_sample_pool` flat pool instead of gathered feature rows.
-    ``orders`` as in :func:`pack_round_batches`.
+    ``orders`` and ``-1`` padding holes as in :func:`pack_round_batches`.
     """
     rng = rng or np.random.default_rng(0)
     K = len(client_indices)
@@ -259,6 +279,8 @@ def pack_round_indices(
 
     cap = _sample_cap(S, B, desired_max_samples)
     for j, ci in enumerate(client_indices):
+        if int(ci) < 0:
+            continue
         n = int(dataset.num_samples[ci])
         if orders is not None:
             order = orders[ci]
@@ -464,6 +486,180 @@ def bucket_capacities(needs: Sequence[int], boundaries: Sequence[int],
         cap = max(min(want, int(cohort_size), max(pop_b, 1)), 1)
         caps.append(ceil_div(cap, quantum) * quantum)
     return caps
+
+
+# ----------------------------------------------------------------------
+# cross-client megabatching (server_config.megabatch): within one step
+# bucket, most clients need far fewer than S_b steps and a capacity-
+# padded grid burns whole client rows — the super-batch tape re-reads
+# the SAME [K_b, S_b, B, ...] grid through a [lanes, depth] pointer
+# tape instead: each lane concatenates many small clients' step
+# sequences back to back (segment ids mark the boundaries), so one
+# scan step trains `lanes` different clients' batches at once and idle
+# tape slots — not empty client rows — are the only padding.  Host
+# side: pure numpy first-fit planning over step needs; the device half
+# (the segment-carrying lane scan) lives in engine/client_update.py.
+# ----------------------------------------------------------------------
+@dataclass
+class MegaTape:
+    """Super-batch pointer tape for ONE bucket grid.
+
+    ptr: ``[lanes, depth]`` int32 — flat SHARD-LOCAL grid step index
+         ``row * S + step`` each tape slot trains on (0 for idle slots);
+    seg: ``[lanes, depth]`` int32 — shard-local grid row (segment id /
+         output slot) owning the slot, -1 for idle padding.
+
+    A client occupies ``num_epochs * need`` CONSECUTIVE slots of one
+    lane (pointers repeat per epoch — no feature duplication), entirely
+    inside its mesh shard's lane block, so the engine's lane scan can
+    reset params/optimizer/rng at segment starts and harvest at ends
+    with shard-local gathers only.
+    """
+
+    ptr: np.ndarray
+    seg: np.ndarray
+    lanes: int
+    depth: int
+    shards: int
+    #: real (non-idle) tape slots — numerator feed for the
+    #: megabatch_utilization meter
+    entries: int
+
+
+def megabatch_lanes(needs: Sequence[int], boundaries: Sequence[int],
+                    cohort_size: int, num_epochs: int,
+                    quantum: int = 1, slack: float = 1.25,
+                    lanes: Optional[int] = None,
+                    caps: Optional[Sequence[int]] = None) -> list:
+    """Static per-bucket lane counts from the POPULATION mix (the
+    megabatch analogue of :func:`bucket_capacities`): expected tape
+    entries of a ``cohort_size`` draw landing in each bucket, with
+    ``slack`` headroom, divided by the bucket's tape depth
+    (``num_epochs * S_b``), rounded up to ``quantum`` (mesh
+    divisibility).  An explicit ``lanes`` overrides every bucket.
+    ``caps`` (the bucket client capacities) clamps from above —
+    ``lanes == K_b`` is the break-even where the tape holds as many
+    padded slots as the per-client grid it replaces."""
+    bounds = list(boundaries)
+    E = max(int(num_epochs), 1)
+    quantum = max(int(quantum), 1)
+    if lanes is not None:
+        out = [ceil_div(int(lanes), quantum) * quantum for _ in bounds]
+    else:
+        arr = np.maximum(np.asarray(needs, dtype=np.int64), 1)
+        b_arr = np.asarray(bounds, dtype=np.int64)
+        fit = np.searchsorted(b_arr, arr)
+        keep = fit < len(bounds)
+        fit_k, arr_k = fit[keep], arr[keep]
+        total = max(int(keep.sum()), 1)
+        out = []
+        for i, s in enumerate(bounds):
+            need_sum = float(arr_k[fit_k == i].sum())
+            # expected entries = pop fraction x cohort x mean need x E
+            exp_entries = slack * cohort_size * need_sum * E / total
+            want = max(int(math.ceil(exp_entries / float(E * int(s)))), 1)
+            out.append(ceil_div(want, quantum) * quantum)
+    if caps is not None:
+        out = [min(l, ceil_div(int(c), quantum) * quantum)
+               for l, c in zip(out, caps)]
+    return [max(l, quantum) for l in out]
+
+
+def plan_megabatch(needs: Sequence[int], num_epochs: int, lanes: int,
+                   step_grid: int, shards: int, capacity: int) -> list:
+    """First-fit super-batch planning for one bucket's cohort.
+
+    ``needs[j]``: step need of the bucket's j-th client (cohort order);
+    the tape depth is ``num_epochs * step_grid``.  Returns a list of
+    ``(rows, tape)`` groups: ``rows`` is a length-``capacity`` list of
+    cohort positions with ``-1`` padding holes (feed it through the
+    hole-aware packers), ``tape`` the matching :class:`MegaTape`.
+
+    Shard locality: grid row block ``[m*K/M, (m+1)*K/M)`` and lane
+    block ``[m*L/M, (m+1)*L/M)`` belong to mesh shard ``m``; a client's
+    slots land in the same shard as its grid row, so the engine's
+    shard_map lane scan never gathers across shards.  A cohort that
+    exceeds one group's rows or lane capacity spills into EXTRA GROUPS
+    OF THE SAME SHAPE — the compiled-variant set stays one program per
+    bucket, same discipline as top-bucket overflow.  Deterministic in
+    (needs, geometry)."""
+    M = max(int(shards), 1)
+    L, S, E = int(lanes), int(step_grid), max(int(num_epochs), 1)
+    cap = int(capacity)
+    if L % M or cap % M:
+        raise ValueError(
+            f"megabatch geometry must be mesh-divisible: lanes={L}, "
+            f"capacity={cap}, shards={M}")
+    depth = E * S
+    L_loc, K_loc = L // M, cap // M
+    groups: list = []
+
+    def _new_group():
+        groups.append({
+            "rows": [[] for _ in range(M)],          # per-shard positions
+            "fill": np.zeros((L,), dtype=np.int64),  # per-lane used depth
+            "ptr": np.zeros((L, depth), dtype=np.int32),
+            "seg": np.full((L, depth), -1, dtype=np.int32),
+            "entries": 0,
+        })
+
+    for pos, need in enumerate(needs):
+        e = E * max(int(need), 1)
+        if e > depth:
+            raise ValueError(
+                f"megabatch: client step need {need} exceeds the bucket "
+                f"grid S={S} — bucket assignment must cover every need")
+        placed = False
+        for g in groups:
+            for m in range(M):
+                if len(g["rows"][m]) >= K_loc:
+                    continue
+                lanes_m = range(m * L_loc, (m + 1) * L_loc)
+                lane = next((l for l in lanes_m
+                             if int(g["fill"][l]) + e <= depth), None)
+                if lane is None:
+                    continue
+                r = len(g["rows"][m])      # shard-local grid row
+                o = int(g["fill"][lane])
+                j = np.arange(e)
+                g["ptr"][lane, o:o + e] = r * S + (j % max(int(need), 1))
+                g["seg"][lane, o:o + e] = r
+                g["fill"][lane] += e
+                g["rows"][m].append(pos)
+                g["entries"] += e
+                placed = True
+                break
+            if placed:
+                break
+        if not placed:
+            _new_group()
+            g = groups[-1]
+            m = 0
+            lane = 0
+            g["ptr"][lane, :e] = 0 * S + (np.arange(e) % max(int(need), 1))
+            g["seg"][lane, :e] = 0
+            g["fill"][lane] = e
+            g["rows"][m].append(pos)
+            g["entries"] = e
+
+    if not groups:
+        _new_group()
+    out = []
+    for g in groups:
+        rows: list = []
+        for m in range(M):
+            block = list(g["rows"][m])
+            rows.extend(block + [-1] * (K_loc - len(block)))
+        out.append((rows, MegaTape(g["ptr"], g["seg"], L, depth, M,
+                                   int(g["entries"]))))
+    return out
+
+
+def megabatch_slots(tapes: Sequence[MegaTape], batch_size: int) -> int:
+    """Total super-batch sample slots (``lanes * depth * B`` summed) —
+    the denominator of the megabatch_utilization meter."""
+    return sum(int(t.lanes) * int(t.depth) * int(batch_size)
+               for t in tapes)
 
 
 def grid_slots(batches: Sequence) -> int:

@@ -46,7 +46,7 @@ import optax
 
 from ..models.base import BaseTask
 from ..optim import make_optimizer
-from ..optim.fused import (combine_grad_terms, fused_apply,
+from ..optim.fused import (combine_grad_terms, fused_apply, segment_select,
                            sgd_pallas_fusable)
 
 
@@ -192,27 +192,6 @@ def build_client_update(task: BaseTask, client_opt_cfg,
             "freeze mask — drop one of them")
     sgd_mu = float(client_opt_cfg.get("momentum", 0.0) or 0.0)
 
-    def _updatable_mask(params):
-        """Per-leaf PYTHON bools from the updatable_layers regex allowlist
-        (names are '.'-joined like torch's named_parameters; patterns are
-        start-anchored via re.match, matching the reference).  Static at
-        trace time, so frozen updates compile to nothing."""
-        import logging
-        import re
-
-        from ..utils.logging import print_rank
-        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-        keeps = []
-        for path, leaf in flat:
-            name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                            for p in path)
-            keep = any(re.match(pat, name)
-                       for pat in hparams.updatable_layers)
-            print_rank(("updating " if keep else "freezing ") + name,
-                       loglevel=logging.DEBUG)
-            keeps.append(bool(keep))
-        return jax.tree_util.tree_unflatten(treedef, keeps)
-
     def client_update(global_params, arrays: Dict[str, jnp.ndarray],
                       sample_mask: jnp.ndarray, lr: jnp.ndarray,
                       rng: jax.Array, grad_offset=None):
@@ -232,7 +211,8 @@ def build_client_update(task: BaseTask, client_opt_cfg,
         else:
             opt_state = tx.init(local_params)
             opt_state.hyperparams["learning_rate"] = lr
-        update_mask = (_updatable_mask(global_params)
+        update_mask = (_updatable_mask(global_params,
+                                       hparams.updatable_layers)
                        if hparams.updatable_layers is not None else None)
 
         def one_step(carry, xs):
@@ -355,6 +335,275 @@ def build_client_update(task: BaseTask, client_opt_cfg,
         return pseudo_grad, loss_sum, num_samples, stats
 
     return client_update
+
+
+def build_mega_update(task: BaseTask, client_opt_cfg,
+                      hparams: ClientHParams) -> Callable:
+    """Cross-client megabatch lane scan (``server_config.megabatch``).
+
+    Returns ``mega_update(global_params, arrays, sample_mask, client_ids,
+    ptr, seg, lr, rng, init_rows=None, offset_rows=None, rng_salt=None)``
+    -> the SAME per-row outputs as ``vmap(client_update)`` over the grid:
+    ``(pseudo_grad [K,...], train_loss [K], num_samples [K], stats {[K]})``.
+
+    Geometry: ``arrays``/``sample_mask`` are the bucket's shard-local
+    ``[K, S, B, ...]`` grids; ``ptr``/``seg`` the ``[L, T]`` pointer tape
+    from :func:`..data.batching.plan_megabatch`.  Instead of one vmap
+    lane per client (K lanes, most steps padding), the scan runs ``L``
+    lanes for ``T`` steps and every lane trains a CONCATENATION of small
+    clients: at a slot whose segment id changes, the lane resets params /
+    optimizer / rng / accumulators to the fresh client state
+    (:func:`..optim.fused.segment_select`); at a segment's last slot the
+    finished client's outputs scatter into its grid row of the output
+    stacks.  Per-step math is ``one_step`` verbatim — same fused grad
+    combine, same accumulator order, same no-op pinning — so each
+    client's update is computed from exactly its own samples.
+
+    rng identity contract (tests/test_megabatch.py): the per-client rng
+    still folds on TRUE client ids, but the lane stream is COMPACT — it
+    splits only on the client's real steps, while the vmap arm also
+    splits on the grid's padded tail steps.  For ``num_epochs == 1`` the
+    real steps consume the identical split prefix, so f32 results are
+    BITWISE equal; for ``num_epochs > 1`` the streams diverge from epoch
+    2 onward and rng-consuming losses (dropout) are only equal to
+    MEGABATCH_FINAL_LOSS_RTOL — rng-free losses stay bitwise.
+
+    Strategy hooks (``BaseStrategy.megabatch_passes``): ``init_rows``
+    (``[K, n_flat]``) replaces the global start/anchor per client —
+    FedBuff's stale history rows, personalization's local models;
+    ``offset_rows`` is SCAFFOLD's flattened ``c - c_i`` drift correction;
+    ``rng_salt`` reproduces a strategy's ``fold_in(rng_c, salt)``
+    sub-stream.  Padding rows (``seg`` never points at them) come back
+    with the exact values the vmap arm produces for masked-out rows.
+    """
+    tx = make_optimizer(client_opt_cfg)
+    freeze = hparams.freeze_layers
+    loss_fn = task.loss
+    pdt = _resolve_dtype(hparams.param_dtype)
+    cdt = _resolve_dtype(hparams.compute_dtype)
+    sdt = _resolve_dtype(hparams.stats_dtype) or jnp.float32
+    if cdt is not None:
+        base_loss = loss_fn
+
+        def loss_fn(p, batch, rng, train):  # noqa: F811 - deliberate wrap
+            return base_loss(_cast_floats(p, cdt),
+                             {k: _cast_floats(v, cdt)
+                              for k, v in batch.items()}, rng, train)
+
+    if hparams.pallas_apply:
+        # engine/round.py refuses this combination up front; the raise
+        # here keeps the builder safe standalone
+        raise ValueError(
+            "server_config.megabatch is incompatible with "
+            "megakernel.pallas_apply: the flat fused kernel has no "
+            "segment-reset lane — drop one of them")
+    E = max(int(hparams.num_epochs), 1)
+
+    def mega_update(global_params, arrays: Dict[str, jnp.ndarray],
+                    sample_mask: jnp.ndarray, client_ids: jnp.ndarray,
+                    ptr: jnp.ndarray, seg: jnp.ndarray, lr: jnp.ndarray,
+                    rng: jax.Array, init_rows=None, offset_rows=None,
+                    rng_salt=None):
+        from jax.flatten_util import ravel_pytree
+        K, S = int(sample_mask.shape[0]), int(sample_mask.shape[1])
+        L = int(ptr.shape[0])
+        _, unravel = ravel_pytree(global_params)
+        update_mask = (_updatable_mask(global_params,
+                                       hparams.updatable_layers)
+                       if hparams.updatable_layers is not None else None)
+
+        # flatten [K, S, ...] -> [K*S, ...]: a tape pointer is the
+        # shard-local flat step index row*S + step, so each lane's batch
+        # is ONE dynamic row gather out of the resident grids
+        arrays_flat = {k: a.reshape((K * S,) + a.shape[2:])
+                       for k, a in arrays.items()}
+        mask_flat = sample_mask.reshape((K * S,) + sample_mask.shape[2:])
+
+        def _fresh(seg_t):
+            """(anchor, local-params) of the segment's client — the
+            anchor is what prox/pseudo-grad measure against (the global,
+            or the strategy's per-client start row)."""
+            if init_rows is None:
+                anchor = global_params
+            else:
+                anchor = unravel(init_rows[jnp.clip(seg_t, 0, K - 1)])
+            lp = (jax.tree.map(lambda w: w.astype(pdt), anchor)
+                  if pdt is not None else anchor)
+            return anchor, lp
+
+        def _fresh_rng(seg_t):
+            cid = client_ids[jnp.clip(seg_t, 0, K - 1)]
+            r = jax.random.fold_in(rng, cid)
+            if rng_salt is not None:
+                r = jax.random.fold_in(r, int(rng_salt))
+            return r
+
+        def lane_step(carry, xs):
+            """ONE tape slot of ONE lane (vmapped over lanes).  Body is
+            ``one_step`` with the segment reset in front and the harvest
+            candidate behind."""
+            (params, opt_state, rng_l, loss_sum, s, s2, n_acc, wloss_acc,
+             ns_acc, rows_acc) = carry
+            ptr_t, seg_t, start_t, _end_t = xs
+            live = seg_t >= 0
+
+            # --- segment start: this slot begins a NEW client
+            anchor, fresh_lp = _fresh(seg_t)
+            fresh_opt = tx.init(fresh_lp)
+            fresh_opt.hyperparams["learning_rate"] = lr
+            params = segment_select(start_t, fresh_lp, params)
+            opt_state = segment_select(start_t, fresh_opt, opt_state)
+            rng_l = jnp.where(start_t, _fresh_rng(seg_t), rng_l)
+            zero = jnp.zeros((), sdt)
+            loss_sum, s, s2, n_acc, wloss_acc, ns_acc, rows_acc = (
+                jnp.where(start_t, zero, v)
+                for v in (loss_sum, s, s2, n_acc, wloss_acc, ns_acc,
+                          rows_acc))
+
+            # --- one_step verbatim on the gathered batch
+            batch = {k: a[ptr_t] for k, a in arrays_flat.items()}
+            mask = jnp.where(live, mask_flat[ptr_t],
+                             jnp.zeros_like(mask_flat[ptr_t]))
+            batch["sample_mask"] = mask
+            off = (None if offset_rows is None else
+                   unravel(offset_rows[jnp.clip(seg_t, 0, K - 1)]))
+            rng_l, sub = jax.random.split(rng_l)
+            (loss, _aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, sub, True)
+            grads = combine_grad_terms(
+                grads, offset=off, prox_mu=hparams.fedprox_mu,
+                params=params, global_params=anchor,
+                max_norm=hparams.max_grad_norm)
+            has_data = (jnp.sum(mask) > 0).astype(jnp.float32)
+            ds, ds2, dn = _suff_stats_of(grads)
+            s = (s + has_data * ds).astype(sdt)
+            s2 = (s2 + has_data * ds2).astype(sdt)
+            n_acc = (n_acc + has_data * dn).astype(sdt)
+            loss_sum = (loss_sum + has_data * loss).astype(sdt)
+            wloss_acc = (wloss_acc + loss * jnp.sum(mask)).astype(sdt)
+            ns_acc = (ns_acc + has_data * _aux.get(
+                "train_sample_count", jnp.sum(mask))).astype(sdt)
+            # mask rows are 0/1 so the stepwise sum is exact in f32 —
+            # rows_acc lands on rows * num_epochs bitwise, the vmap
+            # arm's mean_sample_loss denominator
+            rows_acc = (rows_acc + jnp.sum(mask)).astype(sdt)
+            params, opt_state = fused_apply(
+                tx, grads, opt_state, params,
+                update_mask=update_mask, has_data=has_data)
+
+            # --- harvest candidate (scattered only at segment ends)
+            pg = jax.tree.map(lambda w0, w: w0 - w, anchor, params)
+            if freeze:
+                pg = _freeze_layers(pg, freeze)
+            if hparams.stats_on_smooth_grad:
+                hs, hs2, hn = _suff_stats_of(pg)
+                stats = _derive_stats(hs, hs2, hn)
+            else:
+                stats = _derive_stats(s, s2, n_acc)
+            stats["mean_sample_loss"] = wloss_acc / jnp.maximum(
+                rows_acc, 1.0)
+            num_samples = ns_acc / jnp.maximum(E, 1)
+            new_carry = (params, opt_state, rng_l, loss_sum, s, s2,
+                         n_acc, wloss_acc, ns_acc, rows_acc)
+            return new_carry, (pg, loss_sum, num_samples, stats)
+
+        def scan_body(carry, xs):
+            lane_carry, (pg_stack, tl_stack, ns_stack, stats_stack) = carry
+            ptr_t, seg_t, start_t, end_t = xs
+            new_lane_carry, cand = jax.vmap(lane_step)(
+                lane_carry, (ptr_t, seg_t, start_t, end_t))
+            # each finished segment owns exactly one grid row, so the
+            # lane->row scatter has unique in-bounds targets; idle/non-
+            # end lanes aim at row K and drop
+            idx = jnp.where(end_t & (seg_t >= 0), seg_t, K)
+            pg_stack = jax.tree.map(
+                lambda o, v: o.at[idx].set(v, mode="drop"),
+                pg_stack, cand[0])
+            tl_stack = tl_stack.at[idx].set(cand[1], mode="drop")
+            ns_stack = ns_stack.at[idx].set(cand[2], mode="drop")
+            stats_stack = jax.tree.map(
+                lambda o, v: o.at[idx].set(v, mode="drop"),
+                stats_stack, cand[3])
+            return (new_lane_carry,
+                    (pg_stack, tl_stack, ns_stack, stats_stack)), None
+
+        # --- segment boundaries, derived from the tape in-trace
+        ptr_T, seg_T = ptr.T, seg.T                      # [T, L]
+        fence = jnp.full((1, L), -2, seg.dtype)
+        start_T = seg_T != jnp.concatenate([fence, seg_T[:-1]])
+        end_T = seg_T != jnp.concatenate([seg_T[1:], fence])
+
+        # --- output stacks start at the vmap arm's PADDING-ROW values,
+        # so rows no segment ends on (client_mask == 0 rows) come back
+        # identical to a grid row that ran all-masked steps
+        def _pg0_of(tree):
+            if pdt is None:
+                out = jax.tree.map(jnp.zeros_like, tree)
+            else:
+                out = jax.tree.map(lambda w: w - w.astype(pdt), tree)
+            return _freeze_layers(out, freeze) if freeze else out
+
+        if init_rows is None:
+            pg0_one = _pg0_of(global_params)
+            pg0 = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (K,) + x.shape),
+                pg0_one)
+        else:
+            pg0 = jax.vmap(lambda r: _pg0_of(unravel(r)))(init_rows)
+        if hparams.stats_on_smooth_grad:
+            stats0 = jax.vmap(
+                lambda t: _derive_stats(*_suff_stats_of(t)))(pg0)
+        else:
+            z_k = jnp.zeros((K,), sdt)
+            stats0 = _derive_stats(z_k, z_k, z_k)
+        stats0 = dict(stats0)
+        stats0["mean_sample_loss"] = jnp.zeros((K,), sdt)
+        tl0 = jnp.zeros((K,), sdt)
+        ns0 = jnp.zeros((K,), sdt)
+
+        # --- initial lane carry (slot 0 always starts a segment, so
+        # these are reset before any math touches them)
+        lp0_one = (jax.tree.map(lambda w: w.astype(pdt), global_params)
+                   if pdt is not None else global_params)
+        opt0_one = tx.init(lp0_one)
+        opt0_one.hyperparams["learning_rate"] = lr
+        bcast = lambda x: jnp.broadcast_to(  # noqa: E731
+            jnp.asarray(x)[None], (L,) + jnp.asarray(x).shape)
+        lane_params0 = jax.tree.map(bcast, lp0_one)
+        lane_opt0 = jax.tree.map(bcast, opt0_one)
+        rng0 = bcast(rng)
+        z_l = jnp.zeros((L,), sdt)
+        lane_carry0 = (lane_params0, lane_opt0, rng0, z_l, z_l, z_l, z_l,
+                       z_l, z_l, z_l)
+
+        (_, outs), _ = jax.lax.scan(
+            scan_body, (lane_carry0, (pg0, tl0, ns0, stats0)),
+            (ptr_T, seg_T, start_T, end_T))
+        return outs
+
+    return mega_update
+
+
+def _updatable_mask(params, patterns) -> Any:
+    """Per-leaf PYTHON bools from the updatable_layers regex allowlist
+    (names are '.'-joined like torch's named_parameters; patterns are
+    start-anchored via re.match, matching the reference).  Static at
+    trace time, so frozen updates compile to nothing.  Shared by the
+    per-client and megabatch update builders."""
+    import logging
+    import re
+
+    from ..utils.logging import print_rank
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keeps = []
+    for path, leaf in flat:
+        name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        keep = any(re.match(pat, name) for pat in patterns)
+        print_rank(("updating " if keep else "freezing ") + name,
+                   loglevel=logging.DEBUG)
+        keeps.append(bool(keep))
+    return jax.tree_util.tree_unflatten(treedef, keeps)
 
 
 def _freeze_layers(tree: Any, freeze: Tuple[str, ...]) -> Any:

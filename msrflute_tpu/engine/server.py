@@ -452,6 +452,36 @@ class OptimizationServer:
                 f"client capacities {caps} (population max need "
                 f"{max_need}, monolithic S {self.max_steps})")
 
+        # cross-client megabatching (server_config.megabatch): static
+        # per-bucket LANE counts from the same population histogram the
+        # capacities came from — per-round tape planning happens in
+        # _pack_bucketed_round, the segment-carrying lane scan in the
+        # engine.  The engine __init__ already refused every
+        # incompatible config (missing cohort_bucketing, privacy
+        # metrics, pallas_apply, fedlabels), so this block only sizes
+        # geometry when the cohort block is live.
+        self.megabatch = None
+        self._mega_slots = 0.0
+        self._mega_real = 0.0
+        _mgb = sc.get("megabatch") or {}
+        if _mgb and _mgb.get("enable", True) and \
+                self.cohort_bucketing is not None:
+            from ..data.batching import megabatch_lanes
+            _mgb_E = max(int(cc.get("num_epochs", 1) or 1), 1)
+            mgb_lanes = megabatch_lanes(
+                self._step_needs, bounds, cohort_hi, _mgb_E,
+                quantum=self.mesh.shape[CLIENTS_AXIS],
+                slack=float(_mgb.get("slack", 1.25) or 1.25),
+                lanes=_mgb.get("lanes"), caps=caps)
+            self.megabatch = {
+                "lanes": mgb_lanes, "epochs": _mgb_E,
+                "min_gain": float(_mgb.get("min_gain", 0.1) or 0.0),
+            }
+            print_rank(
+                f"megabatch on: per-bucket lanes {mgb_lanes} over step "
+                f"buckets {bounds} (tape depth = {_mgb_E} x S_b, "
+                f"min_gain {self.megabatch['min_gain']})")
+
         # device-resident dataset (data_config.train.device_resident): the
         # whole sample pool lives in HBM; rounds ship [K,S,B] int32 indices
         # and the row gather runs inside the compiled round program.
@@ -1438,6 +1468,14 @@ class OptimizationServer:
                     fleet_gauges[f"lazy_cache_{key}"] = cs[key]
                     self.scope.devbus_host(f"lazy_cache_{key}", cs[key],
                                            step=round0 + R - 1)
+            mgb_util = (self.megabatch_utilization
+                        if self.megabatch is not None else None)
+            if mgb_util is not None:
+                # live tape occupancy for `scope watch`/rollups; absent
+                # (not 0.0) until a bucket actually attached a tape
+                fleet_gauges["megabatch_utilization"] = mgb_util
+                self.scope.devbus_host("megabatch_utilization",
+                                       mgb_util, step=round0 + R - 1)
             if fleet_gauges and self.scope.rollup is not None:
                 self.scope.rollup.update_gauges(fleet_gauges)
             # watchdogs run over values this tail ALREADY holds: the
@@ -1590,6 +1628,11 @@ class OptimizationServer:
         from ..ops.pallas_attention import drain_attention_events
         for ev in drain_attention_events():
             self.scope.event(ev.pop("kind"), **ev)
+        # megabatch dispatch-gate fallbacks (engine-buffered: the
+        # server's analytic slots gate and the aot_cost shootout both
+        # push here) — same loud-fallback surface as the attention gate
+        for ev in self.engine.drain_megabatch_events():
+            self.scope.event(ev.pop("kind"), **ev)
         reg = self.engine.xla
         if reg is None:
             return
@@ -1698,6 +1741,21 @@ class OptimizationServer:
                 "bucket_grid_variants":
                     len(self.engine.bucket_shapes_seen),
             }
+        if self.megabatch is not None:
+            util = self.megabatch_utilization
+            card["megabatch"] = {
+                "lanes": [int(l) for l in self.megabatch["lanes"]],
+                "utilization": (round(util, 6)
+                                if util is not None else None),
+                # dispatch gate's chosen arm per compiled bucket shape
+                # ("mega" | "vmap") — the regression surface for a
+                # silently-fallen-back bucket
+                "gate_arms": {f"K{k}_S{s}": arm for (k, s), arm in
+                              sorted(self.engine._mega_gate.items())},
+            }
+            # flat copy for the `scope diff --gate` lower_frac rule
+            card["megabatch_utilization"] = \
+                card["megabatch"]["utilization"]
         reg = self.engine.xla
         if reg is not None:
             card["entry_points"] = reg.summary()
@@ -1780,7 +1838,8 @@ class OptimizationServer:
                       int(self.train_dataset.num_samples[ci]))
                   for ci in sampled}
         out = []
-        for (s_b, positions), cap in zip(assignment.items(), caps):
+        for bi, ((s_b, positions), cap) in enumerate(
+                zip(assignment.items(), caps)):
             ids = [sampled[p] for p in positions]
             cap = int(cap)
             # TOP-bucket overflow (sampling variance beyond the slack)
@@ -1790,20 +1849,58 @@ class OptimizationServer:
             # its signature) retraces, once per new grid count
             groups = ([ids] if len(ids) <= cap else
                       [ids[i:i + cap] for i in range(0, len(ids), cap)])
-            for g in groups:
+            tapes = None
+            if self.megabatch is not None and ids:
+                from ..data.batching import plan_megabatch
+                L = int(self.megabatch["lanes"][bi])
+                E = int(self.megabatch["epochs"])
+                plan = plan_megabatch(
+                    [needs[p] for p in positions], E, L, int(s_b),
+                    self.mesh.shape[CLIENTS_AXIS], cap)
+                # analytic slots gate: per lane-scan step the tape
+                # trains L lanes for depth=E*S steps vs the per-client
+                # grid's cap rows for S steps x E epochs — compute
+                # ratio reduces to groups*L vs groups*cap.  The tape
+                # must win by min_gain or the bucket falls back LOUDLY
+                # to the vmap arm (buffered megabatch_fallback event,
+                # the flash-vs-dense discipline)
+                gain = 1.0 + float(self.megabatch["min_gain"])
+                if len(plan) * L * gain <= len(groups) * cap:
+                    # planned row order (shard-local blocks, -1 holes)
+                    # replaces the plain cohort split; the hole-aware
+                    # packers keep grid rows aligned to the tape's
+                    # segment ids
+                    groups = [[ids[j] if j >= 0 else -1 for j in rows]
+                              for rows, _ in plan]
+                    tapes = [t for _, t in plan]
+                else:
+                    self.engine.push_megabatch_event({
+                        "kind": "megabatch_fallback", "reason": "slots",
+                        "bucket_steps": int(s_b), "clients": len(ids),
+                        "lanes": L, "tape_groups": len(plan),
+                        "grid_groups": len(groups)})
+            for gi, g in enumerate(groups):
                 if self._pool_offsets is not None:
                     from ..data.batching import pack_round_indices
-                    out.append(pack_round_indices(
+                    b = pack_round_indices(
                         self.train_dataset, self._pool_offsets, g,
                         self.batch_size, s_b, rng=self._np_rng,
                         pad_clients_to=cap, orders=orders,
-                        desired_max_samples=self.desired_max_samples))
+                        desired_max_samples=self.desired_max_samples)
                 else:
-                    out.append(pack_round_batches(
+                    b = pack_round_batches(
                         self.train_dataset, g, self.batch_size, s_b,
                         rng=self._np_rng, pad_clients_to=cap,
                         orders=orders,
-                        desired_max_samples=self.desired_max_samples))
+                        desired_max_samples=self.desired_max_samples)
+                if tapes is not None:
+                    t = tapes[gi]
+                    b.mega = t
+                    self._mega_slots += float(
+                        t.lanes * t.depth * self.batch_size)
+                    self._mega_real += float(
+                        t.entries * self.batch_size)
+                out.append(b)
         return out
 
     def _record_padding_efficiency(self, batches_flat: list) -> None:
@@ -1813,13 +1910,35 @@ class OptimizationServer:
         ``run_stats`` for observability; the GATED number is the
         run-total ratio (:attr:`padding_efficiency`) — slots-weighted,
         i.e. FLOPs-weighted, so cheap small-cohort chunks cannot mask
-        waste on the expensive ones."""
+        waste on the expensive ones.
+
+        Megabatch grids count their TAPE slots (``lanes * depth * B``,
+        per-epoch-normalized to match the grid convention) instead of
+        the ``K*S*B`` grid the tape re-reads — the lane scan's compute
+        is the tape, so the meter keeps meaning "real samples / sample
+        slots the round actually paid for"."""
         from ..data.batching import grid_slots, padding_efficiency
+        if self.megabatch is None:
+            self.run_stats["paddingEfficiency"].append(
+                padding_efficiency(batches_flat))
+            self._pad_slots += grid_slots(batches_flat)
+            self._pad_real += float(sum(np.sum(b.num_samples)
+                                        for b in batches_flat))
+            return
+        E = max(int(self.megabatch["epochs"]), 1)
+        slots = 0.0
+        for b in batches_flat:
+            t = getattr(b, "mega", None)
+            if t is None:
+                slots += grid_slots([b])
+            else:
+                slots += (float(t.lanes * t.depth)
+                          * int(b.sample_mask.shape[2]) / E)
+        real = float(sum(np.sum(b.num_samples) for b in batches_flat))
         self.run_stats["paddingEfficiency"].append(
-            padding_efficiency(batches_flat))
-        self._pad_slots += grid_slots(batches_flat)
-        self._pad_real += float(sum(np.sum(b.num_samples)
-                                    for b in batches_flat))
+            real / max(slots, 1.0))
+        self._pad_slots += slots
+        self._pad_real += real
 
     @property
     def padding_efficiency(self) -> Optional[float]:
@@ -1828,6 +1947,16 @@ class OptimizationServer:
         if not self._pad_slots:
             return None
         return self._pad_real / self._pad_slots
+
+    @property
+    def megabatch_utilization(self) -> Optional[float]:
+        """Run-total real tape entries / super-batch slots (1.0 = every
+        lane-scan step trains a real client batch; idle tape padding is
+        the complement).  None before any bucket attached a tape —
+        distinct from 0.0, so diff gates skip non-megabatch arms."""
+        if not self._mega_slots:
+            return None
+        return self._mega_real / self._mega_slots
 
     # ------------------------------------------------------------------
     def _chunk_steps(self, chunk_samples: list) -> int:

@@ -88,6 +88,20 @@ def fused_apply(tx: optax.GradientTransformation, grads: Any,
     return new_params, new_opt
 
 
+def segment_select(pred: Any, fresh: Any, carried: Any) -> Any:
+    """Tree-wise ``where(pred, fresh, carried)`` — the cross-client
+    megabatch lane scan's SEGMENT-RESET primitive (engine/client_update.
+    build_mega_update).  At a tape slot whose segment id differs from the
+    previous slot's, the lane is starting a NEW client: params, optimizer
+    state, rng, and loss/stat accumulators all select the fresh client
+    values in one spelling.  ``pred`` is a scalar (per lane under vmap),
+    so every leaf compiles to a broadcast select — the grouped analogue
+    of :func:`fused_apply`'s no-op pin, and like it the select is the
+    LAST op on each leaf, keeping the f32 segment math bit-identical to
+    a per-client trace that never selects."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), fresh, carried)
+
+
 def sgd_pallas_fusable(opt_cfg: Any) -> bool:
     """True when the client optimizer is the plain-SGD shape the pallas
     fused apply kernel implements: ``type: sgd``, no nesterov, no weight

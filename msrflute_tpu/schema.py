@@ -151,6 +151,32 @@ COHORT_BUCKETING_FIELD_SPECS = {
     # the scalar spec table cannot express
 }
 
+MEGABATCH_KEYS = {
+    "enable", "lanes", "slack", "min_gain", "autotune",
+}
+
+MEGABATCH_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    # explicit lane count applied to EVERY bucket's super-batch tape
+    # (power users / A-Bs); absent = auto-sized per bucket from the
+    # population's expected tape occupancy
+    "lanes": ("int", 1, None),
+    # lane-capacity headroom over the expected per-round tape entries:
+    # lower = tighter tapes (better utilization) but more same-shape
+    # overflow grids when sampling runs hot
+    "slack": ("num", 1.0, None),
+    # analytic-gate margin: the megabatch arm must price at least this
+    # fraction cheaper (in padded sample slots) than per-client vmap
+    # before a bucket repacks — covers the per-step gather/reset
+    # overhead the slot count cannot see
+    "min_gain": ("num", 0.0, None),
+    # price both arms with telemetry.xla aot cost analyses at first
+    # dispatch (when the xla introspector is on) instead of trusting
+    # the slot heuristic; the loser falls back loudly
+    # (`megabatch_fallback` instant event)
+    "autotune": ("bool", None, None),
+}
+
 FLEET_KEYS = {
     "enable", "page_pool_slots", "host_cache_rows", "spill_freq",
     "sampling", "prefetch",
@@ -399,6 +425,13 @@ SERVER_KEYS = {
     # per-client updates stay bit-identical to the monolithic grid
     # (docs/config_extensions.md, RUNBOOK "Tuning cohort buckets")
     "cohort_bucketing",
+    # cross-client megabatching: within each step bucket, repack many
+    # small clients' batches into device-saturating super-batch lanes
+    # (a segment-carrying scan replaces the per-client vmap when the
+    # per-bucket dispatch gate prices it cheaper) — default off;
+    # requires cohort_bucketing (docs/config_extensions.md, RUNBOOK
+    # "Closing the MFU gap")
+    "megabatch",
     # megakernel local SGD: epoch/step loop fusion (default on) + the
     # opt-in pallas fused SGD apply — `enable: false` restores the
     # legacy per-epoch unrolled trace (docs/config_extensions.md)
@@ -845,6 +878,39 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                           FLEET_FIELD_SPECS)
             _check_enum(errors, fl, "server_config.fleet", "sampling",
                         ALLOWED_FLEET_SAMPLING)
+        mgb = sc.get("megabatch")
+        if mgb is not None and not isinstance(mgb, dict):
+            errors.append(
+                "server_config.megabatch: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(mgb).__name__}")
+        if isinstance(mgb, dict):
+            _check_unknown(unknown, mgb, "server_config.megabatch",
+                           MEGABATCH_KEYS)
+            _check_fields(errors, mgb, "server_config.megabatch",
+                          MEGABATCH_FIELD_SPECS)
+            _cb_blk = sc.get("cohort_bucketing") or {}
+            _cb_on = bool(_cb_blk) and (not isinstance(_cb_blk, dict)
+                                        or _cb_blk.get("enable", True))
+            if mgb.get("enable", True) and not _cb_on:
+                # decidable at config load (the quiet-failure rule):
+                # the tape geometry is a per-bucket quantity, so an
+                # unbucketed run has nothing to repack
+                errors.append(
+                    "server_config.megabatch requires "
+                    "server_config.cohort_bucketing — the super-batch "
+                    "tape repacks per-bucket grids; add the "
+                    "cohort_bucketing block or drop megabatch")
+            if mgb.get("enable", True) and \
+                    str(strategy or "fedavg").lower() == "fedlabels":
+                # also decidable at config load: fedlabels' dual
+                # sup/unsup training loop steps outside the
+                # client_update contract the lane scan reproduces
+                errors.append(
+                    "server_config.megabatch is set but strategy is "
+                    "'fedlabels' — its dual sup/unsup loop steps "
+                    "outside the client_update contract the lane scan "
+                    "reproduces; drop megabatch or change strategy")
         mk = sc.get("megakernel")
         if mk is not None and not isinstance(mk, dict):
             errors.append(

@@ -101,6 +101,12 @@ class BaseStrategy:
     #: pager pages in/out; non-listed keys (SCAFFOLD's server control
     #: ``c``) stay resident and replicated
     carry_tables: tuple = ()
+    #: cross-client megabatching (server_config.megabatch): True when
+    #: every heavy training this strategy performs flows through the
+    #: ``client_update`` interface, so the engine's fused lane scan can
+    #: stand in for it (see :meth:`megabatch_passes`).  FedLabels opts
+    #: out — its VAT pass trains outside that contract.
+    supports_megabatch: bool = True
 
     def carry_row_defaults(self) -> Dict[str, float]:
         """Fill value per carry-table key for a client that has never
@@ -228,6 +234,25 @@ class BaseStrategy:
         record per-client diagnostics for the same-trace caller (e.g. the
         pre-clip update norm for adaptive clipping)."""
         return pseudo_grad, weight
+
+    # ---- traced, pre-vmap (megabatch lane-scan passes) ---------------
+    def megabatch_passes(self, *, strategy_state, global_params,
+                         client_ids, slots, rng) -> tuple:
+        """Declare the megabatch lane-scan passes this strategy's
+        client step needs — one spec dict per ``client_update`` call it
+        issues, IN CALL ORDER.  Each spec may set ``init_rows``
+        (``[K, n_flat]`` per-client start/anchor rows replacing the
+        global params — FedBuff's stale history, personalization's
+        local models), ``offset_rows`` (``[K, n_flat]`` SCAFFOLD-style
+        grad offsets), and ``rng_salt`` (reproducing a
+        ``fold_in(rng_client, salt)`` sub-stream).  Traced: runs inside
+        the collect program with the shard-local ``client_ids`` (true
+        ids, the rng anchor) and ``slots`` (carry-table rows — pool
+        slots under fleet paging, ids otherwise).  The default single
+        plain pass matches :meth:`client_step`'s one
+        ``client_update(global_params, ...)`` call."""
+        del strategy_state, global_params, client_ids, slots, rng
+        return ({},)
 
     # ---- traced, per-client carry (device_carry strategies) ----------
     def client_step_carry(self, client_update, global_params, arrays,

@@ -125,6 +125,24 @@ class FedBuff(FedAvg):
         parts["default"] = (pg, w * discount)
         return parts, tl, ns, stats
 
+    def megabatch_passes(self, *, strategy_state, global_params,
+                         client_ids, slots, rng):
+        """ONE lane-scan pass starting each client at its stale history
+        version: the per-client ``s_i`` draw replays :meth:`client_step`'s
+        ``fold_in(rng_client, 23)`` stream on the TRUE client ids, so the
+        lane scan trains from (and anchors against) exactly the version
+        the vmap arm would have handed ``client_update``."""
+        from jax.flatten_util import ravel_pytree
+        hist = strategy_state["history"]
+
+        def row(cid):
+            r = jax.random.fold_in(jax.random.fold_in(rng, cid), 23)
+            s_i = jax.random.randint(r, (), 0, self.max_staleness)
+            return ravel_pytree(
+                jax.tree.map(lambda h: h[s_i], hist))[0]
+
+        return ({"init_rows": jax.vmap(row)(client_ids)},)
+
     def apply_server_update(self, params: Any, agg: Any, state: Any,
                             server_lr) -> Tuple[Any, Any]:
         lr = jnp.asarray(server_lr, jnp.float32)

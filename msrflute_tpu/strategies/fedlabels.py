@@ -51,6 +51,9 @@ class FedLabels(BaseStrategy):
     # buffer or RL re-weighting to act on
     supports_staleness = False
     supports_rl = False
+    # the dual sup/unsup training loop steps outside the client_update
+    # contract the megabatch lane scan reproduces
+    supports_megabatch = False
 
     def __init__(self, config, dp_config=None):
         super().__init__(config, dp_config)

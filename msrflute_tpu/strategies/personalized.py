@@ -129,6 +129,24 @@ class PersonalizedFedAvg(FedAvg):
                  "keep": keep}
         return parts, tl, ns, stats, carry
 
+    def megabatch_passes(self, *, strategy_state, global_params,
+                         client_ids, slots, rng):
+        """TWO lane-scan passes matching :meth:`client_step_carry`'s two
+        ``client_update`` calls: the plain global pass, then the local-
+        model pass starting (and anchoring its pseudo-gradient) at each
+        user's ``local`` row — the global clone for never-seen users —
+        under the same ``fold_in(rng, 104729)`` sub-stream."""
+        from jax.flatten_util import ravel_pytree
+        flat_g, _ = ravel_pytree(global_params)
+        n_rows = strategy_state["local"].shape[0]
+        idx = jnp.clip(slots, 0, n_rows - 1)
+        valid = (slots >= 0).astype(jnp.float32)
+        seen = strategy_state["seen"][idx] * valid
+        init_rows = jnp.where(seen[:, None] > 0,
+                              strategy_state["local"][idx],
+                              flat_g[None, :])
+        return ({}, {"init_rows": init_rows, "rng_salt": 104729})
+
     def apply_carry(self, state, client_ids, carry, rng=None):
         keep_b = carry["keep"] > 0
         n_rows = state["local"].shape[0]

@@ -495,6 +495,25 @@ class Scaffold(FedAvg):
             carry["old"] = ci
         return parts, tl, ns, stats, carry
 
+    def megabatch_passes(self, *, strategy_state, global_params,
+                         client_ids, slots, rng):
+        """Megabatch lane-scan spec: ONE pass whose per-client grad
+        offset is the ``c - c_i`` drift correction — the exact spelling
+        :meth:`client_step_carry` feeds ``client_update``, batched per
+        table row (zero for padding rows, so their masked updates stay
+        exact no-ops)."""
+        if not self.fused:
+            return super().megabatch_passes(
+                strategy_state=strategy_state,
+                global_params=global_params, client_ids=client_ids,
+                slots=slots, rng=rng)
+        import jax.numpy as jnp
+        n_rows = strategy_state["ci"].shape[0]
+        valid = (slots >= 0).astype(jnp.float32)[:, None]
+        ci = strategy_state["ci"][jnp.clip(slots, 0, n_rows - 1)] * valid
+        return ({"offset_rows":
+                 (strategy_state["c"][None, :] - ci) * valid},)
+
     def apply_carry(self, state, client_ids, carry, rng=None):
         import jax.numpy as jnp
         rows, keep = carry["row"], carry["keep"]

@@ -313,6 +313,11 @@ DIFF_RULES: Dict[str, Tuple[str, float]] = {
     # a DROP means the grids grew back toward the monolithic worst case
     # — e.g. a bucket-boundary change silently re-padding small clients
     "padding_efficiency": ("lower_frac", 0.10),
+    # tape-slot occupancy of the cross-client megabatch lanes: a DROP
+    # means the lane planner stopped packing small clients densely
+    # (lane geometry drift) or the dispatch gate fell back to the
+    # per-client vmap arm on buckets it used to fuse
+    "megabatch_utilization": ("lower_frac", 0.10),
     "overlap_efficiency_pct": ("lower_abs", 10.0),
     "recompiles": ("higher_abs", 0.0),
     "puts_per_dispatch": ("higher_abs", 0.0),
@@ -329,7 +334,7 @@ DIFF_RULES: Dict[str, Tuple[str, float]] = {
 #: metrics whose thresholds scale with --pct (the wall-clock-ish ones)
 _PCT_SCALED = {"round_secs_p50", "host_tail_secs_p50",
                "staged_bytes_per_round_p50", "hbm_peak_bytes", "mfu_p50",
-               "padding_efficiency"}
+               "padding_efficiency", "megabatch_utilization"}
 
 
 def load_scorecard(path: str) -> Dict[str, Any]:
@@ -434,7 +439,7 @@ def _bench_entry(path: str) -> Dict[str, Any]:
         if isinstance(block, dict) and "secs_per_round" in block:
             row = {"secs_per_round": block.get("secs_per_round")}
             for key in ("mfu_vs_bf16_peak", "device_truth",
-                        "padding_efficiency"):
+                        "padding_efficiency", "megabatch_utilization"):
                 if key in block:
                     row[key] = block[key]
             protocols[name] = row
@@ -475,20 +480,24 @@ def trend_bench(paths: List[str],
                     "a_file": prev["file"], "b_file": last["file"],
                     "limit": round(sa * (1.0 + thresh), 6),
                     "threshold": thresh})
-            # padding efficiency is gated in the OTHER direction: a drop
-            # means the round grids grew back toward the monolithic
-            # pad-to-slowest worst case (cohort-bucketing regression)
-            pa = prev["protocols"][name].get("padding_efficiency")
-            pb = last["protocols"][name].get("padding_efficiency")
-            if isinstance(pa, (int, float)) and \
-                    isinstance(pb, (int, float)) and pa > 0 and \
-                    pb < pa * (1.0 - thresh):
-                regressions.append({
-                    "metric": f"{name}.padding_efficiency",
-                    "a": pa, "b": pb,
-                    "a_file": prev["file"], "b_file": last["file"],
-                    "limit": round(pa * (1.0 - thresh), 6),
-                    "threshold": thresh})
+            # efficiency ratios are gated in the OTHER direction: a
+            # padding_efficiency drop means the round grids grew back
+            # toward the monolithic pad-to-slowest worst case (cohort-
+            # bucketing regression); a megabatch_utilization drop means
+            # the lane planner stopped fusing small clients densely (or
+            # the gate fell back to per-client vmap)
+            for eff in ("padding_efficiency", "megabatch_utilization"):
+                pa = prev["protocols"][name].get(eff)
+                pb = last["protocols"][name].get(eff)
+                if isinstance(pa, (int, float)) and \
+                        isinstance(pb, (int, float)) and pa > 0 and \
+                        pb < pa * (1.0 - thresh):
+                    regressions.append({
+                        "metric": f"{name}.{eff}",
+                        "a": pa, "b": pb,
+                        "a_file": prev["file"], "b_file": last["file"],
+                        "limit": round(pa * (1.0 - thresh), 6),
+                        "threshold": thresh})
     return {"series": series, "regressions": regressions,
             "ok": not regressions}
 

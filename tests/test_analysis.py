@@ -841,6 +841,51 @@ def test_schema_drift_flags_undocumented_cohort_bucketing_knob(tmp_path):
     assert "cohort_bucketing" in found[0].message
 
 
+def test_schema_drift_covers_megabatch_specs(tmp_path):
+    """PR 16 corpus: the megabatch block's field specs are
+    drift-checked like the cohort_bucketing/fleet sections — a
+    MEGABATCH_FIELD_SPECS rule for a key the unknown-key pass doesn't
+    know is dead and must be flagged."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'megabatch'}\n"
+        "MEGABATCH_KEYS = {'enable', 'lanes', 'slack'}\n"
+        "MEGABATCH_FIELD_SPECS = "
+        "{'lanes': ('int', 1, None),"
+        " 'phantom_lanes': ('int', 1, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.megabatch` fuses small clients into lanes.")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("megabatch",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "phantom_lanes" in found[0].message
+    assert "MEGABATCH_KEYS" in found[0].message
+
+
+def test_schema_drift_flags_undocumented_megabatch_knob(tmp_path):
+    """An operator who cannot find the lane-tuning drill in the
+    runbook keeps paying the padded [K, S] grid on every
+    heterogeneous cohort a coarse bucket layout produces."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'megabatch'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text("no lane fusion documented here")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("megabatch",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "megabatch" in found[0].message
+
+
 # ======================================================================
 # PR 6 corpus: put-loop (single-buffer input staging discipline)
 # ======================================================================

@@ -204,6 +204,64 @@ def test_gspmd_partition_mode_pool_sharded(tmp_path):
     np.testing.assert_array_equal(resident, flat)
 
 
+# ======================================================================
+# cross-client megabatching x sharded fleet plane (ISSUE 16)
+# ======================================================================
+def _megabatch_mesh_dataset(seed=1, pool=24):
+    """23 small users (step needs 2-4 at B=4) + 1 big (need 15): one
+    coarse bucket whose 16-lane tape spreads 2 lanes per shard, so the
+    8-shard lane scan genuinely fuses clients inside every shard."""
+    rng = np.random.default_rng(seed)
+    users, per_user = [], []
+    w = rng.normal(size=(8, 4))
+    for u in range(pool):
+        n = 60 if u == pool - 1 else int(rng.integers(6, 17))
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.argmax(x @ w, axis=-1).astype(np.int32)
+        users.append(f"u{u:03d}")
+        per_user.append({"x": x, "y": y})
+    from msrflute_tpu.data import ArraysDataset
+    return ArraysDataset(users, per_user)
+
+
+@pytest.mark.slow  # mesh-tier2 CI runs this file unfiltered
+def test_megabatch_rides_sharded_fleet_plane_bitwise(tmp_path,
+                                                     monkeypatch):
+    """Tape dispatch on the REAL 8-shard mesh, composed with the paged
+    carry plane: per-shard lane blocks (lanes=16 -> 2 per shard), paged
+    scaffold carries, strict transfers — bitwise vs the per-client vmap
+    arm and zero post-warmup recompiles."""
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    ds = _megabatch_mesh_dataset()
+
+    def _go(mega, tmp):
+        over = {
+            "num_clients_per_iteration": 24,
+            "cohort_bucketing": {"enable": True, "max_buckets": 1},
+        }
+        if mega is not None:
+            over["megabatch"] = mega
+        cfg = _cfg(0, fleet={"page_pool_slots": 24}, rounds=4,
+                   server_over=over)
+        server = select_server(cfg.server_config.get("type"))(
+            make_task(cfg.model_config), cfg, ds, model_dir=str(tmp),
+            seed=7)
+        state = server.train()
+        flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+        return flat, server
+
+    off, _ = _go(None, tmp_path / "off")
+    on, sn = _go({"enable": True, "lanes": 16}, tmp_path / "on")
+    assert sn.mesh.shape["clients"] == MESH
+    assert sn.fleet_pager.mesh_shards == MESH
+    gate = sn.engine._mega_gate
+    assert gate and all(arm == "mega" for arm in gate.values()), gate
+    util = sn.megabatch_utilization
+    assert util is not None and 0.0 < util <= 1.0
+    assert sn.engine.recompile_count == 0
+    np.testing.assert_array_equal(on, off)
+
+
 def test_scorecard_gains_flat_fleet_transfer_keys(tmp_path):
     cfg = _cfg(2, fleet={"enable": True},
                server_over={"telemetry": {"enable": True}})

@@ -1,0 +1,408 @@
+"""Cross-client megabatching (ISSUE 16): super-batch tape vs per-client
+vmap grids.
+
+The tentpole contract, tested on a 1-device mesh (megabatch geometry
+quantizes to the mesh, and the conftest's forced 8-device mesh would
+make the tiny toy cohorts measure quantization, not the tape — the
+sharded-lane path gets its own coverage in tests/test_fleet_mesh.py):
+
+- host planner units: lane derivation, first-fit packing, epoch pointer
+  repeat, same-shape overflow groups, mesh-divisibility / need-fits-S
+  refusals, the utilization-meter denominators;
+- megabatch == per-client vmap BITWISE (f32) whenever the plan keeps
+  the finalize sum association unchanged (single tape group), for E=1
+  and E=2;
+- when overflow grouping DOES change the association, the drift is
+  bounded by the pinned tolerance below — not silently unbounded;
+- composition: scaffold fused_carry, fedbuff, personalization, chaos,
+  fleet paging, depth-3 pipelining, shield — all bitwise under
+  MSRFLUTE_STRICT_TRANSFERS=1;
+- zero post-warmup recompiles and a compiled-variant closure of at most
+  two collect programs per bucket (tape arm + vmap arm);
+- the guard refusal ladder (schema + engine) and the LOUD analytic
+  fallback (buffered ``megabatch_fallback`` events, vmap-arm parity).
+"""
+
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+import jax
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.data import ArraysDataset
+from msrflute_tpu.data.batching import (MegaTape, megabatch_lanes,
+                                        megabatch_slots, plan_megabatch)
+from msrflute_tpu.engine.server import select_server
+from msrflute_tpu.models import make_task
+from msrflute_tpu.parallel import make_mesh
+from msrflute_tpu.schema import SchemaError
+
+#: Pinned bound for runs where tape-group overflow changes the finalize
+#: sum association vs the vmap arm (different grouping -> different
+#: float add order).  Measured drift on the toy protocols is a few f32
+#: ulps (~6e-8); genuinely divergent math lands orders of magnitude
+#: beyond this.  Same discipline as BF16_FINAL_LOSS_RTOL.
+MEGABATCH_FINAL_LOSS_RTOL = 1e-5
+
+
+# ======================================================================
+# host planner units (pure numpy, no server)
+# ======================================================================
+def test_megabatch_lanes_explicit_pin_quantizes_to_mesh():
+    out = megabatch_lanes([1, 2, 3], [4, 8], cohort_size=8,
+                          num_epochs=1, quantum=4, lanes=3)
+    assert out == [4, 4]
+
+
+def test_megabatch_lanes_derivation_and_caps_clamp():
+    needs = [1, 1, 2, 2, 3, 8]
+    out = megabatch_lanes(needs, [4, 8], cohort_size=8, num_epochs=1)
+    assert len(out) == 2 and all(l >= 1 for l in out)
+    clamped = megabatch_lanes(needs, [4, 8], cohort_size=64,
+                              num_epochs=1, caps=[2, 2])
+    assert all(l <= 2 for l in clamped)
+
+
+def test_plan_megabatch_packs_small_clients_into_one_lane():
+    plan = plan_megabatch([2, 1, 1], num_epochs=1, lanes=1,
+                          step_grid=4, shards=1, capacity=4)
+    assert len(plan) == 1
+    rows, tape = plan[0]
+    assert rows == [0, 1, 2, -1]
+    assert isinstance(tape, MegaTape)
+    assert (tape.lanes, tape.depth, tape.shards) == (1, 4, 1)
+    assert tape.entries == 4
+    # lane 0 concatenates client rows 0,0,1,2; ptr = row * S + step
+    assert tape.seg[0].tolist() == [0, 0, 1, 2]
+    assert tape.ptr[0].tolist() == [0, 1, 4, 8]
+
+
+def test_plan_megabatch_repeats_pointers_per_epoch():
+    plan = plan_megabatch([2], num_epochs=2, lanes=1, step_grid=2,
+                          shards=1, capacity=1)
+    (rows, tape), = plan
+    assert tape.depth == 4 and tape.entries == 4
+    assert tape.ptr[0].tolist() == [0, 1, 0, 1]  # epoch replay, no dup
+    assert tape.seg[0].tolist() == [0, 0, 0, 0]
+
+
+def test_plan_megabatch_overflow_spills_same_shape_groups():
+    plan = plan_megabatch([3, 3, 3], num_epochs=1, lanes=1,
+                          step_grid=4, shards=1, capacity=4)
+    assert len(plan) == 3  # one need-3 client per depth-4 lane
+    for rows, tape in plan:
+        assert len(rows) == 4  # every group keeps the bucket shape
+        assert tape.ptr.shape == (1, 4)
+
+
+def test_plan_megabatch_refuses_mesh_indivisible_geometry():
+    with pytest.raises(ValueError, match="mesh-divisible"):
+        plan_megabatch([1], num_epochs=1, lanes=3, step_grid=4,
+                       shards=2, capacity=4)
+    with pytest.raises(ValueError, match="mesh-divisible"):
+        plan_megabatch([1], num_epochs=1, lanes=4, step_grid=4,
+                       shards=2, capacity=3)
+
+
+def test_plan_megabatch_refuses_need_beyond_bucket_grid():
+    with pytest.raises(ValueError, match="exceeds the bucket grid"):
+        plan_megabatch([5], num_epochs=1, lanes=1, step_grid=4,
+                       shards=1, capacity=1)
+
+
+def test_megabatch_slots_counts_tape_capacity():
+    t = MegaTape(np.zeros((2, 3), np.int32), np.zeros((2, 3), np.int32),
+                 lanes=2, depth=3, shards=1, entries=5)
+    assert megabatch_slots([t], batch_size=4) == 24
+    assert megabatch_slots([t, t], batch_size=4) == 48
+
+
+# ======================================================================
+# end-to-end parity on a 1-device mesh
+# ======================================================================
+def _hetero_dataset(seed=0, num_users=16, sizes=None):
+    """Heavy-tailed federated pool: mostly tiny clients + a few large
+    ones, so bucketing yields small-S buckets the tape can fuse."""
+    rng = np.random.default_rng(seed)
+    if sizes is None:
+        sizes = [3, 4, 5, 5, 6, 6, 7, 8, 9, 10, 12, 14, 30, 34, 70, 80]
+    users, per_user = [], []
+    w = rng.normal(size=(8, 4))
+    for u, n in enumerate(sizes[:num_users]):
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.argmax(x @ w, axis=-1).astype(np.int32)
+        users.append(f"u{u:03d}")
+        per_user.append({"x": x, "y": y})
+    return ArraysDataset(users, per_user)
+
+
+def _cfg(mega=None, *, rounds=4, depth=0, strategy="fedavg", ncpi=8,
+         epochs=1, server_over=None):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": ncpi,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "val_freq": 100, "initial_val": False,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "data_config": {"val": {"batch_size": 8}},
+        "cohort_bucketing": {"enable": True, "max_buckets": 3},
+    }
+    if strategy == "personalization":
+        strategy = "fedavg"
+        sc["type"] = "personalization"
+        sc["fused_carry"] = True
+    if mega is not None:
+        sc["megabatch"] = mega
+    if server_over:
+        sc.update(server_over)
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": strategy,
+        "server_config": sc,
+        "client_config": {
+            "num_epochs": epochs,
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _run(cfg, dataset, tmp, seed=7):
+    server = select_server(cfg.server_config.get("type"))(
+        make_task(cfg.model_config), cfg, dataset, model_dir=str(tmp),
+        seed=seed, mesh=make_mesh(num_devices=1))
+    state = server.train()
+    flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return flat, server
+
+
+@pytest.fixture(scope="module")
+def hetero_ds():
+    return _hetero_dataset()
+
+
+@pytest.fixture(scope="module")
+def base_pair(hetero_ds, tmp_path_factory):
+    """One shared off/on run pair (rounds=6 so the recompile sentinel
+    sees post-warmup rounds) — the E=1 identity, compile-discipline,
+    scorecard and fallback tests all read from it, keeping the tier-1
+    wall-clock cost to two compiles.  Tests must not mutate it."""
+    tmp = tmp_path_factory.mktemp("mgb_base")
+    off, _ = _run(_cfg(rounds=6), hetero_ds, tmp / "off")
+    on, server = _run(_cfg(mega={"enable": True}, rounds=6), hetero_ds,
+                      tmp / "on")
+    return off, on, server
+
+
+def _assert_mega_ran(server):
+    """Anti-vacuity guard: the tape arm must actually have dispatched
+    (gate recorded a 'mega' verdict and the utilization meter fed)."""
+    gate = server.engine._mega_gate
+    assert any(arm == "mega" for arm in gate.values()), gate
+    util = server.megabatch_utilization
+    assert util is not None and 0.0 < util <= 1.0, util
+
+
+def test_megabatch_matches_vmap_bitwise_e1(base_pair):
+    off, on, server = base_pair
+    _assert_mega_ran(server)
+    np.testing.assert_array_equal(on, off)
+
+
+@pytest.mark.slow
+def test_megabatch_matches_vmap_bitwise_e2(tmp_path, hetero_ds):
+    off, _ = _run(_cfg(epochs=2), hetero_ds, tmp_path / "off")
+    on, sn = _run(_cfg(mega={"enable": True}, epochs=2), hetero_ds,
+                  tmp_path / "on")
+    _assert_mega_ran(sn)
+    np.testing.assert_array_equal(on, off)
+
+
+@pytest.mark.slow
+def test_overflow_multigroup_stays_within_pinned_tolerance(
+        tmp_path, hetero_ds, base_pair):
+    """lanes=1 forces multi-group plans: the finalize sum association
+    changes vs the single-grid vmap arm, so bitwise equality is NOT the
+    contract — the pinned few-ulp tolerance is."""
+    off, _, _ = base_pair
+    on, sn = _run(_cfg(mega={"enable": True, "lanes": 1}, rounds=6),
+                  hetero_ds, tmp_path / "on")
+    _assert_mega_ran(sn)
+    np.testing.assert_allclose(on, off, rtol=MEGABATCH_FINAL_LOSS_RTOL,
+                               atol=MEGABATCH_FINAL_LOSS_RTOL)
+
+
+# ======================================================================
+# composition: every fused surface, strict transfers on
+# ======================================================================
+CHAOS = {"enable": True, "seed": 3, "dropout_rate": 0.25,
+         "straggler_rate": 0.25}
+
+# the whole matrix carries the `slow` marker: tier-1 runs at the edge
+# of its wall-clock budget and keeps only the shared base_pair bitwise
+# sentinel; CI's megabatch suite step (flint.yml) runs this file
+# UNFILTERED, so every composition case still gates every push
+COMPOSE_CASES = [
+    pytest.param("scaffold_fused",
+                 dict(strategy="scaffold",
+                      server_over={"fused_carry": True}),
+                 id="scaffold_fused", marks=pytest.mark.slow),
+    pytest.param("fedbuff",
+                 dict(strategy="fedbuff",
+                      server_over={"fedbuff": {"max_staleness": 3}}),
+                 id="fedbuff", marks=pytest.mark.slow),
+    pytest.param("personalization_fused",
+                 dict(strategy="personalization"),
+                 id="personalization_fused", marks=pytest.mark.slow),
+    pytest.param("chaos", dict(server_over={"chaos": CHAOS}),
+                 id="chaos", marks=pytest.mark.slow),
+    pytest.param("scaffold_fleet_paged",
+                 dict(strategy="scaffold",
+                      server_over={"fused_carry": True,
+                                   "fleet": {"page_pool_slots": 8}}),
+                 id="scaffold_fleet_paged", marks=pytest.mark.slow),
+    pytest.param("chaos_depth3_shield",
+                 dict(depth=3, rounds=6,
+                      server_over={"chaos": CHAOS,
+                                   "robust": {"enable": True}}),
+                 id="chaos_depth3_shield", marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("name,kw", COMPOSE_CASES)
+def test_megabatch_composes_bitwise(tmp_path, monkeypatch, name, kw):
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    ds = _hetero_dataset()
+    off, _ = _run(_cfg(**kw), ds, tmp_path / "off")
+    on, sn = _run(_cfg(mega={"enable": True}, **kw), ds,
+                  tmp_path / "on")
+    _assert_mega_ran(sn)
+    np.testing.assert_array_equal(on, off)
+
+
+# ======================================================================
+# compile discipline
+# ======================================================================
+def test_zero_recompiles_after_warmup_and_variant_closure(base_pair):
+    _, _, server = base_pair
+    _assert_mega_ran(server)
+    assert server.engine.recompile_count == 0
+    # compiled collect variants close at <= 2 per bucket (tape arm +
+    # vmap fallback arm); the finalize program is shared
+    n_buckets = len(server.megabatch["lanes"])
+    collects = {v for v in set(server.engine.compile_log)
+                if "collect" in v}
+    assert 0 < len(collects) <= n_buckets * 2, sorted(collects)
+
+
+# ======================================================================
+# guard refusal ladder
+# ======================================================================
+def test_schema_refuses_megabatch_without_cohort_bucketing():
+    with pytest.raises(SchemaError, match="cohort_bucketing"):
+        FLUTEConfig.from_dict({
+            "model_config": {"model_type": "LR", "num_classes": 4,
+                             "input_dim": 8},
+            "server_config": {
+                "max_iteration": 2, "num_clients_per_iteration": 4,
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "megabatch": {"enable": True},
+            },
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": 0.2},
+                "data_config": {"train": {"batch_size": 4}}},
+        })
+
+
+def test_schema_refuses_megabatch_with_fedlabels():
+    with pytest.raises(SchemaError, match="fedlabels"):
+        FLUTEConfig.from_dict({
+            "model_config": {"model_type": "LR", "num_classes": 4,
+                             "input_dim": 8},
+            "strategy": "fedlabels",
+            "server_config": {
+                "max_iteration": 2, "num_clients_per_iteration": 4,
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "cohort_bucketing": {"enable": True},
+                "megabatch": {"enable": True},
+            },
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": 0.2},
+                "data_config": {"train": {"batch_size": 4}}},
+        })
+
+
+def test_engine_refuses_megabatch_with_privacy_metrics(tmp_path):
+    cfg = _cfg(mega={"enable": True})
+    cfg.privacy_metrics_config = {"apply_metrics": True}
+    with pytest.raises(ValueError, match="privacy_metrics_"):
+        _run(cfg, _hetero_dataset(), tmp_path / "a")
+
+
+def test_engine_refuses_strategy_without_megabatch_support(
+        tmp_path, monkeypatch):
+    from msrflute_tpu.strategies import base as strat_base
+    monkeypatch.setattr(strat_base.BaseStrategy, "supports_megabatch",
+                        False)
+    with pytest.raises(ValueError, match="does not compose"):
+        _run(_cfg(mega={"enable": True}), _hetero_dataset(),
+             tmp_path / "a")
+
+
+def test_engine_refuses_megabatch_with_pallas_apply(
+        tmp_path, monkeypatch):
+    # sidestep the earlier pallas-requires-TPU guard so the ladder's
+    # megabatch x pallas_apply refusal is the one that fires
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cfg = _cfg(mega={"enable": True},
+               server_over={"megakernel": {"pallas_apply": True}})
+    with pytest.raises(ValueError, match="segment-reset"):
+        _run(cfg, _hetero_dataset(), tmp_path / "a")
+
+
+# ======================================================================
+# loud fallback + observability surface
+# ======================================================================
+@pytest.mark.slow
+def test_analytic_gate_falls_back_loudly_to_vmap_arm(
+        tmp_path, hetero_ds, base_pair):
+    """Explicit lanes clamp to the bucket capacity, so a huge pin makes
+    the tape price >= the grid on every bucket: the gate must refuse,
+    buffer megabatch_fallback events, and reproduce the vmap arm
+    exactly."""
+    off, _, _ = base_pair
+    on, sn = _run(_cfg(mega={"enable": True, "lanes": 999}, rounds=6),
+                  hetero_ds, tmp_path / "on")
+    np.testing.assert_array_equal(on, off)
+    assert not any(a == "mega" for a in sn.engine._mega_gate.values())
+    events = sn.engine.drain_megabatch_events()
+    assert events and all(ev["kind"] == "megabatch_fallback"
+                          for ev in events)
+    assert {ev["reason"] for ev in events} == {"slots"}
+    for ev in events:
+        assert ev["tape_groups"] >= ev["grid_groups"] > 0
+    assert sn.megabatch_utilization is None
+
+
+def test_fallback_event_buffer_drains_and_clears(tmp_path):
+    ds = _hetero_dataset(sizes=[4, 4])
+    cfg = _cfg(mega={"enable": True}, ncpi=2, rounds=1)
+    server = select_server(cfg.server_config.get("type"))(
+        make_task(cfg.model_config), cfg, ds, model_dir=str(tmp_path),
+        seed=0, mesh=make_mesh(num_devices=1))
+    server.engine.push_megabatch_event(
+        {"kind": "megabatch_fallback", "reason": "slots", "lanes": 1})
+    out = server.engine.drain_megabatch_events()
+    assert [ev["kind"] for ev in out] == ["megabatch_fallback"]
+    assert server.engine.drain_megabatch_events() == []
+
+
+def test_scorecard_gains_megabatch_block_and_flat_key(base_pair):
+    _, _, server = base_pair
+    card = server.build_scorecard()
+    blk = card["megabatch"]
+    assert blk["lanes"] == [int(l) for l in server.megabatch["lanes"]]
+    assert 0.0 < blk["utilization"] <= 1.0
+    assert blk["gate_arms"] and \
+        set(blk["gate_arms"].values()) <= {"mega", "vmap"}
+    # flat copy is what `scope diff --gate` walks (lower_frac rule)
+    assert card["megabatch_utilization"] == blk["utilization"]
