@@ -1,6 +1,6 @@
 """fluteguard — TPU-safety static analysis for msrflute_tpu.
 
-Fifteen checkers on one interprocedural engine, one CLI::
+Nineteen checkers on one interprocedural engine, one CLI::
 
     python -m msrflute_tpu.analysis msrflute_tpu/     # or: tools/flint
 
@@ -58,6 +58,23 @@ body's helper in another file, a round path's fetch three calls deep.
   baseline, status log) must use tmp + ``os.replace`` or hardlink
   rotation; bare ``open(path, "w")`` and bare ``os.rename`` of a
   committed slot flag, append-only JSONL streams stay silent.
+- **mesh-axis**        collectives and ``P(...)`` specs in the modules
+  that own the mesh must name the canonical axis constants
+  (``CLIENTS_AXIS``/``MODEL_AXIS``); bare string axis literals flag.
+- **shard-locality**   the vmapped/scanned per-lane body of a round
+  program must be collective-free (closures from every vmap/scan
+  root), and ``shard_map`` carry-table gathers must show block-local
+  evidence (the ``axis_index`` conversion idiom, a ``mode="drop"``
+  sentinel scatter, or shard-local bindings).
+- **spec-drift**       the page pool's slot axis must shard over the
+  clients mesh axis: replicated pool-spec bindings, replicated pool
+  ``device_put``s (inline or through a named spec) and UNSHARDED pool
+  puts in ``engine/`` flag (subsumes shard-ready's old
+  replicated-pool check).
+- **collective-budget** each round program's collective sites pinned
+  both ways against docs/architecture.md's "Collective budget"
+  paragraph — extra code sites flag with their round-root path, stale
+  doc entries flag at the doc line.
 
 Static findings pair with a runtime strict mode: under
 ``MSRFLUTE_STRICT_TRANSFERS=1`` the server round loop runs inside a

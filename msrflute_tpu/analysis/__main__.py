@@ -39,7 +39,7 @@ from .core import (Finding, analyze, default_baseline_path,
 _PROJECT_TRIGGER_PARTS = ("docs/", "README.md", "schema.py", "config.py",
                           "engine/", "strategies/", "ops/", "telemetry/",
                           "robust/", "resilience/", "analysis/",
-                          "data/", "rl/", "utils/")
+                          "data/", "rl/", "utils/", "parallel/")
 
 
 def _git_changed_files(root: str, base: Optional[str]
@@ -120,7 +120,8 @@ def main(argv=None) -> int:
                     "pallas-shape, put-loop, schema-drift, shard-ready, "
                     "recompile-hazard, transfer-budget, guard-matrix, "
                     "event-schema, signal-safety, lock-discipline, "
-                    "thread-escape, atomic-write)")
+                    "thread-escape, atomic-write, mesh-axis, "
+                    "shard-locality, spec-drift, collective-budget)")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/dirs to analyze (default: the "
                              "msrflute_tpu package)")

@@ -1,0 +1,187 @@
+"""collective-budget — each round program's collective sites, pinned.
+
+The transfer budget bounds device->host traffic; this rule bounds the
+CROSS-SHARD traffic inside the programs themselves.  Every collective
+site in the round path is a deliberate piece of the layout: the
+finalize psum, the metrics all_gather, the axis_index slot conversion
+— and each one was costed when the mesh plane was designed.  A new
+``psum`` slipped into a refactor is invisible at review (it traces,
+it compiles, it is bit-correct on one device) but multiplies per-round
+latency by the mesh's slowest link.  So the budget is written down and
+machine-checked BOTH ways against ``docs/architecture.md``'s
+"Collective budget" paragraph:
+
+- **code -> doc**: an ``engine/`` module with more sites of an op than
+  the doc grants gets each extra site flagged (with the round-root
+  path when the function is on one, transfer-budget style).  A
+  deliberate new site takes a reasoned inline pragma AND a doc bump —
+  the paragraph is the costing record;
+- **doc -> code**: a documented entry the code no longer matches (op
+  dropped, count shrank, module gone) flags at the doc line — a stale
+  budget is how the NEXT extra collective hides.
+
+Doc format, one module per line in the paragraph anchored by
+"Collective budget" (scanned to the next blank line, event-schema
+style)::
+
+    - `engine/round.py`: `psum` x2, `all_gather` x2, `axis_index` x2
+
+Scope: ``engine/`` modules (``ops/`` kernels are axis-parameterized
+library code — their budgets belong to whichever program instantiates
+them).  ``axis_index`` counts like a collective here: it is cheap, but
+its COUNT pins the global->local conversion idiom shard-locality
+relies on.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, ModuleSummary, Project, _iter_py_files, \
+    build_project
+from .transfer_budget import BOUNDARY_RE, PAGER_ROOT_RE, ROUND_ROOT_RE
+
+RULE = "collective-budget"
+
+#: paragraph anchor in docs/architecture.md
+DOC_ANCHOR = "Collective budget"
+
+_MOD_RE = re.compile(r"`((?:[\w\-]+/)+[\w\-]+\.py)`")
+_OP_RE = re.compile(r"`(\w+)`\s*x(\d+)")
+
+
+def _doc_budget(doc_lines: List[str]
+                ) -> Dict[str, Tuple[int, Dict[str, int]]]:
+    """``{module: (doc line, {op: count})}`` from the anchored
+    paragraph (anchor line to the next blank)."""
+    out: Dict[str, Tuple[int, Dict[str, int]]] = {}
+    for i, line in enumerate(doc_lines):
+        if DOC_ANCHOR not in line:
+            continue
+        # scan past the anchor paragraph's own blank separator; stop at
+        # the first blank AFTER at least one module entry was read
+        for j in range(i, len(doc_lines)):
+            if out and j > i and not doc_lines[j].strip():
+                break
+            mod = _MOD_RE.search(doc_lines[j])
+            if not mod:
+                continue
+            ops = {op: int(n) for op, n in
+                   _OP_RE.findall(doc_lines[j][mod.end():])}
+            if ops:
+                out[mod.group(1)] = (j + 1, ops)
+        break
+    return out
+
+
+def _doc_key(path: str) -> str:
+    """Doc entries name modules package-relative (``engine/round.py``);
+    project summaries key root-relative (``msrflute_tpu/engine/...``)."""
+    head, _, tail = path.partition("/")
+    return tail if head == "msrflute_tpu" and tail else path
+
+
+def _collect_modules(root: str) -> Dict[str, ModuleSummary]:
+    pkg = os.path.join(root, "msrflute_tpu")
+    files = _iter_py_files([pkg] if os.path.isdir(pkg) else [root])
+    return build_project(root, files).modules
+
+
+def check_project(root: str,
+                  project: Optional[Project] = None) -> List[Finding]:
+    doc_path = os.path.join(root, "docs", "architecture.md")
+    if not os.path.exists(doc_path):
+        return []  # not a tree this checker applies to
+    rel_doc = os.path.relpath(doc_path, root).replace(os.sep, "/")
+    with open(doc_path, "r", encoding="utf-8") as fh:
+        doc_lines = fh.read().splitlines()
+    budget = _doc_budget(doc_lines)
+
+    modules = project.modules if project is not None else None
+    # a subset run (`tools/flint engine/round.py`) would judge the doc
+    # against a partial site census — rescan the package instead
+    pkg = os.path.join(root, "msrflute_tpu")
+    if os.path.isdir(pkg):
+        all_rel = {os.path.relpath(p, root).replace(os.sep, "/")
+                   for p in _iter_py_files([pkg])}
+        if modules is None or not all_rel <= set(modules):
+            modules = _collect_modules(root)
+    if modules is None:
+        return []
+
+    # round-root closure for transfer-budget-style path reporting
+    roots = []
+    for path, mod in modules.items():
+        if "engine" not in path.split("/"):
+            continue
+        for qual, fn in mod.functions.items():
+            if (ROUND_ROOT_RE.search(fn.name) or
+                    PAGER_ROOT_RE.match(fn.name)) and \
+                    not BOUNDARY_RE.search(fn.name):
+                roots.append((path, qual))
+    graph = project if project is not None \
+        else Project(os.path.abspath(root), modules)
+    parents = graph.reachable_from(sorted(roots), stop=BOUNDARY_RE) \
+        if roots else {}
+
+    findings: List[Finding] = []
+    seen_mods = set()
+    for path in sorted(modules):
+        if "engine" not in path.split("/"):
+            continue
+        mod = modules[path]
+        # (op, line, fn qual) sites, module-wide
+        sites: Dict[str, List[Tuple[int, str]]] = {}
+        for qual, fn in sorted(mod.functions.items()):
+            for op, line, _axis in fn.collectives:
+                sites.setdefault(op, []).append((line, qual))
+        if not sites and _doc_key(path) not in budget:
+            continue
+        seen_mods.add(_doc_key(path))
+        doc_line, doc_ops = budget.get(_doc_key(path), (0, {}))
+        # ---- code -> doc: extra sites flag --------------------------
+        for op in sorted(sites):
+            allowed = doc_ops.get(op, 0)
+            extra = sorted(sites[op])[allowed:]
+            for line, qual in extra:
+                key = (path, qual)
+                via = ""
+                if key in parents:
+                    chain = graph.call_path(parents, key)
+                    if len(chain) > 1:
+                        via = f" (round path: {' -> '.join(chain)})"
+                findings.append(Finding(
+                    RULE, path, line,
+                    f"collective site `{op}` in `{qual}` exceeds the "
+                    f"documented budget ({allowed} x `{op}` for "
+                    f"{path} in docs/architecture.md)" + via,
+                    hint="a new cross-shard collective multiplies "
+                         "per-round latency by the mesh's slowest "
+                         "link: if deliberate, add a reasoned "
+                         "`# flint: disable=collective-budget` pragma "
+                         "AND bump the doc's Collective budget line "
+                         "(the costing record); otherwise hoist it to "
+                         "an existing sanctioned site"))
+        # ---- doc -> code: stale budget flags ------------------------
+        for op, count in sorted(doc_ops.items()):
+            have = len(sites.get(op, []))
+            if have < count:
+                findings.append(Finding(
+                    RULE, rel_doc, doc_line,
+                    f"docs/architecture.md budgets {count} x `{op}` "
+                    f"for {path} but the code has {have}",
+                    hint="the site moved or was removed — shrink the "
+                         "budget line to match (a stale budget grants "
+                         "headroom the next stray collective hides "
+                         "in)"))
+    for path, (doc_line, _ops) in sorted(budget.items()):
+        if path not in seen_mods:
+            findings.append(Finding(
+                RULE, rel_doc, doc_line,
+                f"docs/architecture.md budgets collectives for "
+                f"`{path}`, which has none (or does not exist)",
+                hint="drop the stale budget entry or fix the module "
+                     "path"))
+    return findings

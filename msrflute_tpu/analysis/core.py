@@ -57,6 +57,7 @@ RULES = ("host-sync", "donation-aliasing", "jit-purity", "pallas-shape",
          "transfer-budget", "guard-matrix", "event-schema",
          "signal-safety", "lock-discipline", "thread-escape",
          "atomic-write",
+         "mesh-axis", "shard-locality", "spec-drift", "collective-budget",
          "stale-suppression", "bare-suppression", "unknown-suppression",
          "parse-error")
 
@@ -81,6 +82,10 @@ RULE_RENAMES = {
     "lock_discipline": "lock-discipline",
     "thread_escape": "thread-escape",
     "atomic_write": "atomic-write",
+    "mesh_axis": "mesh-axis",
+    "shard_locality": "shard-locality",
+    "spec_drift": "spec-drift",
+    "collective_budget": "collective-budget",
 }
 
 #: factories whose RESULT is a compiled callable — shared by host-sync
@@ -411,6 +416,20 @@ class FunctionSummary:
     #: simple local ``name = <expr>`` bindings (last wins) — one level
     #: of value provenance for thread-escape's snapshot check
     local_assigns: Dict[str, str] = field(default_factory=dict)
+    # -- mesh fact layer (mesh-axis / shard-locality /
+    # -- collective-budget ride these; see the module comment) ----------
+    #: collective call sites: (op tail, line, axis desc); axis desc is
+    #: :func:`axis_desc_of`'s classification of the axis argument
+    collectives: List[Tuple[str, int, str]] = field(default_factory=list)
+    #: pool-table gathers: (base name, slice source, line) — Subscript
+    #: loads whose base names a slot-axis table and whose slice looks
+    #: like slot ids (``.at[...]`` update chains are scatters, not
+    #: gathers, and are excluded)
+    slot_gathers: List[Tuple[str, str, int]] = field(default_factory=list)
+    #: sentinel-padded scatters: (base name, line) from
+    #: ``pool.at[slots].set(..., mode="drop")`` — the fixed-shape
+    #: page-in idiom shard-locality accepts as shard-local evidence
+    drop_scatters: List[Tuple[str, int]] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"module": self.module, "qual": self.qual,
@@ -423,7 +442,10 @@ class FunctionSummary:
                 "conc_ops": [list(o) for o in self.conc_ops],
                 "deferred_spans": [list(s) for s in self.deferred_spans],
                 "self_assigns": [list(a) for a in self.self_assigns],
-                "local_assigns": self.local_assigns}
+                "local_assigns": self.local_assigns,
+                "collectives": [list(c) for c in self.collectives],
+                "slot_gathers": [list(g) for g in self.slot_gathers],
+                "drop_scatters": [list(s) for s in self.drop_scatters]}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FunctionSummary":
@@ -437,7 +459,10 @@ class FunctionSummary:
                    [tuple(o) for o in d.get("conc_ops", [])],
                    [tuple(s) for s in d.get("deferred_spans", [])],
                    [tuple(a) for a in d.get("self_assigns", [])],
-                   dict(d.get("local_assigns", {})))
+                   dict(d.get("local_assigns", {})),
+                   [tuple(c) for c in d.get("collectives", [])],
+                   [tuple(g) for g in d.get("slot_gathers", [])],
+                   [tuple(s) for s in d.get("drop_scatters", [])])
 
 
 @dataclass
@@ -481,6 +506,30 @@ class ModuleSummary:
     #: (handler ref as written, line, enclosing class or None)
     signal_handlers: List[Tuple[str, int, Optional[str]]] = \
         field(default_factory=list)
+    # -- mesh fact layer ------------------------------------------------
+    #: per-lane trace roots — refs handed to vmap / lax.scan:
+    #: (ref as written, enclosing class or None, enclosing function
+    #: qual or "" — nested lane bodies resolve in their BUILDER's
+    #: scope, not via the module-wide last-def name index)
+    lane_roots: List[Tuple[str, Optional[str], str]] = \
+        field(default_factory=list)
+    #: shard_map roots: (ref, enclosing class or None, enclosing
+    #: function qual or "", line) — the enclosing qual lets
+    #: shard-locality read the BUILDER's locals for shard-local markers
+    shardmap_roots: List[Tuple[str, Optional[str], str, int]] = \
+        field(default_factory=list)
+    #: sharding-spec bindings: (bound name — ``x`` or ``self.x`` —,
+    #: kind per :func:`spec_kind_of`, line)
+    spec_bindings: List[Tuple[str, str, int]] = \
+        field(default_factory=list)
+    #: ``P("...")`` string-literal axis specs: (axis string, line)
+    spec_literals: List[Tuple[str, int]] = field(default_factory=list)
+    #: device_put sites: (target source, spec desc, line, enclosing
+    #: function qual or ""); spec desc is ``none`` (no sharding arg), a
+    #: :func:`spec_kind_of` kind, or ``name:<dotted>`` for a spec passed
+    #: by name (resolved against spec_bindings by spec-drift)
+    device_puts: List[Tuple[str, str, int, str]] = \
+        field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -498,6 +547,11 @@ class ModuleSummary:
             "devbus": [list(d) for d in self.devbus],
             "thread_spawns": [list(t) for t in self.thread_spawns],
             "signal_handlers": [list(h) for h in self.signal_handlers],
+            "lane_roots": [list(t) for t in self.lane_roots],
+            "shardmap_roots": [list(t) for t in self.shardmap_roots],
+            "spec_bindings": [list(b) for b in self.spec_bindings],
+            "spec_literals": [list(s) for s in self.spec_literals],
+            "device_puts": [list(p) for p in self.device_puts],
         }
 
     @classmethod
@@ -523,6 +577,16 @@ class ModuleSummary:
                              for t in d.get("thread_spawns", [])]
         out.signal_handlers = [(h[0], h[1], h[2])
                                for h in d.get("signal_handlers", [])]
+        out.lane_roots = [(t[0], t[1], t[2])
+                          for t in d.get("lane_roots", [])]
+        out.shardmap_roots = [(t[0], t[1], t[2], t[3])
+                              for t in d.get("shardmap_roots", [])]
+        out.spec_bindings = [(b[0], b[1], b[2])
+                             for b in d.get("spec_bindings", [])]
+        out.spec_literals = [(s[0], s[1])
+                             for s in d.get("spec_literals", [])]
+        out.device_puts = [(p[0], p[1], p[2], p[3])
+                           for p in d.get("device_puts", [])]
         return out
 
 
@@ -541,6 +605,99 @@ _LOCK_NAME_RE = re.compile(r"(lock|cond|mutex|sem)", re.I)
 #: handler sets a flag; the loop's next poll does the unsafe work)
 _SIGNAL_FLAG_RE = re.compile(r"from_signal|in_signal|signal_ctx", re.I)
 _THREAD_FACTORIES = {"threading.Thread", "Thread"}
+
+# -- mesh fact layer ---------------------------------------------------
+#: collective primitives whose second argument (first for axis_index)
+#: names a mesh axis.  ``axis_index`` rides along because
+#: shard-locality treats it as the global->block-local slot-id
+#: conversion evidence, not as a cross-shard collective.
+COLLECTIVE_OPS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                  "ppermute", "all_to_all", "psum_scatter", "pshuffle"}
+#: names/attrs whose FINAL segment is a canonical axis constant — the
+#: only sanctioned way to spell an axis in engine//parallel//strategies/
+_AXIS_CONST_RE = re.compile(r"(CLIENTS_AXIS|MODEL_AXIS)$")
+#: per-lane trace entries (the vmapped/scanned per-client body) vs the
+#: per-shard ones (shard_map): shard-locality prohibits collectives in
+#: the former and audits gathers in the latter
+_LANE_ENTRIES = {"jax.vmap", "vmap", "jax.lax.scan", "lax.scan"}
+_SHARD_MAP_ENTRIES = {"shard_map", "jax.experimental.shard_map.shard_map"}
+_PARTITION_SPEC_TAILS = ("P", "PartitionSpec")
+#: parallel/-helper tails that construct a sharding of known kind
+_SPEC_HELPER_KINDS = {"slot_pool_sharding": "clients",
+                      "client_axis_sharding": "clients",
+                      "replicated_sharding": "replicated"}
+_DEVICE_PUT_NAMES = ("jax.device_put", "device_put")
+
+#: identifier tokens that mark a SLOT-AXIS table (the fleet page pool,
+#: carry-row buffers).  Shared by the summary extractor (slot-gather /
+#: drop-scatter / device_put facts) and spec-drift's replicated-pool
+#: check (moved here from shard-ready).
+POOL_TOKENS = frozenset({"row", "rows", "pool", "slot", "slots",
+                         "table", "tables"})
+_TOKEN_SPLIT = re.compile(r"[^a-zA-Z0-9]+")
+#: a Subscript slice that looks like slot ids (directly or through one
+#: local binding) marks a pool gather
+_SLOT_SLICE_RE = re.compile(r"(slot|idx|ids|indices)", re.I)
+
+
+def pool_name(name: Optional[str]) -> bool:
+    """``rows`` / ``page_pool`` / ``self._tables`` — a slot-axis table
+    name by its identifier tokens."""
+    if not name:
+        return False
+    return any(tok in POOL_TOKENS
+               for tok in _TOKEN_SPLIT.split(name.lower()))
+
+
+def axis_desc_of(node: Optional[ast.AST]) -> str:
+    """Classify a collective's axis argument: ``const:<NAME>`` for the
+    canonical constants, ``literal:<s>`` for a bare string, ``dynamic``
+    for everything else (parameterized axis-library kernels)."""
+    if node is None:
+        return "dynamic"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return f"literal:{node.value}"
+    name = dotted_name(node)
+    if name is not None:
+        m = _AXIS_CONST_RE.search(name.rsplit(".", 1)[-1])
+        if m:
+            return f"const:{m.group(1)}"
+    if isinstance(node, (ast.Tuple, ast.List)):
+        descs = [axis_desc_of(e) for e in node.elts]
+        lit = next((d for d in descs if d.startswith("literal:")), None)
+        if lit:
+            return lit
+        if descs and all(d.startswith("const:") for d in descs):
+            return descs[0]
+    return "dynamic"
+
+
+def spec_kind_of(node: Optional[ast.AST]) -> Optional[str]:
+    """Classify a sharding-spec expression — ``NamedSharding(mesh,
+    P(...))``, a bare ``P(...)`` literal, or a parallel/ helper call —
+    as replicated / clients / model / dynamic.  None when the
+    expression is not a spec construction at all."""
+    if not isinstance(node, ast.Call):
+        return None
+    tail = (call_name(node) or "").split(".")[-1]
+    if tail in _SPEC_HELPER_KINDS:
+        return _SPEC_HELPER_KINDS[tail]
+    if tail == "NamedSharding":
+        if len(node.args) < 2:
+            return "dynamic"
+        return spec_kind_of(node.args[1]) or "dynamic"
+    if tail in _PARTITION_SPEC_TAILS:
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            return "dynamic"
+        if not node.args and not node.keywords:
+            return "replicated"
+        descs = [axis_desc_of(a) for a in node.args]
+        if any(d == "const:CLIENTS_AXIS" for d in descs):
+            return "clients"
+        if any(d == "const:MODEL_AXIS" for d in descs):
+            return "model"
+        return "dynamic"
+    return None
 #: logger-receiver names whose level-method calls count as logging
 _LOGGER_RECV_RE = re.compile(r"(^|\.)(_?logger|log)$", re.I)
 _LOG_LEVEL_TAILS = {"debug", "info", "warning", "warn", "error",
@@ -637,10 +794,16 @@ class _SummaryVisitor(ast.NodeVisitor):
         elif self.class_stack:
             prefix = ".".join(self.class_stack) + "."
         qual = prefix + node.name
-        fn = FunctionSummary(self.info.path, qual, node.name,
-                             self.class_stack[-1] if self.class_stack
-                             else None, node.lineno)
-        self.s.functions[qual] = fn
+        fn = self.s.functions.get(qual)
+        if fn is None:
+            fn = FunctionSummary(self.info.path, qual, node.name,
+                                 self.class_stack[-1] if self.class_stack
+                                 else None, node.lineno)
+            self.s.functions[qual] = fn
+        # else: conditional redefinition (`if mode: def f ... else:
+        # def f`) — accumulate into ONE summary so the facts are the
+        # UNION of the branches (either def may be the one traced;
+        # round.py's gather_axis all_gather lives in one branch only)
         self.s.name_index[node.name] = qual
         for dec in node.decorator_list:
             dec_call = dec.func if isinstance(dec, ast.Call) else dec
@@ -783,6 +946,17 @@ class _SummaryVisitor(ast.NodeVisitor):
                     self.s.jit_attrs.append(tgt.attr)
                     if static:
                         self.s.static_jit["self." + tgt.attr] = static
+        kind = spec_kind_of(value)
+        if kind is not None:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.s.spec_bindings.append(
+                        (tgt.id, kind, node.lineno))
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    self.s.spec_bindings.append(
+                        ("self." + tgt.attr, kind, node.lineno))
         if self.fn_stack:
             for tgt in node.targets:
                 self._record_self_write(tgt)
@@ -863,6 +1037,32 @@ class _SummaryVisitor(ast.NodeVisitor):
             self.fn_stack[-1].self_reads.append(node.attr)
         self.generic_visit(node)
 
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # mesh fact layer: a Load of `pool[slot_ids]` is a pool-table
+        # gather.  `.at[...]` chains are scatter TARGETS (recorded as
+        # drop_scatters in visit_Call), not gathers — a chain through
+        # `.at` is skipped.
+        if self.fn_stack and isinstance(node.ctx, ast.Load) and \
+                not isinstance(node.slice, ast.Constant):
+            base = node.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if not (isinstance(base, ast.Attribute) and
+                    base.attr == "at"):
+                bname = dotted_name(base)
+                if pool_name(bname):
+                    fn = self.fn_stack[-1]
+                    slice_src = self._src_of(node.slice, 80)
+                    prov = slice_src
+                    if isinstance(node.slice, ast.Name):
+                        prov += " " + fn.local_assigns.get(
+                            node.slice.id, "")
+                    if _SLOT_SLICE_RE.search(prov):
+                        fn.slot_gathers.append(
+                            (bname.rsplit(".", 1)[-1], slice_src,
+                             node.lineno))
+        self.generic_visit(node)
+
     def visit_Dict(self, node: ast.Dict) -> None:
         # telemetry event records built as dict literals ({"kind": ...})
         # — the xla.py drain-queue pattern
@@ -919,10 +1119,80 @@ class _SummaryVisitor(ast.NodeVisitor):
                 if ref is None and isinstance(arg, ast.Call) and \
                         call_name(arg) in ("functools.partial", "partial"):
                     ref = arg.args and dotted_name(arg.args[0]) or None
-                if ref:
-                    self.s.traced_roots.append((ref, cls))
+                if not ref:
+                    continue
+                self.s.traced_roots.append((ref, cls))
+                # mesh fact layer: the lane/shard_map split rides along
+                # (shard-locality prohibits collectives in the former
+                # and audits pool gathers in the latter)
+                if name in _LANE_ENTRIES:
+                    self.s.lane_roots.append(
+                        (ref, cls,
+                         self.fn_stack[-1].qual if self.fn_stack
+                         else ""))
+                elif name in _SHARD_MAP_ENTRIES:
+                    self.s.shardmap_roots.append(
+                        (ref, cls,
+                         self.fn_stack[-1].qual if self.fn_stack
+                         else "", node.lineno))
         # telemetry emissions
         tail = name.rsplit(".", 1)[-1] if name else None
+        # -- mesh fact layer -------------------------------------------
+        if self.fn_stack and tail in COLLECTIVE_OPS:
+            axis: Optional[ast.AST] = \
+                node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis = kw.value
+            self.fn_stack[-1].collectives.append(
+                (tail, node.lineno, axis_desc_of(axis)))
+        elif self.fn_stack and tail == "axis_index":
+            axis = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis = kw.value
+            self.fn_stack[-1].collectives.append(
+                ("axis_index", node.lineno, axis_desc_of(axis)))
+        if tail in _PARTITION_SPEC_TAILS:
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    self.s.spec_literals.append(
+                        (arg.value, node.lineno))
+        if name in _DEVICE_PUT_NAMES and node.args:
+            spec: Optional[ast.AST] = \
+                node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg in ("device", "sharding"):
+                    spec = kw.value
+            if spec is None:
+                desc = "none"
+            else:
+                desc = spec_kind_of(spec)
+                if desc is None:
+                    dn = dotted_name(spec)
+                    desc = f"name:{dn}" if dn else "dynamic"
+            self.s.device_puts.append(
+                (self._src_of(node.args[0], 80), desc, node.lineno,
+                 self.fn_stack[-1].qual if self.fn_stack else ""))
+        if self.fn_stack and isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "set":
+            # `pool.at[slots].set(rows, mode="drop")` — the donated
+            # fixed-shape page-in scatter
+            mode = next((kw.value for kw in node.keywords
+                         if kw.arg == "mode"), None)
+            recv = node.func.value
+            if isinstance(mode, ast.Constant) and mode.value == "drop" \
+                    and isinstance(recv, ast.Subscript) and \
+                    isinstance(recv.value, ast.Attribute) and \
+                    recv.value.attr == "at":
+                base = recv.value.value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                bname = dotted_name(base)
+                if pool_name(bname):
+                    self.fn_stack[-1].drop_scatters.append(
+                        (bname.rsplit(".", 1)[-1], node.lineno))
         if tail in _EVENT_APIS:
             idx = _EVENT_APIS[tail]
             if len(node.args) > idx:
@@ -1209,8 +1479,10 @@ _CACHE_VERSION = 1
 #: report nothing.  Bump it whenever ModuleSummary/FunctionSummary gain,
 #: lose or reinterpret a field; a mismatch discards the cache wholesale.
 #: History: 1 = flint v2 (PR 9); 2 = concurrency fact layer
-#: (lock regions, conc ops, thread spawns, signal handlers, assigns).
-SUMMARY_SCHEMA_VERSION = 2
+#: (lock regions, conc ops, thread spawns, signal handlers, assigns);
+#: 3 = mesh fact layer (collectives, slot gathers/scatters, lane and
+#: shard_map roots, sharding-spec bindings, device_put sites).
+SUMMARY_SCHEMA_VERSION = 3
 
 
 def default_cache_path(root: str) -> str:
@@ -1328,10 +1600,12 @@ def analyze(paths: List[str], root: Optional[str] = None,
     checkers (schema-drift, guard-matrix, event-schema,
     transfer-budget) — the incremental mode's call when none of their
     inputs changed."""
-    from . import (atomic_write, donation, event_schema, guard_matrix,
-                   host_sync, jit_purity, lock_discipline, pallas_shape,
-                   put_loop, recompile_hazard, schema_drift, shard_ready,
-                   signal_safety, thread_escape, transfer_budget)
+    from . import (atomic_write, collective_budget, donation,
+                   event_schema, guard_matrix, host_sync, jit_purity,
+                   lock_discipline, mesh_axis, pallas_shape, put_loop,
+                   recompile_hazard, schema_drift, shard_locality,
+                   shard_ready, signal_safety, spec_drift,
+                   thread_escape, transfer_budget)
 
     root = os.path.abspath(root or os.getcwd())
     files = _iter_py_files(paths)
@@ -1389,6 +1663,8 @@ def analyze(paths: List[str], root: Optional[str] = None,
         (recompile_hazard.RULE,
          lambda i: recompile_hazard.check(i, project)),
         (atomic_write.RULE, atomic_write.check),
+        (mesh_axis.RULE, lambda i: mesh_axis.check(i, project)),
+        (spec_drift.RULE, lambda i: spec_drift.check(i, project)),
     ]
     for rel in sorted(infos):
         info = infos[rel]
@@ -1420,6 +1696,12 @@ def analyze(paths: List[str], root: Optional[str] = None,
         if rules is None or thread_escape.RULE in rules:
             findings.extend(thread_escape.check_project(
                 project, emit_paths=emit))
+        if rules is None or shard_locality.RULE in rules:
+            findings.extend(shard_locality.check_project(
+                project, emit_paths=emit))
+        if rules is None or collective_budget.RULE in rules:
+            findings.extend(collective_budget.check_project(
+                root, project))
         # project-checker findings live in .py/.md files that may carry
         # inline pragmas; .md pragmas are not a thing, which is fine
         # because the actionable end of a doc drift is the doc itself.
@@ -1432,7 +1714,8 @@ def analyze(paths: List[str], root: Optional[str] = None,
     project_rules = {transfer_budget.RULE, schema_drift.RULE,
                      guard_matrix.RULE, event_schema.RULE,
                      signal_safety.RULE, lock_discipline.RULE,
-                     thread_escape.RULE}
+                     thread_escape.RULE, shard_locality.RULE,
+                     collective_budget.RULE}
     if not with_project_checkers:
         active -= project_rules
     else:
@@ -1446,5 +1729,8 @@ def analyze(paths: List[str], root: Optional[str] = None,
         if not os.path.exists(os.path.join(root, "docs",
                                            "observability.md")):
             active.discard(event_schema.RULE)
+        if not os.path.exists(os.path.join(root, "docs",
+                                           "architecture.md")):
+            active.discard(collective_budget.RULE)
     return apply_suppressions(findings, suppressions,
                               active_rules=active)

@@ -29,6 +29,11 @@ dodge this rule:
 - every incompatibility the DOCS promise ("Refused/Incompatible with
   `X`") must appear in some runtime refusal or schema check for that
   block — the code can't silently drop a documented guard;
+- every COMPOSITION the docs promise ("Composes with `X` ...
+  (`tests/test_y.py`)") must cite a test file, and the cited file must
+  actually exercise each composed :data:`VOCAB` token — a compatibility
+  claim nobody tests is the refusal matrix's mirror-image failure
+  (the pair runs, silently wrong, instead of refusing);
 - blocks in :data:`SCHEMA_GUARDED` must keep their config-load-time
   strategy check in ``schema.py``.
 
@@ -60,7 +65,10 @@ VOCAB = ("wantRL", "scaffold", "ef_quant", "personalization",
          "secure_agg", "input_staging", "fused_carry", "stale_prob",
          "fedavg", "fedprox",
          # cross-client megabatching refusal tokens (PR 16)
-         "apply_metrics", "fedlabels", "pallas_apply")
+         "apply_metrics", "fedlabels", "pallas_apply",
+         # fleet/mesh-era composition tokens (PR 17): strategies that
+         # pre-bucket their cohort and the paged-carry interplay
+         "wants_cohort")
 
 #: blocks whose strategy incompatibility is decidable at config load —
 #: schema.py must carry the bespoke check (the quiet-failure rule)
@@ -72,6 +80,13 @@ MARKER_SUFFIX = "_rounds"
 
 _DOC_REFUSAL_RE = re.compile(
     r"(refused with|incompatible with|rejected under)", re.I)
+
+#: composition-claim sentence start / end-of-claim boundaries (the
+#: refusal sentence usually follows in the SAME paragraph)
+_COMPOSE_RE = re.compile(r"composes with", re.I)
+_COMPOSE_END_RE = re.compile(
+    r"Refused with|Requires |Incompatible with|Rejected under")
+_TEST_CITE_RE = re.compile(r"`(tests/[\w\-/]+\.py)`")
 
 
 def _parse(path: str, trees: Optional[Dict[str, ast.Module]],
@@ -286,7 +301,13 @@ def check_project(root: str,
                 if not sec_lines[j].strip():
                     break
                 chunk.append(sec_lines[j])
-            for token in _tokens_in(" ".join(chunk)):
+            joined = " ".join(chunk)
+            # a composition sentence sharing the paragraph is NOT part
+            # of the refusal list (layer 5 owns its tokens)
+            comp = _COMPOSE_RE.search(joined)
+            if comp is not None:
+                joined = joined[:comp.start()]
+            for token in _tokens_in(joined):
                 doc_tokens.append((sec_line + i, token))
         enforced = " ".join(text for _, _, text in raises) + " " + \
             " ".join(s for s in schema_strings if block in s)
@@ -302,7 +323,60 @@ def check_project(root: str,
                          "unenforced compatibility table is how silent "
                          "corruption ships"))
 
-    # ---- 5. schema bespoke layer -------------------------------------
+        # ---- 5. composition claims are exercised by the cited test ---
+        # "Composes with A, B (`tests/test_x.py`)" is a promise with the
+        # same weight as a refusal: each VOCAB token in the claim must
+        # appear in the cited test file (the composition-case suite),
+        # and the claim must cite one at all.
+        blob = " ".join(sec_lines)
+        for m in _COMPOSE_RE.finditer(blob):
+            end = _COMPOSE_END_RE.search(blob, m.end())
+            chunk = blob[m.start():end.start() if end else len(blob)]
+            comp_tokens = _tokens_in(chunk)
+            claim_line = sec_line
+            for i, line in enumerate(sec_lines):
+                if _COMPOSE_RE.search(line):
+                    claim_line = sec_line + i
+                    break
+            cite = _TEST_CITE_RE.search(chunk)
+            if cite is None:
+                if comp_tokens:
+                    findings.append(Finding(
+                        RULE, rel_doc, claim_line,
+                        f"`server_config.{block}` claims to compose "
+                        f"with {', '.join(f'`{t}`' for t in comp_tokens)}"
+                        " but cites no test file for the claim",
+                        hint="append the composition suite citation "
+                             "(`tests/test_<block>.py`) the other "
+                             "blocks carry — an uncited composition "
+                             "claim is unfalsifiable"))
+                continue
+            cite_path = os.path.join(root, cite.group(1))
+            if not os.path.exists(cite_path):
+                findings.append(Finding(
+                    RULE, rel_doc, claim_line,
+                    f"`server_config.{block}`'s composition claim "
+                    f"cites `{cite.group(1)}`, which does not exist",
+                    hint="fix the citation or add the suite"))
+                continue
+            with open(cite_path, "r", encoding="utf-8") as fh:
+                cite_src = fh.read()
+            for token in comp_tokens:
+                if token not in cite_src:
+                    findings.append(Finding(
+                        RULE, rel_doc, claim_line,
+                        f"docs promise `server_config.{block}` composes "
+                        f"with `{token}`, but the cited "
+                        f"`{cite.group(1)}` never exercises that "
+                        "config-key combination",
+                        hint="add the composition case (the suite's "
+                             "COMPOSE_CASES pattern: run the pair, "
+                             "assert bitwise parity with the unfused "
+                             "path) or drop the claim — an untested "
+                             "composition promise ships the silent "
+                             "version of a missing refusal"))
+
+    # ---- 6. schema bespoke layer -------------------------------------
     for block in SCHEMA_GUARDED:
         if server_keys and block not in server_keys:
             continue  # a fork that dropped the block owes no guard

@@ -1,0 +1,69 @@
+"""mesh-axis — collectives and specs name the canonical axis constants.
+
+The fleet transfer plane hangs off ONE ``(clients, model)`` mesh whose
+axis names are module constants (``parallel.mesh.CLIENTS_AXIS`` /
+``MODEL_AXIS``).  A collective or ``P(...)`` spec spelled with a bare
+string literal (``psum(x, "clients")``, ``P("clients")``) still runs —
+until someone renames the axis, adds a second mesh, or copies the
+string with a typo, at which point the program either crashes at trace
+time (best case) or silently reduces over the WRONG axis (worst case:
+a cross-client psum over the model axis averages unrelated shards).
+The constants exist so that grep — and this rule — can prove every
+collective targets the axis the layout doc says it does.
+
+Scope: ``engine/``, ``parallel/``, ``strategies/`` — the modules that
+own the mesh.  ``ops/`` kernels take their axis name as a PARAMETER
+(axis-polymorphic library code) and are deliberately out of scope:
+their axis argument classifies as ``dynamic``, never as a literal.
+
+Facts come from the mesh fact layer (``FunctionSummary.collectives``,
+``ModuleSummary.spec_literals``) — one summary walk, shared with
+shard-locality and collective-budget.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .core import (Finding, ModuleInfo, Project, compute_module_summary)
+
+RULE = "mesh-axis"
+
+_SCOPE_PARTS = ("engine", "parallel", "strategies")
+
+
+def _in_scope(info: ModuleInfo) -> bool:
+    parts = info.path.split("/")
+    return any(p in parts for p in _SCOPE_PARTS)
+
+
+def check(info: ModuleInfo,
+          project: Optional[Project] = None) -> List[Finding]:
+    if not _in_scope(info):
+        return []
+    summary = project.modules.get(info.path) if project else None
+    if summary is None:
+        summary = compute_module_summary(info)
+    findings: List[Finding] = []
+    for fn in summary.functions.values():
+        for op, line, axis in fn.collectives:
+            if not axis.startswith("literal:"):
+                continue
+            lit = axis.split(":", 1)[1]
+            findings.append(Finding(
+                RULE, info.path, line,
+                f"collective `{op}` names its mesh axis with the bare "
+                f"string literal '{lit}' in `{fn.qual}`",
+                hint="spell the axis with the canonical constant "
+                     "(parallel.mesh.CLIENTS_AXIS / MODEL_AXIS): a "
+                     "renamed or second mesh axis turns the stray "
+                     "string into a wrong-axis reduction"))
+    for lit, line in summary.spec_literals:
+        findings.append(Finding(
+            RULE, info.path, line,
+            f"PartitionSpec names its mesh axis with the bare string "
+            f"literal '{lit}'",
+            hint="use P(CLIENTS_AXIS) / P(MODEL_AXIS) — the constants "
+                 "keep every spec greppable and rename-safe against "
+                 "the one mesh definition in parallel/mesh.py"))
+    return findings

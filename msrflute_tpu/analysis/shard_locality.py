@@ -1,0 +1,168 @@
+"""shard-locality — collectives live at the shard boundary, not in
+lanes; shard_map gathers are block-local.
+
+PR 15/16's layout contract, until now a comment: the vmapped/scanned
+PER-CLIENT (per-lane) body of a round program never communicates —
+every cross-client reduction happens once, in the finalize/combine
+region at the top of the ``shard_map`` body.  A ``psum`` inside the
+lane body runs per lane step (K collectives per round instead of one)
+and, worse, couples lanes that the megabatch tape planner proved
+independent.  And inside a ``shard_map`` body, a carry-table gather
+must index by BLOCK-LOCAL slot ids: the engine converts global slot
+ids with the ``axis_index`` idiom (``off = axis_index(CLIENTS_AXIS) *
+shard_slots; slots - off``) — a gather by raw global ids reads out of
+bounds on every shard but 0 (clipped: silently wrong rows; the exact
+pre-PR-15 replicated-pool shape).
+
+Two checks, both on the project call graph:
+
+1. **lane collectives** — from every vmap/scan root
+   (``ModuleSummary.lane_roots``) in ``engine//strategies/``, the call
+   closure must contain NO collective (``axis_index`` excluded — it is
+   the conversion idiom, not communication).  Each violation names the
+   lane-root path, transfer-budget style.
+2. **shard_map gather locality** — from every ``shard_map`` root in
+   ``engine/``, a closure containing pool-table gathers
+   (``slot_gathers``) must carry shard-local evidence: an
+   ``axis_index`` call (the global->local conversion), a
+   ``mode="drop"`` sentinel scatter (the fixed-shape page-in), or a
+   ``shard_slots``/local-ids marker in the body's or its BUILDER
+   function's bindings (``hi = self.shard_slots if ...`` — the paging
+   gather clamp).  A gather with none of these is indexing the pool by
+   global ids.
+
+GSPMD-mode dispatch (no ``shard_map``; the partitioner places the
+collectives) never registers roots here and is unjudged — the runtime
+equivalence suite (``tests/test_fleet_mesh.py``) owns that mode.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Set, Tuple
+
+from .core import Finding, Project
+
+RULE = "shard-locality"
+
+#: lane roots are judged where the round programs live
+_SCOPE_PARTS = ("engine", "strategies")
+#: shard_map gather audit: the carry/paging plumbing is engine-only
+_SHARDMAP_PARTS = ("engine",)
+
+#: bindings/attribute reads that mark a shard_map body (or its builder)
+#: as reasoning in BLOCK-LOCAL slot coordinates
+_SHARD_LOCAL_RE = re.compile(
+    r"(shard_slots|shard_local|local_ids|local_slots)")
+
+
+def _has_part(path: str, parts: Tuple[str, ...]) -> bool:
+    segs = path.split("/")
+    return any(p in segs for p in parts)
+
+
+def _resolve_root(project: Project, path: str, ref: str,
+                  cls: Optional[str], builder_qual: str):
+    """A nested body handed to vmap/scan/shard_map resolves in its
+    BUILDER's scope first — round.py defines one ``shard_body`` per
+    builder method, and the module-wide last-def name index would
+    conflate them all onto the final definition."""
+    if builder_qual and "." not in ref:
+        nested = builder_qual + "." + ref
+        mod = project.modules.get(path)
+        if mod is not None and nested in mod.functions:
+            return (path, nested)
+    return project.resolve(path, ref, cls)
+
+
+def check_project(project: Project,
+                  emit_paths: Optional[Set[str]] = None
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # ---- 1. lane closures are collective-free ------------------------
+    lane_roots = []
+    for path, mod in project.modules.items():
+        if not _has_part(path, _SCOPE_PARTS):
+            continue
+        for ref, cls, builder_qual in mod.lane_roots:
+            resolved = _resolve_root(project, path, ref, cls,
+                                     builder_qual)
+            if resolved:
+                lane_roots.append(resolved)
+    if lane_roots:
+        parents = project.reachable_from(sorted(set(lane_roots)))
+        for key in sorted(parents):
+            fn = project.function(key)
+            if fn is None or not _has_part(fn.module, _SCOPE_PARTS):
+                continue
+            if emit_paths is not None and fn.module not in emit_paths:
+                continue
+            chain = project.call_path(parents, key)
+            via = f" (lane path: {' -> '.join(chain)})" \
+                if len(chain) > 1 else ""
+            for op, line, _axis in fn.collectives:
+                if op == "axis_index":
+                    continue
+                findings.append(Finding(
+                    RULE, fn.module, line,
+                    f"collective `{op}` inside the vmapped/scanned "
+                    f"per-lane body `{fn.qual}` — one collective PER "
+                    "LANE STEP instead of one per round" + via,
+                    hint="hoist the reduction to the finalize/combine "
+                         "region of the shard_map body (the sanctioned "
+                         "collective site); lane bodies must stay "
+                         "communication-free so the tape planner's "
+                         "independence proof holds"))
+
+    # ---- 2. shard_map gathers are block-local ------------------------
+    for path, mod in sorted(project.modules.items()):
+        if not _has_part(path, _SHARDMAP_PARTS):
+            continue
+        for ref, cls, builder_qual, _line in mod.shardmap_roots:
+            resolved = _resolve_root(project, path, ref, cls,
+                                     builder_qual)
+            if resolved is None:
+                continue
+            parents = project.reachable_from([resolved])
+            gathers = []
+            evidence = False
+            for key in parents:
+                fn = project.function(key)
+                if fn is None:
+                    continue
+                gathers.extend((fn, g) for g in fn.slot_gathers)
+                if fn.drop_scatters or any(
+                        op == "axis_index"
+                        for op, _l, _a in fn.collectives):
+                    evidence = True
+                blob = " ".join(fn.local_assigns) + " " + \
+                    " ".join(fn.local_assigns.values()) + " " + \
+                    " ".join(fn.self_reads)
+                if _SHARD_LOCAL_RE.search(blob):
+                    evidence = True
+            builder = mod.functions.get(builder_qual)
+            if builder is not None and not evidence:
+                blob = " ".join(builder.local_assigns) + " " + \
+                    " ".join(builder.local_assigns.values()) + " " + \
+                    " ".join(builder.self_reads)
+                if _SHARD_LOCAL_RE.search(blob):
+                    evidence = True
+            if evidence:
+                continue
+            for fn, (base, slice_src, line) in gathers:
+                if emit_paths is not None and \
+                        fn.module not in emit_paths:
+                    continue
+                findings.append(Finding(
+                    RULE, fn.module, line,
+                    f"carry-table gather `{base}[{slice_src}]` inside "
+                    f"shard_map body `{fn.qual}` indexes by GLOBAL "
+                    "slot ids — out of bounds (clipped: wrong rows) on "
+                    "every shard but 0",
+                    hint="convert to block-local ids first (`off = "
+                         "axis_index(CLIENTS_AXIS) * shard_slots; "
+                         "slots - off`) or gather through the pager's "
+                         "shard_slots-clamped path — the slot a lane "
+                         "uses lives on the lane's own shard"))
+    return findings

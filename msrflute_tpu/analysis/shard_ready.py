@@ -30,7 +30,6 @@ traced body in ANOTHER module is still judged traced.
 from __future__ import annotations
 
 import ast
-import re
 from typing import List, Optional, Set
 
 from .core import (Finding, ModuleInfo, Project, call_name,
@@ -132,94 +131,6 @@ def _check_traced_branches(info: ModuleInfo, traced_quals: Set[str],
                     break
 
 
-#: identifier tokens that mark a SLOT-AXIS table (the fleet page pool,
-#: carry-row buffers): a replicated NamedSharding on one of these in an
-#: engine/ hot path is the replicated-pool bug class — page-in bytes,
-#: writeback fetches, and pool HBM all multiply by mesh size instead of
-#: dividing (``parallel.sharding.slot_pool_sharding`` is the fix)
-_POOL_TOKENS = frozenset({"row", "rows", "pool", "slot", "slots",
-                          "table", "tables"})
-_TOKEN_SPLIT = re.compile(r"[^a-zA-Z0-9]+")
-
-
-def _pool_name(name: Optional[str]) -> bool:
-    if not name:
-        return False
-    return any(tok in _POOL_TOKENS
-               for tok in _TOKEN_SPLIT.split(name.lower()))
-
-
-def _name_of(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return None
-
-
-def _is_replicated_spec_call(node: ast.AST) -> bool:
-    """``NamedSharding(mesh, P())`` — a replicated spec construction
-    (``P()``/``PartitionSpec()`` with no axis arguments)."""
-    if not isinstance(node, ast.Call) or \
-            (call_name(node) or "").split(".")[-1] != "NamedSharding" or \
-            len(node.args) < 2:
-        return False
-    spec = node.args[1]
-    return isinstance(spec, ast.Call) and \
-        (call_name(spec) or "").split(".")[-1] in ("P", "PartitionSpec") \
-        and not spec.args and not spec.keywords
-
-
-def _check_replicated_pool(info: ModuleInfo,
-                           findings: List[Finding]) -> None:
-    """Replicated slot-axis tables in engine/ hot paths: a
-    ``NamedSharding(mesh, P())`` bound to (or device_put onto) a
-    pool/rows/slots/table value makes every device carry — and every
-    page-in/writeback move — the WHOLE pool instead of its shard.  The
-    sharded spec (``slot_pool_sharding`` / ``P(CLIENTS_AXIS)``) stays
-    silent."""
-    if "engine" not in info.path.split("/"):
-        return
-    replicated_names: Set[str] = set()
-    for node in ast.walk(info.tree):
-        if isinstance(node, ast.Assign) and \
-                _is_replicated_spec_call(node.value):
-            for tgt in node.targets:
-                name = _name_of(tgt)
-                if name:
-                    replicated_names.add(name)
-                if name and _pool_name(name):
-                    findings.append(Finding(
-                        RULE, info.path, node.lineno,
-                        f"slot-axis table spec `{name}` is a REPLICATED "
-                        "NamedSharding — the page pool's slot axis must "
-                        "shard over the clients mesh axis",
-                        hint="use parallel.sharding.slot_pool_sharding "
-                             "(P(CLIENTS_AXIS) on axis 0): per-device "
-                             "pool HBM and page-in/writeback bytes "
-                             "become total/mesh_size instead of "
-                             "xmesh_size"))
-    for node in ast.walk(info.tree):
-        if not isinstance(node, ast.Call) or \
-                (call_name(node) or "").split(".")[-1] != "device_put" \
-                or len(node.args) < 2:
-            continue
-        target_name = _name_of(node.args[0])
-        if not _pool_name(target_name):
-            continue
-        spec = node.args[1]
-        if _is_replicated_spec_call(spec) or \
-                _name_of(spec) in replicated_names:
-            findings.append(Finding(
-                RULE, info.path, node.lineno,
-                f"device_put of slot-axis table `{target_name}` with a "
-                "replicated sharding — every device receives the whole "
-                "pool buffer (bytes x mesh_size)",
-                hint="stage pool rows with slot_pool_sharding "
-                     "(P(CLIENTS_AXIS)): each device then receives "
-                     "only its shard's segment, total/mesh_size bytes"))
-
-
 def check(info: ModuleInfo,
           project: Optional[Project] = None) -> List[Finding]:
     if not _in_scope(info):
@@ -244,5 +155,7 @@ def check(info: ModuleInfo,
         for stmt in fn_node.body:
             walker.visit(stmt)
     _check_traced_branches(info, traced_quals, findings)
-    _check_replicated_pool(info, findings)
+    # the replicated-pool check moved to spec-drift (the mesh fact
+    # layer sees spec bindings through self-attrs and named specs this
+    # rule's lexical scan could not)
     return findings

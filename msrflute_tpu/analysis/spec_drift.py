@@ -1,0 +1,103 @@
+"""spec-drift — the pool's sharding spec, consistent end to end.
+
+Fleet mode's whole HBM story is one line: the page pool's slot axis is
+SHARDED over the clients mesh axis (``parallel.sharding.
+slot_pool_sharding`` = ``NamedSharding(mesh, P(CLIENTS_AXIS))``), so
+per-device pool bytes, page-in slices and writeback fetches are all
+``total / mesh_size``.  The failure mode is quiet: a replicated spec
+still RUNS — every device just carries (and every transfer moves) the
+whole pool, an x``mesh_size`` regression no test notices on a 1-device
+CI mesh.  This rule pins the spec statically, in ``engine/``:
+
+- **replicated pool binding** — a ``NamedSharding(mesh, P())`` (or
+  ``replicated_sharding(mesh)``) bound to a pool/rows/slots/table
+  name, including ``self._pool_spec = ...`` attribute bindings;
+- **replicated pool put** — ``device_put`` of a pool-named value whose
+  spec argument is replicated, constructed inline or resolved through
+  a named binding.  When the module ALSO binds a clients-sharded spec,
+  the message calls out the drift — the table was annotated
+  ``P(CLIENTS_AXIS)`` somewhere and reached a dispatch site built
+  ``P()``;
+- **unsharded pool put** — ``device_put`` of a pool-named value with
+  NO sharding argument at all: the table lands wherever jax defaults
+  it (device 0, replicated under jit), invisible to the mesh.
+
+Subsumes and extends shard-ready's replicated-pool check (moved here
+so the pool-spec story lives under one rule id).  The sharded idiom —
+``slot_pool_sharding`` / ``P(CLIENTS_AXIS)`` — stays silent, as does
+everything outside ``engine/`` (model-parallel specs in ``parallel/``
+legitimately replicate small leaves).
+
+Facts come from the mesh fact layer (``ModuleSummary.spec_bindings`` /
+``device_puts``); no re-parse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .core import (Finding, ModuleInfo, Project, compute_module_summary,
+                   pool_name)
+
+RULE = "spec-drift"
+
+
+def check(info: ModuleInfo,
+          project: Optional[Project] = None) -> List[Finding]:
+    if "engine" not in info.path.split("/"):
+        return []
+    summary = project.modules.get(info.path) if project else None
+    if summary is None:
+        summary = compute_module_summary(info)
+    findings: List[Finding] = []
+
+    kinds: Dict[str, str] = {}       # bound name -> last spec kind
+    has_clients_binding = False
+    for name, kind, line in summary.spec_bindings:
+        kinds[name] = kind
+        # both `pool_spec = ...` and `self._pool_spec = ...` resolve a
+        # later bare/attr reference
+        kinds[name.rsplit(".", 1)[-1]] = kind
+        if kind == "clients":
+            has_clients_binding = True
+        if kind == "replicated" and pool_name(name):
+            findings.append(Finding(
+                RULE, info.path, line,
+                f"slot-axis table spec `{name}` is a REPLICATED "
+                "NamedSharding — the page pool's slot axis must shard "
+                "over the clients mesh axis",
+                hint="use parallel.sharding.slot_pool_sharding "
+                     "(P(CLIENTS_AXIS) on axis 0): per-device pool HBM "
+                     "and page-in/writeback bytes become "
+                     "total/mesh_size instead of xmesh_size"))
+
+    for target, desc, line, _qual in summary.device_puts:
+        if not pool_name(target.split("(")[0].split("[")[0]):
+            continue
+        kind = desc
+        if desc.startswith("name:"):
+            ref = desc.split(":", 1)[1]
+            kind = kinds.get(ref, kinds.get(ref.rsplit(".", 1)[-1], ""))
+        if kind == "replicated":
+            drift = (" — the module binds a clients-sharded spec "
+                     "elsewhere, so this dispatch site drifted from "
+                     "the table's annotation") if has_clients_binding \
+                else ""
+            findings.append(Finding(
+                RULE, info.path, line,
+                f"device_put of slot-axis table `{target}` with a "
+                "replicated sharding — every device receives the whole "
+                f"pool buffer (bytes x mesh_size){drift}",
+                hint="stage pool rows with slot_pool_sharding "
+                     "(P(CLIENTS_AXIS)): each device then receives "
+                     "only its shard's segment, total/mesh_size bytes"))
+        elif desc == "none":
+            findings.append(Finding(
+                RULE, info.path, line,
+                f"device_put of slot-axis table `{target}` with NO "
+                "sharding — the table lands replicated/on device 0, "
+                "invisible to the mesh layout",
+                hint="pass the pool's sharding explicitly "
+                     "(slot_pool_sharding(mesh)); an unsharded put is "
+                     "how the replicated-pool regression ships"))
+    return findings

@@ -1169,8 +1169,9 @@ def test_shard_ready_vmap_width_and_cold_paths_are_fine(tmp_path):
     assert found == []
 
 
-def test_shard_ready_flags_replicated_pool_spec_binding(tmp_path):
-    # the PR 14 bug class: a slot-axis table pinned to NamedSharding(
+def test_spec_drift_flags_replicated_pool_spec_binding(tmp_path):
+    # the PR 14 bug class (formerly shard-ready's check — moved to the
+    # mesh fact layer): a slot-axis table pinned to NamedSharding(
     # mesh, P()) — pool HBM and page-in bytes go xmesh_size
     found = run_on(tmp_path, "engine/pager.py", """\
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1178,12 +1179,12 @@ def test_shard_ready_flags_replicated_pool_spec_binding(tmp_path):
         class Pool:
             def __init__(self, mesh):
                 self.pool_spec = NamedSharding(mesh, P())
-        """, rules=["shard-ready"])
-    assert rules_of(found) == ["shard-ready"]
+        """, rules=["spec-drift"])
+    assert rules_of(found) == ["spec-drift"]
     assert "REPLICATED" in found[0].message
 
 
-def test_shard_ready_flags_replicated_put_of_row_buffer(tmp_path):
+def test_spec_drift_flags_replicated_put_of_row_buffer(tmp_path):
     found = run_on(tmp_path, "engine/pager.py", """\
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1191,35 +1192,36 @@ def test_shard_ready_flags_replicated_put_of_row_buffer(tmp_path):
         def page_in(mesh, rows):
             rep = NamedSharding(mesh, P())
             return jax.device_put(rows, rep)
-        """, rules=["shard-ready"])
-    assert rules_of(found) == ["shard-ready"]
+        """, rules=["spec-drift"])
+    assert rules_of(found) == ["spec-drift"]
     assert "device_put of slot-axis table" in found[0].message
 
 
-def test_shard_ready_sharded_pool_spec_is_fine(tmp_path):
+def test_spec_drift_sharded_pool_spec_is_fine(tmp_path):
     # the sharded spec (P over the clients axis) stays silent, as do
     # replicated specs bound to non-table names and non-engine modules
     found = run_on(tmp_path, "engine/pager.py", """\
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
 
         def page_in(mesh, rows, scalars):
-            pool_spec = NamedSharding(mesh, P("clients"))
+            pool_spec = NamedSharding(mesh, P(CLIENTS_AXIS))
             replicated = NamedSharding(mesh, P())
             dev = jax.device_put(rows, pool_spec)
             return dev, jax.device_put(scalars, replicated)
-        """, rules=["shard-ready"])
+        """, rules=["spec-drift"])
     assert found == []
 
 
-def test_shard_ready_replicated_pool_outside_engine_is_fine(tmp_path):
+def test_spec_drift_replicated_pool_outside_engine_is_fine(tmp_path):
     found = run_on(tmp_path, "tools/mod.py", """\
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def stage(mesh, rows):
             return jax.device_put(rows, NamedSharding(mesh, P()))
-        """, rules=["shard-ready"])
+        """, rules=["spec-drift"])
     assert found == []
 
 
@@ -1871,6 +1873,11 @@ def test_cli_sarif_format(tmp_path, capsys):
         "artifactLocation"]["uri"] == "engine/mod.py"
     assert result["partialFingerprints"]["flintFindingId/v1"].startswith(
         "host-sync-")
+    # the driver's rule table carries EVERY registered rule (so SARIF
+    # consumers see the mesh rules even on runs with no mesh findings)
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"mesh-axis", "shard-locality", "spec-drift",
+            "collective-budget"} <= ids
 
 
 def test_cli_changed_mode_scopes_to_git_diff(tmp_path, capsys):
@@ -2793,3 +2800,558 @@ def test_thread_escape_string_literal_displays_are_fine(tmp_path):
                                  name="writer").start()
                 self._box = ("stop", 0)
         """, rules=["thread-escape"]) == []
+
+
+# ======================================================================
+# flint-mesh: mesh-axis
+# ======================================================================
+def test_mesh_axis_flags_string_literal_collective(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def finalize(local):
+            return jax.lax.psum(local, "clients")
+        """, rules=["mesh-axis"])
+    assert rules_of(found) == ["mesh-axis"]
+    assert "'clients'" in found[0].message
+    assert "CLIENTS_AXIS" in found[0].hint
+
+
+def test_mesh_axis_flags_partition_spec_literal(tmp_path):
+    # P("clients") in parallel/ — the module that DEFINES the constants
+    # has no excuse to spell the string
+    found = run_on(tmp_path, "parallel/mod.py", """\
+        from jax.sharding import PartitionSpec as P
+
+        def pool_spec():
+            return P("clients")
+        """, rules=["mesh-axis"])
+    assert rules_of(found) == ["mesh-axis"]
+    assert "PartitionSpec" in found[0].message
+
+
+def test_mesh_axis_constant_axis_and_specs_are_fine(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def finalize(local):
+            spec = P(CLIENTS_AXIS)
+            off = jax.lax.axis_index(CLIENTS_AXIS)
+            return jax.lax.psum(local, CLIENTS_AXIS), spec, off
+        """, rules=["mesh-axis"])
+    assert found == []
+
+
+def test_mesh_axis_parameterized_kernels_and_ops_are_fine(tmp_path):
+    # an axis passed as a PARAMETER classifies dynamic (ops/-style
+    # axis-polymorphic library code), and ops/ is out of scope entirely
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+
+        def reduce_over(x, axis_name):
+            return jax.lax.psum(x, axis_name)
+        """, rules=["mesh-axis"])
+    assert found == []
+    found = run_on(tmp_path, "ops/mod.py", """\
+        import jax
+
+        def kernel(x):
+            return jax.lax.psum(x, "clients")
+        """, rules=["mesh-axis"])
+    assert found == []
+
+
+# ======================================================================
+# flint-mesh: shard-locality
+# ======================================================================
+def test_shard_locality_flags_collective_in_lane_body(tmp_path):
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def build():
+            def per_client(x):
+                return jax.lax.psum(x, CLIENTS_AXIS)
+            return jax.vmap(per_client)
+        """, rules=["shard-locality"])
+    assert rules_of(found) == ["shard-locality"]
+    assert "per-lane body" in found[0].message
+    assert "PER LANE STEP" in found[0].message
+
+
+def test_shard_locality_flags_lane_collective_via_call_graph(tmp_path):
+    # the collective hides one call deep in the lane closure
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def reduce_now(y):
+            return jax.lax.psum(y, CLIENTS_AXIS)
+
+        def build():
+            def scan_body(carry, x):
+                return carry, reduce_now(x)
+            return jax.lax.scan(scan_body, 0.0)
+        """, rules=["shard-locality"])
+    assert rules_of(found) == ["shard-locality"]
+    assert "lane path:" in found[0].message
+
+
+def test_shard_locality_flags_global_slot_gather_in_shard_map(tmp_path):
+    # the pre-PR-15 replicated-pool shape: shard_map body gathers the
+    # carry table by RAW global slot ids, no conversion in sight
+    found = run_on(tmp_path, "engine/mod.py", """\
+        from jax.experimental.shard_map import shard_map
+
+        def build(mesh):
+            def shard_body(slots, pool):
+                return pool[slots]
+            return shard_map(shard_body, mesh=mesh)
+        """, rules=["shard-locality"])
+    assert rules_of(found) == ["shard-locality"]
+    assert "GLOBAL slot ids" in found[0].message
+
+
+def test_shard_locality_axis_index_conversion_sanctions_gather(tmp_path):
+    # the PR-15 engine idiom: shard_entry converts global->block-local
+    # with axis_index before the body gathers — silent
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def build(mesh, shard_width):
+            def shard_body(slots, pool):
+                return pool[slots]
+
+            def shard_entry(slots, pool):
+                off = jax.lax.axis_index(CLIENTS_AXIS) * shard_width
+                local = slots - off
+                return shard_body(local, pool)
+            return shard_map(shard_entry, mesh=mesh)
+        """, rules=["shard-locality"])
+    assert found == []
+
+
+def test_shard_locality_builder_shard_slots_clamp_sanctions(tmp_path):
+    # the pager's shape: the BUILDER reasons in shard-local widths
+    # (`hi = self.shard_slots if split else n_slots`), the body's
+    # gather rides that clamp
+    found = run_on(tmp_path, "engine/mod.py", """\
+        from jax.experimental.shard_map import shard_map
+
+        class Pager:
+            def build_gather(self, mesh, split):
+                hi = self.shard_slots if split else self.n_slots
+
+                def shard_body(slots, pool):
+                    return pool[slots]
+                return shard_map(shard_body, mesh=mesh)
+        """, rules=["shard-locality"])
+    assert found == []
+
+
+def test_shard_locality_shard_level_collective_is_fine(tmp_path):
+    # the sanctioned layout: lanes stay communication-free, the psum
+    # happens once at the shard_map body level
+    found = run_on(tmp_path, "engine/mod.py", """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def build(mesh):
+            def per_client(x):
+                return x * 2.0
+
+            def shard_body(xs):
+                ys = jax.vmap(per_client)(xs)
+                return jax.lax.psum(ys, CLIENTS_AXIS)
+            return shard_map(shard_body, mesh=mesh)
+        """, rules=["shard-locality"])
+    assert found == []
+
+
+# ======================================================================
+# flint-mesh: spec-drift (beyond the migrated replicated-pool cases)
+# ======================================================================
+def test_spec_drift_flags_unsharded_pool_put(tmp_path):
+    found = run_on(tmp_path, "engine/pager.py", """\
+        import jax
+
+        def stage(rows):
+            return jax.device_put(rows)
+        """, rules=["spec-drift"])
+    assert rules_of(found) == ["spec-drift"]
+    assert "NO sharding" in found[0].message
+
+
+def test_spec_drift_names_the_drift_when_clients_spec_exists(tmp_path):
+    # the table was annotated P(CLIENTS_AXIS) somewhere in the module,
+    # but the dispatch site resolves a REPLICATED named binding
+    found = run_on(tmp_path, "engine/pager.py", """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def page_in(mesh, rows):
+            pool_spec = NamedSharding(mesh, P(CLIENTS_AXIS))
+            rep = NamedSharding(mesh, P())
+            return jax.device_put(rows, rep)
+        """, rules=["spec-drift"])
+    assert rules_of(found) == ["spec-drift"]
+    assert "drifted" in found[0].message
+
+
+def test_spec_drift_helper_constructed_spec_is_fine(tmp_path):
+    # the blessed helper (parallel.sharding.slot_pool_sharding) and a
+    # put through its binding are the PR-15 idiom — silent
+    found = run_on(tmp_path, "engine/pager.py", """\
+        import jax
+        from msrflute_tpu.parallel.sharding import slot_pool_sharding
+
+        def page_in(mesh, rows):
+            pool_spec = slot_pool_sharding(mesh)
+            return jax.device_put(rows, pool_spec)
+        """, rules=["spec-drift"])
+    assert found == []
+
+
+def test_spec_drift_non_pool_unsharded_put_is_fine(tmp_path):
+    # an unsharded put of a non-table value (scalars, params) is
+    # host-sync/put-loop territory, not a pool-spec drift
+    found = run_on(tmp_path, "engine/pager.py", """\
+        import jax
+
+        def stage(params):
+            return jax.device_put(params)
+        """, rules=["spec-drift"])
+    assert found == []
+
+
+# ======================================================================
+# flint-mesh: collective-budget
+# ======================================================================
+_BUDGET_DOC = """\
+    # architecture
+
+    Collective budget — the round path's cross-shard sites, costed:
+
+    - `engine/round.py`: `psum` x1, `axis_index` x1
+
+    Other sections follow.
+    """
+
+_BUDGET_CODE = """\
+    import jax
+    from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+    def run_round(local, slots, width):
+        off = jax.lax.axis_index(CLIENTS_AXIS) * width
+        return jax.lax.psum(local, CLIENTS_AXIS), slots - off
+    """
+
+
+def test_collective_budget_matching_census_passes(tmp_path):
+    from msrflute_tpu.analysis.collective_budget import check_project
+    root = write_tree(tmp_path, {
+        "docs/architecture.md": _BUDGET_DOC,
+        "msrflute_tpu/engine/round.py": _BUDGET_CODE,
+    })
+    assert check_project(root) == []
+
+
+def test_collective_budget_flags_extra_site_with_round_path(tmp_path):
+    from msrflute_tpu.analysis.collective_budget import check_project
+    root = write_tree(tmp_path, {
+        "docs/architecture.md": _BUDGET_DOC,
+        "msrflute_tpu/engine/round.py": """\
+            import jax
+            from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+            def run_round(local, slots, width):
+                off = jax.lax.axis_index(CLIENTS_AXIS) * width
+                y = jax.lax.psum(local, CLIENTS_AXIS)
+                return finalize(y), slots - off
+
+            def finalize(extra):
+                return jax.lax.psum(extra, CLIENTS_AXIS)
+            """,
+    })
+    found = check_project(root)
+    assert [f.rule for f in found] == ["collective-budget"]
+    assert "exceeds the documented budget" in found[0].message
+    assert "round path:" in found[0].message
+    assert found[0].path == "msrflute_tpu/engine/round.py"
+
+
+def test_collective_budget_flags_stale_doc_entry(tmp_path):
+    from msrflute_tpu.analysis.collective_budget import check_project
+    root = write_tree(tmp_path, {
+        "docs/architecture.md": """\
+            # architecture
+
+            Collective budget — costed sites:
+
+            - `engine/round.py`: `psum` x2, `all_gather` x1
+            """,
+        "msrflute_tpu/engine/round.py": """\
+            import jax
+            from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+            def run_round(local):
+                return jax.lax.psum(local, CLIENTS_AXIS)
+            """,
+    })
+    found = check_project(root)
+    msgs = " | ".join(f.message for f in found)
+    assert all(f.rule == "collective-budget" for f in found)
+    assert all(f.path == "docs/architecture.md" for f in found)
+    assert "budgets 2 x `psum`" in msgs and "code has 1" in msgs
+    assert "budgets 1 x `all_gather`" in msgs and "code has 0" in msgs
+
+
+def test_collective_budget_flags_entry_for_dead_module(tmp_path):
+    from msrflute_tpu.analysis.collective_budget import check_project
+    root = write_tree(tmp_path, {
+        "docs/architecture.md": """\
+            # architecture
+
+            Collective budget — costed sites:
+
+            - `engine/gone.py`: `psum` x1
+            """,
+        "msrflute_tpu/engine/round.py": "x = 1\n",
+    })
+    found = check_project(root)
+    assert [f.rule for f in found] == ["collective-budget"]
+    assert "which has none (or does not exist)" in found[0].message
+
+
+def test_collective_budget_no_doc_means_no_findings(tmp_path):
+    from msrflute_tpu.analysis.collective_budget import check_project
+    root = write_tree(tmp_path, {
+        "msrflute_tpu/engine/round.py": _BUDGET_CODE,
+    })
+    assert check_project(root) == []
+
+
+# ======================================================================
+# flint-mesh: guard-matrix composition claims
+# ======================================================================
+_COMPOSED_DOC = """\
+    # extensions
+
+    ### server_config.robust — screened aggregation
+
+    Requires `strategy: fedavg`.  Incompatible with `wantRL` and
+    `scaffold` (host-orchestrated rounds).  Composes with
+    `fused_carry` strategies (`tests/test_robust.py`).
+    """
+
+
+def test_guard_matrix_exercised_composition_claim_passes(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": _COMPOSED_DOC,
+        "tests/test_robust.py": """\
+            def test_robust_composes_with_fused_carry():
+                cfg = {"robust": {"enable": True}, "fused_carry": True}
+            """})
+    assert check_project(root) == []
+
+
+def test_guard_matrix_flags_untested_composition_claim(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": _COMPOSED_DOC,
+        "tests/test_robust.py": """\
+            def test_robust_alone():
+                cfg = {"robust": {"enable": True}}
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "composes with `fused_carry`" in found[0].message
+    assert "never exercises" in found[0].message
+    assert found[0].path == "docs/config_extensions.md"
+
+
+def test_guard_matrix_flags_uncited_composition_claim(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`
+            and `scaffold` (host-orchestrated rounds).  Composes with
+            `fused_carry` strategies.
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "cites no test file" in found[0].message
+
+
+def test_guard_matrix_flags_composition_citing_missing_file(tmp_path):
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": _COMPOSED_DOC})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "does not exist" in found[0].message
+
+
+def test_guard_matrix_wants_cohort_is_matrix_vocabulary(tmp_path):
+    # the fleet-era token rides the same cross-check: a composition
+    # claim over `wants_cohort` must be exercised by the cited suite
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`
+            and `scaffold` (host-orchestrated rounds).  Composes with
+            `wants_cohort` strategies (`tests/test_robust.py`).
+            """,
+        "tests/test_robust.py": "def test_robust_alone():\n    pass\n"})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "`wants_cohort`" in found[0].message
+
+
+# ======================================================================
+# flint-mesh: historical-bug fixture + rename hygiene + cache schema
+# ======================================================================
+def test_historical_replicated_pool_is_caught_by_spec_drift(tmp_path):
+    """The pre-PR-15 fleet pager, condensed: the pool spec is built
+    replicated at construction and every page-in stages the WHOLE pool
+    to every device — an x mesh_size HBM/transfer regression invisible
+    on the 1-device CI mesh.  spec-drift pins both the binding and the
+    dispatch site."""
+    found = run_on(tmp_path, "engine/paging.py", """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        class DevicePagePool:
+            def __init__(self, mesh, n_slots):
+                self.n_slots = n_slots
+                # BUG (pre-PR-15): replicated spec for a slot-axis table
+                self._pool_spec = NamedSharding(mesh, P())
+
+            def page_in(self, rows):
+                return jax.device_put(rows, self._pool_spec)
+        """, rules=["spec-drift"])
+    assert rules_of(found) == ["spec-drift", "spec-drift"]
+    binding, put = found
+    assert "REPLICATED" in binding.message
+    assert "device_put of slot-axis table" in put.message
+    # and the PR-15 fix shape is silent
+    fixed = run_on(tmp_path, "engine/paging2.py", """\
+        import jax
+        from msrflute_tpu.parallel.sharding import slot_pool_sharding
+
+        class DevicePagePool:
+            def __init__(self, mesh, n_slots):
+                self.n_slots = n_slots
+                self._pool_spec = slot_pool_sharding(mesh)
+
+            def page_in(self, rows):
+                return jax.device_put(rows, self._pool_spec)
+        """, rules=["spec-drift"])
+    assert fixed == []
+
+
+@pytest.mark.parametrize("old,new", [
+    ("mesh_axis", "mesh-axis"),
+    ("shard_locality", "shard-locality"),
+    ("spec_drift", "spec-drift"),
+    ("collective_budget", "collective-budget"),
+])
+def test_mesh_rule_underscore_pragmas_error_with_hint(tmp_path, old, new):
+    found = run_on(tmp_path, "engine/mod.py", f"""\
+        def f(x):
+            # flint: disable={old} migrated spelling
+            return x
+        """, rules=["host-sync"])
+    assert rules_of(found) == ["unknown-suppression"]
+    assert old in found[0].message
+    assert new in found[0].hint
+
+
+def test_mesh_facts_round_trip_through_summary_json(tmp_path):
+    """The v3 fact fields (collectives, slot gathers, drop scatters,
+    lane/shard_map roots, spec bindings/literals, device_put sites)
+    must survive the disk-cache JSON round trip — a field dropped in
+    to_dict/from_dict would silently blind the mesh rules on every
+    cache-warm run."""
+    import ast as _ast
+    from msrflute_tpu.analysis.core import (ModuleInfo, ModuleSummary,
+                                            compute_module_summary)
+    src = textwrap.dedent("""\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from msrflute_tpu.parallel.mesh import CLIENTS_AXIS
+
+        def build(mesh):
+            pool_spec = NamedSharding(mesh, P(CLIENTS_AXIS))
+            lit = P("model")
+
+            def shard_body(slots, pool, rows):
+                off = jax.lax.axis_index(CLIENTS_AXIS)
+                out = pool[slots]
+                pool = pool.at[slots].set(rows, mode="drop")
+                return jax.lax.psum(out, CLIENTS_AXIS), pool
+
+            def per_client(x):
+                return x
+
+            jax.vmap(per_client)
+            staged = jax.device_put(rows_table, pool_spec)
+            return shard_map(shard_body, mesh=mesh), staged
+        """)
+    info = ModuleInfo("engine/mod.py", str(tmp_path / "engine/mod.py"),
+                      src, _ast.parse(src), src.splitlines())
+    summary = compute_module_summary(info)
+    thawed = ModuleSummary.from_dict(
+        json.loads(json.dumps(summary.to_dict())))
+    assert thawed.lane_roots == summary.lane_roots != []
+    assert thawed.shardmap_roots == summary.shardmap_roots != []
+    assert thawed.spec_bindings == summary.spec_bindings != []
+    assert thawed.spec_literals == summary.spec_literals != []
+    assert thawed.device_puts == summary.device_puts != []
+    body = thawed.functions["build.shard_body"]
+    orig = summary.functions["build.shard_body"]
+    assert body.collectives == orig.collectives
+    assert {op for op, _l, _a in body.collectives} == \
+        {"axis_index", "psum"}
+    assert body.slot_gathers == orig.slot_gathers != []
+    assert body.drop_scatters == orig.drop_scatters != []
+
+
+def test_v2_era_summary_cache_is_discarded_under_v3(tmp_path):
+    """PR 17 bumped SUMMARY_SCHEMA_VERSION 2 -> 3 for the mesh fact
+    layer: a cache written by the v2 extractor carries summaries with
+    NONE of the mesh fields, and the (mtime, size) stamps would still
+    match — only the schema key protects the mesh rules from it."""
+    import msrflute_tpu.analysis.core as core
+    from msrflute_tpu.analysis.core import (load_summary_cache,
+                                            save_summary_cache)
+
+    assert core.SUMMARY_SCHEMA_VERSION >= 3
+    pkg = tmp_path / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text("def f():\n    return 1\n")
+    cache = {}
+    core.analyze([str(pkg)], root=str(tmp_path), cache=cache)
+    path = tmp_path / "cache.json"
+    save_summary_cache(str(path), cache)
+    raw = json.loads(path.read_text())
+    raw["schema"] = 2
+    path.write_text(json.dumps(raw))
+    assert load_summary_cache(str(path)) == {}

@@ -54,6 +54,9 @@ def test_every_checker_is_exercised_by_the_real_tree_or_corpus():
                  # flint-threads: concurrency & durability
                  "signal-safety", "lock-discipline", "thread-escape",
                  "atomic-write",
+                 # flint-mesh: sharding & collective discipline
+                 "mesh-axis", "shard-locality", "spec-drift",
+                 "collective-budget",
                  # hygiene
                  "stale-suppression", "bare-suppression",
                  "unknown-suppression"):

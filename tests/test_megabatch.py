@@ -250,6 +250,10 @@ COMPOSE_CASES = [
                  dict(strategy="fedbuff",
                       server_over={"fedbuff": {"max_staleness": 3}}),
                  id="fedbuff", marks=pytest.mark.slow),
+    pytest.param("ef_quant_fused",
+                 dict(strategy="ef_quant",
+                      server_over={"fused_carry": True}),
+                 id="ef_quant_fused", marks=pytest.mark.slow),
     pytest.param("personalization_fused",
                  dict(strategy="personalization"),
                  id="personalization_fused", marks=pytest.mark.slow),
