@@ -782,6 +782,23 @@ def _server_overhead_extras(server) -> dict:
                           quarantine_counters={
                               k: round(float(v), 1)
                               for k, v in shield.counters.items()}))
+    # secure-agg marker (ISSUE 18): a masked run pays per-client pairwise
+    # mask generation plus the server-side cancellation pass, and a
+    # dropout round folds mask recovery into the finalize — comparing it
+    # against an unmasked baseline without the marker would misattribute
+    # that cost (or hide that a run was silently aborting thin rounds)
+    strat = getattr(server, "strategy", None)
+    if not getattr(strat, "wants_cohort", False):
+        out["secure_agg"] = {"enabled": False}
+    else:
+        out["secure_agg"] = {
+            "enabled": True,
+            "frac_bits": int(strat.frac_bits),
+            "clip": float(strat.clip),
+            "graph": str(strat.graph),
+            "min_survivors": int(strat.min_survivors),
+            "recovery_counters": {k: round(float(v), 1)
+                                  for k, v in strat.counters.items()}}
     return out
 
 
@@ -1199,7 +1216,7 @@ def bench_fused_carry_ab(on_tpu: bool) -> dict:
 
 def _config_block_ab(on_tpu: bool, key: str, arms: dict,
                      data_fn=None, protocol=None, per_arm=None,
-                     server_over=None) -> dict:
+                     server_over=None, arm_setup=None) -> dict:
     """Shared off-vs-on overhead harness: run the SAME faithful-mode
     protocol once per arm with ``server_config[key]`` set to that arm's
     block (``None`` = block absent), many rounds inside one ``train()``
@@ -1213,7 +1230,10 @@ def _config_block_ab(on_tpu: bool, key: str, arms: dict,
     point of the optimization); ``protocol`` labels it; ``per_arm(server,
     arm)`` returns extra per-arm fields recorded under ``{key}_{arm}_*``;
     ``server_over`` applies extra server_config blocks to EVERY arm (the
-    megabatch A/B needs cohort_bucketing live on both sides).
+    megabatch A/B needs cohort_bucketing live on both sides);
+    ``arm_setup(cfg, arm)`` mutates the config per arm beyond the block
+    itself (the secagg A/B flips the top-level ``strategy`` field and
+    folds a chaos block into its dropout arm).
     """
     import tempfile
 
@@ -1247,6 +1267,8 @@ def _config_block_ab(on_tpu: bool, key: str, arms: dict,
                                            else oval)
         if block is not None:
             cfg.server_config[key] = dict(block)
+        if arm_setup is not None:
+            arm_setup(cfg, arm)
         task = make_task(cfg.model_config)
         with tempfile.TemporaryDirectory() as tmp:
             server = OptimizationServer(task, cfg, data, model_dir=tmp,
@@ -1303,6 +1325,50 @@ def bench_robust_ab(on_tpu: bool) -> dict:
     for arm in ("screened_mean", "trimmed_mean"):
         out[f"{arm}_overhead_ratio"] = round(
             out[f"robust_{arm}_secs_per_round"] / max(off, 1e-9), 3)
+    return out
+
+
+def bench_secagg_ab(on_tpu: bool) -> dict:
+    """Straggler-tolerant SecAgg overhead A/B (ISSUE 18 satellite): the
+    SAME faithful-mode protocol run unmasked (fedavg), masked
+    (secure_agg, full pairwise graph), masked under seeded
+    dropout+straggler chaos (the recovery path live every round), and
+    masked with the ``graph: log`` topology — so the mask-generation
+    cost splits cleanly: full minus unmasked is the O(K^2)-edge price,
+    log minus unmasked the O(K log K) one, and the dropout arm adds the
+    server-side cancellation pass on top.  Decode exactness and
+    bit-identity to the unmasked sum on the same survivor set are pinned
+    by tests/test_secagg_compose.py, not timed here."""
+    mask = {"frac_bits": 12, "clip": 4.0, "seed": 0}
+
+    def setup(cfg, arm):
+        if arm != "unmasked":
+            cfg.strategy = "secure_agg"
+        if arm == "masked_dropout":
+            cfg.server_config["chaos"] = {
+                "seed": 3, "dropout_rate": 0.2, "straggler_rate": 0.2,
+                "straggler_inflation": 2.0}
+
+    def recovery(server, arm):
+        strat = getattr(server, "strategy", None)
+        if not getattr(strat, "wants_cohort", False):
+            return {}
+        return {"recovered_dropout":
+                round(float(strat.counters["recovered_dropout"]), 1)}
+
+    out = _config_block_ab(on_tpu, "secure_agg", {
+        "unmasked": None,
+        "masked": dict(mask, graph="full"),
+        "masked_log": dict(mask, graph="log"),
+        "masked_dropout": dict(mask, graph="full"),
+    }, arm_setup=setup, per_arm=recovery)
+    off = out["secure_agg_unmasked_secs_per_round"]
+    for arm in ("masked", "masked_log", "masked_dropout"):
+        out[f"{arm}_overhead_ratio"] = round(
+            out[f"secure_agg_{arm}_secs_per_round"] / max(off, 1e-9), 3)
+    out["maskgen_log_vs_full_ratio"] = round(
+        out["secure_agg_masked_log_secs_per_round"] /
+        max(out["secure_agg_masked_secs_per_round"], 1e-9), 3)
     return out
 
 
@@ -1925,6 +1991,20 @@ def main() -> None:
                 extras["robust_overhead_ab"] = bench_robust_ab(on_tpu)
         except Exception as exc:
             extras["robust_overhead_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # straggler-tolerant SecAgg overhead A/B: default-on for CPU runs
+    # (the masked-vs-unmasked and full-vs-log mask-graph cost evidence),
+    # env-gated on TPU like the others
+    if (not on_tpu or os.environ.get("BENCH_SECAGG_AB")) and \
+            (keep is None or "secagg_overhead_ab" in keep) and \
+            _remaining() > 60:
+        try:
+            with _stall_scope("secagg_overhead_ab"):
+                extras["secagg_overhead_ab"] = bench_secagg_ab(on_tpu)
+        except Exception as exc:
+            extras["secagg_overhead_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

@@ -34,6 +34,12 @@ dodge this rule:
   actually exercise each composed :data:`VOCAB` token — a compatibility
   claim nobody tests is the refusal matrix's mirror-image failure
   (the pair runs, silently wrong, instead of refusing);
+- a documented composition must not be CONTRADICTED by a live runtime
+  refusal: if the block's docs claim it composes with token `X` while
+  one of the block's refusal messages still says `X` "does not compose
+  with"/"is incompatible with" it, one of the two layers is stale —
+  exactly what happens when a refusal is lifted in docs but a guard
+  site is missed (or re-introduced by a revert);
 - blocks in :data:`SCHEMA_GUARDED` must keep their config-load-time
   strategy check in ``schema.py``.
 
@@ -87,6 +93,14 @@ _COMPOSE_RE = re.compile(r"composes with", re.I)
 _COMPOSE_END_RE = re.compile(
     r"Refused with|Requires |Incompatible with|Rejected under")
 _TEST_CITE_RE = re.compile(r"`(tests/[\w\-/]+\.py)`")
+
+#: refusal phrasings that flatly deny a composition — a raise carrying
+#: one of these next to a token the docs CLAIM to compose with marks a
+#: stale guard site (refusal lifted in docs, missed in code).  Refusals
+#: that merely constrain HOW a pair composes ("use aggregator: mean")
+#: must avoid this phrasing — that's the convention this layer enforces.
+_CONTRADICT_RE = re.compile(
+    r"(does not compose with|incompatible with)", re.I)
 
 
 def _parse(path: str, trees: Optional[Dict[str, ast.Module]],
@@ -329,10 +343,12 @@ def check_project(root: str,
         # appear in the cited test file (the composition-case suite),
         # and the claim must cite one at all.
         blob = " ".join(sec_lines)
+        claimed_tokens: set = set()
         for m in _COMPOSE_RE.finditer(blob):
             end = _COMPOSE_END_RE.search(blob, m.end())
             chunk = blob[m.start():end.start() if end else len(blob)]
             comp_tokens = _tokens_in(chunk)
+            claimed_tokens.update(comp_tokens)
             claim_line = sec_line
             for i, line in enumerate(sec_lines):
                 if _COMPOSE_RE.search(line):
@@ -375,6 +391,27 @@ def check_project(root: str,
                              "path) or drop the claim — an untested "
                              "composition promise ships the silent "
                              "version of a missing refusal"))
+
+        # ---- 5b. claims vs refusals: no contradiction ----------------
+        # a composition the docs promise for this block must not still
+        # be flatly refused by one of the block's own guard sites — the
+        # config would raise on exactly the pair the docs advertise.
+        for token in sorted(claimed_tokens):
+            for rel, line, text in raises:
+                if token in _tokens_in(text) and \
+                        _CONTRADICT_RE.search(text):
+                    findings.append(Finding(
+                        RULE, rel, line,
+                        f"docs claim `server_config.{block}` composes "
+                        f"with `{token}`, but this refusal still says "
+                        "it does not — a stale guard site (or a stale "
+                        "claim)",
+                        hint="lift the refusal (and cover the pair in "
+                             "the cited composition suite) or retract "
+                             "the docs claim; a refusal that only "
+                             "constrains HOW the pair composes should "
+                             "avoid 'does not compose with'/"
+                             "'incompatible with' phrasing"))
 
     # ---- 6. schema bespoke layer -------------------------------------
     for block in SCHEMA_GUARDED:

@@ -64,6 +64,9 @@ _SPEC_MAP = {
     # cross-client megabatching (PR 16); the cohort_bucketing
     # prerequisite is a cross-block rule and stays bespoke in validate()
     "MEGABATCH_FIELD_SPECS": "MEGABATCH_KEYS",
+    # straggler-tolerant secure aggregation (PR 18); `graph` is
+    # enum-typed and keeps its bespoke check in validate()
+    "SECURE_AGG_FIELD_SPECS": "SECURE_AGG_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",

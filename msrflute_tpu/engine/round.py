@@ -393,16 +393,27 @@ class RoundEngine:
         if self.shield is not None:
             from ..strategies.fedavg import FedAvg
             from ..strategies.robust import RobustFedAvg
-            # exact-class check: SecureAgg/QFFL/FedBuff/... subclass
-            # FedAvg but combine through their own payload parts, which
-            # quarantine zeroing would silently corrupt (e.g. SecureAgg's
-            # pairwise-mask cancellation) — isinstance would admit them
-            if type(strategy) not in (FedAvg, RobustFedAvg):
+            from ..strategies.secure_agg import SecureAgg
+            # exact-class check: QFFL/FedBuff/... subclass FedAvg but
+            # combine through their own payload parts, which quarantine
+            # zeroing would silently corrupt — isinstance would admit
+            # them.  SecureAgg is admitted by name: its masked path
+            # screens on submitted norms (Shield.screen_masked) and a
+            # quarantined client feeds the pairwise-mask cancellation as
+            # one more dropout cause (tests/test_secagg_compose.py)
+            if type(strategy) not in (FedAvg, RobustFedAvg, SecureAgg):
                 raise ValueError(
                     "server_config.robust requires strategy: fedavg/"
-                    f"fedprox — {type(strategy).__name__} aggregates "
-                    "through its own payload parts and would bypass the "
-                    "screening")
+                    f"fedprox/secure_agg — {type(strategy).__name__} "
+                    "aggregates through its own payload parts and would "
+                    "bypass the screening")
+            if isinstance(strategy, SecureAgg) and self.shield.wants_stack:
+                raise ValueError(
+                    f"robust.aggregator={self.shield.aggregator!r} sorts "
+                    "per-client payload coordinates, but secure_agg "
+                    "submissions are masked int32 group elements — only "
+                    "the SUM is meaningful.  Use aggregator: mean (norm "
+                    "screening still applies, on submitted norms)")
             if self.clients_per_chunk:
                 raise ValueError(
                     "server_config.robust is incompatible with "
@@ -465,12 +476,12 @@ class RoundEngine:
                     "cohort_bucketing does not compose with fused RL: "
                     "the DQN re-weighting assumes the single-grid payload "
                     "stack — drop wantRL or cohort_bucketing")
-            if getattr(strategy, "wants_cohort", False):
-                raise ValueError(
-                    f"cohort_bucketing does not compose with "
-                    f"{type(strategy).__name__}: pairwise-mask cohorts "
-                    "(secure_agg) need every pairmate in one grid for "
-                    "mask cancellation — drop cohort_bucketing")
+            # NOTE: wants_cohort strategies (secure_agg) now compose —
+            # each bucket runs its own pairwise-mask graph over the
+            # bucket's sampled sub-cohort and the finalize cancels
+            # residual masks per bucket before decoding; the int32
+            # telescoping is exact either way, so bucketed == monolithic
+            # bit-identical (tests/test_secagg_compose.py)
             if not self.input_staging:
                 raise ValueError(
                     "cohort_bucketing requires input_staging (the "
@@ -759,6 +770,14 @@ class RoundEngine:
         carry_split = carry_paged and self.partition_mode == "shard_map"
         carry_keys = tuple(strategy.carry_tables) if carry_paged else ()
         shard_slots = self._carry_shard_slots
+        # secure-aggregation statics: wants_cohort routes the default
+        # payload through the strategy's mask_parts AFTER corruption
+        # (the adversary attacks the float payload the client would
+        # transmit; the int32 group element is transport, not target)
+        # and masked_screen switches fluteshield to submitted-norm
+        # voting (the masked stack carries no plaintext norm signal)
+        wants_cohort = bool(getattr(strategy, "wants_cohort", False))
+        masked_screen = shield is not None and wants_cohort
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
@@ -800,14 +819,6 @@ class RoundEngine:
                 slot_c = rest.pop(0) if carry_paged else cid_c
                 corrupt_c = rest.pop(0) if chaos_corruption else None
                 rng_c = jax.random.fold_in(rng, cid_c)
-                cohort_kw = {}
-                if strategy.wants_cohort:
-                    # the FULL round cohort (replicated), plus this
-                    # client's own id/presence — secure aggregation
-                    # derives pairwise masks from these
-                    cohort_kw = dict(cohort_ids=cohort_ids,
-                                     cohort_mask=cohort_mask,
-                                     self_id=cid_c, self_mask=cm_c)
                 carry_row = None
                 if device_carry:
                     # carry strategies gather their own table rows from
@@ -829,7 +840,7 @@ class RoundEngine:
                         rng_c, round_idx=round_idx,
                         leakage_threshold=leakage_threshold,
                         quant_threshold=quant_threshold,
-                        strategy_state=strategy_state, **cohort_kw)
+                        strategy_state=strategy_state)
                 if chaos_corruption:
                     # adversarial chaos (resilience/chaos.py corrupt
                     # modes, already gated on the live client_mask):
@@ -851,6 +862,17 @@ class RoundEngine:
                             else g), pg0)
                     parts = dict(parts)
                     parts["default"] = (pg0, w0)
+                sub_norm = jnp.zeros(())
+                if wants_cohort:
+                    # secure aggregation: encode + pairwise-mask the
+                    # POST-corruption payload toward the round's SAMPLED
+                    # cohort (cohort_ids/cohort_mask, replicated); the
+                    # returned sub_norm is the submitted-norm scalar a
+                    # verified-aggregation server would see — the
+                    # shield's masked screening votes on it
+                    parts, sub_norm = strategy.mask_parts(
+                        parts, cid_c, cm_c, cohort_ids, cohort_mask,
+                        round_idx)
                 parts = {name: (tree, w * cm_c)
                          for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
@@ -861,7 +883,8 @@ class RoundEngine:
                     stale = jnp.zeros(())
                 # carry_row is None (a leafless pytree — vmap passes it
                 # through) unless the strategy runs in device-carry mode
-                return parts, tl * cm_c, ns * cm_c, stats, stale, carry_row
+                return (parts, tl * cm_c, ns * cm_c, stats, stale,
+                        carry_row, sub_norm)
 
             def process_chunk(arr_k, sm_k, cm_k, cid_k, *rest_k):
                 """One chunk of clients -> (summed locals, per-client
@@ -875,7 +898,7 @@ class RoundEngine:
                 vmap_args = (arr_k, sm_k, cm_k, cid_k) + \
                     ((slot_k,) if carry_paged else ()) + \
                     ((corrupt_k,) if chaos_corruption else ())
-                parts, tls, nss, stats, stale, carry_rows = \
+                parts, tls, nss, stats, stale, carry_rows, sub_norms = \
                     jax.vmap(per_client)(*vmap_args)
                 # per-client privacy-attack metrics stay per-client (the
                 # server needs the distribution for the adaptive leakage
@@ -893,8 +916,16 @@ class RoundEngine:
                     # jnp.where — a `0 *` multiply would let a NaN leaf
                     # re-poison the very aggregate it was caught in
                     pg_k, w_k = parts["default"]
-                    keep, q_nonfinite, q_norm = shield.screen(
-                        pg_k, tls, w_k, cm_k, gather_axis)
+                    if masked_screen:
+                        # masked submissions carry no plaintext norm or
+                        # finiteness signal — vote on the per-client
+                        # SUBMITTED norms instead (the verified-
+                        # aggregation model; robust/shield.py)
+                        keep, q_nonfinite, q_norm = shield.screen_masked(
+                            sub_norms, tls, w_k, cm_k, gather_axis)
+                    else:
+                        keep, q_nonfinite, q_norm = shield.screen(
+                            pg_k, tls, w_k, cm_k, gather_axis)
                     keep_b = keep > 0
                     pg_k = jax.tree.map(
                         lambda g: jnp.where(
@@ -1051,6 +1082,12 @@ class RoundEngine:
                                           parts["default"][0])
                 stack_keep = gather_axis(cm_eff)
                 out += (stack_tree, stack_keep)
+            if masked_screen:
+                # the post-quarantine survivor mask, replicated: the
+                # round step needs it to cancel the residual pairwise
+                # masks of (survivor, quarantined) edges and to
+                # renormalize the decode over survivors only
+                out += (gather_axis(cm_eff),)
             if device_carry:
                 # replicated full-cohort carry rows: every shard scatters
                 # the identical update, so strategy_state stays replicated
@@ -1094,6 +1131,7 @@ class RoundEngine:
         if self.partition_mode == "shard_map":
             out_specs = (rspec, cspec) + \
                 ((rspec, rspec) if robust_stack else ()) + \
+                ((rspec,) if masked_screen else ()) + \
                 ((rspec,) if device_carry else ()) + \
                 ((rspec,) if rl_fused else ())
             sharded_collect = shard_map(
@@ -1128,6 +1166,11 @@ class RoundEngine:
             # fault counters join round_stats and leave through the same
             # packed single-transfer buffer as every other stat.
             chaos_stats = {}
+            # the round's SAMPLED cohort mask, captured BEFORE chaos
+            # dropout folds in: secure-aggregation clients mask toward
+            # the sampled cohort, so the cancellation pass needs both
+            # masks to find the (survivor, lost) edges
+            sampled_cm = client_mask
             n_used = 0
             if carry_paged:
                 # fleet paging: the host-remapped pool slot per lane —
@@ -1194,7 +1237,7 @@ class RoundEngine:
             collect_out = sharded_collect(
                 bcast, collect_state, arrays, sample_mask, client_mask,
                 client_ids, client_lr, round_idx, leakage_threshold,
-                quant_threshold, rng, client_ids, client_mask,
+                quant_threshold, rng, client_ids, sampled_cm,
                 *carry_tab_args,
                 *((carry_slots,) if carry_paged else ()),
                 *corrupt_args, *pool_args)
@@ -1203,6 +1246,9 @@ class RoundEngine:
             if robust_stack:
                 stack_tree, stack_keep = collect_out[pos:pos + 2]
                 pos += 2
+            if masked_screen:
+                survivors = collect_out[pos]
+                pos += 1
             if device_carry:
                 carry_full = collect_out[pos]
                 pos += 1
@@ -1210,6 +1256,43 @@ class RoundEngine:
                 rl_pc = collect_out[pos]
                 pos += 1
             part_sums = collected["parts"]
+            secagg_stats = {}
+            if wants_cohort:
+                # secure-aggregation mask recovery: subtract the residual
+                # one-sided masks of every (survivor, lost) pair so the
+                # int32 sum telescopes back to exactly the survivors'
+                # encodings.  Both masks are DATA — no dropout pattern
+                # recompiles.  Without a shield the survivor set is the
+                # post-chaos live mask; quarantine shrinks it further.
+                if not masked_screen:
+                    survivors = client_mask
+                default = dict(part_sums["default"])
+                gsum = strategy.cancel_masks(
+                    default["grad_sum"], client_ids, sampled_cm,
+                    survivors, round_idx)
+                f32 = jnp.float32
+                secagg_stats = {
+                    "secagg_recovered_dropout": jnp.sum(
+                        ((sampled_cm > 0) & (client_mask <= 0))
+                        .astype(f32)),
+                    "secagg_recovered_quarantine": jnp.sum(
+                        ((client_mask > 0) & (survivors <= 0))
+                        .astype(f32)),
+                }
+                min_surv = int(getattr(strategy, "min_survivors", 0) or 0)
+                if min_surv > 0:
+                    # SecAgg's t-of-K liveness floor: too few survivors
+                    # aborts the round on device — the aggregate zeroes,
+                    # the server step is a no-op, and the abort flag
+                    # rides the packed stats
+                    abort = (jnp.sum(survivors) <
+                             jnp.asarray(min_surv, survivors.dtype))
+                    gsum = jax.tree.map(
+                        lambda g: g * (1 - abort.astype(g.dtype)), gsum)
+                    secagg_stats["secagg_abort"] = abort.astype(f32)
+                default["grad_sum"] = gsum
+                part_sums = dict(part_sums)
+                part_sums["default"] = default
             deferred = None
             if stale_prob > 0.0:
                 default = part_sums["default"]
@@ -1281,6 +1364,7 @@ class RoundEngine:
                 "agg_grad_norm": optax.global_norm(agg),
             }
             round_stats.update(chaos_stats)
+            round_stats.update(secagg_stats)
             round_stats.update(rl_stats)
             if shield is not None:
                 # per-cause quarantine counters out through the same
@@ -1905,10 +1989,18 @@ class RoundEngine:
         carry_split = carry_paged and self.partition_mode == "shard_map"
         carry_keys = tuple(strategy.carry_tables) if carry_paged else ()
         shard_slots = self._carry_shard_slots
+        # secure aggregation x bucketing: each bucket runs its OWN
+        # pairwise-mask graph over the bucket's sampled sub-cohort (two
+        # replicated operands — the bucket's ids and sampled mask);
+        # residual-mask cancellation happens per bucket in finalize.
+        # The int32 telescoping is exact either way, so the decoded
+        # aggregate is bit-identical to the monolithic round's.
+        wants_cohort = bool(getattr(strategy, "wants_cohort", False))
 
         def shard_body(params, strategy_state, arrays, sample_mask,
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng,
+                       cohort_ids=None, cohort_mask=None,
                        carry_slots=None, corrupt_mode=None, pool=None,
                        ptr=None, seg=None):
             if self.partition_mode == "shard_map":
@@ -1996,6 +2088,16 @@ class RoundEngine:
                             else g), pg0)
                     parts = dict(parts)
                     parts["default"] = (pg0, w0)
+                sub_norm = jnp.zeros(())
+                if wants_cohort:
+                    # encode + mask the post-corruption payload toward
+                    # the BUCKET's sampled sub-cohort (same per-client
+                    # math as the fused round — bucket placement cannot
+                    # perturb a client's encoding, only its mask graph,
+                    # and masks cancel exactly)
+                    parts, sub_norm = strategy.mask_parts(
+                        parts, cid_c, cm_c, cohort_ids, cohort_mask,
+                        round_idx)
                 parts = {name: (tree, w * cm_c)
                          for name, (tree, w) in parts.items()}
                 if stale_prob > 0.0:
@@ -2004,7 +2106,8 @@ class RoundEngine:
                     stale = coin.astype(jnp.float32) * cm_c
                 else:
                     stale = jnp.zeros(())
-                return parts, tl * cm_c, ns * cm_c, stats, stale, carry_row
+                return (parts, tl * cm_c, ns * cm_c, stats, stale,
+                        carry_row, sub_norm)
 
             if pool is not None:
                 arrays = gather_pool(arrays, sample_mask)
@@ -2029,7 +2132,7 @@ class RoundEngine:
                 ((carry_slots,) if carry_paged else ()) + \
                 ((corrupt_mode,) if chaos_corruption else ()) + \
                 mega_rows
-            parts, tls, nss, stats, stale, carry_rows = \
+            parts, tls, nss, stats, stale, carry_rows, sub_norms = \
                 jax.vmap(per_client)(*vmap_args)
             privacy_per_client = {k: v for k, v in stats.items()
                                   if k.startswith("privacy_")}
@@ -2051,6 +2154,11 @@ class RoundEngine:
                     "stats": {k: gather_axis(v) for k, v in stats.items()},
                     "cm": gather_axis(client_mask),
                 }
+                if wants_cohort:
+                    # the finalize's masked screening votes on submitted
+                    # norms (the stack itself is masked int32 — no norm
+                    # signal there by construction)
+                    pc["sub_norm"] = gather_axis(sub_norms)
                 return pc, privacy_per_client
 
             cm_k = client_mask
@@ -2101,6 +2209,11 @@ class RoundEngine:
                         client_mask, client_ids, client_lr, round_idx,
                         leakage_threshold, quant_threshold, rng, *rest):
             rest = list(rest)
+            # secure-agg cohort operands: the bucket's ids + sampled
+            # mask, REPLICATED (every client derives masks toward the
+            # whole bucket, not this shard's slice)
+            cohort_ids = rest.pop(0) if wants_cohort else None
+            cohort_mask = rest.pop(0) if wants_cohort else None
             # megabatch tape: lane axis shard-blocked like the grids, so
             # each shard's lanes point only at its own grid rows
             ptr = rest.pop(0) if mega else None
@@ -2117,7 +2230,9 @@ class RoundEngine:
             return shard_body(params, strategy_state, arrays, sample_mask,
                               client_mask, client_ids, client_lr,
                               round_idx, leakage_threshold,
-                              quant_threshold, rng, carry_slots=slots,
+                              quant_threshold, rng,
+                              cohort_ids=cohort_ids,
+                              cohort_mask=cohort_mask, carry_slots=slots,
                               corrupt_mode=corrupt, pool=pool_arg,
                               ptr=ptr, seg=seg)
 
@@ -2129,6 +2244,7 @@ class RoundEngine:
                 shard_entry, mesh=mesh,
                 in_specs=(rspec, rspec, cspec, cspec, cspec, cspec, rspec,
                           rspec, rspec, rspec, rspec) +
+                         ((rspec, rspec) if wants_cohort else ()) +
                          ((cspec, cspec) if mega else ()) +
                          ((cspec,) if carry_split else ()) +
                          ((cspec,) if carry_paged else ()) +
@@ -2147,6 +2263,9 @@ class RoundEngine:
             # the step grid, corruption modes gate on the live mask;
             # the per-bucket counters sum additively in finalize
             chaos_stats = {}
+            # the bucket's SAMPLED mask, pre-chaos: secure-agg clients
+            # mask toward it; finalize cancels toward the lost slots
+            sampled_cm = client_mask
             tape_args = ()
             if mega:
                 tape_args = tuple(extra_args[:2])
@@ -2205,6 +2324,8 @@ class RoundEngine:
             out = sharded(bcast, collect_state, arrays, sample_mask,
                           client_mask, client_ids, client_lr, round_idx,
                           leakage_threshold, quant_threshold, rng,
+                          *((client_ids, sampled_cm) if wants_cohort
+                            else ()),
                           *tape_args, *carry_tab_args,
                           *((carry_slots,) if carry_paged else ()),
                           *corrupt_args, *pool_args)
@@ -2216,6 +2337,14 @@ class RoundEngine:
                     result["carry"] = out[2]
             result["chaos"] = chaos_stats
             result["ids"] = client_ids
+            if wants_cohort:
+                # everything the finalize's per-bucket mask cancellation
+                # needs: the bucket's sampled and post-chaos live masks
+                # (device arrays — no host sync) and the round index the
+                # mask keys derive from
+                result["sa"] = {"sampled": sampled_cm,
+                                "live": client_mask,
+                                "round_idx": round_idx}
             if carry_paged:
                 # the finalize's apply_carry scatters by pool slot
                 result["slots"] = carry_slots
@@ -2291,11 +2420,44 @@ class RoundEngine:
         device_carry = self.device_carry
         stale_prob = self.stale_prob
         server_tx = self.server_tx
+        wants_cohort = bool(getattr(strategy, "wants_cohort", False))
+        min_surv = int(getattr(strategy, "min_survivors", 0) or 0) \
+            if wants_cohort else 0
+
+        def cancel_buckets(gsum, outs, survivors_per_bucket):
+            """Per-bucket secure-agg mask recovery over the FOLDED sum:
+            residuals are additive across buckets (each bucket has its
+            own mask graph), so chaining ``cancel_masks`` per bucket
+            subtracts exactly the union of (survivor, lost) edge masks.
+            Returns the cancelled sum + per-cause recovery counters."""
+            f32 = jnp.float32
+            rec_drop = jnp.zeros((), f32)
+            rec_quar = jnp.zeros((), f32)
+            surv_tot = jnp.zeros((), f32)
+            for o, surv_b in zip(outs, survivors_per_bucket):
+                sa = o["sa"]
+                gsum = strategy.cancel_masks(
+                    gsum, o["ids"], sa["sampled"], surv_b,
+                    sa["round_idx"])
+                rec_drop += jnp.sum(
+                    ((sa["sampled"] > 0) & (sa["live"] <= 0)).astype(f32))
+                rec_quar += jnp.sum(
+                    ((sa["live"] > 0) & (surv_b <= 0)).astype(f32))
+                surv_tot += jnp.sum((surv_b > 0).astype(f32))
+            sa_stats = {"secagg_recovered_dropout": rec_drop,
+                        "secagg_recovered_quarantine": rec_quar}
+            if min_surv > 0:
+                abort = surv_tot < jnp.asarray(min_surv, f32)
+                gsum = jax.tree.map(
+                    lambda g: g * (1 - abort.astype(g.dtype)), gsum)
+                sa_stats["secagg_abort"] = abort.astype(jnp.float32)
+            return gsum, sa_stats
 
         def finalize(params, opt_state, strategy_state, outs, server_lr,
                      rng):
             bcast = strategy.broadcast_params(params, strategy_state)
             shield_counts = None
+            sa_stats = {}
             if shield is None:
                 # deterministic on-device aggregation order: partial
                 # sums fold left-to-right in ascending-bucket order
@@ -2303,6 +2465,18 @@ class RoundEngine:
                 for o in outs[1:]:
                     total = jax.tree.map(jnp.add, total, o["local"])
                 part_sums = total["parts"]
+                if wants_cohort:
+                    # no shield: a bucket's survivors are its post-chaos
+                    # live clients
+                    default = dict(part_sums["default"])
+                    gsum, sa_stats = cancel_buckets(
+                        default["grad_sum"], outs,
+                        [o["sa"]["live"] for o in outs])
+                    default["grad_sum"] = gsum
+                    part_sums = dict(part_sums)
+                    part_sums["default"] = default
+                    total = dict(total)
+                    total["parts"] = part_sums
                 deferred = None
                 if stale_prob > 0.0:
                     default = part_sums["default"]
@@ -2330,8 +2504,15 @@ class RoundEngine:
                 cm = cat(*[o["pc"]["cm"] for o in outs])
                 stats = jax.tree.map(cat, *[o["pc"]["stats"]
                                             for o in outs])
-                keep, q_nonfinite, q_norm = shield.screen(
-                    stack, tls, w, cm, lambda x: x)
+                if wants_cohort:
+                    # masked stacks carry no plaintext norm signal —
+                    # vote on the cat'd submitted norms instead
+                    sub_norms = cat(*[o["pc"]["sub_norm"] for o in outs])
+                    keep, q_nonfinite, q_norm = shield.screen_masked(
+                        sub_norms, tls, w, cm, lambda x: x)
+                else:
+                    keep, q_nonfinite, q_norm = shield.screen(
+                        stack, tls, w, cm, lambda x: x)
                 keep_b = keep > 0
                 stack = jax.tree.map(
                     lambda g: jnp.where(
@@ -2343,9 +2524,29 @@ class RoundEngine:
                 stats = {k: jnp.where(keep_b, v, 0.0)
                          for k, v in stats.items()}
                 cm = cm * keep
-                gsum = jax.tree.map(
-                    lambda g: jnp.tensordot(w, g, axes=[[0], [0]]),
-                    stack)
+                if wants_cohort:
+                    # masked payloads sum with coefficient EXACTLY 1 per
+                    # surviving slot, in the tree's own int32 dtype (the
+                    # fused round's unit-weight discipline — a float
+                    # weight would break mask cancellation), then the
+                    # per-bucket residual masks toward quarantined and
+                    # dropped slots cancel out of the folded sum
+                    gsum = jax.tree.map(
+                        lambda g: jnp.tensordot(
+                            cm.astype(g.dtype), g, axes=[[0], [0]]),
+                        stack)
+                    sizes = [o["pc"]["cm"].shape[0] for o in outs]
+                    surv_buckets = []
+                    off = 0
+                    for sz in sizes:
+                        surv_buckets.append(cm[off:off + sz])
+                        off += sz
+                    gsum, sa_stats = cancel_buckets(gsum, outs,
+                                                    surv_buckets)
+                else:
+                    gsum = jax.tree.map(
+                        lambda g: jnp.tensordot(w, g, axes=[[0], [0]]),
+                        stack)
                 part_sums = {"default": {
                     "grad_sum": gsum,
                     "weight_sum": jnp.sum(w),
@@ -2422,6 +2623,7 @@ class RoundEngine:
             for o in outs[1:]:
                 chaos_tot = jax.tree.map(jnp.add, chaos_tot, o["chaos"])
             round_stats.update(chaos_tot)
+            round_stats.update(sa_stats)
             if shield_counts is not None:
                 round_stats["shield_nonfinite"] = shield_counts[0]
                 round_stats["shield_norm_outlier"] = shield_counts[1]

@@ -1593,6 +1593,33 @@ class OptimizationServer:
                 if nonfinite or outlier:
                     emit_event(self.scope, "quarantine", round=r,
                                nonfinite=nonfinite, norm_outlier=outlier)
+        if getattr(self.strategy, "wants_cohort", False) and \
+                "secagg_recovered_dropout" in stats:
+            # secure-agg mask-recovery observability: per-cause recovery
+            # counts and the liveness-floor abort flag computed inside
+            # the round program, fetched through the SAME packed single
+            # transfer as every other stat
+            counters = self.strategy.counters
+            for j in range(R):
+                r = round0 + j
+                rec_drop = float(stats["secagg_recovered_dropout"][j])
+                rec_quar = float(stats["secagg_recovered_quarantine"][j])
+                counters["recovered_dropout"] += rec_drop
+                counters["recovered_quarantine"] += rec_quar
+                log_metric("SecAgg recovered (dropout)", rec_drop, step=r)
+                log_metric("SecAgg recovered (quarantine)", rec_quar,
+                           step=r)
+                if rec_drop or rec_quar:
+                    emit_event(self.scope, "secagg_recovered", round=r,
+                               dropout=rec_drop, quarantine=rec_quar)
+                if "secagg_abort" in stats:
+                    aborted = float(stats["secagg_abort"][j])
+                    if aborted:
+                        counters["aborted_rounds"] += aborted
+                        log_metric("SecAgg aborted round", aborted,
+                                   step=r)
+                        emit_event(self.scope, "secagg_abort", round=r,
+                                   aborted=aborted)
         self._process_privacy_stats(
             stats, round0,
             client_mask=self._chunk_client_masks(chunk["batches"]))

@@ -35,6 +35,18 @@ straggler truncation multiplies a step-bound mask into ``sample_mask``
 (partial work still aggregates, CLIP/FedBuff-style).  No shape changes,
 no recompile; the injected-fault counters ride the packed-stats
 single-transfer path back to the host.
+
+Under masked secure aggregation (``strategy: secure_agg``, PR 18) the
+same fault vectors compose instead of refusing: a dropped or fully
+truncated client leaves its pairwise masks STRANDED in the survivors'
+submissions, and the strategy's ``cancel_masks`` finalize re-derives
+and subtracts exactly those residual edges server-side, so the masked
+survivor sum stays bit-identical to the unmasked one on the same
+survivor set (``tests/test_secagg_compose.py``).  Every chaos-induced
+loss shows up in the strategy's ``recovered_dropout`` counter, which
+matches this schedule's ``dropped`` counter round for round — the
+cross-check ``tools/chaos_smoke.py``'s secagg drill replays on the
+host.
 """
 
 from __future__ import annotations

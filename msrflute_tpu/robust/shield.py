@@ -152,6 +152,46 @@ class Shield:
         return keep.astype(client_mask.dtype), q_nonfinite, q_norm
 
     # ------------------------------------------------------------------
+    def screen_masked(self, norms: jnp.ndarray, train_loss: jnp.ndarray,
+                      weight: jnp.ndarray, client_mask: jnp.ndarray,
+                      gather: Callable[[jnp.ndarray], jnp.ndarray]
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """TRACED: :meth:`screen` for secure-aggregation rounds, voting
+        on SUBMITTED norms instead of payload leaves.
+
+        Under secure_agg the per-client payload is a masked int32 group
+        element — uniformly distributed bits that carry no norm or
+        finiteness signal by construction.  What the server CAN see in a
+        verified-aggregation deployment is each client's proven norm
+        bound, which the simulation models as ``norms``: the true L2
+        norm of the post-corruption, pre-mask float payload, computed
+        client-side by ``SecureAgg.mask_parts`` and submitted in the
+        clear ([K] f32).  The screening policy (finite check, median
+        vote, multiplier threshold) and the quarantine semantics are
+        identical to :meth:`screen` — quarantine then feeds the mask
+        cancellation path as one more dropout cause.
+        """
+        finite = jnp.ones(client_mask.shape, bool)
+        if self.screen_nonfinite:
+            # a NaN/Inf float payload yields a NaN/Inf norm (sqrt of a
+            # sum of squares propagates), so the norm carries the
+            # finiteness signal too
+            finite = (jnp.isfinite(norms) & jnp.isfinite(train_loss)
+                      & jnp.isfinite(weight))
+        norm_ok = jnp.ones(client_mask.shape, bool)
+        if self.norm_multiplier > 0.0:
+            vote = client_mask * finite.astype(client_mask.dtype)
+            med = masked_median(gather(norms), gather(vote))
+            norm_ok = jnp.where(med > 0.0,
+                                norms <= self.norm_multiplier * med, True)
+        keep = finite & norm_ok
+        finite_f = finite.astype(client_mask.dtype)
+        q_nonfinite = client_mask * (1.0 - finite_f)
+        q_norm = client_mask * finite_f * \
+            (1.0 - norm_ok.astype(client_mask.dtype))
+        return keep.astype(client_mask.dtype), q_nonfinite, q_norm
+
+    # ------------------------------------------------------------------
     def describe(self) -> Dict[str, Any]:
         """The bench-contract record: a shielded run can never be
         silently compared against an undefended baseline."""

@@ -132,6 +132,20 @@ ROBUST_KEYS = {
     "trim_fraction",
 }
 
+# mirrors strategies/secure_agg.py SECURE_AGG_KEYS (schema_drift keeps
+# the docs table in sync): a misspelled masking knob silently running
+# the defaults is the quiet failure this schema exists to prevent
+SECURE_AGG_KEYS = {
+    "frac_bits", "clip", "seed", "graph", "min_survivors",
+}
+
+SECURE_AGG_FIELD_SPECS = {
+    "frac_bits": ("int", 1, 24),
+    "clip": ("number", None, None),
+    "seed": ("int", None, None),
+    "min_survivors": ("int", 0, None),
+}
+
 COHORT_BUCKETING_KEYS = {
     "enable", "max_buckets", "boundaries", "slack",
 }
@@ -819,12 +833,42 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
             # while poisoned payloads aggregate untouched
             if robust.get("enable", True) and \
                     str(strategy or "fedavg").lower() not in (
-                        "fedavg", "fedprox"):
+                        "fedavg", "fedprox",
+                        "secure_agg", "secagg", "secureagg"):
                 errors.append(
                     "server_config.robust is set but strategy is "
                     f"{strategy!r} — screened aggregation plugs into the "
-                    "fedavg/fedprox combine only; payloads would "
-                    "aggregate UNSCREENED")
+                    "fedavg/fedprox combine (or secure_agg's submitted-"
+                    "norm screening); payloads would aggregate "
+                    "UNSCREENED")
+            if robust.get("enable", True) and \
+                    str(robust.get("aggregator", "mean")) in (
+                        "trimmed_mean", "median") and \
+                    str(strategy or "fedavg").lower() in (
+                        "secure_agg", "secagg", "secureagg"):
+                errors.append(
+                    "server_config.robust.aggregator: "
+                    f"{robust.get('aggregator')!r} sorts per-client "
+                    "payload coordinates, but secure_agg submissions "
+                    "are masked int32 group elements — use aggregator: "
+                    "mean (submitted-norm screening still applies)")
+        sa = sc.get("secure_agg")
+        if isinstance(sa, dict):
+            _check_unknown(unknown, sa, "server_config.secure_agg",
+                           SECURE_AGG_KEYS)
+            _check_fields(errors, sa, "server_config.secure_agg",
+                          SECURE_AGG_FIELD_SPECS)
+            graph = sa.get("graph")
+            if graph is not None and str(graph).lower() not in ("full",
+                                                                "log"):
+                errors.append(
+                    "server_config.secure_agg.graph: must be 'full' or "
+                    f"'log', got {graph!r}")
+            clip = sa.get("clip")
+            if isinstance(clip, (int, float)) and \
+                    not isinstance(clip, bool) and float(clip) <= 0.0:
+                errors.append(
+                    "server_config.secure_agg.clip: must be > 0")
         cb = sc.get("cohort_bucketing")
         if cb is not None and not isinstance(cb, dict):
             errors.append(

@@ -147,17 +147,37 @@ def select_robust_strategy(config, dp_config, base_cls) -> BaseStrategy:
     raw = dict(config.server_config.get("robust") or {})
     if not raw or not raw.get("enable", True):
         return base_cls(config, dp_config)
-    # exact-class check: every specialised strategy (SecureAgg, QFFL,
-    # FedBuff, Scaffold, EFQuant, ...) SUBCLASSES FedAvg but aggregates
-    # through its own payload parts / reweighting, which screening and
-    # the stack combine would silently corrupt — issubclass would wave
-    # them all through when the schema layer is bypassed
+    from .secure_agg import SecureAgg
+    aggregator = str(raw.get("aggregator", "mean"))
+    if base_cls is SecureAgg:
+        # secure_agg composes with the MEAN shield: screening votes on
+        # per-client submitted norms (Shield.screen_masked) and a
+        # quarantined client feeds the pairwise-mask cancellation path
+        # as one more dropout cause (tests/test_secagg_compose.py).
+        # Stack aggregators still cannot work here — coordinate-wise
+        # sort estimators need plaintext per-client payloads, and a
+        # secure_agg submission is a masked int32 group element whose
+        # only meaningful reduction is the SUM
+        if aggregator in ("trimmed_mean", "median"):
+            raise ValueError(
+                f"robust.aggregator={aggregator!r} sorts per-client "
+                "payload coordinates, but secure_agg submissions are "
+                "masked int32 group elements — use aggregator: mean "
+                "(submitted-norm screening still applies)")
+        return base_cls(config, dp_config)
+    # exact-class check: the remaining specialised strategies (QFFL,
+    # FedBuff, Scaffold, EFQuant, fedlabels, ...) SUBCLASS FedAvg but
+    # aggregate through their own payload parts / multi-part reweighting
+    # that quarantine zeroing would silently corrupt, and the engine's
+    # RL / adaptive-clipping guards refuse screening for the same
+    # reason — issubclass would wave them all through when the schema
+    # layer is bypassed
     if base_cls is not FedAvg:
         raise ValueError(
-            "server_config.robust requires strategy: fedavg/fedprox — "
-            f"{base_cls.__name__} aggregates through its own parts and "
-            "would ignore the screening; drop the robust block or the "
-            "strategy")
-    if str(raw.get("aggregator", "mean")) in ("trimmed_mean", "median"):
+            "server_config.robust requires strategy: fedavg/fedprox/"
+            f"secure_agg — {base_cls.__name__} aggregates through its "
+            "own parts and would ignore the screening; drop the robust "
+            "block or the strategy")
+    if aggregator in ("trimmed_mean", "median"):
         return RobustFedAvg(config, dp_config)
     return base_cls(config, dp_config)

@@ -14,17 +14,30 @@ What is simulated faithfully:
   clipped to ``+-clip`` and encoded as int32 with ``frac_bits``
   fractional bits; all masking/summation is int32 with two's-complement
   wraparound (XLA semantics), decoded once after aggregation.
-- **pairwise masks**: for the round's sampled cohort, each pair (i, j)
+- **pairwise masks**: for the round's SAMPLED cohort, each pair (i, j)
   shares a mask derived from a public pair key (round, min_id, max_id);
   the lower id adds it, the higher id subtracts it, so the cohort sum
   telescopes to zero.  Masks are full-range uint32 bits — each
   submission is uniformly distributed in the group regardless of the
   payload (perfect hiding within the simulation).
-- **dropped clients**: a client zeroed by the privacy filter
+- **mid-round client loss** (dropout, stragglers going fully dark,
+  fluteshield quarantine): clients mask toward the round's sampled
+  cohort, so a client that vanishes AFTER the masking round leaves its
+  pairmates' one-sided masks stranded in the sum.  The server-side
+  recovery (:meth:`cancel_masks`) re-derives exactly those residual
+  masks — every (survivor, lost) edge — and subtracts them in the same
+  int32 group, the simulation-side analogue of the Shamir-share mask
+  recovery real SecAgg runs for dropped participants.  The decoded sum
+  over the survivors is then BIT-identical to the unmasked path on the
+  same survivor set, and aggregation weights renormalize on device over
+  survivors only.  Per-cause recovery counters
+  (``secagg_recovered_dropout`` / ``secagg_recovered_quarantine``) and
+  the ``secagg_abort`` flag ride the packed-stats single transfer.
+- **zero-weight clients**: a client zeroed by the privacy filter
   (``filter_weight`` / attack-metric dropping) still submits its masks
   over an encoded zero, exactly like a SecAgg participant that must
-  deliver its masked input (or be reconstructed) once it joined the
-  masking round.  Padding slots (id -1) never enter the protocol.
+  deliver its masked input once it joined the masking round.  Padding
+  slots (id -1) never enter the protocol.
 
 What is NOT simulated: the key-agreement / Shamir-recovery transport
 (there is no adversarial server in a single-controller simulation — the
@@ -43,15 +56,24 @@ while the offset set's closure under negation keeps every edge
 symmetric — the cohort sum still telescopes to zero exactly.  The
 hiding argument weakens from "any K-1 colluders" to "each client has
 at least one honest present neighbor", the standard log-degree
-tradeoff; for the aggregate-only dataflow this simulation exists to
-study, the sums are identical (tested bit-for-bit against "full").
+tradeoff — and under HEAVY dropout a log-graph client can lose every
+neighbor, at which point its submission is protected only by the group
+encoding (see the RUNBOOK's "Dropout under the mask" drill); for the
+aggregate-only dataflow this simulation exists to study, the sums are
+identical (tested bit-for-bit against "full").
 
 Config (``server_config.secure_agg``, bool or dict; weighting
 semantics stay FedAvg's)::
 
     strategy: secure_agg
     server_config:
-      secure_agg: {frac_bits: 12, clip: 4.0, seed: 0, graph: full}
+      secure_agg: {frac_bits: 12, clip: 4.0, seed: 0, graph: full,
+                   min_survivors: 0}
+
+``min_survivors > 0`` aborts a round whose surviving cohort shrank
+below the threshold (real SecAgg's t-of-K liveness floor): the round's
+aggregate zeroes on device — a no-op server step — and the
+``secagg_abort`` counter/event records it.
 
 Range contract: the clip applies to the PSEUDO-GRADIENT (before the
 public weight), so the int32 group must hold ``sum_k w_k * clip *
@@ -59,9 +81,13 @@ public weight), so the int32 group must hold ``sum_k w_k * clip *
 MAX_WEIGHT=100 and K is known from ``num_clients_per_iteration``, so
 the worst case is static — the init RAISES when ``K * 100 * clip *
 2^frac >= 2^31`` (defaults admit K up to 1310), pointing at the
-clip/frac_bits to lower.  Within that bound the int32 SUM is exact;
-decoding splits it into 15-bit halves so the only float rounding is at
-the final aggregate's own magnitude (relative ~2^-24).
+offending knob.  Dropout/quarantine only SHRINK the summed cohort and
+renormalization happens on the float side of the decode (the weight
+denominator), so the full-K bound IS the worst case for every sampled
+sub-cohort — a partial round can never overflow a group the full round
+fits in.  Within that bound the int32 SUM is exact; decoding splits it
+into 15-bit halves so the only float rounding is at the final
+aggregate's own magnitude (relative ~2^-24).
 """
 
 from __future__ import annotations
@@ -72,6 +98,10 @@ import jax
 import jax.numpy as jnp
 
 from .fedavg import FedAvg
+
+#: secure_agg option vocabulary (schema.py's config-load check mirrors
+#: this — the quiet-failure rule for misspelled knobs)
+SECURE_AGG_KEYS = ("frac_bits", "clip", "seed", "graph", "min_survivors")
 
 
 class SecureAgg(FedAvg):
@@ -89,15 +119,16 @@ class SecureAgg(FedAvg):
                 f"server_config.secure_agg must be a bool or an options "
                 f"dict, got {type(sa).__name__}")
         sa = sa if isinstance(sa, dict) else {}
-        unknown = set(sa) - {"frac_bits", "clip", "seed", "graph"}
+        unknown = set(sa) - set(SECURE_AGG_KEYS)
         if unknown:
             raise ValueError(
                 f"server_config.secure_agg has unknown keys {sorted(unknown)}"
-                f" (known: frac_bits, clip, seed, graph)")
+                f" (known: {', '.join(SECURE_AGG_KEYS)})")
         self.frac_bits = int(sa.get("frac_bits", 12))
         self.clip = float(sa.get("clip", 4.0))
         self.seed = int(sa.get("seed", 0))
         self.graph = str(sa.get("graph", "full")).lower()
+        self.min_survivors = int(sa.get("min_survivors", 0))
         if self.graph not in ("full", "log"):
             raise ValueError(
                 f"secure_agg.graph must be 'full' or 'log', "
@@ -108,18 +139,33 @@ class SecureAgg(FedAvg):
                 f"got {self.frac_bits}")
         if not self.clip > 0:
             raise ValueError(f"secure_agg.clip must be > 0, got {self.clip}")
+        if self.min_survivors < 0:
+            raise ValueError(
+                f"secure_agg.min_survivors must be >= 0, "
+                f"got {self.min_survivors}")
         # static range contract: worst-case round sum must fit int32.
         # K from config ("lo:hi" takes hi), weights capped by
-        # filter_weight's MAX_WEIGHT=100 (strategies/base.py)
+        # filter_weight's MAX_WEIGHT=100 (strategies/base.py).  The bound
+        # is checked for the FULL sampled cohort: dropout/straggler/
+        # quarantine loss only removes addends (mask cancellation is
+        # exact in the group, and survivor re-weighting happens in the
+        # float decode's denominator), so no partial cohort can exceed
+        # the full cohort's sum.
         raw_k = config.server_config.get("num_clients_per_iteration", 10)
         k = int(str(raw_k).split(":")[-1])
         worst = k * 100.0 * self.clip * float(1 << self.frac_bits)
         if worst >= 2.0 ** 31:
+            max_k = int((2.0 ** 31 - 1) //
+                        (100.0 * self.clip * float(1 << self.frac_bits)))
             raise ValueError(
-                f"secure_agg range contract violated: K={k} clients x "
-                f"MAX_WEIGHT=100 x clip={self.clip} x 2^{self.frac_bits} "
-                f"= {worst:.3g} >= 2^31 — lower clip or frac_bits (the "
-                f"int32 group must hold the worst-case round sum)")
+                f"secure_agg range contract violated: "
+                f"num_clients_per_iteration={k} x MAX_WEIGHT=100 x "
+                f"clip={self.clip} x 2^{self.frac_bits} = {worst:.3g} >= "
+                f"2^31 — the int32 group must hold the worst-case round "
+                f"sum (dropout renormalization cannot relax this: it "
+                f"divides on the float side, after the group sum).  "
+                f"Lower num_clients_per_iteration to <= {max_k}, or "
+                f"lower clip / frac_bits")
         if dp_config is not None and (
                 dp_config.get("enable_local_dp", False) or
                 dp_config.get("enable_global_dp", False)):
@@ -134,7 +180,21 @@ class SecureAgg(FedAvg):
             raise ValueError(
                 "dump_norm_stats reads per-client payloads, which under "
                 "secure_agg are masked int32 group elements — the dumped "
-                "norms/cosines would be noise; disable one of the two")
+                "norms/cosines would be noise.  (Chaos faults, "
+                "fluteshield screening, cohort bucketing, and pipelining "
+                "now ride the masked path via survivor mask recovery; "
+                "the refusals that REMAIN are per-client-payload readers "
+                "and re-weighters: dump_norm_stats here, wantRL and the "
+                "stack aggregators in the engine, adaptive clipping and "
+                "DP modes above.)  Disable one of the two")
+        #: run-level recovery observability, accumulated by the server
+        #: from the packed round stats (the ChaosSchedule.counters /
+        #: Shield.counters discipline)
+        self.counters: Dict[str, float] = {
+            "recovered_dropout": 0.0,
+            "recovered_quarantine": 0.0,
+            "aborted_rounds": 0.0,
+        }
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -167,7 +227,13 @@ class SecureAgg(FedAvg):
         client); ``graph: "log"`` iterates only the circulant ``±2^t``
         neighbor slots (O(log K) masks per client).  Mask keys derive
         from the PAIR's public ids either way, so which endpoint computes
-        an edge never matters."""
+        an edge never matters.
+
+        ``cohort_mask`` is the round's SAMPLED mask, before chaos
+        dropout or quarantine fold in: masking toward the sampled cohort
+        (not the surviving one) is what makes mid-round loss a
+        server-side recovery problem (:meth:`cancel_masks`) instead of a
+        client-side re-keying one — the faithful SecAgg shape."""
         base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
                                   jnp.asarray(round_idx, jnp.int32))
         leaves, treedef = jax.tree.flatten(tree)
@@ -214,17 +280,25 @@ class SecureAgg(FedAvg):
         return jax.tree.unflatten(treedef, summed)
 
     # ------------------------------------------------------------------
-    def client_step(self, client_update, global_params, arrays, sample_mask,
-                    client_lr, rng, round_idx=None, leakage_threshold=None,
-                    quant_threshold=None, strategy_state=None,
-                    grad_offset=None, cohort_ids=None, cohort_mask=None,
-                    self_id=None, self_mask=None):
-        parts, tl, ns, stats = super().client_step(
-            client_update, global_params, arrays, sample_mask, client_lr,
-            rng, round_idx=round_idx, leakage_threshold=leakage_threshold,
-            quant_threshold=quant_threshold, strategy_state=strategy_state,
-            grad_offset=grad_offset)
+    def mask_parts(self, parts, self_id, self_mask, cohort_ids,
+                   cohort_mask, round_idx):
+        """TRACED, per client: fixed-point-encode and pairwise-mask the
+        default payload part.
+
+        Called by the engine AFTER the strategy's ``client_step`` and
+        the chaos corruption transform (corruption attacks the
+        float payload the client would transmit — attacking the int32
+        group element would model a transport-integrity failure, not an
+        adversarial client), and BEFORE the weighted summation.  Returns
+        ``(parts, sub_norm)`` where ``sub_norm`` is the true L2 norm of
+        the submitted (post-corruption, pre-mask) payload — the one
+        scalar a verified-aggregation scheme (a ZK norm-bound proof)
+        reveals to the server, which is exactly what fluteshield's
+        masked screening votes on (``Shield.screen_masked``)."""
         pg, w = parts["default"]
+        sq = sum(jnp.sum(g ** 2) for g in jax.tree.leaves(pg)
+                 if jnp.issubdtype(g.dtype, jnp.floating))
+        sub_norm = jnp.sqrt(sq)
         scale = jnp.float32(1 << self.frac_bits)
         # clip the pseudo-gradient THEN weight (clipping the product
         # would silently squash heavy-weight clients and break the
@@ -238,8 +312,83 @@ class SecureAgg(FedAvg):
                                  round_idx)
         present = (self_mask > 0).astype(jnp.int32)
         masked = jax.tree.map(lambda e, m: (e + m) * present, enc, masks)
-        parts["default"] = (masked, w)
-        return parts, tl, ns, stats
+        out = dict(parts)
+        out["default"] = (masked, w)
+        return out, sub_norm
+
+    # ------------------------------------------------------------------
+    def cancel_masks(self, grad_sum, cohort_ids, sampled_mask,
+                     survivor_mask, round_idx):
+        """TRACED, once per round (per bucket): subtract the residual
+        one-sided masks of every (survivor, lost) pair from the masked
+        int32 ``grad_sum``.
+
+        A client sampled into the masking round but absent from the sum
+        (chaos dropout, a quarantined submission) leaves each surviving
+        pairmate's signed mask toward it uncancelled.  The residual is
+
+            sum over survivors i, lost j, edge (i, j):
+                sign_i(j) * m_{(round, min_id, max_id)}
+
+        re-derivable from public ids — the simulation analogue of the
+        Shamir-share recovery real SecAgg performs for dropped clients.
+        Subtracting it in the SAME int32 group restores exact
+        telescoping: the remaining sum is precisely the survivors'
+        encoded payloads.  Both masks (``sampled_mask``/``survivor_mask``)
+        are DATA operands, so a dropout pattern never recompiles, and a
+        round with no loss runs the edges through a ``lax.cond`` whose
+        false branch skips the mask derivation entirely — the no-chaos
+        fast path pays K (or K·log K) cheap gate checks, not a second
+        round of mask generation."""
+        base = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                  jnp.asarray(round_idx, jnp.int32))
+        leaves, treedef = jax.tree.flatten(grad_sum)
+        k = cohort_ids.shape[0]
+        surv = survivor_mask > 0
+        samp = sampled_mask > 0
+
+        def edge(p, q, acc):
+            iid = cohort_ids[p]
+            jid = cohort_ids[q]
+            # exactly the edges a surviving i's submission masked toward
+            # a sampled-but-lost j: the _pair_masks gate, restricted to
+            # (present i, absent j)
+            gate = (surv[p] & samp[q] & ~surv[q] &
+                    (iid >= 0) & (jid >= 0) & (jid != iid))
+            lo = jnp.minimum(iid, jid)
+            hi = jnp.maximum(iid, jid)
+            key = jax.random.fold_in(
+                jax.random.fold_in(base, jnp.maximum(lo, 0)),
+                jnp.maximum(hi, 0))
+            sign = jnp.where(jid > iid, jnp.int32(1), jnp.int32(-1))
+
+            def sub(a):
+                out = []
+                for li, al in enumerate(a):
+                    bits = jax.random.bits(jax.random.fold_in(key, li),
+                                           al.shape, jnp.uint32)
+                    out.append(al - sign * jax.lax.bitcast_convert_type(
+                        bits, jnp.int32))
+                return out
+
+            return jax.lax.cond(gate, sub, lambda a: list(a), acc)
+
+        if self.graph == "log" and k > 1:
+            offs = self._log_offsets(k)
+            n = len(offs)
+            offs_a = jnp.asarray(offs, jnp.int32)
+
+            def body(t, acc):
+                p = t // n
+                q = jnp.mod(p + offs_a[jnp.mod(t, n)], k)
+                return edge(p, q, acc)
+
+            summed = jax.lax.fori_loop(0, k * n, body, leaves)
+        else:
+            summed = jax.lax.fori_loop(
+                0, k * k,
+                lambda t, acc: edge(t // k, jnp.mod(t, k), acc), leaves)
+        return jax.tree.unflatten(treedef, summed)
 
     # ------------------------------------------------------------------
     def combine_parts(self, part_sums: Dict[str, Dict[str, Any]],

@@ -3223,6 +3223,83 @@ def test_guard_matrix_wants_cohort_is_matrix_vocabulary(tmp_path):
     assert "`wants_cohort`" in found[0].message
 
 
+_SECAGG_CLAIM_DOC = """\
+    # extensions
+
+    ### server_config.robust — screened aggregation
+
+    Requires `strategy: fedavg`.  Incompatible with `wantRL` and
+    `scaffold` (host-orchestrated rounds).  Composes with
+    `secure_agg` submissions (`tests/test_robust.py`).
+    """
+
+_SECAGG_CLAIM_TEST = """\
+    def test_robust_composes_with_secure_agg():
+        cfg = {"robust": {"enable": True}, "strategy": "secure_agg"}
+    """
+
+
+def test_guard_matrix_flags_contradicted_composition_claim(tmp_path):
+    """PR-18 lesson, condensed: the docs lift a refusal ('composes
+    with secure_agg') but a guard site still flatly refuses the pair —
+    the config raises on exactly the combination the operator docs
+    advertise.  The contradiction layer pins the stale raise."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": _SECAGG_CLAIM_DOC,
+        "tests/test_robust.py": _SECAGG_CLAIM_TEST,
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+                    if sc.get("robust") and host_orchestrated:
+                        raise ValueError(
+                            "server_config.robust requires the fused "
+                            "round path — wantRL and scaffold "
+                            "orchestrate rounds host-side")
+                    if sc.get("robust") and sc.get("secure_agg"):
+                        raise ValueError(
+                            "server_config.robust does not compose "
+                            "with secure_agg payloads")
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "composes with `secure_agg`" in found[0].message
+    assert "still says it does not" in found[0].message
+    assert found[0].path == "msrflute_tpu/engine/server.py"
+
+
+def test_guard_matrix_constraining_refusal_is_not_contradiction(tmp_path):
+    """The sanctioned phrasing: a guard that only constrains HOW the
+    pair composes (and avoids 'does not compose with'/'incompatible
+    with') coexists with the composition claim — no finding."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "docs/config_extensions.md": _SECAGG_CLAIM_DOC,
+        "tests/test_robust.py": _SECAGG_CLAIM_TEST,
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+                    if sc.get("robust") and host_orchestrated:
+                        raise ValueError(
+                            "server_config.robust requires the fused "
+                            "round path — wantRL and scaffold "
+                            "orchestrate rounds host-side")
+                    if sc.get("robust", {}).get("sort") and \\
+                            sc.get("secure_agg"):
+                        raise ValueError(
+                            "server_config.robust sort-based "
+                            "aggregators remain refused for "
+                            "secure_agg submissions — use mean")
+            """})
+    assert check_project(root) == []
+
+
 # ======================================================================
 # flint-mesh: historical-bug fixture + rename hygiene + cache schema
 # ======================================================================

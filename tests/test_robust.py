@@ -443,14 +443,30 @@ def test_robust_refused_for_fedavg_subclass_strategy(synth_dataset,
                                                      tmp_path):
     # the schema layer is bypassed here (post-load mutation, as a
     # programmatic caller could): the runtime guard must still refuse
-    # FedAvg SUBCLASSES — SecureAgg/QFFL/... inherit from FedAvg but
-    # combine through their own payload parts, which quarantine zeroing
-    # would silently corrupt (e.g. pairwise-mask cancellation)
+    # FedAvg SUBCLASSES — QFFL/FedBuff/... inherit from FedAvg but
+    # combine through their own payload parts / reweighting, which
+    # quarantine zeroing would silently corrupt.  (SecureAgg is the
+    # carve-out: it screens on submitted norms and routes quarantine
+    # through mask cancellation — tests/test_secagg_compose.py)
     cfg = _cfg(robust={"norm_multiplier": 4.0})
-    cfg.strategy = "secure_agg"
+    cfg.strategy = "qffl"
     with pytest.raises(ValueError, match="fedavg/fedprox"):
         OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
                           model_dir=str(tmp_path), seed=0)
+
+
+def test_robust_stack_aggregator_refused_for_secure_agg(synth_dataset,
+                                                        tmp_path):
+    # secure_agg composes with the MEAN shield only: coordinate-wise
+    # sort estimators need plaintext payload stacks, and a secure_agg
+    # submission is a masked int32 group element whose only meaningful
+    # reduction is the sum
+    cfg = _cfg(robust={"norm_multiplier": 4.0,
+                       "aggregator": "trimmed_mean"})
+    cfg.strategy = "secure_agg"
+    with pytest.raises(ValueError, match="masked int32 group"):
+        OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
+                           model_dir=str(tmp_path), seed=0)
 
 
 def test_screened_mean_refused_with_adaptive_clipping(synth_dataset,

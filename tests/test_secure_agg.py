@@ -188,6 +188,25 @@ def test_range_contract_k_bound():
         "secure_agg": {"frac_bits": 8}}))
 
 
+def test_range_contract_error_names_knobs_and_dropout_rule():
+    """The refusal must point at the offending knob with the derived
+    max-K remediation, and must state WHY dropout renormalization does
+    not relax the bound (it divides on the float side, after the group
+    sum) — the contract holds for every sampled sub-cohort."""
+    with pytest.raises(ValueError) as exc:
+        SecureAgg(_cfg(extra_server={"num_clients_per_iteration": 1311}))
+    msg = str(exc.value)
+    assert "num_clients_per_iteration=1311" in msg
+    assert "<= 1310" in msg          # the derived remediation
+    assert "clip" in msg and "frac_bits" in msg
+    assert "renormalization" in msg and "float side" in msg
+    # a "lo:hi" dynamic cohort spec is judged on its UPPER bound
+    with pytest.raises(ValueError, match="range contract"):
+        SecureAgg(_cfg(extra_server={
+            "num_clients_per_iteration": "64:1311"}))
+    SecureAgg(_cfg(extra_server={"num_clients_per_iteration": "64:1310"}))
+
+
 def test_log_offsets_symmetric_and_logarithmic():
     """The circulant offset set must be closed under negation mod K
     (edge symmetry = exact cancellation) and O(log K)-sized."""

@@ -199,9 +199,120 @@ def run_smoke(rounds: int = 8, seed: int = 0) -> dict:
     return record
 
 
+def expected_client_loss(chaos: dict, rounds: int, k_padded: int,
+                         n_real: int) -> dict:
+    """Replay the seeded schedule host-side for the secagg drill: how
+    many LIVE real clients drop (secagg's dropout-recovery cause) and
+    how many surviving clients the scale attack poisons (the
+    quarantine-recovery cause) per the ``(seed, stream, round)``
+    contract — nothing read back from the device."""
+    import numpy as np
+
+    from msrflute_tpu.resilience.chaos import CORRUPT_SCALE, ChaosSchedule
+
+    sched = ChaosSchedule(**{k: v for k, v in chaos.items()})
+    out = {"dropped": 0, "scaled_live": 0}
+    shape_only = np.zeros((k_padded, 1, 1), np.float32)
+    for r in range(rounds):
+        drop, _ = sched.client_faults(r, shape_only)
+        mode = sched.corrupt_modes(r, k_padded)
+        real = np.arange(k_padded) < n_real
+        out["dropped"] += int((real & (drop > 0)).sum())
+        out["scaled_live"] += int(
+            ((mode == CORRUPT_SCALE) & real & (drop == 0)).sum())
+    return out
+
+
+def run_secagg_smoke(rounds: int = 6, seed: int = 0) -> dict:
+    """The "dropout under the mask" drill (RUNBOOK): secure_agg + seeded
+    dropout/stragglers + a 100x scale attack screened by fluteshield's
+    submitted-norm vote.  Asserts the per-cause mask-recovery counters
+    (``secagg_recovered_dropout`` / ``secagg_recovered_quarantine``)
+    EXACTLY match the host-side replay of the fault schedule, and that
+    the run ends finite — the masked sum telescoped despite the loss."""
+    from msrflute_tpu.utils.backend import force_cpu_backend
+    force_cpu_backend()
+
+    import numpy as np
+
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.data import ArraysDataset
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+    from msrflute_tpu.parallel.mesh import pad_to_mesh
+
+    chaos = {"seed": 7, "dropout_rate": 0.25, "straggler_rate": 0.25,
+             "straggler_inflation": 2.0, "corrupt_scale_rate": 0.2,
+             "corrupt_scale_factor": 100.0}
+    k = 6
+    cfg = FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "secure_agg",
+        "server_config": {
+            "max_iteration": rounds, "num_clients_per_iteration": k,
+            "initial_lr_client": 0.2,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 10_000, "initial_val": False,
+            "chaos": dict(chaos),
+            "robust": dict(ROBUST),
+            "data_config": {},
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+    rng = np.random.default_rng(seed)
+    users, per = [], []
+    for u in range(12):
+        users.append(f"u{u:02d}")
+        per.append({"x": rng.normal(size=(10, 8)).astype(np.float32),
+                    "y": rng.integers(0, 4, 10).astype(np.int32)})
+    dataset = ArraysDataset(users, per)
+
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        server = OptimizationServer(task, cfg, dataset, model_dir=tmp,
+                                    seed=seed)
+        state = server.train()
+        import jax
+        from jax.flatten_util import ravel_pytree
+        flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+        secagg = {kk: float(v)
+                  for kk, v in server.strategy.counters.items()}
+        quarantine = {kk: float(v)
+                      for kk, v in server.shield.counters.items()}
+    k_padded = pad_to_mesh(k, make_mesh())
+    expect = expected_client_loss(chaos, rounds, k_padded, k)
+    assert np.isfinite(flat).all(), (
+        "secagg run under chaos ended non-finite — mask recovery or the "
+        "submitted-norm screen is broken")
+    assert secagg["recovered_dropout"] == expect["dropped"], (
+        f"secagg_recovered_dropout={secagg['recovered_dropout']} diverged "
+        f"from the seeded dropout schedule ({expect['dropped']}) — the "
+        "mask-recovery path is not schedule-exact")
+    assert secagg["recovered_quarantine"] == expect["scaled_live"], (
+        f"secagg_recovered_quarantine={secagg['recovered_quarantine']} != "
+        f"scheduled live scale corruptions ({expect['scaled_live']}) — "
+        "with a 100x factor the submitted-norm screen must quarantine "
+        "exactly the scheduled attackers")
+    assert quarantine["quarantined_norm_outlier"] == expect["scaled_live"]
+    return {
+        "tool": "chaos_smoke/secagg",
+        "rounds": int(state.round),
+        "chaos": chaos,
+        "secagg": secagg,
+        "quarantine_counters": quarantine,
+        "expected": expect,
+    }
+
+
 def main() -> int:
     record = run_smoke()
     print(json.dumps(record))
+    record_sa = run_secagg_smoke()
+    print(json.dumps(record_sa))
     return 0
 
 
