@@ -799,6 +799,28 @@ def _server_overhead_extras(server) -> dict:
             "min_survivors": int(strat.min_survivors),
             "recovery_counters": {k: round(float(v), 1)
                                   for k, v in strat.counters.items()}}
+    # traffic marker (ISSUE 19): an arrival-plane run draws its cohorts
+    # from a seeded timeline — and, buffered, aggregates STALE work —
+    # so comparing it against a boundary-sampled baseline without the
+    # marker would misattribute both the sampling trail and the
+    # convergence
+    traffic = getattr(server, "traffic", None)
+    if traffic is None:
+        out["traffic"] = {"enabled": False}
+    else:
+        out["traffic"] = dict(
+            traffic.describe(),
+            arrival_rate=round(float(traffic.arrival_rate()), 6),
+            stale_hist=[int(c) for c in traffic.stale_hist],
+            target_accuracy=getattr(server, "target_accuracy", None),
+            counters={k: round(float(v), 1)
+                      for k, v in traffic.counters.items()})
+    # convergence tier: first round whose val accuracy reached
+    # traffic.target_accuracy — recorded on EVERY protocol entry (null
+    # when no target is configured or the run never got there), so
+    # `scope trend` can gate async-tier claims alongside secs_per_round
+    out["rounds_to_target_accuracy"] = getattr(
+        server, "rounds_to_target_accuracy", None)
     return out
 
 
@@ -1457,6 +1479,130 @@ def bench_megakernel_ab(on_tpu: bool) -> dict:
     return out
 
 
+def _separable_dataset(pool, spu, dim, classes, rng, spread=3.0):
+    """Learnable synthetic federated pool (class-mean + noise): the
+    traffic A/B races two orchestrations TO A TARGET ACCURACY, so the
+    labels must actually be learnable — the other protocols' random-
+    label pools would pin every arm at chance and record null."""
+    from msrflute_tpu.data import ArraysDataset
+    means = (rng.normal(size=(classes, dim)) * spread).astype(np.float32)
+    users, per_user = [], []
+    for u in range(pool):
+        y = rng.integers(0, classes, size=(spu,)).astype(np.int32)
+        x = (means[y] + rng.normal(size=(spu, dim))).astype(np.float32)
+        users.append(f"u{u:04d}")
+        per_user.append({"x": x, "y": y})
+    return ArraysDataset(users, per_user)
+
+
+def bench_traffic_ab(on_tpu: bool) -> dict:
+    """flutetraffic sync-vs-buffered A/B on the SAME seeded bursty trace
+    (ISSUE 19 acceptance): classic synchronous rounds (``traffic.mode:
+    sync`` — the barrier discards work computed against a superseded
+    broadcast and waits for a fresh cohort) vs FedBuff-style buffered
+    async (``traffic.mode: buffered`` + ``strategy: fedbuff`` — stale
+    updates aggregate under the staleness discount), both arms drawing
+    the identical arrival timeline, so the A/B compares orchestration,
+    not luck.  Each arm trains round-by-round at ``val_freq: 1`` until
+    val accuracy reaches ``traffic.target_accuracy`` or the round
+    budget runs out, and records ``rounds_to_target_accuracy`` (null
+    when never reached), wall-clock seconds to target, and the
+    arrival-plane TICK at the crossing fire — the simulated-time axis
+    where the async claim actually lives: the sync barrier's discarded
+    deliveries push its crossing tick later even when its round count
+    is lower.  Numbers are recorded as measured, whichever arm wins."""
+    import tempfile
+
+    import jax
+    from msrflute_tpu.config import FLUTEConfig
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel import make_mesh
+
+    pool, spu, dim, classes = 32, 24, 32, 4
+    ncpi = 8
+    # spread/lr/target tuned so the race takes ~20 rounds: wide enough
+    # separation to be learnable, slow enough that orchestration (not
+    # the first cohort) decides the crossing
+    spread, client_lr, target = 0.5, 0.01, 0.75
+    max_rounds = 80 if on_tpu else 60
+    trace = {"enable": True, "seed": 9, "trace": "bursty", "rate": 2.0,
+             "burst_rate": 24.0, "burst_every": 12, "burst_len": 4,
+             "target_accuracy": target}
+    out = {"protocol": "lr_separable", "trace": "bursty",
+           "target_accuracy": target, "round_budget": max_rounds,
+           "population": pool, "buffer_size": ncpi}
+    for arm, strategy in (("sync", "fedavg"), ("buffered", "fedbuff")):
+        raw = {
+            "model_config": {"model_type": "LR", "num_classes": classes,
+                             "input_dim": dim},
+            "strategy": strategy,
+            "server_config": {
+                "max_iteration": 0,
+                "num_clients_per_iteration": ncpi,
+                "initial_lr_client": client_lr,
+                "optimizer_config": {"type": "sgd", "lr": 1.0},
+                "val_freq": 1, "initial_val": False,
+                "rounds_per_step": 1,
+                "traffic": dict(trace, mode=arm),
+                "data_config": {"val": {"batch_size": 64}},
+            },
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": client_lr},
+                "data_config": {"train": {"batch_size": 8}},
+            },
+        }
+        if strategy == "fedbuff":
+            raw["server_config"]["fedbuff"] = {"max_staleness": 4}
+        cfg = FLUTEConfig.from_dict(raw)
+        data = _separable_dataset(pool, spu, dim, classes,
+                                  np.random.default_rng(3),
+                                  spread=spread)
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            server = OptimizationServer(task, cfg, data,
+                                        val_dataset=make_val_ds(data, 8),
+                                        model_dir=tmp, mesh=make_mesh(),
+                                        seed=0)
+            secs_to_target = None
+            tic = time.time()
+            for r in range(1, max_rounds + 1):
+                cfg.server_config.max_iteration = r
+                server.train()
+                if server.rounds_to_target_accuracy is not None:
+                    jax.block_until_ready(server.state.params)
+                    secs_to_target = round(time.time() - tic, 4)
+                    break
+            reached = server.rounds_to_target_accuracy
+            best = server.best_val.get("acc")
+            rec = {
+                "strategy": strategy,
+                "rounds_to_target_accuracy": reached,
+                "secs_to_target": secs_to_target,
+                "rounds_run": int(server.state.round),
+                "best_val_acc": (round(float(best.value), 4)
+                                 if best is not None else None),
+                "sync_discarded": int(
+                    server.traffic.counters["sync_discarded"]),
+                "stale_sum": int(server.traffic.counters["stale_sum"]),
+            }
+            if reached is not None:
+                # fires are 0-indexed; round numbers 1-indexed
+                rec["tick_at_target"] = int(
+                    server.traffic.fire(reached - 1)["tick"])
+            out[arm] = rec
+    a, b = out["sync"], out["buffered"]
+    sa, sb = a.get("secs_to_target"), b.get("secs_to_target")
+    out["async_fewer_secs_to_target"] = (
+        bool(sb < sa) if isinstance(sa, (int, float)) and
+        isinstance(sb, (int, float)) else None)
+    ta, tb = a.get("tick_at_target"), b.get("tick_at_target")
+    out["async_earlier_tick_at_target"] = (
+        bool(tb < ta) if isinstance(ta, (int, float)) and
+        isinstance(tb, (int, float)) else None)
+    return out
+
+
 def _hetero_image_dataset(pool, shape, classes, rng, min_samples=4,
                           max_samples=256, small_frac=0.75):
     """Heterogeneous federated pool: ``small_frac`` of users hold a
@@ -2047,6 +2193,19 @@ def main() -> None:
                 extras["megakernel_ab"] = bench_megakernel_ab(on_tpu)
         except Exception as exc:
             extras["megakernel_ab"] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+            _mirror_partial()
+
+    # flutetraffic sync-vs-buffered A/B on the same seeded bursty trace:
+    # default-on for CPU runs (the rounds-to-target-accuracy acceptance
+    # evidence for the arrival plane), env-gated on TPU like the rest
+    if (not on_tpu or os.environ.get("BENCH_TRAFFIC_AB")) and \
+            (keep is None or "traffic_ab" in keep) and _remaining() > 60:
+        try:
+            with _stall_scope("traffic_ab"):
+                extras["traffic_ab"] = bench_traffic_ab(on_tpu)
+        except Exception as exc:
+            extras["traffic_ab"] = {
                 "error": f"{type(exc).__name__}: {exc}"}
             _mirror_partial()
 

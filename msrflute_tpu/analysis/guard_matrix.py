@@ -60,7 +60,12 @@ from .core import Finding
 RULE = "guard-matrix"
 
 #: config blocks that require the fused round path at runtime
-GUARDED_BLOCKS = ("robust", "chaos", "cohort_bucketing", "megabatch")
+GUARDED_BLOCKS = ("robust", "chaos", "cohort_bucketing", "megabatch",
+                  # fluteflow arrival plane (PR 19): the refusal ladder
+                  # covers host-orchestrated rounds, the buffer==cohort
+                  # geometry, fleet sampling modes, the secure_agg
+                  # liveness floor, and megabatch x traced staleness
+                  "traffic")
 
 #: the incompatibility vocabulary the matrix is checked over: config
 #: keys, strategy names and flags that appear in refusal messages and
@@ -74,7 +79,10 @@ VOCAB = ("wantRL", "scaffold", "ef_quant", "personalization",
          "apply_metrics", "fedlabels", "pallas_apply",
          # fleet/mesh-era composition tokens (PR 17): strategies that
          # pre-bucket their cohort and the paged-carry interplay
-         "wants_cohort")
+         "wants_cohort",
+         # fluteflow arrival-plane token (PR 19): the traffic block
+         # itself, so other blocks' traffic refusals are matrix cells
+         "traffic")
 
 #: blocks whose strategy incompatibility is decidable at config load —
 #: schema.py must carry the bespoke check (the quiet-failure rule)

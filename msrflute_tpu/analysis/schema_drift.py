@@ -67,6 +67,10 @@ _SPEC_MAP = {
     # straggler-tolerant secure aggregation (PR 18); `graph` is
     # enum-typed and keeps its bespoke check in validate()
     "SECURE_AGG_FIELD_SPECS": "SECURE_AGG_KEYS",
+    # fluteflow arrival plane (PR 19); `mode`/`trace` are enum-typed
+    # and `classes` is a list-of-mappings — those keep bespoke checks
+    # in validate()
+    "TRAFFIC_FIELD_SPECS": "TRAFFIC_KEYS",
 }
 #: structural keys docs may mention with further dotted children
 _STRUCTURAL = {"data_config", "optimizer_config", "annealing_config",
@@ -113,6 +117,11 @@ DOCUMENTED_KNOBS = (
     # tuning drill will keep paying the padded [K, S] grid on every
     # heterogeneous cohort a coarse bucket layout produces
     "megabatch",
+    # fluteflow arrival plane: an operator who cannot find the traffic
+    # drill will keep benchmarking async strategies against a
+    # boundary-sampled timeline where their whole reason to exist —
+    # rounds-to-target under real arrivals — is unmeasurable
+    "traffic",
 )
 
 _DOC_MENTION_RE = re.compile(

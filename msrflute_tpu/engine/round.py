@@ -44,6 +44,7 @@ from ..optim import make_optimizer
 from ..parallel.mesh import CLIENTS_AXIS, MODEL_AXIS, make_mesh
 from ..resilience.chaos import (CORRUPT_NAN, CORRUPT_SCALE,
                                 CORRUPT_SIGN_FLIP)
+from ..traffic.schedule import STALE_HIST_BINS
 from ..robust import make_shield
 from ..strategies.base import BaseStrategy
 from ..telemetry import devbus_config_enabled, xla_config_enabled
@@ -382,6 +383,30 @@ class RoundEngine:
             _chaos_raw.get("corrupt_scale_factor", 10.0) or 10.0)
         self._corrupt_flip_scale = float(
             _chaos_raw.get("corrupt_sign_flip_scale", 1.0) or 1.0)
+
+        # fluteflow traced staleness (server_config.traffic, buffered
+        # mode, with a strategy that declares supports_traced_staleness
+        # — FedBuff): the round program takes ONE more per-round data
+        # operand — staleness [K] int32, the TRUE broadcast-version gap
+        # the arrival plane measured — threaded on the exact rails the
+        # chaos vectors ride (appended after corrupt_mode in every
+        # positional order), so traffic costs no recompile and the
+        # per-staleness histogram counters ride the packed-stats single
+        # transfer.  Static at engine build: a traffic-free config (or
+        # sync mode, or a staleness-blind strategy) compiles the exact
+        # program it always did.
+        _traffic_raw = sc.get("traffic") or {}
+        _traffic_on = bool(_traffic_raw and
+                           _traffic_raw.get("enable", True))
+        self.traffic_staleness = bool(
+            _traffic_on and
+            str(_traffic_raw.get("mode", "buffered")) == "buffered" and
+            getattr(strategy, "supports_traced_staleness", False))
+        if self.traffic_staleness and self.clients_per_chunk:
+            raise ValueError(
+                "server_config.traffic traced staleness cannot compose "
+                "with clients_per_chunk: the chunk scan's operand tuple "
+                "is fixed per chunk — disable one of them")
 
         # fluteshield screened aggregation (server_config.robust): the
         # quarantine mask is computed INSIDE the round program from the
@@ -753,6 +778,9 @@ class RoundEngine:
         chaos_corruption = self.chaos_corruption
         corrupt_scale = self._corrupt_scale
         corrupt_flip_scale = self._corrupt_flip_scale
+        # fluteflow static: the traced-staleness operand threads AFTER
+        # corrupt_mode in every positional order below
+        traffic_staleness = self.traffic_staleness
         # universal-overlap statics: both compile-time branches — a
         # config without fused_carry traces the exact legacy program
         device_carry = self.device_carry
@@ -783,7 +811,8 @@ class RoundEngine:
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng,
                        cohort_ids=None, cohort_mask=None,
-                       carry_slots=None, corrupt_mode=None, pool=None):
+                       carry_slots=None, corrupt_mode=None,
+                       staleness=None, pool=None):
             if self.partition_mode == "shard_map":
                 # shard-local [K_local] -> full replicated [K] cohort
                 # (the median vote and the robust payload stack need
@@ -818,6 +847,7 @@ class RoundEngine:
                 rest = list(rest)
                 slot_c = rest.pop(0) if carry_paged else cid_c
                 corrupt_c = rest.pop(0) if chaos_corruption else None
+                stale_c = rest.pop(0) if traffic_staleness else None
                 rng_c = jax.random.fold_in(rng, cid_c)
                 carry_row = None
                 if device_carry:
@@ -833,14 +863,23 @@ class RoundEngine:
                             live_mask=cm_c, round_idx=round_idx,
                             leakage_threshold=leakage_threshold,
                             quant_threshold=quant_threshold,
-                            strategy_state=strategy_state)
+                            strategy_state=strategy_state,
+                            **({"staleness": stale_c} if traffic_staleness
+                               else {}))
                 else:
+                    # traced staleness (fluteflow): the arrival plane's
+                    # TRUE broadcast-version gap replaces the strategy's
+                    # in-jit staleness model — passed only when the
+                    # engine compiled the operand in, so staleness-blind
+                    # strategies keep their exact call signature
                     parts, tl, ns, stats = strategy.client_step(
                         client_update, params, arr_c, mask_c, client_lr,
                         rng_c, round_idx=round_idx,
                         leakage_threshold=leakage_threshold,
                         quant_threshold=quant_threshold,
-                        strategy_state=strategy_state)
+                        strategy_state=strategy_state,
+                        **({"staleness": stale_c} if traffic_staleness
+                           else {}))
                 if chaos_corruption:
                     # adversarial chaos (resilience/chaos.py corrupt
                     # modes, already gated on the live client_mask):
@@ -893,11 +932,13 @@ class RoundEngine:
                 rest_k = list(rest_k)
                 slot_k = rest_k.pop(0) if carry_paged else None
                 corrupt_k = rest_k.pop(0) if chaos_corruption else None
+                stale_k = rest_k.pop(0) if traffic_staleness else None
                 if pool is not None:
                     arr_k = gather_pool(arr_k, sm_k)
                 vmap_args = (arr_k, sm_k, cm_k, cid_k) + \
                     ((slot_k,) if carry_paged else ()) + \
-                    ((corrupt_k,) if chaos_corruption else ())
+                    ((corrupt_k,) if chaos_corruption else ()) + \
+                    ((stale_k,) if traffic_staleness else ())
                 parts, tls, nss, stats, stale, carry_rows, sub_norms = \
                     jax.vmap(per_client)(*vmap_args)
                 # per-client privacy-attack metrics stay per-client (the
@@ -1043,7 +1084,8 @@ class RoundEngine:
                  extras) = process_chunk(
                     arrays, sample_mask, client_mask, client_ids,
                     *((carry_slots,) if carry_paged else ()),
-                    *((corrupt_mode,) if chaos_corruption else ()))
+                    *((corrupt_mode,) if chaos_corruption else ()),
+                    *((staleness,) if traffic_staleness else ()))
             if self.partition_mode == "shard_map":
                 # the "harvest": one collective instead of K P2P recvs
                 total = jax.lax.psum(local, CLIENTS_AXIS)
@@ -1120,13 +1162,15 @@ class RoundEngine:
                 off = jax.lax.axis_index(CLIENTS_AXIS) * shard_slots
                 slots = jnp.where(slots >= 0, slots - off, -1)
             corrupt = rest.pop(0) if chaos_corruption else None
+            stale = rest.pop(0) if traffic_staleness else None
             pool_arg = rest.pop(0) if pool_mode else None
             return shard_body(params, strategy_state, arrays, sample_mask,
                               client_mask, client_ids, client_lr,
                               round_idx, leakage_threshold,
                               quant_threshold, rng, cohort_ids,
                               cohort_mask, carry_slots=slots,
-                              corrupt_mode=corrupt, pool=pool_arg)
+                              corrupt_mode=corrupt, staleness=stale,
+                              pool=pool_arg)
 
         if self.partition_mode == "shard_map":
             out_specs = (rspec, cspec) + \
@@ -1141,6 +1185,7 @@ class RoundEngine:
                          ((cspec,) if carry_split else ()) +
                          ((cspec,) if carry_paged else ()) +
                          ((cspec,) if chaos_corruption else ()) +
+                         ((cspec,) if traffic_staleness else ()) +
                          ((rspec,) if pool_mode else ()),
                 out_specs=out_specs, check_vma=False)
         else:
@@ -1220,6 +1265,30 @@ class RoundEngine:
                         (corrupt_mode == CORRUPT_SIGN_FLIP).astype(f32)),
                 })
                 corrupt_args = (corrupt_mode,)
+            stale_args = ()
+            traffic_stats = {}
+            if traffic_staleness:
+                # fluteflow traced staleness (one more per-round data
+                # operand): gated on the LIVE mask — padding slots and
+                # chaos-dropped clients contribute nothing, so their
+                # staleness must not count — and binned into the
+                # per-staleness histogram that rides the packed stats
+                # (the host replay oracle in traffic/schedule.py is the
+                # cross-check).  The strategy consumes the TRUE value;
+                # only the histogram clips at its last (overflow) bin.
+                stale_vec = extra_args[n_used]
+                n_used += 1
+                stale_vec = jnp.where(client_mask > 0, stale_vec, 0)
+                f32 = jnp.float32
+                live = (client_mask > 0).astype(f32)
+                binned = jnp.minimum(stale_vec, STALE_HIST_BINS - 1)
+                traffic_stats = {
+                    f"traffic_stale_{b}": jnp.sum(
+                        (binned == b).astype(f32) * live)
+                    for b in range(STALE_HIST_BINS)}
+                traffic_stats["traffic_stale_sum"] = jnp.sum(
+                    stale_vec.astype(f32) * live)
+                stale_args = (stale_vec,)
             pool_args = extra_args[n_used:]
             # strategies may move the broadcast point off the canonical
             # params (e.g. FedAC's momentum-like md point); default identity
@@ -1240,7 +1309,7 @@ class RoundEngine:
                 quant_threshold, rng, client_ids, sampled_cm,
                 *carry_tab_args,
                 *((carry_slots,) if carry_paged else ()),
-                *corrupt_args, *pool_args)
+                *corrupt_args, *stale_args, *pool_args)
             collected, privacy_per_client = collect_out[0], collect_out[1]
             pos = 2
             if robust_stack:
@@ -1364,6 +1433,7 @@ class RoundEngine:
                 "agg_grad_norm": optax.global_norm(agg),
             }
             round_stats.update(chaos_stats)
+            round_stats.update(traffic_stats)
             round_stats.update(secagg_stats)
             round_stats.update(rl_stats)
             if shield is not None:
@@ -1423,7 +1493,9 @@ class RoundEngine:
         chaos_faults = self.chaos_client_faults
         chaos_corruption = self.chaos_corruption
         n_extra = (1 if self.carry_paged else 0) + \
-            (2 if chaos_faults else 0) + (1 if chaos_corruption else 0)
+            (2 if chaos_faults else 0) + \
+            (1 if chaos_corruption else 0) + \
+            (1 if self.traffic_staleness else 0)
 
         def multi(params, opt_state, strategy_state, arrays, sample_mask,
                   client_mask, client_ids, client_lrs, server_lrs,
@@ -1593,32 +1665,36 @@ class RoundEngine:
     # ------------------------------------------------------------------
     def _chaos_host(self, chaos_vecs: Optional[list],
                     stacked: bool) -> tuple:
-        """Validate + assemble the chaos fault vectors as HOST numpy
-        arrays, one per trailing program operand: per round ``(drop [K],
-        keep_steps [K])`` when client faults compiled in, followed by
-        ``(corrupt_mode [K],)`` when corruption compiled in — or nothing
-        when the engine compiled without either.  Mismatches are
-        programming errors and raise."""
+        """Validate + assemble the per-round fault/staleness vectors as
+        HOST numpy arrays, one per trailing program operand: per round
+        ``(drop [K], keep_steps [K])`` when client faults compiled in,
+        followed by ``(corrupt_mode [K],)`` when corruption compiled in,
+        followed by ``(staleness [K],)`` when traced staleness compiled
+        in (fluteflow) — or nothing when the engine compiled without
+        any.  Mismatches are programming errors and raise."""
         dtypes = ([np.float32, np.float32] if self.chaos_client_faults
                   else []) + \
-                 ([np.int32] if self.chaos_corruption else [])
+                 ([np.int32] if self.chaos_corruption else []) + \
+                 ([np.int32] if self.traffic_staleness else [])
         if not dtypes:
             if chaos_vecs:
                 raise ValueError(
                     "chaos vectors supplied but the engine was built "
-                    "without chaos client faults or corruption "
-                    "(server_config.chaos)")
+                    "without chaos client faults, corruption, or traced "
+                    "staleness (server_config.chaos / traffic)")
             return ()
         if not chaos_vecs:
             raise ValueError(
-                "engine built with chaos client faults/corruption: every "
-                "dispatch needs the per-round fault vectors")
+                "engine built with chaos client faults/corruption/"
+                "traced staleness: every dispatch needs the per-round "
+                "vectors")
         if any(len(entry) != len(dtypes) for entry in chaos_vecs):
             raise ValueError(
                 f"chaos vector arity mismatch: engine expects "
                 f"{len(dtypes)} per-round vectors "
                 f"(faults={self.chaos_client_faults}, "
-                f"corruption={self.chaos_corruption})")
+                f"corruption={self.chaos_corruption}, "
+                f"staleness={self.traffic_staleness})")
         out = []
         for i, dt in enumerate(dtypes):
             vals = [np.asarray(entry[i], dt) for entry in chaos_vecs]
@@ -1982,6 +2058,9 @@ class RoundEngine:
         chaos_corruption = self.chaos_corruption
         corrupt_scale = self._corrupt_scale
         corrupt_flip_scale = self._corrupt_flip_scale
+        # fluteflow: the traced-staleness operand threads after
+        # corrupt_mode per bucket, exactly like the monolithic round
+        traffic_staleness = self.traffic_staleness
         device_carry = self.device_carry
         carry_paged = self.carry_paged
         # mesh-sharded page pool: same split as the monolithic round —
@@ -2001,7 +2080,8 @@ class RoundEngine:
                        client_mask, client_ids, client_lr, round_idx,
                        leakage_threshold, quant_threshold, rng,
                        cohort_ids=None, cohort_mask=None,
-                       carry_slots=None, corrupt_mode=None, pool=None,
+                       carry_slots=None, corrupt_mode=None,
+                       staleness=None, pool=None,
                        ptr=None, seg=None):
             if self.partition_mode == "shard_map":
                 def gather_axis(x):
@@ -2030,6 +2110,7 @@ class RoundEngine:
                 rest = list(rest)
                 slot_c = rest.pop(0) if carry_paged else cid_c
                 corrupt_c = rest.pop(0) if chaos_corruption else None
+                stale_c = rest.pop(0) if traffic_staleness else None
                 rng_c = jax.random.fold_in(rng, cid_c)
                 if mega:
                     # fake-update replay: the lane scan already trained
@@ -2065,14 +2146,18 @@ class RoundEngine:
                             live_mask=cm_c, round_idx=round_idx,
                             leakage_threshold=leakage_threshold,
                             quant_threshold=quant_threshold,
-                            strategy_state=strategy_state)
+                            strategy_state=strategy_state,
+                            **({"staleness": stale_c} if traffic_staleness
+                               else {}))
                 else:
                     parts, tl, ns, stats = strategy.client_step(
                         update_fn, params, arr_c, mask_c, client_lr,
                         rng_c, round_idx=round_idx,
                         leakage_threshold=leakage_threshold,
                         quant_threshold=quant_threshold,
-                        strategy_state=strategy_state)
+                        strategy_state=strategy_state,
+                        **({"staleness": stale_c} if traffic_staleness
+                           else {}))
                 if chaos_corruption:
                     pg0, w0 = parts["default"]
                     mult = jnp.where(
@@ -2131,6 +2216,7 @@ class RoundEngine:
             vmap_args = (arrays, sample_mask, client_mask, client_ids) + \
                 ((carry_slots,) if carry_paged else ()) + \
                 ((corrupt_mode,) if chaos_corruption else ()) + \
+                ((staleness,) if traffic_staleness else ()) + \
                 mega_rows
             parts, tls, nss, stats, stale, carry_rows, sub_norms = \
                 jax.vmap(per_client)(*vmap_args)
@@ -2226,6 +2312,7 @@ class RoundEngine:
                 off = jax.lax.axis_index(CLIENTS_AXIS) * shard_slots
                 slots = jnp.where(slots >= 0, slots - off, -1)
             corrupt = rest.pop(0) if chaos_corruption else None
+            stale = rest.pop(0) if traffic_staleness else None
             pool_arg = rest.pop(0) if pool_mode else None
             return shard_body(params, strategy_state, arrays, sample_mask,
                               client_mask, client_ids, client_lr,
@@ -2233,8 +2320,8 @@ class RoundEngine:
                               quant_threshold, rng,
                               cohort_ids=cohort_ids,
                               cohort_mask=cohort_mask, carry_slots=slots,
-                              corrupt_mode=corrupt, pool=pool_arg,
-                              ptr=ptr, seg=seg)
+                              corrupt_mode=corrupt, staleness=stale,
+                              pool=pool_arg, ptr=ptr, seg=seg)
 
         if self.partition_mode == "shard_map":
             out_specs = ((rspec, cspec) if defer_screen else
@@ -2249,6 +2336,7 @@ class RoundEngine:
                          ((cspec,) if carry_split else ()) +
                          ((cspec,) if carry_paged else ()) +
                          ((cspec,) if chaos_corruption else ()) +
+                         ((cspec,) if traffic_staleness else ()) +
                          ((rspec,) if pool_mode else ()),
                 out_specs=out_specs, check_vma=False)
         else:
@@ -2311,6 +2399,21 @@ class RoundEngine:
                         (corrupt_mode == CORRUPT_SIGN_FLIP).astype(f32)),
                 })
                 corrupt_args = (corrupt_mode,)
+            stale_args = ()
+            if traffic_staleness:
+                stale_vec = extra_args[n_used]
+                n_used += 1
+                stale_vec = jnp.where(client_mask > 0, stale_vec, 0)
+                f32 = jnp.float32
+                live = (client_mask > 0).astype(f32)
+                binned = jnp.minimum(stale_vec, STALE_HIST_BINS - 1)
+                chaos_stats.update({
+                    f"traffic_stale_{b}": jnp.sum(
+                        (binned == b).astype(f32) * live)
+                    for b in range(STALE_HIST_BINS)})
+                chaos_stats["traffic_stale_sum"] = jnp.sum(
+                    stale_vec.astype(f32) * live)
+                stale_args = (stale_vec,)
             pool_args = extra_args[n_used:]
             bcast = strategy.broadcast_params(params, strategy_state)
             if carry_split:
@@ -2328,7 +2431,7 @@ class RoundEngine:
                             else ()),
                           *tape_args, *carry_tab_args,
                           *((carry_slots,) if carry_paged else ()),
-                          *corrupt_args, *pool_args)
+                          *corrupt_args, *stale_args, *pool_args)
             if defer_screen:
                 result = {"pc": out[0], "privacy": out[1]}
             else:

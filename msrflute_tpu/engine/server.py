@@ -37,6 +37,7 @@ from ..models.base import BaseTask
 from ..optim import PlateauTracker, make_lr_schedule
 from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
 from ..resilience import PreemptionHandler, make_chaos
+from ..traffic import STALE_HIST_BINS, make_traffic
 from ..resilience.integrity import RetryPolicy
 from ..strategies import select_strategy
 from ..telemetry import NULL_SPAN, emit_event, make_telemetry
@@ -217,6 +218,79 @@ class OptimizationServer:
                     "would ignore the injected faults; zero those rates "
                     "(IO faults and preempt_at_round still apply) or "
                     "drop the feature")
+
+        # ---- fluteflow: event-driven arrival plane -------------------
+        # server_config.traffic (traffic/): clients become available per
+        # a seeded trace and aggregation FIRES when the buffer fills —
+        # the schedule replaces boundary sampling (the base _sample
+        # consults it), so every plane that assumes "cohort drawn at the
+        # round boundary" must either compose or refuse loudly here.
+        self.traffic = make_traffic(sc, len(train_dataset))
+        #: next fire the base _sample will serve; re-anchored to the
+        #: resumed round at train() entry (the timeline is a pure
+        #: function of the seed, so fast_forward is a cache warm-up)
+        self._traffic_round = 0
+        if self.traffic is not None:
+            if host_orchestrated:
+                raise ValueError(
+                    "server_config.traffic requires the fused round "
+                    "path — wantRL, strategy: scaffold / ef_quant, and "
+                    "personalization orchestrate rounds host-side and "
+                    "would keep boundary sampling, silently ignoring "
+                    "the arrival plane; drop the traffic block for "
+                    "this configuration")
+            ncpi = sc.get("num_clients_per_iteration", 10)
+            if not isinstance(ncpi, int) or \
+                    self.traffic.buffer_size != int(ncpi):
+                raise ValueError(
+                    f"server_config.traffic.buffer_size "
+                    f"({self.traffic.buffer_size}) must equal a FIXED "
+                    f"num_clients_per_iteration (got {ncpi!r}) — the "
+                    "fused program's [K, S, B] grid is compiled for "
+                    "exactly K client slots, so the buffer IS the "
+                    "cohort (the FedBuff buffer == K mapping)")
+            if (self._fleet_cfg is not None and
+                    str(self._fleet_cfg.get("sampling", "uniform"))
+                    != "uniform"):
+                raise ValueError(
+                    "server_config.traffic and fleet.sampling != "
+                    "'uniform' are two cohort-selection planes — the "
+                    "arrival schedule decides WHO trains, so a "
+                    "weighted/floyd fleet draw would be silently "
+                    "ignored; use fleet.sampling: uniform or drop the "
+                    "traffic block")
+            _sa = sc.get("secure_agg") or {}
+            if _sa and _sa.get("enable", True):
+                _min_surv = int(_sa.get("min_survivors", 0) or 0)
+                if _min_surv > self.traffic.buffer_size:
+                    raise ValueError(
+                        f"secure_agg.min_survivors ({_min_surv}) "
+                        f"exceeds traffic.buffer_size "
+                        f"({self.traffic.buffer_size}) — a buffered "
+                        "fire delivers exactly buffer_size clients, so "
+                        "every round would abort below the liveness "
+                        "floor; lower min_survivors or raise "
+                        "buffer_size")
+            if self.engine.traffic_staleness:
+                _mgb_t = sc.get("megabatch") or {}
+                if _mgb_t and _mgb_t.get("enable", True):
+                    raise ValueError(
+                        "server_config.megabatch cannot compose with "
+                        "traced staleness (traffic.mode: buffered + a "
+                        "staleness-aware strategy): megabatch_passes "
+                        "replays the strategy's in-jit staleness draw "
+                        "per lane and would diverge from the trace's "
+                        "true per-client staleness; drop megabatch or "
+                        "run traffic.mode: sync")
+        #: convergence-tier gate surface (traffic.target_accuracy): the
+        #: first round whose val accuracy reaches the configured target
+        #: — None until reached, and stays None when no target is set or
+        #: the run never gets there.  bench.py records it per protocol
+        #: and per traffic_ab arm; `scope trend` gates it alongside
+        #: secs_per_round.
+        self.rounds_to_target_accuracy: Optional[int] = None
+        _tgt = (sc.get("traffic") or {}).get("target_accuracy")
+        self.target_accuracy = (float(_tgt) if _tgt is not None else None)
         #: SIGTERM/SIGINT -> drain in-flight round -> emergency
         #: checkpoint -> resumable exit (resilience/preemption.py); the
         #: loop polls `requested` at chunk boundaries
@@ -837,6 +911,22 @@ class OptimizationServer:
 
     # ------------------------------------------------------------------
     def _sample(self) -> list:
+        if self.traffic is not None:
+            # fluteflow: the arrival plane decides WHO trains — the
+            # cohort is the fire's buffer contents, replayed from the
+            # seeded timeline (deterministic in fire order, so serial ==
+            # pipelined == prefetched == resumed).  The numpy sampling
+            # trail is untouched: a traffic run is a different trail by
+            # construction, like a fleet sampling mode.
+            r = self._traffic_round
+            self._traffic_round = r + 1
+            fire = self.traffic.fire(r)
+            emit_event(self.scope, "buffer_fired", round=r,
+                       tick=int(fire["tick"]),
+                       wait_ticks=int(fire["wait_ticks"]),
+                       stale_max=int(fire["staleness"].max(initial=0)),
+                       stale_sum=int(fire["staleness"].sum()))
+            return [int(c) for c in fire["cohort"]]
         sc = self.config.server_config
         n = parse_clients_per_round(sc.get("num_clients_per_iteration", 10),
                                     self._np_rng)
@@ -878,6 +968,12 @@ class OptimizationServer:
         self.preempted = False
         self.preemption.reset()  # a past preemption must not latch forever
         self.preemption.install()
+        if self.traffic is not None:
+            # a resumed run replays the identical fire sequence: the
+            # timeline is a pure function of the traffic seed, so this
+            # is a cache warm-up, not a state restore
+            self._traffic_round = int(self.state.round)
+            self.traffic.fast_forward(self._traffic_round)
         if self.scope is not None:
             # stall monitor (ISSUE 13): a named daemon thread polling
             # the round-completion heartbeat — spawned only when
@@ -1167,13 +1263,15 @@ class OptimizationServer:
                             new_sstate, self.state.round)
             chaos_vecs = None
             if self.engine.chaos_client_faults or \
-                    self.engine.chaos_corruption:
+                    self.engine.chaos_corruption or \
+                    self.engine.traffic_staleness:
                 # deterministic per-round fault vectors (seeded on the
                 # round index, resilience/chaos.py) — data operands of
                 # the compiled program, so no recompile ever.  Each
                 # entry carries (drop, keep_steps) and/or the
-                # adversarial corruption modes, matching what the
-                # engine compiled in.
+                # adversarial corruption modes and/or the arrival
+                # plane's traced staleness, matching what the engine
+                # compiled in (the _chaos_host arity check).
                 chaos_vecs = []
                 for j in range(R):
                     if self.cohort_bucketing is not None:
@@ -1194,6 +1292,13 @@ class OptimizationServer:
                                     round_no + j,
                                     batch.sample_mask.shape[0],
                                     salt=bi + 1),)
+                            if self.engine.traffic_staleness:
+                                # staleness keys on CLIENT id, not the
+                                # bucket slot: the fire's lookup table
+                                # realigns to however the packer split
+                                # the cohort (padding slots map to 0)
+                                entry += (self.traffic.staleness_vector(
+                                    round_no + j, batch.client_ids),)
                             per_bucket.append(entry)
                         chaos_vecs.append(per_bucket)
                         continue
@@ -1205,6 +1310,9 @@ class OptimizationServer:
                         entry += (self.chaos.corrupt_modes(
                             round_no + j,
                             batches[j].sample_mask.shape[0]),)
+                    if self.engine.traffic_staleness:
+                        entry += (self.traffic.staleness_vector(
+                            round_no + j, batches[j].client_ids),)
                     chaos_vecs.append(entry)
             # the device window span opens at dispatch and is ended by
             # whoever drains this chunk — the explicit begin/end API
@@ -1575,6 +1683,20 @@ class OptimizationServer:
                     emit_event(self.scope, "chaos_corruption", round=r,
                                nan_injected=nans, scaled=scaled,
                                sign_flipped=flipped)
+        if self.traffic is not None and "traffic_stale_sum" in stats:
+            # arrival-plane observability: the on-device staleness
+            # histogram rides the SAME packed transfer as every other
+            # stat; the schedule's host-side rollups are the replay
+            # oracle these counters are cross-checked against
+            # (tests/test_traffic.py)
+            for j in range(R):
+                r = round0 + j
+                stale_sum = float(stats["traffic_stale_sum"][j])
+                hist = [float(stats[f"traffic_stale_{b}"][j])
+                        for b in range(STALE_HIST_BINS)]
+                log_metric("Traffic staleness sum", stale_sum, step=r)
+                emit_event(self.scope, "traffic_staleness", round=r,
+                           stale_sum=stale_sum, hist=hist)
         if self.shield is not None and "shield_nonfinite" in stats:
             # fluteshield quarantine observability: per-cause counters
             # computed inside the round program, fetched through the
@@ -1783,6 +1905,23 @@ class OptimizationServer:
             # flat copy for the `scope diff --gate` lower_frac rule
             card["megabatch_utilization"] = \
                 card["megabatch"]["utilization"]
+        if self.traffic is not None:
+            # arrival-plane rollups (traffic/schedule.py): the trace
+            # identity plus the host replay oracle's counters — enough
+            # to make a traffic run impossible to confuse with a
+            # boundary-sampled baseline in `scope diff`
+            card["traffic"] = {
+                **self.traffic.describe(),
+                "arrival_rate": round(self.traffic.arrival_rate(), 6),
+                "mean_buffer_occupancy": round(
+                    self.traffic.mean_buffer_occupancy(), 6),
+                "stale_hist": [int(c) for c in self.traffic.stale_hist],
+                "counters": {k: float(v) for k, v in
+                             self.traffic.counters.items()},
+                "target_accuracy": self.target_accuracy,
+                "rounds_to_target_accuracy":
+                    self.rounds_to_target_accuracy,
+            }
         reg = self.engine.xla
         if reg is not None:
             card["entry_points"] = reg.summary()
@@ -2585,6 +2724,19 @@ class OptimizationServer:
                     self.ckpt.save_best(self.state, name)
                     if name == self.best_model_criterion:
                         improved = True
+            # convergence-tier crossing (traffic.target_accuracy): the
+            # FIRST val eval at/above the target pins the round — the
+            # rounds_to_target_accuracy bench.py records and `scope
+            # trend` gates alongside secs_per_round
+            if self.target_accuracy is not None and \
+                    self.rounds_to_target_accuracy is None:
+                acc = metrics.get("acc")
+                if acc is not None and np.isfinite(acc.value) and \
+                        float(acc.value) >= self.target_accuracy:
+                    self.rounds_to_target_accuracy = int(round_no)
+                    emit_event(self.scope, "target_accuracy_reached",
+                               round=round_no, acc=float(acc.value),
+                               target=self.target_accuracy)
         return improved
 
     def _log_per_user_stats(self, split: str, round_no: int,

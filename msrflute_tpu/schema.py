@@ -238,6 +238,60 @@ MEGAKERNEL_FIELD_SPECS = {
     "pallas_apply": ("bool", None, None),
 }
 
+# mirrors traffic/schedule.py _SCHEDULE_KEYS + the trace knobs consumed
+# by traffic/traces.py make_trace (schema_drift keeps the docs table in
+# sync): a misspelled arrival knob silently running the Poisson defaults
+# is the quiet failure this schema exists to prevent
+TRAFFIC_KEYS = {
+    "enable", "mode", "seed", "buffer_size", "duration_lo",
+    "duration_hi", "max_idle_ticks", "target_accuracy",
+    # trace selection + per-trace knobs (traffic/traces.py)
+    "trace", "rate", "period", "depth", "burst_rate", "burst_every",
+    "burst_len", "classes",
+}
+
+#: arrival-plane mode vocabulary (traffic/schedule.py TRAFFIC_MODES):
+#: `buffered` = FedBuff-style async firing with true traced staleness;
+#: `sync` = the barrier baseline (stale deliveries discarded, counted)
+ALLOWED_TRAFFIC_MODES = ["sync", "buffered"]
+
+#: trace catalogue (traffic/traces.py TRACE_NAMES)
+ALLOWED_TRAFFIC_TRACES = ["poisson", "diurnal", "bursty",
+                          "device_classes"]
+
+TRAFFIC_FIELD_SPECS = {
+    "enable": ("bool", None, None),
+    "seed": ("int", None, None),
+    # arrivals needed to fire a round — must equal the run's (fixed)
+    # num_clients_per_iteration: the fused [K, S, B] grid is compiled
+    # for exactly K client slots, so the buffer IS the cohort (the
+    # server refuses a mismatch at construction)
+    "buffer_size": ("int", 1, None),
+    # training-duration draw bounds, in ticks (per-class duration_scale
+    # multiplies on top for device_classes)
+    "duration_lo": ("int", 1, None),
+    "duration_hi": ("int", 1, None),
+    # starvation tripwire: ticks without a fire before the schedule
+    # raises instead of spinning forever on an undersubscribed trace
+    "max_idle_ticks": ("int", 1, None),
+    # bench.py rounds_to_target_accuracy threshold (traffic_ab arm)
+    "target_accuracy": ("num", 0.0, 1.0),
+    # mean arrivals per tick across the population (trace-specific
+    # baseline; bursty's off-burst floor)
+    "rate": ("num", 0.0, None),
+    # diurnal / device_classes cycle length, ticks
+    "period": ("int", 1, None),
+    # diurnal modulation depth: 0 = flat, 1 = full swing through zero
+    "depth": ("num", 0.0, None),
+    # bursty flash-crowd knobs: in-burst rate + burst geometry
+    "burst_rate": ("num", 0.0, None),
+    "burst_every": ("int", 1, None),
+    "burst_len": ("int", 1, None),
+    # `mode`/`trace` keep enum checks in validate(); `classes` (a list
+    # of per-class mappings) keeps a bespoke check — the scalar spec
+    # table cannot express it
+}
+
 PRECISION_KEYS = {
     "enable", "params", "compute", "stats",
 }
@@ -422,6 +476,11 @@ SERVER_KEYS = {
     # kill/resume drill) and the checkpoint retry/backoff/escalation
     # policy — see docs/config_extensions.md and docs/RUNBOOK.md
     "chaos", "checkpoint_retry",
+    # fluteflow: event-driven arrival plane (traffic/) — seeded traffic
+    # traces decide WHO trains and WHEN aggregation fires (buffered
+    # async with true traced staleness, or the sync barrier baseline);
+    # see docs/config_extensions.md
+    "traffic",
     # flutescope telemetry: round spans + Perfetto trace export, the
     # packed-stats device-metric bus, opt-in jax.profiler round windows,
     # and the NaN/round-time/checkpoint watchdogs — default off, zero
@@ -966,6 +1025,52 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                            MEGAKERNEL_KEYS)
             _check_fields(errors, mk, "server_config.megakernel",
                           MEGAKERNEL_FIELD_SPECS)
+        traffic = sc.get("traffic")
+        if traffic is not None and not isinstance(traffic, dict):
+            errors.append(
+                "server_config.traffic: must be a mapping (see "
+                "docs/config_extensions.md), got "
+                f"{type(traffic).__name__}")
+        if isinstance(traffic, dict):
+            _check_unknown(unknown, traffic, "server_config.traffic",
+                           TRAFFIC_KEYS)
+            _check_fields(errors, traffic, "server_config.traffic",
+                          TRAFFIC_FIELD_SPECS)
+            _check_enum(errors, traffic, "server_config.traffic",
+                        "mode", ALLOWED_TRAFFIC_MODES)
+            _check_enum(errors, traffic, "server_config.traffic",
+                        "trace", ALLOWED_TRAFFIC_TRACES)
+            lo, hi = traffic.get("duration_lo"), traffic.get("duration_hi")
+            if isinstance(lo, int) and isinstance(hi, int) and hi < lo:
+                errors.append(
+                    "server_config.traffic: duration_hi "
+                    f"({hi}) < duration_lo ({lo})")
+            classes = traffic.get("classes")
+            if classes is not None and (
+                    not isinstance(classes, (list, tuple)) or
+                    not all(isinstance(c, dict) for c in classes)):
+                errors.append(
+                    "server_config.traffic.classes: expected a list of "
+                    "per-class mappings (fraction/rate/window/phase/"
+                    f"duration_scale), got {classes!r}")
+            if traffic.get("enable", True):
+                # decidable at config load (the quiet-failure rule):
+                # the liveness floor can never be met when it exceeds
+                # the fire size — every round would abort
+                _sa_blk = sc.get("secure_agg") or {}
+                if isinstance(_sa_blk, dict) and \
+                        _sa_blk.get("enable", True):
+                    ms = _sa_blk.get("min_survivors")
+                    bs = traffic.get("buffer_size",
+                                     sc.get("num_clients_per_iteration"))
+                    if isinstance(ms, int) and isinstance(bs, int) and \
+                            ms > bs:
+                        errors.append(
+                            "server_config.secure_agg.min_survivors "
+                            f"({ms}) exceeds traffic.buffer_size ({bs}) "
+                            "— a buffered fire delivers exactly "
+                            "buffer_size clients, so every round would "
+                            "abort below the liveness floor")
         prec = sc.get("precision")
         if prec is not None and not isinstance(prec, dict):
             errors.append(

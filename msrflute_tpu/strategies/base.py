@@ -60,6 +60,14 @@ class BaseStrategy:
     #: reference core/strategies/dga.py:260-284); the engine draws the
     #: per-client coin and hands combine() separate now/deferred sums.
     stale_prob: float = 0.0
+    #: fluteflow traced staleness (traffic/): True when
+    #: :meth:`client_step` accepts a ``staleness=`` int32 operand — the
+    #: arrival plane's TRUE broadcast-version gap per update.  The
+    #: engine compiles the operand in (and the server builds per-fire
+    #: staleness vectors) only when this is declared AND
+    #: ``server_config.traffic.mode`` is ``buffered`` — staleness-blind
+    #: strategies keep their exact call signature under traffic.
+    supports_traced_staleness: bool = False
     #: when True the engine skips the server optimizer and calls
     #: :meth:`apply_server_update` instead (multi-sequence schemes: FedAC)
     owns_server_update: bool = False

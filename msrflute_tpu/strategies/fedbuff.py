@@ -34,6 +34,23 @@ buffer size of the paper maps onto ``num_clients_per_iteration`` (K
 arrivals trigger one server step).  ``max_staleness: 1`` is exactly
 FedAvg (every client reads index 0) — pinned by test.
 
+Drawn vs TRACED staleness: without the arrival plane, ``s_i`` is a
+MODEL — an in-jit uniform draw from the client's rng fold, standing in
+for an async timeline the simulator does not have.  With
+``server_config.traffic`` in ``buffered`` mode the timeline is real:
+the engine passes each update's TRUE broadcast-version gap (fires since
+the client's version, ``traffic/schedule.py``) as an int32 data operand
+and ``client_step`` uses it instead of drawing — the history index
+clips to ``max_staleness - 1`` (the state holds that many versions; an
+older client trains from the oldest retained), while the aggregation
+DISCOUNT uses the unclipped true gap, so over-horizon updates are
+downweighted by how stale they actually are.  The ``max_staleness: 1
+== FedAvg`` pin carries over exactly when the trace's timeline is
+staleness-free (every fire at version gap 0, e.g. ``mode: sync``
+semantics or ``buffer_size`` small enough that no overlap occurs);
+with real staleness in the trace the two differ precisely by the
+discount — that difference is the measurement, not a bug.
+
 Config::
 
     strategy: fedbuff
@@ -57,6 +74,11 @@ from .fedavg import FedAvg
 class FedBuff(FedAvg):
 
     supports_staleness = False   # DGA's aggregate deferral doesn't compose
+    #: the engine compiles the traced-staleness operand in (and the
+    #: server builds per-fire staleness vectors) only for strategies
+    #: that declare they consume it — see the module docstring's
+    #: drawn-vs-traced distinction
+    supports_traced_staleness = True
     supports_rl = False
     owns_server_update = True
     stateful = True
@@ -106,13 +128,21 @@ class FedBuff(FedAvg):
     def client_step(self, client_update, global_params, arrays, sample_mask,
                     client_lr, rng, round_idx=None, leakage_threshold=None,
                     quant_threshold=None, strategy_state=None,
-                    grad_offset=None):
+                    grad_offset=None, staleness=None):
         # per-client staleness: this client trains from the version it
         # "received" s_i server-steps ago.  Early rounds have identical
         # history slots (init_state), matching a cold-start system where
-        # nothing has moved yet.
-        s_i = jax.random.randint(jax.random.fold_in(rng, 23), (), 0,
-                                 self.max_staleness)
+        # nothing has moved yet.  ``staleness`` (traced mode, the
+        # arrival plane's int32 operand) replaces the modeled draw: the
+        # history index clips to the retained horizon, the discount
+        # keeps the TRUE gap (module docstring).
+        if staleness is not None:
+            s_true = jnp.asarray(staleness, jnp.int32)
+            s_i = jnp.clip(s_true, 0, self.max_staleness - 1)
+        else:
+            s_i = jax.random.randint(jax.random.fold_in(rng, 23), (), 0,
+                                     self.max_staleness)
+            s_true = s_i
         start = jax.tree.map(lambda h: h[s_i],
                              strategy_state["history"])
         parts, tl, ns, stats = super().client_step(
@@ -121,7 +151,7 @@ class FedBuff(FedAvg):
             quant_threshold=quant_threshold, strategy_state=strategy_state,
             grad_offset=grad_offset)
         pg, w = parts["default"]
-        discount = (1.0 + s_i.astype(jnp.float32)) ** (-self.rho)
+        discount = (1.0 + s_true.astype(jnp.float32)) ** (-self.rho)
         parts["default"] = (pg, w * discount)
         return parts, tl, ns, stats
 

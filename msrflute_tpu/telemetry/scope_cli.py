@@ -439,7 +439,8 @@ def _bench_entry(path: str) -> Dict[str, Any]:
         if isinstance(block, dict) and "secs_per_round" in block:
             row = {"secs_per_round": block.get("secs_per_round")}
             for key in ("mfu_vs_bf16_peak", "device_truth",
-                        "padding_efficiency", "megabatch_utilization"):
+                        "padding_efficiency", "megabatch_utilization",
+                        "rounds_to_target_accuracy", "traffic"):
                 if key in block:
                     row[key] = block[key]
             protocols[name] = row
@@ -454,8 +455,11 @@ def trend_bench(paths: List[str],
     """Series view over committed bench artifacts (given order — pass
     them sorted; BENCH_* stamps sort chronologically) + regressions
     between the last two entries that actually measured: the headline
-    ``value`` and each shared protocol's ``secs_per_round``, both gated
-    at ``pct`` (default 15%) slower-than-previous."""
+    ``value``, each shared protocol's ``secs_per_round``, and — when a
+    convergence target is configured — its
+    ``rounds_to_target_accuracy``, all gated at ``pct`` (default 15%)
+    worse-than-previous; efficiency ratios gate in the drop
+    direction."""
     thresh = (float(pct) if pct is not None else 15.0) / 100.0
     series = [_bench_entry(p) for p in paths]
     measured = [e for e in series if isinstance(e.get("value"),
@@ -497,6 +501,26 @@ def trend_bench(paths: List[str],
                         "a": pa, "b": pb,
                         "a_file": prev["file"], "b_file": last["file"],
                         "limit": round(pa * (1.0 - thresh), 6),
+                        "threshold": thresh})
+            # convergence tier (flutetraffic): MORE rounds to the same
+            # target accuracy is a regression, and so is LOSING a
+            # previously-reached target (a measured count decaying to
+            # null while the newer artifact still configures a target —
+            # null without a configured target just means "not a
+            # convergence run" and never gates)
+            ra = prev["protocols"][name].get("rounds_to_target_accuracy")
+            rb = last["protocols"][name].get("rounds_to_target_accuracy")
+            if isinstance(ra, (int, float)) and ra > 0:
+                tr_last = last["protocols"][name].get("traffic") or {}
+                lost = (rb is None and
+                        tr_last.get("target_accuracy") is not None)
+                if lost or (isinstance(rb, (int, float)) and
+                            rb > ra * (1.0 + thresh)):
+                    regressions.append({
+                        "metric": f"{name}.rounds_to_target_accuracy",
+                        "a": ra, "b": rb,
+                        "a_file": prev["file"], "b_file": last["file"],
+                        "limit": round(ra * (1.0 + thresh), 6),
                         "threshold": thresh})
     return {"series": series, "regressions": regressions,
             "ok": not regressions}

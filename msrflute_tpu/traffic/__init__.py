@@ -1,0 +1,20 @@
+"""fluteflow — the event-driven arrival plane (``server_config.traffic``).
+
+Seeded traffic traces (:mod:`.traces`) model WHEN clients become
+available; the :class:`~.schedule.TrafficSchedule` turns arrivals into
+buffer-triggered round fires carrying TRUE per-update staleness.  See
+``docs/config_extensions.md`` ("traffic") for knobs, the trace
+catalogue, and the composition/refusal lists.
+"""
+
+from .traces import (ArrivalTrace, BurstyTrace, DeviceClassTrace,
+                     DiurnalTrace, PoissonTrace, TRACE_NAMES, make_trace)
+from .schedule import (STALE_HIST_BINS, TRAFFIC_MODES, TrafficSchedule,
+                       make_traffic)
+
+__all__ = [
+    "ArrivalTrace", "PoissonTrace", "DiurnalTrace", "BurstyTrace",
+    "DeviceClassTrace", "TRACE_NAMES", "make_trace",
+    "TrafficSchedule", "TRAFFIC_MODES", "STALE_HIST_BINS",
+    "make_traffic",
+]

@@ -495,6 +495,51 @@ def test_schema_drift_covers_fleet_specs(tmp_path):
         "FLEET_KEYS" in found[0].message
 
 
+def test_schema_drift_traffic_specs_consistent(tmp_path):
+    """PR 19 corpus (positive): a traffic block whose spec table only
+    rules keys the unknown-key pass knows, with the flash-crowd drill
+    in the runbook, is drift-free."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'traffic'}\n"
+        "TRAFFIC_KEYS = {'enable', 'mode', 'seed', 'buffer_size',"
+        " 'rate'}\n"
+        "TRAFFIC_FIELD_SPECS = {'seed': ('int', 0, None),"
+        " 'rate': ('num', 0, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.traffic` flash-crowd drill lives here.")
+    assert check_project(str(tmp_path),
+                         documented_knobs=("traffic",)) == []
+
+
+def test_schema_drift_flags_dead_traffic_spec(tmp_path):
+    """PR 19 corpus (negative): a TRAFFIC_FIELD_SPECS rule for a key
+    missing from TRAFFIC_KEYS is dead — the key errors as unknown
+    before its type rule ever runs."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'traffic'}\n"
+        "TRAFFIC_KEYS = {'enable', 'mode', 'seed'}\n"
+        "TRAFFIC_FIELD_SPECS = {'seed': ('int', 0, None),"
+        " 'burst_rate': ('num', 0, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.traffic` flash-crowd drill lives here.")
+    found = check_project(str(tmp_path), documented_knobs=("traffic",))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "burst_rate" in found[0].message and \
+        "TRAFFIC_KEYS" in found[0].message
+
+
 def test_schema_drift_real_tree_is_consistent():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     found = check_project(repo)
@@ -1501,6 +1546,107 @@ def test_guard_matrix_flags_missing_runtime_guard_and_schema(tmp_path):
     assert all(f.rule == "guard-matrix" for f in found)
     assert "`robust` has no runtime refusal" in msgs
     assert "no config-load-time strategy check" in msgs
+
+
+#: PR 19 corpus: the consistent tree extended with an arrival-plane
+#: block — `traffic` in SERVER_KEYS, the refusal ladder in server.py
+#: (host-orchestrated rounds, secure_agg liveness floor), and a docs
+#: section naming every refused token.
+_TRAFFIC_SCHEMA = """\
+    SERVER_KEYS = {'max_iteration', 'robust', 'traffic'}
+    ERR = ("server_config.robust is set but strategy is wrong — "
+           "it plugs into the fedavg combine only; payloads would "
+           "aggregate UNSCREENED")
+    """
+_TRAFFIC_SERVER = """\
+    class Server:
+        def __init__(self, sc, strategy):
+            host_orchestrated = (
+                sc.get("wantRL", False) or
+                getattr(strategy, "host_rounds", False))
+            if sc.get("robust") and host_orchestrated:
+                raise ValueError(
+                    "server_config.robust requires the fused round "
+                    "path — wantRL and scaffold orchestrate rounds "
+                    "host-side")
+            if sc.get("traffic") and host_orchestrated:
+                raise ValueError(
+                    "server_config.traffic drives the fused round "
+                    "path only — wantRL and scaffold orchestrate "
+                    "rounds host-side")
+            sa = sc.get("secure_agg") or {}
+            if sc.get("traffic") and sa.get("min_survivors", 0) > 4:
+                raise ValueError(
+                    "server_config.traffic buffered firing cannot "
+                    "satisfy the secure_agg min_survivors liveness "
+                    "floor — shrink the floor or grow the buffer")
+    """
+_TRAFFIC_DOCS = """\
+    # extensions
+
+    ### server_config.robust — screened aggregation
+
+    Requires `strategy: fedavg`.  Incompatible with `wantRL` and
+    `scaffold` (host-orchestrated rounds).
+
+    ### server_config.traffic — event-driven arrival plane
+
+    Buffered rounds fire on arrivals.  Refused with `wantRL` and
+    `scaffold` (host-orchestrated rounds) and with a `secure_agg`
+    `min_survivors` floor the buffer cannot satisfy.
+    """
+
+
+def test_guard_matrix_consistent_traffic_tree_passes(tmp_path):
+    """PR 19 corpus (positive): schema knows `traffic`, the server
+    carries the arrival-plane refusal ladder, and the docs section
+    names every refused token — matrix-consistent."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _TRAFFIC_SCHEMA,
+        "msrflute_tpu/engine/server.py": _TRAFFIC_SERVER,
+        "docs/config_extensions.md": _TRAFFIC_DOCS})
+    assert check_project(root) == []
+
+
+def test_guard_matrix_flags_traffic_refusal_token_missing_from_docs(
+        tmp_path):
+    """PR 19 corpus (negative): the traffic ladder refuses under the
+    `secure_agg` liveness floor but the docs section never mentions
+    it — the operator-facing table silently lags the code."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _TRAFFIC_SCHEMA,
+        "msrflute_tpu/engine/server.py": _TRAFFIC_SERVER,
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`
+            and `scaffold` (host-orchestrated rounds).
+
+            ### server_config.traffic — event-driven arrival plane
+
+            Buffered rounds fire on arrivals.  Refused with `wantRL`
+            and `scaffold` (host-orchestrated rounds).
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "secure_agg" in found[0].message
+    assert found[0].path == "docs/config_extensions.md"
+
+
+def test_guard_matrix_flags_traffic_missing_runtime_guard(tmp_path):
+    """PR 19 corpus (negative): `traffic` in SERVER_KEYS with no
+    runtime refusal anywhere — a host-orchestrated config would
+    silently run the arrival plane degraded."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _TRAFFIC_SCHEMA})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "`traffic` has no runtime refusal" in found[0].message
 
 
 # ======================================================================

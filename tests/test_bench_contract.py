@@ -134,6 +134,13 @@ def test_bench_records_device_truth_for_every_measured_protocol():
         # so a fleet run can never be silently compared against a
         # resident baseline
         assert line.get("fleet") == {"enabled": False}, (name, line)
+        # traffic marker (ISSUE 19): every protocol entry declares its
+        # arrival-plane posture and carries the convergence field —
+        # null here because no traffic.target_accuracy is configured,
+        # never a fabricated number
+        assert line.get("traffic") == {"enabled": False}, (name, line)
+        assert "rounds_to_target_accuracy" in line, (name, line)
+        assert line["rounds_to_target_accuracy"] is None, (name, line)
         # a steady-state bench protocol never recompiles (the sentinel's
         # no-churn invariant holds on the bench path too)
         assert truth["recompiles"] == 0, (name, truth)
@@ -493,3 +500,34 @@ def test_bench_bert_gathered_entry_configures_the_gathered_head():
     for key in ("vocab_size", "hidden_size", "num_hidden_layers",
                 "max_seq_length", "dtype"):
         assert gathered[key] == base[key], key
+
+
+def test_bench_traffic_ab_contract():
+    """ISSUE 19 acceptance surface: the traffic_ab harness races sync
+    vs buffered on the SAME seeded bursty trace and records
+    rounds_to_target_accuracy / secs_to_target / the crossing tick per
+    arm — null when an arm never reaches the target, and the comparison
+    verdicts are computed from the recorded numbers, not asserted."""
+    import inspect
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    src = inspect.getsource(bench.bench_traffic_ab)
+    for needle in ("rounds_to_target_accuracy", "secs_to_target",
+                   "tick_at_target", '"sync"', '"buffered"',
+                   "target_accuracy", "sync_discarded", "stale_sum",
+                   "async_fewer_secs_to_target",
+                   "async_earlier_tick_at_target"):
+        assert needle in src, needle
+    # both arms draw the identical trace: ONE trace dict, mode-only
+    # difference per arm
+    assert 'dict(trace, mode=arm)' in src
+    # per-protocol record: every protocol entry carries the convergence
+    # field and the arrival-plane marker via the shared extras helper
+    extras_src = inspect.getsource(bench._server_overhead_extras)
+    assert "rounds_to_target_accuracy" in extras_src
+    assert '"traffic"' in extras_src
+    # main() wires the arm in (default-on for CPU, env-gated on TPU)
+    main_src = inspect.getsource(bench.main)
+    assert "traffic_ab" in main_src and "BENCH_TRAFFIC_AB" in main_src

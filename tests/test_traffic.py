@@ -1,0 +1,534 @@
+"""fluteflow arrival plane (``server_config.traffic``).
+
+Contracts pinned here (ISSUE 19):
+
+- traces are seeded and stream-independent: the timeline is a pure
+  function of ``(traffic.seed, trace config, buffer_size, mode)`` —
+  never of the global RNG, the training RNG, or call order — and the
+  arrival/duration streams never collide;
+- buffered firing delivers TRUE staleness (broadcast-version gap), the
+  on-device histogram the packed stats carry matches the host replay
+  oracle bin for bin, and the staleness operand causes ZERO post-warmup
+  recompiles (data operand, not a shape);
+- ``mode: sync`` and ``mode: buffered`` coincide exactly when the
+  timeline is overlap-free (buffer == population), and FedBuff's
+  ``max_staleness: 1 == FedAvg`` pin carries over to traced mode on a
+  staleness-free timeline;
+- the composition tier: traced staleness + depth-3 pipeline + cohort
+  bucketing + fleet paging, and secure_agg over buffered cohorts, all
+  under ``MSRFLUTE_STRICT_TRANSFERS=1``, bit-identical serial vs piped;
+- the refusal ladder: host-orchestrated strategies (scaffold and kin),
+  buffer/cohort geometry mismatch, non-uniform fleet sampling, the
+  secure_agg ``min_survivors`` liveness floor, megabatch x traced
+  staleness, and clients_per_chunk x traced staleness all refuse
+  loudly at construction.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from msrflute_tpu.config import FLUTEConfig
+from msrflute_tpu.traffic import (STALE_HIST_BINS, TRACE_NAMES,
+                                  TRAFFIC_MODES, TrafficSchedule,
+                                  make_trace, make_traffic)
+from msrflute_tpu.traffic.traces import (_ARRIVAL_STREAM,
+                                         _DURATION_STREAM, tick_rng)
+
+
+def _sched(population=16, buffer_size=4, mode="buffered", seed=3,
+           trace=None, **kw):
+    return TrafficSchedule(
+        make_trace(trace or {"trace": "poisson", "rate": 6.0},
+                   population),
+        buffer_size=buffer_size, mode=mode, seed=seed, **kw)
+
+
+# ======================================================================
+# 1. traces: shapes, bounds, determinism, stream independence
+# ======================================================================
+@pytest.mark.parametrize("name", TRACE_NAMES)
+def test_trace_probs_shapes_and_bounds(name):
+    tr = make_trace({"trace": name}, 24)
+    assert tr.name == name and tr.population == 24
+    for t in (0, 1, 7, 63, 64, 1000):
+        p = tr.probs(t)
+        assert p.shape == (24,) and (p >= 0).all() and (p <= 1).all()
+    scale = tr.duration_scale()
+    assert scale.shape == (24,) and (scale >= 1.0).all()
+    assert tr.describe()["trace"] == name
+
+
+def test_trace_draws_never_touch_the_global_rng():
+    """Arrival decisions come from SeedSequence-keyed per-tick streams,
+    never the process-global RNG — enabling traffic cannot move any
+    draw another subsystem makes from ``np.random``."""
+    np.random.seed(123)
+    want = np.random.random(4)
+    np.random.seed(123)
+    s = _sched()
+    for r in range(6):
+        s.fire(r)
+    np.testing.assert_array_equal(np.random.random(4), want)
+
+
+def test_arrival_and_duration_streams_are_distinct():
+    a = tick_rng(7, _ARRIVAL_STREAM, 5).random(16)
+    d = tick_rng(7, _DURATION_STREAM, 5).random(16)
+    assert not np.array_equal(a, d)
+    # and both are pure functions of (seed, stream, tick)
+    np.testing.assert_array_equal(
+        a, tick_rng(7, _ARRIVAL_STREAM, 5).random(16))
+
+
+def test_schedule_is_deterministic_per_seed():
+    a, b = _sched(seed=11), _sched(seed=11)
+    for r in range(5):
+        fa, fb = a.fire(r), b.fire(r)
+        np.testing.assert_array_equal(fa["cohort"], fb["cohort"])
+        np.testing.assert_array_equal(fa["staleness"], fb["staleness"])
+        assert fa["tick"] == fb["tick"]
+    c = _sched(seed=12)
+    moved = any(
+        not np.array_equal(a.fire(r)["cohort"], c.fire(r)["cohort"])
+        for r in range(5))
+    assert moved
+
+
+def test_device_class_partition_covers_population():
+    tr = make_trace({"trace": "device_classes"}, 20)
+    assert tr._edges[0] == 0 and tr._edges[-1] == 20
+    assert (np.diff(tr._edges) >= 0).all()
+    # the slow IoT tail really is slower
+    assert tr.duration_scale().max() > tr.duration_scale().min()
+    # windows gate availability: some tick leaves a class dark
+    open_counts = {int((tr.probs(t) > 0).sum()) for t in range(64)}
+    assert len(open_counts) > 1
+
+
+# ======================================================================
+# 2. schedule: firing semantics, sync barrier, replay, starvation
+# ======================================================================
+def test_buffered_cohorts_unique_with_true_version_gaps():
+    s = _sched(buffer_size=3, trace={"trace": "bursty", "rate": 2.0,
+                                     "burst_rate": 24.0,
+                                     "burst_every": 12, "burst_len": 4})
+    saw_stale = False
+    for r in range(12):
+        rec = s.fire(r)
+        assert len(set(rec["cohort"].tolist())) == 3  # no duplicates
+        assert (rec["staleness"] >= 0).all()
+        saw_stale = saw_stale or bool((rec["staleness"] > 0).any())
+    # the bursty overlap actually produced version gaps to measure
+    assert saw_stale
+    assert s.counters["fires"] == 12
+    assert s.stale_hist.sum() == 12 * 3
+    assert s.counters["stale_sum"] == float(s.stale_hist @
+                                            np.arange(STALE_HIST_BINS)) \
+        or s.counters["stale_max"] >= STALE_HIST_BINS - 1
+
+
+def test_sync_mode_discards_superseded_work_and_reports_zero_staleness():
+    s = _sched(buffer_size=2, mode="sync", duration_lo=1, duration_hi=6,
+               trace={"trace": "poisson", "rate": 8.0})
+    for r in range(10):
+        assert (s.fire(r)["staleness"] == 0).all()
+    # the synchronous barrier's waste is counted, not hidden
+    assert s.counters["sync_discarded"] > 0
+    assert s.counters["stale_sum"] == 0.0
+
+
+def test_fast_forward_replays_the_identical_prefix():
+    a = _sched(seed=5)
+    natural = [a.fire(r) for r in range(6)]
+    b = _sched(seed=5)
+    b.fast_forward(5)            # resume path: cache warm-up only
+    for r in range(6):
+        np.testing.assert_array_equal(natural[r]["cohort"],
+                                      b.fire(r)["cohort"])
+        np.testing.assert_array_equal(natural[r]["staleness"],
+                                      b.fire(r)["staleness"])
+
+
+def test_starved_trace_raises_with_diagnosis():
+    s = _sched(population=4, buffer_size=4, max_idle_ticks=40,
+               trace={"trace": "poisson", "rate": 0.001})
+    with pytest.raises(RuntimeError, match="starved"):
+        s.fire(0)
+
+
+def test_schedule_constructor_refusals():
+    with pytest.raises(ValueError, match="mode"):
+        _sched(mode="async")
+    with pytest.raises(ValueError, match="population"):
+        _sched(population=4, buffer_size=8)
+    with pytest.raises(ValueError, match="duration"):
+        _sched(duration_lo=3, duration_hi=2)
+    with pytest.raises(ValueError, match="trace"):
+        make_trace({"trace": "banana"}, 8)
+    assert set(TRAFFIC_MODES) == {"sync", "buffered"}
+
+
+def test_make_traffic_defaults_buffer_to_cohort():
+    sc = {"num_clients_per_iteration": 6,
+          "traffic": {"seed": 1, "rate": 4.0}}
+    t = make_traffic(sc, 16)
+    assert t is not None and t.buffer_size == 6
+    assert t.mode == "buffered"
+    assert make_traffic({"traffic": {"enable": False}}, 16) is None
+    assert make_traffic({}, 16) is None
+
+
+# ======================================================================
+# 3. schema: the traffic block
+# ======================================================================
+def _raw(server_over):
+    sc = {"max_iteration": 2, "num_clients_per_iteration": 4,
+          "initial_lr_client": 0.2,
+          "optimizer_config": {"type": "sgd", "lr": 1.0},
+          "data_config": {}}
+    sc.update(server_over)
+    return {"model_config": {"model_type": "LR", "num_classes": 4,
+                             "input_dim": 8},
+            "strategy": "fedavg",
+            "server_config": sc,
+            "client_config": {
+                "optimizer_config": {"type": "sgd", "lr": 0.2},
+                "data_config": {"train": {"batch_size": 4}}}}
+
+
+def test_schema_accepts_traffic_block():
+    FLUTEConfig.from_dict(_raw({"traffic": {
+        "mode": "buffered", "seed": 3, "trace": "diurnal",
+        "rate": 6.0, "period": 32, "depth": 0.9,
+        "duration_lo": 1, "duration_hi": 4}}))
+
+
+def test_schema_rejects_bad_traffic_keys_and_values():
+    with pytest.raises(ValueError, match="traffic"):
+        FLUTEConfig.from_dict(_raw({"traffic": {"burst_cadence": 3}}))
+    with pytest.raises(ValueError, match="traffic"):
+        FLUTEConfig.from_dict(_raw({"traffic": {"mode": "async"}}))
+    with pytest.raises(ValueError, match="traffic"):
+        FLUTEConfig.from_dict(_raw({"traffic": {"trace": "banana"}}))
+    with pytest.raises(ValueError, match="traffic"):
+        FLUTEConfig.from_dict(_raw({"traffic": {"duration_lo": 4,
+                                                "duration_hi": 2}}))
+    with pytest.raises(ValueError, match="traffic"):
+        FLUTEConfig.from_dict(_raw({"traffic": {
+            "trace": "device_classes", "classes": ["phones"]}}))
+    with pytest.raises(ValueError, match="traffic"):
+        FLUTEConfig.from_dict(_raw({"traffic": "on"}))
+    # cross-block: a liveness floor the buffer can never satisfy is
+    # decidable from the raw config
+    with pytest.raises(ValueError, match="min_survivors"):
+        FLUTEConfig.from_dict(_raw({
+            "strategy": "secure_agg",
+            "traffic": {"buffer_size": 4},
+            "secure_agg": {"min_survivors": 9}}))
+
+
+# ======================================================================
+# 4. server refusal ladder (guard-matrix cells)
+# ======================================================================
+def _server(synth_dataset, tmp, server_over, strategy="fedavg"):
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    raw = _raw(server_over)
+    raw["strategy"] = strategy
+    cfg = FLUTEConfig.from_dict(raw)
+    task = make_task(cfg.model_config)
+    return OptimizationServer(task, cfg, synth_dataset,
+                              model_dir=str(tmp), seed=7)
+
+
+def test_refuses_host_orchestrated_strategies(synth_dataset, tmp_path):
+    # scaffold orchestrates rounds host-side: boundary sampling would
+    # silently ignore the arrival plane
+    with pytest.raises(ValueError, match="traffic"):
+        _server(synth_dataset, tmp_path,
+                {"traffic": {"seed": 1}}, strategy="scaffold")
+
+
+def test_refuses_buffer_cohort_mismatch(synth_dataset, tmp_path):
+    with pytest.raises(ValueError, match="buffer_size"):
+        _server(synth_dataset, tmp_path,
+                {"traffic": {"seed": 1, "buffer_size": 3}})
+
+
+def test_refuses_nonuniform_fleet_sampling(synth_dataset, tmp_path):
+    with pytest.raises(ValueError, match="traffic"):
+        _server(synth_dataset, tmp_path,
+                {"traffic": {"seed": 1},
+                 "fleet": {"sampling": "floyd"}})
+
+
+def test_refuses_secure_agg_liveness_floor_above_buffer(synth_dataset,
+                                                        tmp_path):
+    # schema catches the explicit buffer_size; the server re-checks the
+    # defaulted one (buffer == cohort) at construction
+    import msrflute_tpu.schema as schema
+
+    raw = _raw({"traffic": {"seed": 1},
+                "secure_agg": {"min_survivors": 9}})
+    raw["strategy"] = "secure_agg"
+    with pytest.raises(ValueError, match="min_survivors"):
+        FLUTEConfig.from_dict(raw)
+    assert "traffic" in schema.SERVER_KEYS
+
+
+def test_refuses_megabatch_with_traced_staleness(synth_dataset,
+                                                 tmp_path):
+    with pytest.raises(ValueError, match="megabatch"):
+        _server(synth_dataset, tmp_path,
+                {"traffic": {"seed": 1},
+                 "cohort_bucketing": {"enable": True},
+                 "megabatch": {"enable": True}},
+                strategy="fedbuff")
+
+
+def test_refuses_clients_per_chunk_with_traced_staleness(synth_dataset,
+                                                         tmp_path):
+    with pytest.raises(ValueError, match="clients_per_chunk"):
+        _server(synth_dataset, tmp_path,
+                {"traffic": {"seed": 1}, "clients_per_chunk": 2},
+                strategy="fedbuff")
+
+
+def test_drawn_staleness_strategies_skip_the_operand(synth_dataset,
+                                                     tmp_path):
+    """FedAvg neither draws nor consumes staleness: traffic still picks
+    the cohorts, but the engine compiles no staleness operand."""
+    srv = _server(synth_dataset, tmp_path, {"traffic": {"seed": 1}})
+    assert srv.traffic is not None
+    assert srv.engine.traffic_staleness is False
+
+
+# ======================================================================
+# 5. e2e: determinism, firewall, oracle, sentinel, composition
+# ======================================================================
+def _cfg(traffic, *, strategy="fedavg", rounds=5, depth=1, ncpi=4,
+         server_over=None):
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": ncpi,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "val_freq": 100, "initial_val": False,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "data_config": {"val": {"batch_size": 8}},
+    }
+    if traffic is not None:
+        sc["traffic"] = traffic
+    if server_over:
+        sc.update(server_over)
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": strategy,
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _run(cfg, dataset, seed=7):
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    task = make_task(cfg.model_config)
+    with tempfile.TemporaryDirectory() as tmp:
+        server = OptimizationServer(task, cfg, dataset, model_dir=tmp,
+                                    seed=seed)
+        state = server.train()
+        flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return flat, server
+
+
+DIURNAL = {"seed": 5, "mode": "buffered", "trace": "diurnal",
+           "rate": 6.0, "period": 16, "depth": 0.8}
+BURSTY = {"seed": 9, "mode": "buffered", "trace": "bursty",
+          "rate": 2.0, "burst_rate": 24.0, "burst_every": 12,
+          "burst_len": 4}
+
+
+def test_buffered_run_is_bit_reproducible_with_scorecard(synth_dataset):
+    cfg = _cfg(DIURNAL, rounds=5)
+    flat, server = _run(cfg, synth_dataset)
+    flat2, server2 = _run(cfg, synth_dataset)
+    np.testing.assert_array_equal(flat, flat2)
+    assert np.isfinite(flat).all()
+    card = server.build_scorecard()
+    assert card["traffic"]["mode"] == "buffered"
+    assert card["traffic"]["trace"] == "diurnal"
+    assert card["traffic"]["counters"]["fires"] >= 5
+    assert card["traffic"]["arrival_rate"] > 0
+    assert card["traffic"]["counters"] == \
+        server2.build_scorecard()["traffic"]["counters"]
+
+
+def test_rounds_to_target_accuracy_recorded_honestly(synth_dataset):
+    """``traffic.target_accuracy`` is bench.py's convergence-gate
+    source: a target of 0.0 crosses at the FIRST val eval
+    (``rounds_to_target_accuracy == 1``) and rides the scorecard's
+    traffic card; an unreachable 1.0 stays ``None`` — ``null`` in the
+    bench record, never a fabricated number."""
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    for target, reached_round in ((0.0, 1), (1.0, None)):
+        cfg = _cfg(dict(DIURNAL, target_accuracy=target), rounds=2,
+                   server_over={"val_freq": 1})
+        task = make_task(cfg.model_config)
+        with tempfile.TemporaryDirectory() as tmp:
+            server = OptimizationServer(task, cfg, synth_dataset,
+                                        val_dataset=synth_dataset,
+                                        model_dir=tmp, seed=7)
+            server.train()
+        assert server.target_accuracy == target
+        assert server.rounds_to_target_accuracy == reached_round
+        card = server.build_scorecard()
+        assert card["traffic"]["target_accuracy"] == target
+        assert card["traffic"]["rounds_to_target_accuracy"] == \
+            reached_round
+
+
+@pytest.mark.slow
+def test_sync_equals_buffered_when_buffer_is_the_population(
+        synth_dataset):
+    """The firewall: with buffer == population nobody can overlap a
+    fire, so the two orchestration modes see the identical timeline —
+    zero staleness, zero discards, bit-identical params."""
+    flat_b, srv_b = _run(_cfg(dict(DIURNAL, mode="buffered"), rounds=4,
+                              ncpi=16), synth_dataset)
+    flat_s, srv_s = _run(_cfg(dict(DIURNAL, mode="sync"), rounds=4,
+                              ncpi=16), synth_dataset)
+    np.testing.assert_array_equal(flat_b, flat_s)
+    assert srv_b.traffic.counters["stale_sum"] == 0.0
+    assert srv_s.traffic.counters["sync_discarded"] == 0.0
+
+
+@pytest.mark.slow
+def test_fedbuff_max_staleness_one_pin_carries_to_traced_mode(
+        synth_dataset):
+    """``max_staleness: 1 == FedAvg`` survives the arrival plane when
+    the timeline is staleness-free (buffer == population): the traced
+    gap is 0 everywhere, the discount is 1, the history index is 0."""
+    traffic = dict(DIURNAL)
+    fb, srv = _run(_cfg(traffic, strategy="fedbuff", rounds=4, ncpi=16,
+                        server_over={"fedbuff": {"max_staleness": 1}}),
+                   synth_dataset)
+    fa, _ = _run(_cfg(traffic, strategy="fedavg", rounds=4, ncpi=16),
+                 synth_dataset)
+    assert srv.engine.traffic_staleness is True
+    assert srv.traffic.counters["stale_sum"] == 0.0
+    np.testing.assert_array_equal(fb, fa)
+
+
+@pytest.mark.slow
+def test_device_staleness_histogram_matches_host_replay_oracle(
+        synth_dataset, monkeypatch):
+    """The on-device per-staleness histogram (packed-stats operand
+    path) must agree bin for bin with the host TrafficSchedule replay —
+    the cross-check that the engine really received TRUE version gaps,
+    not a modeled draw."""
+    import msrflute_tpu.engine.server as server_mod
+
+    events = []
+    real = server_mod.emit_event
+    monkeypatch.setattr(
+        server_mod, "emit_event",
+        lambda scope, kind, **f: (events.append((kind, f)),
+                                  real(scope, kind, **f))[-1])
+    cfg = _cfg(BURSTY, strategy="fedbuff", rounds=8,
+               server_over={"fedbuff": {"max_staleness": 4}})
+    flat, server = _run(cfg, synth_dataset)
+    assert np.isfinite(flat).all()
+    hists = [f["hist"] for kind, f in events
+             if kind == "traffic_staleness"]
+    assert len(hists) == 8
+    device_hist = np.asarray(hists, np.float64).sum(axis=0)
+    np.testing.assert_array_equal(device_hist,
+                                  server.traffic.stale_hist)
+    assert sum(f["stale_sum"] for kind, f in events
+               if kind == "traffic_staleness") == \
+        server.traffic.counters["stale_sum"]
+    # the trace genuinely produced staleness to measure
+    assert server.traffic.counters["stale_sum"] > 0
+    assert [kind for kind, _ in events].count("buffer_fired") == 8
+
+
+def test_staleness_operand_causes_zero_post_warmup_recompiles():
+    """Staleness is DATA, not shape: after the warmup compile the round
+    program is closed — more rounds with different staleness vectors
+    trigger no new compiles and zero sentinel recompiles.  The dataset
+    is size-uniform so the packed grid is constant by construction and
+    the staleness operand is the ONLY thing that varies per round."""
+    import tempfile as _tf
+
+    from conftest import make_synthetic_classification
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    ds = make_synthetic_classification(samples_lo=12, samples_hi=12)
+    cfg = _cfg(BURSTY, strategy="fedbuff", rounds=10,
+               server_over={"fedbuff": {"max_staleness": 4},
+                            "telemetry": {"enable": True}})
+    task = make_task(cfg.model_config)
+    with _tf.TemporaryDirectory() as tmp:
+        server = OptimizationServer(task, cfg, ds,
+                                    model_dir=tmp, seed=7)
+        cfg.server_config.max_iteration = 3
+        server.train()                   # warmup compiles here
+        warm = len(server.engine.compile_log)
+        cfg.server_config.max_iteration = 10
+        server.train()                   # resume: fast_forward replay
+        assert len(server.engine.compile_log) == warm
+        assert server.engine.xla.recompiles == 0
+        assert server.build_scorecard()["recompiles"] == 0
+
+
+@pytest.mark.slow
+def test_composition_depth3_bucketing_fleet_strict(synth_dataset,
+                                                   monkeypatch):
+    """The composition tier the docs promise: traced staleness +
+    depth-3 pipeline ring + cohort bucketing + fleet paging, strict
+    transfers — bit-identical to the serial run."""
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+
+    def cfg(depth):
+        return _cfg(BURSTY, strategy="fedbuff", rounds=6, depth=depth,
+                    server_over={
+                        "fedbuff": {"max_staleness": 4},
+                        "cohort_bucketing": {"enable": True,
+                                             "max_buckets": 2},
+                        "fleet": {"enable": True}})
+
+    serial, srv_s = _run(cfg(0), synth_dataset)
+    piped, srv_p = _run(cfg(3), synth_dataset)
+    np.testing.assert_array_equal(serial, piped)
+    assert srv_p.pipelined_chunks > 0
+    assert srv_s.engine.traffic_staleness and \
+        srv_p.engine.traffic_staleness
+    # lookahead sampling replays the same cached fire sequence
+    assert srv_s.traffic.stale_hist.sum() == \
+        srv_p.traffic.stale_hist.sum()
+
+
+@pytest.mark.slow
+def test_secure_agg_over_buffered_cohorts(synth_dataset, monkeypatch):
+    """secure_agg composes with the arrival plane when the liveness
+    floor fits the buffer: masked aggregation runs over traffic-chosen
+    cohorts, deterministically."""
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    cfg = _cfg(dict(DIURNAL, seed=13), strategy="secure_agg", rounds=4,
+               server_over={"secure_agg": {"min_survivors": 2}})
+    flat, srv = _run(cfg, synth_dataset)
+    flat2, srv2 = _run(cfg, synth_dataset)
+    np.testing.assert_array_equal(flat, flat2)
+    assert np.isfinite(flat).all()
+    assert srv.traffic.counters["fires"] >= 4

@@ -409,6 +409,43 @@ def test_scope_trend_gates_bench_artifacts(tmp_path):
                        "--gate"]) == 0
 
 
+def test_scope_trend_gates_rounds_to_target_accuracy(tmp_path):
+    """The convergence tier joins the trend gate: more rounds to the
+    same target regresses, and a previously-reached target decaying to
+    null (while the newer artifact still configures one) regresses too;
+    null without a configured target never gates."""
+    def bench_line(rtt, with_target=True):
+        proto = {"secs_per_round": 0.10,
+                 "rounds_to_target_accuracy": rtt}
+        if with_target:
+            proto["traffic"] = {"enabled": True, "mode": "buffered",
+                                "target_accuracy": 0.75}
+        return {"metric": "cnn_femnist_secs_per_round", "value": 0.10,
+                "extras": {"backend": "tpu", "cnn_femnist": proto}}
+
+    import json as _json
+
+    from msrflute_tpu.telemetry.scope_cli import main as scope_main
+    paths = {}
+    for name, line in (("a", bench_line(20)), ("ok", bench_line(21)),
+                       ("slow", bench_line(40)),
+                       ("lost", bench_line(None)),
+                       ("untargeted", bench_line(None,
+                                                 with_target=False))):
+        p = tmp_path / f"BENCH_{name}.json"
+        p.write_text(_json.dumps(line))
+        paths[name] = str(p)
+    assert scope_main(["trend", paths["a"], paths["ok"], "--gate"]) == 0
+    assert scope_main(["trend", paths["a"], paths["slow"],
+                       "--gate"]) == 3
+    assert scope_main(["trend", paths["a"], paths["lost"],
+                       "--gate"]) == 3
+    # no target configured in the newer artifact: not a convergence
+    # run, so the null never gates
+    assert scope_main(["trend", paths["a"], paths["untargeted"],
+                       "--gate"]) == 0
+
+
 def test_bench_device_truth_contract():
     """Every protocol line must carry the device-truth fields (mfu /
     hbm_peak_bytes / recompiles), and bench's cost analysis goes through

@@ -269,6 +269,179 @@ def run_endurance(rounds: int = 40, num_users: int = 24,
     return record
 
 
+#: the flash-crowd drill's arrival plane (RUNBOOK "Flash-crowd
+#: drill"): a bursty trace — quiet off-burst floor, periodic flash
+#: crowds — fired buffered, so FedBuff's discount consumes the TRUE
+#: traced per-client staleness
+TRAFFIC = {
+    "seed": 9, "mode": "buffered", "trace": "bursty",
+    "rate": 2.0, "burst_rate": 24.0, "burst_every": 12, "burst_len": 4,
+}
+
+
+def _traffic_config(rounds: int, preempt_at):
+    """The arrival-plane posture: buffered FedBuff on the bursty trace
+    under cohort bucketing, a depth-3 pipeline and strict transfers,
+    with the forced midpoint preemption driving the resume replay."""
+    from msrflute_tpu.config import FLUTEConfig
+
+    telemetry = json.loads(json.dumps(TELEMETRY))
+    sc = {
+        "max_iteration": rounds,
+        "num_clients_per_iteration": 8,
+        "initial_lr_client": 0.1,
+        "rounds_per_step": 2,
+        "pipeline_depth": 3,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "val_freq": 1000, "initial_val": False,
+        "resume_from_checkpoint": True,
+        "data_config": {},
+        "cohort_bucketing": {"max_buckets": 3, "slack": 2.0},
+        "fedbuff": {"max_staleness": 4},
+        "traffic": dict(TRAFFIC),
+        "checkpoint_retry": {"retries": 3, "backoff_base_s": 0.0,
+                             "jitter": 0.0},
+        "telemetry": telemetry,
+    }
+    if preempt_at is not None:
+        # zero-rate chaos block: ONLY the preemption drill rides it —
+        # bit-identical to no client faults at all
+        sc["chaos"] = {"seed": 11, "preempt_at_round": preempt_at}
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "fedbuff",
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.1},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def run_traffic(rounds: int = 24, num_users: int = 24,
+                out_dir: str | None = None,
+                report_path: str | None = None) -> dict:
+    """The flash-crowd drill (ISSUE 19 acceptance): buffered
+    FedBuff rounds fired by a seeded bursty arrival trace, under
+    cohort bucketing + a depth-3 pipeline + strict transfers, with a
+    forced midpoint preemption + resume.  Asserts:
+
+    - the engine compiled the traced-staleness DATA operand (arrival
+      dynamics ride operands, never the program — so the resumed leg
+      must be recompile-flat past warmup);
+    - the resumed run REPLAYS the identical arrival timeline: every
+      fire's (tick, cohort, staleness) matches a fresh schedule built
+      from the same seed;
+    - ``tools/scope health --gate`` exits 0 and the scorecard's
+      traffic card accounts for every fired round;
+
+    and emits a BENCH_FLEET-style trajectory record under
+    ``extras.traffic`` so ``tools/scope trend`` can walk a committed
+    series of them.
+    """
+    os.environ.setdefault("MSRFLUTE_STRICT_TRANSFERS", "1")
+    import numpy as np
+
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.telemetry.scope_cli import health, summarize
+    from msrflute_tpu.traffic import make_traffic
+    from msrflute_tpu.utils.logging import init_logging
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="traffic_")
+    init_logging(out_dir)
+    dataset = _hetero_dataset(num_users)
+    preempt_at = max(rounds // 2, 1)
+    tic = time.time()
+
+    # ---- leg 1: into the forced preemption ---------------------------
+    cfg = _traffic_config(rounds, preempt_at)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                dataset, model_dir=out_dir, seed=0)
+    assert server.traffic is not None, "arrival plane not engaged"
+    assert server.engine.traffic_staleness, (
+        "fedbuff + buffered must compile the traced-staleness operand")
+    server.train()
+    assert server.preempted, "forced preemption never fired"
+
+    # ---- leg 2: resume to completion, recompile-flat past warmup -----
+    cfg2 = _traffic_config(rounds, preempt_at)
+    server2 = OptimizationServer(make_task(cfg2.model_config), cfg2,
+                                 dataset, model_dir=out_dir, seed=0)
+    recompiles_per_chunk: list = []
+    drain = server2._drain_chunk
+
+    def observing_drain(chunk, vf, rf):
+        drain(chunk, vf, rf)
+        recompiles_per_chunk.append(int(server2.engine.recompile_count))
+
+    server2._drain_chunk = observing_drain
+    server2.train()
+    assert server2.state.round == rounds, (server2.state.round, rounds)
+    warm = min(2, max(len(recompiles_per_chunk) - 1, 0))
+    steady = recompiles_per_chunk[warm:]
+    assert not steady or steady[-1] == steady[0], (
+        "post-warmup recompiles", recompiles_per_chunk)
+
+    # ---- replay oracle: resumed timeline == fresh timeline -----------
+    fresh = make_traffic(
+        {"traffic": dict(TRAFFIC), "num_clients_per_iteration": 8},
+        len(dataset))
+    for r in range(rounds):
+        a, b = server2.traffic.fire(r), fresh.fire(r)
+        assert int(a["tick"]) == int(b["tick"]), (r, a, b)
+        assert np.array_equal(a["cohort"], b["cohort"]), (
+            "resume replayed a different cohort", r)
+        assert np.array_equal(a["staleness"], b["staleness"]), (
+            "resume replayed different staleness", r)
+    wall = time.time() - tic
+
+    # ---- the oracle --------------------------------------------------
+    verdict = health(out_dir)
+    assert verdict["ok"], ("traffic run must gate healthy", verdict)
+
+    summary = summarize(out_dir)
+    card = (summary.get("scorecard") or {}) if isinstance(
+        summary.get("scorecard"), dict) else {}
+    tcard = card.get("traffic") or {}
+    assert tcard, "scorecard must carry the traffic card"
+    counters = tcard.get("counters") or {}
+    assert int(counters.get("fires", 0)) == rounds, counters
+    secs_p50 = card.get("round_secs_p50")
+    record = {
+        "kind": "traffic",
+        "metric": "traffic_secs_per_round",
+        "value": secs_p50,
+        "rounds": rounds,
+        "wall_secs": round(wall, 2),
+        "health": {"ok": verdict["ok"],
+                   "findings": verdict["findings"],
+                   "warnings": verdict["warnings"]},
+        "extras": {
+            "traffic": {
+                "secs_per_round": secs_p50,
+                "rounds_per_hour": (round(3600.0 / secs_p50, 1)
+                                    if secs_p50 else None),
+                "trace": TRAFFIC["trace"],
+                "mode": TRAFFIC["mode"],
+                "arrival_rate": tcard.get("arrival_rate"),
+                "mean_buffer_occupancy":
+                    tcard.get("mean_buffer_occupancy"),
+                "stale_hist": tcard.get("stale_hist"),
+                "counters": counters,
+                "recompiles_per_chunk": recompiles_per_chunk,
+                "preempt_resume": True,
+            },
+        },
+    }
+    if report_path:
+        tmp = report_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(record, fh, indent=1, sort_keys=True)
+        os.replace(tmp, report_path)
+    return record
+
+
 def _fleet_config(rounds: int, cohort: int, preempt_at):
     """The fleet posture: fused-carry SCAFFOLD (the richest carry
     state: a pageable per-client table plus the resident server
@@ -457,9 +630,24 @@ def main(argv=None) -> int:
                          "(ISSUE 14); emits a BENCH_FLEET record")
     ap.add_argument("--fleet-population", type=int, default=1_000_000)
     ap.add_argument("--fleet-cohort", type=int, default=1024)
+    ap.add_argument("--traffic", action="store_true",
+                    help="flash-crowd posture: buffered FedBuff fired "
+                         "by a seeded bursty arrival trace, preempt + "
+                         "resume replay (ISSUE 19); emits a "
+                         "BENCH_FLEET-style record")
     ap.add_argument("--report", default=None,
                     help="write the trajectory record here")
     args = ap.parse_args(argv)
+    if args.traffic:
+        record = run_traffic(rounds=(24 if args.rounds is None
+                                     else args.rounds),
+                             num_users=args.users,
+                             out_dir=args.out_dir,
+                             report_path=args.report)
+        print(json.dumps(record, indent=1, sort_keys=True))
+        ok = record["health"]["ok"]
+        print("traffic:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
     if args.fleet:
         record = run_fleet(rounds=(8 if args.rounds is None
                                    else args.rounds),
