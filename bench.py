@@ -815,6 +815,17 @@ def _server_overhead_extras(server) -> dict:
             target_accuracy=getattr(server, "target_accuracy", None),
             counters={k: round(float(v), 1)
                       for k, v in traffic.counters.items()})
+    # infra marker (ISSUE 20): a run under injected host-service faults
+    # pays retry/degradation overhead on every durable-IO surface (and
+    # may have shed its prefetch daemon mid-run) — comparing it against
+    # an unfaulted baseline without the marker would misattribute the
+    # tail, so the fault ledger rides every protocol entry
+    infra = getattr(chaos, "infra", None) if chaos is not None else None
+    out["infra"] = ({"enabled": False} if infra is None else
+                    dict(infra.describe(),
+                         fault_counters={k: round(float(v), 1)
+                                         for k, v in
+                                         infra.counters.items()}))
     # convergence tier: first round whose val accuracy reached
     # traffic.target_accuracy — recorded on EVERY protocol entry (null
     # when no target is configured or the run never got there), so

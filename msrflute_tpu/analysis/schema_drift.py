@@ -47,6 +47,9 @@ _SPEC_MAP = {
     # unknown-key pass knows, like every other section
     "CHAOS_FIELD_SPECS": "CHAOS_KEYS",
     "CHECKPOINT_RETRY_FIELD_SPECS": "CHECKPOINT_RETRY_KEYS",
+    # flutearmor's infrastructure fault plane (PR 20): the nested
+    # chaos.infra mapping has its own key set + spec table
+    "CHAOS_INFRA_FIELD_SPECS": "CHAOS_INFRA_KEYS",
     # flutescope telemetry blocks (PR 4)
     "TELEMETRY_FIELD_SPECS": "TELEMETRY_KEYS",
     "WATCHDOG_FIELD_SPECS": "WATCHDOG_KEYS",
@@ -122,6 +125,11 @@ DOCUMENTED_KNOBS = (
     # boundary-sampled timeline where their whole reason to exist —
     # rounds-to-target under real arrivals — is unmeasurable
     "traffic",
+    # flutearmor infra fault plane: an operator who cannot find the
+    # infrastructure-fault drill will rehearse cohort failures but meet
+    # host-service failures (dead prefetch daemon, flaky row store) for
+    # the first time mid-campaign
+    "infra",
 )
 
 _DOC_MENTION_RE = re.compile(
@@ -268,8 +276,13 @@ def check_project(root: str,
         server_keys = sets.get("SERVER_KEYS", set())
         client_keys = sets.get("CLIENT_KEYS", set())
         dataset_keys = sets.get("DATASET_KEYS", set())
+        # nested blocks participate too: chaos.infra is an operator
+        # knob even though "infra" is a CHAOS_KEYS member, not a
+        # top-level section key
+        chaos_keys = sets.get("CHAOS_KEYS", set())
         for knob in documented_knobs:
-            if knob not in (server_keys | client_keys | dataset_keys):
+            if knob not in (server_keys | client_keys | dataset_keys |
+                            chaos_keys):
                 continue  # rule 1/2 territory, do not double-report
             if knob not in runbook:
                 findings.append(Finding(

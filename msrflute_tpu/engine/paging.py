@@ -74,6 +74,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
@@ -81,6 +82,7 @@ import numpy as np
 
 from ..data.fleet import lane_shard_map
 from ..parallel.mesh import CLIENTS_AXIS, clients_axis_size
+from ..telemetry import emit_event
 
 
 def _pow2_width(n: int, floor: int = 8) -> int:
@@ -89,6 +91,37 @@ def _pow2_width(n: int, floor: int = 8) -> int:
     warmup."""
     n = max(int(n), int(floor))
     return 1 << max(n - 1, 0).bit_length()
+
+
+def read_marker(store_dir: Optional[str]) -> Optional[int]:
+    """The durable fleet round marker under ``store_dir`` (None when the
+    store has never committed one).  A module function so the server's
+    resume-anchor pairing can probe the marker BEFORE deciding which
+    checkpoint slot to restore — the :class:`FleetRowStore` itself is
+    only built after that decision."""
+    if store_dir is None:
+        return None
+    path = os.path.join(store_dir, "fleet_round.npy")
+    if not os.path.exists(path):
+        return None
+    return int(np.load(path)[0])
+
+
+def _parse_row_name(name: str) -> Optional[tuple]:
+    """``row_{cid}.g{gen}.npz`` -> (cid, gen); legacy ``row_{cid}.npz``
+    -> (cid, 0); anything else (tmp files, the marker) -> None."""
+    if not name.startswith("row_") or not name.endswith(".npz") \
+            or ".tmp" in name:
+        return None
+    stem = name[len("row_"):-len(".npz")]
+    if ".g" in stem:
+        cid_s, _, gen_s = stem.partition(".g")
+    else:
+        cid_s, gen_s = stem, "0"
+    try:
+        return int(cid_s), int(gen_s)
+    except ValueError:
+        return None
 
 
 class FleetRowStore:
@@ -101,9 +134,21 @@ class FleetRowStore:
     current row set.  ``flush()`` writes the remaining dirty rows
     through — the server calls it at ``fleet.spill_freq`` cadence and
     commits the round marker only after the paired model checkpoint is
-    durable (the ControlStore discipline; a marker/checkpoint mismatch
-    on resume resets the rows — carry state belongs to exactly one
+    durable (the ControlStore discipline; a marker behind the resumed
+    checkpoint resets the rows — carry state belongs to exactly one
     parameter trajectory).
+
+    Spill files are GENERATION-versioned (flutearmor crash-point
+    contract): each row lands at ``row_{cid}.g{round}.npz`` where
+    ``round`` is the round whose writeback produced the content
+    (``put_round``, set by the pager per writeback), and overwriting a
+    row keeps its previous generation on disk until :meth:`mark_durable`
+    says a checkpoint at or past that generation is durable.  A hard
+    kill at ANY byte of the spill/marker/checkpoint sequence then leaves
+    a bit-identical resume reachable: the server resumes from the slot
+    matching the marker and :meth:`adopt_round` prunes the dead
+    trajectory's newer generations, so every row read yields exactly the
+    content it had at the resumed round.
 
     Mutations happen only on the server's round-loop thread; the
     ``fleet-prefetch`` worker reads through :meth:`peek` (RAM/spilling
@@ -114,9 +159,14 @@ class FleetRowStore:
     """
 
     def __init__(self, store_dir: Optional[str], cache_rows: int = 8192,
-                 resume: bool = False):
+                 resume: bool = False, ladder=None):
         self.store_dir = store_dir
         self.cache_rows = max(int(cache_rows), 1)
+        #: optional resilience.DurableIOLadder: spill writes and the
+        #: round marker retry-then-escalate; reads retry-then-raise
+        #: (losing a carry row corrupts training) — None keeps the
+        #: historical raw-IO behaviour for direct constructions
+        self.ladder = ladder
         self._rows: "OrderedDict[int, Dict[str, np.ndarray]]" = \
             OrderedDict()
         self._dirty: set = set()
@@ -125,14 +175,28 @@ class FleetRowStore:
         self._spilling: Dict[int, Dict[str, np.ndarray]] = {}
         self._ram_lock = threading.Lock()
         self.spilled_rows = 0
+        #: content round per RAM row (the generation a spill writes to)
+        self._tags: Dict[int, int] = {}
+        #: known on-disk generations per row, sorted ascending
+        self._gens: Dict[int, List[int]] = {}
+        #: newest round whose checkpoint is known durable — generations
+        #: superseded by a newer one at/below this are garbage
+        self._safe_round = -1
+        #: round tag for incoming put()s — the pager sets this per
+        #: writeback batch; direct constructions default to one
+        #: generation (tag 0), the historical single-file behaviour
+        self.put_round = 0
         if store_dir is not None:
             os.makedirs(store_dir, exist_ok=True)
-            if not resume:
+            if resume:
+                self._scan_gens()
+            else:
                 self._wipe_files()
 
     # -- paths ----------------------------------------------------------
-    def _path(self, cid: int) -> str:
-        return os.path.join(self.store_dir, f"row_{int(cid)}.npz")
+    def _path(self, cid: int, gen: int = 0) -> str:
+        return os.path.join(self.store_dir,
+                            f"row_{int(cid)}.g{int(gen)}.npz")
 
     def _marker_path(self) -> str:
         return os.path.join(self.store_dir, "fleet_round.npy")
@@ -142,13 +206,82 @@ class FleetRowStore:
             if name.startswith("row_") or name == "fleet_round.npy":
                 os.remove(os.path.join(self.store_dir, name))
 
+    def _scan_gens(self) -> None:
+        """Resume inventory: one directory listing builds the
+        per-row generation map the reads select from."""
+        gens: Dict[int, List[int]] = {}
+        for name in os.listdir(self.store_dir):
+            parsed = _parse_row_name(name)
+            if parsed is not None:
+                gens.setdefault(parsed[0], []).append(parsed[1])
+        for lst in gens.values():
+            lst.sort()
+        with self._ram_lock:
+            self._gens = gens
+
+    def _newest_gen(self, cid: int) -> Optional[int]:
+        with self._ram_lock:
+            gens = self._gens.get(cid)
+            return gens[-1] if gens else None
+
+    def adopt_round(self, round_no: int) -> None:
+        """Resume adoption: delete every generation NEWER than the
+        resumed round — the dead trajectory's future — so every
+        subsequent read yields the row exactly as of the anchor."""
+        round_no = int(round_no)
+        doomed: List[tuple] = []
+        with self._ram_lock:
+            for cid, gens in list(self._gens.items()):
+                for g in [g for g in gens if g > round_no]:
+                    gens.remove(g)
+                    doomed.append((cid, g))
+                if not gens:
+                    del self._gens[cid]
+        for cid, g in doomed:
+            try:
+                os.remove(self._path(cid, g))
+            except OSError:
+                pass
+
+    def mark_durable(self, round_no: int) -> None:
+        """A checkpoint at/past ``round_no`` is durable: generations
+        superseded at/below it become prunable (GC happens lazily at
+        each row's next spill — no directory scans on the hot path)."""
+        self._safe_round = max(self._safe_round, int(round_no))
+
+    def _register_gen(self, cid: int, gen: int) -> None:
+        """Record a landed spill and GC this row's superseded
+        generations: a generation is garbage once a NEWER one exists
+        at or below the durable horizon (any future resume anchors at
+        or past the horizon, so the newest covered generation is the
+        one every reachable anchor selects)."""
+        doomed: List[int] = []
+        with self._ram_lock:
+            gens = self._gens.setdefault(cid, [])
+            if gen not in gens:
+                gens.append(gen)
+                gens.sort()
+            covered = [g for g in gens if g <= self._safe_round]
+            if covered:
+                doomed = [g for g in gens if g < covered[-1]]
+                for g in doomed:
+                    gens.remove(g)
+        for g in doomed:
+            try:
+                os.remove(self._path(cid, g))
+            except OSError:
+                pass
+
     # -- rows -----------------------------------------------------------
     def _read_file(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
         """Stateless disk read (no RAM insert, no LRU motion) — the
         prefetch thread's half of :meth:`get`."""
         if self.store_dir is None:
             return None
-        path = self._path(cid)
+        gen = self._newest_gen(cid)
+        if gen is None:
+            return None
+        path = self._path(cid, gen)
         if not os.path.exists(path):
             return None
         with np.load(path) as zf:
@@ -165,6 +298,22 @@ class FleetRowStore:
                 row = self._spilling.get(cid)
         return row
 
+    def _read_durable(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
+        """The main-thread disk read: under the ladder, a transient
+        error retries with backoff and EXHAUSTION RAISES (DurableIOError
+        -> flight-recorded abort) — a silently-lost carry row would
+        corrupt training.  The prefetch thread never comes through here;
+        its failures degrade to cold paging instead."""
+        if self.ladder is None:
+            return self._read_file(cid)
+        box: Dict[str, Any] = {}
+
+        def _do() -> None:
+            box["row"] = self._read_file(cid)
+        self.ladder.run(_do, surface="store_read",
+                        what=f"fleet row {int(cid)} read")
+        return box.get("row")
+
     def get(self, cid: int) -> Optional[Dict[str, np.ndarray]]:
         cid = int(cid)
         with self._ram_lock:
@@ -175,13 +324,21 @@ class FleetRowStore:
             row = self._spilling.get(cid)
             if row is not None:
                 return row
-        row = self._read_file(cid)
+        row = self._read_durable(cid)
         if row is not None:
+            # the RAM copy inherits the on-disk generation's tag, so a
+            # later clean re-spill is an idempotent same-file rewrite
+            gen = self._newest_gen(cid)
+            with self._ram_lock:
+                self._tags[cid] = int(gen or 0)
             self._insert(cid, row, dirty=False)
         return row
 
     def put(self, cid: int, row: Dict[str, np.ndarray]) -> None:
-        self._insert(int(cid), row, dirty=True)
+        cid = int(cid)
+        with self._ram_lock:
+            self._tags[cid] = int(self.put_round)
+        self._insert(cid, row, dirty=True)
 
     def _insert(self, cid: int, row: Dict[str, np.ndarray],
                 dirty: bool) -> None:
@@ -201,18 +358,35 @@ class FleetRowStore:
                     self._spilling[old_cid] = old_row
                     to_spill.append((old_cid, old_row))
         for old_cid, old_row in to_spill:
-            self._write(old_cid, old_row)
-            with self._ram_lock:
-                self._spilling.pop(old_cid, None)
-            self.spilled_rows += 1
+            if self._write(old_cid, old_row):
+                with self._ram_lock:
+                    self._spilling.pop(old_cid, None)
+                self.spilled_rows += 1
+            # on exhausted retries the row STAYS in _spilling: still
+            # served to peek/get, re-attempted at the next flush() —
+            # a lost write degrades capacity, never correctness (the
+            # ladder's escalator aborts a persistent outage)
 
-    def _write(self, cid: int, row: Dict[str, np.ndarray]) -> None:
+    def _write(self, cid: int, row: Dict[str, np.ndarray]) -> bool:
         if self.store_dir is None:
-            return
-        path = self._path(cid)
+            return True
+        with self._ram_lock:
+            gen = int(self._tags.get(cid, 0))
+        path = self._path(cid, gen)
         tmp = path + ".tmp.npz"  # .npz suffix stops np.savez appending one
-        np.savez(tmp, **row)
-        os.replace(tmp, path)
+
+        def _do() -> None:
+            np.savez(tmp, **row)
+            os.replace(tmp, path)
+        if self.ladder is None:
+            _do()
+            ok = True
+        else:
+            ok = self.ladder.run(_do, surface="store_write",
+                                 what=f"fleet row {int(cid)} spill")
+        if ok:
+            self._register_gen(cid, gen)
+        return ok
 
     def has_rows(self) -> bool:
         """Whether ANY client has a stored row (RAM or disk) — the
@@ -223,12 +397,16 @@ class FleetRowStore:
         if self.store_dir is None:
             return False
         with os.scandir(self.store_dir) as it:
-            return any(entry.name.startswith("row_") for entry in it)
+            return any(entry.name.startswith("row_")
+                       and ".tmp" not in entry.name for entry in it)
 
     # -- durability -----------------------------------------------------
     def flush(self) -> int:
         """Write every dirty RAM row through to disk; returns the row
-        count (the spill transfer meter)."""
+        count (the spill transfer meter).  A row whose write exhausts
+        its retries goes BACK on the dirty set (and stuck spill-through
+        evictees re-attempt here too) — flush degrades to partial, never
+        to silent loss."""
         if self.store_dir is None:
             self._dirty.clear()
             return 0
@@ -237,9 +415,21 @@ class FleetRowStore:
             pending = [(cid, self._rows.get(cid))
                        for cid in sorted(self._dirty)]
             self._dirty.clear()
+            stuck = sorted(self._spilling.items())
         for cid, row in pending:
-            if row is not None:
-                self._write(cid, row)
+            if row is None:
+                continue
+            if self._write(cid, row):
+                n += 1
+            else:
+                with self._ram_lock:
+                    if cid in self._rows:
+                        self._dirty.add(cid)
+        for cid, row in stuck:
+            if self._write(cid, row):
+                with self._ram_lock:
+                    self._spilling.pop(cid, None)
+                self.spilled_rows += 1
                 n += 1
         return n
 
@@ -248,14 +438,18 @@ class FleetRowStore:
             return
         path = self._marker_path()
         tmp = path + ".tmp.npy"
-        np.save(tmp, np.asarray([int(round_no)], np.int64))
-        os.replace(tmp, path)
+
+        def _do() -> None:
+            np.save(tmp, np.asarray([int(round_no)], np.int64))
+            os.replace(tmp, path)
+        if self.ladder is None:
+            _do()
+        else:
+            self.ladder.run(_do, surface="marker",
+                            what=f"fleet round marker {int(round_no)}")
 
     def round(self) -> Optional[int]:
-        if self.store_dir is None or not os.path.exists(
-                self._marker_path()):
-            return None
-        return int(np.load(self._marker_path())[0])
+        return read_marker(self.store_dir)
 
     def reset(self) -> None:
         """Drop every row + marker (trajectory-mismatch semantics)."""
@@ -263,6 +457,8 @@ class FleetRowStore:
             self._rows.clear()
             self._dirty.clear()
             self._spilling.clear()
+            self._tags.clear()
+            self._gens.clear()
         if self.store_dir is not None:
             self._wipe_files()
 
@@ -280,7 +476,8 @@ class CarryPager:
                  host_cache_rows: int = 8192,
                  resume: bool = False,
                  partition_mode: str = "shard_map",
-                 prefetch: bool = True):
+                 prefetch: bool = True,
+                 ladder=None, faults=None):
         import jax
         from ..parallel.sharding import slot_pool_sharding
 
@@ -320,8 +517,15 @@ class CarryPager:
         #: slot-axis tables and page-in/writeback buffers are SHARDED
         #: over the clients axis — per-device bytes = total/mesh_size
         self._pool_spec = slot_pool_sharding(mesh)
+        #: one DurableIOLadder governs the store's spill/read/marker IO
+        #: AND this pager's writeback fetch; the chaos InfraFaults (if
+        #: any) supplies the prefetch-surface hooks below
+        self.ladder = ladder
+        self._infra = faults
+        self._prefetch_fault = (faults.hook("prefetch")
+                                if faults is not None else None)
         self.store = FleetRowStore(store_dir, cache_rows=host_cache_rows,
-                                   resume=resume)
+                                   resume=resume, ladder=ladder)
 
         # ---- slot state (per shard) ----------------------------------
         self._free: List[List[int]] = [
@@ -372,6 +576,7 @@ class CarryPager:
         self.writeback_bytes = 0
         self.prefetch_hits = 0
         self.prefetch_misses = 0
+        self.prefetch_degradations = 0
 
     # ------------------------------------------------------------------
     def describe(self) -> Dict[str, Any]:
@@ -396,6 +601,7 @@ class CarryPager:
                 int(self.writeback_bytes // self.mesh_shards),
             "prefetch_hits": int(self.prefetch_hits),
             "prefetch_misses": int(self.prefetch_misses),
+            "prefetch_degradations": int(self.prefetch_degradations),
             # None (not 0.0) when prefetch never engaged: a serial /
             # sample-hooked / prefetch-off run has no coverage to
             # report, and a 0.0 would trip the scope-diff hit-rate gate
@@ -540,16 +746,46 @@ class CarryPager:
         return len(want)
 
     def _prefetch_worker(self, cids: List[int], staging: dict) -> None:
-        scope = self.scope
-        if scope is not None:
-            with scope.span("fleet_prefetch", rows=len(cids)):
+        try:
+            scope = self.scope
+            if scope is not None:
+                with scope.span("fleet_prefetch", rows=len(cids)):
+                    self._prefetch_rows(cids, staging)
+            else:
                 self._prefetch_rows(cids, staging)
-        else:
-            self._prefetch_rows(cids, staging)
+        except Exception as exc:  # noqa: BLE001 - any death must degrade
+            self._degrade_prefetch(exc)
+
+    def _degrade_prefetch(self, exc: BaseException) -> None:
+        """The fleet-prefetch daemon died (injected chaos fault or a
+        real one): permanently fall back to COLD paging — every later
+        miss takes the synchronous ``store.get`` path, which loads the
+        exact same values (bit-identical by the staging contract), just
+        on the critical path.  One structured ``prefetch_degraded``
+        instant event surfaces it; the thread never dies silently into
+        a dead staging generation."""
+        self.prefetch_enabled = False
+        self.prefetch_degradations += 1
+        with self._staging_lock:
+            self._staging = {}
+        emit_event(self.scope, "prefetch_degraded",
+                   error=repr(exc),
+                   degradations=int(self.prefetch_degradations))
 
     def _prefetch_rows(self, cids: List[int], staging: dict) -> None:
         store = self.store
+        infra = self._infra
+        if infra is not None:
+            # seeded staging stall: exercises the superseded-generation
+            # path (prepare_chunk clears a half-filled staging dict and
+            # the loop below notices and stops) without killing the
+            # worker
+            delay = infra.prefetch_delay()
+            if delay > 0.0:
+                time.sleep(delay)
         for cid in cids:
+            if self._prefetch_fault is not None:
+                self._prefetch_fault()
             row = store.peek(cid)
             if row is None:
                 row = store._read_file(cid)
@@ -741,11 +977,14 @@ class CarryPager:
                 check_vma=False)
         return jax.jit(gather)
 
-    def queue_writeback(self, strategy_state: Any) -> Dict[str, Any]:
+    def queue_writeback(self, strategy_state: Any,
+                        round_no: int = 0) -> Dict[str, Any]:
         """Dispatch the async per-shard gather of this chunk's slot
         rows from the POST-chunk tables.  Must run before the next
         dispatch donates ``strategy_state`` (program order then
-        guarantees the gather reads the chunk's output).  Returns the
+        guarantees the gather reads the chunk's output).  ``round_no``
+        is the chunk's LAST round — the generation tag the drained rows
+        spill under (the crash-point rollback anchor).  Returns the
         handle the drain completes (idempotently — a shard migration
         may have force-completed it early)."""
         ticket = self._ticket
@@ -754,6 +993,7 @@ class CarryPager:
             return {"ids": np.empty((0,), np.int64), "rows": None,
                     "slots": np.empty((0,), np.int32),
                     "pos": np.empty((0,), np.int64), "done": True,
+                    "round": int(round_no),
                     "page_in_bytes": int((ticket or {}).get(
                         "page_in_bytes", 0)),
                     "writeback_bytes": 0}
@@ -790,6 +1030,7 @@ class CarryPager:
         handle = {"ids": ticket["ids"][order],
                   "slots": ticket["slots"][order],
                   "pos": pos, "rows": rows, "done": False,
+                  "round": int(round_no),
                   "page_in_bytes": int(ticket["page_in_bytes"]),
                   "writeback_bytes": wb_bytes}
         self._outstanding.append(handle)
@@ -815,8 +1056,23 @@ class CarryPager:
         if handle["rows"] is None or ids.size == 0:
             return
         jax = self._jax
-        fetched = jax.device_get(handle["rows"])
+        if self.ladder is None:
+            fetched = jax.device_get(handle["rows"])
+        else:
+            # transient fetch failures retry under the ladder; an
+            # exhausted fetch raises DurableIOError (these are the
+            # post-chunk carry rows — losing them corrupts training)
+            box: Dict[str, Any] = {}
+
+            def _fetch() -> None:
+                box["v"] = jax.device_get(handle["rows"])
+            self.ladder.run(_fetch, surface="writeback",
+                            what=f"fleet writeback of {int(ids.size)} rows")
+            fetched = box["v"]
         pos = handle["pos"]
+        # the rows about to land carry this chunk's final round as
+        # their generation tag (crash-point rollback selects on it)
+        self.store.put_round = int(handle.get("round", 0))
         for i, cid in enumerate(ids):
             # np.array (copy), not np.asarray (view): a view would pin
             # the whole padded [M*W] fetch buffer in the host row cache
@@ -847,6 +1103,12 @@ class CarryPager:
 
     def round(self) -> Optional[int]:
         return self.store.round()
+
+    def adopt_round(self, round_no: int) -> None:
+        self.store.adopt_round(round_no)
+
+    def mark_durable(self, round_no: int) -> None:
+        self.store.mark_durable(round_no)
 
     def reset(self) -> None:
         """Trajectory mismatch on resume: drop the host rows AND the

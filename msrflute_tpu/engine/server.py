@@ -38,7 +38,7 @@ from ..optim import PlateauTracker, make_lr_schedule
 from ..parallel.mesh import CLIENTS_AXIS, make_mesh, pad_to_mesh
 from ..resilience import PreemptionHandler, make_chaos
 from ..traffic import STALE_HIST_BINS, make_traffic
-from ..resilience.integrity import RetryPolicy
+from ..resilience.integrity import DurableIOLadder, RetryPolicy
 from ..strategies import select_strategy
 from ..telemetry import NULL_SPAN, emit_event, make_telemetry
 from ..telemetry.rollup import host_rss_bytes
@@ -148,9 +148,11 @@ class OptimizationServer:
             # into contiguous per-shard blocks (per-device HBM =
             # slots / mesh_size rows), so the pool must be a mesh
             # multiple — quantize UP (a pool slightly past N just means
-            # some slots never allocate)
-            slots = ((slots + mesh_shards - 1) // mesh_shards) \
-                * mesh_shards
+            # some slots never allocate).  The same helper re-derives
+            # the geometry at mesh-elastic resume, so construction and
+            # resume can never disagree on the quantization rule.
+            from ..parallel.sharding import quantize_pool_slots
+            slots = quantize_pool_slots(slots, self.mesh)
             # in-flight floor: with depth-N pipelining, (depth+1) chunks
             # of rps cohorts each can pin rows simultaneously — a pool
             # below that would deadlock allocation mid-run; refuse at
@@ -218,6 +220,17 @@ class OptimizationServer:
                     "would ignore the injected faults; zero those rates "
                     "(IO faults and preempt_at_round still apply) or "
                     "drop the feature")
+        if self.chaos is not None and self.chaos.has_infra_faults and \
+                not self._fleet_paged:
+            raise ValueError(
+                "server_config.chaos.infra requires fleet paged carry — "
+                "the infra fault streams target the fleet host services "
+                "(row-store spill/read, the fleet-prefetch daemon, the "
+                "writeback fetch, the round marker), which only exist "
+                "under server_config.fleet with a fused_carry "
+                "device-carry strategy (scaffold / ef_quant / "
+                "personalized); zero the infra rates or enable fleet "
+                "paging")
 
         # ---- fluteflow: event-driven arrival plane -------------------
         # server_config.traffic (traffic/): clients become available per
@@ -344,6 +357,28 @@ class OptimizationServer:
             io_fault=(self.chaos.io_fault_hook if self.chaos is not None
                       else None))
 
+        # ---- flutearmor: ONE durable-IO ladder for every host service
+        # (resilience/integrity.py).  The same checkpoint_retry policy
+        # that governs checkpoint saves now governs row-store spill/read,
+        # the fleet round marker, the writeback fetch, and the rollup
+        # writer — with per-surface escalators and the documented
+        # degradation table; chaos.infra (when configured) supplies the
+        # seeded per-surface fault hooks, so retries redraw fresh
+        # decisions exactly like the checkpoint IO stream
+        _infra = self.chaos.infra if self.chaos is not None else None
+        _hooks = {}
+        if _infra is not None:
+            _hooks = {"store_write": _infra.hook("store_write"),
+                      "store_read": _infra.hook("store_read"),
+                      # the round marker is store-family durable IO: it
+                      # shares the spill stream (one service, one tag)
+                      "marker": _infra.hook("store_write"),
+                      "writeback": _infra.hook("writeback"),
+                      "writer": _infra.hook("writer")}
+        self.ladder = DurableIOLadder(
+            policy=RetryPolicy.from_config(sc.get("checkpoint_retry")),
+            fault_hooks=_hooks)
+
         # ---- flutescope telemetry (server_config.telemetry) ----------
         # None when the block is absent/disabled — the default, and the
         # zero-cost contract: every instrumentation point below is one
@@ -372,6 +407,16 @@ class OptimizationServer:
             # wedges past the grace period, the black box is on disk
             self.preemption.add_flush_hook(self.scope.flush)
             self.preemption.add_flush_hook(self._flight_on_preempt)
+        # every failed durable-IO attempt lands a structured
+        # store_io_fault instant event (scope-less runs fall back to the
+        # metrics stream), and the rollup writer itself degrades through
+        # the ladder: an exhausted window append becomes the
+        # rollup_windows_dropped event + counter, never an exception up
+        # the host tail
+        self.ladder.event = self._ladder_event
+        if self.scope is not None and self.scope.rollup is not None:
+            self.scope.rollup.ladder = self.ladder
+            self.scope.rollup.on_drop = self._rollup_dropped
 
         # LR machinery: server-side schedule + client plateau decay
         self.initial_lr_client = float(sc.get("initial_lr_client", 0.01))
@@ -670,12 +715,22 @@ class OptimizationServer:
                                      self.strategy.init_state(params), 0)
             print_rank(f"warm-started from pretrained model {pretrained}")
         resumed = False
+        self._status_ring: list = []
         if sc.get("resume_from_checkpoint", False):
             restored = self.ckpt.load(self.state)
+            if restored is not None and self._fleet_paged:
+                restored = self._paired_fleet_anchor(restored, model_dir)
             if restored is not None:
-                self.state = restored
+                self.state = self._place_restored(restored, self.state)
                 resumed = True
-                status = self.ckpt.read_status()
+                status = self._paired_status(self.ckpt.read_status(),
+                                             int(self.state.round))
+                # continue the per-round anchor ring from the resumed
+                # round; entries beyond it belong to the dead trajectory
+                # and get rewritten by the replay
+                self._status_ring = [
+                    e for e in status.get("status_ring", [])
+                    if int(e[0]) <= int(self.state.round)]
                 self.lr_weight = float(status.get("weight", 1.0))
                 # re-anchor the RNG streams (client sampling order + the
                 # device-key counter) so the post-resume trajectory is
@@ -812,13 +867,17 @@ class OptimizationServer:
             from .paging import CarryPager
             if resumed:
                 # the restored tables came off the checkpoint as host
-                # arrays: re-lay them out with the slot axis sharded so
-                # the donated round program sees the SAME layout a fresh
-                # init builds (no resharding copy, no donation churn)
+                # arrays: first re-derive the slot geometry for THIS
+                # mesh (mesh-elastic resume — a checkpoint saved on M
+                # shards may restore [P_old] tables), then re-lay them
+                # out with the slot axis sharded so the donated round
+                # program sees the SAME layout a fresh init builds (no
+                # resharding copy, no donation churn)
                 self.state = ServerState(
                     self.state.params, self.state.opt_state,
                     self.engine.shard_carry_state(
-                        self.state.strategy_state),
+                        self._elastic_carry_tables(
+                            self.state.strategy_state)),
                     self.state.round)
             self.fleet_pager = CarryPager(
                 self.strategy, self.state.strategy_state,
@@ -828,18 +887,32 @@ class OptimizationServer:
                     self._fleet_cfg.get("host_cache_rows", 8192) or 8192),
                 resume=resumed,
                 partition_mode=self.engine.partition_mode,
-                prefetch=bool(self._fleet_cfg.get("prefetch", True)))
+                prefetch=bool(self._fleet_cfg.get("prefetch", True)),
+                ladder=self.ladder,
+                faults=(self.chaos.infra if self.chaos is not None
+                        else None))
             # the prefetch worker spans its host IO on its own thread
             # track — the trace then SHOWS the paging stage overlapping
             # the device window instead of on the critical path
             self.fleet_pager.scope = self.scope
-            if resumed and self.fleet_pager.round() != self.state.round:
-                print_rank(
-                    f"fleet carry rows were at round "
-                    f"{self.fleet_pager.round()} but the checkpoint "
-                    f"resumed at {self.state.round}; resetting carry "
-                    "rows (one-trajectory rule)")
-                self.fleet_pager.reset()
+            if resumed:
+                marker = self.fleet_pager.round()
+                if marker is None or int(marker) < int(self.state.round):
+                    # unreachable when the anchor pairing above chose
+                    # the slot, but direct dir surgery / legacy stores
+                    # still get the one-trajectory safety net
+                    print_rank(
+                        f"fleet carry rows were at round {marker} but "
+                        f"the checkpoint resumed at {self.state.round}; "
+                        "resetting carry rows (one-trajectory rule)")
+                    self.fleet_pager.reset()
+                else:
+                    # prune the dead trajectory's newer row generations
+                    # (a marker AHEAD of the anchor is fine: those
+                    # generations are exactly what adoption removes)
+                    self.fleet_pager.adopt_round(int(self.state.round))
+                    self.fleet_pager.mark_durable(
+                        int(self.state.round) - 1)
             mb = (self.fleet_pager.n_slots *
                   self.fleet_pager.hbm_row_bytes()) / 2**20
             print_rank(
@@ -869,6 +942,147 @@ class OptimizationServer:
         """Watchdog ``mark`` action: persist the finding to the status
         log so a post-mortem sees it without the metrics stream."""
         self.ckpt.update_status({f"watchdog_{kind}": dict(fields)})
+
+    def _ladder_event(self, kind: str, **fields: Any) -> None:
+        """The durable-IO ladder's structured-event sink (scope or the
+        bare metrics stream — emit_event handles both)."""
+        emit_event(self.scope, kind, **fields)
+
+    def _rollup_dropped(self, rec: Dict[str, Any]) -> None:
+        """Rollup-writer exhaustion callback: the degradation table's
+        telemetry leg — count it, surface it, keep training."""
+        dropped = (self.scope.rollup.windows_dropped
+                   if self.scope is not None and
+                   self.scope.rollup is not None else 1)
+        emit_event(self.scope, "rollup_windows_dropped",
+                   windows_dropped=int(dropped),
+                   window=rec.get("window"))
+
+    def _place_restored(self, restored: Any, template: Any) -> Any:
+        """Re-place a checkpoint-restored state on the shardings
+        ``init_state`` chose (the pretrained-path idiom): restore hands
+        back HOST numpy leaves, and dispatching those raw commits a
+        second input layout — the first post-resume chunk would compile
+        a warmup variant that differs from steady state (a spurious
+        recompile on every resume).  Leaves whose SHAPE changed (a
+        mesh-elastic resume's slot-sized carry tables) stay host-side:
+        the fleet path rebuilds and re-shards them explicitly.  Only
+        MESH shardings are re-placed: a template leaf sitting on a
+        SingleDeviceSharding is an UNCOMMITTED jnp-op result whose
+        placement was incidental (jit moves it freely), and committing
+        the restored copy there via device_put would pin it to one
+        device next to committed mesh-sharded params — an
+        incompatible-devices dispatch error.  Those leaves come back as
+        uncommitted host numpy, the layout the fresh init dispatches."""
+        from jax.sharding import SingleDeviceSharding
+        def leaf(host, old):
+            sh = getattr(old, "sharding", None)
+            if sh is None or isinstance(sh, SingleDeviceSharding) or \
+                    np.shape(host) != tuple(old.shape):
+                return np.asarray(jax.device_get(host))
+            return jax.device_put(jnp.asarray(host, old.dtype), sh)
+        from .round import ServerState
+        return ServerState(
+            params=jax.tree.map(leaf, restored.params, template.params),
+            opt_state=jax.tree.map(leaf, restored.opt_state,
+                                   template.opt_state),
+            strategy_state=jax.tree.map(leaf, restored.strategy_state,
+                                        template.strategy_state),
+            round=restored.round)
+
+    def _paired_fleet_anchor(self, restored: Any, model_dir: str) -> Any:
+        """Crash-consistent resume anchor under fleet paging
+        (flutearmor crash-point contract): the carry marker commits
+        AFTER the model checkpoint, so a hard kill inside a round's
+        commit window can leave ``latest_model`` ahead of the durable
+        row set (pipelined loops save each chunk's latest at the NEXT
+        dispatch, widening the window to the ring depth).  Bit-identical
+        resume requires params and carry from the SAME round, so the
+        anchor is the round the MARKER proves durable: keep latest when
+        it matches (or trails — newer row generations prune away), fall
+        back to the ``.prev`` slot when THAT matches, and otherwise
+        cold-start — the seeded run replays from round 0 to the same
+        bits, trading wall clock for correctness."""
+        from .paging import read_marker
+        marker = read_marker(os.path.join(model_dir, "fleet_carry"))
+        durable = int(marker) if marker is not None else 0
+        latest_round = int(restored.round)
+        if durable >= latest_round:
+            return restored
+        from .checkpoint import LATEST_PREV
+        prev = self.ckpt.load(self.state, LATEST_PREV)
+        if prev is not None and int(prev.round) == durable:
+            print_rank(
+                f"fleet carry rows are durable through round {durable} "
+                f"but latest_model is at {latest_round} (hard stop "
+                "inside the commit window); resuming from the previous "
+                "slot so params and carry stay on one trajectory")
+            return prev
+        print_rank(
+            f"fleet carry rows are durable through round {durable} with "
+            f"no matching checkpoint slot (latest {latest_round}); "
+            "cold-starting — the seeded replay reproduces the run "
+            "bit-for-bit")
+        return None
+
+    def _paired_status(self, status: Dict[str, Any],
+                       round_no: int) -> Dict[str, Any]:
+        """The status snapshot PAIRED with the resumed round: the
+        status log is written before the round's checkpoint commits
+        (and an async save can land later still), so after a hard kill
+        the flat fields may belong to a nearby round.  The per-round
+        anchor ring keeps the last few snapshots; re-anchoring from the
+        checkpoint's own entry keeps the replayed sampling trail — and
+        the LR/plateau/best-val trajectory — bit-identical.  Logs
+        without a ring (or a ring that rolled past the anchor) fall
+        back to the flat fields, the historical behaviour."""
+        for entry in reversed(status.get("status_ring", [])):
+            if int(entry[0]) == int(round_no):
+                merged = dict(status)
+                merged.update(entry[1])
+                return merged
+        return status
+
+    def _elastic_carry_tables(self, strategy_state: Any) -> Any:
+        """Mesh-elastic resume (flutearmor leg 4): a fleet checkpoint
+        saved on M shards restores carry tables sized for the OLD
+        mesh's quantized pool; this run's pool (``strategy.carry_rows``,
+        re-quantized for the NEW mesh at construction) may differ.
+        Slot-sized tables rebuild at the new capacity from the carry
+        defaults — sound because resumed slot maps start EMPTY and the
+        host row store (shard-agnostic, keyed by global client id) is
+        the authoritative row source: every next touch pages the true
+        row in, so per-client math never sees the rebuilt defaults.
+        The sampling trail replays via the regular RNG re-anchoring —
+        final params stay bit-identical to the uninterrupted run
+        (tests/test_fleet_mesh.py)."""
+        new_slots = int(self.strategy.carry_rows)
+        defaults = dict(self.strategy.carry_row_defaults())
+        rebuilt = {}
+        old_slots = None
+        for k in self.strategy.carry_tables:
+            leaf = strategy_state[k]
+            rows = int(leaf.shape[0])
+            if rows == new_slots:
+                continue
+            old_slots = rows
+            rebuilt[k] = np.full(
+                (new_slots,) + tuple(int(d) for d in leaf.shape[1:]),
+                defaults.get(k, 0.0), dtype=np.dtype(str(leaf.dtype)))
+        if not rebuilt:
+            return strategy_state
+        emit_event(self.scope, "elastic_resume",
+                   from_slots=int(old_slots), to_slots=new_slots,
+                   mesh_shards=int(self.mesh.shape[CLIENTS_AXIS]),
+                   tables=sorted(rebuilt))
+        print_rank(
+            f"mesh-elastic resume: carry pool re-quantized "
+            f"{old_slots} -> {new_slots} slots for the "
+            f"{int(self.mesh.shape[CLIENTS_AXIS])}-shard mesh; rows "
+            "reload from the host store on first touch")
+        new_state = dict(strategy_state)
+        new_state.update(rebuilt)
+        return new_state
 
     def _flight_on_preempt(self) -> None:
         """Preemption flush hook: persist the flight record as part of
@@ -1375,7 +1589,7 @@ class OptimizationServer:
                 # them — the dp_clip stash discipline); the drain
                 # completes it with one explicit fetch
                 chunk["fleet_wb"] = self.fleet_pager.queue_writeback(
-                    self.state.strategy_state)
+                    self.state.strategy_state, round_no=round_no + R)
             # dispatch is async: pack the next chunk NOW, while the device
             # executes this one (reading the stats below is what blocks)
             if lookahead_pack and round_no + R < max_iteration:
@@ -1858,6 +2072,18 @@ class OptimizationServer:
         if self.scope is not None and self.scope.rollup is not None:
             card["rollup_windows"] = int(
                 self.scope.rollup.windows_flushed)
+            # the degradation table's telemetry ledger: windows lost to
+            # writer exhaustion (always present when rollups are on —
+            # 0 is the healthy reading the drill gates against)
+            card["rollup_windows_dropped"] = int(
+                self.scope.rollup.windows_dropped)
+        if self.chaos is not None and self.chaos.infra is not None:
+            # seeded infra-fault ledger (chaos.infra): per-surface
+            # injected-fault counts — a drill run is impossible to
+            # confuse with a clean one on the regression surface
+            card["infra_faults"] = {
+                k: float(v)
+                for k, v in sorted(self.chaos.infra.counters.items())}
         if self.fleet_pager is not None:
             # paging pressure joins the regression surface: a hit-rate
             # collapse or an eviction storm is a fleet-sizing regression
@@ -2240,6 +2466,34 @@ class OptimizationServer:
         if round_no % rec_freq == 0 and self.test_dataset is not None:
             self._maybe_eval("test", round_no)
 
+        status_update = {
+            "i": round_no,
+            "weight": self.lr_weight,
+            # rng resume anchors: numpy bit-generator state + device-key
+            # use counter, captured at the point all randomness for
+            # rounds <= round_no (and none beyond) has been drawn
+            **(rng_snapshot if rng_snapshot is not None
+               else self._rng_snapshot()),
+            **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
+        }
+        if self.best_val:
+            status_update["best_val_hib"] = {
+                k: bool(m.higher_is_better)
+                for k, m in self.best_val.items()}
+        if self.plateau is not None:
+            status_update["plateau"] = {
+                "lr": self.plateau.lr, "best": self.plateau.best,
+                "bad_rounds": self.plateau.bad_rounds}
+        # the status write leads the round's durable sequence (status ->
+        # rows/marker -> checkpoint), and the ring keeps one snapshot
+        # per recent round: whatever slot a crash leaves loadable, the
+        # anchors for exactly that round are already durable
+        # (flutearmor crash-point contract — _paired_status)
+        self._status_ring.append([int(round_no), dict(status_update)])
+        del self._status_ring[:-16]
+        status_update["status_ring"] = self._status_ring
+        self.ckpt.update_status(status_update)
+
         with self._tspan("ckpt_submit", round=round_no):
             if not skip_latest:
                 self.ckpt.save_latest(self.state)
@@ -2301,34 +2555,27 @@ class OptimizationServer:
             # every drained row (writeback-on-drain); spill the dirty
             # ones to disk and commit the round marker only once the
             # paired model checkpoint is durable — the ControlStore
-            # pairing rule.  fleet.spill_freq > 1 amortizes the disk IO;
-            # a stop inside the window resets rows on resume (marker
-            # mismatch), the same tradeoff as scaffold_flush_freq.
+            # pairing rule.  Unlike the control stores, a hard stop
+            # inside this window stays bit-identically resumable: spills
+            # are generation-versioned, so resume rolls the rows back to
+            # whatever slot matches the marker (_paired_fleet_anchor).
+            # fleet.spill_freq > 1 amortizes the disk IO; a stop inside
+            # THAT window resets rows on resume (marker behind anchor),
+            # the same tradeoff as scaffold_flush_freq.
             spill_freq = int(self._fleet_cfg.get("spill_freq", 1) or 1)
             final = round_no >= self._max_iteration
             if spill_freq <= 1 or round_no % spill_freq == 0 or final:
                 self.ckpt.wait()
                 self.fleet_pager.flush()
-                self.fleet_pager.set_round(int(self.state.round))
-        status_update = {
-            "i": round_no,
-            "weight": self.lr_weight,
-            # rng resume anchors: numpy bit-generator state + device-key
-            # use counter, captured at the point all randomness for
-            # rounds <= round_no (and none beyond) has been drawn
-            **(rng_snapshot if rng_snapshot is not None
-               else self._rng_snapshot()),
-            **{f"best_val_{k}": m.value for k, m in self.best_val.items()},
-        }
-        if self.best_val:
-            status_update["best_val_hib"] = {
-                k: bool(m.higher_is_better)
-                for k, m in self.best_val.items()}
-        if self.plateau is not None:
-            status_update["plateau"] = {
-                "lr": self.plateau.lr, "best": self.plateau.best,
-                "bad_rounds": self.plateau.bad_rounds}
-        self.ckpt.update_status(status_update)
+                # the marker commits the DRAINED round (the pipelined
+                # loop's self.state can already belong to a newer
+                # dispatched chunk whose rows are not on the host yet)
+                self.fleet_pager.set_round(int(round_no))
+                # every checkpoint through round_no is durable after
+                # the wait() above; row generations superseded at or
+                # below round_no - 1 become garbage (the - 1 keeps the
+                # generation a corruption fallback to .prev would need)
+                self.fleet_pager.mark_durable(int(round_no) - 1)
         # one buffered-metrics flush per chunk instead of one per metric
         # line — the jsonl stream stays observable at round granularity
         # while the host tail stops paying a syscall per scalar

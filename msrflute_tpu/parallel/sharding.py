@@ -32,6 +32,18 @@ def slot_pool_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(CLIENTS_AXIS))
 
 
+def quantize_pool_slots(slots: int, mesh: Mesh) -> int:
+    """Quantize a page-pool slot count UP to a multiple of the clients
+    mesh axis, so the slot axis splits into equal per-shard blocks.  The
+    server applies this at construction AND at mesh-elastic resume: a
+    fleet checkpoint saved on M shards resuming on M' re-derives its
+    pool capacity for the NEW mesh here (the host row store is
+    shard-agnostic, so only the slot geometry needs re-quantizing)."""
+    shards = int(mesh.shape[CLIENTS_AXIS])
+    slots = max(int(slots), 1)
+    return ((slots + shards - 1) // shards) * shards
+
+
 def infer_model_sharding(params: Any, mesh: Mesh,
                          min_elements: int = 16_384) -> Any:
     """Pytree of NamedShardings: big leaves sharded on ``model``, rest

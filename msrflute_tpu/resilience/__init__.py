@@ -20,16 +20,17 @@ partially.  This package is the engine's answer:
   and ``preempt_at_round`` drives the kill/resume drill deterministically.
 """
 
-from .chaos import ChaosSchedule, make_chaos
+from .chaos import ChaosSchedule, InfraFaults, make_chaos
 from .integrity import (CheckpointCorruptionError, CheckpointEscalationError,
-                        FailureEscalator, RetryPolicy, blob_checksum,
-                        read_sidecar, tree_checksum, verify_blob,
-                        write_sidecar)
+                        DurableIOError, DurableIOLadder, FailureEscalator,
+                        RetryPolicy, blob_checksum, read_sidecar,
+                        tree_checksum, verify_blob, write_sidecar)
 from .preemption import GracefulPreemption, PreemptionHandler
 
 __all__ = [
-    "ChaosSchedule", "make_chaos",
+    "ChaosSchedule", "InfraFaults", "make_chaos",
     "CheckpointCorruptionError", "CheckpointEscalationError",
+    "DurableIOError", "DurableIOLadder",
     "FailureEscalator", "RetryPolicy", "blob_checksum", "read_sidecar",
     "tree_checksum", "verify_blob", "write_sidecar",
     "GracefulPreemption", "PreemptionHandler",

@@ -63,6 +63,14 @@ _IO_STREAM = 0xC7A051F0
 #: its OWN tag so enabling corruption never moves the dropout/straggler
 #: schedule an existing seed produces
 _CORRUPT_STREAM = 0xC7A0C0DE
+#: infrastructure-fault streams (flutearmor, ``chaos.infra``): one tag
+#: PER host service, so raising one service's rate never moves another
+#: service's schedule — and none of them ever move the client streams
+_INFRA_STORE_WRITE_STREAM = 0xC7A05701
+_INFRA_STORE_READ_STREAM = 0xC7A05702
+_INFRA_PREFETCH_STREAM = 0xC7A0F7EC
+_INFRA_WRITER_STREAM = 0xC7A03217
+_INFRA_WRITEBACK_STREAM = 0xC7A03B0A
 
 #: corruption mode encoding for the per-round ``[K]`` int32 operand the
 #: fused round program consumes (engine/round.py); 0 = clean
@@ -73,6 +81,122 @@ CORRUPT_SIGN_FLIP = 3  # payload x -corrupt_sign_flip_scale (sign flip)
 
 #: "no straggler bound" sentinel — far above any realistic step grid
 NO_BOUND = 1e9
+
+
+class InfraFaults:
+    """Seeded infrastructure-fault streams (``server_config.chaos.infra``).
+
+    Where :class:`ChaosSchedule` makes the *cohort* adversarial, this
+    makes the *host services* adversarial: the FleetRowStore's ``.npz``
+    spill/read pair, the ControlStore round marker, the ``fleet-prefetch``
+    daemon, the rollup/metrics writers, and the writeback ``device_get``.
+    Each surface draws from its OWN call-indexed SeedSequence stream
+    (``[seed, stream, call]``), so raising one service's rate never moves
+    another service's schedule, retries of the same operation redraw
+    fresh decisions (a schedule that always re-failed the retry would
+    make rates < 1 untestable), and none of the draws touch the client
+    fault streams — ``chaos.infra`` composes with every existing chaos
+    block without perturbing it.  Like the checkpoint IO stream, the
+    counters restart at call 0 in a resumed process: injected infra
+    faults exercise the retry/degradation ladder and never touch model
+    state, so exact cross-resume alignment is not required.
+    """
+
+    _STREAMS = {
+        "store_write": _INFRA_STORE_WRITE_STREAM,
+        "store_read": _INFRA_STORE_READ_STREAM,
+        "prefetch": _INFRA_PREFETCH_STREAM,
+        "writer": _INFRA_WRITER_STREAM,
+        "writeback": _INFRA_WRITEBACK_STREAM,
+    }
+
+    def __init__(self, seed: int = 0,
+                 store_write_error_rate: float = 0.0,
+                 store_read_error_rate: float = 0.0,
+                 prefetch_error_rate: float = 0.0,
+                 prefetch_delay_rate: float = 0.0,
+                 prefetch_delay_s: float = 0.05,
+                 writer_error_rate: float = 0.0,
+                 writeback_error_rate: float = 0.0):
+        rates = {"store_write_error_rate": store_write_error_rate,
+                 "store_read_error_rate": store_read_error_rate,
+                 "prefetch_error_rate": prefetch_error_rate,
+                 "prefetch_delay_rate": prefetch_delay_rate,
+                 "writer_error_rate": writer_error_rate,
+                 "writeback_error_rate": writeback_error_rate}
+        for key, val in rates.items():
+            if not 0.0 <= float(val) <= 1.0:
+                raise ValueError(f"chaos.infra.{key} must be in [0, 1]")
+        if float(prefetch_delay_s) < 0.0:
+            raise ValueError("chaos.infra.prefetch_delay_s must be >= 0")
+        self.seed = int(seed)
+        self.rates = {k: float(v) for k, v in rates.items()}
+        self.prefetch_delay_s = float(prefetch_delay_s)
+        self._calls = {name: 0 for name in self._STREAMS}
+        self._calls["prefetch_delay"] = 0
+        #: per-surface injected-fault observability, merged into the
+        #: server scorecard next to the client-fault counters
+        self.counters: Dict[str, float] = {
+            "store_write_faults": 0.0, "store_read_faults": 0.0,
+            "prefetch_faults": 0.0, "prefetch_delays": 0.0,
+            "writer_faults": 0.0, "writeback_faults": 0.0,
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return any(v > 0.0 for v in self.rates.values())
+
+    def _draw(self, surface: str, rate: float) -> bool:
+        """One call-indexed decision on ``surface``'s stream.  The delay
+        sub-stream shares the prefetch tag with a salt word appended, so
+        delay draws never advance the prefetch *error* schedule."""
+        if surface == "prefetch_delay":
+            key = [self.seed, _INFRA_PREFETCH_STREAM,
+                   self._calls[surface], 1]
+        else:
+            key = [self.seed, self._STREAMS[surface], self._calls[surface]]
+        self._calls[surface] += 1
+        rng = np.random.default_rng(np.random.SeedSequence(key))
+        return bool(rng.random() < rate)
+
+    def fault(self, surface: str) -> bool:
+        """True when ``surface``'s next physical operation should fail."""
+        rate = self.rates[f"{surface}_error_rate"]
+        if self._draw(surface, rate):
+            self.counters[f"{surface}_faults"] += 1
+            return True
+        return False
+
+    def hook(self, surface: str):
+        """A zero-arg raise-hook for ``surface`` (the shape the durable-IO
+        ladder's fault probes expect), or None when the rate is 0 — so
+        the hot paths stay branch-free with chaos disabled."""
+        if self.rates[f"{surface}_error_rate"] <= 0.0:
+            return None
+
+        def _probe() -> None:
+            if self.fault(surface):
+                raise OSError(
+                    f"chaos: injected {surface} infra fault "
+                    f"#{int(self.counters[f'{surface}_faults'])} "
+                    f"({surface}_error_rate="
+                    f"{self.rates[f'{surface}_error_rate']})")
+        return _probe
+
+    def prefetch_delay(self) -> float:
+        """Seconds the prefetch worker should stall before staging this
+        chunk (0.0 almost always) — exercises the superseded-generation
+        staging path without killing the thread."""
+        if self._draw("prefetch_delay", self.rates["prefetch_delay_rate"]):
+            self.counters["prefetch_delays"] += 1
+            return self.prefetch_delay_s
+        return 0.0
+
+    def describe(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"enabled": self.enabled, "seed": self.seed}
+        out.update(self.rates)
+        out["prefetch_delay_s"] = self.prefetch_delay_s
+        return out
 
 
 class ChaosSchedule:
@@ -88,7 +212,8 @@ class ChaosSchedule:
                  corrupt_scale_rate: float = 0.0,
                  corrupt_sign_flip_rate: float = 0.0,
                  corrupt_scale_factor: float = 10.0,
-                 corrupt_sign_flip_scale: float = 1.0):
+                 corrupt_sign_flip_scale: float = 1.0,
+                 infra: Optional[InfraFaults] = None):
         if not 0.0 <= float(dropout_rate) <= 1.0:
             raise ValueError("chaos.dropout_rate must be in [0, 1]")
         if not 0.0 <= float(straggler_rate) <= 1.0:
@@ -125,6 +250,7 @@ class ChaosSchedule:
         self.corrupt_sign_flip_rate = float(corrupt_sign_flip_rate)
         self.corrupt_scale_factor = float(corrupt_scale_factor)
         self.corrupt_sign_flip_scale = float(corrupt_sign_flip_scale)
+        self.infra = infra
         self._io_calls = 0
         #: injected-fault observability, accumulated by the server from
         #: the packed round stats (dropped/straggled/steps_lost +
@@ -145,6 +271,10 @@ class ChaosSchedule:
         return (self.corrupt_nan_rate > 0.0 or
                 self.corrupt_scale_rate > 0.0 or
                 self.corrupt_sign_flip_rate > 0.0)
+
+    @property
+    def has_infra_faults(self) -> bool:
+        return self.infra is not None and self.infra.enabled
 
     @staticmethod
     def _entropy(seed: int, stream: int, round_no: int,
@@ -264,6 +394,8 @@ class ChaosSchedule:
             "corrupt_sign_flip_rate": self.corrupt_sign_flip_rate,
             "corrupt_scale_factor": self.corrupt_scale_factor,
             "corrupt_sign_flip_scale": self.corrupt_sign_flip_scale,
+            "infra": (self.infra.describe()
+                      if self.infra is not None else None),
         }
 
 
@@ -276,6 +408,25 @@ def make_chaos(server_config) -> Optional[ChaosSchedule]:
     raw = dict(raw)
     if not raw.pop("enable", True):
         return None
+    infra_raw = raw.get("infra")
+    infra = None
+    if infra_raw:
+        if not isinstance(infra_raw, dict):
+            raise ValueError("chaos.infra must be a mapping of "
+                             "infrastructure fault rates")
+        infra = InfraFaults(
+            seed=raw.get("seed", 0),
+            store_write_error_rate=infra_raw.get(
+                "store_write_error_rate", 0.0),
+            store_read_error_rate=infra_raw.get(
+                "store_read_error_rate", 0.0),
+            prefetch_error_rate=infra_raw.get("prefetch_error_rate", 0.0),
+            prefetch_delay_rate=infra_raw.get("prefetch_delay_rate", 0.0),
+            prefetch_delay_s=infra_raw.get("prefetch_delay_s", 0.05),
+            writer_error_rate=infra_raw.get("writer_error_rate", 0.0),
+            writeback_error_rate=infra_raw.get(
+                "writeback_error_rate", 0.0),
+        )
     return ChaosSchedule(
         seed=raw.get("seed", 0),
         dropout_rate=raw.get("dropout_rate", 0.0),
@@ -288,4 +439,5 @@ def make_chaos(server_config) -> Optional[ChaosSchedule]:
         corrupt_sign_flip_rate=raw.get("corrupt_sign_flip_rate", 0.0),
         corrupt_scale_factor=raw.get("corrupt_scale_factor", 10.0),
         corrupt_sign_flip_scale=raw.get("corrupt_sign_flip_scale", 1.0),
+        infra=infra,
     )

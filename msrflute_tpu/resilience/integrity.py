@@ -181,6 +181,102 @@ def run_with_retry(fn: Callable[[], None], policy: RetryPolicy,
     return False
 
 
+class DurableIOError(RuntimeError):
+    """A durable-IO operation whose loss would corrupt training state
+    (row-store read, writeback ``device_get``) exhausted its retry
+    budget.  Raised FROM the training thread so the server's
+    BaseException tail persists the flight record before aborting."""
+
+
+class DurableIOLadder:
+    """One retry/degradation policy object for ALL durable host IO.
+
+    Generalizes the checkpoint-only RetryPolicy + FailureEscalator pair
+    into the explicit degradation table flutearmor documents (RUNBOOK
+    "Infrastructure-fault drill"): every surface shares ONE
+    :class:`RetryPolicy` (``server_config.checkpoint_retry`` — one knob,
+    one ladder), but keeps its OWN consecutive-failure escalator and its
+    own exhaustion mode:
+
+    - ``mode="escalate"`` (row-store SPILL, ControlStore marker): the
+      failed rows stay host-visible (the caller keeps them dirty / in
+      the spilling map), so a lost write degrades capacity, not
+      correctness — but ``escalation_threshold`` consecutive exhausted
+      writes abort via :class:`CheckpointEscalationError` exactly like
+      an uncheckpointable run would.
+    - ``mode="raise"`` (row-store READ, writeback ``device_get``):
+      exhaustion raises :class:`DurableIOError` immediately — silently
+      losing carry rows corrupts training, so the only honest move is a
+      flight-recorded abort.
+    - ``mode="drop"`` (rollup/metrics writers): exhaustion returns False
+      and the caller drops the window + counts it — telemetry loss must
+      never become a host-tail exception.
+    """
+
+    #: surface -> exhaustion mode; also the registry of valid surfaces
+    MODES = {
+        "store_write": "escalate",
+        "store_read": "raise",
+        "marker": "escalate",
+        "writeback": "raise",
+        "writer": "drop",
+    }
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 fault_hooks: Optional[dict] = None):
+        self.policy = policy if policy is not None else RetryPolicy()
+        #: surface -> zero-arg chaos raise-hook (InfraFaults.hook), run
+        #: before each physical attempt so retries redraw fresh decisions
+        self.fault_hooks = dict(fault_hooks or {})
+        #: optional instant-event emitter ``event(kind, **fields)`` the
+        #: server wires to flutescope — every failed attempt on a
+        #: store-family surface lands a ``store_io_fault`` event, so the
+        #: infra drill's degradations are all structured, never log-only
+        self.event: Optional[Callable[..., None]] = None
+        self.escalators = {
+            name: FailureEscalator(self.policy.escalation_threshold)
+            for name, mode in self.MODES.items() if mode == "escalate"
+        }
+
+    def run(self, fn: Callable[[], None], surface: str,
+            what: str = "") -> bool:
+        """Run one durable operation on ``surface`` under the ladder.
+        True on success; on exhaustion, behave per the surface's mode
+        (see class docstring).  ``what`` labels log lines."""
+        mode = self.MODES[surface]
+        hook = self.fault_hooks.get(surface)
+
+        def attempt() -> None:
+            try:
+                if hook is not None:
+                    hook()
+                fn()
+            except Exception as exc:
+                # structured observability per failed attempt (injected
+                # OR real), on the surfaces whose loss is a store/state
+                # problem; writer failures get their own rollup event
+                if self.event is not None and surface != "writer":
+                    self.event("store_io_fault", surface=surface,
+                               what=what, error=repr(exc))
+                raise
+        ok = run_with_retry(attempt, self.policy,
+                            what=what or f"{surface} io")
+        if ok:
+            if mode == "escalate":
+                self.escalators[surface].record_success()
+            return True
+        if mode == "raise":
+            raise DurableIOError(
+                f"{surface} IO exhausted its retry budget "
+                f"({self.policy.retries} attempts){': ' + what if what else ''}"
+                " — losing this data would corrupt training state")
+        if mode == "escalate":
+            esc = self.escalators[surface]
+            esc.record_failure(what or surface)
+            esc.check()
+        return False
+
+
 class FailureEscalator:
     """Consecutive-failure counter shared by the checkpoint writer paths.
     Thread-safe enough for its use (int ops under the GIL; the writer

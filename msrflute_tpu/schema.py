@@ -125,6 +125,17 @@ CHAOS_KEYS = {
     # resilience/chaos.py corrupt_modes)
     "corrupt_nan_rate", "corrupt_scale_rate", "corrupt_sign_flip_rate",
     "corrupt_scale_factor", "corrupt_sign_flip_scale",
+    # flutearmor's infrastructure fault plane (nested mapping,
+    # CHAOS_INFRA_KEYS / resilience/chaos.py InfraFaults)
+    "infra",
+}
+
+#: ``server_config.chaos.infra`` — seeded host-service fault streams
+#: (flutearmor): each knob arms one surface's call-indexed stream
+CHAOS_INFRA_KEYS = {
+    "store_write_error_rate", "store_read_error_rate",
+    "prefetch_error_rate", "prefetch_delay_rate", "prefetch_delay_s",
+    "writer_error_rate", "writeback_error_rate",
 }
 
 ROBUST_KEYS = {
@@ -415,6 +426,18 @@ CHAOS_FIELD_SPECS = {
     # rehearse shrink attacks); strictly positive
     "corrupt_scale_factor": ("num", 0.0, None),
     "corrupt_sign_flip_scale": ("num", 0.0, None),
+}
+
+CHAOS_INFRA_FIELD_SPECS = {
+    "store_write_error_rate": ("num", 0.0, 1.0),
+    "store_read_error_rate": ("num", 0.0, 1.0),
+    "prefetch_error_rate": ("num", 0.0, 1.0),
+    "prefetch_delay_rate": ("num", 0.0, 1.0),
+    # seconds a delayed prefetch staging stalls (superseded-generation
+    # drill); any non-negative duration
+    "prefetch_delay_s": ("num", 0.0, None),
+    "writer_error_rate": ("num", 0.0, 1.0),
+    "writeback_error_rate": ("num", 0.0, 1.0),
 }
 
 CHECKPOINT_RETRY_FIELD_SPECS = {
@@ -853,6 +876,20 @@ def validate(raw: Dict[str, Any], strict: Optional[bool] = None) -> None:
                         not isinstance(val, bool) and float(val) == 0.0:
                     errors.append(
                         f"server_config.chaos.{key}: must be > 0")
+            infra = chaos.get("infra")
+            if infra is not None and not isinstance(infra, dict):
+                errors.append(
+                    "server_config.chaos.infra: must be a mapping of "
+                    "infrastructure fault rates (see "
+                    "docs/config_extensions.md), got "
+                    f"{type(infra).__name__}")
+            if isinstance(infra, dict):
+                _check_unknown(unknown, infra,
+                               "server_config.chaos.infra",
+                               CHAOS_INFRA_KEYS)
+                _check_fields(errors, infra,
+                              "server_config.chaos.infra",
+                              CHAOS_INFRA_FIELD_SPECS)
         robust = sc.get("robust")
         if robust is not None and not isinstance(robust, dict):
             errors.append(

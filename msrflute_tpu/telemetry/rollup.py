@@ -196,11 +196,23 @@ class RollupEngine:
     #: default rounds per rollup window
     DEFAULT_WINDOW = 16
 
-    def __init__(self, out_dir: str, window: int = DEFAULT_WINDOW):
+    def __init__(self, out_dir: str, window: int = DEFAULT_WINDOW,
+                 ladder=None):
         self.out_dir = out_dir
         self.path = os.path.join(out_dir, ROLLUPS_FILENAME)
         self.window = max(int(window), 1)
         self.windows_flushed = 0
+        #: rollup degradation ledger: a window whose append exhausts its
+        #: retries is DROPPED and counted — telemetry loss must never
+        #: become a host-tail exception in a training run
+        self.windows_dropped = 0
+        #: optional resilience.DurableIOLadder governing the jsonl
+        #: append (surface "writer": retry, then drop); None appends raw
+        #: but still drops-and-counts on failure
+        self.ladder = ladder
+        #: optional ``on_drop(rec)`` callback the server wires to emit
+        #: the ``rollup_windows_dropped`` instant event
+        self.on_drop = None
         self._fh = None  # opened lazily at first flush
         self._lock = threading.Lock()
         # ---- window state (reset at every flush) ----
@@ -324,15 +336,46 @@ class RollupEngine:
         self._w_t0 = time.time()
 
     def _append(self, rec: Dict[str, Any]) -> None:
-        if self._fh is None:
-            os.makedirs(self.out_dir, exist_ok=True)
-            self._fh = open(self.path, "a", encoding="utf-8")
-        # one complete line + flush: the crash-safe jsonl idiom — a
-        # reader (scope watch / health) never sees a torn record older
-        # than the last flush, and a kill loses at most the line being
-        # written (readers tolerate a torn tail)
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
+        def _do() -> None:
+            if self._fh is None:
+                os.makedirs(self.out_dir, exist_ok=True)
+                self._fh = open(self.path, "a", encoding="utf-8")
+            # one complete line + flush: the crash-safe jsonl idiom — a
+            # reader (scope watch / health) never sees a torn record
+            # older than the last flush, and a kill loses at most the
+            # line being written (readers tolerate a torn tail)
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self.ladder is not None:
+            ok = self.ladder.run(_do, surface="writer",
+                                 what="rollup window append")
+        else:
+            try:
+                _do()
+                ok = True
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:  # noqa: BLE001 - telemetry must not abort
+                ok = False
+        if not ok:
+            self._drop_window(rec)
+
+    def _drop_window(self, rec: Dict[str, Any]) -> None:
+        """Writer exhaustion: the window record is lost, the loss is
+        counted, the handle resets (a broken fh must not poison every
+        later flush), and the server's callback turns it into the
+        ``rollup_windows_dropped`` instant event — the degradation table
+        in action, never an exception up the host tail."""
+        self.windows_dropped += 1
+        fh, self._fh = self._fh, None
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+        cb = self.on_drop
+        if cb is not None:
+            cb(rec)
 
     def maybe_flush(self) -> Optional[Dict[str, Any]]:
         """Housekeeping-cadence flush point: append the window record

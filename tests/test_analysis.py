@@ -472,6 +472,100 @@ def test_schema_drift_flags_undocumented_resilience_knob(tmp_path):
     assert "chaos" in msgs and "checkpoint_retry" in msgs
 
 
+def test_schema_drift_infra_specs_consistent(tmp_path):
+    """PR 20 corpus (positive): the nested ``chaos.infra`` block's spec
+    table only rules keys CHAOS_INFRA_KEYS knows, `infra` is a CHAOS_KEYS
+    member, and the runbook documents the drill — drift-free."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'chaos'}\n"
+        "CHAOS_KEYS = {'seed', 'infra'}\n"
+        "CHAOS_INFRA_KEYS = {'store_write_error_rate',"
+        " 'prefetch_error_rate', 'prefetch_delay_s'}\n"
+        "CHAOS_INFRA_FIELD_SPECS = {"
+        "'store_write_error_rate': ('num', 0, 1),"
+        " 'prefetch_error_rate': ('num', 0, 1),"
+        " 'prefetch_delay_s': ('num', 0, None)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.chaos` carries the fault streams; the infra "
+        "drill injects host-service faults.")
+    assert check_project(str(tmp_path),
+                         documented_knobs=("chaos", "infra")) == []
+
+
+def test_schema_drift_infra_knob_scoped_to_chaos_keys(tmp_path):
+    """PR 20 corpus (positive): a fork whose chaos block has NO nested
+    infra mapping owes no runbook entry for it — the documented-knob
+    rule only covers knobs the schema actually knows (here via
+    CHAOS_KEYS, since `infra` is nested, not a SERVER_KEYS member)."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'chaos'}\n"
+        "CHAOS_KEYS = {'seed', 'dropout_rate'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.chaos` is the fault-injection knob.")
+    assert check_project(str(tmp_path),
+                         documented_knobs=("chaos", "infra")) == []
+
+
+def test_schema_drift_flags_dead_infra_spec(tmp_path):
+    """PR 20 corpus (negative): a CHAOS_INFRA_FIELD_SPECS rule for a key
+    CHAOS_INFRA_KEYS does not know is dead code — the spec would never
+    fire on any accepted config."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'chaos'}\n"
+        "CHAOS_KEYS = {'seed', 'infra'}\n"
+        "CHAOS_INFRA_KEYS = {'store_write_error_rate'}\n"
+        "CHAOS_INFRA_FIELD_SPECS = {"
+        "'store_write_error_rate': ('num', 0, 1),"
+        " 'ghost_error_rate': ('num', 0, 1)}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.chaos` and its infra streams are documented.")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("chaos", "infra"))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "ghost_error_rate" in found[0].message
+    assert "CHAOS_INFRA_KEYS" in found[0].message
+
+
+def test_schema_drift_flags_undocumented_infra_knob(tmp_path):
+    """PR 20 corpus (negative): `infra` nested in CHAOS_KEYS but absent
+    from the runbook — the operator meets host-service failures
+    mid-campaign instead of in the drill."""
+    pkg = tmp_path / "msrflute_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "schema.py").write_text(
+        "SERVER_KEYS = {'max_iteration', 'chaos'}\n"
+        "CHAOS_KEYS = {'seed', 'infra'}\n")
+    (pkg / "config.py").write_text(
+        "class ServerConfig:\n    max_iteration: int = 0\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RUNBOOK.md").write_text(
+        "`server_config.chaos` drills client faults only.")
+    found = check_project(str(tmp_path),
+                          documented_knobs=("chaos", "infra"))
+    assert [f.rule for f in found] == ["schema-drift"]
+    assert "`infra`" in found[0].message
+    assert "not documented" in found[0].message
+
+
 def test_schema_drift_covers_fleet_specs(tmp_path):
     """PR 14 corpus: the fleet block's field specs are drift-checked
     like every other section — a FLEET_FIELD_SPECS rule for a key the
@@ -1647,6 +1741,198 @@ def test_guard_matrix_flags_traffic_missing_runtime_guard(tmp_path):
     found = check_project(root)
     assert [f.rule for f in found] == ["guard-matrix"]
     assert "`traffic` has no runtime refusal" in found[0].message
+
+
+#: PR 20 corpus: the consistent tree extended with the flutearmor infra
+#: fault plane — `chaos` in SERVER_KEYS, the infra refusal in server.py
+#: (fleet paged carry required), and a chaos section whose infra
+#: subsection names every refused token + cites the composition suite.
+_INFRA_SCHEMA = """\
+    SERVER_KEYS = {'max_iteration', 'robust', 'chaos'}
+    ERR = ("server_config.robust is set but strategy is wrong — "
+           "it plugs into the fedavg combine only; payloads would "
+           "aggregate UNSCREENED")
+    """
+_INFRA_SERVER = """\
+    class Server:
+        def __init__(self, sc, strategy):
+            host_orchestrated = (
+                sc.get("wantRL", False) or
+                getattr(strategy, "host_rounds", False))
+            if sc.get("robust") and host_orchestrated:
+                raise ValueError(
+                    "server_config.robust requires the fused round "
+                    "path — wantRL and scaffold orchestrate rounds "
+                    "host-side")
+            infra = (sc.get("chaos") or {}).get("infra")
+            if infra and not sc.get("fleet"):
+                raise ValueError(
+                    "server_config.chaos.infra requires fleet paged "
+                    "carry — the fault streams target the fleet host "
+                    "services, which only exist under fused_carry "
+                    "device-carry strategies (scaffold / ef_quant); "
+                    "zero the infra rates or enable fleet paging")
+    """
+_INFRA_DOCS = """\
+    # extensions
+
+    ### server_config.robust — screened aggregation
+
+    Requires `strategy: fedavg`.  Incompatible with `wantRL` and
+    `scaffold` (host-orchestrated rounds).
+
+    ### server_config.chaos — fault injection
+
+    Seeded client + host-service fault streams.
+
+    #### server_config.chaos.infra — host-service fault streams
+
+    Refused with a `ValueError` unless fleet paging is live under a
+    `fused_carry` device-carry strategy (`scaffold` / `ef_quant`).
+    Composes with `scaffold` + `fused_carry` fleet paging
+    (`tests/test_resilience.py`).
+    """
+_INFRA_CITED_TEST = """\
+    def test_infra_composes_with_fleet_paging():
+        cfg = {"strategy": "scaffold", "fused_carry": True}
+    """
+
+
+def test_guard_matrix_consistent_infra_tree_passes(tmp_path):
+    """PR 20 corpus (positive): the infra refusal names
+    `fused_carry`/`scaffold`/`ef_quant`, the chaos section documents
+    every token, and the composition claim cites a suite exercising
+    both composed tokens — matrix-consistent."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _INFRA_SCHEMA,
+        "msrflute_tpu/engine/server.py": _INFRA_SERVER,
+        "docs/config_extensions.md": _INFRA_DOCS,
+        "tests/test_resilience.py": _INFRA_CITED_TEST})
+    assert check_project(root) == []
+
+
+def test_guard_matrix_infra_refusal_after_compose_same_paragraph(
+        tmp_path):
+    """PR 20 corpus (positive): the infra paragraph carries BOTH a
+    refusal sentence and a composition claim; the refusal's tokens stay
+    rule-4 cells (enforced by the guard) and the compose claim's tokens
+    stay rule-5 cells (exercised by the cited suite) — neither layer
+    swallows the other's tokens."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _INFRA_SCHEMA,
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+                    if sc.get("robust") and host_orchestrated:
+                        raise ValueError(
+                            "server_config.robust requires the fused "
+                            "round path — wantRL and scaffold "
+                            "orchestrate rounds host-side")
+                    if (sc.get("chaos") or {}).get("infra") and \\
+                            sc.get("wantRL"):
+                        raise ValueError(
+                            "server_config.chaos.infra is refused "
+                            "under wantRL — host-orchestrated rounds "
+                            "bypass the fleet host services")
+            """,
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`
+            and `scaffold` (host-orchestrated rounds).
+
+            ### server_config.chaos — fault injection
+
+            #### server_config.chaos.infra — host-service streams
+
+            Refused with `wantRL` (host-orchestrated rounds).  Composes
+            with `scaffold` fleet paging (`tests/test_resilience.py`).
+            """,
+        "tests/test_resilience.py": _INFRA_CITED_TEST})
+    assert check_project(root) == []
+
+
+def test_guard_matrix_flags_infra_refusal_token_missing_from_docs(
+        tmp_path):
+    """PR 20 corpus (negative): the infra guard refuses without
+    `fused_carry` but the chaos section never mentions the token — the
+    operator-facing table silently lags the code."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _INFRA_SCHEMA,
+        "msrflute_tpu/engine/server.py": _INFRA_SERVER,
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`
+            and `scaffold` (host-orchestrated rounds).
+
+            ### server_config.chaos — fault injection
+
+            #### server_config.chaos.infra — host-service streams
+
+            Refused with a `ValueError` unless fleet paging is live
+            (`scaffold` / `ef_quant` device-carry strategies).
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "fused_carry" in found[0].message
+    assert found[0].path == "docs/config_extensions.md"
+
+
+def test_guard_matrix_flags_unenforced_infra_doc_promise(tmp_path):
+    """PR 20 corpus (negative): the docs promise chaos.infra is refused
+    without `fused_carry` fleet paging, but no runtime guard or schema
+    check enforces it — the code silently dropped a documented guard."""
+    from msrflute_tpu.analysis.guard_matrix import check_project
+    root = _consistent(tmp_path, **{
+        "msrflute_tpu/schema.py": _INFRA_SCHEMA,
+        "msrflute_tpu/engine/server.py": """\
+            class Server:
+                def __init__(self, sc, strategy):
+                    host_orchestrated = (
+                        sc.get("wantRL", False) or
+                        getattr(strategy, "host_rounds", False))
+                    if sc.get("robust") and host_orchestrated:
+                        raise ValueError(
+                            "server_config.robust requires the fused "
+                            "round path — wantRL and scaffold "
+                            "orchestrate rounds host-side")
+                    if (sc.get("chaos") or {}).get("infra") and \\
+                            sc.get("wantRL"):
+                        raise ValueError(
+                            "server_config.chaos.infra is refused "
+                            "under wantRL — host-orchestrated rounds "
+                            "bypass the fleet host services")
+            """,
+        "docs/config_extensions.md": """\
+            # extensions
+
+            ### server_config.robust — screened aggregation
+
+            Requires `strategy: fedavg`.  Incompatible with `wantRL`
+            and `scaffold` (host-orchestrated rounds).
+
+            ### server_config.chaos — fault injection
+
+            #### server_config.chaos.infra — host-service streams
+
+            Refused with `wantRL` and unless fleet paging is live
+            under `fused_carry`.
+            """})
+    found = check_project(root)
+    assert [f.rule for f in found] == ["guard-matrix"]
+    assert "fused_carry" in found[0].message
+    assert "no runtime guard" in found[0].message
 
 
 # ======================================================================

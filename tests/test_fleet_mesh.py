@@ -262,6 +262,110 @@ def test_megabatch_rides_sharded_fleet_plane_bitwise(tmp_path,
     np.testing.assert_array_equal(on, off)
 
 
+# ======================================================================
+# mesh-ELASTIC resume (ISSUE 20): save on M shards, resume on M'
+# ======================================================================
+def _run_mesh(cfg, tmp, ndev, seed=7):
+    from msrflute_tpu.parallel.mesh import make_mesh
+    ds = make_synthetic_classification()
+    server = select_server(cfg.server_config.get("type"))(
+        make_task(cfg.model_config), cfg, ds, model_dir=str(tmp),
+        mesh=make_mesh(num_devices=ndev), seed=seed)
+    state = server.train()
+    flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return flat, server, state
+
+
+def _carry_rows(server, n_users=16):
+    return {i: server.fleet_pager.user_row(i) for i in range(n_users)}
+
+
+def _assert_rows_equal(a, b):
+    for i in a:
+        if a[i] is None or b[i] is None:
+            assert a[i] is None and b[i] is None, i
+            continue
+        assert set(a[i]) == set(b[i]), i
+        for k in a[i]:
+            np.testing.assert_array_equal(a[i][k], b[i][k]), (i, k)
+
+
+def _elastic_legs(tmp_path, monkeypatch, *, cohort, mesh_a, slots_a,
+                  mesh_b, slots_b, zero_recompiles=True):
+    """Baseline on mesh_a uninterrupted; leg 1 on mesh_a preempted at
+    round 3; leg 2 RESUMES the same model_dir on mesh_b with a DIFFERENT
+    pool capacity — the pager re-quantizes slot geometry, rebuilds the
+    carry page tables, and replays the sampling trail."""
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    over = {"num_clients_per_iteration": cohort}
+    ref, srv_ref, _ = _run_mesh(
+        _cfg(0, fleet={"page_pool_slots": slots_a}, server_over=over),
+        tmp_path / "ref", mesh_a)
+
+    run_dir = tmp_path / "run"
+    over_pre = dict(over, chaos={"preempt_at_round": 3})
+    _, srv_pre, pre_state = _run_mesh(
+        _cfg(0, fleet={"page_pool_slots": slots_a}, server_over=over_pre),
+        run_dir, mesh_a)
+    assert srv_pre.preempted and pre_state.round == 3
+
+    events = []
+    import msrflute_tpu.engine.server as server_mod
+    real = server_mod.emit_event
+
+    def spy(scope, kind, **fields):
+        events.append((kind, fields))
+        return real(scope, kind, **fields)
+    monkeypatch.setattr(server_mod, "emit_event", spy)
+    over_res = dict(over_pre, resume_from_checkpoint=True)
+    res, srv_res, res_state = _run_mesh(
+        _cfg(0, fleet={"page_pool_slots": slots_b}, server_over=over_res),
+        run_dir, mesh_b)
+    assert res_state.round == 5 and not srv_res.preempted
+    elastic = [f for k, f in events if k == "elastic_resume"]
+    assert len(elastic) == 1
+    assert elastic[0]["from_slots"] == slots_a
+    assert elastic[0]["to_slots"] == slots_b
+    assert elastic[0]["mesh_shards"] == mesh_b
+    # no layout churn on the NEW mesh: every dispatch signature compiled
+    # exactly ONCE (a restored state whose placement differed from
+    # steady state would re-trace the same signature twice); with stable
+    # round geometry that means zero post-warmup recompiles outright
+    for fn in srv_res.engine._staged_cache.values():
+        n = (int(fn.cache_len) if hasattr(fn, "cache_len")
+             else int(fn._cache_size()))
+        assert n == 1
+    if zero_recompiles:
+        assert srv_res.engine.recompile_count == 0
+    # bitwise-equal final params AND per-client carry rows: the host row
+    # store is shard-agnostic and authoritative, the rebuilt pool pages
+    # it back in on demand
+    np.testing.assert_array_equal(ref, res)
+    _assert_rows_equal(_carry_rows(srv_ref), _carry_rows(srv_res))
+
+
+def test_elastic_resume_8_to_4_shards_bit_identical(tmp_path, monkeypatch):
+    """Fleet checkpoint saved on 8 virtual shards resumes on 4 with a
+    re-quantized pool — final params bitwise vs the uninterrupted
+    8-shard run (both meshes >= cohort, the geometry-constrained
+    bit-identity contract)."""
+    _elastic_legs(tmp_path, monkeypatch, cohort=4,
+                  mesh_a=MESH, slots_a=16, mesh_b=4, slots_b=8)
+
+
+def test_elastic_resume_8_to_1_shard_bit_identical(tmp_path, monkeypatch):
+    """Shrink-to-one: with cohort 1 the round reduction is a single
+    lane, so even the 8 -> 1 mesh change is bitwise invariant (a wider
+    cohort on mesh 1 re-associates the in-shard reduction — 1-ulp, the
+    documented contract boundary).  Mesh 1 pow2-quantizes each round's
+    grid individually (no 8-lane pad), so distinct per-round signatures
+    are expected — the elastic assertion is one compile per signature,
+    not one signature."""
+    _elastic_legs(tmp_path, monkeypatch, cohort=1,
+                  mesh_a=MESH, slots_a=16, mesh_b=1, slots_b=4,
+                  zero_recompiles=False)
+
+
 def test_scorecard_gains_flat_fleet_transfer_keys(tmp_path):
     cfg = _cfg(2, fleet={"enable": True},
                server_over={"telemetry": {"enable": True}})

@@ -188,3 +188,245 @@ def test_chaos_smoke_tool_fires_every_fault_class():
     assert record["chaos"]["enabled"] is True
     for key in ("dropped", "straggled", "steps_lost", "ckpt_io_faults"):
         assert record["fault_counters"][key] > 0
+
+
+# ======================================================================
+# flutearmor infrastructure-fault plane (ISSUE 20):
+# server_config.chaos.infra + the DurableIOLadder degradation table
+# ======================================================================
+def _fleet_cfg(chaos=None, depth=0, rounds=4, fleet=None, server_over=None):
+    """A paged-carry config the infra streams can target: strategy
+    ``scaffold`` with ``fused_carry`` fleet paging (the host services —
+    row store, prefetch daemon, writeback — only exist on this path)."""
+    sc = {
+        "max_iteration": rounds, "num_clients_per_iteration": 4,
+        "initial_lr_client": 0.2, "pipeline_depth": depth,
+        "fused_carry": True, "rounds_per_step": 1,
+        "optimizer_config": {"type": "sgd", "lr": 1.0},
+        "val_freq": 100, "initial_val": False, "data_config": {},
+        "fleet": fleet if fleet is not None else {"enable": True},
+    }
+    if chaos is not None:
+        sc["chaos"] = chaos
+    if server_over:
+        sc.update(server_over)
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "scaffold",
+        "server_config": sc,
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.2},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def _fleet_run(synth_dataset, tmp_path, tag, chaos=None, depth=0,
+               rounds=4, fleet=None):
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    cfg = _fleet_cfg(chaos=chaos, depth=depth, rounds=rounds, fleet=fleet)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                synth_dataset,
+                                model_dir=str(tmp_path / tag), seed=7)
+    state = server.train()
+    flat = np.asarray(ravel_pytree(jax.device_get(state.params))[0])
+    return server, flat
+
+
+def test_infra_streams_are_deterministic_independent_and_validated():
+    from msrflute_tpu.resilience.chaos import InfraFaults
+
+    a = InfraFaults(seed=2, store_write_error_rate=0.5,
+                    prefetch_delay_rate=0.5, prefetch_delay_s=0.01)
+    b = InfraFaults(seed=2, store_write_error_rate=0.5,
+                    prefetch_delay_rate=0.5, prefetch_delay_s=0.01)
+    seq_a = [a.fault("store_write") for _ in range(64)]
+    seq_b = [b.fault("store_write") for _ in range(64)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+    assert a.counters["store_write_faults"] == float(sum(seq_a))
+    # raising ANOTHER surface's rate never moves this stream (per-surface
+    # SeedSequence streams, like the corrupt_* contract)
+    c = InfraFaults(seed=2, store_write_error_rate=0.5,
+                    store_read_error_rate=0.9, prefetch_delay_rate=0.5,
+                    prefetch_delay_s=0.01)
+    assert [c.fault("store_write") for _ in range(64)] == seq_a
+    # the delay stream is seeded and counted too
+    d_a = [a.prefetch_delay() for _ in range(32)]
+    d_b = [b.prefetch_delay() for _ in range(32)]
+    assert d_a == d_b
+    assert any(d > 0 for d in d_a) and not all(d > 0 for d in d_a)
+    assert a.counters["prefetch_delays"] == float(
+        sum(1 for d in d_a if d > 0))
+    # hooks: a zero-rate surface has NO hook (zero overhead on the hot
+    # path); a firing hook raises OSError naming the surface
+    assert InfraFaults(seed=0).hook("writer") is None
+    with pytest.raises(OSError, match="writer"):
+        InfraFaults(seed=0, writer_error_rate=1.0).hook("writer")()
+    with pytest.raises(ValueError, match="store_read_error_rate"):
+        InfraFaults(store_read_error_rate=1.5)
+
+
+def test_make_chaos_parses_and_schema_validates_infra_block():
+    cfg = _cfg(chaos={"infra": {"store_write_error_rate": 0.5}})
+    sched = make_chaos(cfg.server_config)
+    assert sched is not None and sched.has_infra_faults
+    assert sched.infra.enabled
+    assert sched.describe()["infra"] is not None
+    # an all-zero infra block is inert (the zero-rate firewall)
+    inert = make_chaos(_cfg(
+        chaos={"dropout_rate": 0.1,
+               "infra": {"store_write_error_rate": 0.0}}).server_config)
+    assert not inert.has_infra_faults
+    # schema layer: non-mapping and out-of-range/unknown keys refuse at
+    # config load, not deep inside a fleet run
+    with pytest.raises(ValueError, match="infra"):
+        _cfg(chaos={"infra": 5})
+    with pytest.raises(ValueError, match="store_write_error_rate"):
+        _cfg(chaos={"infra": {"store_write_error_rate": 2.0}})
+    with pytest.raises(ValueError, match="unknown"):
+        _cfg(chaos={"infra": {"store_wirte_error_rate": 0.1}})
+
+
+def test_durable_ladder_degradation_table():
+    """The unified ladder's per-surface exhaustion modes — the
+    RUNBOOK "Infrastructure-fault drill" table, as code."""
+    from msrflute_tpu.resilience.integrity import (
+        CheckpointEscalationError, DurableIOError, DurableIOLadder,
+        RetryPolicy)
+
+    pol = RetryPolicy(retries=2, backoff_base_s=0.0, backoff_max_s=0.0,
+                      jitter=0.0, escalation_threshold=2)
+    lad = DurableIOLadder(policy=pol)
+    events = []
+    lad.event = lambda kind, **f: events.append((kind, f))
+
+    def boom():
+        raise OSError("disk on fire")
+
+    # success passes through; a transient blip is retried to success and
+    # every FAILED attempt lands a structured store_io_fault event
+    assert lad.run(lambda: None, surface="store_write") is True
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("blip")
+    assert lad.run(flaky, surface="store_write", what="row 3 spill") is True
+    assert [k for k, _ in events] == ["store_io_fault"]
+    assert events[0][1]["surface"] == "store_write"
+    assert "row 3 spill" in events[0][1]["what"]
+    # raise-mode (store read / writeback): exhaustion raises from the
+    # training thread — losing carry rows would corrupt training
+    with pytest.raises(DurableIOError, match="store_read"):
+        lad.run(boom, surface="store_read")
+    with pytest.raises(DurableIOError, match="writeback"):
+        lad.run(boom, surface="writeback")
+    # drop-mode (rollup writer): exhaustion returns False and emits NO
+    # store_io_fault (the rollup layer counts its own drops)
+    before = len(events)
+    assert lad.run(boom, surface="writer") is False
+    assert len(events) == before
+    # escalate-mode (spill / marker): keeps returning False until the
+    # consecutive-exhaustion budget is spent, then aborts the run
+    assert lad.run(boom, surface="marker") is False
+    with pytest.raises(CheckpointEscalationError):
+        lad.run(boom, surface="marker")
+    # a success resets the surface's escalator
+    lad2 = DurableIOLadder(policy=pol)
+    assert lad2.run(boom, surface="marker") is False
+    assert lad2.run(lambda: None, surface="marker") is True
+    assert lad2.escalators["marker"].consecutive == 0
+
+
+def test_rollup_writer_drop_is_counted_never_raised(tmp_path):
+    from msrflute_tpu.telemetry.rollup import RollupEngine
+
+    # a healthy engine appends; a broken out_dir (no such directory)
+    # drops-and-counts instead of raising into the host tail
+    ok = RollupEngine(str(tmp_path), window=1)
+    ok.observe_round(0, 1.0, 4.0)
+    assert ok.maybe_flush() is not None
+    assert ok.windows_dropped == 0
+
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the rollup dir should be")
+    eng = RollupEngine(str(blocked), window=1)
+    dropped = []
+    eng.on_drop = lambda rec: dropped.append(rec)
+    eng.observe_round(0, 1.0, 4.0)
+    rec = eng.maybe_flush()
+    assert rec is not None  # the record is built, only the append failed
+    assert eng.windows_dropped == 1
+    assert len(dropped) == 1 and dropped[0]["kind"] == "rollup"
+
+
+def test_infra_refused_without_fleet_paged_carry(synth_dataset, tmp_path):
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+
+    cfg = _cfg(chaos={"infra": {"store_write_error_rate": 0.1}})
+    with pytest.raises(ValueError, match="chaos.infra requires fleet"):
+        OptimizationServer(make_task(cfg.model_config), cfg, synth_dataset,
+                           model_dir=str(tmp_path), seed=0)
+
+
+def test_infra_faults_absorbed_bit_identical_and_counted(
+        synth_dataset, tmp_path, monkeypatch):
+    """The drill acceptance: a scaffold + fused_carry fleet run under
+    faults on EVERY infra surface finishes, counts each fault class,
+    and lands bit-identical params to the clean run — the retry ladder
+    absorbs the blips without ever touching model state."""
+    monkeypatch.setenv("MSRFLUTE_STRICT_TRANSFERS", "1")
+    # a 2-row host cache forces spill-through AND store reads at toy scale
+    fleet = {"enable": True, "host_cache_rows": 2, "spill_freq": 1}
+    _, clean = _fleet_run(synth_dataset, tmp_path, "clean", fleet=fleet)
+    chaos = {"seed": 3, "infra": {
+        "store_write_error_rate": 0.25,
+        "store_read_error_rate": 0.15,
+        "prefetch_delay_rate": 0.3, "prefetch_delay_s": 0.001,
+        "writeback_error_rate": 0.3,
+    }}
+    srv, faulty = _fleet_run(synth_dataset, tmp_path, "faulty",
+                             chaos=chaos, fleet=fleet)
+    counters = srv.chaos.infra.counters
+    assert counters["store_write_faults"] > 0
+    assert counters["store_read_faults"] > 0
+    assert counters["writeback_faults"] > 0
+    np.testing.assert_array_equal(clean, faulty)
+    # the scorecard carries the infra counters (the bench `infra`
+    # contract marker drains this)
+    card = srv.build_scorecard()
+    assert card["infra_faults"]["store_write_faults"] > 0
+
+
+def test_prefetch_daemon_death_degrades_to_cold_path(
+        synth_dataset, tmp_path, monkeypatch):
+    """A dying fleet-prefetch daemon must surface ONE structured
+    prefetch_degraded event and fall back permanently to cold-path
+    paging — bit-identical results, never a crashed run."""
+    import msrflute_tpu.engine.paging as paging_mod
+
+    events = []
+    real = paging_mod.emit_event
+
+    def spy(scope, kind, **fields):
+        events.append((kind, fields))
+        return real(scope, kind, **fields)
+    monkeypatch.setattr(paging_mod, "emit_event", spy)
+
+    _, clean = _fleet_run(synth_dataset, tmp_path, "clean", depth=2)
+    chaos = {"seed": 1, "infra": {"prefetch_error_rate": 1.0}}
+    srv, faulty = _fleet_run(synth_dataset, tmp_path, "faulty",
+                             chaos=chaos, depth=2)
+    assert srv.fleet_pager.prefetch_degradations == 1
+    assert srv.fleet_pager.prefetch_enabled is False
+    degr = [f for k, f in events if k == "prefetch_degraded"]
+    assert len(degr) == 1 and "error" in degr[0]
+    np.testing.assert_array_equal(clean, faulty)

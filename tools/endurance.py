@@ -53,6 +53,11 @@ sys.path.insert(0, REPO_ROOT)
 if "--fleet" in (sys.argv or []):
     from msrflute_tpu.utils.backend import force_cpu_backend
     force_cpu_backend(4)
+elif "--infra" in (sys.argv or []):
+    # the infra drill's mesh-elastic resume needs headroom to SHRINK:
+    # leg 1 trains on an 8-shard clients mesh, leg 2 resumes on 4
+    from msrflute_tpu.utils.backend import force_cpu_backend
+    force_cpu_backend(8)
 
 #: the chaos drill: every client-fault class live, plus the forced
 #: midpoint preemption the driver adds per-run
@@ -614,6 +619,196 @@ def run_fleet(rounds: int = 8, population: int = 1_000_000,
     return record
 
 
+def _infra_config(rounds: int, preempt_at, slots: int):
+    """The infrastructure-fault posture (RUNBOOK "Infrastructure-fault
+    drill"): the PROVEN mesh-elastic parity geometry (cohort 4 — both
+    meshes >= cohort) under faults on every host-service surface, with
+    a tiny host cache forcing spill-through so the store streams
+    actually fire, and a depth-3 pipeline so the fleet-prefetch daemon
+    ENGAGES (serial mode never stages ahead, so the prefetch-kill leg
+    of the drill needs the pipelined loop).
+
+    Client dropout/straggler chaos is deliberately OFF: those draws
+    are keyed per padded cohort slot, so their streams are
+    mesh-geometry-dependent and an 8-shard and a 4-shard run see
+    different fault schedules — which is chaos working as designed,
+    not an elastic-resume defect.  The drill's parity oracle needs the
+    fault plane whose WHOLE contract is "never touches model state":
+    infra faults plus checkpoint-IO faults, which are absorbed by the
+    retry ladders regardless of mesh shape."""
+    from msrflute_tpu.config import FLUTEConfig
+
+    telemetry = json.loads(json.dumps(TELEMETRY))
+    chaos = {"seed": CHAOS["seed"],
+             "ckpt_io_error_rate": CHAOS["ckpt_io_error_rate"]}
+    # the escalate/drop surfaces (spill, writer) tolerate hot rates;
+    # the RAISE surfaces (read, writeback) abort the run on retry
+    # exhaustion by design, so their rates stay low enough that the
+    # 4-attempt ladder absorbs every injected blip on the seeded stream
+    chaos["infra"] = {
+        "store_write_error_rate": 0.2,
+        "store_read_error_rate": 0.05,
+        # rate 1.0 KILLS the fleet-prefetch daemon on its first stage:
+        # the drill must cross the prefetch_degraded -> permanent
+        # cold-path fallback, not just absorb a blip
+        "prefetch_error_rate": 1.0,
+        "writer_error_rate": 0.2,
+        "writeback_error_rate": 0.05,
+    }
+    if preempt_at is not None:
+        chaos["preempt_at_round"] = preempt_at
+    return FLUTEConfig.from_dict({
+        "model_config": {"model_type": "LR", "num_classes": 4,
+                         "input_dim": 8},
+        "strategy": "scaffold",
+        "server_config": {
+            "max_iteration": rounds,
+            "num_clients_per_iteration": 4,
+            "initial_lr_client": 0.1,
+            "fused_carry": True,
+            "rounds_per_step": 1,
+            "pipeline_depth": 3,
+            "optimizer_config": {"type": "sgd", "lr": 1.0},
+            "val_freq": 100000, "initial_val": False,
+            "resume_from_checkpoint": True,
+            "data_config": {},
+            "chaos": chaos,
+            "checkpoint_retry": {"retries": 4, "backoff_base_s": 0.0,
+                                 "jitter": 0.0},
+            "fleet": {"page_pool_slots": slots, "host_cache_rows": 2,
+                      "spill_freq": 1},
+            "telemetry": telemetry,
+        },
+        "client_config": {
+            "optimizer_config": {"type": "sgd", "lr": 0.1},
+            "data_config": {"train": {"batch_size": 4}}},
+    })
+
+
+def run_infra(rounds: int = 12, num_users: int = 32,
+              out_dir: str | None = None,
+              report_path: str | None = None) -> dict:
+    """The infrastructure-fault drill (ISSUE 20 acceptance): a
+    scaffold + fused_carry fleet run on an 8-shard virtual mesh under
+    seeded faults on EVERY host-service surface (row-store spill/read,
+    a killed prefetch daemon, rollup writer, writeback fetch) plus
+    checkpoint-IO faults, forcibly preempted at the midpoint and resumed
+    on a FOUR-shard mesh with a re-quantized page pool.  Asserts:
+
+    - final params bit-identical to the never-preempted 8-shard run
+      under the same fault streams (the ladder absorbs every injected
+      blip without touching model state; the elastic resume re-derives
+      slot geometry without re-associating the round sum);
+    - every degradation is observable: the infra fault ledger counts
+      each surface, the dead daemon shows up as prefetch faults;
+    - ``tools/scope health --gate`` exits 0 over the run dir;
+
+    and emits a BENCH_INFRA trajectory record under ``extras.infra``.
+    """
+    os.environ.setdefault("MSRFLUTE_STRICT_TRANSFERS", "1")
+    import numpy as np
+    from jax.flatten_util import ravel_pytree
+
+    import jax
+    from msrflute_tpu.engine import OptimizationServer
+    from msrflute_tpu.models import make_task
+    from msrflute_tpu.parallel.mesh import make_mesh
+    from msrflute_tpu.telemetry.scope_cli import health, summarize
+    from msrflute_tpu.utils.logging import init_logging
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="infra_")
+    init_logging(out_dir)
+    dataset = _hetero_dataset(num_users)
+    preempt_at = max(rounds // 2, 1)
+    tic = time.time()
+
+    def _flat(state):
+        return np.asarray(
+            ravel_pytree(jax.device_get(state.params))[0])
+
+    # ---- reference: never-preempted 8-shard run, same fault streams --
+    cfg_ref = _infra_config(rounds, None, slots=32)
+    ref_state = OptimizationServer(
+        make_task(cfg_ref.model_config), cfg_ref, dataset,
+        model_dir=tempfile.mkdtemp(prefix="infra_ref_"),
+        mesh=make_mesh(num_devices=8), seed=0).train()
+    ref = _flat(ref_state)
+
+    # ---- leg 1: 8 shards into the forced preemption ------------------
+    cfg = _infra_config(rounds, preempt_at, slots=32)
+    server = OptimizationServer(make_task(cfg.model_config), cfg,
+                                dataset, model_dir=out_dir,
+                                mesh=make_mesh(num_devices=8), seed=0)
+    server.train()
+    assert server.preempted, "forced preemption never fired"
+    leg1 = dict(server.chaos.infra.counters)
+    assert leg1["store_write_faults"] > 0, leg1
+    assert leg1["prefetch_faults"] > 0, (
+        "the prefetch daemon was never faulted", leg1)
+
+    # ---- leg 2: resume on 4 shards with a re-quantized pool ----------
+    cfg2 = _infra_config(rounds, preempt_at, slots=16)
+    server2 = OptimizationServer(make_task(cfg2.model_config), cfg2,
+                                 dataset, model_dir=out_dir,
+                                 mesh=make_mesh(num_devices=4), seed=0)
+    res_state = server2.train()
+    wall = time.time() - tic
+    assert res_state.round == rounds, (res_state.round, rounds)
+    assert not server2.preempted
+    assert server2.fleet_pager.mesh_shards == 4, \
+        server2.fleet_pager.mesh_shards
+    res = _flat(res_state)
+    assert np.array_equal(ref, res), (
+        "8 -> 4 shard elastic resume under infra faults diverged from "
+        "the never-preempted 8-shard run")
+    leg2 = dict(server2.chaos.infra.counters)
+
+    # ---- the oracle --------------------------------------------------
+    verdict = health(out_dir)
+    assert verdict["ok"], ("infra drill must gate healthy", verdict)
+    assert verdict["rollup_windows"] >= 2, verdict
+
+    summary = summarize(out_dir)
+    card = (summary.get("scorecard") or {}) if isinstance(
+        summary.get("scorecard"), dict) else {}
+    assert (card.get("infra_faults") or {}).get(
+        "store_write_faults", 0) > 0, (
+        "scorecard must carry the infra fault ledger", card)
+    secs_p50 = card.get("round_secs_p50")
+    record = {
+        "kind": "infra",
+        "metric": "infra_secs_per_round",
+        "value": secs_p50,
+        "rounds": rounds,
+        "wall_secs": round(wall, 2),
+        "health": {"ok": verdict["ok"],
+                   "findings": verdict["findings"],
+                   "warnings": verdict["warnings"]},
+        "extras": {
+            "infra": {
+                "secs_per_round": secs_p50,
+                "rounds_per_hour": (round(3600.0 / secs_p50, 1)
+                                    if secs_p50 else None),
+                "mesh_shards_from": 8,
+                "mesh_shards_to": 4,
+                "pool_slots_from": 32,
+                "pool_slots_to": 16,
+                "fault_rates": cfg2.server_config["chaos"]["infra"],
+                "faults_leg1": leg1,
+                "faults_leg2": leg2,
+                "elastic_bit_identical": True,
+                "preempt_resume": True,
+            },
+        },
+    }
+    if report_path:
+        tmp = report_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(record, fh, indent=1, sort_keys=True)
+        os.replace(tmp, report_path)
+    return record
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     # None sentinel: each posture resolves its own default (40-round
@@ -630,6 +825,12 @@ def main(argv=None) -> int:
                          "(ISSUE 14); emits a BENCH_FLEET record")
     ap.add_argument("--fleet-population", type=int, default=1_000_000)
     ap.add_argument("--fleet-cohort", type=int, default=1024)
+    ap.add_argument("--infra", action="store_true",
+                    help="infrastructure-fault posture: fleet paging "
+                         "under faults on every host-service surface, "
+                         "a forced midpoint preempt and an 8 -> 4 shard "
+                         "mesh-elastic resume (ISSUE 20); emits a "
+                         "BENCH_INFRA record")
     ap.add_argument("--traffic", action="store_true",
                     help="flash-crowd posture: buffered FedBuff fired "
                          "by a seeded bursty arrival trace, preempt + "
@@ -638,6 +839,16 @@ def main(argv=None) -> int:
     ap.add_argument("--report", default=None,
                     help="write the trajectory record here")
     args = ap.parse_args(argv)
+    if args.infra:
+        record = run_infra(rounds=(12 if args.rounds is None
+                                   else args.rounds),
+                           num_users=args.users,
+                           out_dir=args.out_dir,
+                           report_path=args.report)
+        print(json.dumps(record, indent=1, sort_keys=True))
+        ok = record["health"]["ok"]
+        print("infra:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
     if args.traffic:
         record = run_traffic(rounds=(24 if args.rounds is None
                                      else args.rounds),
